@@ -1,0 +1,21 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the PaddlePaddle
+v1.6 "Fluid" contract (reference: /root/reference, Xreki/Paddle).
+
+The user-facing API mirrors ``paddle.fluid`` (Program/Block/Operator IR,
+Executor, layers, optimizers, ParallelExecutor/CompiledProgram, fleet), but the
+engine is built TPU-first: whole program blocks are lowered to XLA through JAX
+(an op -> lowering-rule table instead of per-op CUDA kernels), data parallelism
+is SPMD over a ``jax.sharding.Mesh`` (collective ops map to ``lax.psum`` and
+friends over ICI), and memory management is XLA buffer donation instead of an
+allocator/GC stack.
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import distributed  # noqa: F401
+
+# Fluid-style top-level conveniences (reference: python/paddle/__init__.py)
+from .fluid import framework as _framework  # noqa: F401

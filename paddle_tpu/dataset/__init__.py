@@ -1,0 +1,9 @@
+"""Built-in datasets (reference: python/paddle/dataset/ — mnist, cifar, imdb,
+... with auto-download). This environment has no network egress, so datasets
+are deterministic synthetic generators with the same sample shapes/dtypes and
+reader interface; point `set_data_dir` at real data to use it instead."""
+
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401

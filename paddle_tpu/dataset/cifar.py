@@ -1,0 +1,57 @@
+"""CIFAR readers (reference: python/paddle/dataset/cifar.py — yields
+(image[3072] in [0,1], label) samples). Synthetic label-correlated data."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_patterns10 = None
+_patterns100 = None
+
+
+def _pat(n_classes):
+    global _patterns10, _patterns100
+    if n_classes == 10:
+        if _patterns10 is None:
+            _patterns10 = np.random.RandomState(7).uniform(
+                0, 1, size=(10, 3072)
+            ).astype(np.float32)
+        return _patterns10
+    if _patterns100 is None:
+        _patterns100 = np.random.RandomState(8).uniform(
+            0, 1, size=(100, 3072)
+        ).astype(np.float32)
+    return _patterns100
+
+
+def _reader(n, n_classes, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        labels = rng.randint(0, n_classes, size=n).astype(np.int64)
+        pats = _pat(n_classes)
+        for i in range(n):
+            img = np.clip(
+                pats[labels[i]] * 0.6
+                + rng.normal(0, 0.2, 3072).astype(np.float32),
+                0.0,
+                1.0,
+            ).astype(np.float32)
+            yield img, int(labels[i])
+
+    return reader
+
+
+def train10():
+    return _reader(4096, 10, seed=20)
+
+
+def test10():
+    return _reader(512, 10, seed=21)
+
+
+def train100():
+    return _reader(4096, 100, seed=22)
+
+
+def test100():
+    return _reader(512, 100, seed=23)

@@ -1,0 +1,37 @@
+"""IMDB sentiment readers (reference: python/paddle/dataset/imdb.py — yields
+(word-id sequence, label) samples). Synthetic class-correlated sequences."""
+
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB_SIZE = 5148
+
+
+def word_dict():
+    return {("w%d" % i): i for i in range(VOCAB_SIZE)}
+
+
+def _reader(n, seed, max_len=100):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(10, max_len))
+            # positive reviews draw from the upper half of the vocab
+            lo, hi = (VOCAB_SIZE // 2, VOCAB_SIZE) if label else (0, VOCAB_SIZE // 2)
+            main = rng.randint(lo, hi, size=int(length * 0.7))
+            noise = rng.randint(0, VOCAB_SIZE, size=length - len(main))
+            seq = np.concatenate([main, noise])
+            rng.shuffle(seq)
+            yield seq.astype(np.int64).tolist(), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(2048, seed=30)
+
+
+def test(word_idx=None):
+    return _reader(256, seed=31)

@@ -1,0 +1,76 @@
+"""MNIST readers (reference: python/paddle/dataset/mnist.py — yields
+(image[784] in [-1,1], label int) samples).
+
+Without network egress, samples are synthetic but label-correlated (each
+digit class has a stable pattern + noise) so models genuinely learn and loss
+curves are meaningful."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_data_dir = None
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+
+
+def set_data_dir(path):
+    global _data_dir
+    _data_dir = path
+
+
+def _load_real(split):
+    if _data_dir is None:
+        return None
+    img_path = os.path.join(_data_dir, "%s_images.npy" % split)
+    lab_path = os.path.join(_data_dir, "%s_labels.npy" % split)
+    if os.path.exists(img_path) and os.path.exists(lab_path):
+        return np.load(img_path), np.load(lab_path)
+    return None
+
+
+_class_patterns = None
+
+
+def _patterns():
+    global _class_patterns
+    if _class_patterns is None:
+        rng = np.random.RandomState(42)
+        _class_patterns = rng.uniform(-1.0, 1.0, size=(10, 784)).astype(
+            np.float32
+        )
+    return _class_patterns
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int64)
+    pats = _patterns()
+    imgs = pats[labels] * 0.5 + rng.normal(
+        0, 0.3, size=(n, 784)
+    ).astype(np.float32)
+    imgs = np.clip(imgs, -1.0, 1.0).astype(np.float32)
+    return imgs, labels
+
+
+def _reader(split, n, seed):
+    def reader():
+        real = _load_real(split)
+        if real is not None:
+            imgs, labels = real
+        else:
+            imgs, labels = _synthetic(n, seed)
+        for i in range(len(labels)):
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def train():
+    return _reader("train", TRAIN_SIZE, seed=1)
+
+
+def test():
+    return _reader("test", TEST_SIZE, seed=2)

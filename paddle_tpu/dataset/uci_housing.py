@@ -1,0 +1,35 @@
+"""UCI housing readers (reference: python/paddle/dataset/uci_housing.py —
+yields (features[13], price) samples). Synthetic linear-plus-noise data with
+the real feature dimensionality when no local data is present."""
+
+from __future__ import annotations
+
+import numpy as np
+
+FEATURE_DIM = 13
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.normal(0, 1, size=(n, FEATURE_DIM)).astype(np.float32)
+    w = np.linspace(-2, 2, FEATURE_DIM).astype(np.float32)
+    y = (x @ w + 3.0 + rng.normal(0, 0.1, size=n)).astype(np.float32)
+    return x, y
+
+
+def train():
+    def reader():
+        x, y = _make(404, seed=10)
+        for i in range(len(y)):
+            yield x[i], np.asarray([y[i]], np.float32)
+
+    return reader
+
+
+def test():
+    def reader():
+        x, y = _make(102, seed=11)
+        for i in range(len(y)):
+            yield x[i], np.asarray([y[i]], np.float32)
+
+    return reader

@@ -1,0 +1,3 @@
+"""Distributed launch + host services (reference: python/paddle/distributed/)."""
+
+from . import launch  # noqa: F401

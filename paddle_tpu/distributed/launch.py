@@ -1,0 +1,131 @@
+"""Multi-process launcher (reference: python/paddle/distributed/launch.py —
+start_procs:147 spawns one proc per device and wires PADDLE_TRAINER_ID /
+PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINER_ENDPOINTS env).
+
+TPU-native: one process per HOST (each process owns its local chips through
+jax; per-chip parallelism is SPMD inside the process, not process-per-chip as
+with CUDA). The same env contract is kept, plus JAX_* coordinator vars so
+jax.distributed can bootstrap over DCN."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="paddle_tpu distributed launcher")
+    parser.add_argument(
+        "--cluster_node_ips", type=str, default="127.0.0.1",
+        help="comma-separated host ips",
+    )
+    parser.add_argument("--node_ip", type=str, default="127.0.0.1")
+    parser.add_argument("--started_port", type=int, default=6170)
+    parser.add_argument("--print_config", type=bool, default=True)
+    parser.add_argument(
+        "--nproc_per_node", type=int, default=1,
+        help="processes per node (default 1: SPMD owns all local chips)",
+    )
+    parser.add_argument("--selected_gpus", type=str, default=None)
+    parser.add_argument("--log_level", type=int, default=20)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument(
+        "training_script", type=str,
+        help="the training script followed by its arguments",
+    )
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def start_procs(args):
+    """reference: launch.py:147 start_procs."""
+    procs = []
+    log_fns = []
+    node_ips = [ip.strip() for ip in args.cluster_node_ips.split(",")]
+    node_id = node_ips.index(args.node_ip)
+    num_nodes = len(node_ips)
+    nproc = args.nproc_per_node
+    all_endpoints = [
+        "%s:%d" % (ip, args.started_port + i)
+        for ip in node_ips
+        for i in range(nproc)
+    ]
+    nranks = num_nodes * nproc
+    coordinator = "%s:%d" % (node_ips[0], args.started_port + 1000)
+
+    current_env = copy_env = dict(os.environ)
+    _ = copy_env
+    for i in range(nproc):
+        rank = node_id * nproc + i
+        current_endpoint = "%s:%d" % (args.node_ip, args.started_port + i)
+        proc_env = dict(current_env)
+        proc_env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_CURRENT_ENDPOINT": current_endpoint,
+                "PADDLE_TRAINERS_NUM": str(nranks),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(all_endpoints),
+                # jax.distributed bootstrap over DCN
+                "JAX_COORDINATOR_ADDRESS": coordinator,
+                "JAX_NUM_PROCESSES": str(nranks),
+                "JAX_PROCESS_ID": str(rank),
+            }
+        )
+        cmd = [sys.executable, "-u", args.training_script] + list(
+            args.training_script_args
+        )
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            fn = open("%s/workerlog.%d" % (args.log_dir, i), "w")
+            log_fns.append(fn)
+            proc = subprocess.Popen(cmd, env=proc_env, stdout=fn, stderr=fn)
+        else:
+            proc = subprocess.Popen(cmd, env=proc_env)
+        procs.append(proc)
+
+    try:
+        alive = True
+        error = False
+        while alive and not error:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    error = True
+            import time
+
+            time.sleep(0.25)
+        if error:
+            terminate_procs(procs)
+            sys.exit(1)
+    except KeyboardInterrupt:
+        terminate_procs(procs)
+        raise
+    finally:
+        for fn in log_fns:
+            fn.close()
+
+
+def terminate_procs(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+
+
+def launch():
+    args = _parse_args()
+    if args.print_config:
+        print(
+            "launch %d procs on node %s (of %s)"
+            % (args.nproc_per_node, args.node_ip, args.cluster_node_ips)
+        )
+    start_procs(args)
+
+
+if __name__ == "__main__":
+    launch()

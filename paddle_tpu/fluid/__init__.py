@@ -1,0 +1,139 @@
+"""paddle_tpu.fluid — the Fluid-contract API surface over the TPU engine
+(reference: python/paddle/fluid/__init__.py)."""
+
+from __future__ import annotations
+
+from . import core
+from . import framework
+from .framework import (
+    Program,
+    Variable,
+    Operator,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    in_dygraph_mode,
+    cpu_places,
+    cuda_places,
+    tpu_places,
+)
+from .core import (
+    CPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    TPUPlace,
+    LoDTensor,
+    LoDTensorArray,
+    Scope,
+)
+from . import initializer
+from . import layers
+from . import nets
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import metrics
+from . import backward
+from .backward import append_backward, gradients
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import unique_name
+from .executor import Executor, global_scope, scope_guard
+from .compiler import CompiledProgram, ExecutionStrategy, BuildStrategy
+from .parallel_executor import ParallelExecutor
+from .data_feeder import DataFeeder
+from . import io
+from .io import (
+    save_vars,
+    save_params,
+    save_persistables,
+    load_vars,
+    load_params,
+    load_persistables,
+    save_inference_model,
+    load_inference_model,
+    save,
+    load,
+)
+from . import reader
+from .reader import DataLoader, PyReader
+from . import dataset
+from . import dygraph
+from . import profiler
+from . import contrib
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from . import incubate
+from . import debugger
+from .debugger import set_check_nan_inf
+
+Tensor = LoDTensor
+
+
+def set_flags(flags):
+    for k, v in flags.items():
+        core.set_flag(k, v)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: core.get_flag(k) for k in flags}
+
+
+__all__ = [
+    "core",
+    "framework",
+    "Program",
+    "Variable",
+    "Operator",
+    "Parameter",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "name_scope",
+    "in_dygraph_mode",
+    "CPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
+    "TPUPlace",
+    "LoDTensor",
+    "LoDTensorArray",
+    "Scope",
+    "Tensor",
+    "initializer",
+    "layers",
+    "nets",
+    "optimizer",
+    "regularizer",
+    "clip",
+    "metrics",
+    "backward",
+    "append_backward",
+    "gradients",
+    "ParamAttr",
+    "WeightNormParamAttr",
+    "unique_name",
+    "Executor",
+    "global_scope",
+    "scope_guard",
+    "CompiledProgram",
+    "ExecutionStrategy",
+    "BuildStrategy",
+    "ParallelExecutor",
+    "DataFeeder",
+    "io",
+    "DataLoader",
+    "PyReader",
+    "dygraph",
+    "profiler",
+    "contrib",
+    "transpiler",
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "incubate",
+    "cpu_places",
+    "cuda_places",
+    "tpu_places",
+]

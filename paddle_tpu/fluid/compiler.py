@@ -1,0 +1,205 @@
+"""CompiledProgram — data-parallel execution via SPMD over a device mesh.
+
+Reference: python/paddle/fluid/compiler.py (CompiledProgram:65,
+with_data_parallel:138, _compile_data_parallel:274) driving the C++
+ParallelExecutor (parallel_executor.cc:398) that clones the graph per device
+and inserts AllReduceOpHandles per gradient.
+
+TPU-native redesign: there is no per-device graph cloning. The single block
+program is traced under ``jax.shard_map`` over a Mesh with a ``data`` axis:
+feeds are sharded on dim 0, state is replicated, and the collective
+transpiler's ``c_allreduce_sum`` ops on gradients lower to ``lax.psum`` over
+ICI. XLA inserts the collective schedule (latency-hiding) — the reference's
+fuse_all_reduce / all_reduce_deps passes have no equivalent work left to do.
+
+BuildStrategy / ExecutionStrategy are kept API-compatible; most knobs map to
+XLA behavior and are recorded but inert (SURVEY.md §2 #15).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import core
+from .framework import (
+    OP_ROLE_KEY,
+    OP_ROLE_VAR_KEY,
+    OpRole,
+)
+
+
+class ExecutionStrategy(object):
+    """reference: framework/details/execution_strategy.h:25-38."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+        self.allow_op_delay = False
+
+
+class BuildStrategy(object):
+    """reference: framework/details/build_strategy.h."""
+
+    class ReduceStrategy(object):
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy(object):
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = (
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        )
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_elewise_add_act_ops = False  # XLA fuses
+        self.fuse_bn_act_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False  # XLA all-reduce combiner
+        self.sync_batch_norm = False
+        self.memory_optimize = True  # donation; always on
+        self.enable_inplace = True
+        self.cache_runtime_context = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.trainers_endpoints = []
+        self.collective = None
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+
+
+class CompiledProgram(object):
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._exec_strategy = None
+        self._places = None
+        self._share_vars_from = None
+        self._compiled = None
+        self._mesh = None
+
+    def with_data_parallel(
+        self,
+        loss_name=None,
+        build_strategy=None,
+        exec_strategy=None,
+        share_vars_from=None,
+        places=None,
+    ):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    def with_inference_optimize(self, config):
+        return self
+
+    @property
+    def program(self):
+        return self._program
+
+    # -- execution ---------------------------------------------------------
+    def _device_count(self):
+        import jax
+
+        if self._places:
+            return len(self._places)
+        return max(jax.local_device_count(), 1)
+
+    def _get_mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import build_data_mesh
+
+            self._mesh = build_data_mesh(self._device_count())
+        return self._mesh
+
+    def _apply_grad_allreduce(self):
+        """Insert c_allreduce_sum on every param gradient + loss scaling —
+        the program-level contract of the reference's multi-device pass
+        (multi_devices_graph_pass.cc:454 CreateAllReduceOp, ScaleLossGrad at
+        :292,:514) realised with the collective transpiler (reference:
+        transpiler/collective.py:178 GradAllReduce)."""
+        from .transpiler.collective import GradAllReduce
+
+        if getattr(self._program, "_grad_allreduce_applied", False):
+            return
+        t = GradAllReduce(nrings=1)
+        t._transpile_main_program_inplace(
+            self._program, nranks=self._device_count(), loss_name=self._loss_name
+        )
+        self._program._grad_allreduce_applied = True
+
+    def _run(self, executor, feed=None, fetch_list=None, scope=None,
+             return_numpy=True):
+        from . import executor as _executor_mod
+
+        scope = scope or core.global_scope()
+        feed = dict(feed or {})
+        fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+        from .framework import Variable
+
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
+        ]
+        feed = {
+            k: (v.numpy() if isinstance(v, core.LoDTensor) else np.asarray(v))
+            for k, v in feed.items()
+        }
+
+        if not self._is_data_parallel or self._device_count() == 1:
+            return executor.run(
+                self._program,
+                feed=feed,
+                fetch_list=fetch_list,
+                scope=scope,
+                return_numpy=return_numpy,
+            )
+
+        self._apply_grad_allreduce()
+        mesh = self._get_mesh()
+        key = (
+            id(self._program),
+            self._program._version,
+            tuple(sorted(feed.keys())),
+            tuple(fetch_names),
+            "dp",
+        )
+        compiled = executor._cache.get(key)
+        if compiled is None or compiled.version != self._program._version:
+            compiled = _executor_mod._CompiledBlock(
+                self._program,
+                0,
+                list(feed.keys()),
+                fetch_names,
+                executor.place,
+                mesh_axes={"data": mesh.devices.size},
+                mesh=mesh,
+            )
+            executor._cache[key] = compiled
+        rng_key = executor._next_rng(self._program)
+        outs = compiled.run(scope, feed, rng_key, executor.place)
+        if return_numpy:
+            return [None if o is None else np.asarray(o) for o in outs]
+        return [
+            None if o is None else core.LoDTensor(np.asarray(o)) for o in outs
+        ]
+
+
+_ = (OP_ROLE_KEY, OP_ROLE_VAR_KEY, OpRole)  # re-exported for transpilers

@@ -1,0 +1,6 @@
+"""Contrib (reference: python/paddle/fluid/contrib/)."""
+
+from . import mixed_precision  # noqa: F401
+from .mixed_precision import decorate  # noqa: F401
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401

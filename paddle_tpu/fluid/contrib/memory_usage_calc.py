@@ -1,0 +1,28 @@
+"""Estimate program memory usage (reference:
+python/paddle/fluid/contrib/memory_usage_calc.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+
+DEBUG = False
+
+
+def memory_usage(program, batch_size=1):
+    """Rough per-batch activation+param bytes from var shapes (-1 dims take
+    batch_size). XLA fusion typically does better; this is the upper bound."""
+    total = 0.0
+    for var in program.list_vars():
+        if not var.shape:
+            continue
+        numel = 1
+        for s in var.shape:
+            numel *= batch_size if s < 0 else int(s)
+        try:
+            itemsize = np.dtype(core.dtype_to_np(var.dtype)).itemsize
+        except Exception:
+            itemsize = 4
+        total += numel * itemsize
+    return total / (1024.0 ** 2), "MB"

@@ -1,0 +1,89 @@
+"""AMP op lists (reference: contrib/mixed_precision/fp16_lists.py).
+
+white: ops that run in low precision (MXU-bound — matmul/conv),
+black: ops that must stay fp32 (reductions/losses/normalization statistics),
+gray: follow their inputs.
+
+On TPU the low-precision dtype is bfloat16 — same exponent range as fp32, so
+dynamic loss scaling is unnecessary (kept for API parity with the CUDA-era
+fp16 path)."""
+
+from __future__ import annotations
+
+white_list = {
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+    "mul",
+    "matmul",
+    "bmm",
+}
+
+black_list = {
+    "exp",
+    "square",
+    "log",
+    "mean",
+    "sum",
+    "cos_sim",
+    "softmax",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "cross_entropy",
+    "cross_entropy2",
+    "batch_norm",
+    "layer_norm",
+    "reduce_sum",
+    "reduce_mean",
+}
+
+gray_list = {
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "elementwise_mod",
+    "elementwise_floordiv",
+    "relu",
+    "relu6",
+    "leaky_relu",
+    "gelu",
+    "tanh",
+    "sigmoid",
+    "dropout",
+    "pool2d",
+    "reshape2",
+    "transpose2",
+    "concat",
+    "split",
+    "slice",
+    "stack",
+    "squeeze2",
+    "unsqueeze2",
+    "flatten2",
+    "pad",
+    "scale",
+    "cast",
+    "lookup_table",
+    "lookup_table_v2",
+}
+
+
+class AutoMixedPrecisionLists(object):
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        self.black_varnames = set(custom_black_varnames or [])
+        if custom_white_list:
+            for op in custom_white_list:
+                self.white_list.add(op)
+                self.black_list.discard(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                self.black_list.add(op)
+                self.white_list.discard(op)

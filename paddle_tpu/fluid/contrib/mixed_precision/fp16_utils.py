@@ -1,0 +1,201 @@
+"""AMP program rewrite + loss scaling (reference:
+contrib/mixed_precision/fp16_utils.py — rewrite_program:174 inserts cast ops
+per black/white lists; update_loss_scaling:300 dynamic scaling).
+
+TPU-native: the low-precision dtype is bf16. rewrite_program inserts cast
+ops at precision boundaries; XLA then keeps white chains in bf16 on the MXU.
+Dynamic loss scaling is expressed with in-graph isfinite/where ops so the
+whole AMP step remains one XLA program (the reference ran scaling update
+logic as separate ops too)."""
+
+from __future__ import annotations
+
+from ... import core
+from ...framework import OP_ROLE_KEY, OpRole
+from ... import unique_name
+
+_FLOAT_SLOTS_SKIP = {"LearningRate", "Mean", "Variance", "Beta1Pow", "Beta2Pow"}
+
+
+def _low_dtype(use_bf16=True):
+    return core.VarDesc.VarType.BF16 if use_bf16 else core.VarDesc.VarType.FP16
+
+
+def _insert_cast_op(block, idx, in_name, out_name, in_dtype, out_dtype):
+    block._insert_op(
+        idx,
+        type="cast",
+        inputs={"X": [in_name]},
+        outputs={"Out": [out_name]},
+        attrs={
+            "in_dtype": in_dtype,
+            "out_dtype": out_dtype,
+            OP_ROLE_KEY: OpRole.Forward,
+        },
+    )
+
+
+def rewrite_program(main_prog, amp_lists, use_bf16=True):
+    """Cast float inputs of white-list ops to bf16 and float inputs of
+    black-list ops back to fp32 (reference: fp16_utils.py:174)."""
+    low = _low_dtype(use_bf16)
+    block = main_prog.global_block()
+    cast_cache = {}  # (var, dtype) -> casted name
+    idx = 0
+    while idx < len(block.ops):
+        op_ = block.ops[idx]
+        target = None
+        if op_.type in amp_lists.white_list:
+            target = low
+        elif op_.type in amp_lists.black_list:
+            target = core.VarDesc.VarType.FP32
+        if target is None:
+            idx += 1
+            continue
+        n_insert = 0
+        for slot, names in list(op_.inputs.items()):
+            if slot in _FLOAT_SLOTS_SKIP:
+                continue
+            new_names = []
+            for name in names:
+                var = block._find_var_recursive(name)
+                if (
+                    var is None
+                    or var.dtype
+                    not in (core.VarDesc.VarType.FP32, core.VarDesc.VarType.BF16,
+                            core.VarDesc.VarType.FP16)
+                    or var.dtype == target
+                    or name in amp_lists.black_varnames
+                ):
+                    new_names.append(name)
+                    continue
+                key = (name, target)
+                if key not in cast_cache:
+                    cast_name = unique_name.generate(name + ".cast")
+                    block.create_var(
+                        name=cast_name,
+                        shape=var.shape,
+                        dtype=target,
+                        persistable=False,
+                    )
+                    _insert_cast_op(
+                        block, idx + n_insert, name, cast_name, var.dtype, target
+                    )
+                    n_insert += 1
+                    cast_cache[key] = cast_name
+                new_names.append(cast_cache[key])
+            op_.inputs[slot] = new_names
+        # outputs of white ops are low precision
+        if target == low:
+            for slot, names in op_.outputs.items():
+                for name in names:
+                    var = block._find_var_recursive(name)
+                    if var is not None and var.dtype == core.VarDesc.VarType.FP32:
+                        var.dtype = low
+        idx += n_insert + 1
+    main_prog._bump_version()
+
+
+def cast_parameters_to_bf16(program, scope=None):
+    """Optional weight cast for pure-bf16 training."""
+    import numpy as np
+
+    scope = scope or core.global_scope()
+    import jax.numpy as jnp
+
+    for p in program.all_parameters():
+        val = scope.get(p.name)
+        if val is not None and np.asarray(val).dtype == np.float32:
+            scope.set(p.name, jnp.asarray(val, jnp.bfloat16))
+            p.dtype = core.VarDesc.VarType.BF16
+
+
+def scale_loss(loss, loss_scaling_var):
+    from ...layers import nn as lnn
+
+    return lnn.elementwise_mul(loss, loss_scaling_var)
+
+
+def unscale_grads(params_grads, loss_scaling_var):
+    from ...layers import nn as lnn
+
+    out = []
+    for p, g in params_grads:
+        if g is None:
+            out.append((p, g))
+        else:
+            out.append((p, lnn.elementwise_div(g, loss_scaling_var)))
+    return out
+
+
+def update_loss_scaling(
+    grads,
+    loss_scaling_var,
+    good_steps_var,
+    incr_every_n_steps,
+    decr_every_n_nan_or_inf,
+    incr_ratio,
+    decr_ratio,
+):
+    """In-graph dynamic loss-scale update (reference: fp16_utils.py:300).
+    Returns the all-finite predicate var; caller multiplies grads by it to
+    mask non-finite steps (the XLA-friendly form of "skip the update")."""
+    from ...layers import tensor as ltensor
+    from ...layers import nn as lnn
+    from ...layer_helper import LayerHelper
+
+    helper = LayerHelper("update_loss_scaling")
+    finite = None
+    for _, g in grads:
+        if g is None:
+            continue
+        f = ltensor.isfinite(g)
+        finite = f if finite is None else lnn.logical_and(finite, f)
+    if finite is None:
+        return None
+
+    one = ltensor.fill_constant([1], "float32", 1.0)
+    zero = ltensor.fill_constant([1], "float32", 0.0)
+    finite_f = ltensor.cast(finite, "float32")
+
+    # good_steps = finite ? good_steps+1 : 0
+    inc = lnn.elementwise_add(good_steps_var, one)
+    new_good = lnn.elementwise_mul(inc, finite_f)
+
+    # grow when good_steps reaches threshold
+    thresh = ltensor.fill_constant([1], "float32", float(incr_every_n_steps))
+    from ...layers import control_flow as cf
+
+    grow = ltensor.cast(cf.greater_equal(new_good, thresh), "float32")
+    grown = lnn.elementwise_mul(
+        loss_scaling_var, ltensor.fill_constant([1], "float32", incr_ratio)
+    )
+    shrunk = lnn.elementwise_mul(
+        loss_scaling_var, ltensor.fill_constant([1], "float32", decr_ratio)
+    )
+    # new_scale = finite ? (grow ? grown : scale) : shrunk
+    kept = lnn.elementwise_add(
+        lnn.elementwise_mul(grown, grow),
+        lnn.elementwise_mul(loss_scaling_var, lnn.elementwise_sub(one, grow)),
+    )
+    new_scale = lnn.elementwise_add(
+        lnn.elementwise_mul(kept, finite_f),
+        lnn.elementwise_mul(shrunk, lnn.elementwise_sub(one, finite_f)),
+    )
+    # reset good counter after growth
+    new_good = lnn.elementwise_mul(new_good, lnn.elementwise_sub(one, grow))
+
+    helper.append_op(
+        type="assign",
+        inputs={"X": [new_scale]},
+        outputs={"Out": [loss_scaling_var]},
+        attrs={OP_ROLE_KEY: OpRole.Optimize},
+    )
+    helper.append_op(
+        type="assign",
+        inputs={"X": [new_good]},
+        outputs={"Out": [good_steps_var]},
+        attrs={OP_ROLE_KEY: OpRole.Optimize},
+    )
+    _ = zero
+    return finite_f

@@ -1,0 +1,426 @@
+"""Runtime core: places, dtypes, Scope, LoDTensor.
+
+TPU-native replacement for the reference's C++ core exposed through pybind
+(reference: paddle/fluid/pybind/pybind.cc, paddle/fluid/platform/place.h:26-58,
+paddle/fluid/framework/scope.h:46, paddle/fluid/framework/lod_tensor.h:52-104).
+
+Here the "device runtime" is JAX/XLA: a Place names a jax device class, a
+Scope maps variable names to host/device arrays (jax.Array), and LoDTensor is
+a thin ragged-batch wrapper (level-of-detail offsets + dense padded storage).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# dtype enum — mirrors the proto VarType.Type numbering, which is the
+# serialization contract (reference: paddle/fluid/framework/framework.proto:105-137).
+# ---------------------------------------------------------------------------
+class VarDesc(object):
+    class VarType(object):
+        BOOL = 0
+        INT16 = 1
+        INT32 = 2
+        INT64 = 3
+        FP16 = 4
+        FP32 = 5
+        FP64 = 6
+        # Tensor-ish containers
+        LOD_TENSOR = 7
+        SELECTED_ROWS = 8
+        FEED_MINIBATCH = 9
+        FETCH_LIST = 10
+        STEP_SCOPES = 11
+        LOD_RANK_TABLE = 12
+        LOD_TENSOR_ARRAY = 13
+        PLACE_LIST = 14
+        READER = 15
+        RAW = 17
+        TUPLE = 18
+        SIZE_T = 19
+        UINT8 = 20
+        INT8 = 21
+        # TPU-native extension: bf16 is the preferred mixed-precision dtype on
+        # the MXU (the reference, CUDA-era, only had FP16).
+        BF16 = 22
+
+
+_DTYPE_TO_NP = {
+    VarDesc.VarType.BOOL: np.bool_,
+    VarDesc.VarType.INT16: np.int16,
+    VarDesc.VarType.INT32: np.int32,
+    VarDesc.VarType.INT64: np.int64,
+    VarDesc.VarType.FP16: np.float16,
+    VarDesc.VarType.FP32: np.float32,
+    VarDesc.VarType.FP64: np.float64,
+    VarDesc.VarType.UINT8: np.uint8,
+    VarDesc.VarType.INT8: np.int8,
+    VarDesc.VarType.SIZE_T: np.uint64,
+}
+
+_NP_TO_DTYPE = {np.dtype(v): k for k, v in _DTYPE_TO_NP.items()}
+
+
+def dtype_to_np(dtype):
+    """fluid dtype enum (or string / np.dtype) -> numpy dtype."""
+    if dtype == VarDesc.VarType.BF16 or dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    if isinstance(dtype, int):
+        return np.dtype(_DTYPE_TO_NP[dtype])
+    if isinstance(dtype, str):
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def np_to_dtype(np_dtype):
+    """numpy dtype (or string) -> fluid dtype enum."""
+    if str(np_dtype) == "bfloat16":
+        return VarDesc.VarType.BF16
+    return _NP_TO_DTYPE[np.dtype(np_dtype)]
+
+
+def convert_np_dtype_to_dtype_(np_dtype):
+    return np_to_dtype(np_dtype)
+
+
+def dtype_is_floating(dtype):
+    if not isinstance(dtype, int):
+        dtype = np_to_dtype(dtype)
+    return dtype in (
+        VarDesc.VarType.FP16,
+        VarDesc.VarType.FP32,
+        VarDesc.VarType.FP64,
+        VarDesc.VarType.BF16,
+    )
+
+
+def dtype_name(dtype):
+    if dtype == VarDesc.VarType.BF16:
+        return "bfloat16"
+    return np.dtype(_DTYPE_TO_NP[dtype]).name
+
+
+# ---------------------------------------------------------------------------
+# Places (reference: paddle/fluid/platform/place.h:26-58). On TPU the only
+# real device class is the TPU chip grid managed by XLA; CPUPlace maps to the
+# jax cpu backend (used by tests and as the reference backend).
+# ---------------------------------------------------------------------------
+class Place(object):
+    _kind = "undefined"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(
+            self, "_device_id", None
+        ) == getattr(other, "_device_id", None)
+
+    def __hash__(self):
+        return hash((self._kind, getattr(self, "_device_id", None)))
+
+    def __repr__(self):
+        return "%s()" % type(self).__name__
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+    def __init__(self, device_id=0):
+        self._device_id = int(device_id)
+
+    def __repr__(self):
+        return "TPUPlace(%d)" % self._device_id
+
+
+class CUDAPlace(TPUPlace):
+    """Compatibility alias: scripts written against the reference swap
+    ``CUDAPlace(0)`` for ``TPUPlace(0)``; accepting the old spelling makes the
+    swap optional."""
+
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def _jax_backend_for(place):
+    """Resolve a Place to a jax backend name that is actually available."""
+    import jax
+
+    if isinstance(place, TPUPlace):
+        for backend in ("tpu", "axon"):
+            try:
+                jax.devices(backend)
+                return backend
+            except RuntimeError:
+                continue
+        return None  # default backend (whatever jax picked)
+    return "cpu"
+
+
+def get_jax_device(place):
+    import jax
+
+    backend = _jax_backend_for(place)
+    devices = jax.devices(backend) if backend else jax.devices()
+    idx = getattr(place, "_device_id", 0)
+    return devices[idx % len(devices)]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def get_tpu_device_count():
+    import jax
+
+    backend = _jax_backend_for(TPUPlace(0))
+    try:
+        return len(jax.devices(backend) if backend else jax.devices())
+    except RuntimeError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# LoDTensor — ragged sequence batch: dense storage + level-of-detail offsets
+# (reference: paddle/fluid/framework/lod_tensor.h:52 LoD, :104 LoDTensor).
+# ---------------------------------------------------------------------------
+class LoDTensor(object):
+    def __init__(self, array=None, lod=None, place=None):
+        self._array = None if array is None else np.asarray(array)
+        self._lod = [list(level) for level in (lod or [])]
+        self._place = place or CPUPlace()
+
+    # -- fluid pybind API surface (pybind.cc:402-539) --
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+        if place is not None:
+            self._place = place
+
+    def set_lod(self, lod):
+        self._lod = [list(level) for level in lod]
+
+    def lod(self):
+        return [list(level) for level in self._lod]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = [_lengths_to_offsets(level) for level in lengths]
+
+    def recursive_sequence_lengths(self):
+        return [_offsets_to_lengths(level) for level in self._lod]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        try:
+            n = self._lod[-1][-1]
+        except IndexError:
+            return False
+        return self._array is None or n == self._array.shape[0]
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+    def _dtype(self):
+        return self._array.dtype if self._array is not None else None
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype is not None else a
+
+    def numpy(self):
+        return np.asarray(self._array)
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, lod=%s)" % (self.shape(), self._lod)
+
+
+def _lengths_to_offsets(lengths):
+    out = [0]
+    for n in lengths:
+        out.append(out[-1] + int(n))
+    return out
+
+
+def _offsets_to_lengths(offsets):
+    return [int(offsets[i + 1] - offsets[i]) for i in range(len(offsets) - 1)]
+
+
+class LoDTensorArray(list):
+    """Array of LoDTensors (reference: framework/lod_tensor_array.h)."""
+
+
+class SelectedRows(object):
+    """Row-sparse tensor: (rows, value) pair used for embedding gradients
+    (reference: paddle/fluid/framework/selected_rows.h:32)."""
+
+    def __init__(self, rows=None, height=0, value=None):
+        self.rows = list(rows or [])
+        self.height = int(height)
+        self.value = value  # np/jax array [len(rows), ...dims]
+
+    def to_dense(self):
+        import numpy as _np
+
+        dense = _np.zeros((self.height,) + tuple(self.value.shape[1:]), self.value.dtype)
+        _np.add.at(dense, _np.asarray(self.rows), _np.asarray(self.value))
+        return dense
+
+
+# ---------------------------------------------------------------------------
+# Scope — hierarchical name -> variable-value map
+# (reference: paddle/fluid/framework/scope.h:46).
+# ---------------------------------------------------------------------------
+class _ScopeVar(object):
+    __slots__ = ("name", "value")
+
+    def __init__(self, name, value=None):
+        self.name = name
+        self.value = value  # jax.Array | np.ndarray | LoDTensor | SelectedRows | py obj
+
+    def get_tensor(self):
+        if isinstance(self.value, LoDTensor):
+            return self.value
+        t = LoDTensor()
+        if self.value is not None:
+            t.set(np.asarray(self.value))
+        # writes through: scope var now holds the LoDTensor wrapper
+        self.value = t
+        return t
+
+    def set_value(self, value):
+        self.value = value
+
+
+class Scope(object):
+    def __init__(self, parent=None):
+        self._vars = {}
+        self._parent = parent
+        self._kids = []
+        self._lock = threading.Lock()
+
+    def var(self, name):
+        with self._lock:
+            if name not in self._vars:
+                self._vars[name] = _ScopeVar(name)
+            return self._vars[name]
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            v = s._vars.get(name)
+            if v is not None:
+                return v
+            s = s._parent
+        return None
+
+    def erase(self, names):
+        with self._lock:
+            for n in names:
+                self._vars.pop(n, None)
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    # -- convenience used by the executor --
+    def get(self, name, default=None):
+        v = self.find_var(name)
+        return default if v is None else v.value
+
+    def set(self, name, value):
+        self.var(name).set_value(value)
+
+    def has(self, name):
+        return self.find_var(name) is not None
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def _switch_scope(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old
+
+
+# ---------------------------------------------------------------------------
+# Flags — gflags-compatible env parsing (reference: platform/flags.cc,
+# python/paddle/fluid/__init__.py:162-210 env whitelist).
+# ---------------------------------------------------------------------------
+_FLAGS_DEFAULTS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,  # functional engine: always eager
+    "FLAGS_allocator_strategy": "xla",  # XLA owns device memory on TPU
+    "FLAGS_use_system_allocator": False,
+    "FLAGS_cudnn_deterministic": True,  # XLA is deterministic by construction
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_sync_nccl_allreduce": True,
+    "FLAGS_fraction_of_gpu_memory_to_use": 1.0,
+}
+
+_flags = {}
+
+
+def globals_flags():
+    return dict(_FLAGS_DEFAULTS, **_flags)
+
+
+def get_flag(name):
+    if name in _flags:
+        return _flags[name]
+    env = os.environ.get(name)
+    if env is not None:
+        default = _FLAGS_DEFAULTS.get(name)
+        if isinstance(default, bool):
+            return env.lower() in ("1", "true", "yes")
+        if isinstance(default, float):
+            return float(env)
+        if isinstance(default, int):
+            return int(env)
+        return env
+    return _FLAGS_DEFAULTS.get(name)
+
+
+def set_flag(name, value):
+    _flags[name] = value
+
+
+def init_gflags(args):
+    for a in args:
+        a = a.lstrip("-")
+        if "=" in a:
+            k, v = a.split("=", 1)
+            set_flag(k, v)
+
+
+def init_glog(_prog):
+    pass
+
+
+def init_devices():
+    pass

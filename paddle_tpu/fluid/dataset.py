@@ -1,0 +1,143 @@
+"""fluid.dataset — file-based training ingest
+(reference: python/paddle/fluid/dataset.py over C++ Dataset/DataFeed,
+framework/data_set.h:41-226, data_feed.h:61-532 with distributed shuffle).
+
+TPU-native: datasets produce numpy batches on host threads; "global shuffle"
+across workers shuffles file assignment by worker rank (the reference's
+fleet-coordinated shuffle, without the pserver round-trip)."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetFactory(object):
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        return QueueDataset()
+
+
+class DatasetBase(object):
+    def __init__(self):
+        self.filelist = []
+        self.use_var = []
+        self.batch_size = 1
+        self.thread_num = 1
+        self.pipe_command = "cat"
+        self._parse_fn = None
+        self._rank = 0
+        self._nranks = 1
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self.thread_num = thread_num
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_var = var_list
+
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_parse_fn(self, fn):
+        """TPU extension: a python line-parser replacing the C++
+        MultiSlotDataFeed proto parsing (data_feed.proto)."""
+        self._parse_fn = fn
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs = (fs_name, fs_ugi)
+
+    def _default_parse(self, line):
+        parts = line.strip().split()
+        return [np.asarray([float(p)]) for p in parts]
+
+    def _iter_samples(self):
+        files = [
+            f
+            for i, f in enumerate(self.filelist)
+            if i % self._nranks == self._rank
+        ]
+        parse = self._parse_fn or self._default_parse
+        for path in files:
+            with open(path, "r") as f:
+                for line in f:
+                    yield parse(line)
+
+    def _iter_batches(self):
+        slots = None
+        count = 0
+        for sample in self._iter_samples():
+            if slots is None:
+                slots = [[] for _ in sample]
+            for i, field in enumerate(sample):
+                slots[i].append(field)
+            count += 1
+            if count == self.batch_size:
+                yield [np.asarray(s) for s in slots]
+                slots, count = None, 0
+        if slots and count:
+            yield [np.asarray(s) for s in slots]
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference: data_feed.h MultiSlotDataFeed)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffle"
+        )
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffle"
+        )
+
+
+class InMemoryDataset(DatasetBase):
+    """Loaded-then-shuffled dataset (reference: data_set.h InMemoryDataset,
+    load_into_memory/local_shuffle/global_shuffle)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        self._samples = list(super()._iter_samples())
+        self._loaded = True
+
+    def local_shuffle(self):
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # same-seed permutation of file assignment on every worker; each
+        # worker keeps its rank's share (reference coordinates via fleet)
+        rng = random.Random(len(self.filelist))
+        rng.shuffle(self.filelist)
+        if self._loaded:
+            self.load_into_memory()
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def _iter_samples(self):
+        if not self._loaded:
+            self.load_into_memory()
+        return iter(self._samples)

@@ -1,0 +1,44 @@
+"""Numerical debugging (reference: python/paddle/fluid/debugger.py pretty
+program dumps; NaN/Inf checking at operator.cc:945-956 FLAGS_check_nan_inf).
+
+TPU-native: NaN checking maps to jax debug_nans plus an executor-level
+post-run fetch scan when FLAGS_check_nan_inf is set."""
+
+from __future__ import annotations
+
+from . import core
+
+__all__ = ["pprint_program_codes", "draw_block_graphviz", "set_check_nan_inf"]
+
+
+def set_check_nan_inf(enabled=True):
+    """Enable jax debug_nans — the XLA-native equivalent of
+    FLAGS_check_nan_inf's per-op output scan."""
+    core.set_flag("FLAGS_check_nan_inf", bool(enabled))
+    try:
+        import jax
+
+        jax.config.update("jax_debug_nans", bool(enabled))
+    except Exception:
+        pass
+
+
+def pprint_program_codes(program):
+    print(program.to_string())
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Emit a graphviz dot of a block (reference: debugger.py
+    draw_block_graphviz, ir/graph_viz_pass.cc)."""
+    lines = ["digraph G {"]
+    for i, op_ in enumerate(block.ops):
+        op_node = 'op_%d [label="%s", shape=box]' % (i, op_.type)
+        lines.append(op_node)
+        for n in op_.input_arg_names:
+            lines.append('"%s" -> op_%d' % (n, i))
+        for n in op_.output_arg_names:
+            lines.append('op_%d -> "%s"' % (i, n))
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path

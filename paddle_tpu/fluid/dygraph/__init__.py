@@ -1,0 +1,47 @@
+"""Dygraph (eager) mode (reference: paddle/fluid/imperative/ C++ engine +
+python/paddle/fluid/dygraph/).
+
+TPU-native: eager mode IS jax — VarBase wraps a jax.Array, Tracer.trace_op
+executes each op's lowering rule immediately (ops dispatch through the same
+registry as the static engine, mirroring the reference where dygraph reuses
+the kernel registry via PreparedOp, imperative/prepared_operator.h:31) and
+records the tape for BasicEngine-style backward."""
+
+from . import base
+from .base import (  # noqa: F401
+    guard,
+    enabled,
+    enable_dygraph,
+    disable_dygraph,
+    to_variable,
+    no_grad,
+    grad,
+)
+from .layers import Layer  # noqa: F401
+from .nn import (  # noqa: F401
+    Conv2D,
+    Pool2D,
+    Linear,
+    FC,
+    BatchNorm,
+    Embedding,
+    LayerNorm,
+    Dropout,
+    PRelu,
+    GroupNorm,
+    SpectralNorm,
+)
+from .tracer import Tracer, VarBase  # noqa: F401
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    NoamDecay,
+    PiecewiseDecay,
+    NaturalExpDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    PolynomialDecay,
+    CosineDecay,
+)
+from . import jit  # noqa: F401
+from .jit import TracedLayer  # noqa: F401

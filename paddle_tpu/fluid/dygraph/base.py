@@ -1,0 +1,172 @@
+"""Dygraph mode switches (reference: python/paddle/fluid/dygraph/base.py:100
+guard, to_variable)."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .. import core
+from .. import framework
+from .tracer import Tracer, VarBase
+
+
+def _current_tracer():
+    return framework._dygraph_tracer_
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+_global_tracer = None
+
+
+def enable_dygraph(place=None):
+    global _global_tracer
+    _global_tracer = Tracer()
+    framework._dygraph_tracer_ = _global_tracer
+    framework._dygraph_current_expected_place_ = place or core.CPUPlace()
+
+
+def disable_dygraph():
+    global _global_tracer
+    framework._dygraph_tracer_ = None
+    _global_tracer = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    tracer = Tracer()
+    with framework._dygraph_guard(tracer):
+        with framework._dygraph_place_guard(place or core.CPUPlace()):
+            yield
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    import jax.numpy as jnp
+
+    arr = np.asarray(value)
+    device = core.get_jax_device(framework._current_expected_place())
+    import jax
+
+    jarr = jax.device_put(arr, device)
+    return VarBase(jarr, name=name, stop_gradient=True)
+
+
+@contextlib.contextmanager
+def _no_grad_ctx():
+    tracer = _current_tracer()
+    if tracer is None:
+        yield
+        return
+    old = tracer._no_grad
+    tracer._no_grad = True
+    try:
+        yield
+    finally:
+        tracer._no_grad = old
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return _no_grad_ctx()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _no_grad_ctx():
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Eager jax-backed grad for dygraph tensors."""
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    for o in outputs:
+        o.backward()
+    return [i.grad for i in inputs]
+
+
+def _create_parameter_eager(attr, shape, dtype, initializer):
+    """LayerHelper.create_parameter in dygraph mode: run the initializer op
+    eagerly instead of appending to the startup program."""
+    from ..ops.registry import LowerCtx, _FakeOp
+    from ..ops import registry as _registry
+    import jax
+
+    tracer = _current_tracer()
+    name = attr.name or framework.unique_name.generate("eager_param")
+    # build the init op spec by letting the initializer write into a scratch
+    # static block? Simpler: map known initializer classes to direct sampling.
+    from .. import initializer as I
+
+    np_dtype = core.dtype_to_np(dtype if isinstance(dtype, int) else core.np_to_dtype(np.dtype(dtype)))
+    key = tracer._next_key() if tracer else jax.random.key(0)
+    shape = [int(s) for s in shape]
+    if isinstance(initializer, I.ConstantInitializer):
+        value = jax.numpy.full(shape, initializer.value, np_dtype)
+    elif isinstance(initializer, I.UniformInitializer):
+        value = jax.random.uniform(
+            key, shape, np_dtype, minval=initializer.low, maxval=initializer.high
+        )
+    elif isinstance(initializer, I.NormalInitializer):
+        value = (
+            jax.random.normal(key, shape, np_dtype) * initializer.scale
+            + initializer.loc
+        )
+    elif isinstance(initializer, I.TruncatedNormalInitializer):
+        value = (
+            jax.random.truncated_normal(key, -2.0, 2.0, shape, np_dtype)
+            * initializer.scale
+            + initializer.loc
+        )
+    elif isinstance(initializer, (I.XavierInitializer, I.MSRAInitializer)):
+        fi, fo = I._fans(_ShapeVar(shape))
+        if isinstance(initializer, I.XavierInitializer):
+            fi = initializer.fan_in or fi
+            fo = initializer.fan_out or fo
+            if initializer.uniform:
+                limit = float(np.sqrt(6.0 / (fi + fo)))
+                value = jax.random.uniform(key, shape, np_dtype, -limit, limit)
+            else:
+                std = float(np.sqrt(2.0 / (fi + fo)))
+                value = jax.random.normal(key, shape, np_dtype) * std
+        else:
+            fi = initializer.fan_in or fi
+            if initializer.uniform:
+                limit = float(np.sqrt(6.0 / fi))
+                value = jax.random.uniform(key, shape, np_dtype, -limit, limit)
+            else:
+                std = float(np.sqrt(2.0 / fi))
+                value = jax.random.normal(key, shape, np_dtype) * std
+    elif isinstance(initializer, I.NumpyArrayInitializer):
+        value = jax.numpy.asarray(initializer.value.reshape(shape), np_dtype)
+    else:
+        value = jax.numpy.zeros(shape, np_dtype)
+    p = VarBase(
+        value,
+        name=name,
+        persistable=True,
+        stop_gradient=not attr.trainable,
+        is_parameter=True,
+    )
+    p.trainable = attr.trainable
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    _ = (LowerCtx, _FakeOp, _registry)
+    return p
+
+
+class _ShapeVar(object):
+    def __init__(self, shape):
+        self.shape = tuple(shape)

@@ -1,0 +1,55 @@
+"""Dygraph-to-static capture (reference: python/paddle/fluid/dygraph/jit.py
+TracedLayer over imperative/jit/program_desc_tracer.cc).
+
+TPU-native: a TracedLayer jit-compiles the layer's forward with jax — the
+"static program" is the XLA executable itself."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import guard, to_variable
+from .tracer import VarBase
+
+
+class TracedLayer(object):
+    def __init__(self, layer, feed_vars=None):
+        self._layer = layer
+        self._compiled = None
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer)
+        outs = layer(*inputs)
+        return outs, tl
+
+    def __call__(self, *inputs):
+        import jax
+
+        if self._compiled is None:
+            layer = self._layer
+
+            def fn(*arrs):
+                with guard():
+                    vb_inputs = [VarBase(a, stop_gradient=True) for a in arrs]
+                    out = layer(*vb_inputs)
+                    if isinstance(out, (list, tuple)):
+                        return tuple(o.value for o in out)
+                    return out.value
+
+            self._compiled = jax.jit(fn)
+        arrs = [
+            i.value if isinstance(i, VarBase) else np.asarray(i) for i in inputs
+        ]
+        out = self._compiled(*arrs)
+        if isinstance(out, tuple):
+            return [VarBase(o, stop_gradient=True) for o in out]
+        return VarBase(out, stop_gradient=True)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        raise NotImplementedError(
+            "export via fluid.io.save_inference_model on a static build"
+        )
+
+
+_ = to_variable

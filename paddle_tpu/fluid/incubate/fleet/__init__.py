@@ -1,0 +1,5 @@
+"""Fleet distributed-training facade (reference:
+python/paddle/fluid/incubate/fleet/)."""
+
+from . import base  # noqa: F401
+from . import collective  # noqa: F401

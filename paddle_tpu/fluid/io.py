@@ -1,0 +1,294 @@
+"""Persistence (reference: python/paddle/fluid/io.py — save_vars:149,
+save_params:273, save_persistables:523, save_inference_model:1011,
+load_inference_model:1215, save:1493/load:1547 consolidated formats).
+
+Save programs are built with host `save`/`save_combine` ops and run through
+the Executor, exactly as in the reference — so checkpoints written here use
+the reference's tensor stream format (ops/io_ops.py)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from . import core
+from .executor import Executor
+from .framework import (
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    program_guard,
+)
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "save",
+    "load",
+    "load_program_state",
+    "set_program_state",
+]
+
+
+def is_persistable(var):
+    return var.persistable and var.name not in (
+        "feed",
+        "fetch",
+    )
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _build_save_program(vars_list, dirname, filename):
+    prog = Program()
+    block = prog.global_block()
+    for v in vars_list:
+        block.create_var(
+            name=v.name, shape=v.shape, dtype=v.dtype, persistable=True
+        )
+    if filename is None:
+        for v in vars_list:
+            block.append_op(
+                type="save",
+                inputs={"X": [v.name]},
+                outputs={},
+                attrs={"file_path": os.path.join(dirname, v.name)},
+            )
+    else:
+        block.append_op(
+            type="save_combine",
+            inputs={"X": [v.name for v in vars_list]},
+            outputs={},
+            attrs={"file_path": os.path.join(dirname, filename)},
+        )
+    return prog
+
+
+def _build_load_program(vars_list, dirname, filename):
+    prog = Program()
+    block = prog.global_block()
+    for v in vars_list:
+        block.create_var(
+            name=v.name, shape=v.shape, dtype=v.dtype, persistable=True
+        )
+    if filename is None:
+        for v in vars_list:
+            block.append_op(
+                type="load",
+                inputs={},
+                outputs={"Out": [v.name]},
+                attrs={"file_path": os.path.join(dirname, v.name)},
+            )
+    else:
+        block.append_op(
+            type="load_combine",
+            inputs={},
+            outputs={"Out": [v.name for v in vars_list]},
+            attrs={"file_path": os.path.join(dirname, filename)},
+        )
+    return prog
+
+
+def save_vars(
+    executor,
+    dirname,
+    main_program=None,
+    vars=None,
+    predicate=None,
+    filename=None,
+):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [
+            v for v in main_program.list_vars() if (predicate or is_persistable)(v)
+        ]
+    else:
+        vars = [
+            main_program.global_block()._var_recursive(v)
+            if isinstance(v, str)
+            else v
+            for v in vars
+        ]
+    vars = [v for v in vars if v is not None]
+    os.makedirs(dirname, exist_ok=True)
+    prog = _build_save_program(vars, dirname, filename)
+    executor.run(prog)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor, dirname, main_program, predicate=is_parameter, filename=filename
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor, dirname, main_program, predicate=is_persistable, filename=filename
+    )
+
+
+def load_vars(
+    executor,
+    dirname,
+    main_program=None,
+    vars=None,
+    predicate=None,
+    filename=None,
+):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [
+            v for v in main_program.list_vars() if (predicate or is_persistable)(v)
+        ]
+    else:
+        vars = [
+            main_program.global_block()._var_recursive(v)
+            if isinstance(v, str)
+            else v
+            for v in vars
+        ]
+    vars = [v for v in vars if v is not None]
+    prog = _build_load_program(vars, dirname, filename)
+    executor.run(prog)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor, dirname, main_program, predicate=is_parameter, filename=filename
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor, dirname, main_program, predicate=is_persistable, filename=filename
+    )
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    export_for_deployment=True,
+    program_only=False,
+):
+    """Prune to the inference subgraph + save params
+    (reference: io.py:1011)."""
+    main_program = main_program or default_main_program()
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    pruned = main_program.clone(for_test=True)
+    pruned = pruned._prune(
+        feeds=feeded_var_names, fetches=[t.name for t in target_vars]
+    )
+    pruned._inference_io = {
+        "feed": list(feeded_var_names),
+        "fetch": [t.name for t in target_vars],
+    }
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    from . import proto
+
+    with open(model_path, "wb") as f:
+        f.write(proto.program_to_bytes(pruned))
+    if program_only:
+        return [t.name for t in target_vars]
+    save_persistables(executor, dirname, pruned, params_filename)
+    return [t.name for t in target_vars]
+
+
+def load_inference_model(
+    dirname,
+    executor,
+    model_filename=None,
+    params_filename=None,
+    pserver_endpoints=None,
+):
+    from . import proto
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        program = proto.program_from_bytes(f.read())
+    load_persistables(executor, dirname, program, params_filename)
+    io_info = getattr(program, "_inference_io", None) or {}
+    feed_names = io_info.get("feed", [])
+    fetch_names = io_info.get("fetch", [])
+    fetch_vars = [
+        program.global_block()._var_recursive(n) for n in fetch_names
+    ]
+    return [program, feed_names, fetch_vars]
+
+
+def save(program, model_path):
+    """Consolidated .pdparams/.pdopt/.pdmodel save (reference: io.py:1493)."""
+    scope = core.global_scope()
+    base = model_path
+    param_dict = {}
+    opt_dict = {}
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        if isinstance(v, Parameter):
+            param_dict[v.name] = arr
+        else:
+            opt_dict[v.name] = arr
+    with open(base + ".pdparams", "wb") as f:
+        pickle.dump(param_dict, f, protocol=2)
+    with open(base + ".pdopt", "wb") as f:
+        pickle.dump(opt_dict, f, protocol=2)
+    from . import proto
+
+    with open(base + ".pdmodel", "wb") as f:
+        f.write(proto.program_to_bytes(program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference: io.py load — restore consolidated state."""
+    scope = core.global_scope()
+    base = model_path
+    for suffix in (".pdparams", ".pdopt"):
+        path = base + suffix
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        for name, arr in state.items():
+            scope.set(name, np.asarray(arr))
+
+
+def load_program_state(model_path, var_list=None):
+    state = {}
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path + suffix
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                state.update(pickle.load(f))
+    return state
+
+
+def set_program_state(program, state):
+    scope = core.global_scope()
+    for v in program.list_vars():
+        if v.name in state:
+            scope.set(v.name, np.asarray(state[v.name]))
+
+
+_ = (Executor, program_guard)

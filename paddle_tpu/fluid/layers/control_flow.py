@@ -1,0 +1,305 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py
+— While, Switch, array ops, increment, less_than...).
+
+TPU note: `While` builds a sub-block that the executor lowers to
+``lax.while_loop`` (executor.py lower_while_op); Python-side loop carries must
+be shape-stable, which XLA requires anyway.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from ..framework import Operator, Variable
+from ..layer_helper import LayerHelper
+from .tensor import fill_constant
+
+__all__ = [
+    "While",
+    "Switch",
+    "increment",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal",
+    "not_equal",
+    "cond",
+    "is_empty",
+]
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            dtype=core.VarDesc.VarType.BOOL
+        )
+        cond.stop_gradient = True
+    helper.append_op(
+        type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]}
+    )
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="increment",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+class While(object):
+    """reference: control_flow.py While — usage:
+
+        cond = layers.less_than(i, n)
+        while_op = layers.While(cond)
+        with while_op.block():
+            ...
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.is_test = is_test
+
+    def block(self):
+        return _WhileBlockGuard(self)
+
+
+class _WhileBlockGuard(object):
+    def __init__(self, while_op):
+        self.while_op = while_op
+
+    def __enter__(self):
+        main = self.while_op.helper.main_program
+        self.parent_block = main.current_block()
+        self.sub_block = main._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        main = self.while_op.helper.main_program
+        sub = main.current_block()
+        main._rollback()
+        parent = main.current_block()
+        # gather external inputs of the sub-block
+        inner_defined = set()
+        x_names = []
+        for op_ in sub.ops:
+            for n in op_.input_arg_names:
+                if n not in inner_defined and n not in x_names:
+                    x_names.append(n)
+            inner_defined |= set(op_.output_arg_names)
+        out_names = [n for n in inner_defined if parent._find_var_recursive(n)]
+        step_scopes = parent.create_var(
+            name=self.while_op.helper.name + ".step_scopes",
+            type=core.VarDesc.VarType.STEP_SCOPES,
+        )
+        parent.append_op(
+            type="while",
+            inputs={
+                "X": [n for n in x_names if parent._find_var_recursive(n)],
+                "Condition": [self.while_op.cond_var],
+            },
+            outputs={"Out": out_names, "StepScopes": [step_scopes]},
+            attrs={"sub_block": sub.idx, "is_test": self.while_op.is_test},
+        )
+        return True
+
+
+class Switch(object):
+    """reference: control_flow.py Switch — sequential case guards built on
+    conditional_block."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.inside_scope = False
+        self.pre_not_conditions = []
+
+    def case(self, condition):
+        return _SwitchCaseGuard(self, condition)
+
+    def default(self):
+        return _SwitchCaseGuard(self, None)
+
+    def __enter__(self):
+        self.inside_scope = True
+        return self
+
+    def __exit__(self, *args):
+        self.inside_scope = False
+        return False
+
+
+class _SwitchCaseGuard(object):
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    def __enter__(self):
+        from .nn import logical_and, logical_not
+
+        cond = self.condition
+        prevs = self.switch.pre_not_conditions
+        if cond is None:  # default: all previous conds false
+            full = prevs[0]
+            for p in prevs[1:]:
+                full = logical_and(full, p)
+        else:
+            full = cond
+            for p in prevs:
+                full = logical_and(full, p)
+            self.switch.pre_not_conditions.append(logical_not(cond))
+        main = self.switch.helper.main_program
+        self._cond = full
+        self._parent = main.current_block()
+        self._sub = main._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        main = self.switch.helper.main_program
+        sub = main.current_block()
+        main._rollback()
+        parent = main.current_block()
+        inner_defined = set()
+        for op_ in sub.ops:
+            inner_defined |= set(op_.output_arg_names)
+        out_names = [n for n in inner_defined if parent._find_var_recursive(n)]
+        scope_var = parent.create_var(
+            name=self.switch.helper.name + ".scope",
+            type=core.VarDesc.VarType.STEP_SCOPES,
+        )
+        parent.append_op(
+            type="conditional_block",
+            inputs={"Cond": [self._cond], "Input": []},
+            outputs={"Out": out_names, "Scope": [scope_var]},
+            attrs={"sub_block": sub.idx, "is_scalar_condition": True},
+        )
+        return True
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional two-branch conditional. Both branches must produce
+    shape/dtype-matching outputs (XLA requirement, same as lax.cond)."""
+    helper = LayerHelper("cond", name=name)
+    from .nn import logical_not
+
+    true_out = None
+    false_out = None
+    with Switch() as switch:
+        with switch.case(pred):
+            if true_fn is not None:
+                true_out = true_fn()
+        with switch.case(logical_not(pred)):
+            if false_fn is not None:
+                false_out = false_fn()
+    if true_out is None:
+        return None
+    # merge via select
+    out = helper.create_variable_for_type_inference(dtype=true_out.dtype)
+    helper.append_op(
+        type="where",
+        inputs={"Condition": [pred], "X": [true_out], "Y": [false_out]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+# -- tensor arrays (LoDTensorArray) — used by RNN/beam-search -----------------
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.create_variable(
+        name="{0}.out".format(helper.name),
+        type=core.VarDesc.VarType.LOD_TENSOR_ARRAY,
+        dtype=dtype,
+    )
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(
+        type="write_to_array",
+        inputs={"X": [x], "I": [i]},
+        outputs={"Out": [array]},
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(
+        type="read_from_array",
+        inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(dtype=core.VarDesc.VarType.INT64)
+    helper.append_op(
+        type="lod_array_length", inputs={"X": [array]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            dtype=core.VarDesc.VarType.BOOL
+        )
+    helper.append_op(
+        type="is_empty", inputs={"X": [x]}, outputs={"Out": [cond]}
+    )
+    return cond
+
+
+_ = (np, Operator, Variable, fill_constant)

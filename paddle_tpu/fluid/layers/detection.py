@@ -1,0 +1,190 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py,
+operators/detection/ — ~30 ops). Round-1 surface: the pieces with static
+shapes (iou, box coding, prior boxes); NMS-style data-dependent-output ops are
+host-side and raise for now."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..ops.registry import op
+
+__all__ = ["iou_similarity", "box_coder", "prior_box"]
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="iou_similarity",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+@op("iou_similarity")
+def _iou_similarity(ctx, op_):
+    import jax.numpy as jnp
+
+    a = ctx.in1(op_, "X")  # [N, 4] xyxy
+    b = ctx.in1(op_, "Y")  # [M, 4]
+    ax1, ay1, ax2, ay2 = [a[:, i : i + 1] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[None, :, i] for i in range(4)]
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = (ax2 - ax1) * (ay2 - ay1)
+    area_b = (bx2 - bx1) * (by2 - by1)
+    ctx.out(op_, "Out", inter / jnp.maximum(area_a + area_b - inter, 1e-10))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, name=None, axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(dtype=target_box.dtype)
+    helper.append_op(
+        type="box_coder",
+        inputs={
+            "PriorBox": [prior_box],
+            "PriorBoxVar": [prior_box_var] if prior_box_var is not None else [],
+            "TargetBox": [target_box],
+        },
+        outputs={"OutputBox": [out]},
+        attrs={
+            "code_type": code_type,
+            "box_normalized": box_normalized,
+            "axis": axis,
+        },
+    )
+    return out
+
+
+@op("box_coder")
+def _box_coder(ctx, op_):
+    import jax.numpy as jnp
+
+    prior = ctx.in1(op_, "PriorBox")  # [M,4]
+    pvar = ctx.in1(op_, "PriorBoxVar", optional=True)
+    target = ctx.in1(op_, "TargetBox")
+    code_type = op_.attr("code_type", "encode_center_size")
+    norm = bool(op_.attr("box_normalized", True))
+    off = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+    else:
+        t = target  # [N,M,4]
+        d = t if t.ndim == 3 else t[:, None, :]
+        if pvar is not None:
+            d = d * pvar[None, :, :]
+        ocx = pcx[None, :] + d[:, :, 0] * pw[None, :]
+        ocy = pcy[None, :] + d[:, :, 1] * ph[None, :]
+        ow = jnp.exp(d[:, :, 2]) * pw[None, :]
+        oh = jnp.exp(d[:, :, 3]) * ph[None, :]
+        out = jnp.stack(
+            [
+                ocx - ow * 0.5,
+                ocy - oh * 0.5,
+                ocx + ow * 0.5 - off,
+                ocy + oh * 0.5 - off,
+            ],
+            axis=-1,
+        )
+    ctx.out(op_, "OutputBox", out)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    box = helper.create_variable_for_type_inference(dtype=input.dtype)
+    var = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [box], "Variances": [var]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+            "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+        },
+    )
+    return box, var
+
+
+@op("prior_box")
+def _prior_box(ctx, op_):
+    import jax.numpy as jnp
+
+    feat = ctx.in1(op_, "Input")
+    img = ctx.in1(op_, "Image")
+    min_sizes = [float(s) for s in op_.attr("min_sizes")]
+    max_sizes = [float(s) for s in op_.attr("max_sizes", [])]
+    ars = [float(a) for a in op_.attr("aspect_ratios", [1.0])]
+    if op_.attr("flip", False):
+        ars = ars + [1.0 / a for a in ars if a != 1.0]
+    variances = [float(v) for v in op_.attr("variances")]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_w = op_.attr("step_w", 0.0) or img_w / w
+    step_h = op_.attr("step_h", 0.0) or img_h / h
+    offset = float(op_.attr("offset", 0.5))
+    boxes = []
+    for i in range(h):
+        for j in range(w):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((cx, cy, ms, ms))
+                if max_sizes:
+                    bs = float(np.sqrt(ms * max_sizes[k]))
+                    cell.append((cx, cy, bs, bs))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            boxes.extend(cell)
+    arr = np.asarray(boxes, np.float32)
+    out = np.stack(
+        [
+            (arr[:, 0] - arr[:, 2] / 2) / img_w,
+            (arr[:, 1] - arr[:, 3] / 2) / img_h,
+            (arr[:, 0] + arr[:, 2] / 2) / img_w,
+            (arr[:, 1] + arr[:, 3] / 2) / img_h,
+        ],
+        axis=1,
+    ).reshape(h, w, -1, 4)
+    if op_.attr("clip", False):
+        out = np.clip(out, 0.0, 1.0)
+    n_priors = out.shape[2]
+    var = np.tile(np.asarray(variances, np.float32), (h, w, n_priors, 1))
+    ctx.out(op_, "Boxes", jnp.asarray(out))
+    ctx.out(op_, "Variances", jnp.asarray(var))

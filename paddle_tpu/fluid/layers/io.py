@@ -1,0 +1,40 @@
+"""Data-input layers (reference: python/paddle/fluid/layers/io.py — data
+layer + py_reader plumbing)."""
+
+from __future__ import annotations
+
+from .. import core
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["data"]
+
+
+def data(
+    name,
+    shape,
+    append_batch_size=True,
+    dtype="float32",
+    lod_level=0,
+    type=core.VarDesc.VarType.LOD_TENSOR,
+    stop_gradient=True,
+):
+    """Declare a feed slot (reference: layers/io.py data — injects a var
+    with is_data=True; feeding happens at executor boundary, no feed op)."""
+    helper = LayerHelper("data")
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.create_global_variable(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        type=type,
+        stop_gradient=stop_gradient,
+        lod_level=lod_level,
+        is_data=True,
+        persistable=False,
+    )
+
+
+_ = (default_main_program, default_startup_program)

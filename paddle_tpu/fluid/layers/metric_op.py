@@ -1,0 +1,40 @@
+"""Metric layers (reference: python/paddle/fluid/layers/metric_op.py)."""
+
+from __future__ import annotations
+
+from .. import core
+from ..layer_helper import LayerHelper
+from .nn import topk
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference: metric_op.py accuracy — top_k + accuracy op."""
+    helper = LayerHelper("accuracy")
+    topk_out, topk_indices = topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference(dtype="float32")
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(
+            dtype=core.VarDesc.VarType.INT32
+        )
+    if total is None:
+        total = helper.create_variable_for_type_inference(
+            dtype=core.VarDesc.VarType.INT32
+        )
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [topk_out], "Indices": [topk_indices], "Label": [label]},
+        outputs={"Accuracy": [acc_out], "Correct": [correct], "Total": [total]},
+    )
+    acc_out.stop_gradient = True
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    """Streaming AUC is stateful host-side; provided via fluid.metrics.Auc.
+    This in-graph version returns batch AUC from the confusion accumulation."""
+    raise NotImplementedError(
+        "in-graph streaming AUC is not supported on the XLA path; "
+        "use paddle_tpu.fluid.metrics.Auc on fetched predictions"
+    )

@@ -1,0 +1,53 @@
+"""Layer utilities (reference: python/paddle/fluid/layers/utils.py)."""
+
+from __future__ import annotations
+
+import collections
+
+
+def convert_to_list(value, n, name, dtype=int):
+    if isinstance(value, dtype):
+        return [value] * n
+    try:
+        value_list = list(value)
+    except TypeError:
+        raise ValueError(
+            "%s must be a %s or an iterable of %s" % (name, dtype, dtype)
+        )
+    if len(value_list) != n:
+        raise ValueError("%s must have %d elements" % (name, n))
+    return value_list
+
+
+def is_sequence(seq):
+    return isinstance(seq, collections.abc.Sequence) and not isinstance(
+        seq, str
+    ) or isinstance(seq, dict)
+
+
+def flatten(nest):
+    out = []
+
+    def _walk(x):
+        if isinstance(x, dict):
+            for k in sorted(x):
+                _walk(x[k])
+        elif is_sequence(x):
+            for i in x:
+                _walk(i)
+        else:
+            out.append(x)
+
+    _walk(nest)
+    return out
+
+
+def map_structure(func, *structures):
+    s = structures[0]
+    if isinstance(s, dict):
+        return {k: map_structure(func, *[x[k] for x in structures]) for k in s}
+    if is_sequence(s):
+        return type(s)(
+            map_structure(func, *xs) for xs in zip(*structures)
+        )
+    return func(*structures)

@@ -1,0 +1,136 @@
+"""Collective ops over the device mesh.
+
+Reference: paddle/fluid/operators/collective/ (c_allreduce_op.h:57-110 ->
+ncclAllReduce on a ring keyed by ring_id; c_broadcast_op, c_allgather_op,
+c_reducescatter_op, c_sync_calc_stream_op, c_sync_comm_stream_op,
+c_comm_init_op, c_gen_nccl_id_op TCP bootstrap).
+
+TPU-native mapping (SURVEY.md §5.8): the ring_id becomes a mesh-axis name and
+each op lowers to the XLA collective over ICI — psum / all_gather /
+psum_scatter / ppermute — inside the shard_map'd block program. When the
+block is traced single-device (no mesh axes), collectives are identities, so
+the same Program runs anywhere. Stream-sync ops are no-ops: XLA schedules
+communication/computation overlap itself (latency-hiding scheduler).
+"""
+
+from __future__ import annotations
+
+from .registry import op, same_shape_infer
+
+
+def _axis_for(ctx, op_):
+    """ring_id -> mesh axis. Ring 0 is the data axis; other rings map to the
+    axis registered under 'ring{N}' if present (hierarchical allreduce uses a
+    2-level ICI×DCN mesh instead of multiple rings)."""
+    ring = int(op_.attr("ring_id", 0))
+    if ring == 0:
+        return ctx.data_axis
+    name = "ring%d" % ring
+    return name if name in ctx.mesh_axes else ctx.data_axis
+
+
+def _register_allreduce(name, reducer):
+    def lower(ctx, op_, _red=reducer):
+        import jax.lax as lax
+
+        x = ctx.in1(op_, "X")
+        axis = _axis_for(ctx, op_)
+        if axis is not None:
+            x = _red(lax, x, axis)
+        ctx.out(op_, "Out", x)
+
+    op(name, infer_shape=same_shape_infer("X"), grad="generic")(lower)
+
+
+def _pprod(lax, x, a):
+    import jax.numpy as jnp
+
+    return jnp.prod(lax.all_gather(x, a, axis=0), axis=0)
+
+
+_register_allreduce("c_allreduce_sum", lambda lax, x, a: lax.psum(x, a))
+_register_allreduce("c_allreduce_max", lambda lax, x, a: lax.pmax(x, a))
+_register_allreduce("c_allreduce_min", lambda lax, x, a: lax.pmin(x, a))
+_register_allreduce("c_allreduce_prod", _pprod)
+_register_allreduce("allreduce", lambda lax, x, a: lax.psum(x, a))
+
+
+@op("c_broadcast", infer_shape=same_shape_infer("X"), grad="generic")
+def _c_broadcast(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    axis = _axis_for(ctx, op_)
+    if axis is None:
+        ctx.out(op_, "Out", x)
+        return
+    root = int(op_.attr("root", 0))
+    # select root's value on every member: mask + psum rides ICI efficiently
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    ctx.out(op_, "Out", lax.psum(masked, axis))
+
+
+@op("broadcast", infer_shape=same_shape_infer("X"), grad="generic")
+def _broadcast_op(ctx, op_):
+    _c_broadcast(ctx, op_)
+
+
+@op("c_allgather", grad="generic")
+def _c_allgather(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "X")
+    axis = _axis_for(ctx, op_)
+    if axis is None:
+        ctx.out(op_, "Out", x)
+        return
+    out = lax.all_gather(x, axis, axis=0)
+    ctx.out(op_, "Out", out.reshape((-1,) + tuple(x.shape[1:])))
+
+
+@op("c_reducescatter", grad="generic")
+def _c_reducescatter(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "X")
+    axis = _axis_for(ctx, op_)
+    if axis is None:
+        ctx.out(op_, "Out", x)
+        return
+    ctx.out(op_, "Out", lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True))
+
+
+# Stream-sync ops: XLA's scheduler owns the compute/comm overlap — no-ops.
+@op("c_sync_calc_stream", infer_shape=same_shape_infer("X"))
+def _c_sync_calc_stream(ctx, op_):
+    ctx.out(op_, "Out", ctx.in1(op_, "X"))
+
+
+@op("c_sync_comm_stream", infer_shape=same_shape_infer("X"))
+def _c_sync_comm_stream(ctx, op_):
+    ctx.out(op_, "Out", ctx.in1(op_, "X"))
+
+
+# Bootstrap ops: the mesh is constructed by jax.distributed + Mesh at
+# executor/compiler level (parallel/mesh.py); in-graph they are no-ops kept
+# for Program-level parity with reference-transpiled programs.
+@op("c_comm_init", host=True)
+def _c_comm_init(ctx, op_):
+    pass
+
+
+@op("c_comm_init_all", host=True)
+def _c_comm_init_all(ctx, op_):
+    pass
+
+
+@op("c_gen_nccl_id", host=True)
+def _c_gen_nccl_id(ctx, op_):
+    pass
+
+
+@op("gen_nccl_id", host=True)
+def _gen_nccl_id(ctx, op_):
+    pass

@@ -1,0 +1,110 @@
+"""Control-flow & comparison ops.
+
+Reference: paddle/fluid/operators/controlflow/ (compare_op.cc, logical_op.cc,
+while_op.cc with sub-block + step scopes, conditional_block_op.cc) and
+increment_op.cc.
+
+TPU-native: comparisons/logicals are elementwise jnp; `while`/
+`conditional_block` sub-blocks lower to lax.while_loop / lax.cond with the
+block's read/write var set as the carried tuple — data-dependent Python
+control flow is not allowed under jit, so the sub-block is traced once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import SkipInferShape, in_var, op, register_op, set_out
+
+
+def _cmp_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", v.shape, 0)  # BOOL
+
+
+def _register_compare(name, fn):
+    def lower(ctx, op_, _fn=fn):
+        x = ctx.in1(op_, "X")
+        y = ctx.in1(op_, "Y")
+        ctx.out(op_, "Out", _fn(x, y))
+
+    register_op(name, infer_shape=_cmp_infer, lower=lower)
+
+
+_register_compare("equal", lambda x, y: x == y)
+_register_compare("not_equal", lambda x, y: x != y)
+_register_compare("less_than", lambda x, y: x < y)
+_register_compare("less_equal", lambda x, y: x <= y)
+_register_compare("greater_than", lambda x, y: x > y)
+_register_compare("greater_equal", lambda x, y: x >= y)
+
+
+def _register_logical(name, fn, unary=False):
+    def lower(ctx, op_, _fn=fn, _unary=unary):
+        x = ctx.in1(op_, "X")
+        if _unary:
+            ctx.out(op_, "Out", _fn(x, None))
+        else:
+            ctx.out(op_, "Out", _fn(x, ctx.in1(op_, "Y")))
+
+    register_op(name, infer_shape=_cmp_infer, lower=lower)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+_register_logical("logical_and", lambda x, y: _jnp().logical_and(x, y))
+_register_logical("logical_or", lambda x, y: _jnp().logical_or(x, y))
+_register_logical("logical_xor", lambda x, y: _jnp().logical_xor(x, y))
+_register_logical("logical_not", lambda x, y: _jnp().logical_not(x), unary=True)
+
+
+@op("increment")
+def _increment(ctx, op_):
+    x = ctx.in1(op_, "X")
+    step = np.asarray(op_.attr("step", 1.0), x.dtype)
+    ctx.out(op_, "Out", x + step)
+
+
+@op("where", grad="generic")
+def _where(ctx, op_):
+    cond = ctx.in1(op_, "Condition")
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    ctx.out(op_, "Out", _jnp().where(cond, x, y))
+
+
+@op("select_input")
+def _select_input(ctx, op_):
+    import jax.numpy as jnp
+
+    xs = ctx.ins(op_, "X")
+    mask = ctx.in1(op_, "Mask").reshape(()).astype(np.int32)
+    out = xs[0]
+    for i, x in enumerate(xs[1:], start=1):
+        out = jnp.where(mask == i, x, out)
+    ctx.out(op_, "Out", out)
+
+
+# while / conditional_block lower through the executor, which owns sub-block
+# tracing (see executor.py _lower_while / _lower_cond); the registry entries
+# mark them lowerable so they don't split the XLA segment.
+def _while_lower(ctx, op_):
+    from .. import executor as _executor
+
+    _executor.lower_while_op(ctx, op_)
+
+
+def _cond_block_lower(ctx, op_):
+    from .. import executor as _executor
+
+    _executor.lower_conditional_block(ctx, op_)
+
+
+register_op("while", lower=_while_lower)
+register_op("conditional_block", lower=_cond_block_lower)

@@ -1,0 +1,234 @@
+"""Host-side save/load ops with the reference's binary tensor stream format.
+
+Reference: paddle/fluid/operators/save_op.cc:25, load_op.cc,
+save_combine_op.cc, load_combine_op.cc; serialization in
+framework/tensor_util.cc TensorToStream / TensorFromStream:
+
+    LoDTensor stream := uint32 version(0)
+                        uint64 lod_level
+                        { uint64 nbytes, size_t[] offsets } * lod_level
+                        uint32 version(0)
+                        int32  desc_size
+                        VarType.TensorDesc proto (data_type=1, dims=2 packed)
+                        raw tensor bytes
+
+These are host ops: they split the XLA segment and read/write the Scope.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from .. import core
+from .registry import register_op
+
+_NP_TO_PROTO = {
+    np.dtype(np.bool_): core.VarDesc.VarType.BOOL,
+    np.dtype(np.int16): core.VarDesc.VarType.INT16,
+    np.dtype(np.int32): core.VarDesc.VarType.INT32,
+    np.dtype(np.int64): core.VarDesc.VarType.INT64,
+    np.dtype(np.float16): core.VarDesc.VarType.FP16,
+    np.dtype(np.float32): core.VarDesc.VarType.FP32,
+    np.dtype(np.float64): core.VarDesc.VarType.FP64,
+    np.dtype(np.uint8): core.VarDesc.VarType.UINT8,
+    np.dtype(np.int8): core.VarDesc.VarType.INT8,
+}
+_PROTO_TO_NP = {v: k for k, v in _NP_TO_PROTO.items()}
+
+
+def _encode_varint(value):
+    out = b""
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out += bytes([bits | 0x80])
+        else:
+            out += bytes([bits])
+            return out
+
+
+def _decode_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+
+
+def _tensor_desc_bytes(arr):
+    """VarType.TensorDesc{ data_type=1 (enum), dims=2 (packed int64) }."""
+    dtype_enum = _NP_TO_PROTO[np.dtype(arr.dtype)]
+    out = bytes([0x08]) + _encode_varint(dtype_enum)  # field 1, varint
+    dims_payload = b"".join(_encode_varint(int(d)) for d in arr.shape)
+    out += bytes([0x12]) + _encode_varint(len(dims_payload)) + dims_payload
+    return out
+
+
+def _parse_tensor_desc(buf):
+    pos = 0
+    dtype_enum = None
+    dims = []
+    while pos < len(buf):
+        tag, pos = _decode_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == 0:
+            dtype_enum, pos = _decode_varint(buf, pos)
+        elif field == 2 and wire == 2:
+            ln, pos = _decode_varint(buf, pos)
+            end = pos + ln
+            while pos < end:
+                d, pos = _decode_varint(buf, pos)
+                dims.append(d)
+        elif field == 2 and wire == 0:  # unpacked fallback
+            d, pos = _decode_varint(buf, pos)
+            dims.append(d)
+        else:
+            raise ValueError("unexpected TensorDesc field %d" % field)
+    return _PROTO_TO_NP[dtype_enum], dims
+
+
+def serialize_lod_tensor(value):
+    if isinstance(value, core.LoDTensor):
+        arr = value.numpy()
+        lod = value.lod()
+    else:
+        arr = np.asarray(value)
+        lod = []
+    out = struct.pack("<I", 0)  # version
+    out += struct.pack("<Q", len(lod))
+    for level in lod:
+        level_arr = np.asarray(level, np.uint64)
+        out += struct.pack("<Q", level_arr.nbytes)
+        out += level_arr.tobytes()
+    out += struct.pack("<I", 0)  # tensor version
+    desc = _tensor_desc_bytes(arr)
+    out += struct.pack("<i", len(desc))
+    out += desc
+    out += np.ascontiguousarray(arr).tobytes()
+    return out
+
+
+def deserialize_lod_tensor(buf, pos=0):
+    (version,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    assert version == 0, "unsupported tensor stream version %d" % version
+    (lod_level,) = struct.unpack_from("<Q", buf, pos)
+    pos += 8
+    lod = []
+    for _ in range(lod_level):
+        (nbytes,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        level = np.frombuffer(buf, np.uint64, int(nbytes) // 8, pos)
+        pos += int(nbytes)
+        lod.append([int(x) for x in level])
+    (tversion,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    assert tversion == 0
+    (desc_size,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    np_dtype, dims = _parse_tensor_desc(buf[pos : pos + desc_size])
+    pos += desc_size
+    count = int(np.prod(dims)) if dims else 1
+    arr = np.frombuffer(buf, np_dtype, count, pos).reshape(dims)
+    pos += arr.nbytes
+    t = core.LoDTensor(arr.copy())
+    t.set_lod(lod)
+    return t, pos
+
+
+# -- host op implementations -------------------------------------------------
+def _ensure_dir(path):
+    d = os.path.dirname(path)
+    if d and not os.path.isdir(d):
+        os.makedirs(d, exist_ok=True)
+
+
+def _save_lower(ctx, op_):
+    name = op_.input("X")[0]
+    value = ctx.scope.get(name)
+    if value is None:
+        raise ValueError("save: variable %r not found in scope" % name)
+    path = op_.attr("file_path")
+    _ensure_dir(path)
+    with open(path, "wb") as f:
+        f.write(serialize_lod_tensor(_to_host(value)))
+
+
+def _load_lower(ctx, op_):
+    name = op_.output("Out")[0]
+    path = op_.attr("file_path")
+    with open(path, "rb") as f:
+        t, _ = deserialize_lod_tensor(f.read())
+    ctx.scope.set(name, t.numpy() if not t.lod() else t)
+
+
+def _save_combine_lower(ctx, op_):
+    names = op_.input("X")
+    path = op_.attr("file_path")
+    _ensure_dir(path)
+    with open(path, "wb") as f:
+        for n in names:
+            value = ctx.scope.get(n)
+            if value is None:
+                raise ValueError("save_combine: %r not in scope" % n)
+            f.write(serialize_lod_tensor(_to_host(value)))
+
+
+def _load_combine_lower(ctx, op_):
+    names = op_.output("Out")
+    path = op_.attr("file_path")
+    with open(path, "rb") as f:
+        buf = f.read()
+    pos = 0
+    for n in names:
+        t, pos = deserialize_lod_tensor(buf, pos)
+        ctx.scope.set(n, t.numpy() if not t.lod() else t)
+
+
+def _to_host(value):
+    if isinstance(value, core.LoDTensor):
+        return value
+    return np.asarray(value)
+
+
+register_op("save", lower=_save_lower, host=True)
+register_op("load", lower=_load_lower, host=True)
+register_op("save_combine", lower=_save_combine_lower, host=True)
+register_op("load_combine", lower=_load_combine_lower, host=True)
+
+
+def _print_lower(ctx, op_):
+    name = op_.input("In")[0] if op_.input("In") else op_.input("X")[0]
+    value = ctx.scope.get(name)
+    message = op_.attr("message", "")
+    print("%s %s %s" % (message, name, np.asarray(value)))
+    out_names = op_.output("Out")
+    if out_names:
+        ctx.scope.set(out_names[0], value)
+
+
+register_op("print", lower=_print_lower, host=True)
+
+
+def _feed_noop(ctx, op_):
+    pass
+
+
+def _fetch_noop(ctx, op_):
+    name = op_.input("X")[0]
+    out = op_.output("Out")
+    if out:
+        v = ctx.scope.get(name)
+        ctx.scope.set(out[0], v)
+
+
+register_op("feed", lower=_feed_noop, host=True)
+register_op("fetch", lower=_fetch_noop, host=True)

@@ -1,0 +1,45 @@
+"""Metric ops (reference: paddle/fluid/operators/metrics/accuracy_op.cc,
+auc_op.cc)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import op
+
+
+@op("accuracy")
+def _accuracy(ctx, op_):
+    import jax.numpy as jnp
+
+    # Out: topk values [N,k] — Indices carries the predicted classes
+    indices = ctx.in1(op_, "Indices")
+    label = ctx.in1(op_, "Label")
+    if label.ndim == indices.ndim:
+        lab = label
+    else:
+        lab = label[..., None]
+    correct = jnp.any(indices == lab, axis=-1)
+    num_correct = jnp.sum(correct.astype(np.int32))
+    total = np.prod(correct.shape)
+    ctx.out(op_, "Accuracy", (num_correct / np.asarray(total, np.float32)).reshape((1,)).astype(np.float32))
+    ctx.out(op_, "Correct", num_correct.reshape((1,)))
+    ctx.out(op_, "Total", jnp.full((1,), total, np.int32))
+
+
+@op("mean_iou")
+def _mean_iou(ctx, op_):
+    import jax.numpy as jnp
+
+    pred = ctx.in1(op_, "Predictions").reshape(-1)
+    label = ctx.in1(op_, "Labels").reshape(-1)
+    num_classes = int(op_.attr("num_classes"))
+    onehot_p = (pred[:, None] == jnp.arange(num_classes)[None, :])
+    onehot_l = (label[:, None] == jnp.arange(num_classes)[None, :])
+    inter = jnp.sum(onehot_p & onehot_l, axis=0).astype(np.float32)
+    union = jnp.sum(onehot_p | onehot_l, axis=0).astype(np.float32)
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1.0), jnp.zeros_like(union))
+    valid = jnp.sum((union > 0).astype(np.float32))
+    ctx.out(op_, "OutMeanIou", (jnp.sum(iou) / jnp.maximum(valid, 1.0)).reshape((1,)))
+    ctx.out(op_, "OutWrong", (union - inter).astype(np.int32))
+    ctx.out(op_, "OutCorrect", inter.astype(np.int32))

@@ -1,0 +1,509 @@
+"""NN ops: convolution, pooling, batch norm, dropout, interpolation.
+
+Reference kernels: paddle/fluid/operators/conv_op.cc (+conv_cudnn_op.cu),
+pool_op.cc, batch_norm_op.cc, dropout_op.cc, conv_transpose_op.cc.
+On TPU these lower to lax.conv_general_dilated / lax.reduce_window, which XLA
+maps onto the MXU; layout stays NCHW at the API level (the contract) and XLA
+picks the internal tiling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import (
+    SkipInferShape,
+    in_var,
+    op,
+    register_op,
+    same_shape_infer,
+    set_out,
+)
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v), int(v)]
+
+
+def _conv_out_dim(size, k, pad, stride, dilation=1):
+    if size < 0:
+        return -1
+    eff = dilation * (k - 1) + 1
+    return (size + 2 * pad - eff) // stride + 1
+
+
+# ---------------------------------------------------------------------------
+# conv2d / depthwise_conv2d
+# ---------------------------------------------------------------------------
+def _conv2d_infer(op_, block):
+    x = in_var(op_, block, "Input")
+    w = in_var(op_, block, "Filter")
+    if x is None or w is None or len(x.shape) != 4:
+        raise SkipInferShape()
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    dil = _pair(op_.attr("dilations", [1, 1]))
+    n, _, h, wd = x.shape
+    oc, _, kh, kw = w.shape
+    set_out(
+        op_,
+        block,
+        "Output",
+        (
+            n,
+            oc,
+            _conv_out_dim(h, kh, pads[0], strides[0], dil[0]),
+            _conv_out_dim(wd, kw, pads[1], strides[1], dil[1]),
+        ),
+        x.dtype,
+    )
+
+
+def _conv2d_lower(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "Input")
+    w = ctx.in1(op_, "Filter")
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    dil = _pair(op_.attr("dilations", [1, 1]))
+    groups = int(op_.attr("groups", 1)) or 1
+    if op_.type == "depthwise_conv2d":
+        groups = x.shape[1]
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=x.dtype,
+    )
+    ctx.out(op_, "Output", out)
+
+
+register_op("conv2d", infer_shape=_conv2d_infer, lower=_conv2d_lower, grad="generic")
+register_op(
+    "depthwise_conv2d", infer_shape=_conv2d_infer, lower=_conv2d_lower, grad="generic"
+)
+
+
+def _conv2d_transpose_infer(op_, block):
+    x = in_var(op_, block, "Input")
+    w = in_var(op_, block, "Filter")
+    if x is None or w is None or len(x.shape) != 4:
+        raise SkipInferShape()
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    dil = _pair(op_.attr("dilations", [1, 1]))
+    n, _, h, wd = x.shape
+    _, oc_g, kh, kw = w.shape
+    groups = int(op_.attr("groups", 1)) or 1
+    oh = (h - 1) * strides[0] - 2 * pads[0] + dil[0] * (kh - 1) + 1 if h > 0 else -1
+    ow = (wd - 1) * strides[1] - 2 * pads[1] + dil[1] * (kw - 1) + 1 if wd > 0 else -1
+    set_out(op_, block, "Output", (n, oc_g * groups, oh, ow), x.dtype)
+
+
+@op("conv2d_transpose", infer_shape=_conv2d_transpose_infer, grad="generic")
+def _conv2d_transpose(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "Input")
+    w = ctx.in1(op_, "Filter")  # [in_c, out_c/groups, kh, kw]
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    dil = _pair(op_.attr("dilations", [1, 1]))
+    groups = int(op_.attr("groups", 1)) or 1
+    kh, kw = w.shape[2], w.shape[3]
+    # transposed conv = lhs-dilated conv with flipped, transposed kernel
+    pad_h = dil[0] * (kh - 1) - pads[0]
+    pad_w = dil[1] * (kw - 1) - pads[1]
+    w_t = np.flip if isinstance(w, np.ndarray) else None
+    import jax.numpy as jnp
+
+    wk = jnp.flip(w, axis=(2, 3))
+    wk = jnp.swapaxes(wk, 0, 1)  # -> [out_c/groups, in_c, kh, kw]
+    if groups > 1:
+        # regroup: [g, oc/g, ic/g? ...] — reference groups conv_transpose rarely used
+        ic = x.shape[1]
+        wk = wk.reshape(groups, w.shape[1], ic // groups, kh, kw)
+        wk = wk.reshape(groups * w.shape[1], ic // groups, kh, kw)
+    out = lax.conv_general_dilated(
+        x,
+        wk,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    _ = w_t
+    ctx.out(op_, "Output", out)
+
+
+# ---------------------------------------------------------------------------
+# pool2d
+# ---------------------------------------------------------------------------
+def _pool2d_infer(op_, block):
+    x = in_var(op_, block, "X")
+    if x is None or len(x.shape) != 4:
+        raise SkipInferShape()
+    n, c, h, w = x.shape
+    if op_.attr("global_pooling", False) or op_.attr("adaptive", False) and _pair(op_.attr("ksize"))[0] == 1:
+        set_out(op_, block, "Out", (n, c, 1, 1), x.dtype)
+        return
+    if op_.attr("adaptive", False):
+        kh, kw = _pair(op_.attr("ksize"))
+        set_out(op_, block, "Out", (n, c, kh, kw), x.dtype)
+        return
+    ksize = _pair(op_.attr("ksize"))
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    if op_.attr("ceil_mode", False):
+        oh = -(-(h + 2 * pads[0] - ksize[0]) // strides[0]) + 1 if h > 0 else -1
+        ow = -(-(w + 2 * pads[1] - ksize[1]) // strides[1]) + 1 if w > 0 else -1
+    else:
+        oh = (h + 2 * pads[0] - ksize[0]) // strides[0] + 1 if h > 0 else -1
+        ow = (w + 2 * pads[1] - ksize[1]) // strides[1] + 1 if w > 0 else -1
+    set_out(op_, block, "Out", (n, c, oh, ow), x.dtype)
+
+
+@op("pool2d", infer_shape=_pool2d_infer, grad="generic")
+def _pool2d(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ptype = op_.attr("pooling_type", "max")
+    if op_.attr("global_pooling", False):
+        if ptype == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
+        else:
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        ctx.out(op_, "Out", out)
+        return
+    if op_.attr("adaptive", False):
+        kh, kw = _pair(op_.attr("ksize"))
+        h, w = x.shape[2], x.shape[3]
+        assert h % kh == 0 and w % kw == 0, (
+            "adaptive pool requires divisible dims for static lowering"
+        )
+        xr = x.reshape(x.shape[0], x.shape[1], kh, h // kh, kw, w // kw)
+        out = jnp.max(xr, axis=(3, 5)) if ptype == "max" else jnp.mean(xr, axis=(3, 5))
+        ctx.out(op_, "Out", out)
+        return
+    ksize = _pair(op_.attr("ksize"))
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    dims = (1, 1, ksize[0], ksize[1])
+    strd = (1, 1, strides[0], strides[1])
+    padding = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    if op_.attr("ceil_mode", False):
+        h, w = x.shape[2], x.shape[3]
+        oh = -(-(h + 2 * pads[0] - ksize[0]) // strides[0]) + 1
+        ow = -(-(w + 2 * pads[1] - ksize[1]) // strides[1]) + 1
+        need_h = (oh - 1) * strides[0] + ksize[0] - h - 2 * pads[0]
+        need_w = (ow - 1) * strides[1] + ksize[1] - w - 2 * pads[1]
+        padding = [
+            (0, 0),
+            (0, 0),
+            (pads[0], pads[0] + max(need_h, 0)),
+            (pads[1], pads[1] + max(need_w, 0)),
+        ]
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(
+            x, np.asarray(init, x.dtype), lax.max, dims, strd, padding
+        )
+    else:
+        ssum = lax.reduce_window(
+            x, np.asarray(0, x.dtype), lax.add, dims, strd, padding
+        )
+        if op_.attr("exclusive", True):
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(
+                ones, np.asarray(0, x.dtype), lax.add, dims, strd, padding
+            )
+            out = ssum / cnt
+        else:
+            out = ssum / float(ksize[0] * ksize[1])
+    ctx.out(op_, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# batch_norm — mutates running Mean/Variance in place (outputs MeanOut/
+# VarianceOut alias the input vars, as in the reference batch_norm_op.cc)
+# ---------------------------------------------------------------------------
+def _batch_norm_infer(op_, block):
+    x = in_var(op_, block, "X")
+    if x is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Y", x.shape, x.dtype)
+    c = x.shape[1] if len(x.shape) > 1 else x.shape[0]
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        set_out(op_, block, slot, (c,), x.dtype)
+
+
+@op("batch_norm", infer_shape=_batch_norm_infer, grad="generic",
+    stateful_inputs=(("Mean", "MeanOut"), ("Variance", "VarianceOut")))
+def _batch_norm(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    scale = ctx.in1(op_, "Scale")
+    bias = ctx.in1(op_, "Bias")
+    mean = ctx.in1(op_, "Mean")
+    var = ctx.in1(op_, "Variance")
+    eps = float(op_.attr("epsilon", 1e-5))
+    momentum = float(op_.attr("momentum", 0.9))
+    is_test = bool(op_.attr("is_test", False))
+    use_global = bool(op_.attr("use_global_stats", False)) or is_test
+    layout = op_.attr("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = tuple(x.shape[i] if i == ch_axis else 1 for i in range(x.ndim))
+
+    if use_global:
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        bmean = jnp.mean(x, axis=axes)
+        bvar = jnp.mean(jnp.square(x), axis=axes) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        new_mean = mean * momentum + bmean * (1.0 - momentum)
+        new_var = var * momentum + bvar * (1.0 - momentum)
+        saved_mean = bmean
+        saved_var = 1.0 / jnp.sqrt(bvar + eps)
+
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.out(op_, "Y", y)
+    ctx.out(op_, "MeanOut", new_mean)
+    ctx.out(op_, "VarianceOut", new_var)
+    ctx.out(op_, "SavedMean", saved_mean)
+    ctx.out(op_, "SavedVariance", saved_var)
+
+
+@op("sync_batch_norm", infer_shape=_batch_norm_infer, grad="generic")
+def _sync_batch_norm(ctx, op_):
+    """Cross-replica batch norm: batch stats psum'd over the data axis
+    (reference: operators/sync_batch_norm_op.cu — NCCL allreduce of
+    sum/sum-of-squares; here lax.pmean over the mesh axis)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    axis = ctx.data_axis
+    x = ctx.in1(op_, "X")
+    scale = ctx.in1(op_, "Scale")
+    bias = ctx.in1(op_, "Bias")
+    mean = ctx.in1(op_, "Mean")
+    var = ctx.in1(op_, "Variance")
+    eps = float(op_.attr("epsilon", 1e-5))
+    momentum = float(op_.attr("momentum", 0.9))
+    is_test = bool(op_.attr("is_test", False))
+    ch_axis = 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = tuple(x.shape[i] if i == ch_axis else 1 for i in range(x.ndim))
+    if is_test:
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+        saved_mean, saved_var = jnp.zeros_like(mean), jnp.zeros_like(var)
+    else:
+        bmean = jnp.mean(x, axis=axes)
+        bsq = jnp.mean(jnp.square(x), axis=axes)
+        if axis is not None:
+            bmean = lax.pmean(bmean, axis)
+            bsq = lax.pmean(bsq, axis)
+        bvar = bsq - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        new_mean = mean * momentum + bmean * (1.0 - momentum)
+        new_var = var * momentum + bvar * (1.0 - momentum)
+        saved_mean = bmean
+        saved_var = 1.0 / jnp.sqrt(bvar + eps)
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.out(op_, "Y", y)
+    ctx.out(op_, "MeanOut", new_mean)
+    ctx.out(op_, "VarianceOut", new_var)
+    ctx.out(op_, "SavedMean", saved_mean)
+    ctx.out(op_, "SavedVariance", saved_var)
+
+
+def _instance_norm_like(ctx, op_, axes_fn):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    eps = float(op_.attr("epsilon", 1e-5))
+    axes = axes_fn(x)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    scale = ctx.in1(op_, "Scale", optional=True)
+    bias = ctx.in1(op_, "Bias", optional=True)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    ctx.out(op_, "Y", y)
+    ctx.out(op_, "SavedMean", mean.reshape(mean.shape[:2]))
+    ctx.out(op_, "SavedVariance", var.reshape(var.shape[:2]))
+
+
+@op("instance_norm", infer_shape=same_shape_infer("X", "Y"), grad="generic")
+def _instance_norm(ctx, op_):
+    _instance_norm_like(ctx, op_, lambda x: tuple(range(2, x.ndim)))
+
+
+@op("group_norm", infer_shape=same_shape_infer("X", "Y"), grad="generic")
+def _group_norm(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    groups = int(op_.attr("groups", 1))
+    eps = float(op_.attr("epsilon", 1e-5))
+    n, c = x.shape[0], x.shape[1]
+    xr = x.reshape((n, groups, c // groups) + tuple(x.shape[2:]))
+    axes = tuple(range(2, xr.ndim))
+    mean = jnp.mean(xr, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xr - mean), axis=axes, keepdims=True)
+    y = ((xr - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    scale = ctx.in1(op_, "Scale", optional=True)
+    bias = ctx.in1(op_, "Bias", optional=True)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    ctx.out(op_, "Y", y)
+    ctx.out(op_, "Mean", mean.reshape((n, groups)))
+    ctx.out(op_, "Variance", var.reshape((n, groups)))
+
+
+# ---------------------------------------------------------------------------
+# dropout — custom grad via saved Mask (reference: dropout_op.cc)
+# ---------------------------------------------------------------------------
+def _dropout_infer(op_, block):
+    x = in_var(op_, block, "X")
+    if x is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", x.shape, x.dtype)
+    set_out(op_, block, "Mask", x.shape, x.dtype)
+
+
+def _dropout_grad_maker(op_):
+    return [
+        dict(
+            type="dropout_grad",
+            inputs={
+                "Mask": op_.output("Mask"),
+                "Out@GRAD": [n + "@GRAD" for n in op_.output("Out")],
+            },
+            outputs={"X@GRAD": [n + "@GRAD" for n in op_.input("X")]},
+            attrs=dict(op_.attrs),
+        )
+    ]
+
+
+@op("dropout", infer_shape=_dropout_infer, grad=_dropout_grad_maker)
+def _dropout(ctx, op_):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    p = float(op_.attr("dropout_prob", 0.5))
+    is_test = bool(op_.attr("is_test", False))
+    impl = op_.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * np.asarray(1.0 - p, x.dtype)
+        ctx.out(op_, "Out", out)
+        ctx.out(op_, "Mask", jnp.ones_like(x))
+        return
+    keep = jax.random.bernoulli(ctx.next_key(), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        mask = keep.astype(x.dtype) / np.asarray(max(1.0 - p, 1e-12), x.dtype)
+    else:
+        mask = keep.astype(x.dtype)
+    ctx.out(op_, "Out", x * mask)
+    ctx.out(op_, "Mask", mask)
+
+
+@op("dropout_grad")
+def _dropout_grad(ctx, op_):
+    mask = ctx.in1(op_, "Mask")
+    dout = ctx.in1(op_, "Out@GRAD")
+    ctx.out(op_, "X@GRAD", dout * mask)
+
+
+# ---------------------------------------------------------------------------
+# misc NN
+# ---------------------------------------------------------------------------
+@op("relu_grad")  # fast path: avoids vjp re-trace for the hottest activation
+def _relu_grad(ctx, op_):
+    import jax.numpy as jnp
+
+    out = ctx.in1(op_, "Out")
+    dout = ctx.in1(op_, "Out@GRAD")
+    ctx.out(op_, "X@GRAD", jnp.where(out > 0, dout, jnp.zeros_like(dout)))
+
+
+@op("lrn", infer_shape=same_shape_infer("X"), grad="generic")
+def _lrn(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    n = int(op_.attr("n", 5))
+    k = float(op_.attr("k", 2.0))
+    alpha = float(op_.attr("alpha", 1e-4))
+    beta = float(op_.attr("beta", 0.75))
+    sq = jnp.square(x)
+    half = n // 2
+    acc = lax.reduce_window(
+        sq,
+        np.asarray(0, x.dtype),
+        lax.add,
+        (1, n, 1, 1),
+        (1, 1, 1, 1),
+        [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)],
+    )
+    mid = k + alpha * acc
+    ctx.out(op_, "MidOut", mid)
+    ctx.out(op_, "Out", x / jnp.power(mid, beta))
+
+
+@op("interp_nearest", grad="generic")
+@op("nearest_interp", grad="generic")
+def _nearest_interp(ctx, op_):
+    import jax
+
+    x = ctx.in1(op_, "X")
+    oh = int(op_.attr("out_h", 0))
+    ow = int(op_.attr("out_w", 0))
+    scale = op_.attr("scale", 0.0)
+    if (not oh or not ow) and scale:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
+    ctx.out(op_, "Out", out)
+
+
+@op("bilinear_interp", grad="generic")
+def _bilinear_interp(ctx, op_):
+    import jax
+
+    x = ctx.in1(op_, "X")
+    oh = int(op_.attr("out_h", 0))
+    ow = int(op_.attr("out_w", 0))
+    scale = op_.attr("scale", 0.0)
+    if (not oh or not ow) and scale:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    ctx.out(op_, "Out", out)

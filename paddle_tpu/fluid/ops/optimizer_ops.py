@@ -1,0 +1,321 @@
+"""Optimizer update ops.
+
+Reference kernels: paddle/fluid/operators/optimizers/ (14 update rules:
+sgd_op.cc, momentum_op.cc, adam_op.cc, adamax_op.cc, adagrad_op.cc,
+rmsprop_op.cc, adadelta_op.cc, ftrl_op.cc, lamb_op.cc, lars_momentum_op.cc,
+decayed_adagrad_op.cc, dpsgd_op.cc, proximal_gd_op.cc, proximal_adagrad_op.cc).
+
+Each op rewrites its Param (and accumulator) outputs onto the same var names
+as the inputs — the in-place contract the reference implements with shared
+buffers and we implement with env rebinding + XLA buffer donation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import op
+
+
+def _lr(ctx, op_):
+    lr = ctx.in1(op_, "LearningRate")
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@op("sgd", stateful_inputs=(("Param", "ParamOut"),))
+def _sgd(ctx, op_):
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad")
+    ctx.out(op_, "ParamOut", p - _lr(ctx, op_).astype(p.dtype) * g.astype(p.dtype))
+
+
+@op(
+    "momentum",
+    stateful_inputs=(("Param", "ParamOut"), ("Velocity", "VelocityOut")),
+)
+def _momentum(ctx, op_):
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad")
+    v = ctx.in1(op_, "Velocity")
+    mu = np.asarray(op_.attr("mu"), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    v_new = mu * v + g
+    if op_.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.out(op_, "ParamOut", p_new)
+    ctx.out(op_, "VelocityOut", v_new)
+
+
+@op(
+    "lars_momentum",
+    stateful_inputs=(("Param", "ParamOut"), ("Velocity", "VelocityOut")),
+)
+def _lars_momentum(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad")
+    v = ctx.in1(op_, "Velocity")
+    mu = np.asarray(op_.attr("mu"), p.dtype)
+    lars_coeff = float(op_.attr("lars_coeff", 0.001))
+    lars_wd = float(op_.attr("lars_weight_decay", 0.0005))
+    lr = _lr(ctx, op_).astype(p.dtype)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12),
+        lr,
+    )
+    v_new = mu * v + local_lr * (g + lars_wd * p)
+    ctx.out(op_, "ParamOut", p - v_new)
+    ctx.out(op_, "VelocityOut", v_new)
+
+
+@op(
+    "adam",
+    stateful_inputs=(
+        ("Param", "ParamOut"),
+        ("Moment1", "Moment1Out"),
+        ("Moment2", "Moment2Out"),
+        ("Beta1Pow", "Beta1PowOut"),
+        ("Beta2Pow", "Beta2PowOut"),
+    ),
+)
+def _adam(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    m1 = ctx.in1(op_, "Moment1")
+    m2 = ctx.in1(op_, "Moment2")
+    b1p = ctx.in1(op_, "Beta1Pow").reshape(())
+    b2p = ctx.in1(op_, "Beta2Pow").reshape(())
+    b1 = np.asarray(op_.attr("beta1", 0.9), p.dtype)
+    b2 = np.asarray(op_.attr("beta2", 0.999), p.dtype)
+    eps = np.asarray(op_.attr("epsilon", 1e-8), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    ctx.out(op_, "ParamOut", p_new)
+    ctx.out(op_, "Moment1Out", m1n)
+    ctx.out(op_, "Moment2Out", m2n)
+    # reference updates beta pows on host side inside the op since 1.6
+    ctx.out(op_, "Beta1PowOut", (b1p * b1).reshape((1,)))
+    ctx.out(op_, "Beta2PowOut", (b2p * b2).reshape((1,)))
+
+
+@op(
+    "adamax",
+    stateful_inputs=(
+        ("Param", "ParamOut"),
+        ("Moment", "MomentOut"),
+        ("InfNorm", "InfNormOut"),
+    ),
+)
+def _adamax(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    m = ctx.in1(op_, "Moment")
+    inf = ctx.in1(op_, "InfNorm")
+    b1p = ctx.in1(op_, "Beta1Pow").reshape(())
+    b1 = np.asarray(op_.attr("beta1", 0.9), p.dtype)
+    b2 = np.asarray(op_.attr("beta2", 0.999), p.dtype)
+    eps = np.asarray(op_.attr("epsilon", 1e-8), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    p_new = p - (lr / (1 - b1p)) * (m_new / inf_new)
+    ctx.out(op_, "ParamOut", p_new)
+    ctx.out(op_, "MomentOut", m_new)
+    ctx.out(op_, "InfNormOut", inf_new)
+
+
+@op("adagrad", stateful_inputs=(("Param", "ParamOut"), ("Moment", "MomentOut")))
+def _adagrad(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    m = ctx.in1(op_, "Moment")
+    eps = np.asarray(op_.attr("epsilon", 1e-6), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    m_new = m + g * g
+    ctx.out(op_, "ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.out(op_, "MomentOut", m_new)
+
+
+@op(
+    "decayed_adagrad",
+    stateful_inputs=(("Param", "ParamOut"), ("Moment", "MomentOut")),
+)
+def _decayed_adagrad(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    m = ctx.in1(op_, "Moment")
+    decay = np.asarray(op_.attr("decay", 0.95), p.dtype)
+    eps = np.asarray(op_.attr("epsilon", 1e-6), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    m_new = decay * m + (1 - decay) * g * g
+    ctx.out(op_, "ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.out(op_, "MomentOut", m_new)
+
+
+@op(
+    "rmsprop",
+    stateful_inputs=(
+        ("Param", "ParamOut"),
+        ("MeanSquare", "MeanSquareOut"),
+        ("Moment", "MomentOut"),
+        ("MeanGrad", "MeanGradOut"),
+    ),
+)
+def _rmsprop(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    ms = ctx.in1(op_, "MeanSquare")
+    mom = ctx.in1(op_, "Moment")
+    rho = np.asarray(op_.attr("decay", 0.95), p.dtype)
+    eps = np.asarray(op_.attr("epsilon", 1e-6), p.dtype)
+    mu = np.asarray(op_.attr("momentum", 0.0), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    ms_new = rho * ms + (1 - rho) * g * g
+    if op_.attr("centered", False):
+        mg = ctx.in1(op_, "MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - mg_new * mg_new + eps)
+        ctx.out(op_, "MeanGradOut", mg_new)
+    else:
+        denom = jnp.sqrt(ms_new + eps)
+        mg0 = ctx.in1(op_, "MeanGrad", optional=True)
+        if mg0 is not None:
+            ctx.out(op_, "MeanGradOut", mg0)
+    mom_new = mu * mom + lr * g / denom
+    ctx.out(op_, "ParamOut", p - mom_new)
+    ctx.out(op_, "MeanSquareOut", ms_new)
+    ctx.out(op_, "MomentOut", mom_new)
+
+
+@op(
+    "adadelta",
+    stateful_inputs=(
+        ("Param", "ParamOut"),
+        ("AvgSquaredGrad", "AvgSquaredGradOut"),
+        ("AvgSquaredUpdate", "AvgSquaredUpdateOut"),
+    ),
+)
+def _adadelta(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    ag = ctx.in1(op_, "AvgSquaredGrad")
+    au = ctx.in1(op_, "AvgSquaredUpdate")
+    rho = np.asarray(op_.attr("rho", 0.95), p.dtype)
+    eps = np.asarray(op_.attr("epsilon", 1e-6), p.dtype)
+    ag_new = rho * ag + (1 - rho) * g * g
+    update = -jnp.sqrt((au + eps) / (ag_new + eps)) * g
+    au_new = rho * au + (1 - rho) * update * update
+    ctx.out(op_, "ParamOut", p + update)
+    ctx.out(op_, "AvgSquaredGradOut", ag_new)
+    ctx.out(op_, "AvgSquaredUpdateOut", au_new)
+
+
+@op(
+    "ftrl",
+    stateful_inputs=(
+        ("Param", "ParamOut"),
+        ("SquaredAccumulator", "SquaredAccumOut"),
+        ("LinearAccumulator", "LinearAccumOut"),
+    ),
+)
+def _ftrl(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    sq = ctx.in1(op_, "SquaredAccumulator")
+    lin = ctx.in1(op_, "LinearAccumulator")
+    l1 = np.asarray(op_.attr("l1", 0.0), p.dtype)
+    l2 = np.asarray(op_.attr("l2", 0.0), p.dtype)
+    lr_power = np.asarray(op_.attr("lr_power", -0.5), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    new_sq = sq + g * g
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    x = l1 * jnp.sign(new_lin) - new_lin
+    y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    p_new = jnp.where(jnp.abs(new_lin) > l1, x / y, jnp.zeros_like(p))
+    ctx.out(op_, "ParamOut", p_new)
+    ctx.out(op_, "SquaredAccumOut", new_sq)
+    ctx.out(op_, "LinearAccumOut", new_lin)
+
+
+@op(
+    "lamb",
+    stateful_inputs=(
+        ("Param", "ParamOut"),
+        ("Moment1", "Moment1Out"),
+        ("Moment2", "Moment2Out"),
+        ("Beta1Pow", "Beta1PowOut"),
+        ("Beta2Pow", "Beta2PowOut"),
+    ),
+)
+def _lamb(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    m1 = ctx.in1(op_, "Moment1")
+    m2 = ctx.in1(op_, "Moment2")
+    b1p = ctx.in1(op_, "Beta1Pow").reshape(())
+    b2p = ctx.in1(op_, "Beta2Pow").reshape(())
+    b1 = np.asarray(op_.attr("beta1", 0.9), p.dtype)
+    b2 = np.asarray(op_.attr("beta2", 0.999), p.dtype)
+    eps = np.asarray(op_.attr("epsilon", 1e-6), p.dtype)
+    wd = np.asarray(op_.attr("weight_decay", 0.01), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    ctx.out(op_, "ParamOut", p - lr * trust * r)
+    ctx.out(op_, "Moment1Out", m1n)
+    ctx.out(op_, "Moment2Out", m2n)
+    ctx.out(op_, "Beta1PowOut", (b1p * b1).reshape((1,)))
+    ctx.out(op_, "Beta2PowOut", (b2p * b2).reshape((1,)))
+
+
+@op("dpsgd", stateful_inputs=(("Param", "ParamOut"),))
+def _dpsgd(ctx, op_):
+    """Differentially-private SGD (reference: optimizers/dpsgd_op.cc):
+    clip per-batch grad to clip-norm, add gaussian noise sigma, then SGD."""
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad").astype(p.dtype)
+    clip_ = np.asarray(op_.attr("clip", 10.0), p.dtype)
+    batch_size = np.asarray(op_.attr("batch_size", 16.0), p.dtype)
+    sigma = np.asarray(op_.attr("sigma", 1.0), p.dtype)
+    lr = _lr(ctx, op_).astype(p.dtype)
+    norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip_ / jnp.maximum(norm, 1e-12))
+    import jax
+
+    noise = jax.random.normal(ctx.next_key(), g.shape, g.dtype) * sigma * clip_
+    g_priv = (g * scale + noise) / batch_size
+    ctx.out(op_, "ParamOut", p - lr * g_priv)

@@ -1,0 +1,425 @@
+"""Op registry and lowering machinery.
+
+The analogue of the reference's OpInfoMap / REGISTER_OPERATOR
+(paddle/fluid/framework/op_registry.h:199, op_info.h) redesigned for XLA:
+instead of (type -> kernel functor per place), each OpDef carries
+
+- ``infer_shape(op, block)``  — compile-time shape/dtype propagation
+  (reference: framework/shape_inference.h compile-time path),
+- ``lower(ctx, op)``          — the JAX lowering rule, executed while tracing
+  a whole block into one XLA computation,
+- ``grad_maker(op, ...)``     — desc-level grad-op construction
+  (reference protocol: framework/grad_op_desc_maker.h:39); defaults to a
+  generic maker whose lowering is ``jax.vjp`` of the forward rule.
+
+Grad naming contract matches the reference: grad of var ``x`` is ``x@GRAD``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GRAD_SUFFIX = "@GRAD"
+EMPTY_VAR = "@EMPTY@"
+
+# attr keys used to carry the forward op signature on generic grad ops
+FWD_INPUTS_ATTR = "__fwd_inputs__"
+FWD_OUTPUTS_ATTR = "__fwd_outputs__"
+
+
+class SkipInferShape(Exception):
+    """Raised by infer_shape rules that can't infer (e.g. unknown dims)."""
+
+
+class OpDef(object):
+    __slots__ = (
+        "type",
+        "infer_shape",
+        "lower",
+        "grad_maker",
+        "host",
+        "stateful_inputs",
+    )
+
+    def __init__(
+        self, type, infer_shape=None, lower=None, grad_maker=None, host=False,
+        stateful_inputs=(),
+    ):
+        self.type = type
+        self.infer_shape = infer_shape
+        self.lower = lower
+        self.grad_maker = grad_maker
+        self.host = host  # True: runs on host python, splits the XLA segment
+        # input slots that alias an output (in-place update, e.g. optimizer
+        # Param/ParamOut) — informs buffer donation
+        self.stateful_inputs = tuple(stateful_inputs)
+
+
+_REGISTRY = {}
+
+
+def register_op(
+    type,
+    infer_shape=None,
+    lower=None,
+    grad=None,
+    host=False,
+    stateful_inputs=(),
+):
+    """Register an op. ``grad`` may be:
+    - "generic": use the generic vjp-backed grad maker,
+    - None: op has no gradient (grad ops never generated),
+    - callable(op) -> list[op-spec dict]: custom desc-level grad maker.
+    """
+    grad_maker = generic_grad_maker if grad == "generic" else grad
+    d = OpDef(
+        type,
+        infer_shape=infer_shape,
+        lower=lower,
+        grad_maker=grad_maker,
+        host=host,
+        stateful_inputs=stateful_inputs,
+    )
+    _REGISTRY[type] = d
+    return d
+
+
+def op(type, **kwargs):
+    """Decorator form: @op("relu", grad="generic") def lower(ctx, op)."""
+
+    def deco(fn):
+        register_op(type, lower=fn, **kwargs)
+        return fn
+
+    return deco
+
+
+def get_op_def(type):
+    d = _REGISTRY.get(type)
+    if d is None and type.endswith("_grad"):
+        base = _REGISTRY.get(type[: -len("_grad")])
+        if base is not None and base.lower is not None:
+            # synthesize a generic vjp grad def (cached)
+            d = OpDef(type, lower=_generic_grad_lower)
+            _REGISTRY[type] = d
+    return d
+
+
+def has_op(type):
+    return get_op_def(type) is not None
+
+
+def all_op_types():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Lowering context
+# ---------------------------------------------------------------------------
+class LowerCtx(object):
+    """Environment threaded through the lowering of one block segment.
+
+    ``env`` maps var name -> traced jax value. ``base_key`` is a jax PRNG key
+    (traced input) for random ops; each random op takes ``next_key()``.
+    ``mesh_axes`` names the SPMD mesh axes this block is being traced under
+    (e.g. {"data": 8}) — collective ops lower to lax collectives over these
+    axes; empty means single-device and collectives become identities.
+    """
+
+    def __init__(self, env=None, base_key=None, mesh_axes=None, block=None,
+                 scope=None):
+        self.env = env if env is not None else {}
+        self.base_key = base_key
+        self._key_counter = 0
+        self.mesh_axes = dict(mesh_axes or {})
+        self.block = block
+        self.scope = scope  # host-side scope, only for host ops
+
+    # -- env access --
+    def get(self, name):
+        if name == EMPTY_VAR:
+            return None
+        try:
+            return self.env[name]
+        except KeyError:
+            raise KeyError(
+                "var %r is not materialized in the lowering environment"
+                % name
+            )
+
+    def get_opt(self, name):
+        if name == EMPTY_VAR:
+            return None
+        return self.env.get(name)
+
+    def set(self, name, value):
+        if name != EMPTY_VAR:
+            self.env[name] = value
+
+    # -- op-relative access --
+    def in1(self, op, slot, idx=0, optional=False):
+        names = op.inputs.get(slot) or []
+        if not names or names[idx] == EMPTY_VAR:
+            if optional:
+                return None
+            raise KeyError("op %s missing input slot %r" % (op.type, slot))
+        return self.get(names[idx]) if not optional else self.get_opt(names[idx])
+
+    def ins(self, op, slot):
+        return [self.get(n) for n in op.inputs.get(slot, []) if n != EMPTY_VAR]
+
+    def out(self, op, slot, value, idx=0):
+        names = op.outputs.get(slot) or []
+        if names and names[idx] != EMPTY_VAR:
+            self.set(names[idx], value)
+
+    def outs(self, op, slot, values):
+        names = op.outputs.get(slot) or []
+        for n, v in zip(names, values):
+            if n != EMPTY_VAR:
+                self.set(n, v)
+
+    def next_key(self):
+        import jax
+
+        if self.base_key is None:
+            raise RuntimeError(
+                "random op lowered without a PRNG key — executor must pass one"
+            )
+        k = jax.random.fold_in(self.base_key, self._key_counter)
+        self._key_counter += 1
+        axis = self.data_axis
+        if axis is not None:
+            # distinct randomness per shard (the reference's per-device
+            # cuRAND streams); axis_index is free inside shard_map
+            k = jax.random.fold_in(k, jax.lax.axis_index(axis))
+        return k
+
+    @property
+    def data_axis(self):
+        """Name of the data-parallel mesh axis if tracing under one."""
+        for name in ("data", "dp"):
+            if name in self.mesh_axes:
+                return name
+        return None
+
+    def axis_size(self, axis_name):
+        return self.mesh_axes.get(axis_name, 1)
+
+
+def run_op(ctx, op):
+    """Lower a single op into the context environment."""
+    d = get_op_def(op.type)
+    if d is None or d.lower is None:
+        raise NotImplementedError(
+            "no lowering rule registered for op %r" % op.type
+        )
+    d.lower(ctx, op)
+
+
+# ---------------------------------------------------------------------------
+# Generic grad: desc maker + vjp lowering
+# ---------------------------------------------------------------------------
+def generic_grad_maker(op):
+    """Grad-op spec with the reference naming convention: inputs are the
+    forward inputs, forward outputs, and output grads (slot ``S@GRAD``);
+    outputs are input grads. The forward signature is recorded in attrs so
+    the vjp lowering can re-trace the forward rule."""
+    g_inputs = {}
+    for slot, names in op.inputs.items():
+        g_inputs[slot] = list(names)
+    for slot, names in op.outputs.items():
+        g_inputs[slot] = list(names)
+        g_inputs[slot + GRAD_SUFFIX] = [n + GRAD_SUFFIX for n in names]
+    g_outputs = {
+        slot + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in names]
+        for slot, names in op.inputs.items()
+    }
+    attrs = dict(op.attrs)
+    attrs[FWD_INPUTS_ATTR] = {k: list(v) for k, v in op.inputs.items()}
+    attrs[FWD_OUTPUTS_ATTR] = {k: list(v) for k, v in op.outputs.items()}
+    return [
+        dict(
+            type=op.type + "_grad",
+            inputs=g_inputs,
+            outputs=g_outputs,
+            attrs=attrs,
+        )
+    ]
+
+
+class _FakeOp(object):
+    """Lightweight op stand-in for re-tracing a forward rule inside vjp."""
+
+    __slots__ = ("type", "inputs", "outputs", "attrs")
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def input(self, slot):
+        return list(self.inputs.get(slot, []))
+
+    def output(self, slot):
+        return list(self.outputs.get(slot, []))
+
+
+def _is_float(v):
+    import jax.numpy as jnp
+
+    return v is not None and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+
+
+def _generic_grad_lower(ctx, op):
+    """Lower ``foo_grad`` via jax.vjp of foo's forward rule.
+
+    The recomputed forward is CSE'd by XLA against the original forward in
+    the same block program, so this costs nothing at run time while keeping
+    the per-op grad-kernel surface near zero (the reference needed a
+    hand-written grad kernel per op: e.g. operators/conv_op.cc grad +
+    conv_cudnn_op.cu — here one rule covers all).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fwd_type = op.type[: -len("_grad")]
+    fwd_def = get_op_def(fwd_type)
+    fwd_inputs = op.attr(FWD_INPUTS_ATTR)
+    fwd_outputs = op.attr(FWD_OUTPUTS_ATTR)
+    if fwd_inputs is None or fwd_outputs is None:
+        raise NotImplementedError(
+            "generic grad for %s requires maker-recorded signature" % op.type
+        )
+
+    # which (slot, idx) entries we need grads for
+    wrt = []  # [(slot, idx, name)]
+    for gslot, gnames in op.outputs.items():
+        if not gslot.endswith(GRAD_SUFFIX):
+            continue
+        slot = gslot[: -len(GRAD_SUFFIX)]
+        for idx, gname in enumerate(gnames):
+            if gname == EMPTY_VAR:
+                continue
+            src_names = fwd_inputs.get(slot, [])
+            if idx < len(src_names):
+                val = ctx.get_opt(src_names[idx])
+                if _is_float(val):
+                    wrt.append((slot, idx, gname))
+
+    if not wrt:
+        return
+
+    primal_vals = tuple(
+        ctx.get(fwd_inputs[slot][idx]) for slot, idx, _ in wrt
+    )
+    # deterministic flat order of forward outputs
+    out_order = [
+        (slot, idx, name)
+        for slot in sorted(fwd_outputs)
+        for idx, name in enumerate(fwd_outputs[slot])
+        if name != EMPTY_VAR
+    ]
+
+    attrs = {
+        k: v
+        for k, v in op.attrs.items()
+        if k not in (FWD_INPUTS_ATTR, FWD_OUTPUTS_ATTR)
+    }
+
+    def fwd_fn(*vals):
+        env = dict()
+        # base: all forward inputs from the outer env
+        for slot, names in fwd_inputs.items():
+            for n in names:
+                if n != EMPTY_VAR:
+                    v = ctx.get_opt(n)
+                    if v is not None:
+                        env[n] = v
+        for (slot, idx, _), v in zip(wrt, vals):
+            env[fwd_inputs[slot][idx]] = v
+        sub = LowerCtx(env=env, base_key=None, mesh_axes=ctx.mesh_axes)
+        fake = _FakeOp(fwd_type, fwd_inputs, fwd_outputs, attrs)
+        fwd_def.lower(sub, fake)
+        return tuple(
+            env.get(name) for _, _, name in out_order
+        )
+
+    outs, vjp_fn = jax.vjp(fwd_fn, *primal_vals)
+
+    cots = []
+    for (slot, idx, name), o in zip(out_order, outs):
+        og = ctx.get_opt(name + GRAD_SUFFIX)
+        # the grad op lists OG inputs under slot "S@GRAD"
+        og_names = op.inputs.get(slot + GRAD_SUFFIX, [])
+        if og is None and idx < len(og_names):
+            og = ctx.get_opt(og_names[idx])
+        if og is None:
+            og = jnp.zeros_like(o) if o is not None else None
+        cots.append(og)
+
+    grads = vjp_fn(tuple(cots))
+    for (slot, idx, gname), g in zip(wrt, grads):
+        ctx.set(gname, g)
+
+
+# ---------------------------------------------------------------------------
+# infer_shape helpers
+# ---------------------------------------------------------------------------
+def set_out(op, block, slot, shape, dtype=None, idx=0):
+    names = op.outputs.get(slot) or []
+    if not names or names[idx] == EMPTY_VAR:
+        return
+    v = block._find_var_recursive(names[idx])
+    if v is not None:
+        v.shape = tuple(int(s) for s in shape)
+        if dtype is not None:
+            v.dtype = dtype
+
+
+def in_var(op, block, slot, idx=0):
+    names = op.inputs.get(slot) or []
+    if not names:
+        return None
+    return block._find_var_recursive(names[idx])
+
+
+def same_shape_infer(in_slot, out_slot="Out"):
+    def infer(op, block):
+        v = in_var(op, block, in_slot)
+        if v is None:
+            raise SkipInferShape()
+        set_out(op, block, out_slot, v.shape, v.dtype)
+
+    return infer
+
+
+def numeric_grad(f, xs, eps=1e-3):
+    """Finite-difference gradient oracle for tests (reference test harness:
+    python/paddle/fluid/tests/unittests/op_test.py:46 get_numeric_gradient)."""
+    xs = [np.asarray(x, np.float64) for x in xs]
+    base = float(np.sum(f(*xs)))
+    grads = []
+    for i, x in enumerate(xs):
+        g = np.zeros_like(x)
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            old = x[idx]
+            x[idx] = old + eps
+            up = float(np.sum(f(*xs)))
+            x[idx] = old - eps
+            down = float(np.sum(f(*xs)))
+            x[idx] = old
+            g[idx] = (up - down) / (2 * eps)
+            it.iternext()
+        grads.append(g)
+    _ = base
+    return grads

@@ -1,0 +1,113 @@
+"""Sequence (ragged) ops on the padded+length representation.
+
+The reference scales sequence length with LoD ragged tensors and ~20 LoD-aware
+kernels (paddle/fluid/operators/sequence_ops/, LoD at framework/lod_tensor.h:52).
+XLA needs static shapes, so the TPU-native representation is dense
+[batch, max_len, ...] plus an int32 length vector (SURVEY.md §7 hard part 1):
+LoD feeds are padded at the executor boundary (data_feeder.py) and a companion
+``{name}@SEQ_LEN`` env entry carries lengths. Masking replaces ragged offsets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import op
+
+
+def _lengths(ctx, op_, slot="X"):
+    names = op_.inputs.get(slot) or []
+    if not names:
+        return None
+    return ctx.get_opt(names[0] + "@SEQ_LEN")
+
+
+def _mask(x, lengths):
+    import jax.numpy as jnp
+
+    if lengths is None:
+        return jnp.ones(x.shape[:2], dtype=bool)
+    t = jnp.arange(x.shape[1])
+    return t[None, :] < lengths[:, None]
+
+
+@op("sequence_pool", grad="generic")
+def _sequence_pool(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, ...]
+    ptype = op_.attr("pooltype", "AVERAGE").upper()
+    lengths = _lengths(ctx, op_)
+    m = _mask(x, lengths)
+    mexp = m.reshape(m.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(x * mexp, axis=1)
+    elif ptype == "AVERAGE":
+        cnt = jnp.maximum(jnp.sum(mexp, axis=1), 1.0)
+        out = jnp.sum(x * mexp, axis=1) / cnt
+    elif ptype == "SQRT":
+        cnt = jnp.maximum(jnp.sum(mexp, axis=1), 1.0)
+        out = jnp.sum(x * mexp, axis=1) / jnp.sqrt(cnt)
+    elif ptype == "MAX":
+        neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+        out = jnp.max(jnp.where(mexp > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        if lengths is None:
+            out = x[:, -1]
+        else:
+            idx = jnp.maximum(lengths - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            )[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError("sequence_pool type %r" % ptype)
+    ctx.out(op_, "Out", out)
+    if op_.output("MaxIndex"):
+        import jax.numpy as jnp2
+
+        ctx.out(op_, "MaxIndex", jnp2.argmax(x, axis=1).astype(np.int32))
+
+
+@op("sequence_softmax", grad="generic")
+def _sequence_softmax(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T]
+    lengths = _lengths(ctx, op_)
+    m = _mask(x, lengths)
+    neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+    masked = jnp.where(m, x, neg)
+    e = jnp.exp(masked - jnp.max(masked, axis=1, keepdims=True))
+    e = jnp.where(m, e, jnp.zeros_like(e))
+    ctx.out(op_, "Out", e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-12))
+
+
+@op("sequence_expand", grad="generic")
+def _sequence_expand(ctx, op_):
+    # padded representation: broadcast along time of Y
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    if x.ndim < y.ndim:
+        x = x[:, None]
+    reps = [1] * x.ndim
+    reps[1] = y.shape[1] // x.shape[1] if x.shape[1] else y.shape[1]
+    ctx.out(op_, "Out", jnp.tile(x, reps))
+
+
+@op("sequence_reshape", grad="generic")
+def _sequence_reshape(ctx, op_):
+    x = ctx.in1(op_, "X")
+    new_dim = int(op_.attr("new_dim"))
+    ctx.out(op_, "Out", x.reshape((x.shape[0], -1, new_dim)))
+
+
+@op("sequence_concat", grad="generic")
+def _sequence_concat(ctx, op_):
+    import jax.numpy as jnp
+
+    xs = ctx.ins(op_, "X")
+    ctx.out(op_, "Out", jnp.concatenate(xs, axis=1))

@@ -1,0 +1,642 @@
+"""Tensor creation / manipulation ops.
+
+Reference kernels: paddle/fluid/operators/fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, cast_op.cc, concat_op.cc,
+split_op.cc, reshape_op.cc (reshape2 carries XShape for grad), transpose_op.cc,
+assign_op.cc, scale_op.cc, sum_op.cc, lookup_table_op.cc, gather_op.cc, ...
+Here each is a JAX rule; gradients come from the generic vjp path unless the
+op is random or integer-valued.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from .registry import (
+    SkipInferShape,
+    in_var,
+    op,
+    register_op,
+    same_shape_infer,
+    set_out,
+)
+
+
+def _np_dtype(attr_dtype):
+    return core.dtype_to_np(attr_dtype)
+
+
+# -- creation ---------------------------------------------------------------
+def _fill_constant_infer(op_, block):
+    shape = op_.attr("shape", [])
+    set_out(op_, block, "Out", shape, op_.attr("dtype", core.VarDesc.VarType.FP32))
+
+
+@op("fill_constant", infer_shape=_fill_constant_infer)
+def _fill_constant(ctx, op_):
+    import jax.numpy as jnp
+
+    shape = [int(s) for s in op_.attr("shape", [])]
+    val = op_.attr("value", 0.0)
+    if op_.input("ValueTensor"):
+        val = ctx.in1(op_, "ValueTensor")
+    ctx.out(op_, "Out", jnp.full(shape, val, _np_dtype(op_.attr("dtype"))))
+
+
+@op("fill_constant_batch_size_like", infer_shape=_fill_constant_infer)
+def _fill_constant_bsl(ctx, op_):
+    import jax.numpy as jnp
+
+    ref = ctx.in1(op_, "Input")
+    shape = [int(s) for s in op_.attr("shape", [])]
+    in_idx = int(op_.attr("input_dim_idx", 0))
+    out_idx = int(op_.attr("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    ctx.out(
+        op_,
+        "Out",
+        jnp.full(shape, op_.attr("value", 0.0), _np_dtype(op_.attr("dtype"))),
+    )
+
+
+@op("uniform_random", infer_shape=_fill_constant_infer)
+def _uniform_random(ctx, op_):
+    import jax
+
+    shape = [int(s) for s in op_.attr("shape", [])]
+    lo = float(op_.attr("min", -1.0))
+    hi = float(op_.attr("max", 1.0))
+    dt = _np_dtype(op_.attr("dtype", core.VarDesc.VarType.FP32))
+    ctx.out(
+        op_,
+        "Out",
+        jax.random.uniform(ctx.next_key(), shape, dt, minval=lo, maxval=hi),
+    )
+
+
+@op("gaussian_random", infer_shape=_fill_constant_infer)
+def _gaussian_random(ctx, op_):
+    import jax
+
+    shape = [int(s) for s in op_.attr("shape", [])]
+    mean = float(op_.attr("mean", 0.0))
+    std = float(op_.attr("std", 1.0))
+    dt = _np_dtype(op_.attr("dtype", core.VarDesc.VarType.FP32))
+    ctx.out(op_, "Out", jax.random.normal(ctx.next_key(), shape, dt) * std + mean)
+
+
+@op("truncated_gaussian_random", infer_shape=_fill_constant_infer)
+def _truncated_gaussian_random(ctx, op_):
+    import jax
+
+    shape = [int(s) for s in op_.attr("shape", [])]
+    mean = float(op_.attr("mean", 0.0))
+    std = float(op_.attr("std", 1.0))
+    dt = _np_dtype(op_.attr("dtype", core.VarDesc.VarType.FP32))
+    sample = jax.random.truncated_normal(ctx.next_key(), -2.0, 2.0, shape, dt)
+    ctx.out(op_, "Out", sample * std + mean)
+
+
+@op("range")
+def _range(ctx, op_):
+    import jax.numpy as jnp
+
+    start = ctx.in1(op_, "Start").reshape(())
+    end = ctx.in1(op_, "End").reshape(())
+    step = ctx.in1(op_, "Step").reshape(())
+    # XLA requires a static output length, so Start/End/Step must be concrete
+    # at trace time (fill_constant in the same program, or host values)
+    try:
+        n = int(np.floor((float(end) - float(start)) / float(step)))
+    except Exception as exc:
+        raise NotImplementedError(
+            "range op needs concrete Start/End/Step at compile time (XLA "
+            "needs a static shape); got traced values — build them with "
+            "fill_constant instead of feeding them"
+        ) from exc
+    ctx.out(op_, "Out", start + step * jnp.arange(n, dtype=start.dtype))
+
+
+def _fill_zeros_like_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", v.shape, v.dtype)
+
+
+@op("fill_zeros_like", infer_shape=_fill_zeros_like_infer)
+def _fill_zeros_like(ctx, op_):
+    import jax.numpy as jnp
+
+    ctx.out(op_, "Out", jnp.zeros_like(ctx.in1(op_, "X")))
+
+
+@op("fill_any_like", infer_shape=_fill_zeros_like_infer)
+def _fill_any_like(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    dt = op_.attr("dtype", -1)
+    dtype = x.dtype if dt in (-1, None) else _np_dtype(dt)
+    ctx.out(op_, "Out", jnp.full(x.shape, op_.attr("value", 0.0), dtype))
+
+
+# -- dtype / copy -----------------------------------------------------------
+def _cast_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", v.shape, op_.attr("out_dtype"))
+
+
+@op("cast", infer_shape=_cast_infer, grad="generic")
+def _cast(ctx, op_):
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", x.astype(_np_dtype(op_.attr("out_dtype"))))
+
+
+@op("assign", infer_shape=same_shape_infer("X"), grad="generic")
+def _assign(ctx, op_):
+    ctx.out(op_, "Out", ctx.in1(op_, "X"))
+
+
+@op("share_data", infer_shape=same_shape_infer("X"), grad="generic")
+def _share_data(ctx, op_):
+    ctx.out(op_, "Out", ctx.in1(op_, "X"))
+
+
+def _scale_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", v.shape, v.dtype)
+
+
+@op("scale", infer_shape=_scale_infer, grad="generic")
+def _scale(ctx, op_):
+    x = ctx.in1(op_, "X")
+    scale = op_.attr("scale", 1.0)
+    if op_.input("ScaleTensor"):
+        scale = ctx.in1(op_, "ScaleTensor").reshape(())
+    bias = op_.attr("bias", 0.0)
+    if op_.attr("bias_after_scale", True):
+        out = x * scale + np.asarray(bias, x.dtype)
+    else:
+        out = (x + np.asarray(bias, x.dtype)) * scale
+    ctx.out(op_, "Out", out.astype(x.dtype))
+
+
+def _sum_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", v.shape, v.dtype)
+
+
+@op("sum", infer_shape=_sum_infer, grad="generic")
+def _sum(ctx, op_):
+    xs = ctx.ins(op_, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.out(op_, "Out", out)
+
+
+# -- shape manipulation ------------------------------------------------------
+def _reshape_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    shape = list(op_.attr("shape", []))
+    in_shape = list(v.shape)
+    if -1 in shape or 0 in shape:
+        shape = [in_shape[i] if s == 0 else s for i, s in enumerate(shape)]
+        if -1 in shape and all(s > 0 for s in in_shape):
+            known = int(np.prod([s for s in shape if s != -1])) or 1
+            total = int(np.prod(in_shape))
+            shape[shape.index(-1)] = total // known
+    set_out(op_, block, "Out", shape, v.dtype)
+    if op_.output("XShape"):
+        set_out(op_, block, "XShape", (0,) + tuple(in_shape), v.dtype)
+
+
+def _reshape_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    shape = list(op_.attr("shape", []))
+    if op_.input("Shape"):
+        shape = [int(s) for s in np.asarray(ctx.in1(op_, "Shape"))]
+    shape = [x.shape[i] if s == 0 else int(s) for i, s in enumerate(shape)] if 0 in shape else [int(s) for s in shape]
+    ctx.out(op_, "Out", jnp.reshape(x, shape))
+    if op_.output("XShape"):
+        ctx.out(op_, "XShape", jnp.zeros((0,), x.dtype))
+
+
+register_op("reshape", infer_shape=_reshape_infer, lower=_reshape_lower, grad="generic")
+register_op("reshape2", infer_shape=_reshape_infer, lower=_reshape_lower, grad="generic")
+
+
+def _transpose_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    axis = op_.attr("axis", [])
+    shape = [v.shape[a] for a in axis]
+    set_out(op_, block, "Out", shape, v.dtype)
+    if op_.output("XShape"):
+        set_out(op_, block, "XShape", (0,) + tuple(v.shape), v.dtype)
+
+
+def _transpose_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.transpose(x, op_.attr("axis")))
+    if op_.output("XShape"):
+        ctx.out(op_, "XShape", jnp.zeros((0,), x.dtype))
+
+
+register_op("transpose", infer_shape=_transpose_infer, lower=_transpose_lower, grad="generic")
+register_op("transpose2", infer_shape=_transpose_infer, lower=_transpose_lower, grad="generic")
+
+
+def _squeeze_axes(shape, axes):
+    if axes:
+        return [d for i, d in enumerate(shape) if i not in set(a % len(shape) for a in axes)]
+    return [d for d in shape if d != 1]
+
+
+def _squeeze_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    shape = _squeeze_axes(list(v.shape), op_.attr("axes", []))
+    set_out(op_, block, "Out", shape, v.dtype)
+    if op_.output("XShape"):
+        set_out(op_, block, "XShape", (0,) + tuple(v.shape), v.dtype)
+
+
+def _squeeze_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    shape = _squeeze_axes(list(x.shape), op_.attr("axes", []))
+    ctx.out(op_, "Out", jnp.reshape(x, shape))
+    if op_.output("XShape"):
+        ctx.out(op_, "XShape", jnp.zeros((0,), x.dtype))
+
+
+register_op("squeeze", infer_shape=_squeeze_infer, lower=_squeeze_lower, grad="generic")
+register_op("squeeze2", infer_shape=_squeeze_infer, lower=_squeeze_lower, grad="generic")
+
+
+def _unsqueeze_shape(shape, axes):
+    out = list(shape)
+    for a in sorted(a % (len(out) + 1) for a in axes):
+        out.insert(a, 1)
+    return out
+
+
+def _unsqueeze_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    shape = _unsqueeze_shape(v.shape, op_.attr("axes", []))
+    set_out(op_, block, "Out", shape, v.dtype)
+    if op_.output("XShape"):
+        set_out(op_, block, "XShape", (0,) + tuple(v.shape), v.dtype)
+
+
+def _unsqueeze_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.reshape(x, _unsqueeze_shape(x.shape, op_.attr("axes", []))))
+    if op_.output("XShape"):
+        ctx.out(op_, "XShape", jnp.zeros((0,), x.dtype))
+
+
+register_op("unsqueeze", infer_shape=_unsqueeze_infer, lower=_unsqueeze_lower, grad="generic")
+register_op("unsqueeze2", infer_shape=_unsqueeze_infer, lower=_unsqueeze_lower, grad="generic")
+
+
+def _flatten_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    ax = int(op_.attr("axis", 1))
+    shape = list(v.shape)
+    if all(s >= 0 for s in shape):
+        out = [int(np.prod(shape[:ax])) if ax else 1, int(np.prod(shape[ax:]))]
+    else:
+        out = [-1, -1]
+    set_out(op_, block, "Out", out, v.dtype)
+    if op_.output("XShape"):
+        set_out(op_, block, "XShape", (0,) + tuple(v.shape), v.dtype)
+
+
+def _flatten_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ax = int(op_.attr("axis", 1))
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    ctx.out(op_, "Out", jnp.reshape(x, (lead, -1)))
+    if op_.output("XShape"):
+        ctx.out(op_, "XShape", jnp.zeros((0,), x.dtype))
+
+
+register_op("flatten", infer_shape=_flatten_infer, lower=_flatten_lower, grad="generic")
+register_op("flatten2", infer_shape=_flatten_infer, lower=_flatten_lower, grad="generic")
+
+
+def _concat_infer(op_, block):
+    vs = [block._find_var_recursive(n) for n in op_.input("X")]
+    if any(v is None for v in vs):
+        raise SkipInferShape()
+    ax = int(op_.attr("axis", 0))
+    shape = list(vs[0].shape)
+    if shape and all(v.shape for v in vs):
+        shape[ax] = sum(v.shape[ax] for v in vs)
+    set_out(op_, block, "Out", shape, vs[0].dtype)
+
+
+@op("concat", infer_shape=_concat_infer, grad="generic")
+def _concat(ctx, op_):
+    import jax.numpy as jnp
+
+    xs = ctx.ins(op_, "X")
+    ax = int(op_.attr("axis", 0))
+    if op_.input("AxisTensor"):
+        ax = int(np.asarray(ctx.in1(op_, "AxisTensor")))
+    ctx.out(op_, "Out", jnp.concatenate(xs, axis=ax))
+
+
+@op("split", grad="generic")
+def _split(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ax = int(op_.attr("axis", 0))
+    sections = op_.attr("sections", [])
+    num = int(op_.attr("num", 0))
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=ax)
+    else:
+        outs = jnp.split(x, num, axis=ax)
+    ctx.outs(op_, "Out", outs)
+
+
+@op("stack", grad="generic")
+def _stack(ctx, op_):
+    import jax.numpy as jnp
+
+    xs = ctx.ins(op_, "X")
+    ctx.out(op_, "Y", jnp.stack(xs, axis=int(op_.attr("axis", 0))))
+
+
+@op("unstack", grad="generic")
+def _unstack(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ax = int(op_.attr("axis", 0))
+    parts = jnp.split(x, x.shape[ax], axis=ax)
+    ctx.outs(op_, "Y", [jnp.squeeze(p, axis=ax) for p in parts])
+
+
+def _expand_infer(op_, block):
+    v = in_var(op_, block, "X")
+    if v is None:
+        raise SkipInferShape()
+    times = op_.attr("expand_times", [])
+    shape = [d * t if d >= 0 else -1 for d, t in zip(v.shape, times)]
+    set_out(op_, block, "Out", shape, v.dtype)
+
+
+@op("expand", infer_shape=_expand_infer, grad="generic")
+def _expand(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.tile(x, op_.attr("expand_times")))
+
+
+@op("slice", grad="generic")
+def _slice(ctx, op_):
+    x = ctx.in1(op_, "Input")
+    axes = op_.attr("axes", [])
+    starts = op_.attr("starts", [])
+    ends = op_.attr("ends", [])
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(int(s), int(e))
+    out = x[tuple(idx)]
+    decrease = op_.attr("decrease_axis", [])
+    if decrease:
+        import jax.numpy as jnp
+
+        out = jnp.squeeze(out, axis=tuple(decrease))
+    ctx.out(op_, "Out", out)
+
+
+@op("gather", grad="generic")
+def _gather(ctx, op_):
+    x = ctx.in1(op_, "X")
+    idx = ctx.in1(op_, "Index").reshape(-1)
+    ctx.out(op_, "Out", x[idx])
+
+
+@op("scatter", grad="generic")
+def _scatter(ctx, op_):
+    x = ctx.in1(op_, "X")
+    ids = ctx.in1(op_, "Ids").reshape(-1)
+    upd = ctx.in1(op_, "Updates")
+    if op_.attr("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    ctx.out(op_, "Out", out)
+
+
+@op("shape")
+def _shape(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")
+    ctx.out(op_, "Out", jnp.asarray(x.shape, np.int32))
+
+
+def _lookup_table_infer(op_, block):
+    w = in_var(op_, block, "W")
+    ids = in_var(op_, block, "Ids")
+    if w is None or ids is None:
+        raise SkipInferShape()
+    id_shape = list(ids.shape)
+    if op_.type == "lookup_table" and id_shape and id_shape[-1] == 1:
+        id_shape = id_shape[:-1]
+    set_out(op_, block, "Out", tuple(id_shape) + (w.shape[-1],), w.dtype)
+
+
+def _lookup_table_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    w = ctx.in1(op_, "W")
+    ids = ctx.in1(op_, "Ids")
+    if op_.type == "lookup_table" and ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    padding_idx = int(op_.attr("padding_idx", -1))
+    out = w[ids]
+    if padding_idx != -1:
+        if padding_idx < 0:
+            padding_idx += w.shape[0]
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    ctx.out(op_, "Out", out)
+
+
+register_op(
+    "lookup_table",
+    infer_shape=_lookup_table_infer,
+    lower=_lookup_table_lower,
+    grad="generic",
+)
+register_op(
+    "lookup_table_v2",
+    infer_shape=_lookup_table_infer,
+    lower=_lookup_table_lower,
+    grad="generic",
+)
+
+
+@op("one_hot")
+def _one_hot(ctx, op_):
+    import jax
+
+    x = ctx.in1(op_, "X")
+    depth = int(op_.attr("depth"))
+    if x.shape and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    ctx.out(op_, "Out", jax.nn.one_hot(x, depth, dtype=np.float32))
+
+
+@op("arg_max")
+def _arg_max(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.argmax(x, axis=int(op_.attr("axis", -1))).astype(np.int64))
+
+
+@op("arg_min")
+def _arg_min(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.argmin(x, axis=int(op_.attr("axis", -1))).astype(np.int64))
+
+
+@op("top_k")
+def _top_k(ctx, op_):
+    import jax
+
+    x = ctx.in1(op_, "X")
+    k = int(op_.attr("k", 1))
+    vals, idx = jax.lax.top_k(x, k)
+    ctx.out(op_, "Out", vals)
+    ctx.out(op_, "Indices", idx.astype(np.int64))
+
+
+@op("where_index")
+def _where_index(ctx, op_):
+    # data-dependent output shape: host-only op in XLA-land
+    raise NotImplementedError(
+        "where_index has a data-dependent shape; use masked ops instead"
+    )
+
+
+@op("pad", grad="generic")
+def _pad(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    paddings = op_.attr("paddings")
+    pad_value = op_.attr("pad_value", 0.0)
+    pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.out(op_, "Out", jnp.pad(x, pairs, constant_values=pad_value))
+
+
+@op("pad2d", grad="generic")
+def _pad2d(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    p = op_.attr("paddings")  # [top, bottom, left, right]
+    mode = op_.attr("mode", "constant")
+    value = op_.attr("pad_value", 0.0)
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if op_.attr("data_format", "NCHW") == "NHWC":
+        pairs = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    modes = {"constant": "constant", "reflect": "reflect", "edge": "edge"}
+    if mode == "constant":
+        ctx.out(op_, "Out", jnp.pad(x, pairs, constant_values=value))
+    else:
+        ctx.out(op_, "Out", jnp.pad(x, pairs, mode=modes[mode]))
+
+
+@op("reverse", grad="generic")
+def _reverse(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.flip(x, axis=tuple(op_.attr("axis"))))
+
+
+@op("isinf")
+def _isinf(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.any(jnp.isinf(x)).reshape((1,)))
+
+
+@op("isnan")
+def _isnan(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.any(jnp.isnan(x)).reshape((1,)))
+
+
+@op("argsort")
+def _argsort(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ax = int(op_.attr("axis", -1))
+    idx = jnp.argsort(x, axis=ax)
+    ctx.out(op_, "Out", jnp.sort(x, axis=ax))
+    ctx.out(op_, "Indices", idx.astype(np.int64))
+
+
+@op("linspace")
+def _linspace(ctx, op_):
+    import jax.numpy as jnp
+
+    start = ctx.in1(op_, "Start").reshape(())
+    stop = ctx.in1(op_, "Stop").reshape(())
+    num = int(np.asarray(ctx.in1(op_, "Num")))
+    ctx.out(op_, "Out", jnp.linspace(start, stop, num))
+
+
+@op("diag")
+def _diag(ctx, op_):
+    import jax.numpy as jnp
+
+    ctx.out(op_, "Out", jnp.diag(ctx.in1(op_, "Diagonal")))

@@ -1,0 +1,959 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py — 19 classes at
+:54,:690,:761,:870,...; each appends per-parameter update ops to the Program).
+
+The update rules are ops (ops/optimizer_ops.py) lowered into the same XLA
+program as forward+backward, so one train step is ONE fused executable — the
+reference's fuse_optimizer_ops_pass / coalesce_grad_tensor_pass exist to
+approximate this and are unnecessary here.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from . import core
+from .backward import append_backward
+from .framework import (
+    OP_ROLE_KEY,
+    OpRole,
+    Parameter,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    op_role_guard,
+    program_guard,
+)
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from . import unique_name
+
+__all__ = [
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adam",
+    "Adamax",
+    "Dpsgd",
+    "DecayedAdagrad",
+    "Ftrl",
+    "SGDOptimizer",
+    "MomentumOptimizer",
+    "LarsMomentumOptimizer",
+    "AdagradOptimizer",
+    "AdamOptimizer",
+    "AdamaxOptimizer",
+    "DpsgdOptimizer",
+    "DecayedAdagradOptimizer",
+    "RMSPropOptimizer",
+    "FtrlOptimizer",
+    "Adadelta",
+    "AdadeltaOptimizer",
+    "LambOptimizer",
+    "ExponentialMovingAverage",
+    "LookaheadOptimizer",
+    "ModelAverage",
+    "RecomputeOptimizer",
+    "DGCMomentumOptimizer",
+]
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate_map = {}
+        self._accumulators = defaultdict(dict)
+        self.helper = None
+        self._opti_name_list = []
+
+    # -- learning rate --
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        lr_name = unique_name.generate("learning_rate")
+        lr_var = program.global_block().create_var(
+            name=lr_name,
+            shape=[1],
+            dtype="float32",
+            persistable=True,
+        )
+        lr_var.stop_gradient = True
+        self.helper.set_variable_initializer(
+            lr_var, Constant(value=float(self._learning_rate))
+        )
+        self._learning_rate_map[program] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        program = program or default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        base = self._global_learning_rate()
+        if param_lr == 1.0:
+            return base
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="scale",
+            inputs={"X": [base]},
+            outputs={"Out": [out]},
+            attrs={"scale": float(param_lr), OP_ROLE_KEY: OpRole.Optimize},
+        )
+        return out
+
+    # -- accumulators (reference: Optimizer._add_accumulator) --
+    def _add_accumulator(
+        self, name, param, dtype=None, fill_value=0.0, shape=None
+    ):
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        var_name = unique_name.generate(param.name + "_" + name)
+        block = default_main_program().global_block()
+        var = block.create_var(
+            name=var_name,
+            shape=shape if shape is not None else param.shape,
+            dtype=dtype or param.dtype,
+            persistable=True,
+        )
+        var.stop_gradient = True
+        var.belong_to_optimizer = True
+        self.helper.set_variable_initializer(
+            var, Constant(value=float(fill_value))
+        )
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        if param.name not in self._accumulators[name]:
+            raise LookupError(
+                "accumulator %s for parameter %s not created" % (name, param.name)
+            )
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    # -- main passes (reference: _create_optimization_pass at optimizer.py:385) --
+    def _create_optimization_pass(self, parameters_and_grads):
+        program = default_main_program()
+        block = program.global_block()
+        self.helper = LayerHelper(self.__class__.__name__)
+        with op_role_guard(OpRole.Optimize):
+            self._create_global_learning_rate()
+            self._create_accumulators(
+                block, [p for p, g in parameters_and_grads if g is not None]
+            )
+            optimize_ops = []
+            for param_and_grad in parameters_and_grads:
+                if param_and_grad[1] is None:
+                    continue
+                if param_and_grad[0].trainable:
+                    optimize_ops.append(
+                        self._append_optimize_op(block, param_and_grad)
+                    )
+            self._finish_update(block, parameters_and_grads)
+        return optimize_ops
+
+    def backward(
+        self,
+        loss,
+        startup_program=None,
+        parameter_list=None,
+        no_grad_set=None,
+        callbacks=None,
+    ):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        from . import clip as _clip
+        from . import regularizer as _regularizer
+
+        params_grads = _clip.append_gradient_clip_ops(params_grads)
+        params_grads = _regularizer.append_regularization_ops(
+            params_grads, self.regularization
+        )
+        return self._create_optimization_pass(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        with program_guard(
+            default_main_program(), startup_program or default_startup_program()
+        ):
+            return self.apply_gradients(params_grads)
+
+    def minimize(
+        self,
+        loss,
+        startup_program=None,
+        parameter_list=None,
+        no_grad_set=None,
+        grad_clip=None,
+    ):
+        params_grads = self.backward(
+            loss,
+            startup_program=startup_program,
+            parameter_list=parameter_list,
+            no_grad_set=no_grad_set,
+        )
+        if grad_clip is not None:
+            from . import clip as _clip
+
+            params_grads = _clip.append_clip_with(params_grads, grad_clip)
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    """reference: optimizer.py:690 SGDOptimizer -> sgd op."""
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param]},
+            attrs={OP_ROLE_KEY: OpRole.Optimize},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator(self._velocity_acc_str, param)
+        return block.append_op(
+            type="momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={
+                "mu": self._momentum,
+                "use_nesterov": self._use_nesterov,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class LarsMomentumOptimizer(MomentumOptimizer):
+    def __init__(
+        self,
+        learning_rate,
+        momentum,
+        lars_coeff=0.001,
+        lars_weight_decay=0.0005,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, momentum, **kwargs)
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator(self._velocity_acc_str, param)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(
+                self._moment_acc_str, p, fill_value=self.initial_accumulator_value
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type="adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon, OP_ROLE_KEY: OpRole.Optimize},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = "moment1"
+    _moment2_acc_str = "moment2"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+    _beta2_pow_acc_str = "beta2_pow_acc"
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        lazy_mode=False,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+            self._add_accumulator(
+                self._beta1_pow_acc_str, p, fill_value=self._beta1, shape=[1]
+            )
+            self._add_accumulator(
+                self._beta2_pow_acc_str, p, fill_value=self._beta2, shape=[1]
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment1 = self._get_accumulator(self._moment1_acc_str, param)
+        moment2 = self._get_accumulator(self._moment2_acc_str, param)
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+        beta2_pow = self._get_accumulator(self._beta2_pow_acc_str, param)
+        return block.append_op(
+            type="adam",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment1": [moment1],
+                "Moment2": [moment2],
+                "Beta1Pow": [beta1_pow],
+                "Beta2Pow": [beta2_pow],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "Moment1Out": [moment1],
+                "Moment2Out": [moment2],
+                "Beta1PowOut": [beta1_pow],
+                "Beta2PowOut": [beta2_pow],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "lazy_mode": self._lazy_mode,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+    _inf_norm_acc_str = "inf_norm"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+
+    def __init__(
+        self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw
+    ):
+        super().__init__(learning_rate, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+            self._add_accumulator(
+                self._beta1_pow_acc_str, p, fill_value=self._beta1, shape=[1]
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        inf_norm = self._get_accumulator(self._inf_norm_acc_str, param)
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+        op = block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "InfNorm": [inf_norm],
+                "Beta1Pow": [beta1_pow],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [moment],
+                "InfNormOut": [inf_norm],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+        return op
+
+    def _finish_update(self, block, parameters_and_grads):
+        # update beta1 pow accumulators once per step
+        for param, grad in parameters_and_grads:
+            if grad is None:
+                continue
+            beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+            block.append_op(
+                type="scale",
+                inputs={"X": [beta1_pow]},
+                outputs={"Out": [beta1_pow]},
+                attrs={"scale": self._beta1, OP_ROLE_KEY: OpRole.Optimize},
+            )
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999, sigma=1e-8):
+        super().__init__(learning_rate)
+        self._clip = clip
+        self._batch_size = batch_size
+        self._sigma = sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="dpsgd",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param]},
+            attrs={
+                "clip": self._clip,
+                "batch_size": self._batch_size,
+                "sigma": self._sigma,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={
+                "decay": self._decay,
+                "epsilon": self._epsilon,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    _avg_squared_grad_acc_str = "_avg_squared_grad"
+    _avg_squared_update_acc_str = "_avg_squared_update"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        avg_g = self._get_accumulator(self._avg_squared_grad_acc_str, param)
+        avg_u = self._get_accumulator(self._avg_squared_update_acc_str, param)
+        return block.append_op(
+            type="adadelta",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "AvgSquaredGrad": [avg_g],
+                "AvgSquaredUpdate": [avg_u],
+            },
+            outputs={
+                "ParamOut": [param],
+                "AvgSquaredGradOut": [avg_g],
+                "AvgSquaredUpdateOut": [avg_u],
+            },
+            attrs={
+                "epsilon": self._epsilon,
+                "rho": self._rho,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    _momentum_acc_str = "momentum"
+    _mean_square_acc_str = "mean_square"
+    _mean_grad_acc_str = "mean_grad"
+
+    def __init__(
+        self,
+        learning_rate,
+        rho=0.95,
+        epsilon=1e-6,
+        momentum=0.0,
+        centered=False,
+        **kw,
+    ):
+        super().__init__(learning_rate, **kw)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+            self._add_accumulator(self._mean_grad_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        momentum = self._get_accumulator(self._momentum_acc_str, param)
+        mean_square = self._get_accumulator(self._mean_square_acc_str, param)
+        mean_grad = self._get_accumulator(self._mean_grad_acc_str, param)
+        return block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [momentum],
+                "MeanSquare": [mean_square],
+                "MeanGrad": [mean_grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [momentum],
+                "MeanSquareOut": [mean_square],
+                "MeanGradOut": [mean_grad],
+            },
+            attrs={
+                "epsilon": self._epsilon,
+                "decay": self._rho,
+                "momentum": self._momentum,
+                "centered": self._centered,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    _squared_acc_str = "squared"
+    _linear_acc_str = "linear"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        squared = self._get_accumulator(self._squared_acc_str, param)
+        linear = self._get_accumulator(self._linear_acc_str, param)
+        return block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "SquaredAccumulator": [squared],
+                "LinearAccumulator": [linear],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "SquaredAccumOut": [squared],
+                "LinearAccumOut": [linear],
+            },
+            attrs={
+                "l1": self._l1,
+                "l2": self._l2,
+                "lr_power": self._lr_power,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(
+        self,
+        learning_rate=0.001,
+        lamb_weight_decay=0.01,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-6,
+        exclude_from_weight_decay_fn=None,
+        **kw,
+    ):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_from_weight_decay_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        wd = self._weight_decay
+        if self._exclude_from_weight_decay_fn is not None and \
+                self._exclude_from_weight_decay_fn(param):
+            wd = 0.0
+        moment1 = self._get_accumulator(self._moment1_acc_str, param)
+        moment2 = self._get_accumulator(self._moment2_acc_str, param)
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+        beta2_pow = self._get_accumulator(self._beta2_pow_acc_str, param)
+        return block.append_op(
+            type="lamb",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment1": [moment1],
+                "Moment2": [moment2],
+                "Beta1Pow": [beta1_pow],
+                "Beta2Pow": [beta2_pow],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "Moment1Out": [moment1],
+                "Moment2Out": [moment2],
+                "Beta1PowOut": [beta1_pow],
+                "Beta2PowOut": [beta2_pow],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "weight_decay": wd,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Deep Gradient Compression momentum (reference: optimizer.py:870).
+    On TPU dense psum over ICI outperforms top-k sparsification at the scales
+    the reference targeted, so DGC runs as momentum + the same local-grad
+    clipping; the sparse path is kept API-compatible."""
+
+    def __init__(
+        self,
+        learning_rate,
+        momentum,
+        rampup_begin_step=0,
+        rampup_step=1,
+        sparsity=(0.999,),
+        use_nesterov=False,
+        local_grad_clip_norm=None,
+        num_trainers=None,
+        **kw,
+    ):
+        super().__init__(learning_rate, momentum, use_nesterov, **kw)
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity
+        self._local_grad_clip_norm = local_grad_clip_norm
+
+
+class ExponentialMovingAverage(object):
+    """reference: optimizer.py ExponentialMovingAverage — shadow vars updated
+    in-graph each step; apply()/restore() swap them in for eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._shadows = {}  # param name -> shadow var
+
+    def update(self):
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper("ema")
+        with op_role_guard(OpRole.Optimize):
+            for param in block.all_parameters():
+                if not param.trainable:
+                    continue
+                shadow = block.create_var(
+                    name=unique_name.generate(param.name + ".ema"),
+                    shape=param.shape,
+                    dtype=param.dtype,
+                    persistable=True,
+                )
+                helper.set_variable_initializer(shadow, Constant(0.0))
+                self._shadows[param.name] = shadow
+                # shadow = decay * shadow + (1-decay) * param
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [shadow]},
+                    outputs={"Out": [shadow]},
+                    attrs={"scale": self._decay},
+                )
+                tmp = block.create_var(
+                    name=unique_name.generate(param.name + ".ema_tmp"),
+                    shape=param.shape,
+                    dtype=param.dtype,
+                )
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [param]},
+                    outputs={"Out": [tmp]},
+                    attrs={"scale": 1.0 - self._decay},
+                )
+                block.append_op(
+                    type="elementwise_add",
+                    inputs={"X": [shadow], "Y": [tmp]},
+                    outputs={"Out": [shadow]},
+                )
+
+    def apply(self, executor, need_restore=True):
+        import contextlib
+
+        scope = core.global_scope()
+
+        @contextlib.contextmanager
+        def _apply():
+            backup = {}
+            for pname, shadow in self._shadows.items():
+                backup[pname] = scope.get(pname)
+                sval = scope.get(shadow.name)
+                if sval is not None:
+                    scope.set(pname, sval)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for pname, val in backup.items():
+                        scope.set(pname, val)
+
+        return _apply()
+
+    def restore(self, executor):
+        pass
+
+
+class ModelAverage(Optimizer):
+    """reference: optimizer.py ModelAverage — running average of params over
+    a window; swap in for eval via apply()."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sums = {}
+        self._counts = {}
+
+    def _append_average_ops(self, block, param):
+        helper = LayerHelper("model_average")
+        s = block.create_var(
+            name=unique_name.generate(param.name + "_sum"),
+            shape=param.shape, dtype=param.dtype, persistable=True,
+        )
+        helper.set_variable_initializer(s, Constant(0.0))
+        block.append_op(
+            type="elementwise_add", inputs={"X": [s], "Y": [param]},
+            outputs={"Out": [s]},
+        )
+        self._sums[param.name] = s
+
+    def apply(self, executor, need_restore=True):
+        raise NotImplementedError(
+            "ModelAverage.apply requires the trainer loop integration"
+        )
+
+
+class LookaheadOptimizer(object):
+    """reference: optimizer.py:3606 LookaheadOptimizer — fast/slow weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        mini_out = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program
+        )
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper("lookahead")
+        with op_role_guard(OpRole.Optimize):
+            step = block.create_var(
+                name=unique_name.generate("lookahead_step"),
+                shape=[1], dtype="int64", persistable=True,
+            )
+            helper.set_variable_initializer(step, Constant(0.0))
+            block.append_op(
+                type="increment", inputs={"X": [step]},
+                outputs={"Out": [step]}, attrs={"step": 1.0},
+            )
+            for param in block.all_parameters():
+                if not param.trainable:
+                    continue
+                slow = block.create_var(
+                    name=unique_name.generate(param.name + "_slow"),
+                    shape=param.shape, dtype=param.dtype, persistable=True,
+                )
+                helper.set_variable_initializer(slow, Constant(0.0))
+                block.append_op(
+                    type="lookahead_update",
+                    inputs={"Param": [param], "SlowParam": [slow], "Step": [step]},
+                    outputs={"ParamOut": [param], "SlowParamOut": [slow]},
+                    attrs={"alpha": self.alpha, "k": self.k},
+                )
+        return mini_out
+
+
+class RecomputeOptimizer(Optimizer):
+    """reference: optimizer.py:3313 RecomputeOptimizer — activation
+    checkpointing. TPU-native realisation: segments between checkpoints are
+    wrapped in jax.checkpoint by the executor when the program advertises
+    checkpoint vars (program._recompute_checkpoints)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        loss.block.program._recompute_checkpoints = [
+            c.name if isinstance(c, Variable) else c
+            for c in (self._checkpoints or [])
+        ]
+        return self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks
+        )
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_optimize(loss, startup_program, params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+
+# lookahead_update op
+from .ops.registry import op as _op  # noqa: E402
+
+
+@_op(
+    "lookahead_update",
+    stateful_inputs=(("Param", "ParamOut"), ("SlowParam", "SlowParamOut")),
+)
+def _lookahead_update(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    slow = ctx.in1(op_, "SlowParam")
+    step = ctx.in1(op_, "Step").reshape(())
+    alpha = np.asarray(op_.attr("alpha", 0.5), p.dtype)
+    k = int(op_.attr("k", 5))
+    sync = (step % k) == 0
+    new_slow = jnp.where(sync, alpha * p + (1 - alpha) * slow, slow)
+    new_p = jnp.where(sync, new_slow, p)
+    ctx.out(op_, "ParamOut", new_p)
+    ctx.out(op_, "SlowParamOut", new_slow)
+
+
+# short aliases matching fluid.optimizer.*
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Dpsgd = DpsgdOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer

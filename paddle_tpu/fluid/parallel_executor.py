@@ -1,0 +1,60 @@
+"""ParallelExecutor — legacy data-parallel wrapper
+(reference: python/paddle/fluid/parallel_executor.py:28, wrapping the C++ PE
+at framework/parallel_executor.cc:398). Delegates to CompiledProgram's SPMD
+path; kept for API parity."""
+
+from __future__ import annotations
+
+from . import core
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .executor import Executor
+from .framework import default_main_program
+
+
+class ParallelExecutor(object):
+    def __init__(
+        self,
+        use_cuda=False,
+        loss_name=None,
+        main_program=None,
+        share_vars_from=None,
+        exec_strategy=None,
+        build_strategy=None,
+        num_trainers=1,
+        trainer_id=0,
+        scope=None,
+    ):
+        self._main_program = main_program or default_main_program()
+        self._scope = scope or core.global_scope()
+        place = core.TPUPlace(0) if use_cuda else core.CPUPlace()
+        self._places = (
+            core.tpu_places() if use_cuda else core.cpu_places()
+        )
+        self._exe = Executor(place)
+        self._compiled = CompiledProgram(
+            self._main_program, build_strategy=build_strategy
+        ).with_data_parallel(
+            loss_name=loss_name,
+            exec_strategy=exec_strategy or ExecutionStrategy(),
+            share_vars_from=share_vars_from._compiled if share_vars_from else None,
+        )
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(
+            self._compiled,
+            feed=feed,
+            fetch_list=fetch_list,
+            scope=self._scope,
+            return_numpy=return_numpy,
+        )
+
+    @property
+    def device_count(self):
+        return self._compiled._device_count()
+
+    def drop_local_exe_scopes(self):
+        pass
+
+
+_ = BuildStrategy

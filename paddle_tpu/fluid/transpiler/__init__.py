@@ -1,0 +1,45 @@
+"""Program transpilers (reference: python/paddle/fluid/transpiler/)."""
+
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from . import collective  # noqa: F401
+from .collective import GradAllReduce, LocalSGD  # noqa: F401
+
+
+class HashName(object):
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = pserver_endpoints
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server_id = hash(var.name) % len(self.pserver_endpoints)
+            eplist.append(self.pserver_endpoints[server_id])
+        return eplist
+
+
+class RoundRobin(object):
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = pserver_endpoints
+        self.pserver_idx = 0
+
+    def dispatch(self, varlist):
+        eplist = []
+        for _ in varlist:
+            eplist.append(self.pserver_endpoints[self.pserver_idx])
+            self.pserver_idx = (self.pserver_idx + 1) % len(
+                self.pserver_endpoints
+            )
+        return eplist
+
+
+def memory_optimize(*args, **kwargs):
+    """Deprecated in the reference (memory_optimization_transpiler.py shim);
+    on TPU, XLA buffer assignment + donation make it a no-op."""
+    return None
+
+
+def release_memory(*args, **kwargs):
+    return None

@@ -1,0 +1,1 @@
+"""Model zoo built on the fluid layers API (used by tests and bench.py)."""

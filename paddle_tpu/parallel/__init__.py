@@ -1,0 +1,13 @@
+"""Device-mesh and SPMD utilities — the TPU-native communication backend.
+
+Replaces the reference's NCCL layer (paddle/fluid/platform/nccl_helper.h
+NCCLContextMap/NCCLCommunicator rings, collective_helper.h NCCLCommContext)
+with jax.sharding.Mesh over ICI/DCN and XLA collectives.
+"""
+
+from .mesh import (  # noqa: F401
+    build_data_mesh,
+    build_mesh,
+    shard_map,
+    CommContext,
+)

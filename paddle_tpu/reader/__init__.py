@@ -1,0 +1,15 @@
+"""Composable reader decorators (reference: python/paddle/reader/)."""
+
+from .decorator import (  # noqa: F401
+    map_readers,
+    buffered,
+    compose,
+    chain,
+    shuffle,
+    ComposeNotAligned,
+    firstn,
+    xmap_readers,
+    cache,
+    multiprocess_reader,
+    batch,
+)

@@ -1,0 +1,13 @@
+"""Test config: run on the jax CPU backend with 8 virtual devices so
+multi-chip SPMD paths are exercised without TPU hardware (the reference's
+philosophy of simulating multi-node on localhost — test_dist_base.py)."""
+
+import os
+
+# must be set before jax is imported anywhere
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

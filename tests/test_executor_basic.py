@@ -1,0 +1,97 @@
+"""Executor + Program basics (reference test model:
+python/paddle/fluid/tests/unittests/test_executor_and_mul.py etc.)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _fresh_programs():
+    return fluid.Program(), fluid.Program()
+
+
+def test_fill_constant_fetch():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        out = fluid.layers.fill_constant(shape=[2, 3], dtype="float32", value=7.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(main, fetch_list=[out])
+    np.testing.assert_allclose(res, np.full((2, 3), 7.5, np.float32))
+
+
+def test_feed_fetch_roundtrip():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0) if hasattr(fluid.layers, "scale") else x * 2.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    data = np.arange(6, dtype=np.float32).reshape(2, 3)
+    (res,) = exe.run(main, feed={"x": data}, fetch_list=[y])
+    np.testing.assert_allclose(res, data * 2.0)
+
+
+def test_fc_forward_matches_numpy():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=x, size=5, act=None,
+                              param_attr=fluid.ParamAttr(name="fc_w"),
+                              bias_attr=fluid.ParamAttr(name="fc_b"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    w = np.asarray(scope.get("fc_w"))
+    b = np.asarray(scope.get("fc_b"))
+    data = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    (res,) = exe.run(main, feed={"x": data}, fetch_list=[out])
+    np.testing.assert_allclose(res, data @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_persistable_state_survives_runs():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        counter = fluid.layers.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True, name="ctr"
+        )
+        fluid.layers.increment(counter, value=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for expected in (1.0, 2.0, 3.0):
+        (res,) = exe.run(main, fetch_list=[counter])
+        assert float(np.asarray(res).ravel()[0]) == expected
+
+
+def test_program_cache_reuse():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = x * 3.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = np.ones((1, 2), np.float32)
+    exe.run(main, feed={"x": d}, fetch_list=[y])
+    n_cached = len(exe._cache)
+    exe.run(main, feed={"x": d}, fetch_list=[y])
+    assert len(exe._cache) == n_cached
+
+
+def test_prune_drops_unused_ops():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = x * 2.0
+        z = x * 5.0
+    pruned = main._prune(feeds=["x"], fetches=[y])
+    kept_types = [op.type for op in pruned.global_block().ops]
+    assert len(kept_types) < len(main.global_block().ops)
+    _ = z
+
+
+def test_missing_feed_raises():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = x + 1.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(Exception):
+        exe.run(main, feed={}, fetch_list=[y])

@@ -1,0 +1,126 @@
+"""End-to-end training tests — the "book"-style fixtures
+(reference: python/paddle/fluid/tests/book/test_recognize_digits.py trains to
+a loss threshold)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset.mnist as mnist
+
+
+def _mnist_batch(n=64, seed=0):
+    data = list(mnist.train()())[: n * 4]
+    imgs = np.stack([d[0] for d in data])
+    labels = np.array([d[1] for d in data], np.int64).reshape(-1, 1)
+    return imgs, labels
+
+
+def _build_mlp():
+    x = fluid.layers.data(name="x", shape=[784], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=64, act="relu")
+    logits = fluid.layers.fc(input=h, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, y)
+    avg = fluid.layers.mean(loss)
+    return x, y, avg
+
+
+def test_mlp_sgd_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, avg = _build_mlp()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    imgs, labels = _mnist_batch()
+    losses = []
+    for step in range(25):
+        i = (step * 64) % 192
+        (l,) = exe.run(
+            main,
+            feed={"x": imgs[i : i + 64], "y": labels[i : i + 64]},
+            fetch_list=[avg],
+        )
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
+
+
+def test_mlp_adam_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, avg = _build_mlp()
+        opt = fluid.optimizer.Adam(learning_rate=0.01)
+        opt.minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    imgs, labels = _mnist_batch()
+    losses = []
+    for step in range(25):
+        i = (step * 64) % 192
+        (l,) = exe.run(
+            main,
+            feed={"x": imgs[i : i + 64], "y": labels[i : i + 64]},
+            fetch_list=[avg],
+        )
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+
+def test_lenet_conv_training():
+    """config 1 of BASELINE.md: MNIST LeNet on the static Program path."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        import paddle_tpu.fluid.nets as nets
+
+        c1 = nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=6, pool_size=2,
+            pool_stride=2, act="relu",
+        )
+        c2 = nets.simple_img_conv_pool(
+            input=c1, filter_size=5, num_filters=16, pool_size=2,
+            pool_stride=2, act="relu",
+        )
+        fc1 = fluid.layers.fc(input=c2, size=120, act="relu")
+        logits = fluid.layers.fc(input=fc1, size=10)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg = fluid.layers.mean(loss)
+        opt = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+        opt.minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    imgs, labels = _mnist_batch()
+    imgs = imgs.reshape(-1, 1, 28, 28)
+    losses = []
+    for step in range(15):
+        i = (step * 32) % 128
+        (l,) = exe.run(
+            main,
+            feed={"img": imgs[i : i + 32], "label": labels[i : i + 32]},
+            fetch_list=[avg],
+        )
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_batch_norm_updates_running_stats():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4, 8, 8], dtype="float32")
+        out = fluid.layers.batch_norm(
+            input=img, moving_mean_name="bn_mean", moving_variance_name="bn_var"
+        )
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    before = np.asarray(fluid.global_scope().get("bn_mean")).copy()
+    data = np.random.RandomState(1).normal(3.0, 1.0, (8, 4, 8, 8)).astype(
+        np.float32
+    )
+    exe.run(main, feed={"img": data}, fetch_list=[loss])
+    after = np.asarray(fluid.global_scope().get("bn_mean"))
+    assert not np.allclose(before, after), "running mean not updated"
+    assert np.all(after > 0.1), "running mean should move toward ~3"
