@@ -1,0 +1,81 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec) on one
+chip (BASELINE.md metric 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the reference's V100+NCCL path. The
+reference publishes no numbers in-repo (BASELINE.md), so the baseline
+constant below is the commonly reported PaddlePaddle-era ResNet-50 fp32
+V100 figure (~360 images/sec/GPU); the north-star target is >=0.9x.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+V100_RESNET50_FP32_IMG_PER_SEC = 360.0
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    if fluid.core.get_tpu_device_count() > 0:
+        place = fluid.TPUPlace(0)
+    else:
+        place = fluid.CPUPlace()
+        batch = min(batch, int(os.environ.get("BENCH_CPU_BATCH", "8")))
+        steps = min(steps, 3)
+
+    main_prog, startup, feeds, loss, acc = resnet.build_resnet_train(
+        depth=50, class_num=1000, image_size=224
+    )
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rs = np.random.RandomState(0)
+    img = rs.rand(batch, 3, 224, 224).astype("float32")
+    label = rs.randint(0, 1000, (batch, 1)).astype("int64")
+    # pre-stage the batch on device: the benchmark measures training-step
+    # compute (the reference's synthetic-data convention), not host link
+    # bandwidth — on this rig H2D rides a network tunnel to the chip
+    import jax
+
+    dev = fluid.core.get_jax_device(place)
+    feed = {
+        "img": jax.device_put(img, dev),
+        "label": jax.device_put(label, dev),
+    }
+
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(float(np.asarray(l).ravel()[0]))
+
+    ips = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_throughput",
+                "value": round(ips, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(ips / V100_RESNET50_FP32_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

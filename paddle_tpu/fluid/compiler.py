@@ -125,7 +125,12 @@ class CompiledProgram(object):
         if self._mesh is None:
             from ..parallel.mesh import build_data_mesh
 
-            self._mesh = build_data_mesh(self._device_count())
+            devices = None
+            if self._places:
+                first = self._places[0]
+                if hasattr(first, "platform"):  # jax Device objects
+                    devices = list(self._places)
+            self._mesh = build_data_mesh(self._device_count(), devices=devices)
         return self._mesh
 
     def _apply_grad_allreduce(self):
@@ -158,8 +163,14 @@ class CompiledProgram(object):
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
+        import jax
+
         feed = {
-            k: (v.numpy() if isinstance(v, core.LoDTensor) else np.asarray(v))
+            k: (
+                v.numpy()
+                if isinstance(v, core.LoDTensor)
+                else (v if isinstance(v, jax.Array) else np.asarray(v))
+            )
             for k, v in feed.items()
         }
 

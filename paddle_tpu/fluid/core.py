@@ -185,8 +185,10 @@ def get_tpu_device_count():
     import jax
 
     backend = _jax_backend_for(TPUPlace(0))
+    if backend is None:
+        return 0  # no tpu/axon backend registered (cpu-only environment)
     try:
-        return len(jax.devices(backend) if backend else jax.devices())
+        return len(jax.devices(backend))
     except RuntimeError:
         return 0
 

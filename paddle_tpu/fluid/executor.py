@@ -357,6 +357,7 @@ class _CompiledBlock(object):
             needs_rng = any(o.type in _RANDOM_OPS for o in seg.ops)
 
             fn = self._build_segment_fn(seg, feeds, mutable, const, out_names)
+            raw_fn = fn
             if self.mesh is not None:
                 fn = self._shard_map_wrap(fn, feeds, mutable, const, out_names)
             donate = (1,) if device_backend not in (None, "cpu") else ()
@@ -371,6 +372,7 @@ class _CompiledBlock(object):
                         const=const,
                         outs=out_names,
                         fn=jfn,
+                        raw_fn=raw_fn,
                         needs_rng=needs_rng,
                     ),
                 )
@@ -504,7 +506,9 @@ def _to_device(val, device):
     if isinstance(val, core.LoDTensor):
         val = val.numpy()
     if isinstance(val, jax.Array):
-        return val
+        # no-op when placement already matches; reshards otherwise (a
+        # committed single-device array fed to a mesh-sharded computation)
+        return jax.device_put(val, device)
     return jax.device_put(np.asarray(val), device)
 
 
@@ -617,6 +621,8 @@ class Executor(object):
 
 
 def _feed_value(v, feed, name):
-    if isinstance(v, core.LoDTensor):
-        return v
+    import jax
+
+    if isinstance(v, (core.LoDTensor, jax.Array)):
+        return v  # jax arrays stay device-resident (no D2H round-trip)
     return np.asarray(v)

@@ -1,1 +1,7 @@
-"""Model zoo built on the fluid layers API (used by tests and bench.py)."""
+"""Model zoo built on the fluid layers API (used by tests and bench.py).
+
+Mirrors the reference's book/dist-test fixture models (SURVEY.md §4, §6
+configs): LeNet (MNIST), ResNet-50 (ImageNet), BERT-base, Transformer NMT.
+"""
+
+from . import lenet, resnet, bert, transformer  # noqa: F401

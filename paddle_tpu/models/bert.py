@@ -1,0 +1,192 @@
+"""BERT encoder — bring-up config 3 (BASELINE.json "BERT-base blocks") and
+the second headline benchmark model.
+
+The reference era's BERT implementations on Fluid (e.g. the
+`multihead_matmul_fuse_pass` fusion target, ir/multihead_matmul_fuse_pass.cc)
+build attention exactly from this op sequence: fc(Q/K/V) -> reshape ->
+transpose -> matmul(QK^T)*scale -> softmax -> dropout -> matmul(V) ->
+transpose -> reshape -> fc. On TPU the whole sequence fuses inside one XLA
+computation (the fusion pass's job is subsumed by the compiler); matmuls run
+on the MXU in bf16 when AMP is on.
+"""
+
+import math
+
+import paddle_tpu.fluid as fluid
+
+
+class BertConfig(object):
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 hidden_dropout=0.1, attention_dropout=0.1, is_test=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.is_test = is_test
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(**kw)
+
+
+def _dropout(x, rate, is_test):
+    if is_test or rate <= 0.0:
+        return x
+    return fluid.layers.dropout(x, dropout_prob=rate)
+
+
+def mask_to_bias(mask_2d):
+    """[N, S, S] 0/1 attention mask -> additive bias [N, 1, S, S]
+    (0 where attendable, -10000 where masked), broadcast over heads."""
+    neg = fluid.layers.elementwise_mul(
+        fluid.layers.elementwise_add(
+            mask_2d,
+            fluid.layers.fill_constant(shape=[1], dtype="float32", value=-1.0),
+        ),
+        fluid.layers.fill_constant(shape=[1], dtype="float32", value=10000.0),
+    )
+    bias = fluid.layers.unsqueeze(neg, axes=[1])
+    bias.stop_gradient = True
+    return bias
+
+
+def multi_head_attention(q_in, kv_in, attn_bias, cfg, name):
+    """Self/cross attention on [N, S, H] inputs."""
+    d_head = cfg.hidden_size // cfg.num_heads
+
+    def _proj(x, suffix):
+        return fluid.layers.fc(
+            input=x, size=cfg.hidden_size, num_flatten_dims=2,
+            name="%s_%s" % (name, suffix),
+        )
+
+    def _split_heads(x):
+        # [N, S, H] -> [N, heads, S, d_head]
+        x = fluid.layers.reshape(x, shape=[0, 0, cfg.num_heads, d_head])
+        return fluid.layers.transpose(x, perm=[0, 2, 1, 3])
+
+    q = _split_heads(_proj(q_in, "q"))
+    k = _split_heads(_proj(kv_in, "k"))
+    v = _split_heads(_proj(kv_in, "v"))
+    scores = fluid.layers.matmul(
+        q, k, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
+    )
+    if attn_bias is not None:
+        scores = fluid.layers.elementwise_add(scores, attn_bias)
+    weights = fluid.layers.softmax(scores, axis=-1)
+    weights = _dropout(weights, cfg.attention_dropout, cfg.is_test)
+    ctxt = fluid.layers.matmul(weights, v)  # [N, heads, S, d_head]
+    ctxt = fluid.layers.transpose(ctxt, perm=[0, 2, 1, 3])
+    ctxt = fluid.layers.reshape(ctxt, shape=[0, 0, cfg.hidden_size])
+    return fluid.layers.fc(
+        input=ctxt, size=cfg.hidden_size, num_flatten_dims=2,
+        name="%s_out" % name,
+    )
+
+
+def _ffn(x, cfg, name):
+    h = fluid.layers.fc(
+        input=x, size=cfg.intermediate_size, num_flatten_dims=2,
+        act="gelu", name="%s_fc0" % name,
+    )
+    return fluid.layers.fc(
+        input=h, size=cfg.hidden_size, num_flatten_dims=2,
+        name="%s_fc1" % name,
+    )
+
+
+def encoder_layer(x, attn_bias, cfg, name):
+    attn = multi_head_attention(x, x, attn_bias, cfg, "%s_att" % name)
+    attn = _dropout(attn, cfg.hidden_dropout, cfg.is_test)
+    x = fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, attn), begin_norm_axis=2,
+        name="%s_ln1" % name,
+    )
+    ff = _dropout(_ffn(x, cfg, "%s_ffn" % name), cfg.hidden_dropout, cfg.is_test)
+    return fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, ff), begin_norm_axis=2,
+        name="%s_ln2" % name,
+    )
+
+
+def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
+    """Returns (sequence_output [N,S,H], pooled_output [N,H]).
+
+    ``input_mask``: [N, S, 1] float32, 1.0 for real tokens.
+    """
+    emb = fluid.layers.embedding(
+        input=src_ids, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(name="word_embedding"),
+    )
+    pos = fluid.layers.embedding(
+        input=pos_ids, size=[cfg.max_position_embeddings, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(name="pos_embedding"),
+    )
+    sent = fluid.layers.embedding(
+        input=sent_ids, size=[cfg.type_vocab_size, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(name="sent_embedding"),
+    )
+    emb = fluid.layers.elementwise_add(
+        fluid.layers.elementwise_add(emb, pos), sent
+    )
+    emb = fluid.layers.layer_norm(emb, begin_norm_axis=2, name="emb_ln")
+    emb = _dropout(emb, cfg.hidden_dropout, cfg.is_test)
+
+    mask_t = fluid.layers.transpose(input_mask, perm=[0, 2, 1])
+    attn_mask = fluid.layers.matmul(input_mask, mask_t)  # [N, S, S]
+    attn_bias = mask_to_bias(attn_mask)
+
+    x = emb
+    for i in range(cfg.num_layers):
+        x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i)
+
+    first_tok = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1])
+    first_tok = fluid.layers.reshape(first_tok, shape=[-1, cfg.hidden_size])
+    pooled = fluid.layers.fc(
+        input=first_tok, size=cfg.hidden_size, act="tanh", name="pooler"
+    )
+    return x, pooled
+
+
+def build_bert_classifier(cfg, seq_len, num_classes=2, learning_rate=2e-5):
+    """Sequence-classification fine-tune graph (config 3 / SQuAD-style head).
+
+    Returns (main, startup, feeds, avg_loss, acc)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src_ids = fluid.layers.data(name="src_ids", shape=[seq_len, 1], dtype="int64")
+        pos_ids = fluid.layers.data(name="pos_ids", shape=[seq_len, 1], dtype="int64")
+        sent_ids = fluid.layers.data(name="sent_ids", shape=[seq_len, 1], dtype="int64")
+        input_mask = fluid.layers.data(
+            name="input_mask", shape=[seq_len, 1], dtype="float32"
+        )
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        _, pooled = bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg)
+        pooled = _dropout(pooled, cfg.hidden_dropout, cfg.is_test)
+        logits = fluid.layers.fc(input=pooled, size=num_classes, name="cls")
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(
+            input=fluid.layers.softmax(logits), label=label
+        )
+        opt = fluid.optimizer.Adam(learning_rate=learning_rate)
+        opt.minimize(avg_loss)
+    feeds = [src_ids, pos_ids, sent_ids, input_mask, label]
+    return main, startup, feeds, avg_loss, acc

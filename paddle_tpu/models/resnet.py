@@ -1,0 +1,100 @@
+"""ResNet family — bring-up config 2/4 (BASELINE.json) and the headline
+throughput benchmark model.
+
+Reference fixtures: python/paddle/fluid/tests/unittests/dist_se_resnext.py and
+test_parallel_executor_seresnext.py build SE-ResNeXt the same way (conv_bn
+helpers over layers.conv2d/batch_norm); this is the plain ResNet-v1.5
+variant (stride-2 in the 3x3 of the bottleneck), the standard benchmark
+configuration.
+
+TPU notes: convs stay NCHW at the program level (the Fluid contract); the
+conv2d lowering hands XLA `NCHW` dimension numbers and XLA picks the optimal
+internal layout for the MXU. BatchNorm keeps running stats as persistable
+vars mutated via donated buffers.
+"""
+
+import paddle_tpu.fluid as fluid
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _conv_bn(input, num_filters, filter_size, stride=1, groups=1, act=None,
+             is_test=False):
+    conv = fluid.layers.conv2d(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=stride,
+        padding=(filter_size - 1) // 2,
+        groups=groups,
+        act=None,
+        bias_attr=False,
+    )
+    return fluid.layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _shortcut(input, ch_out, stride, is_test):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return _conv_bn(input, ch_out, 1, stride, is_test=is_test)
+    return input
+
+
+def _basic_block(input, num_filters, stride, is_test):
+    conv0 = _conv_bn(input, num_filters, 3, stride, act="relu", is_test=is_test)
+    conv1 = _conv_bn(conv0, num_filters, 3, 1, is_test=is_test)
+    short = _shortcut(input, num_filters, stride, is_test)
+    return fluid.layers.relu(fluid.layers.elementwise_add(short, conv1))
+
+
+def _bottleneck_block(input, num_filters, stride, is_test):
+    conv0 = _conv_bn(input, num_filters, 1, act="relu", is_test=is_test)
+    conv1 = _conv_bn(conv0, num_filters, 3, stride, act="relu", is_test=is_test)
+    conv2 = _conv_bn(conv1, num_filters * 4, 1, is_test=is_test)
+    short = _shortcut(input, num_filters * 4, stride, is_test)
+    return fluid.layers.relu(fluid.layers.elementwise_add(short, conv2))
+
+
+def resnet(img, class_num=1000, depth=50, is_test=False):
+    """ResNet forward; ``img`` [N, 3, H, W] -> logits [N, class_num]."""
+    block_kind, stages = _DEPTH_CFG[depth]
+    block = _basic_block if block_kind == "basic" else _bottleneck_block
+    conv = _conv_bn(img, 64, 7, stride=2, act="relu", is_test=is_test)
+    pool = fluid.layers.pool2d(
+        conv, pool_size=3, pool_stride=2, pool_padding=1, pool_type="max"
+    )
+    num_filters = [64, 128, 256, 512]
+    for stage, count in enumerate(stages):
+        for i in range(count):
+            stride = 2 if i == 0 and stage > 0 else 1
+            pool = block(pool, num_filters[stage], stride, is_test)
+    pool = fluid.layers.pool2d(pool, pool_type="avg", global_pooling=True)
+    return fluid.layers.fc(input=pool, size=class_num)
+
+
+def build_resnet_train(depth=50, class_num=1000, image_size=224,
+                       learning_rate=0.1, momentum=0.9, is_test=False):
+    """(main, startup, feeds, avg_loss, acc) for ResNet training."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(
+            name="img", shape=[3, image_size, image_size], dtype="float32"
+        )
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        logits = resnet(img, class_num=class_num, depth=depth, is_test=is_test)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(
+            input=fluid.layers.softmax(logits), label=label
+        )
+        opt = fluid.optimizer.Momentum(
+            learning_rate=learning_rate, momentum=momentum
+        )
+        opt.minimize(avg_loss)
+    return main, startup, [img, label], avg_loss, acc

@@ -1,0 +1,187 @@
+"""Transformer NMT (encoder-decoder) — bring-up config 5 (BASELINE.json
+"Transformer NMT with beam search").
+
+Reference fixture: python/paddle/fluid/tests/unittests/dist_transformer.py
+(the same WMT transformer the dist tests train). Same op-level construction
+as models/bert.py; adds the causal decoder mask and label smoothing.
+"""
+
+import math
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from .bert import multi_head_attention, _ffn, _dropout, mask_to_bias
+
+
+class TransformerConfig(object):
+    def __init__(self, src_vocab=30000, tgt_vocab=30000, hidden_size=512,
+                 num_heads=8, num_layers=6, intermediate_size=2048,
+                 max_len=256, dropout=0.1, label_smooth=0.1, is_test=False):
+        self.src_vocab = src_vocab
+        self.tgt_vocab = tgt_vocab
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.num_layers = num_layers
+        self.intermediate_size = intermediate_size
+        self.max_len = max_len
+        self.dropout = dropout
+        self.label_smooth = label_smooth
+        self.is_test = is_test
+        # bert.multi_head_attention reads these names:
+        self.hidden_dropout = dropout
+        self.attention_dropout = dropout
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("src_vocab", 1000)
+        kw.setdefault("tgt_vocab", 1000)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_len", 32)
+        return cls(**kw)
+
+
+def _pos_encoding_table(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float64")
+    dim = np.arange(d_model)[None, :].astype("float64")
+    angle = pos / np.power(10000.0, 2 * (dim // 2) / d_model)
+    table = np.zeros((max_len, d_model), dtype="float32")
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table
+
+
+def _embed(ids, pos_ids, vocab, cfg, name):
+    emb = fluid.layers.embedding(
+        input=ids, size=[vocab, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(name="%s_word_emb" % name),
+    )
+    emb = fluid.layers.elementwise_mul(
+        emb,
+        fluid.layers.fill_constant(
+            shape=[1], dtype="float32", value=math.sqrt(cfg.hidden_size)
+        ),
+    )
+    pos = fluid.layers.embedding(
+        input=pos_ids, size=[cfg.max_len, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(
+            name="%s_pos_emb" % name,
+            initializer=fluid.initializer.NumpyArrayInitializer(
+                _pos_encoding_table(cfg.max_len, cfg.hidden_size)
+            ),
+            trainable=False,
+        ),
+    )
+    pos.stop_gradient = True
+    emb = fluid.layers.elementwise_add(emb, pos)
+    return _dropout(emb, cfg.dropout, cfg.is_test)
+
+
+def _residual_ln(x, sub, cfg, name):
+    sub = _dropout(sub, cfg.dropout, cfg.is_test)
+    return fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, sub), begin_norm_axis=2, name=name
+    )
+
+
+def _mask_to_bias(mask_2d):
+    return mask_to_bias(mask_2d)
+
+
+def transformer(cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask,
+                causal_mask):
+    """Forward; returns decoder logits [N, T, tgt_vocab].
+
+    masks: src_mask/tgt_mask [N, S, 1] float (1=real token);
+    causal_mask [1, T, T] float lower-triangular ones.
+    """
+    src_self = fluid.layers.matmul(
+        src_mask, fluid.layers.transpose(src_mask, perm=[0, 2, 1])
+    )
+    enc_bias = _mask_to_bias(src_self)
+    enc = _embed(src_ids, src_pos, cfg.src_vocab, cfg, "src")
+    for i in range(cfg.num_layers):
+        name = "enc_%d" % i
+        attn = multi_head_attention(enc, enc, enc_bias, cfg, name + "_att")
+        enc = _residual_ln(enc, attn, cfg, name + "_ln1")
+        enc = _residual_ln(enc, _ffn(enc, cfg, name + "_ffn"), cfg, name + "_ln2")
+
+    tgt_self = fluid.layers.matmul(
+        tgt_mask, fluid.layers.transpose(tgt_mask, perm=[0, 2, 1])
+    )
+    tgt_self = fluid.layers.elementwise_mul(tgt_self, causal_mask)
+    dec_self_bias = _mask_to_bias(tgt_self)
+    # cross mask: [N, T, 1] x [N, 1, S]
+    cross = fluid.layers.matmul(
+        tgt_mask, fluid.layers.transpose(src_mask, perm=[0, 2, 1])
+    )
+    cross_bias = _mask_to_bias(cross)
+
+    dec = _embed(tgt_ids, tgt_pos, cfg.tgt_vocab, cfg, "tgt")
+    for i in range(cfg.num_layers):
+        name = "dec_%d" % i
+        attn = multi_head_attention(dec, dec, dec_self_bias, cfg, name + "_satt")
+        dec = _residual_ln(dec, attn, cfg, name + "_ln1")
+        xatt = multi_head_attention(dec, enc, cross_bias, cfg, name + "_xatt")
+        dec = _residual_ln(dec, xatt, cfg, name + "_ln2")
+        dec = _residual_ln(dec, _ffn(dec, cfg, name + "_ffn"), cfg, name + "_ln3")
+
+    return fluid.layers.fc(
+        input=dec, size=cfg.tgt_vocab, num_flatten_dims=2, name="dec_proj"
+    )
+
+
+def build_transformer_train(cfg, src_len, tgt_len, learning_rate=2.0,
+                            warmup_steps=4000):
+    """(main, startup, feeds, avg_loss) — label-smoothed NMT training graph
+    with the Noam LR schedule (reference:
+    layers/learning_rate_scheduler.py noam_decay)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src_ids = fluid.layers.data(name="src_ids", shape=[src_len, 1], dtype="int64")
+        src_pos = fluid.layers.data(name="src_pos", shape=[src_len, 1], dtype="int64")
+        src_mask = fluid.layers.data(name="src_mask", shape=[src_len, 1], dtype="float32")
+        tgt_ids = fluid.layers.data(name="tgt_ids", shape=[tgt_len, 1], dtype="int64")
+        tgt_pos = fluid.layers.data(name="tgt_pos", shape=[tgt_len, 1], dtype="int64")
+        tgt_mask = fluid.layers.data(name="tgt_mask", shape=[tgt_len, 1], dtype="float32")
+        labels = fluid.layers.data(name="labels", shape=[tgt_len, 1], dtype="int64")
+        causal = _causal_const(tgt_len)
+        logits = transformer(
+            cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask, causal
+        )
+        flat = fluid.layers.reshape(logits, shape=[-1, cfg.tgt_vocab])
+        lab = fluid.layers.reshape(labels, shape=[-1, 1])
+        if cfg.label_smooth > 0:
+            one_hot = fluid.layers.one_hot(lab, depth=cfg.tgt_vocab)
+            smoothed = fluid.layers.label_smooth(
+                label=one_hot, epsilon=cfg.label_smooth
+            )
+            smoothed.stop_gradient = True
+            loss = fluid.layers.softmax_with_cross_entropy(
+                flat, smoothed, soft_label=True
+            )
+        else:
+            loss = fluid.layers.softmax_with_cross_entropy(flat, lab)
+        # mask out pad positions
+        wmask = fluid.layers.reshape(tgt_mask, shape=[-1, 1])
+        loss = fluid.layers.elementwise_mul(loss, wmask)
+        avg_loss = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(loss), fluid.layers.reduce_sum(wmask)
+        )
+        opt = fluid.optimizer.Adam(
+            learning_rate=learning_rate * cfg.hidden_size ** -0.5 / warmup_steps ** 0.5,
+            beta1=0.9, beta2=0.98, epsilon=1e-9,
+        )
+        opt.minimize(avg_loss)
+    feeds = [src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask, labels]
+    return main, startup, feeds, avg_loss
+
+
+def _causal_const(tgt_len):
+    table = np.tril(np.ones((tgt_len, tgt_len), dtype="float32"))[None]
+    v = fluid.layers.assign(table)
+    v.stop_gradient = True
+    return v

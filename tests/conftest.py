@@ -4,10 +4,17 @@ philosophy of simulating multi-node on localhost — test_dist_base.py)."""
 
 import os
 
-# must be set before jax is imported anywhere
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# must be set before jax backends initialize
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the axon sitecustomize pre-imports jax and pins jax_platforms to
+# "axon,cpu"; override it before any backend is touched so tests run on the
+# 8-device virtual CPU mesh
+import jax
+
+jax.config.update("jax_platforms", "cpu")
