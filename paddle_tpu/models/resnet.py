@@ -79,8 +79,13 @@ def resnet(img, class_num=1000, depth=50, is_test=False):
 
 
 def build_resnet_train(depth=50, class_num=1000, image_size=224,
-                       learning_rate=0.1, momentum=0.9, is_test=False):
-    """(main, startup, feeds, avg_loss, acc) for ResNet training."""
+                       learning_rate=0.1, momentum=0.9, is_test=False,
+                       use_amp=False):
+    """(main, startup, feeds, avg_loss, acc) for ResNet training.
+
+    ``use_amp``: bf16 mixed precision via the AMP program rewrite
+    (contrib/mixed_precision) — matmuls/convs run bf16 on the MXU, master
+    weights and the optimizer update stay fp32."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         img = fluid.layers.data(
@@ -96,5 +101,9 @@ def build_resnet_train(depth=50, class_num=1000, image_size=224,
         opt = fluid.optimizer.Momentum(
             learning_rate=learning_rate, momentum=momentum
         )
+        if use_amp:
+            from paddle_tpu.fluid.contrib import mixed_precision as _mp
+
+            opt = _mp.decorate(opt)
         opt.minimize(avg_loss)
     return main, startup, [img, label], avg_loss, acc

@@ -87,10 +87,6 @@ def _residual_ln(x, sub, cfg, name):
     )
 
 
-def _mask_to_bias(mask_2d):
-    return mask_to_bias(mask_2d)
-
-
 def transformer(cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask,
                 causal_mask):
     """Forward; returns decoder logits [N, T, tgt_vocab].
@@ -101,7 +97,7 @@ def transformer(cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask,
     src_self = fluid.layers.matmul(
         src_mask, fluid.layers.transpose(src_mask, perm=[0, 2, 1])
     )
-    enc_bias = _mask_to_bias(src_self)
+    enc_bias = mask_to_bias(src_self)
     enc = _embed(src_ids, src_pos, cfg.src_vocab, cfg, "src")
     for i in range(cfg.num_layers):
         name = "enc_%d" % i
@@ -113,12 +109,12 @@ def transformer(cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask,
         tgt_mask, fluid.layers.transpose(tgt_mask, perm=[0, 2, 1])
     )
     tgt_self = fluid.layers.elementwise_mul(tgt_self, causal_mask)
-    dec_self_bias = _mask_to_bias(tgt_self)
+    dec_self_bias = mask_to_bias(tgt_self)
     # cross mask: [N, T, 1] x [N, 1, S]
     cross = fluid.layers.matmul(
         tgt_mask, fluid.layers.transpose(src_mask, perm=[0, 2, 1])
     )
-    cross_bias = _mask_to_bias(cross)
+    cross_bias = mask_to_bias(cross)
 
     dec = _embed(tgt_ids, tgt_pos, cfg.tgt_vocab, cfg, "tgt")
     for i in range(cfg.num_layers):
@@ -171,9 +167,17 @@ def build_transformer_train(cfg, src_len, tgt_len, learning_rate=2.0,
         avg_loss = fluid.layers.elementwise_div(
             fluid.layers.reduce_sum(loss), fluid.layers.reduce_sum(wmask)
         )
+        from paddle_tpu.fluid.layers.learning_rate_scheduler import noam_decay
+
+        lr = noam_decay(cfg.hidden_size, warmup_steps)
+        lr = fluid.layers.elementwise_mul(
+            lr,
+            fluid.layers.fill_constant(
+                shape=[1], dtype="float32", value=float(learning_rate)
+            ),
+        )
         opt = fluid.optimizer.Adam(
-            learning_rate=learning_rate * cfg.hidden_size ** -0.5 / warmup_steps ** 0.5,
-            beta1=0.9, beta2=0.98, epsilon=1e-9,
+            learning_rate=lr, beta1=0.9, beta2=0.98, epsilon=1e-9
         )
         opt.minimize(avg_loss)
     feeds = [src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask, labels]
