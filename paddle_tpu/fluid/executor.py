@@ -109,13 +109,18 @@ def _analyze_ops(ops, defined):
     return reads, writes
 
 
-def _sub_block_external_reads(program, op_, defined_hint=None):
-    """Names a control-flow op's sub-block reads from the enclosing scope."""
+def _sub_block_external_reads(program, op_, block=None):
+    """Names a control-flow op's sub-block reads from the enclosing scope.
+    Names private to the sub-block (loop-bound step/state vars of
+    recurrent/dynamic_decode) are excluded — they resolve only inside the
+    sub-block, not from the op's own block."""
     idx = op_.attr("sub_block", None)
     if idx is None:
         return []
     sub = program.block(idx if isinstance(idx, int) else idx.idx)
     reads, _ = _analyze_ops(sub.ops, set())
+    if block is not None:
+        reads = [n for n in reads if block._find_var_recursive(n) is not None]
     return reads
 
 
@@ -144,7 +149,7 @@ def split_segments(program, block):
             if op_.has_attr("sub_block"):
                 extra.extend(
                     n
-                    for n in _sub_block_external_reads(program, op_)
+                    for n in _sub_block_external_reads(program, op_, block)
                     if n not in reads and n not in writes
                 )
         seg.reads = reads + [n for n in dict.fromkeys(extra)]

@@ -21,5 +21,6 @@ from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import controlflow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import io_ops  # noqa: F401

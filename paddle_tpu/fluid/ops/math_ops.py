@@ -159,7 +159,23 @@ def _ew_infer(op_, block):
     v = in_var(op_, block, "X")
     if v is None:
         raise SkipInferShape()
-    set_out(op_, block, "Out", v.shape, v.dtype)
+    shape = v.shape
+    y = in_var(op_, block, "Y")
+    # Paddle broadcasting: Y broadcasts over X, so X's rank dominates —
+    # except the degenerate x=[1]-style case where Y carries the shape
+    if y is not None and y.shape and len(y.shape) > len(shape):
+        shape = y.shape
+    elif (
+        y is not None
+        and y.shape
+        and len(y.shape) == len(shape)
+        and any(s in (1, -1) for s in shape)
+    ):
+        shape = tuple(
+            ys if xs == 1 and ys != 1 else xs
+            for xs, ys in zip(shape, y.shape)
+        )
+    set_out(op_, block, "Out", shape, v.dtype)
 
 
 def _register_elementwise(name, fn, grad="generic"):

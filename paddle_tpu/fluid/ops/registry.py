@@ -345,7 +345,11 @@ def _generic_grad_lower(ctx, op):
                         env[n] = v
         for (slot, idx, _), v in zip(wrt, vals):
             env[fwd_inputs[slot][idx]] = v
-        sub = LowerCtx(env=env, base_key=None, mesh_axes=ctx.mesh_axes)
+        # block threads through so ops with sub-blocks (recurrent,
+        # dynamic_decode) can resolve them during the vjp replay
+        sub = LowerCtx(
+            env=env, base_key=None, mesh_axes=ctx.mesh_axes, block=ctx.block
+        )
         fake = _FakeOp(fwd_type, fwd_inputs, fwd_outputs, attrs)
         fwd_def.lower(sub, fake)
         return tuple(

@@ -1,0 +1,311 @@
+"""Recurrent ops: ``recurrent`` (scan over a sub-block) and
+``dynamic_decode`` (bounded while-loop over a sub-block), plus
+``gather_tree`` for beam-search finalization.
+
+Reference counterparts: operators/recurrent_op.cc (step-scope loops),
+layers/rnn.py rnn()/dynamic_decode (While + LoDTensorArray at the Python
+layer), operators/gather_tree_op (beam backtracking).
+
+TPU-native redesign: the reference runs each timestep as a separate
+executor invocation over step scopes; here the whole loop is ONE XLA op —
+``lax.scan`` for fixed-length recurrence (unrolled pipelining, grads via
+vjp replay of the scan) and ``lax.while_loop`` with pre-allocated output
+buffers for data-dependent-length decoding. Sequence padding is masked with
+``where`` on the carried state, matching the reference's step-mask
+(_maybe_copy in layers/rnn.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import (
+    LowerCtx,
+    SkipInferShape,
+    in_var,
+    op,
+    register_op,
+    set_out,
+)
+
+
+def _sub_block(ctx, op_):
+    idx = op_.attr("sub_block")
+    idx = idx if isinstance(idx, int) else idx.idx
+    return ctx.block.program.block(idx)
+
+
+def _frozen_env(ctx, sub, bound_names):
+    """Outer values visible to the sub-block (parameters etc.)."""
+    from ..executor import _analyze_ops
+
+    reads, _ = _analyze_ops(sub.ops, set())
+    bound = set(bound_names)
+    out = {}
+    for n in reads:
+        if n in bound:
+            continue
+        v = ctx.get_opt(n)
+        if v is not None:
+            out[n] = v
+    return out
+
+
+def _lower_sub(ctx, sub, env):
+    from .registry import run_op
+
+    sub_ctx = LowerCtx(
+        env=env, base_key=ctx.base_key, mesh_axes=ctx.mesh_axes, block=sub
+    )
+    for o in sub.ops:
+        run_op(sub_ctx, o)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# recurrent: lax.scan over the time axis
+# ---------------------------------------------------------------------------
+def _recurrent_infer(op_, block):
+    time_major = bool(op_.attr("time_major", False))
+    x = in_var(op_, block, "Inputs")
+    if x is None or len(x.shape) < 2:
+        raise SkipInferShape()
+    n, t = (x.shape[1], x.shape[0]) if time_major else (x.shape[0], x.shape[1])
+    idx = op_.attr("sub_block")
+    sub = block.program.block(idx if isinstance(idx, int) else idx.idx)
+    for i, name in enumerate(op_.attr("step_output_names") or []):
+        v = sub._find_var_recursive(name)
+        if v is not None:
+            shape = (
+                (t, n) + tuple(v.shape[1:])
+                if time_major
+                else (n, t) + tuple(v.shape[1:])
+            )
+            set_out(op_, block, "Outputs", shape, v.dtype, idx=i)
+    init_names = op_.inputs.get("InitStates") or []
+    for i, name in enumerate(init_names):
+        v = block._find_var_recursive(name)
+        if v is not None:
+            set_out(op_, block, "FinalStates", v.shape, v.dtype, idx=i)
+
+
+@op("recurrent", infer_shape=_recurrent_infer, grad="generic")
+def _recurrent_lower(ctx, op_):
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    sub = _sub_block(ctx, op_)
+    step_in = list(op_.attr("step_input_names") or [])
+    st_in = list(op_.attr("state_input_names") or [])
+    st_out = list(op_.attr("state_output_names") or [])
+    out_names = list(op_.attr("step_output_names") or [])
+    time_major = bool(op_.attr("time_major", False))
+    rev = bool(op_.attr("is_reverse", False))
+
+    xs = ctx.ins(op_, "Inputs")
+    states = tuple(ctx.ins(op_, "InitStates"))
+    seq_len = ctx.in1(op_, "SequenceLength", optional=True)
+
+    if not time_major:
+        xs = [jnp.swapaxes(x, 0, 1) for x in xs]  # -> [T, N, ...]
+    if rev:
+        xs = [jnp.flip(x, 0) for x in xs]
+
+    frozen = _frozen_env(ctx, sub, step_in + st_in)
+    base_key = ctx.base_key
+
+    def body(carry, xt):
+        t, st = carry
+        env = dict(frozen)
+        env.update(zip(step_in, xt))
+        env.update(zip(st_in, st))
+        sub_ctx = LowerCtx(
+            env=env,
+            base_key=None if base_key is None else jax.random.fold_in(base_key, t),
+            mesh_axes=ctx.mesh_axes,
+            block=sub,
+        )
+        from .registry import run_op
+
+        for o in sub.ops:
+            run_op(sub_ctx, o)
+        new_st = tuple(env[n] for n in st_out)
+        if seq_len is not None:
+            # step mask: past a sequence's end, carry the old state forward
+            # (reference layers/rnn.py _maybe_copy). With is_reverse the
+            # inputs were flipped, so padding sits at the FRONT: a sequence
+            # of length L is alive for t in [T-L, T).
+            sl = seq_len.reshape(-1).astype(jnp.int32)
+            T_total = xs[0].shape[0]
+            alive = (t >= T_total - sl) if rev else (t < sl)
+            def _mask(new, old):
+                cond = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(cond, new, old)
+            new_st = tuple(_mask(n_, o_) for n_, o_ in zip(new_st, st))
+        outs = tuple(env[n] for n in out_names)
+        return (t + 1, new_st), outs
+
+    t0 = jnp.asarray(0, jnp.int32)
+    (_, final), ys = lax.scan(body, (t0, states), tuple(xs))
+    ys = list(ys) if isinstance(ys, tuple) else [ys]
+    if rev:
+        ys = [jnp.flip(y, 0) for y in ys]
+    if not time_major:
+        ys = [jnp.swapaxes(y, 0, 1) for y in ys]
+    ctx.outs(op_, "Outputs", ys)
+    ctx.outs(op_, "FinalStates", list(final))
+
+
+# ---------------------------------------------------------------------------
+# dynamic_decode: bounded lax.while_loop with pre-allocated output buffers
+# ---------------------------------------------------------------------------
+def _dynamic_decode_infer(op_, block):
+    idx = op_.attr("sub_block")
+    sub = block.program.block(idx if isinstance(idx, int) else idx.idx)
+    max_steps = int(op_.attr("max_step_num"))
+    for i, name in enumerate(op_.attr("step_output_names") or []):
+        v = sub._find_var_recursive(name)
+        if v is not None:
+            n = v.shape[0] if v.shape else -1
+            set_out(
+                op_, block, "Outputs",
+                (n, max_steps) + tuple(v.shape[1:]), v.dtype, idx=i,
+            )
+    for i, name in enumerate(op_.inputs.get("InitStates") or []):
+        v = block._find_var_recursive(name)
+        if v is not None:
+            set_out(op_, block, "FinalStates", v.shape, v.dtype, idx=i)
+    fin = in_var(op_, block, "InitFinished")
+    if fin is not None:
+        set_out(op_, block, "Length", fin.shape, np.int32)
+
+
+@op("dynamic_decode", infer_shape=_dynamic_decode_infer)
+def _dynamic_decode_lower(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    sub = _sub_block(ctx, op_)
+    time_name = op_.attr("time_name")
+    input_names = list(op_.attr("input_names") or [])
+    st_in = list(op_.attr("state_input_names") or [])
+    fin_name = op_.attr("finished_name")
+    out_names = list(op_.attr("step_output_names") or [])
+    next_in = list(op_.attr("next_input_names") or [])
+    st_out = list(op_.attr("state_output_names") or [])
+    next_fin = op_.attr("next_finished_name")
+    max_steps = int(op_.attr("max_step_num"))
+
+    inputs = tuple(ctx.ins(op_, "InitInputs"))
+    states = tuple(ctx.ins(op_, "InitStates"))
+    finished = ctx.in1(op_, "InitFinished").astype(bool)
+
+    frozen = _frozen_env(
+        ctx, sub, input_names + st_in + [time_name, fin_name]
+    )
+
+    # pre-allocated [max_steps, ...] output buffers (time-major while
+    # looping; transposed to batch-major at the end)
+    def _probe_shapes():
+        env = dict(frozen)
+        env.update(zip(input_names, inputs))
+        env.update(zip(st_in, states))
+        env[time_name] = jnp.asarray(0, jnp.int32)
+        env[fin_name] = finished
+        env = dict(env)
+        _lower_sub(ctx, sub, env)
+        return [env[n] for n in out_names]
+
+    import jax
+
+    probe = jax.eval_shape(lambda: _probe_shapes())
+    # tail fill: steps past early loop exit keep the buffer's initial value,
+    # so it must be a VALID step — e.g. beam search fills token buffers with
+    # end_token and parent buffers with the identity beam (arange), keeping
+    # gather_tree backtracking correct on unexecuted steps
+    tail_fill = list(op_.attr("output_tail_fill") or [])
+    tail_arange = list(op_.attr("output_tail_arange") or [])
+    bufs = []
+    for i, p in enumerate(probe):
+        shape = (max_steps,) + tuple(p.shape)
+        if i < len(tail_arange) and tail_arange[i]:
+            b = jnp.broadcast_to(
+                jnp.arange(shape[-1], dtype=p.dtype), shape
+            )
+        else:
+            fill = tail_fill[i] if i < len(tail_fill) else 0
+            b = jnp.full(shape, fill, p.dtype)
+        bufs.append(b)
+    bufs = tuple(bufs)
+    lengths = jnp.full(finished.shape, max_steps, jnp.int32)
+
+    def cond_fn(carry):
+        t, _, _, fin, _, _ = carry
+        return jnp.logical_and(t < max_steps, jnp.logical_not(jnp.all(fin)))
+
+    def body_fn(carry):
+        t, ins, st, fin, bufs, lengths = carry
+        env = dict(frozen)
+        env.update(zip(input_names, ins))
+        env.update(zip(st_in, st))
+        env[time_name] = t
+        env[fin_name] = fin
+        _lower_sub(ctx, sub, env)
+        outs = [env[n] for n in out_names]
+        new_bufs = tuple(
+            lax.dynamic_update_index_in_dim(b, o.astype(b.dtype), t, 0)
+            for b, o in zip(bufs, outs)
+        )
+        new_fin = env[next_fin].astype(bool).reshape(fin.shape)
+        # first step where finished flips on = decoded length
+        just = jnp.logical_and(jnp.logical_not(fin), new_fin)
+        lengths = jnp.where(just, t + 1, lengths)
+        new_ins = tuple(env[n] for n in next_in)
+        new_st = tuple(env[n] for n in st_out)
+        return (t + 1, new_ins, new_st, new_fin, new_bufs, lengths)
+
+    t0 = jnp.asarray(0, jnp.int32)
+    _, _, final_st, _, bufs, lengths = lax.while_loop(
+        cond_fn, body_fn, (t0, inputs, states, finished, bufs, lengths)
+    )
+    outs = [jnp.moveaxis(b, 0, 1) for b in bufs]  # -> [batch, T, ...]
+    ctx.outs(op_, "Outputs", outs)
+    ctx.outs(op_, "FinalStates", list(final_st))
+    ctx.out(op_, "Length", lengths)
+
+
+# ---------------------------------------------------------------------------
+# gather_tree: beam-search backtrack (reference: gather_tree_op)
+# ---------------------------------------------------------------------------
+def _gather_tree_infer(op_, block):
+    ids = in_var(op_, block, "Ids")
+    if ids is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", ids.shape, ids.dtype)
+
+
+@op("gather_tree", infer_shape=_gather_tree_infer)
+def _gather_tree_lower(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    ids = ctx.in1(op_, "Ids")          # [batch, T, beam]
+    parents = ctx.in1(op_, "Parents")  # [batch, T, beam]
+    ids_t = jnp.moveaxis(ids, 1, 0)
+    par_t = jnp.moveaxis(parents, 1, 0)
+    T = ids_t.shape[0]
+    batch = ids_t.shape[1]
+    beam = ids_t.shape[2]
+    binx = jnp.arange(batch)[:, None]
+
+    def body(carry, xt):
+        beam_idx = carry            # [batch, beam] which beam to follow
+        step_ids, step_parents = xt
+        tok = step_ids[binx, beam_idx]
+        parent = step_parents[binx, beam_idx]
+        return parent, tok
+
+    start = jnp.tile(jnp.arange(beam)[None, :], (batch, 1))
+    _, toks = lax.scan(body, start, (ids_t, par_t), reverse=True)
+    ctx.out(op_, "Out", jnp.moveaxis(toks, 0, 1))

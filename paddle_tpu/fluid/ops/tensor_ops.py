@@ -374,7 +374,29 @@ def _concat(ctx, op_):
     ctx.out(op_, "Out", jnp.concatenate(xs, axis=ax))
 
 
-@op("split", grad="generic")
+def _split_infer(op_, block):
+    x = in_var(op_, block, "X")
+    if x is None or not x.shape:
+        raise SkipInferShape()
+    ax = int(op_.attr("axis", 0))
+    if ax < 0:
+        ax += len(x.shape)
+    sections = op_.attr("sections", [])
+    num = int(op_.attr("num", 0))
+    names = op_.outputs.get("Out") or []
+    dim = x.shape[ax]
+    for i in range(len(names)):
+        if sections:
+            d = int(sections[i])
+        else:
+            d = dim // num if dim >= 0 else -1
+        shape = tuple(
+            d if j == ax else s for j, s in enumerate(x.shape)
+        )
+        set_out(op_, block, "Out", shape, x.dtype, idx=i)
+
+
+@op("split", infer_shape=_split_infer, grad="generic")
 def _split(ctx, op_):
     import jax.numpy as jnp
 

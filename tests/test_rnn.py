@@ -1,0 +1,248 @@
+"""Cell-based RNN + beam search tests (reference: test_rnn_cell_api.py,
+test_rnn_decode_api.py, test_gather_tree_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+L = fluid.layers
+
+
+def _run(main, startup, feed, fetch, scope=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = scope or fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    return exe, scope, exe.run(main, feed=feed, fetch_list=fetch, scope=scope)
+
+
+def test_gru_rnn_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[12, 8], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="int64")
+        cell = L.GRUCell(hidden_size=16)
+        outs, final = L.rnn(cell, x)
+        assert tuple(outs.shape) == (-1, 12, 16)
+        logits = L.fc(input=final, size=4)
+        loss = L.mean(L.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    xd = np.random.RandomState(0).rand(16, 12, 8).astype("float32")
+    yd = np.random.RandomState(1).randint(0, 4, (16, 1)).astype("int64")
+    ls = [
+        float(np.asarray(
+            exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss],
+                    scope=scope)[0]
+        ).ravel()[0])
+        for _ in range(10)
+    ]
+    assert ls[-1] < ls[0] - 0.05, ls
+
+
+def test_lstm_sequence_length_masking():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[12, 8], dtype="float32")
+        sl = L.data(name="sl", shape=[1], dtype="int32")
+        cell = L.LSTMCell(hidden_size=16)
+        outs, (h, c) = L.rnn(cell, x, sequence_length=sl)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    xd = rs.rand(4, 12, 8).astype("float32")
+    sld = np.array([12, 5, 5, 1], np.int32)
+    (h1,) = exe.run(main, feed={"x": xd, "sl": sld}, fetch_list=[h],
+                    scope=scope)
+    xg = xd.copy()
+    xg[1, 5:] = 9.9
+    xg[3, 1:] = -9.9
+    (h2,) = exe.run(main, feed={"x": xg, "sl": sld}, fetch_list=[h],
+                    scope=scope)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-6)
+
+
+def test_rnn_is_reverse():
+    """reversed rnn on x == forward rnn on flipped x, with final states
+    equal and outputs flipped."""
+    rs = np.random.RandomState(0)
+    xd = rs.rand(3, 7, 5).astype("float32")
+
+    def build(is_reverse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard():  # identical param names both builds
+            with fluid.program_guard(main, startup):
+                x = L.data(name="x", shape=[7, 5], dtype="float32")
+                cell = L.GRUCell(hidden_size=6, name="g")
+                outs, final = L.rnn(cell, x, is_reverse=is_reverse)
+        return main, startup, outs, final
+
+    main1, st1, o1, f1 = build(True)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(st1, scope=scope)
+    out_rev, fin_rev = exe.run(
+        main1, feed={"x": xd}, fetch_list=[o1, f1], scope=scope
+    )
+
+    main2, st2, o2, f2 = build(False)
+    # reuse the same parameters (same names) in the same scope
+    out_fwd, fin_fwd = exe.run(
+        main2, feed={"x": xd[:, ::-1].copy()}, fetch_list=[o2, f2],
+        scope=scope,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fin_rev), np.asarray(fin_fwd), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_rev), np.asarray(out_fwd)[:, ::-1], rtol=1e-5
+    )
+
+
+def test_beam_search_decode():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc = L.data(name="enc", shape=[16], dtype="float32")
+        cell = L.GRUCell(hidden_size=16, name="dec_gru")
+        emb = lambda ids: L.embedding(
+            ids, size=[20, 8], param_attr=fluid.ParamAttr(name="tgt_emb")
+        )
+        proj = lambda h: L.fc(h, size=20, name="proj", bias_attr=False)
+        dec = L.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=4,
+            embedding_fn=emb, output_fn=proj,
+        )
+        outputs, states = L.dynamic_decode(dec, inits=[enc], max_step_num=10)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    encd = np.random.RandomState(0).rand(3, 16).astype("float32")
+    (res,) = exe.run(main, feed={"enc": encd}, fetch_list=[outputs],
+                     scope=scope)
+    res = np.asarray(res)
+    assert res.shape == (3, 10, 4), res.shape
+    assert res.min() >= 0 and res.max() < 20
+
+
+def test_lstm_beam_search_decode():
+    """two-state (h, c) cell through the decode loop."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc_h = L.data(name="ench", shape=[8], dtype="float32")
+        enc_c = L.data(name="encc", shape=[8], dtype="float32")
+        cell = L.LSTMCell(hidden_size=8, name="dec_lstm")
+        emb = lambda ids: L.embedding(
+            ids, size=[12, 8], param_attr=fluid.ParamAttr(name="t_emb")
+        )
+        proj = lambda h: L.fc(h, size=12, name="p", bias_attr=False)
+        dec = L.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=3,
+            embedding_fn=emb, output_fn=proj,
+        )
+        outputs, states = L.dynamic_decode(
+            dec, inits=[enc_h, enc_c], max_step_num=6
+        )
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {
+        "ench": np.random.RandomState(0).rand(2, 8).astype("float32"),
+        "encc": np.random.RandomState(1).rand(2, 8).astype("float32"),
+    }
+    (res,) = exe.run(main, feed=feed, fetch_list=[outputs], scope=scope)
+    assert np.asarray(res).shape == (2, 6, 3)
+
+
+def test_gather_tree_matches_numpy():
+    """gather_tree backtracking vs a hand-rolled numpy oracle
+    (reference: test_gather_tree_op.py)."""
+    rs = np.random.RandomState(0)
+    batch, T, beam = 2, 5, 3
+    ids = rs.randint(0, 9, (batch, T, beam)).astype("int64")
+    parents = rs.randint(0, beam, (batch, T, beam)).astype("int64")
+
+    def oracle(ids, parents):
+        out = np.zeros_like(ids)
+        for b in range(batch):
+            for k in range(beam):
+                cur = k
+                for t in range(T - 1, -1, -1):
+                    out[b, t, k] = ids[b, t, cur]
+                    cur = parents[b, t, cur]
+        return out
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        vi = L.data(name="ids", shape=[T, beam], dtype="int64")
+        vp = L.data(name="parents", shape=[T, beam], dtype="int64")
+        from paddle_tpu.fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("gather_tree")
+        out = helper.create_variable_for_type_inference(vi.dtype)
+        helper.append_op(
+            type="gather_tree",
+            inputs={"Ids": [vi], "Parents": [vp]},
+            outputs={"Out": [out]},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(
+        main, feed={"ids": ids, "parents": parents}, fetch_list=[out]
+    )
+    np.testing.assert_array_equal(np.asarray(res), oracle(ids, parents))
+
+
+def test_beam_search_early_finish_tail():
+    """Steps past early loop exit must read as end_token with per-beam
+    ancestry preserved (buffer tail fill), not start-token zeros."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc = L.data(name="enc", shape=[16], dtype="float32")
+        cell = L.GRUCell(hidden_size=16, name="dg2")
+        emb = lambda ids: L.embedding(
+            ids, size=[20, 8], param_attr=fluid.ParamAttr(name="te2")
+        )
+        proj = lambda h: L.fc(h, size=20, name="pj2", bias_attr=False)
+        dec = L.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=4,
+            embedding_fn=emb, output_fn=proj,
+        )
+        outputs, _ = L.dynamic_decode(dec, inits=[enc], max_step_num=10)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    (res,) = exe.run(
+        main,
+        feed={"enc": np.random.RandomState(0).rand(3, 16).astype("float32")},
+        fetch_list=[outputs], scope=scope,
+    )
+    res = np.asarray(res)
+    for b in range(res.shape[0]):
+        for k in range(res.shape[2]):
+            seq = list(res[b, :, k])
+            if 1 in seq:
+                t = seq.index(1)
+                assert all(v == 1 for v in seq[t:]), (b, k, seq)
+
+
+def test_rnn_reverse_with_sequence_length():
+    """is_reverse + sequence_length: final state invariant to padding."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[6, 4], dtype="float32")
+        sl = L.data(name="sl", shape=[1], dtype="int32")
+        cell = L.GRUCell(hidden_size=5, name="rg2")
+        outs, final = L.rnn(cell, x, sequence_length=sl, is_reverse=True)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    xd = np.random.RandomState(0).rand(2, 6, 4).astype("float32")
+    sld = np.array([6, 3], np.int32)
+    (h1,) = exe.run(main, feed={"x": xd, "sl": sld}, fetch_list=[final],
+                    scope=scope)
+    xg = xd.copy()
+    xg[1, 3:] = 123.0
+    (h2,) = exe.run(main, feed={"x": xg, "sl": sld}, fetch_list=[final],
+                    scope=scope)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-6)
