@@ -53,6 +53,12 @@ class DatasetBase(object):
         MultiSlotDataFeed proto parsing (data_feed.proto)."""
         self._parse_fn = fn
 
+    def set_multislot(self, slot_is_float):
+        """Parse files in the MultiSlot text format (reference:
+        framework/data_feed.cc MultiSlotDataFeed — per line, per slot:
+        count then values) with the native C++ parser."""
+        self._multislot = list(slot_is_float)
+
     def set_hdfs_config(self, fs_name, fs_ugi):
         self._hdfs = (fs_name, fs_ugi)
 
@@ -66,11 +72,26 @@ class DatasetBase(object):
             for i, f in enumerate(self.filelist)
             if i % self._nranks == self._rank
         ]
+        if getattr(self, "_multislot", None) is not None:
+            yield from self._iter_multislot(files)
+            return
         parse = self._parse_fn or self._default_parse
         for path in files:
             with open(path, "r") as f:
                 for line in f:
                     yield parse(line)
+
+    def _iter_multislot(self, files):
+        from . import native
+
+        for path in files:
+            ms = native.MultiSlotFile(path, self._multislot)
+            slots = [ms.slot(i) for i in range(len(self._multislot))]
+            for line in range(ms.num_lines):
+                yield [
+                    vals[offs[line]:offs[line + 1]]
+                    for vals, offs in slots
+                ]
 
     def _iter_batches(self):
         slots = None
@@ -82,10 +103,27 @@ class DatasetBase(object):
                 slots[i].append(field)
             count += 1
             if count == self.batch_size:
-                yield [np.asarray(s) for s in slots]
+                yield [_stack_slot(s) for s in slots]
                 slots, count = None, 0
         if slots and count:
-            yield [np.asarray(s) for s in slots]
+            yield [_stack_slot(s) for s in slots]
+
+
+def _stack_slot(fields):
+    """Batch one slot: equal-length fields stack densely; variable-length
+    (sparse id) fields become a LoDTensor — concatenated values with
+    sequence lengths (reference: MultiSlotDataFeed emitting LoD slots)."""
+    lens = {np.asarray(f).shape[:1] for f in fields}
+    if len(lens) <= 1:
+        return np.asarray(fields)
+    from . import core
+
+    flat = np.concatenate([np.asarray(f).ravel() for f in fields])
+    t = core.LoDTensor(flat.reshape(-1, 1))
+    t.set_recursive_sequence_lengths(
+        [[int(np.asarray(f).size) for f in fields]]
+    )
+    return t
 
 
 class QueueDataset(DatasetBase):

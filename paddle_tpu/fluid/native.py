@@ -1,0 +1,303 @@
+"""ctypes bindings for the native C++ runtime library (csrc/).
+
+The library is compiled on first use with g++ (cached next to the source,
+keyed by source mtime). Components and their reference counterparts:
+
+- ``serialize_tensor``/``deserialize_tensor`` — the LoDTensor stream format
+  (framework/tensor_util.cc TensorToStream), byte-identical to the Python
+  implementation in ops/io_ops.py (which stays as the fallback).
+- ``BlockingQueue`` — operators/reader/lod_tensor_blocking_queue.h; blocking
+  push/pop release the GIL (ctypes), so DataLoader producer threads overlap
+  with compute.
+- ``MultiSlotFile`` — framework/data_feed.cc MultiSlotDataFeed text parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "..", "csrc")
+_SRC = os.path.join(_CSRC, "paddle_tpu_native.cpp")
+_SO = os.path.join(_CSRC, "_build", "libpaddle_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_compile_error = None
+
+
+def _compile():
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # compile to a per-pid temp file and rename: concurrent worker processes
+    # must never CDLL a half-written library
+    tmp = "%s.%d.tmp" % (_SO, os.getpid())
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)
+
+
+def _load():
+    global _lib, _compile_error
+    with _lib_lock:
+        if _lib is not None or _compile_error is not None:
+            return _lib
+        try:
+            if (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                _compile()
+            lib = ctypes.CDLL(_SO)
+        except Exception as e:  # no g++ / compile failure -> Python fallback
+            _compile_error = e
+            return None
+        c = ctypes.c_void_p
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64 = ctypes.c_uint64
+        u64p = ctypes.POINTER(u64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pt_free.argtypes = [c]
+        lib.pt_queue_create.restype = c
+        lib.pt_queue_create.argtypes = [u64]
+        lib.pt_queue_push.argtypes = [c, u8p, u64, ctypes.c_int]
+        lib.pt_queue_pop.argtypes = [
+            c, ctypes.POINTER(u8p), u64p, ctypes.c_int
+        ]
+        lib.pt_queue_close.argtypes = [c]
+        lib.pt_queue_size.restype = u64
+        lib.pt_queue_size.argtypes = [c]
+        lib.pt_queue_destroy.argtypes = [c]
+        lib.pt_tensor_serialize.argtypes = [
+            ctypes.c_int, ctypes.c_int, i64p, u8p, u64, ctypes.c_int,
+            u64p, u64p, ctypes.POINTER(u8p), u64p,
+        ]
+        lib.pt_tensor_read.restype = c
+        lib.pt_tensor_read.argtypes = [u8p, u64]
+        lib.pt_tensor_dtype.argtypes = [c]
+        lib.pt_tensor_ndim.argtypes = [c]
+        lib.pt_tensor_dims.restype = i64p
+        lib.pt_tensor_dims.argtypes = [c]
+        lib.pt_tensor_data.restype = u8p
+        lib.pt_tensor_data.argtypes = [c]
+        lib.pt_tensor_nbytes.restype = u64
+        lib.pt_tensor_nbytes.argtypes = [c]
+        lib.pt_tensor_consumed.restype = u64
+        lib.pt_tensor_consumed.argtypes = [c]
+        lib.pt_tensor_lod_levels.argtypes = [c]
+        lib.pt_tensor_lod_level_len.restype = u64
+        lib.pt_tensor_lod_level_len.argtypes = [c, ctypes.c_int]
+        lib.pt_tensor_lod_level.restype = u64p
+        lib.pt_tensor_lod_level.argtypes = [c, ctypes.c_int]
+        lib.pt_tensor_destroy.argtypes = [c]
+        lib.pt_multislot_parse.restype = c
+        lib.pt_multislot_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
+        ]
+        lib.pt_ms_num_lines.restype = u64
+        lib.pt_ms_num_lines.argtypes = [c]
+        lib.pt_ms_offsets.restype = u64p
+        lib.pt_ms_offsets.argtypes = [c, ctypes.c_int]
+        lib.pt_ms_ints.restype = i64p
+        lib.pt_ms_ints.argtypes = [c, ctypes.c_int]
+        lib.pt_ms_floats.restype = ctypes.POINTER(ctypes.c_float)
+        lib.pt_ms_floats.argtypes = [c, ctypes.c_int]
+        lib.pt_ms_total.restype = u64
+        lib.pt_ms_total.argtypes = [c, ctypes.c_int]
+        lib.pt_ms_destroy.argtypes = [c]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# tensor stream serialization
+# ---------------------------------------------------------------------------
+_NP_TO_ENUM = {
+    np.dtype(np.bool_): 0, np.dtype(np.int16): 1, np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3, np.dtype(np.float16): 4, np.dtype(np.float32): 5,
+    np.dtype(np.float64): 6, np.dtype(np.uint8): 20, np.dtype(np.int8): 21,
+}
+_ENUM_TO_NP = {v: k for k, v in _NP_TO_ENUM.items()}
+
+
+def serialize_tensor(arr, lod=None):
+    """numpy array (+ LoD offsets) -> reference tensor-stream bytes."""
+    lib = _load()
+    # note: np.ascontiguousarray would promote 0-d to 1-d; asarray keeps rank
+    arr = np.asarray(arr, order="C")
+    lod = lod or []
+    dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    flat = []
+    lens = []
+    for level in lod:
+        lens.append(len(level))
+        flat.extend(int(x) for x in level)
+    lens_arr = (ctypes.c_uint64 * max(len(lens), 1))(*(lens or [0]))
+    flat_arr = (ctypes.c_uint64 * max(len(flat), 1))(*(flat or [0]))
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    data = arr.tobytes()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    rc = lib.pt_tensor_serialize(
+        _NP_TO_ENUM[arr.dtype], arr.ndim, dims, buf, len(data),
+        len(lod), lens_arr, flat_arr, ctypes.byref(out),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        raise RuntimeError("pt_tensor_serialize failed (%d)" % rc)
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.pt_free(out)
+
+
+def deserialize_tensor(buf, pos=0):
+    """bytes -> (numpy array, lod list, bytes consumed)."""
+    lib = _load()
+    # zero-copy view at offset: c_char_p exposes the bytes object's own
+    # buffer (read-only use; `buf` outlives the call)
+    base = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value
+    ptr = ctypes.cast(
+        ctypes.c_void_p(base + pos), ctypes.POINTER(ctypes.c_uint8)
+    )
+    h = lib.pt_tensor_read(ptr, len(buf) - pos)
+    if not h:
+        raise ValueError("malformed tensor stream")
+    try:
+        dt = _ENUM_TO_NP[lib.pt_tensor_dtype(h)]
+        ndim = lib.pt_tensor_ndim(h)
+        dims = [lib.pt_tensor_dims(h)[i] for i in range(ndim)]
+        nbytes = lib.pt_tensor_nbytes(h)
+        arr = np.frombuffer(
+            ctypes.string_at(lib.pt_tensor_data(h), nbytes), dt
+        ).reshape(dims).copy()
+        lod = []
+        for i in range(lib.pt_tensor_lod_levels(h)):
+            ln = lib.pt_tensor_lod_level_len(h, i)
+            p = lib.pt_tensor_lod_level(h, i)
+            lod.append([int(p[j]) for j in range(ln)])
+        return arr, lod, int(lib.pt_tensor_consumed(h))
+    finally:
+        lib.pt_tensor_destroy(h)
+
+
+# ---------------------------------------------------------------------------
+# blocking queue
+# ---------------------------------------------------------------------------
+class QueueClosed(Exception):
+    pass
+
+
+class BlockingQueue(object):
+    """Bounded blocking byte-blob queue backed by the C++ implementation
+    (reference: LoDTensorBlockingQueue). Blocking ops release the GIL."""
+
+    def __init__(self, capacity):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable: %s"
+                               % _compile_error)
+        self._lib = lib
+        self._h = lib.pt_queue_create(int(capacity))
+
+    def push(self, data, timeout_ms=-1):
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        rc = self._lib.pt_queue_push(self._h, buf, len(data), timeout_ms)
+        if rc == 2:
+            raise QueueClosed()
+        return rc == 0
+
+    def pop(self, timeout_ms=-1):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.pt_queue_pop(
+            self._h, ctypes.byref(out), ctypes.byref(out_len), timeout_ms
+        )
+        if rc == 2:
+            raise QueueClosed()
+        if rc == 1:
+            return None
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.pt_free(out)
+
+    def close(self):
+        self._lib.pt_queue_close(self._h)
+
+    def size(self):
+        return int(self._lib.pt_queue_size(self._h))
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.pt_queue_close(self._h)
+                self._lib.pt_queue_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# MultiSlot parser
+# ---------------------------------------------------------------------------
+class MultiSlotFile(object):
+    """Parse a MultiSlot-format text file (reference data_feed.cc format:
+    per line, per slot: count then values)."""
+
+    def __init__(self, path, slot_is_float):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable: %s"
+                               % _compile_error)
+        self._lib = lib
+        flags = (ctypes.c_int * len(slot_is_float))(
+            *[1 if f else 0 for f in slot_is_float]
+        )
+        self._n_slots = len(slot_is_float)
+        self._is_float = list(slot_is_float)
+        self._h = lib.pt_multislot_parse(
+            path.encode(), self._n_slots, flags
+        )
+        if not self._h:
+            raise ValueError("failed to parse MultiSlot file %r" % path)
+
+    @property
+    def num_lines(self):
+        return int(self._lib.pt_ms_num_lines(self._h))
+
+    def slot(self, i):
+        """-> (values ndarray, offsets ndarray[num_lines+1])."""
+        n = self.num_lines
+        offs = np.ctypeslib.as_array(
+            self._lib.pt_ms_offsets(self._h, i), shape=(n + 1,)
+        ).copy()
+        total = int(self._lib.pt_ms_total(self._h, i))
+        if self._is_float[i]:
+            vals = np.ctypeslib.as_array(
+                self._lib.pt_ms_floats(self._h, i), shape=(max(total, 1),)
+            )[:total].copy()
+        else:
+            vals = np.ctypeslib.as_array(
+                self._lib.pt_ms_ints(self._h, i), shape=(max(total, 1),)
+            )[:total].copy()
+        return vals, offs
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.pt_ms_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

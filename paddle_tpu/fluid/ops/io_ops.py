@@ -102,6 +102,14 @@ def serialize_lod_tensor(value):
     else:
         arr = np.asarray(value)
         lod = []
+    from .. import native
+
+    if native.available() and np.dtype(arr.dtype) in _NP_TO_PROTO:
+        return native.serialize_tensor(arr, lod)
+    return _serialize_lod_tensor_py(arr, lod)
+
+
+def _serialize_lod_tensor_py(arr, lod):
     out = struct.pack("<I", 0)  # version
     out += struct.pack("<Q", len(lod))
     for level in lod:
@@ -117,6 +125,17 @@ def serialize_lod_tensor(value):
 
 
 def deserialize_lod_tensor(buf, pos=0):
+    from .. import native
+
+    if native.available():
+        arr, lod, consumed = native.deserialize_tensor(buf, pos)
+        t = core.LoDTensor(arr)
+        t.set_lod(lod)
+        return t, pos + consumed
+    return _deserialize_lod_tensor_py(buf, pos)
+
+
+def _deserialize_lod_tensor_py(buf, pos=0):
     (version,) = struct.unpack_from("<I", buf, pos)
     pos += 4
     assert version == 0, "unsupported tensor stream version %d" % version

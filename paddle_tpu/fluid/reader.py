@@ -12,6 +12,7 @@ current step runs — the standard XLA input-pipeline overlap."""
 from __future__ import annotations
 
 import queue
+import struct
 import threading
 
 import numpy as np
@@ -87,6 +88,11 @@ class _GeneratorLoader(object):
         return self._run()
 
     def _run(self):
+        from . import native
+
+        if native.available():
+            yield from self._run_native()
+            return
         q = queue.Queue(maxsize=self._capacity)
         sentinel = object()
 
@@ -109,7 +115,88 @@ class _GeneratorLoader(object):
             if isinstance(batch, dict):
                 yield batch
             else:
-                yield dict(zip(names, batch))
+                # no feed_list (from_dataset) -> yield the raw batch list
+                yield dict(zip(names, batch)) if names else batch
+
+    def _run_native(self):
+        """Producer thread feeds the native C++ blocking queue with
+        tensor-stream-encoded batches (reference: GeneratorLoader over
+        LoDTensorBlockingQueue, reader.py:298 + reader_py.cc); blocking
+        push/pop release the GIL so parsing overlaps with compute."""
+        import pickle
+
+        from . import native
+        from .ops import io_ops as _io
+
+        q = native.BlockingQueue(self._capacity)
+        names = self._feed_names()
+        producer_error = []
+
+        def _encode_item(arr):
+            # kind 0: tensor stream; kind 1: pickle (dtypes/objects the
+            # stream format does not cover — same universality as the
+            # Python-queue path)
+            try:
+                if isinstance(arr, core.LoDTensor):
+                    return b"\x00" + _io.serialize_lod_tensor(arr)
+                a = np.asarray(arr)
+                if np.dtype(a.dtype) in native._NP_TO_ENUM:
+                    return b"\x00" + native.serialize_tensor(a, [])
+            except Exception:
+                pass
+            return b"\x01" + pickle.dumps(arr, protocol=4)
+
+        def _encode(batch):
+            if isinstance(batch, dict):
+                batch = [batch[n] for n in names] if names else list(
+                    batch.values()
+                )
+            parts = [_encode_item(arr) for arr in batch]
+            head = struct.pack("<I", len(parts))
+            return head + b"".join(
+                struct.pack("<Q", len(p)) + p for p in parts
+            )
+
+        def _producer():
+            try:
+                for batch in self._batch_reader():
+                    if self._exited:
+                        return
+                    try:
+                        q.push(_encode(batch))
+                    except native.QueueClosed:
+                        return
+            except BaseException as e:  # surfaced to the consumer
+                producer_error.append(e)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=_producer, daemon=True)
+        t.start()
+        while True:
+            try:
+                blob = q.pop()
+            except native.QueueClosed:
+                if producer_error:
+                    raise producer_error[0]
+                return
+            if blob is None:
+                continue
+            (count,) = struct.unpack_from("<I", blob, 0)
+            pos = 4
+            vals = []
+            for _ in range(count):
+                (plen,) = struct.unpack_from("<Q", blob, pos)
+                pos += 8
+                kind = blob[pos]
+                body = blob[pos + 1 : pos + plen]
+                pos += plen
+                if kind == 0:
+                    tns, _ = _io.deserialize_lod_tensor(body)
+                    vals.append(tns if tns.lod() else tns.numpy())
+                else:
+                    vals.append(pickle.loads(body))
+            yield dict(zip(names, vals)) if names else vals
 
     # non-iterable (start/reset) mode
     def start(self):

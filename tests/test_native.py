@@ -1,0 +1,224 @@
+"""Native C++ runtime component tests (serialization parity, blocking
+queue, MultiSlot parser, DataLoader integration).
+
+Reference test counterparts: framework/tensor_util_test.cc,
+operators/reader/ queue tests, framework/data_feed_test.cc.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import native
+from paddle_tpu.fluid.ops import io_ops
+from paddle_tpu.fluid import core
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++)"
+)
+
+
+@needs_native
+def test_serialization_parity_with_python():
+    """C++ serializer must be byte-identical to the Python reference
+    implementation of the tensor stream format."""
+    cases = [
+        (np.arange(12, dtype=np.float32).reshape(3, 4), []),
+        (np.random.RandomState(0).rand(5, 2).astype(np.float64), [[0, 2, 5]]),
+        (np.array([1, 2, 3], np.int64), [[0, 1, 3], [0, 1, 2, 3]]),
+        (np.array(3.14, np.float32), []),
+        (np.zeros((0, 4), np.float32), []),
+    ]
+    for arr, lod in cases:
+        py = io_ops._serialize_lod_tensor_py(arr, lod)
+        nat = native.serialize_tensor(arr, lod)
+        assert py == nat, (arr.dtype, arr.shape)
+        a2, lod2, consumed = native.deserialize_tensor(py)
+        assert np.array_equal(np.asarray(a2).reshape(arr.shape), arr)
+        assert consumed == len(py)
+        assert lod2 == [[int(x) for x in l] for l in lod]
+
+
+@needs_native
+def test_save_load_roundtrip_through_native():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[4, 3], dtype="float32",
+                                          name="w_native_rt")
+        y = fluid.layers.mul(x, w) if hasattr(fluid.layers, "mul") else None
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with tempfile.TemporaryDirectory() as d:
+            fluid.io.save_params(exe, d, main_program=main)
+            before = np.asarray(scope.get("w_native_rt")).copy()
+            scope.set("w_native_rt", np.zeros_like(before))
+            fluid.io.load_params(exe, d, main_program=main)
+            np.testing.assert_array_equal(
+                np.asarray(scope.get("w_native_rt")), before
+            )
+
+
+@needs_native
+def test_blocking_queue_capacity_and_close():
+    q = native.BlockingQueue(2)
+    assert q.push(b"a") and q.push(b"b")
+    assert q.push(b"c", timeout_ms=50) is False  # full -> timeout
+    assert q.pop() == b"a"
+    assert q.push(b"c", timeout_ms=1000)
+    got = []
+
+    def consumer():
+        while True:
+            try:
+                b = q.pop()
+            except native.QueueClosed:
+                return
+            if b is not None:
+                got.append(b)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.1)
+    q.close()
+    t.join(2)
+    assert got == [b"b", b"c"]
+
+
+@needs_native
+def test_multislot_parser():
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("2 10 20 3 0.5 1.5 2.5\n")
+        f.write("1 99 0\n")
+        f.write("\n")  # blank lines skipped
+        f.write("3 7 8 9 1 9.0\n")
+        path = f.name
+    try:
+        ms = native.MultiSlotFile(path, [False, True])
+        assert ms.num_lines == 3
+        ids, ioffs = ms.slot(0)
+        fl, foffs = ms.slot(1)
+        assert list(ids) == [10, 20, 99, 7, 8, 9]
+        assert list(ioffs) == [0, 2, 3, 6]
+        assert np.allclose(fl, [0.5, 1.5, 2.5, 9.0])
+        assert list(foffs) == [0, 3, 3, 4]
+    finally:
+        os.unlink(path)
+
+
+@needs_native
+def test_dataloader_through_native_queue():
+    """DataLoader batches flow through the C++ blocking queue."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="nx", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="ny", shape=[1], dtype="int64")
+        loader = fluid.DataLoader.from_generator(
+            feed_list=[x, y], capacity=4, iterable=True
+        )
+    rs = np.random.RandomState(0)
+    data = [
+        (rs.rand(8, 4).astype("float32"),
+         rs.randint(0, 5, (8, 1)).astype("int64"))
+        for _ in range(5)
+    ]
+    loader.set_batch_generator(lambda: iter(data))
+    seen = list(loader)
+    assert len(seen) == 5
+    for (xb, yb), batch in zip(data, seen):
+        np.testing.assert_array_equal(batch["nx"], xb)
+        np.testing.assert_array_equal(batch["ny"], yb)
+
+
+@needs_native
+def test_dataset_multislot_batches():
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        for i in range(6):
+            f.write("1 %d 2 %f %f\n" % (i, i * 0.5, i * 0.25))
+        path = f.name
+    try:
+        from paddle_tpu.fluid.dataset import DatasetFactory
+
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_filelist([path])
+        ds.set_batch_size(3)
+        ds.set_multislot([False, True])
+        batches = list(ds._iter_batches())
+        assert len(batches) == 2
+        ids, floats = batches[0]
+        assert ids.shape == (3, 1) and floats.shape == (3, 2)
+        np.testing.assert_array_equal(ids.ravel(), [0, 1, 2])
+    finally:
+        os.unlink(path)
+
+
+@needs_native
+def test_multislot_short_line_fails():
+    """A line missing a slot must fail parsing, not silently consume the
+    next line's tokens (slot misalignment)."""
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("1 5\n")          # only slot 0 present (2 slots declared)
+        f.write("2 10 20 1 7\n")  # well-formed line
+        path = f.name
+    try:
+        with pytest.raises(ValueError):
+            native.MultiSlotFile(path, [False, False])
+    finally:
+        os.unlink(path)
+
+
+@needs_native
+def test_multislot_ragged_sparse_slot_batches_as_lod():
+    """Variable-count id slots (the MultiSlot format's main use case) batch
+    into LoDTensors, not a crash."""
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("2 10 20 1 0.5\n")
+        f.write("1 99 1 1.5\n")
+        path = f.name
+    try:
+        from paddle_tpu.fluid.dataset import DatasetFactory
+
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_filelist([path])
+        ds.set_batch_size(2)
+        ds.set_multislot([False, True])
+        (batch,) = list(ds._iter_batches())
+        ids, floats = batch
+        assert isinstance(ids, core.LoDTensor)
+        assert ids.recursive_sequence_lengths() == [[2, 1]]
+        np.testing.assert_array_equal(ids.numpy().ravel(), [10, 20, 99])
+        assert floats.shape == (2, 1)
+    finally:
+        os.unlink(path)
+
+
+@needs_native
+def test_dataloader_pickle_fallback_and_error_propagation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="px", shape=[2], dtype="float32")
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], capacity=2, iterable=True
+    )
+    # uint32 is outside the tensor-stream dtype set -> pickle fallback
+    arrs = [np.arange(4, dtype=np.uint32).reshape(2, 2) for _ in range(3)]
+    loader.set_batch_generator(lambda: iter([(a,) for a in arrs]))
+    seen = list(loader)
+    assert len(seen) == 3
+    np.testing.assert_array_equal(seen[0]["px"], arrs[0])
+
+    # producer exceptions must surface, not yield a silent empty epoch
+    def bad_gen():
+        yield (arrs[0],)
+        raise RuntimeError("boom in producer")
+
+    loader.set_batch_generator(bad_gen)
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        list(loader)
