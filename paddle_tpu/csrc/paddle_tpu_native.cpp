@@ -379,7 +379,10 @@ void* pt_multislot_parse(const char* path, int num_slots,
             }
             ms->floats[s].push_back(v);
           } else {
-            long long v = std::strtoll(content.data() + i, &endp, 10);
+            // ids are uint64 in the format (hash features exceed 2^63);
+            // stored in the int64 buffer bit-for-bit
+            unsigned long long v =
+                std::strtoull(content.data() + i, &endp, 10);
             if (endp == content.data() + i) {
               ok = false;
               break;

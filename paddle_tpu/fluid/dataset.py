@@ -53,11 +53,18 @@ class DatasetBase(object):
         MultiSlotDataFeed proto parsing (data_feed.proto)."""
         self._parse_fn = fn
 
-    def set_multislot(self, slot_is_float):
+    def set_multislot(self, slot_is_float, dense_slots=None):
         """Parse files in the MultiSlot text format (reference:
         framework/data_feed.cc MultiSlotDataFeed — per line, per slot:
-        count then values) with the native C++ parser."""
+        count then values) with the native C++ parser.
+
+        ``dense_slots``: per-slot bool; dense slots stack into one array,
+        sparse slots always batch as LoDTensors. Default: inferred from the
+        first parsed file (a slot with a uniform per-line count is dense) —
+        the decision is then FIXED for the whole epoch so a slot's batch
+        type never flips with batch content."""
         self._multislot = list(slot_is_float)
+        self._dense_slots = list(dense_slots) if dense_slots else None
 
     def set_hdfs_config(self, fs_name, fs_ugi):
         self._hdfs = (fs_name, fs_ugi)
@@ -87,6 +94,11 @@ class DatasetBase(object):
         for path in files:
             ms = native.MultiSlotFile(path, self._multislot)
             slots = [ms.slot(i) for i in range(len(self._multislot))]
+            if self._dense_slots is None:
+                self._dense_slots = [
+                    bool(len(set(np.diff(offs))) <= 1)
+                    for _, offs in slots
+                ]
             for line in range(ms.num_lines):
                 yield [
                     vals[offs[line]:offs[line + 1]]
@@ -103,18 +115,28 @@ class DatasetBase(object):
                 slots[i].append(field)
             count += 1
             if count == self.batch_size:
-                yield [_stack_slot(s) for s in slots]
+                yield self._stack_batch(slots)
                 slots, count = None, 0
         if slots and count:
-            yield [_stack_slot(s) for s in slots]
+            yield self._stack_batch(slots)
+
+    def _stack_batch(self, slots):
+        dense = getattr(self, "_dense_slots", None)
+        return [
+            _stack_slot(s, None if dense is None else dense[i])
+            for i, s in enumerate(slots)
+        ]
 
 
-def _stack_slot(fields):
-    """Batch one slot: equal-length fields stack densely; variable-length
-    (sparse id) fields become a LoDTensor — concatenated values with
-    sequence lengths (reference: MultiSlotDataFeed emitting LoD slots)."""
-    lens = {np.asarray(f).shape[:1] for f in fields}
-    if len(lens) <= 1:
+def _stack_slot(fields, dense=None):
+    """Batch one slot: dense slots stack into one array; sparse slots become
+    LoDTensors — concatenated values with sequence lengths (reference:
+    MultiSlotDataFeed emitting LoD slots). ``dense=None`` decides from this
+    batch's content (generic parse_fn path)."""
+    if dense is None:
+        lens = {np.asarray(f).shape[:1] for f in fields}
+        dense = len(lens) <= 1
+    if dense:
         return np.asarray(fields)
     from . import core
 

@@ -195,6 +195,27 @@ def save_inference_model(
     pruned = pruned._prune(
         feeds=feeded_var_names, fetches=[t.name for t in target_vars]
     )
+    # persist feed/fetch targets as in-graph feed/fetch ops so they survive
+    # serialization (reference: io.py prepend_feed_ops/append_fetch_ops —
+    # load_inference_model recovers the names from these ops)
+    blk = pruned.global_block()
+    feed_holder = blk.create_var(
+        name="feed", type=core.VarDesc.VarType.FEED_MINIBATCH,
+        persistable=True,
+    )
+    fetch_holder = blk.create_var(
+        name="fetch", type=core.VarDesc.VarType.FETCH_LIST, persistable=True,
+    )
+    for i, name in reversed(list(enumerate(feeded_var_names))):
+        blk._prepend_op(
+            type="feed", inputs={"X": [feed_holder.name]},
+            outputs={"Out": [name]}, attrs={"col": i},
+        )
+    for i, t in enumerate(target_vars):
+        blk.append_op(
+            type="fetch", inputs={"X": [t.name]},
+            outputs={"Out": [fetch_holder.name]}, attrs={"col": i},
+        )
     pruned._inference_io = {
         "feed": list(feeded_var_names),
         "fetch": [t.name for t in target_vars],
@@ -224,9 +245,22 @@ def load_inference_model(
     with open(model_path, "rb") as f:
         program = proto.program_from_bytes(f.read())
     load_persistables(executor, dirname, program, params_filename)
-    io_info = getattr(program, "_inference_io", None) or {}
-    feed_names = io_info.get("feed", [])
-    fetch_names = io_info.get("fetch", [])
+    # recover feed/fetch targets from the persisted feed/fetch ops
+    # (reference: io.py load_inference_model reads them the same way)
+    feed_cols, fetch_cols = [], []
+    for op in program.global_block().ops:
+        if op.type == "feed":
+            feed_cols.append((int(op.attr("col", 0)), op.output("Out")[0]))
+        elif op.type == "fetch":
+            fetch_cols.append((int(op.attr("col", 0)), op.input("X")[0]))
+    feed_names = [n for _, n in sorted(feed_cols)]
+    fetch_names = [n for _, n in sorted(fetch_cols)]
+    if not feed_names and not fetch_names:
+        # models saved before feed/fetch ops were persisted carried the
+        # targets as program metadata (round-tripped by proto.py)
+        io_info = getattr(program, "_inference_io", None) or {}
+        feed_names = io_info.get("feed", [])
+        fetch_names = io_info.get("fetch", [])
     fetch_vars = [
         program.global_block()._var_recursive(n) for n in fetch_names
     ]

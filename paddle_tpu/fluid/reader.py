@@ -147,14 +147,18 @@ class _GeneratorLoader(object):
             return b"\x01" + pickle.dumps(arr, protocol=4)
 
         def _encode(batch):
+            # dict batches keep their own keys (same semantics as the
+            # Python-queue path, which yields dicts unchanged)
+            keys = None
             if isinstance(batch, dict):
-                batch = [batch[n] for n in names] if names else list(
-                    batch.values()
-                )
+                keys = list(batch.keys())
+                batch = [batch[k] for k in keys]
             parts = [_encode_item(arr) for arr in batch]
             head = struct.pack("<I", len(parts))
-            return head + b"".join(
-                struct.pack("<Q", len(p)) + p for p in parts
+            kblob = pickle.dumps(keys, protocol=4)
+            return (
+                head + struct.pack("<Q", len(kblob)) + kblob
+                + b"".join(struct.pack("<Q", len(p)) + p for p in parts)
             )
 
         def _producer():
@@ -184,6 +188,10 @@ class _GeneratorLoader(object):
                 continue
             (count,) = struct.unpack_from("<I", blob, 0)
             pos = 4
+            (klen,) = struct.unpack_from("<Q", blob, pos)
+            pos += 8
+            keys = pickle.loads(blob[pos : pos + klen])
+            pos += klen
             vals = []
             for _ in range(count):
                 (plen,) = struct.unpack_from("<Q", blob, pos)
@@ -196,7 +204,12 @@ class _GeneratorLoader(object):
                     vals.append(tns if tns.lod() else tns.numpy())
                 else:
                     vals.append(pickle.loads(body))
-            yield dict(zip(names, vals)) if names else vals
+            if keys is not None:
+                yield dict(zip(keys, vals))
+            elif names:
+                yield dict(zip(names, vals))
+            else:
+                yield vals
 
     # non-iterable (start/reset) mode
     def start(self):

@@ -1,0 +1,224 @@
+"""Inference stack — AnalysisPredictor equivalent.
+
+Reference: paddle/fluid/inference/api/ (AnalysisConfig in
+paddle_analysis_config.h, AnalysisPredictor in analysis_predictor.cc:136
+PrepareProgram / :461 OptimizeInferenceProgram / :636 ZeroCopyRun,
+CreatePaddlePredictor at :478,911).
+
+TPU-native redesign: the reference's analysis pipeline (fuse passes,
+TensorRT/Anakin subgraph capture, memory planning) is subsumed by XLA — the
+pruned inference Program is lowered whole-block and AOT-compiled per input
+shape. ZeroCopy semantics map to device-resident jax arrays: inputs set on a
+ZeroCopyTensor stay on device between runs, outputs are fetched lazily.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid import executor as _executor_mod
+from ..fluid import io as _io
+
+__all__ = [
+    "AnalysisConfig",
+    "AnalysisPredictor",
+    "ZeroCopyTensor",
+    "create_paddle_predictor",
+]
+
+
+class AnalysisConfig(object):
+    """reference: paddle_analysis_config.h. GPU/MKLDNN/TensorRT knobs are
+    accepted for script compatibility; XLA owns those decisions on TPU."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        if params_file is not None:
+            # (prog_file, params_file) constructor form
+            self._model_dir = os.path.dirname(model_dir)
+            self._model_filename = os.path.basename(model_dir)
+            self._params_filename = os.path.basename(params_file)
+        else:
+            self._model_dir = model_dir
+            self._model_filename = None
+            self._params_filename = None
+        self._use_tpu = True
+        self._device_id = 0
+        self._memory_optim = True
+        self._ir_optim = True
+        self._use_feed_fetch_ops = False
+
+    def set_model(self, model_dir, params_file=None):
+        self.__init__(model_dir, params_file)
+
+    def model_dir(self):
+        return self._model_dir
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True  # accepted: device is the TPU chip
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def use_gpu(self):
+        return self._use_tpu
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_use_feed_fetch_ops(self, x=True):
+        self._use_feed_fetch_ops = x
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_tensorrt_engine(self, *args, **kwargs):
+        pass  # XLA owns subgraph compilation on TPU
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class ZeroCopyTensor(object):
+    """Device-resident input/output handle
+    (reference: paddle_api.h ZeroCopyTensor — copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self, predictor, name, is_input):
+        self._predictor = predictor
+        self._name = name
+        self._is_input = is_input
+
+    @property
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, arr):
+        import jax
+
+        assert self._is_input, "copy_from_cpu on an output tensor"
+        dev = core.get_jax_device(self._predictor._place)
+        self._predictor._inputs[self._name] = jax.device_put(
+            np.ascontiguousarray(arr), dev
+        )
+
+    def reshape(self, shape):
+        pass  # shapes come from the array set in copy_from_cpu
+
+    def copy_to_cpu(self):
+        out = self._predictor._outputs.get(self._name)
+        if out is None:
+            raise RuntimeError(
+                "no output for %r; call zero_copy_run first" % self._name
+            )
+        return np.asarray(out)
+
+
+class AnalysisPredictor(object):
+    """reference: analysis_predictor.cc AnalysisPredictor."""
+
+    def __init__(self, config):
+        self._config = config
+        self._place = (
+            core.TPUPlace(config._device_id)
+            if config._use_tpu and core.get_tpu_device_count() > 0
+            else core.CPUPlace()
+        )
+        self._scope = core.Scope()
+        from ..fluid.executor import Executor
+
+        self._exe = Executor(self._place)
+        with _scope_ctx(self._scope):
+            (
+                self._program,
+                self._feed_names,
+                self._fetch_vars,
+            ) = _io.load_inference_model(
+                config._model_dir,
+                self._exe,
+                model_filename=config._model_filename,
+                params_filename=config._params_filename,
+            )
+        self._fetch_names = [v.name for v in self._fetch_vars]
+        self._inputs = {}
+        self._outputs = {}
+        self._compiled = None  # one block; jax.jit caches per input shape
+
+    # -- ZeroCopy API --------------------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name):
+        assert name in self._feed_names, name
+        return ZeroCopyTensor(self, name, True)
+
+    def get_output_tensor(self, name):
+        assert name in self._fetch_names, name
+        return ZeroCopyTensor(self, name, False)
+
+    def zero_copy_run(self):
+        """reference: analysis_predictor.cc:636 ZeroCopyRun — no feed/fetch
+        copies; inputs were placed on device via copy_from_cpu."""
+        if self._compiled is None:
+            self._compiled = _executor_mod._CompiledBlock(
+                self._program, 0, list(self._feed_names),
+                self._fetch_names, self._place,
+            )
+        import jax
+
+        rng = jax.random.key(0)
+        outs = self._compiled.run(
+            self._scope, dict(self._inputs), rng, self._place
+        )
+        self._outputs = dict(zip(self._fetch_names, outs))
+
+    # -- classic run() API ---------------------------------------------------
+    def run(self, inputs):
+        """inputs: list of numpy arrays in feed order (PaddleTensor-free
+        simplification of paddle_api.h Run)."""
+        import jax
+
+        dev = core.get_jax_device(self._place)
+        for name, arr in zip(self._feed_names, inputs):
+            self._inputs[name] = jax.device_put(
+                np.ascontiguousarray(arr), dev
+            )
+        self.zero_copy_run()
+        return [np.asarray(self._outputs[n]) for n in self._fetch_names]
+
+    def clone(self):
+        """New predictor sharing nothing mutable (fresh scope + cache)."""
+        return AnalysisPredictor(self._config)
+
+    @property
+    def program(self):
+        return self._program
+
+
+class _scope_ctx(object):
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        self._old = core._switch_scope(self._scope)
+        return self._scope
+
+    def __exit__(self, *a):
+        core._switch_scope(self._old)
+        return False
+
+
+def create_paddle_predictor(config):
+    """reference: analysis_predictor.cc:911 CreatePaddlePredictor."""
+    return AnalysisPredictor(config)

@@ -1,0 +1,81 @@
+"""Inference stack tests: save_inference_model -> AnalysisPredictor
+(reference: inference/tests/api/analyzer_*_tester.cc pattern — save from a
+trained program, reload, compare outputs vs the training-time executor)."""
+
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import inference
+
+
+def _train_and_save(dirname):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        logits = fluid.layers.fc(h, size=4, name="cls")
+        sm = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rs = np.random.RandomState(0)
+    xd = rs.rand(16, 8).astype("float32")
+    yd = rs.randint(0, 4, (16, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(5):
+            exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss],
+                    scope=scope)
+        fluid.io.save_inference_model(
+            dirname, ["x"], [sm], exe, main_program=main
+        )
+        (expect,) = exe.run(
+            main.clone(for_test=True), feed={"x": xd, "y": yd},
+            fetch_list=[sm], scope=scope,
+        )
+    return xd, np.asarray(expect)
+
+
+def test_predictor_matches_training_executor():
+    with tempfile.TemporaryDirectory() as d:
+        xd, expect = _train_and_save(d)
+        config = inference.AnalysisConfig(d)
+        pred = inference.create_paddle_predictor(config)
+        assert pred.get_input_names() == ["x"]
+        (out,) = pred.run([xd])
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_zero_copy_api():
+    with tempfile.TemporaryDirectory() as d:
+        xd, expect = _train_and_save(d)
+        pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+        inp = pred.get_input_tensor("x")
+        inp.copy_from_cpu(xd)
+        pred.zero_copy_run()
+        out_name = pred.get_output_names()[0]
+        out = pred.get_output_tensor(out_name).copy_to_cpu()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+        # second run with a different batch size recompiles transparently
+        xd2 = xd[:3]
+        inp.copy_from_cpu(xd2)
+        pred.zero_copy_run()
+        out2 = pred.get_output_tensor(out_name).copy_to_cpu()
+        assert out2.shape[0] == 3
+        np.testing.assert_allclose(out2, expect[:3], rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_independent():
+    with tempfile.TemporaryDirectory() as d:
+        xd, expect = _train_and_save(d)
+        pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+        pred2 = pred.clone()
+        (o1,) = pred.run([xd])
+        (o2,) = pred2.run([xd])
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
