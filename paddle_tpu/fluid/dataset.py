@@ -95,9 +95,16 @@ class DatasetBase(object):
             ms = native.MultiSlotFile(path, self._multislot)
             slots = [ms.slot(i) for i in range(len(self._multislot))]
             if self._dense_slots is None:
+                # reference semantics: sparse id (int) slots are ALWAYS LoD;
+                # float slots are dense when the first file is uniform.
+                # Pass dense_slots explicitly to override (recommended under
+                # SPMD, where ranks parse different files).
                 self._dense_slots = [
-                    bool(len(set(np.diff(offs))) <= 1)
-                    for _, offs in slots
+                    bool(
+                        self._multislot[i]
+                        and len(set(np.diff(offs))) <= 1
+                    )
+                    for i, (_, offs) in enumerate(slots)
                 ]
             for line in range(ms.num_lines):
                 yield [
@@ -137,6 +144,12 @@ def _stack_slot(fields, dense=None):
         lens = {np.asarray(f).shape[:1] for f in fields}
         dense = len(lens) <= 1
     if dense:
+        lens = {np.asarray(f).shape[:1] for f in fields}
+        if len(lens) > 1:
+            raise ValueError(
+                "slot declared dense but has variable per-line counts; "
+                "pass dense_slots=[...] to set_multislot to mark it sparse"
+            )
         return np.asarray(fields)
     from . import core
 

@@ -121,6 +121,13 @@ class LayerHelper(object):
         return param
 
     def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+        if in_dygraph_mode():
+            from .dygraph.tracer import VarBase
+
+            return VarBase(
+                name=unique_name.generate(".".join([self.name, "tmp"])),
+                stop_gradient=stop_gradient,
+            )
         return self.main_program.current_block().create_var(
             name=unique_name.generate(".".join([self.name, "tmp"])),
             dtype=dtype,

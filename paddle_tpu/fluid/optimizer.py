@@ -22,6 +22,7 @@ from .framework import (
     Variable,
     default_main_program,
     default_startup_program,
+    in_dygraph_mode,
     op_role_guard,
     program_guard,
 )
@@ -60,7 +61,8 @@ __all__ = [
 
 
 class Optimizer(object):
-    def __init__(self, learning_rate, regularization=None, name=None):
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 parameter_list=None, grad_clip=None):
         self._learning_rate = learning_rate
         self.regularization = regularization
         self._name = name
@@ -68,9 +70,25 @@ class Optimizer(object):
         self._accumulators = defaultdict(dict)
         self.helper = None
         self._opti_name_list = []
+        # dygraph mode: parameters are bound at construction
+        # (reference: optimizer.py Optimizer.__init__ parameter_list)
+        self._parameter_list = parameter_list
+        self._grad_clip = grad_clip
+        self._dygraph_lr_var = None
 
     # -- learning rate --
     def _create_global_learning_rate(self):
+        if in_dygraph_mode():
+            if self._dygraph_lr_var is None:
+                import jax.numpy as jnp
+
+                from .dygraph.tracer import VarBase
+
+                self._dygraph_lr_var = VarBase(
+                    jnp.full((1,), float(self._learning_rate), jnp.float32),
+                    stop_gradient=True,
+                )
+            return
         program = default_main_program()
         lr = self._learning_rate_map.get(program)
         if lr is not None:
@@ -96,6 +114,17 @@ class Optimizer(object):
         return self._learning_rate_map.get(program)
 
     def _create_param_lr(self, param_and_grad):
+        if in_dygraph_mode():
+            mult = (getattr(param_and_grad[0], "optimize_attr", None) or {}).get(
+                "learning_rate", 1.0
+            )
+            if mult == 1.0:
+                return self._dygraph_lr_var
+            from .dygraph.tracer import VarBase
+
+            return VarBase(
+                self._dygraph_lr_var.value * float(mult), stop_gradient=True
+            )
         param = param_and_grad[0]
         param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
         base = self._global_learning_rate()
@@ -118,6 +147,24 @@ class Optimizer(object):
         if param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         var_name = unique_name.generate(param.name + "_" + name)
+        if in_dygraph_mode():
+            import jax.numpy as jnp
+
+            from . import core as _core
+            from .dygraph.tracer import VarBase
+
+            np_dtype = _core.dtype_to_np(dtype) if dtype else np.asarray(
+                param.numpy()
+            ).dtype
+            acc = VarBase(
+                jnp.full(
+                    tuple(shape if shape is not None else param.shape),
+                    float(fill_value), np_dtype,
+                ),
+                name=var_name, stop_gradient=True,
+            )
+            self._accumulators[name][param.name] = acc
+            return acc
         block = default_main_program().global_block()
         var = block.create_var(
             name=var_name,
@@ -205,6 +252,10 @@ class Optimizer(object):
         no_grad_set=None,
         grad_clip=None,
     ):
+        if in_dygraph_mode():
+            return self._dygraph_minimize(
+                loss, parameter_list or self._parameter_list
+            )
         params_grads = self.backward(
             loss,
             startup_program=startup_program,
@@ -217,6 +268,44 @@ class Optimizer(object):
             params_grads = _clip.append_clip_with(params_grads, grad_clip)
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
         return optimize_ops, params_grads
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Eager update: grads were accumulated on VarBases by
+        loss.backward(); the optimizer op runs through the tracer
+        (Block.append_op routes there), updating params in place
+        (reference: dygraph path of optimizer.py minimize)."""
+        if not parameter_list:
+            raise ValueError(
+                "dygraph optimizer needs parameter_list "
+                "(pass it to the constructor or minimize)"
+            )
+        from . import clip as _clip
+        from . import regularizer as _regularizer
+        from .dygraph.tracer import VarBase
+
+        params_grads = [
+            (p, VarBase(p._grad, stop_gradient=True))
+            for p in parameter_list
+            if getattr(p, "_grad", None) is not None
+        ]
+        params_grads = _regularizer.append_regularization_ops(
+            params_grads, self.regularization
+        )
+        if self._grad_clip is not None:
+            params_grads = _clip.append_clip_with(
+                params_grads, self._grad_clip
+            )
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        # block.append_op routes to the tracer under the dygraph guard, so
+        # each optimizer's _append_optimize_op runs eagerly unchanged
+        block = default_main_program().global_block()
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        ops = []
+        for pg in params_grads:
+            ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return ops, params_grads
 
 
 class SGDOptimizer(Optimizer):

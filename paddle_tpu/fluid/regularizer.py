@@ -69,8 +69,14 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
             params_and_grads.append((param, grad))
             continue
         regularization_term = None
-        block = grad.block
-        if param.regularizer is not None:
+        # dygraph VarBase grads have no block; the global block's append_op
+        # routes through the tracer there, so one code path serves both modes
+        block = getattr(grad, "block", None)
+        if block is None:
+            from .framework import default_main_program
+
+            block = default_main_program().global_block()
+        if getattr(param, "regularizer", None) is not None:
             regularization_term = param.regularizer(param, grad, block)
         elif regularization is not None:
             regularization_term = regularization(param, grad, block)

@@ -51,7 +51,15 @@ class AnalysisConfig(object):
         self._use_feed_fetch_ops = False
 
     def set_model(self, model_dir, params_file=None):
-        self.__init__(model_dir, params_file)
+        # only the paths change; device/optim flags set earlier survive
+        if params_file is not None:
+            self._model_dir = os.path.dirname(model_dir)
+            self._model_filename = os.path.basename(model_dir)
+            self._params_filename = os.path.basename(params_file)
+        else:
+            self._model_dir = model_dir
+            self._model_filename = None
+            self._params_filename = None
 
     def model_dir(self):
         return self._model_dir
@@ -136,7 +144,9 @@ class AnalysisPredictor(object):
         from ..fluid.executor import Executor
 
         self._exe = Executor(self._place)
-        with _scope_ctx(self._scope):
+        from ..fluid.executor import scope_guard
+
+        with scope_guard(self._scope):
             (
                 self._program,
                 self._feed_names,
@@ -189,6 +199,11 @@ class AnalysisPredictor(object):
         simplification of paddle_api.h Run)."""
         import jax
 
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                "expected %d inputs (%s), got %d"
+                % (len(self._feed_names), self._feed_names, len(inputs))
+            )
         dev = core.get_jax_device(self._place)
         for name, arr in zip(self._feed_names, inputs):
             self._inputs[name] = jax.device_put(
@@ -204,19 +219,6 @@ class AnalysisPredictor(object):
     @property
     def program(self):
         return self._program
-
-
-class _scope_ctx(object):
-    def __init__(self, scope):
-        self._scope = scope
-
-    def __enter__(self):
-        self._old = core._switch_scope(self._scope)
-        return self._scope
-
-    def __exit__(self, *a):
-        core._switch_scope(self._old)
-        return False
 
 
 def create_paddle_predictor(config):

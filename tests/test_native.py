@@ -153,8 +153,12 @@ def test_dataset_multislot_batches():
         batches = list(ds._iter_batches())
         assert len(batches) == 2
         ids, floats = batches[0]
-        assert ids.shape == (3, 1) and floats.shape == (3, 2)
-        np.testing.assert_array_equal(ids.ravel(), [0, 1, 2])
+        # int id slots are always LoD (reference MultiSlotDataFeed
+        # semantics); floats with uniform counts stack densely
+        assert isinstance(ids, core.LoDTensor)
+        assert ids.recursive_sequence_lengths() == [[1, 1, 1]]
+        np.testing.assert_array_equal(ids.numpy().ravel(), [0, 1, 2])
+        assert floats.shape == (3, 2)
     finally:
         os.unlink(path)
 

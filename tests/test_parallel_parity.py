@@ -1,0 +1,178 @@
+"""SURVEY §4 test families: ParallelExecutor-style parity (single vs
+multi-device loss allclose, reference: test_parallel_executor_mnist.py),
+collective ops vs numpy oracle (reference: test_collective_base.py), and
+dygraph/static parity (reference: test_imperative_mnist.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import lenet
+
+L = fluid.layers
+
+
+def _param_names(prog):
+    return [
+        v.name for v in prog.list_vars()
+        if isinstance(v, fluid.framework.Parameter)
+        or getattr(v, "persistable", False)
+    ]
+
+
+def test_dp_loss_matches_single_device():
+    """Data-parallel training over the 8-device mesh must track the
+    single-device loss trajectory (grads are averaged, so DP over the full
+    batch == single device on the full batch)."""
+    main, startup, feeds, loss, acc = lenet.build_lenet_train(
+        learning_rate=0.1
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    rs = np.random.RandomState(7)
+    img = rs.rand(16, 1, 28, 28).astype("float32")
+    lab = rs.randint(0, 10, (16, 1)).astype("int64")
+
+    sc1 = fluid.core.Scope()
+    exe.run(startup, scope=sc1)
+    sc2 = fluid.core.Scope()
+    for n in _param_names(main):
+        v = sc1.get(n)
+        if v is not None:
+            sc2.set(n, np.asarray(v).copy())
+
+    single_losses = [
+        float(np.asarray(
+            exe.run(main, feed={"img": img, "label": lab},
+                    fetch_list=[loss], scope=sc1)[0]
+        ).ravel()[0])
+        for _ in range(4)
+    ]
+
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name
+    )
+    dp_losses = []
+    for _ in range(4):
+        (l,) = exe.run(
+            compiled, feed={"img": img, "label": lab}, fetch_list=[loss],
+            scope=sc2,
+        )
+        dp_losses.append(float(np.asarray(l).mean()))
+
+    np.testing.assert_allclose(single_losses, dp_losses, rtol=2e-4,
+                               atol=2e-4)
+
+
+def _run_collective(build_fn, x, nranks=8):
+    """Run a collective-using program through the DP mesh; x is sharded on
+    dim 0 across nranks."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = L.data(name="x", shape=list(x.shape[1:]), dtype="float32")
+        out = build_fn(xv)
+        out.persistable = False
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    (res,) = exe.run(compiled, feed={"x": x}, fetch_list=[out], scope=scope)
+    return np.asarray(res)
+
+
+def test_c_allreduce_sum_matches_numpy():
+    from paddle_tpu.fluid.layers import collective
+
+    x = np.random.RandomState(0).rand(16, 4).astype("float32")
+    res = _run_collective(lambda v: collective._allreduce(v, reduce_type="sum"), x)
+    # every shard's output is the elementwise sum over the 8 shards;
+    # fetch concatenates shard outputs on dim 0
+    expect_one = x.reshape(8, 2, 4).sum(axis=0)
+    expect = np.tile(expect_one, (8, 1))
+    np.testing.assert_allclose(res, expect, rtol=1e-5)
+
+
+def test_c_allreduce_max_matches_numpy():
+    from paddle_tpu.fluid.layers import collective
+
+    x = np.random.RandomState(1).rand(16, 4).astype("float32")
+    res = _run_collective(lambda v: collective._allreduce(v, reduce_type="max"), x)
+    expect = np.tile(x.reshape(8, 2, 4).max(axis=0), (8, 1))
+    np.testing.assert_allclose(res, expect, rtol=1e-6)
+
+
+def test_c_allgather_matches_numpy():
+    from paddle_tpu.fluid.layers import collective
+
+    x = np.random.RandomState(2).rand(8, 3).astype("float32")
+    res = _run_collective(
+        lambda v: collective._c_allgather(v, nranks=8), x
+    )
+    # each shard holds [1,3]; allgather -> [8,3] on every shard; concat -> [64,3]
+    expect = np.tile(x, (8, 1))
+    np.testing.assert_allclose(res, expect, rtol=1e-6)
+
+
+def test_c_reducescatter_matches_numpy():
+    from paddle_tpu.fluid.layers import collective
+
+    x = np.random.RandomState(3).rand(64, 4).astype("float32")
+    res = _run_collective(
+        lambda v: collective._c_reducescatter(v, nranks=8), x
+    )
+    # per shard input [8,4]; elementwise sum across shards is [8,4]; shard i
+    # keeps row i -> per-shard [1,4]; fetch concat == the summed block
+    expect = x.reshape(8, 8, 4).sum(axis=0)
+    np.testing.assert_allclose(res, expect, rtol=1e-5)
+
+
+def test_dygraph_static_parity():
+    """Same weights -> same forward output in static and dygraph mode."""
+    rs = np.random.RandomState(0)
+    xd = rs.rand(4, 8).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = L.data(name="x", shape=[8], dtype="float32")
+            h = L.fc(x, size=16, act="relu", name="p1")
+            out = L.fc(h, size=3, name="p2")
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    (static_out,) = exe.run(
+        main, feed={"x": xd}, fetch_list=[out], scope=scope
+    )
+
+    w1 = np.asarray(scope.get("p1.w_0"))
+    b1 = np.asarray(scope.get("p1.b_0"))
+    w2 = np.asarray(scope.get("p2.w_0"))
+    b2 = np.asarray(scope.get("p2.b_0"))
+
+    with fluid.dygraph.guard(fluid.CPUPlace()):
+        lin1 = fluid.dygraph.Linear(8, 16, act="relu")
+        lin2 = fluid.dygraph.Linear(16, 3)
+        lin1.weight.set_value(w1)
+        lin1.bias.set_value(b1)
+        lin2.weight.set_value(w2)
+        lin2.bias.set_value(b2)
+        dy_out = lin2(lin1(fluid.dygraph.to_variable(xd)))
+        np.testing.assert_allclose(
+            np.asarray(static_out), dy_out.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_accuracy_metric_matches_numpy():
+    logits = np.array(
+        [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]], "float32"
+    )
+    labels = np.array([[1], [0], [0], [0]], "int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lv = L.data(name="logits", shape=[2], dtype="float32")
+        yv = L.data(name="y", shape=[1], dtype="int64")
+        acc = L.accuracy(input=L.softmax(lv), label=yv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (a,) = exe.run(
+        main, feed={"logits": logits, "y": labels}, fetch_list=[acc]
+    )
+    # predictions argmax -> [1,0,1,0] vs labels [1,0,0,0]: 3/4 correct
+    assert abs(float(np.asarray(a).ravel()[0]) - 0.75) < 1e-6
