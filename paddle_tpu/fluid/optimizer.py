@@ -57,6 +57,7 @@ __all__ = [
     "ModelAverage",
     "RecomputeOptimizer",
     "DGCMomentumOptimizer",
+    "PipelineOptimizer",
 ]
 
 
@@ -199,7 +200,9 @@ class Optimizer(object):
     # -- main passes (reference: _create_optimization_pass at optimizer.py:385) --
     def _create_optimization_pass(self, parameters_and_grads):
         program = default_main_program()
-        block = program.global_block()
+        # current (not global) block: PipelineOptimizer wraps the update in
+        # a conditional sub-block (apply every k-th step)
+        block = program.current_block()
         self.helper = LayerHelper(self.__class__.__name__)
         with op_role_guard(OpRole.Optimize):
             self._create_global_learning_rate()
@@ -824,81 +827,6 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         self._local_grad_clip_norm = local_grad_clip_norm
 
 
-class ExponentialMovingAverage(object):
-    """reference: optimizer.py ExponentialMovingAverage — shadow vars updated
-    in-graph each step; apply()/restore() swap them in for eval."""
-
-    def __init__(self, decay=0.999, thres_steps=None, name=None):
-        self._decay = decay
-        self._name = name or ""
-        self._shadows = {}  # param name -> shadow var
-
-    def update(self):
-        program = default_main_program()
-        block = program.global_block()
-        helper = LayerHelper("ema")
-        with op_role_guard(OpRole.Optimize):
-            for param in block.all_parameters():
-                if not param.trainable:
-                    continue
-                shadow = block.create_var(
-                    name=unique_name.generate(param.name + ".ema"),
-                    shape=param.shape,
-                    dtype=param.dtype,
-                    persistable=True,
-                )
-                helper.set_variable_initializer(shadow, Constant(0.0))
-                self._shadows[param.name] = shadow
-                # shadow = decay * shadow + (1-decay) * param
-                block.append_op(
-                    type="scale",
-                    inputs={"X": [shadow]},
-                    outputs={"Out": [shadow]},
-                    attrs={"scale": self._decay},
-                )
-                tmp = block.create_var(
-                    name=unique_name.generate(param.name + ".ema_tmp"),
-                    shape=param.shape,
-                    dtype=param.dtype,
-                )
-                block.append_op(
-                    type="scale",
-                    inputs={"X": [param]},
-                    outputs={"Out": [tmp]},
-                    attrs={"scale": 1.0 - self._decay},
-                )
-                block.append_op(
-                    type="elementwise_add",
-                    inputs={"X": [shadow], "Y": [tmp]},
-                    outputs={"Out": [shadow]},
-                )
-
-    def apply(self, executor, need_restore=True):
-        import contextlib
-
-        scope = core.global_scope()
-
-        @contextlib.contextmanager
-        def _apply():
-            backup = {}
-            for pname, shadow in self._shadows.items():
-                backup[pname] = scope.get(pname)
-                sval = scope.get(shadow.name)
-                if sval is not None:
-                    scope.set(pname, sval)
-            try:
-                yield
-            finally:
-                if need_restore:
-                    for pname, val in backup.items():
-                        scope.set(pname, val)
-
-        return _apply()
-
-    def restore(self, executor):
-        pass
-
-
 class ModelAverage(Optimizer):
     """reference: optimizer.py ModelAverage — running average of params over
     a window; swap in for eval via apply()."""
@@ -1009,6 +937,215 @@ class RecomputeOptimizer(Optimizer):
         )
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
         return optimize_ops, params_grads
+
+
+class ExponentialMovingAverage(object):
+    """reference: optimizer.py:2786 ExponentialMovingAverage — shadow
+    (EMA) copies of trainable params updated in-graph; ``apply`` swaps the
+    bias-corrected EMA values into the scope for evaluation and ``restore``
+    swaps the training weights back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._name = name or ""
+        self._shadows = {}  # param name -> shadow var
+        self._step = None
+        self._backup = {}
+        _ = thres_steps  # accepted for API parity
+
+    def update(self):
+        """Append EMA-update ops to the current main program (call after
+        optimizer.minimize)."""
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper(self._name or "ema")
+        with op_role_guard(OpRole.Optimize):
+            self._step = block.create_var(
+                name=unique_name.generate("ema_step"), shape=[1],
+                dtype="int64", persistable=True,
+            )
+            self._step.stop_gradient = True
+            helper.set_variable_initializer(self._step, Constant(0.0))
+            block.append_op(
+                type="increment", inputs={"X": [self._step]},
+                outputs={"Out": [self._step]}, attrs={"step": 1.0},
+            )
+            for param in block.all_parameters():
+                if not param.trainable:
+                    continue
+                shadow = block.create_var(
+                    name=unique_name.generate(param.name + ".ema"),
+                    shape=param.shape, dtype=param.dtype, persistable=True,
+                )
+                shadow.stop_gradient = True
+                helper.set_variable_initializer(shadow, Constant(0.0))
+                # shadow = decay*shadow + (1-decay)*param, via axpy ops
+                tmp = helper.create_variable_for_type_inference(param.dtype)
+                block.append_op(
+                    type="scale", inputs={"X": [shadow]},
+                    outputs={"Out": [tmp]}, attrs={"scale": self._decay},
+                )
+                tmp2 = helper.create_variable_for_type_inference(param.dtype)
+                block.append_op(
+                    type="scale", inputs={"X": [param]},
+                    outputs={"Out": [tmp2]},
+                    attrs={"scale": 1.0 - self._decay},
+                )
+                block.append_op(
+                    type="elementwise_add", inputs={"X": [tmp], "Y": [tmp2]},
+                    outputs={"Out": [shadow]},
+                )
+                self._shadows[param.name] = shadow
+
+    def apply(self, executor, need_restore=True):
+        """Context manager: evaluation runs with bias-corrected EMA
+        weights."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from . import core as _core
+
+            scope = _core.global_scope()
+            step = float(np.asarray(scope.get(self._step.name)).ravel()[0])
+            if step < 1.0:
+                # no EMA update has run yet; shadows are zero — swapping
+                # would silently zero every parameter
+                yield
+                return
+            corr = 1.0 - self._decay ** step
+            self._backup = {}
+            for pname, shadow in self._shadows.items():
+                self._backup[pname] = np.asarray(scope.get(pname)).copy()
+                ema_val = np.asarray(scope.get(shadow.name)) / corr
+                scope.set(pname, ema_val.astype(self._backup[pname].dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _ctx()
+
+    def restore(self, executor):
+        from . import core as _core
+
+        scope = _core.global_scope()
+        for pname, val in self._backup.items():
+            scope.set(pname, val)
+        self._backup = {}
+
+
+class PipelineOptimizer(object):
+    """reference: optimizer.py:3020 PipelineOptimizer — the reference cuts
+    the program into sections run by SectionWorker threads passing scopes
+    through queues (trainer.h:114, section_worker.cc:141).
+
+    TPU-native realisation: microbatch gradient merge. Grads accumulate
+    into persistable buffers every step; every ``num_microbatches``-th step
+    a conditional block applies the (averaged) update and zeroes the
+    buffers — XLA's pipelined scheduling over the mesh replaces thread/queue
+    stage overlap (the cut_list/place_list/queue knobs are accepted and
+    recorded for API parity)."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=None):
+        self._optimizer = optimizer
+        self._num_microbatches = int(
+            num_microbatches if num_microbatches is not None else sync_steps
+        ) or 1
+        self._cut_list = cut_list
+        self._place_list = place_list
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import control_flow as _cf
+        from .layers import tensor as _tensor
+
+        k = self._num_microbatches
+        # anchor on the loss's program, not the ambient default — minimize
+        # may be called outside any program_guard
+        program = loss.block.program
+        startup = startup_program or default_startup_program()
+        with program_guard(program, startup):
+            params_grads = self._optimizer.backward(
+                loss, startup_program=startup_program,
+                parameter_list=parameter_list, no_grad_set=no_grad_set,
+            )
+            if k <= 1:
+                return (
+                    self._optimizer.apply_optimize(
+                        loss, startup_program, params_grads
+                    ),
+                    params_grads,
+                )
+        block = program.global_block()
+        helper = LayerHelper("pipeline")
+        with program_guard(program, startup), op_role_guard(OpRole.Optimize):
+            step = block.create_var(
+                name=unique_name.generate("pipe_step"), shape=[1],
+                dtype="int64", persistable=True,
+            )
+            step.stop_gradient = True
+            helper.set_variable_initializer(step, Constant(0.0))
+            block.append_op(
+                type="increment", inputs={"X": [step]},
+                outputs={"Out": [step]}, attrs={"step": 1.0},
+            )
+            accums = []
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                acc = block.create_var(
+                    name=unique_name.generate(p.name + ".grad_merge"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                )
+                acc.stop_gradient = True
+                helper.set_variable_initializer(acc, Constant(0.0))
+                block.append_op(
+                    type="elementwise_add", inputs={"X": [acc], "Y": [g]},
+                    outputs={"Out": [acc]},
+                )
+                accums.append((p, acc))
+
+            kvar = _tensor.fill_constant(
+                shape=[1], dtype="int64", value=float(k)
+            )
+            rem = block.create_var(
+                name=unique_name.generate("pipe_rem"), shape=[1],
+                dtype="int64",
+            )
+            block.append_op(
+                type="elementwise_mod", inputs={"X": [step], "Y": [kvar]},
+                outputs={"Out": [rem]},
+            )
+            zero = _tensor.fill_constant(
+                shape=[1], dtype="int64", value=0.0
+            )
+            boundary = _cf.equal(rem, zero)
+
+            with _cf.Switch() as switch:
+                with switch.case(boundary):
+                    merged = []
+                    for p, acc in accums:
+                        avg = helper.create_variable_for_type_inference(
+                            p.dtype
+                        )
+                        block2 = program.current_block()
+                        block2.append_op(
+                            type="scale", inputs={"X": [acc]},
+                            outputs={"Out": [avg]},
+                            attrs={"scale": 1.0 / k},
+                        )
+                        merged.append((p, avg))
+                    self._optimizer.apply_gradients(merged)
+                    for p, acc in accums:
+                        program.current_block().append_op(
+                            type="scale", inputs={"X": [acc]},
+                            outputs={"Out": [acc]}, attrs={"scale": 0.0},
+                        )
+        return [], params_grads
 
 
 # lookahead_update op

@@ -1,0 +1,170 @@
+"""SPMD transformer training step over a (data, model, sp) mesh.
+
+The scaling-book recipe realized for this framework: one transformer block
+whose weights are tensor-parallel over the ``model`` axis (column-parallel
+QKV/FFN-in, row-parallel out/FFN-out with ``psum``), whose sequence is
+context-parallel over the ``sp`` axis (ring attention, see
+ring_attention.py), and whose batch is data-parallel over ``data``
+(gradients ``psum``-ed). Everything runs under one ``shard_map`` so XLA
+schedules the collectives (ICI) together with compute.
+
+The reference scales only via DP + pserver (SURVEY.md §2 parallelism
+inventory — TP/SP absent); this module is the TPU-native long-context /
+multi-chip machinery. Used by ``__graft_entry__.dryrun_multichip`` and as
+the substrate for distributed perf work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def init_params(rng, vocab, embed, heads, head_dim, ffn, dtype="float32"):
+    """Replicated-logical parameter pytree; sharding specs from
+    param_specs()."""
+    rs = np.random.RandomState(rng)
+
+    def norm(*shape):
+        return (rs.randn(*shape) * 0.02).astype(dtype)
+
+    return {
+        "emb": norm(vocab, embed),
+        "wq": norm(embed, heads * head_dim),
+        "wk": norm(embed, heads * head_dim),
+        "wv": norm(embed, heads * head_dim),
+        "wo": norm(heads * head_dim, embed),
+        "w1": norm(embed, ffn),
+        "w2": norm(ffn, embed),
+        "ln1_g": np.ones((embed,), dtype),
+        "ln1_b": np.zeros((embed,), dtype),
+        "ln2_g": np.ones((embed,), dtype),
+        "ln2_b": np.zeros((embed,), dtype),
+        "head": norm(embed, vocab),
+    }
+
+
+def param_specs():
+    """PartitionSpec per param: the head/ffn dimension shards over
+    'model'; everything else is replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    col = P(None, "model")   # column parallel: output dim sharded
+    row = P("model", None)   # row parallel: input dim sharded
+    rep = P()
+    return {
+        "emb": rep, "wq": col, "wk": col, "wv": col, "wo": row,
+        "w1": col, "w2": row, "ln1_g": rep, "ln1_b": rep,
+        "ln2_g": rep, "ln2_b": rep, "head": rep,
+    }
+
+
+def _ln(x, g, b):
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+
+def _block_fwd(params, ids, labels, heads_local, head_dim, causal=True):
+    """Per-shard forward; runs INSIDE shard_map.
+
+    ids/labels: [B_local, S_local] int32. Params arrive as their LOCAL
+    shards (column-parallel weights have the trailing dim divided by the
+    model-axis size)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from .ring_attention import ring_attention
+
+    x = params["emb"][ids]  # [B, S, E]
+    h = _ln(x, params["ln1_g"], params["ln1_b"])
+    B, S, _ = h.shape
+
+    def split_heads(t):
+        return jnp.moveaxis(
+            t.reshape(B, S, heads_local, head_dim), 2, 1
+        )  # [B, Hl, S, D]
+
+    q = split_heads(h @ params["wq"])
+    k = split_heads(h @ params["wk"])
+    v = split_heads(h @ params["wv"])
+    # context parallelism: sequence is sharded over "sp"
+    attn = ring_attention(q, k, v, axis_name="sp", causal=causal)
+    attn = jnp.moveaxis(attn, 1, 2).reshape(B, S, heads_local * head_dim)
+    # row-parallel out-projection: partial products summed over "model"
+    proj = lax.psum(attn @ params["wo"], "model")
+    x = x + proj
+
+    h2 = _ln(x, params["ln2_g"], params["ln2_b"])
+    ff = jnp.maximum(h2 @ params["w1"], 0.0)       # column parallel
+    ff = lax.psum(ff @ params["w2"], "model")      # row parallel
+    x = x + ff
+
+    logits = x @ params["head"]  # [B, S, V]
+    logp = logits - jnp.log(
+        jnp.sum(jnp.exp(logits - logits.max(-1, keepdims=True)), -1,
+                keepdims=True)
+    ) - logits.max(-1, keepdims=True)
+    nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+    # per-shard SUM of token losses; the global mean is taken OUTSIDE the
+    # shard_map so autodiff of the reduction is ordinary jax (shard_map's
+    # transpose handles the cotangent scatter)
+    return jnp.sum(nll).reshape(1)
+
+
+def build_train_step(mesh, vocab=64, embed=32, heads=4, head_dim=8, ffn=64,
+                     lr=0.1, causal=True):
+    """-> (jitted_step, sharded_params): ``step(params, ids, labels) ->
+    (loss, new_params)`` with dp/tp/sp shardings baked in."""
+    import jax
+    import jax.lax as lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import shard_map as _shard_map
+
+    model_size = mesh.shape["model"]
+    assert heads % model_size == 0, (heads, model_size)
+    heads_local = heads // model_size
+    specs = param_specs()
+    data_spec = P("data", "sp")  # ids/labels: batch × sequence sharded
+    param_spec_tree = {k: specs[k] for k in specs}
+
+    # forward under shard_map returns the vector of per-shard loss SUMS
+    # (duplicated across the model axis); mean + autodiff happen outside —
+    # differentiating THROUGH shard_map is the supported AD path and
+    # produces correctly-reduced grads with the params' shardings
+    fwd = _shard_map(
+        functools.partial(
+            _block_fwd, heads_local=heads_local, head_dim=head_dim,
+            causal=causal,
+        ),
+        mesh,
+        (param_spec_tree, data_spec, data_spec),
+        P(("data", "model", "sp")),
+    )
+
+    def loss_fn(params, ids, labels):
+        import jax.numpy as jnp
+
+        shard_sums = fwd(params, ids, labels)  # [data*model*sp]
+        tokens = ids.size
+        return jnp.sum(shard_sums) / (model_size * tokens)
+
+    def step(params, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads
+        )
+        return loss, new_params
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+
+    params_np = init_params(0, vocab, embed, heads, head_dim, ffn)
+    params = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params_np.items()
+    }
+    return jstep, params

@@ -1,0 +1,79 @@
+"""Ring attention + SPMD (dp x tp x sp) transformer tests.
+
+The reference has DP only (SURVEY.md §2 parallelism inventory); these cover
+the TPU-native long-context/multi-chip machinery: context parallelism via
+ring attention (ppermute ring, online softmax) and tensor parallelism via
+sharded matmuls with psum, validated against single-device math."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.mesh import build_mesh
+from paddle_tpu.parallel import ring_attention as ra
+from paddle_tpu.parallel import spmd_transformer as st
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = build_mesh({"sp": 8}, devices=jax.devices("cpu")[:8])
+    rs = np.random.RandomState(0)
+    B, H, S, D = 2, 4, 64, 16
+    q = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    fn = ra.ring_attention_sharded(mesh, "sp")
+    out = fn(q, k, v, causal=causal)
+    ref = ra.full_attention(q, k, v, causal=causal)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_ring_attention_grads_match():
+    """Gradients flow through the ppermute ring correctly."""
+    mesh = build_mesh({"sp": 4}, devices=jax.devices("cpu")[:4])
+    rs = np.random.RandomState(1)
+    B, H, S, D = 1, 2, 32, 8
+    q = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rs.rand(B, H, S, D).astype("float32"))
+    fn = ra.ring_attention_sharded(mesh, "sp")
+
+    g_ring = jax.grad(lambda a: jnp.sum(fn(a, k, v, causal=True) ** 2))(q)
+    g_full = jax.grad(
+        lambda a: jnp.sum(ra.full_attention(a, k, v, causal=True) ** 2)
+    )(q)
+    assert float(jnp.max(jnp.abs(g_ring - g_full))) < 2e-5
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 2, 2), (2, 1, 4), (1, 2, 4), (8, 1, 1), (1, 1, 8)]
+)
+def test_spmd_transformer_parity(shape):
+    """dp x tp x sp training step produces the same params as single
+    device — the loss-parity methodology of test_dist_base.py:891 applied
+    to every mesh factorization."""
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 64, (8, 16)).astype("int32")
+    labels = rs.randint(0, 64, (8, 16)).astype("int32")
+
+    def run(d, m, sp):
+        mesh = build_mesh(
+            {"data": d, "model": m, "sp": sp},
+            devices=jax.devices("cpu")[: d * m * sp],
+        )
+        step, params = st.build_train_step(mesh, lr=0.5)
+        for _ in range(3):
+            loss, params = step(params, ids, labels)
+        return float(np.asarray(loss)), {
+            k: np.asarray(v) for k, v in params.items()
+        }
+
+    base_loss, base = run(1, 1, 1)
+    loss, got = run(*shape)
+    assert abs(loss - base_loss) < 1e-5, (loss, base_loss)
+    for k in base:
+        np.testing.assert_allclose(
+            got[k], base[k], rtol=1e-3, atol=1e-6, err_msg=k
+        )
