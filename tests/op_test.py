@@ -1,0 +1,254 @@
+"""Per-op verification harness — the TPU port of the reference's single most
+important test asset (python/paddle/fluid/tests/unittests/op_test.py:46
+get_numeric_gradient, :721 check_output, :896 check_grad).
+
+A test subclasses ``OpTest`` and defines in ``setUp``::
+
+    self.op_type = "elementwise_add"
+    self.inputs = {"X": x_np, "Y": y_np}           # or [(name, arr), ...]
+    self.attrs = {"axis": -1}
+    self.outputs = {"Out": x_np + y_np}            # numpy oracle
+
+``check_output()`` runs the single op in a scratch Program/Scope through the
+real executor (whole-block XLA lowering) and compares every output against
+the numpy oracle.
+
+``check_grad(["X"], "Out")`` builds the analytic gradient through the real
+machinery — the op's grad maker via ``append_backward`` on a scalar
+objective ``sum_i mean(output_i)`` — and compares it against a central
+finite-difference numeric gradient of the op's own forward, exactly the
+reference's oracle construction.
+
+LoD inputs are written ``(array, recursive_sequence_lengths)`` tuples, as in
+the reference harness.
+"""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def _split_lod(val):
+    """-> (ndarray, recursive_sequence_lengths or None)."""
+    if isinstance(val, tuple):
+        arr, lod = val
+        return np.asarray(arr), lod
+    return np.asarray(val), None
+
+
+def _as_feed(arr, lod):
+    if lod is None:
+        return arr
+    t = core.LoDTensor(arr)
+    t.set_recursive_sequence_lengths([list(map(int, l)) for l in _norm_lod(lod)])
+    return t
+
+
+def _norm_lod(lod):
+    # accept both a flat level and a list of levels
+    if lod and not isinstance(lod[0], (list, tuple, np.ndarray)):
+        return [list(lod)]
+    return [list(l) for l in lod]
+
+
+class OpTest(unittest.TestCase):
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    # -- program construction ------------------------------------------------
+    def _iter_slot(self, val):
+        """Yield (var_name, array, lod) entries for one slot value."""
+        if isinstance(val, list):
+            for name, v in val:
+                arr, lod = _split_lod(v)
+                yield name, arr, lod
+        else:
+            arr, lod = _split_lod(val)
+            yield None, arr, lod
+
+    def _build(self, extra_fetch_loss=False):
+        main, startup = fluid.Program(), fluid.Program()
+        block = main.global_block()
+        feed = {}
+        inputs_spec = {}
+        for slot, val in self.inputs.items():
+            names = []
+            for name, arr, lod in self._iter_slot(val):
+                name = name or slot.lower()
+                block.create_var(
+                    name=name, shape=arr.shape, dtype=str(arr.dtype),
+                    lod_level=1 if lod else 0, is_data=True,
+                )
+                feed[name] = _as_feed(arr, lod)
+                names.append(name)
+            inputs_spec[slot] = names
+        outputs_spec = {}
+        out_names = {}
+        for slot, val in self.outputs.items():
+            names = []
+            for name, arr, lod in self._iter_slot(val):
+                name = name or "out@" + slot.lower()
+                block.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype))
+                names.append(name)
+            outputs_spec[slot] = names
+            out_names[slot] = names
+        block.append_op(
+            type=self.op_type,
+            inputs=inputs_spec,
+            outputs=outputs_spec,
+            attrs=dict(self.attrs or {}),
+        )
+        return main, startup, feed, out_names
+
+    def _expected(self):
+        """[(slot, var_name, expected_array_or_None)] in fetch order."""
+        entries = []
+        for slot, val in self.outputs.items():
+            for name, arr, lod in self._iter_slot(val):
+                entries.append((slot, name or "out@" + slot.lower(), arr))
+        return entries
+
+    # -- check_output --------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None,
+                     equal_nan=False):
+        no_check = set(no_check_set or [])
+        main, startup, feed, _ = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        exe.run(startup, scope=scope)
+        entries = [e for e in self._expected() if e[0] not in no_check]
+        fetch = [name for _, name, _ in entries]
+        results = exe.run(main, feed=feed, fetch_list=fetch, scope=scope)
+        for (slot, name, expect), got in zip(entries, results):
+            self.assertIsNotNone(got, "output %s (%s) not produced" % (name, slot))
+            np.testing.assert_allclose(
+                np.asarray(got).astype(np.float64),
+                np.asarray(expect).astype(np.float64),
+                rtol=rtol, atol=atol, equal_nan=equal_nan,
+                err_msg="op %s output %r (slot %s) mismatch"
+                % (self.op_type, name, slot),
+            )
+
+    # -- check_grad ----------------------------------------------------------
+    def _objective_program(self, output_names):
+        """Program: op -> mean each checked output -> sum -> scalar loss."""
+        main, startup, feed, out_names = self._build()
+        block = main.global_block()
+        means = []
+        for slot in output_names:
+            for name in out_names[slot]:
+                m = block.create_var(
+                    name="m@" + name, shape=(1,), dtype="float32"
+                )
+                block.append_op(
+                    type="mean", inputs={"X": [name]}, outputs={"Out": [m.name]}
+                )
+                means.append(m)
+        if len(means) == 1:
+            loss = means[0]
+        else:
+            loss = block.create_var(name="loss@sum", shape=(1,), dtype="float32")
+            block.append_op(
+                type="sum",
+                inputs={"X": [m.name for m in means]},
+                outputs={"Out": [loss.name]},
+            )
+        return main, startup, feed, loss
+
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_names,
+        max_relative_error=0.005,
+        numeric_grad_delta=0.005,
+        user_defined_grads=None,
+        no_grad_set=None,
+    ):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        # expand slots to concrete var names (list-form slots hold many vars)
+        var_names = []
+        for slot in inputs_to_check:
+            val = self.inputs.get(slot)
+            if isinstance(val, list):
+                var_names.extend(n for n, _ in val)
+            else:
+                var_names.append(slot.lower())
+        main, startup, feed, loss = self._objective_program(output_names)
+        grad_names = [n + "@GRAD" for n in var_names]
+        # analytic path: real grad makers via append_backward
+        fluid.backward.append_backward(
+            loss, no_grad_set=set(no_grad_set or [])
+        )
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        exe.run(startup, scope=scope)
+        analytic = exe.run(
+            main, feed=feed, fetch_list=grad_names, scope=scope
+        )
+
+        if user_defined_grads is not None:
+            numeric = [np.asarray(g) for g in user_defined_grads]
+        else:
+            numeric = [
+                self._numeric_grad(name, feed, output_names, numeric_grad_delta)
+                for name in var_names
+            ]
+
+        for slot, a, n in zip(var_names, analytic, numeric):
+            self.assertIsNotNone(a, "no analytic grad for %s" % slot)
+            a = np.asarray(a, np.float64).reshape(np.asarray(n).shape)
+            n = np.asarray(n, np.float64)
+            # reference error criterion (op_test.py:606 __assert_is_close):
+            # |a - n| / max(|a|, 1) bounded elementwise
+            norm = np.abs(a).copy()
+            norm[norm < 1e-3] = 1.0
+            diff = np.abs(a - n) / norm
+            max_diff = float(diff.max()) if diff.size else 0.0
+            self.assertLessEqual(
+                max_diff,
+                max_relative_error,
+                "op %s grad of %r: max relative error %g > %g\nanalytic=%r\nnumeric=%r"
+                % (self.op_type, slot, max_diff, max_relative_error, a, n),
+            )
+
+    def _numeric_grad(self, var_name, feed, output_names, delta):
+        """Central finite difference of the op's own forward, run through the
+        executor (the op is its own oracle, as in the reference)."""
+        main, startup, _, loss = self._objective_program(output_names)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        exe.run(startup, scope=scope)
+
+        base = feed[var_name]
+        lod = None
+        if isinstance(base, core.LoDTensor):
+            lod = base.recursive_sequence_lengths()
+            base = base.numpy()
+        x = np.array(base, dtype=np.float64)
+
+        def objective(arr):
+            f = dict(feed)
+            cast = arr.astype(base.dtype)
+            f[var_name] = cast if lod is None else _as_feed(cast, lod)
+            (val,) = exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+            return float(np.asarray(val).ravel()[0])
+
+        grad = np.zeros_like(x)
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            orig = x[idx]
+            x[idx] = orig + delta
+            up = objective(x)
+            x[idx] = orig - delta
+            down = objective(x)
+            x[idx] = orig
+            grad[idx] = (up - down) / (2.0 * delta)
+            it.iternext()
+        return grad

@@ -1,0 +1,358 @@
+"""Per-op tests: math/activation/elementwise ops through the OpTest harness
+(reference model: test_elementwise_add_op.py, test_softmax_op.py,
+test_mul_op.py, test_softmax_with_cross_entropy_op.py, ...)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RS = np.random.RandomState
+
+
+class TestElementwiseAdd(OpTest):
+    def setUp(self):
+        rs = RS(1)
+        x = rs.rand(3, 4).astype("float32")
+        y = rs.rand(3, 4).astype("float32")
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    def setUp(self):
+        rs = RS(2)
+        x = rs.rand(2, 3, 4).astype("float32")
+        y = rs.rand(3).astype("float32")
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseSub(OpTest):
+    def setUp(self):
+        rs = RS(3)
+        x = rs.rand(3, 4).astype("float32")
+        y = rs.rand(3, 4).astype("float32")
+        self.op_type = "elementwise_sub"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseMul(OpTest):
+    def setUp(self):
+        rs = RS(4)
+        x = rs.rand(3, 4).astype("float32")
+        y = rs.rand(3, 4).astype("float32")
+        self.op_type = "elementwise_mul"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    def setUp(self):
+        rs = RS(5)
+        x = rs.rand(3, 4).astype("float32") + 0.5
+        y = rs.rand(3, 4).astype("float32") + 0.5
+        self.op_type = "elementwise_div"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestMul(OpTest):
+    def setUp(self):
+        rs = RS(6)
+        x = rs.rand(4, 5).astype("float32")
+        y = rs.rand(5, 3).astype("float32")
+        self.op_type = "mul"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulTransY(OpTest):
+    def setUp(self):
+        rs = RS(7)
+        x = rs.rand(4, 5).astype("float32")
+        y = rs.rand(3, 5).astype("float32")
+        self.op_type = "matmul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_Y": True}
+        self.outputs = {"Out": x @ y.T}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestSoftmax(OpTest):
+    def setUp(self):
+        rs = RS(8)
+        x = rs.rand(3, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.op_type = "softmax"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestMean(OpTest):
+    def setUp(self):
+        rs = RS(9)
+        x = rs.rand(3, 4).astype("float32")
+        self.op_type = "mean"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([x.mean()], "float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSum(OpTest):
+    def setUp(self):
+        rs = RS(10)
+        xs = [("x%d" % i, rs.rand(3, 4).astype("float32")) for i in range(3)]
+        self.op_type = "sum"
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": sum(a for _, a in xs)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestRelu(OpTest):
+    def setUp(self):
+        rs = RS(11)
+        x = rs.rand(3, 4).astype("float32") * 2 - 1
+        # keep away from the kink for finite differences
+        x[np.abs(x) < 0.05] = 0.5
+        self.op_type = "relu"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSigmoid(OpTest):
+    def setUp(self):
+        rs = RS(12)
+        x = rs.rand(3, 4).astype("float32") * 2 - 1
+        self.op_type = "sigmoid"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1.0 / (1.0 + np.exp(-x))}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestTanh(OpTest):
+    def setUp(self):
+        rs = RS(13)
+        x = rs.rand(3, 4).astype("float32") * 2 - 1
+        self.op_type = "tanh"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tanh(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestCrossEntropy(OpTest):
+    def setUp(self):
+        rs = RS(14)
+        x = rs.rand(4, 6).astype("float32") + 0.1
+        x /= x.sum(-1, keepdims=True)
+        label = rs.randint(0, 6, (4, 1)).astype("int64")
+        out = -np.log(x[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.op_type = "cross_entropy"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    """The custom grad maker flagged unverified by the round-1 verdict."""
+
+    def setUp(self):
+        rs = RS(15)
+        logits = rs.rand(5, 7).astype("float32") * 2
+        label = rs.randint(0, 7, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label.ravel()]).reshape(5, 1)
+        self.op_type = "softmax_with_cross_entropy"
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {
+            "Loss": loss.astype("float32"),
+            "Softmax": sm.astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss")
+
+
+class TestSoftmaxWithCrossEntropySoftLabel(OpTest):
+    def setUp(self):
+        rs = RS(16)
+        logits = rs.rand(4, 6).astype("float32") * 2
+        label = rs.rand(4, 6).astype("float32")
+        label /= label.sum(-1, keepdims=True)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -(label * np.log(sm)).sum(-1, keepdims=True)
+        self.op_type = "softmax_with_cross_entropy"
+        self.inputs = {"Logits": logits, "Label": label}
+        self.attrs = {"soft_label": True}
+        self.outputs = {
+            "Loss": loss.astype("float32"),
+            "Softmax": sm.astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss")
+
+
+class TestLayerNorm(OpTest):
+    def setUp(self):
+        rs = RS(17)
+        x = rs.rand(3, 8).astype("float32")
+        scale = rs.rand(8).astype("float32")
+        bias = rs.rand(8).astype("float32")
+        eps = 1e-5
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + eps) * scale + bias
+        self.op_type = "layer_norm"
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {
+            "Y": y.astype("float32"),
+            "Mean": mean.ravel().astype("float32"),
+            "Variance": var.ravel().astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(
+            ["X", "Scale", "Bias"], "Y", max_relative_error=0.02
+        )
+
+
+class TestSquareErrorCost(OpTest):
+    def setUp(self):
+        rs = RS(18)
+        x = rs.rand(4, 3).astype("float32")
+        y = rs.rand(4, 3).astype("float32")
+        self.op_type = "square_error_cost"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x - y) ** 2}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestLogSoftmax(OpTest):
+    def setUp(self):
+        rs = RS(19)
+        x = rs.rand(3, 6).astype("float32")
+        shifted = x - x.max(-1, keepdims=True)
+        out = shifted - np.log(np.exp(shifted).sum(-1, keepdims=True))
+        self.op_type = "log_softmax"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    def setUp(self):
+        rs = RS(20)
+        x = rs.rand(4, 5).astype("float32") * 2 - 1
+        label = rs.rand(4, 5).astype("float32")
+        out = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.op_type = "sigmoid_cross_entropy_with_logits"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
