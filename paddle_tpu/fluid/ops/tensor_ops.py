@@ -166,6 +166,28 @@ def _share_data(ctx, op_):
     ctx.out(op_, "Out", ctx.in1(op_, "X"))
 
 
+@op("recompute_barrier", infer_shape=same_shape_infer("X"))
+def _recompute_barrier(ctx, op_):
+    """Value-identity breaker for activation recompute: the recomputed
+    forward chain reads barriered copies of the checkpoint vars so XLA
+    cannot CSE it against the original forward (the TPU realisation of
+    remat; reference: backward.py:576 recompute-segment replay).
+
+    The optional ``Dep`` operand is the cotangent flowing into the segment;
+    routing it through the barrier makes the replay data-dependent on the
+    downstream backward, so the scheduler cannot hoist all replays together
+    (which would re-materialise every activation at once)."""
+    import jax
+
+    x = ctx.in1(op_, "X")
+    dep = ctx.in1(op_, "Dep", optional=True)
+    if dep is not None:
+        x, _ = jax.lax.optimization_barrier((x, dep))
+    else:
+        x = jax.lax.optimization_barrier(x)
+    ctx.out(op_, "Out", x)
+
+
 def _scale_infer(op_, block):
     v = in_var(op_, block, "X")
     if v is None:

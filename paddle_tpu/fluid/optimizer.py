@@ -903,9 +903,11 @@ class LookaheadOptimizer(object):
 
 class RecomputeOptimizer(Optimizer):
     """reference: optimizer.py:3313 RecomputeOptimizer — activation
-    checkpointing. TPU-native realisation: segments between checkpoints are
-    wrapped in jax.checkpoint by the executor when the program advertises
-    checkpoint vars (program._recompute_checkpoints)."""
+    checkpointing. The backward pass replays each inter-checkpoint forward
+    segment from barriered checkpoint values (append_backward(checkpoints=),
+    reference _append_backward_ops_with_checkpoints_ backward.py:576), so
+    peak live memory holds checkpoints + one segment instead of every
+    activation — XLA remat via desc-level op replay."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
@@ -916,12 +918,14 @@ class RecomputeOptimizer(Optimizer):
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
-        loss.block.program._recompute_checkpoints = [
+        from .backward import append_backward
+
+        ckpts = [
             c.name if isinstance(c, Variable) else c
             for c in (self._checkpoints or [])
         ]
-        return self._optimizer.backward(
-            loss, startup_program, parameter_list, no_grad_set, callbacks
+        return append_backward(
+            loss, parameter_list, no_grad_set, callbacks, checkpoints=ckpts
         )
 
     def apply_gradients(self, params_grads):

@@ -1,0 +1,181 @@
+"""RecomputeOptimizer: activation checkpointing via backward-region replay
+(reference: optimizer.py:3313, backward.py:576). Verifies (1) numerically
+identical training vs the plain optimizer, (2) the replayed forward is
+actually present and CSE-proof (optimization_barrier in the lowered jaxpr),
+(3) peak temp memory drops."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import executor as _executor_mod
+
+LAYERS = 8
+HIDDEN = 64
+BATCH = 16
+
+# big enough that activation buffers dominate XLA temp memory
+MEM_LAYERS = 12
+MEM_HIDDEN = 256
+MEM_BATCH = 256
+
+
+def _build(use_recompute, layers=LAYERS, hidden=HIDDEN):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[hidden], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = x
+        ckpts = []
+        for i in range(layers):
+            h = fluid.layers.fc(
+                h, size=hidden, act="tanh",
+                param_attr=fluid.ParamAttr(name="w%d" % i),
+                bias_attr=fluid.ParamAttr(name="b%d" % i),
+            )
+            if i % 3 == 2:
+                ckpts.append(h)
+        pred = fluid.layers.fc(
+            h, size=1,
+            param_attr=fluid.ParamAttr(name="w_out"),
+            bias_attr=fluid.ParamAttr(name="b_out"),
+        )
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        sgd = fluid.optimizer.SGD(learning_rate=0.05)
+        if use_recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(sgd)
+            opt._set_checkpoints(ckpts)
+        else:
+            opt = sgd
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, steps=5):
+    main.random_seed = 7
+    startup.random_seed = 7
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        xb = rs.rand(BATCH, HIDDEN).astype("float32")
+        yb = rs.rand(BATCH, 1).astype("float32")
+        (l,) = exe.run(
+            main, feed={"x": xb, "y": yb}, fetch_list=[loss], scope=scope
+        )
+        losses.append(float(np.asarray(l).ravel()[0]))
+    return losses
+
+
+def test_recompute_matches_plain_training():
+    base = _train(*_build(False))
+    remat = _train(*_build(True))
+    np.testing.assert_allclose(remat, base, rtol=1e-5, atol=1e-6)
+
+
+def _compiled_plan(main, loss):
+    compiled = _executor_mod._CompiledBlock(
+        main, 0, ["x", "y"], [loss.name], fluid.CPUPlace()
+    )
+    plans = [p[2] for p in compiled._plans if p[0] == "xla"]
+    assert len(plans) == 1, "expected one fused XLA segment"
+    return plans[0]
+
+
+def _jaxpr_of(main, loss):
+    import jax
+
+    plan = _compiled_plan(main, loss)
+    rs = np.random.RandomState(0)
+    feed_vals = (
+        rs.rand(BATCH, HIDDEN).astype("float32"),
+        rs.rand(BATCH, 1).astype("float32"),
+    )
+    mutable = tuple(
+        np.zeros([d if d > 0 else 1 for d in
+                  main.global_block()._find_var_recursive(n).shape],
+                 "float32")
+        for n in plan["mutable"]
+    )
+    const = {
+        n: np.zeros([d if d > 0 else 1 for d in
+                     main.global_block()._find_var_recursive(n).shape],
+                    "float32")
+        for n in plan["const"]
+    }
+    rng = jax.random.key(0)
+    return jax.make_jaxpr(plan["raw_fn"])(feed_vals, mutable, const, rng)
+
+
+def test_recompute_jaxpr_contains_barrier_and_replay():
+    main, _, loss = _build(True)
+    jaxpr = str(_jaxpr_of(main, loss))
+    assert "opt_barrier" in jaxpr or "optimization_barrier" in jaxpr, (
+        "no optimization_barrier in lowered jaxpr"
+    )
+    base_main, _, base_loss = _build(False)
+    base_jaxpr = str(_jaxpr_of(base_main, base_loss))
+    # the replayed forward adds extra matmuls beyond the plain fwd+bwd
+    assert jaxpr.count("dot_general") > base_jaxpr.count("dot_general")
+
+
+def test_recompute_program_has_replay_ops():
+    main, _, loss = _build(True)
+    types = [op.type for op in main.global_block().ops]
+    assert "recompute_barrier" in types
+    replayed = [
+        n
+        for op in main.global_block().ops
+        for n in op.output_arg_names
+        if "@RECOMPUTE@" in n
+    ]
+    assert replayed, "no replayed activation vars in backward region"
+
+
+def test_recompute_memory_is_checkpoint_bound():
+    """Peak temp memory of a checkpointed program must scale with the
+    NUMBER OF CHECKPOINTS, not with depth: doubling the layer count (which
+    adds 4 checkpoints here) may add at most ~4 activation buffers + slack.
+    A keep-all-activations backward would add 12 activation buffers.
+
+    (Note: an unchecked program is not a usable baseline for a "memory
+    drops" comparison on the CPU backend — the desc-level backward lowers
+    per-op through jax.vjp forward replays, which XLA CPU already schedules
+    rematerialization-style, so its temp footprint is depth-flat. The
+    explicit checkpoint path instead gives *guaranteed* bounded memory
+    independent of the scheduler's CSE decisions.)"""
+    import jax
+
+    def peak(layers):
+        main, _, loss = _build(True, layers=layers, hidden=MEM_HIDDEN)
+        plan = _compiled_plan(main, loss)
+        rs = np.random.RandomState(0)
+        feed_vals = (
+            rs.rand(MEM_BATCH, MEM_HIDDEN).astype("float32"),
+            rs.rand(MEM_BATCH, 1).astype("float32"),
+        )
+        mutable = tuple(
+            np.zeros([d if d > 0 else 1 for d in
+                      main.global_block()._find_var_recursive(n).shape],
+                     "float32")
+            for n in plan["mutable"]
+        )
+        const = {
+            n: np.zeros([d if d > 0 else 1 for d in
+                         main.global_block()._find_var_recursive(n).shape],
+                        "float32")
+            for n in plan["const"]
+        }
+        rng = jax.random.key(0)
+        lowered = jax.jit(plan["raw_fn"]).lower(feed_vals, mutable, const, rng)
+        analysis = lowered.compile().memory_analysis()
+        if analysis is None:
+            pytest.skip("memory_analysis unavailable on this backend")
+        return analysis.temp_size_in_bytes
+
+    act_bytes = MEM_BATCH * MEM_HIDDEN * 4
+    growth = peak(2 * MEM_LAYERS) - peak(MEM_LAYERS)
+    new_ckpts = MEM_LAYERS // 3  # one checkpoint every 3 layers
+    assert growth <= (new_ckpts + 2) * act_bytes, (growth, act_bytes)
