@@ -1,0 +1,191 @@
+"""Async / GEO-SGD communicators for parameter-server training.
+
+Reference counterparts:
+- ``AsyncCommunicator`` — paddle/fluid/operators/distributed/communicator.cc
+  :285 and python/paddle/fluid/communicator.py: background send threads that
+  pop queued grads per var, merge up to ``max_merge_var_num`` of them (mean),
+  and push to the owning pserver; independent recv threads pull params.
+- ``GeoSgdCommunicator`` — communicator.h:332: trainers run local SGD and
+  periodically push parameter *deltas* (vs a snapshot) to the pserver, which
+  applies them additively; trainers then pull the merged params.
+
+TPU note: like the rest of the pserver path this is host-side (DCN) traffic;
+device arrays are pulled to host once per push.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from . import native
+from .ops import distributed_ops as _dist_ops
+
+_global_communicator = [None]
+
+
+def global_communicator():
+    return _global_communicator[0]
+
+
+class Communicator(object):
+    """Async grad push with merging (reference AsyncCommunicator).
+
+    ``grad_endpoints``: {grad_name: endpoint} ownership map (from the
+    transpiler's param_grad_ep_mapping). While running, async-mode ``send``
+    ops enqueue here instead of pushing synchronously.
+    """
+
+    def __init__(self, program=None, grad_endpoints=None, trainer_id=0,
+                 max_merge_var_num=20, send_wait_ms=10, send_queue_size=200):
+        self.grad_endpoints = dict(grad_endpoints or {})
+        if program is not None and not self.grad_endpoints:
+            # derive from the trainer program's send ops
+            for op_ in program.global_block().ops:
+                if op_.type == "send":
+                    eps = op_.attr("endpoints") or []
+                    for n in op_.input_arg_names:
+                        if eps:
+                            self.grad_endpoints[n] = eps[0]
+        self.trainer_id = int(trainer_id)
+        self.max_merge_var_num = int(max_merge_var_num)
+        self.send_wait_ms = send_wait_ms
+        self.send_queue_size = int(send_queue_size)
+        self._queues = {}  # name -> deque of np arrays
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._running = False
+        self._thread = None
+
+    # -- lifecycle (reference communicator.py Communicator.start/stop) --
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        _global_communicator[0] = self
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+        self._flush()
+        if _global_communicator[0] is self:
+            _global_communicator[0] = None
+
+    def is_running(self):
+        return self._running
+
+    # -- producer side (called from the send op lowering) --
+    def push(self, name, value):
+        with self._cv:
+            q = self._queues.setdefault(name, deque())
+            while len(q) >= self.send_queue_size and self._running:
+                self._cv.wait(timeout=1.0)
+            q.append(np.asarray(value))
+            self._cv.notify_all()
+
+    # -- consumer side --
+    def _drain_one(self):
+        """Pop up to max_merge_var_num pending grads for one var; -> (name,
+        merged) or None."""
+        with self._cv:
+            for name, q in self._queues.items():
+                if q:
+                    n = min(len(q), self.max_merge_var_num)
+                    arrs = [q.popleft() for _ in range(n)]
+                    self._cv.notify_all()
+                    merged = arrs[0].astype(np.float64)
+                    for a in arrs[1:]:
+                        merged = merged + a
+                    return name, (merged / n).astype(arrs[0].dtype)
+        return None
+
+    def _send_loop(self):
+        while True:
+            item = self._drain_one()
+            if item is None:
+                with self._cv:
+                    if not self._running:
+                        return
+                time.sleep(self.send_wait_ms / 1000.0)
+                continue
+            self._send(item)
+
+    def _flush(self):
+        while True:
+            item = self._drain_one()
+            if item is None:
+                return
+            self._send(item)
+
+    def _send(self, item):
+        name, merged = item
+        ep = self.grad_endpoints.get(name)
+        if ep is None:
+            return
+        client = _dist_ops.get_client(ep, self.trainer_id)
+        client.send_var(name, native.serialize_tensor(merged))
+
+
+class GeoSgdCommunicator(Communicator):
+    """GEO-SGD (reference GeoSgdCommunicator, communicator.h:332): every
+    ``push_interval`` local steps, push param deltas vs the last snapshot
+    and pull merged params back into the scope."""
+
+    def __init__(self, scope, param_endpoints, trainer_id=0,
+                 push_interval=4):
+        super().__init__(grad_endpoints={}, trainer_id=trainer_id)
+        self.scope = scope
+        self.param_endpoints = dict(param_endpoints)
+        self.push_interval = int(push_interval)
+        self._step = 0
+        self._snapshots = {}
+
+    def start(self):
+        # snapshot current params
+        for pname in self.param_endpoints:
+            v = self.scope.get(pname)
+            if v is not None:
+                self._snapshots[pname] = np.asarray(v).copy()
+        self._running = True
+        _global_communicator[0] = self
+
+    def stop(self):
+        if self._running and self._step % self.push_interval:
+            self._push_pull()  # flush the tail deltas (reference stop flush)
+        self._running = False
+        if _global_communicator[0] is self:
+            _global_communicator[0] = None
+
+    def on_step(self):
+        """Call once per local train step."""
+        self._step += 1
+        if self._step % self.push_interval:
+            return
+        self._push_pull()
+
+    def _push_pull(self):
+        for pname, ep in self.param_endpoints.items():
+            cur = np.asarray(self.scope.get(pname))
+            snap = self._snapshots.get(pname)
+            if snap is None:
+                self._snapshots[pname] = cur.copy()
+                continue
+            delta = cur - snap
+            client = _dist_ops.get_client(ep, self.trainer_id)
+            client.send_var(
+                pname + "@DELTA", native.serialize_tensor(delta)
+            )
+            fresh, _lod, _used = native.deserialize_tensor(
+                client.get_var(pname)
+            )
+            self.scope.set(pname, fresh)
+            self._snapshots[pname] = np.asarray(fresh).copy()

@@ -248,10 +248,10 @@ def lower_conditional_block(ctx, op_):
 # ---------------------------------------------------------------------------
 # host ops
 # ---------------------------------------------------------------------------
-def _run_host_op(op_, scope, place, local_env=None):
+def _run_host_op(op_, scope, place, local_env=None, block=None):
     opdef = _registry.get_op_def(op_.type)
     env = _ScopeEnv(scope, local_env)
-    ctx = LowerCtx(env=env, block=None, scope=_HostScope(scope, local_env))
+    ctx = LowerCtx(env=env, block=block, scope=_HostScope(scope, local_env))
     opdef.lower(ctx, op_)
 
 
@@ -454,7 +454,7 @@ class _CompiledBlock(object):
         for kind, seg, plan in self._plans:
             if kind == "host":
                 for op_ in seg.ops:
-                    _run_host_op(op_, scope, place, local_env)
+                    _run_host_op(op_, scope, place, local_env, self.block)
                 continue
             feed_vals = []
             for n in plan["feeds"]:
@@ -530,6 +530,11 @@ class Executor(object):
         self._closed = False
 
     def close(self):
+        """Graceful shutdown; notifies pservers (reference: Executor::Close
+        -> SendComplete, framework/executor.cc:110)."""
+        from .ops import distributed_ops as _dist_ops
+
+        _dist_ops.close_all_clients(send_complete=True)
         self._closed = True
         self._cache.clear()
 

@@ -23,12 +23,19 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(_HERE, "..", "csrc")
-_SRC = os.path.join(_CSRC, "paddle_tpu_native.cpp")
 _SO = os.path.join(_CSRC, "_build", "libpaddle_tpu_native.so")
 
 _lib = None
 _lib_lock = threading.Lock()
 _compile_error = None
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f)
+        for f in os.listdir(_CSRC)
+        if f.endswith(".cpp")
+    )
 
 
 def _compile():
@@ -38,7 +45,7 @@ def _compile():
     tmp = "%s.%d.tmp" % (_SO, os.getpid())
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", tmp,
+        *_sources(), "-o", tmp,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, _SO)
@@ -50,9 +57,10 @@ def _load():
         if _lib is not None or _compile_error is not None:
             return _lib
         try:
+            src_mtime = max(os.path.getmtime(s) for s in _sources())
             if (
                 not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+                or os.path.getmtime(_SO) < src_mtime
             ):
                 _compile()
             lib = ctypes.CDLL(_SO)
@@ -112,6 +120,41 @@ def _load():
         lib.pt_ms_total.restype = u64
         lib.pt_ms_total.argtypes = [c, ctypes.c_int]
         lib.pt_ms_destroy.argtypes = [c]
+        # RPC transport (rpc.cpp)
+        u32 = ctypes.c_uint32
+        u32p = ctypes.POINTER(u32)
+        lib.pt_rpc_server_create.restype = c
+        lib.pt_rpc_server_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int
+        ]
+        lib.pt_rpc_server_port.restype = ctypes.c_int
+        lib.pt_rpc_server_port.argtypes = [c]
+        lib.pt_rpc_server_wait_sends.argtypes = [c, ctypes.c_int]
+        lib.pt_rpc_server_begin_serve.argtypes = [c]
+        lib.pt_rpc_server_end_step.argtypes = [c, ctypes.c_int]
+        lib.pt_rpc_server_get_recv.argtypes = [
+            c, ctypes.c_char_p, ctypes.POINTER(u8p), u64p
+        ]
+        lib.pt_rpc_server_put_param.argtypes = [c, ctypes.c_char_p, u8p, u64]
+        lib.pt_rpc_server_pop_send.argtypes = [
+            c, ctypes.c_char_p, ctypes.c_int, u32p, ctypes.POINTER(u8p),
+            u64p, ctypes.c_int,
+        ]
+        lib.pt_rpc_server_n_complete.restype = ctypes.c_int
+        lib.pt_rpc_server_n_complete.argtypes = [c]
+        lib.pt_rpc_server_destroy.argtypes = [c]
+        lib.pt_rpc_connect.restype = c
+        lib.pt_rpc_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int
+        ]
+        lib.pt_rpc_send_var.argtypes = [c, u32, ctypes.c_char_p, u8p, u64]
+        lib.pt_rpc_get_var.argtypes = [
+            c, u32, ctypes.c_char_p, ctypes.POINTER(u8p), u64p
+        ]
+        lib.pt_rpc_send_barrier.argtypes = [c, u32]
+        lib.pt_rpc_fetch_barrier.argtypes = [c, u32]
+        lib.pt_rpc_complete.argtypes = [c, u32]
+        lib.pt_rpc_close.argtypes = [c]
         _lib = lib
         return _lib
 
@@ -299,5 +342,166 @@ class MultiSlotFile(object):
             if self._h:
                 self._lib.pt_ms_destroy(self._h)
                 self._h = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# RPC transport (pserver runtime)
+# ---------------------------------------------------------------------------
+class RpcServer(object):
+    """Parameter-server transport endpoint (reference: RPCServer,
+    operators/distributed/rpc_server.h; gRPC backend grpc/grpc_server.cc).
+    Handles SEND/GET/barriers/COMPLETE; the optimize loop lives in Python
+    (ops/distributed_ops.py listen_and_serv)."""
+
+    def __init__(self, port, n_trainers, sync_mode=True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable: %s" % _compile_error
+            )
+        self._lib = lib
+        self._h = lib.pt_rpc_server_create(
+            int(port), int(n_trainers), 1 if sync_mode else 0
+        )
+        if not self._h:
+            raise RuntimeError("failed to bind rpc server on port %s" % port)
+
+    @property
+    def port(self):
+        return int(self._lib.pt_rpc_server_port(self._h))
+
+    def wait_sends(self, timeout_ms=-1):
+        """0 = batch ready, 1 = timeout, 3 = all trainers complete."""
+        return int(self._lib.pt_rpc_server_wait_sends(self._h, timeout_ms))
+
+    def begin_serve(self):
+        self._lib.pt_rpc_server_begin_serve(self._h)
+
+    def end_step(self, timeout_ms=-1):
+        return int(self._lib.pt_rpc_server_end_step(self._h, timeout_ms))
+
+    def get_recv(self, name):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.pt_rpc_server_get_recv(
+            self._h, name.encode(), ctypes.byref(out), ctypes.byref(out_len)
+        )
+        if rc != 0:
+            return None
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.pt_free(out)
+
+    def put_param(self, name, data):
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        self._lib.pt_rpc_server_put_param(
+            self._h, name.encode(), buf, len(data)
+        )
+
+    def pop_send(self, timeout_ms=-1):
+        """Async mode: -> (name, trainer_id, payload) | "timeout" | None
+        (None = all trainers complete and queue drained)."""
+        name_buf = ctypes.create_string_buffer(64 << 10)
+        trainer = ctypes.c_uint32()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.pt_rpc_server_pop_send(
+            self._h, name_buf, len(name_buf), ctypes.byref(trainer),
+            ctypes.byref(out), ctypes.byref(out_len), timeout_ms,
+        )
+        if rc == 1:
+            return "timeout"
+        if rc == 3:
+            return None
+        try:
+            return (
+                name_buf.value.decode(),
+                int(trainer.value),
+                ctypes.string_at(out, out_len.value),
+            )
+        finally:
+            self._lib.pt_free(out)
+
+    def n_complete(self):
+        return int(self._lib.pt_rpc_server_n_complete(self._h))
+
+    def shutdown(self):
+        if self._h:
+            self._lib.pt_rpc_server_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class RpcClient(object):
+    """Trainer-side connection to one pserver endpoint (reference:
+    RPCClient, operators/distributed/rpc_client.h / grpc/grpc_client.cc)."""
+
+    def __init__(self, endpoint, trainer_id=0, timeout_ms=60000):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable: %s" % _compile_error
+            )
+        self._lib = lib
+        host, port = endpoint.rsplit(":", 1)
+        if host in ("localhost", ""):
+            host = "127.0.0.1"
+        self.endpoint = endpoint
+        self.trainer_id = int(trainer_id)
+        self._h = lib.pt_rpc_connect(host.encode(), int(port), timeout_ms)
+        if not self._h:
+            raise ConnectionError(
+                "cannot connect to pserver at %s" % endpoint
+            )
+
+    def send_var(self, name, payload):
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        rc = self._lib.pt_rpc_send_var(
+            self._h, self.trainer_id, name.encode(), buf, len(payload)
+        )
+        if rc != 0:
+            raise ConnectionError("send_var(%s) -> rc %d" % (name, rc))
+
+    def get_var(self, name):
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.pt_rpc_get_var(
+            self._h, self.trainer_id, name.encode(), ctypes.byref(out),
+            ctypes.byref(out_len),
+        )
+        if rc != 0:
+            if bool(out):
+                self._lib.pt_free(out)
+            raise ConnectionError("get_var(%s) -> rc %d" % (name, rc))
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.pt_free(out)
+
+    def send_barrier(self):
+        self._lib.pt_rpc_send_barrier(self._h, self.trainer_id)
+
+    def fetch_barrier(self):
+        self._lib.pt_rpc_fetch_barrier(self._h, self.trainer_id)
+
+    def complete(self):
+        self._lib.pt_rpc_complete(self._h, self.trainer_id)
+
+    def close(self):
+        if self._h:
+            self._lib.pt_rpc_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:
             pass

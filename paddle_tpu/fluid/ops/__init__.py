@@ -24,3 +24,4 @@ from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import io_ops  # noqa: F401
+from . import distributed_ops  # noqa: F401

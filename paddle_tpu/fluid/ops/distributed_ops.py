@@ -1,0 +1,242 @@
+"""Parameter-server distributed ops (host ops over the native RPC transport).
+
+Reference counterparts (paddle/fluid/operators/distributed_ops/):
+- ``send``           — send_op.cc: serialize scope vars, RPCClient AsyncSendVar
+- ``recv``           — recv_op.cc: AsyncGetVar into scope
+- ``send_barrier``   — send_barrier_op.cc
+- ``fetch_barrier``  — fetch_barrier_op.cc
+- ``listen_and_serv``— listen_and_serv_op.cc: pserver main loop. Sync mode:
+  wait for all trainers' grads + send barriers, merge per-trainer grad copies
+  (the reference's _append_pserver_grad_merge_ops sum + scale), run the
+  per-grad optimize sub-blocks, publish params, serve Gets until all fetch
+  barriers. Async mode: RunAsyncLoop — optimize per received grad
+  immediately, serve current params at any time.
+
+Transport is paddle_tpu/csrc/rpc.cpp (framed TCP; the reference used gRPC —
+semantics preserved, dependency dropped). Payloads ride the LoDTensor stream
+format so send/recv interoperate with save/load bytes.
+
+TPU note: this path is host-side by design (giant-embedding pserver workloads
+ride the DCN, not ICI); the optimize sub-blocks themselves still lower
+through XLA via _CompiledBlock.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .registry import register_op
+from .. import native
+
+_clients_lock = threading.Lock()
+_clients = {}  # (endpoint, trainer_id) -> native.RpcClient
+
+
+def get_client(endpoint, trainer_id):
+    key = (endpoint, int(trainer_id))
+    with _clients_lock:
+        c = _clients.get(key)
+        if c is None:
+            c = native.RpcClient(endpoint, trainer_id)
+            _clients[key] = c
+        return c
+
+
+def close_all_clients(send_complete=True):
+    """Executor::Close semantics (reference executor.cc:110 SendComplete)."""
+    with _clients_lock:
+        for c in _clients.values():
+            try:
+                if send_complete:
+                    c.complete()
+                c.close()
+            except Exception:
+                pass
+        _clients.clear()
+
+
+def _scope_value(ctx, name):
+    v = ctx.scope.get(name)
+    if v is None:
+        raise KeyError("send: var %r not found in scope" % name)
+    return np.asarray(v)
+
+
+def _send_lower(ctx, op_):
+    eps = op_.attr("endpoints") or op_.attr("epmap") or []
+    tid = int(op_.attr("trainer_id", 0))
+    names = [n for n in op_.input_arg_names]
+    if not op_.attr("sync_mode", True):
+        # async mode: hand grads to the running communicator, which merges
+        # and pushes in the background (reference send_op.cc routing through
+        # Communicator::GetInstance when not sync)
+        from .. import communicator as _comm
+
+        c = _comm.global_communicator()
+        if c is not None and c.is_running():
+            for n in names:
+                c.push(n, _scope_value(ctx, n))
+            return
+    for ep in eps:
+        client = get_client(ep, tid)
+        for n in names:
+            payload = native.serialize_tensor(_scope_value(ctx, n))
+            client.send_var(n, payload)
+
+
+def _recv_lower(ctx, op_):
+    eps = op_.attr("endpoints") or op_.attr("epmap") or []
+    tid = int(op_.attr("trainer_id", 0))
+    names = [n for n in op_.output_arg_names]
+    for ep in eps:
+        client = get_client(ep, tid)
+        for n in names:
+            arr, _lod, _used = native.deserialize_tensor(client.get_var(n))
+            ctx.scope.set(n, arr)
+
+
+def _send_barrier_lower(ctx, op_):
+    for ep in op_.attr("endpoints") or []:
+        get_client(ep, int(op_.attr("trainer_id", 0))).send_barrier()
+
+
+def _fetch_barrier_lower(ctx, op_):
+    for ep in op_.attr("endpoints") or []:
+        get_client(ep, int(op_.attr("trainer_id", 0))).fetch_barrier()
+
+
+# ---------------------------------------------------------------------------
+# listen_and_serv
+# ---------------------------------------------------------------------------
+def _compile_optimize_block(program, block_idx, place):
+    from .. import executor as _executor_mod
+
+    return _executor_mod._CompiledBlock(program, block_idx, [], [], place)
+
+
+def _merge_trainer_grads(server, grad_name, n_trainers):
+    """Sum per-trainer copies and average (reference:
+    _append_pserver_grad_merge_ops — sum op + scale 1/trainer_num)."""
+    arrs = []
+    for t in range(n_trainers):
+        payload = server.get_recv("%s@trainer_%d" % (grad_name, t))
+        if payload is not None:
+            arr, _lod, _used = native.deserialize_tensor(payload)
+            arrs.append(arr.astype(np.float64))
+    if not arrs:
+        return None
+    merged = arrs[0]
+    for a in arrs[1:]:
+        merged = merged + a
+    return (merged / float(len(arrs))).astype(np.float32)
+
+
+def _listen_and_serv_lower(ctx, op_):
+    import jax
+
+    program = ctx.block.program if ctx.block is not None else None
+    if program is None:
+        # host ops get block=None from _run_host_op; the program rides on
+        # the op itself (set by the transpiler)
+        program = op_.attrs.get("__program__")
+    endpoint = op_.attr("endpoint")
+    n_trainers = int(op_.attr("Fanin", 1))
+    sync_mode = bool(op_.attr("sync_mode", True))
+    grad_to_block_id = op_.attr("grad_to_block_id") or []
+    timeout_ms = int(op_.attr("rpc_timeout_ms", 600000))
+
+    port = int(endpoint.rsplit(":", 1)[1])
+    scope = ctx.scope
+    from .. import core as _core
+
+    place = _core.CPUPlace()
+
+    # grad name -> (optimize block idx, param name)
+    grad_map = {}
+    for item in grad_to_block_id:
+        gname, bidx = item.rsplit(":", 1)
+        bidx = int(bidx)
+        pname = None
+        for blk_op in program.block(bidx).ops:
+            pnames = blk_op.input("Param")
+            if pnames:
+                pname = pnames[0]
+                break
+        grad_map[gname] = (bidx, pname)
+
+    served_params = [
+        v.name
+        for v in program.global_block().vars.values()
+        if v.persistable and not v.name.startswith("__")
+    ]
+
+    server = native.RpcServer(port, n_trainers, sync_mode)
+    compiled = {}
+    rng = jax.random.key(0)
+
+    def publish(names):
+        for pname in names:
+            v = scope.get(pname)
+            if v is not None:
+                server.put_param(pname, native.serialize_tensor(np.asarray(v)))
+
+    try:
+        publish(served_params)
+        if sync_mode:
+            while True:
+                rc = server.wait_sends(timeout_ms)
+                if rc != 0:
+                    break
+                for gname, (bidx, _pname) in grad_map.items():
+                    merged = _merge_trainer_grads(server, gname, n_trainers)
+                    if merged is None:
+                        continue
+                    scope.set(gname, merged)
+                    cb = compiled.get(bidx)
+                    if cb is None:
+                        cb = _compile_optimize_block(program, bidx, place)
+                        compiled[bidx] = cb
+                    cb.run(scope, {}, rng, place)
+                publish(served_params)
+                server.begin_serve()
+                rc = server.end_step(timeout_ms)
+                if rc != 0:
+                    break
+        else:
+            while True:
+                item = server.pop_send(timeout_ms)
+                if item == "timeout" or item is None:
+                    break
+                gname, _tid, payload = item
+                if gname.endswith("@DELTA"):
+                    # GEO-SGD: apply the param delta additively (reference
+                    # GeoSgdCommunicator server side: sum deltas into param)
+                    pname = gname[: -len("@DELTA")]
+                    delta, _lod, _used = native.deserialize_tensor(payload)
+                    cur = scope.get(pname)
+                    if cur is not None:
+                        scope.set(pname, np.asarray(cur) + delta)
+                        publish([pname])
+                    continue
+                if gname not in grad_map:
+                    continue
+                arr, _lod, _used = native.deserialize_tensor(payload)
+                scope.set(gname, arr)
+                bidx, pname = grad_map[gname]
+                cb = compiled.get(bidx)
+                if cb is None:
+                    cb = _compile_optimize_block(program, bidx, place)
+                    compiled[bidx] = cb
+                cb.run(scope, {}, rng, place)
+                publish([pname] if pname else served_params)
+    finally:
+        server.shutdown()
+
+
+register_op("send", lower=_send_lower, host=True)
+register_op("recv", lower=_recv_lower, host=True)
+register_op("send_barrier", lower=_send_barrier_lower, host=True)
+register_op("fetch_barrier", lower=_fetch_barrier_lower, host=True)
+register_op("listen_and_serv", lower=_listen_and_serv_lower, host=True)

@@ -134,6 +134,7 @@ class LowerCtx(object):
         self.mesh_axes = dict(mesh_axes or {})
         self.block = block
         self.scope = scope  # host-side scope, only for host ops
+        self._cur_op = None  # op currently being lowered (set by run_op)
 
     # -- env access --
     def get(self, name):
@@ -180,13 +181,48 @@ class LowerCtx(object):
                 self.set(n, v)
 
     def next_key(self):
+        """PRNG key for the op being lowered. Derivation rules (matching the
+        reference's seeding semantics, e.g. uniform_random_op.cc `seed`
+        attr):
+        - op has a nonzero ``seed`` attr -> key(seed): fully deterministic,
+          independent of everything else;
+        - otherwise fold the (program-seed, step) base key by a hash of the
+          op's first output name: the same var gets the same init in every
+          process regardless of which subset of ops the program contains
+          (required for trainer/pserver init agreement in dist training);
+        - no current op (direct lowering-rule calls) -> positional counter.
+        """
         import jax
 
         if self.base_key is None:
             raise RuntimeError(
                 "random op lowered without a PRNG key — executor must pass one"
             )
-        k = jax.random.fold_in(self.base_key, self._key_counter)
+        op = self._cur_op
+        seed_attr = 0
+        salt = None
+        if op is not None:
+            try:
+                seed_attr = int(op.attr("seed", 0) or 0)
+            except Exception:
+                seed_attr = 0
+            for slot in sorted(op.outputs or {}):
+                for n in op.outputs[slot]:
+                    if n != EMPTY_VAR:
+                        salt = n
+                        break
+                if salt is not None:
+                    break
+        if seed_attr:
+            k = jax.random.key(seed_attr)
+        elif salt is not None:
+            import zlib
+
+            k = jax.random.fold_in(
+                self.base_key, zlib.crc32(salt.encode()) & 0x7FFFFFFF
+            )
+        else:
+            k = jax.random.fold_in(self.base_key, self._key_counter)
         self._key_counter += 1
         axis = self.data_axis
         if axis is not None:
@@ -214,7 +250,12 @@ def run_op(ctx, op):
         raise NotImplementedError(
             "no lowering rule registered for op %r" % op.type
         )
-    d.lower(ctx, op)
+    prev = ctx._cur_op
+    ctx._cur_op = op
+    try:
+        d.lower(ctx, op)
+    finally:
+        ctx._cur_op = prev
 
 
 # ---------------------------------------------------------------------------

@@ -155,7 +155,10 @@ class DistributeTranspiler(object):
                 block._find_var_recursive(gname)
             )
 
-        # trainer program: strip optimizer ops, append send/recv
+        # trainer program: strip optimizer ops, append send (+barrier) /
+        # recv (+barrier) — the reference trainer-side rewrite
+        # (distribute_transpiler.py: grad -> send -> send_barrier -> recv ->
+        # fetch_barrier, :495 onwards)
         self.trainer_program = program.clone()
         tblock = self.trainer_program.global_block()
         opt_idx = [
@@ -165,9 +168,9 @@ class DistributeTranspiler(object):
         ]
         for i in reversed(opt_idx):
             tblock._remove_op(i)
-        for ep in self.pserver_endpoints:
+        all_eps = list(self.pserver_endpoints)
+        for ep in all_eps:
             grads = [g.name for g in self.param_grad_ep_mapping[ep]["grads"] if g]
-            params = [p.name for p in self.param_grad_ep_mapping[ep]["params"] if p]
             if grads:
                 tblock.append_op(
                     type="send",
@@ -176,15 +179,62 @@ class DistributeTranspiler(object):
                     attrs={
                         "endpoints": [ep],
                         "sync_mode": self.sync_mode,
+                        "trainer_id": self.trainer_id,
                         OP_ROLE_KEY: OpRole.RPC,
                     },
                 )
+        if self.sync_mode:
+            tblock.append_op(
+                type="send_barrier",
+                inputs={},
+                outputs={},
+                attrs={
+                    "endpoints": all_eps,
+                    "trainer_id": self.trainer_id,
+                    OP_ROLE_KEY: OpRole.RPC,
+                },
+            )
+        for ep in all_eps:
+            params = [p.name for p in self.param_grad_ep_mapping[ep]["params"] if p]
             if params:
                 tblock.append_op(
                     type="recv",
                     inputs={},
                     outputs={"Out": params},
-                    attrs={"endpoints": [ep], OP_ROLE_KEY: OpRole.RPC},
+                    attrs={
+                        "endpoints": [ep],
+                        "trainer_id": self.trainer_id,
+                        OP_ROLE_KEY: OpRole.RPC,
+                    },
+                )
+        if self.sync_mode:
+            tblock.append_op(
+                type="fetch_barrier",
+                inputs={},
+                outputs={},
+                attrs={
+                    "endpoints": all_eps,
+                    "trainer_id": self.trainer_id,
+                    OP_ROLE_KEY: OpRole.RPC,
+                },
+            )
+        # trainer startup: after local init, pull the authoritative initial
+        # params from the pservers so every trainer and the pserver agree
+        # (reference: startup-program rewrite in transpile(); the server's
+        # GET handler serves pre-step-0 reads immediately)
+        sblock = self.startup_program.global_block()
+        for ep in all_eps:
+            params = [p.name for p in self.param_grad_ep_mapping[ep]["params"] if p]
+            if params:
+                sblock.append_op(
+                    type="recv",
+                    inputs={},
+                    outputs={"Out": params},
+                    attrs={
+                        "endpoints": [ep],
+                        "trainer_id": self.trainer_id,
+                        OP_ROLE_KEY: OpRole.RPC,
+                    },
                 )
 
     def get_trainer_program(self, wait_port=True):
@@ -193,8 +243,12 @@ class DistributeTranspiler(object):
 
     def get_pserver_program(self, endpoint):
         """reference: distribute_transpiler.py:1003 — optimize blocks behind
-        a listen_and_serv loop; here the returned program carries the param/
-        optimizer subsets and paddle_tpu.distributed.ps_server serves it."""
+        a listen_and_serv op. The returned program has one sub-block per
+        owned grad holding its optimizer op(s) (the reference's
+        _create_pserver_block per grad), and the global block holds a single
+        ``listen_and_serv`` op (operators/distributed_ops/
+        listen_and_serv_op.cc) whose host lowering runs the serve loop over
+        the native RPC transport."""
         pserver_program = Program()
         pblock = pserver_program.global_block()
         mapping = self.param_grad_ep_mapping[endpoint]
@@ -209,45 +263,90 @@ class DistributeTranspiler(object):
             if g is None:
                 continue
             pblock.create_var(name=g.name, shape=g.shape, dtype=g.dtype)
-        # copy optimizer ops for the params owned by this pserver
+
         owned = {p.name for p in mapping["params"] if p is not None}
+        grad_of_param = dict(
+            (p, g) for p, g in getattr(self.origin_program, "_params_grads", [])
+        )
+        # one optimize sub-block per owned param (reference
+        # _create_pserver_block); aux vars (LR, moments) created persistable
+        # in the global block
+        grad_to_block_id = []
+        aux_slots = (
+            "Grad", "LearningRate", "Velocity", "Moment1", "Moment2",
+            "Moment", "MeanSquare", "MeanGrad", "Beta1Pow", "Beta2Pow",
+            "InfNorm", "AvgSquaredGrad", "AvgSquaredUpdate", "SquaredAccum",
+            "LinearAccum",
+        )
         for op_ in origin_block.ops:
             if not (op_.attr(OP_ROLE_KEY, 0) & OpRole.Optimize):
                 continue
             pnames = op_.input("Param")
-            if pnames and pnames[0] in owned:
-                for slot in ("Grad", "LearningRate", "Velocity", "Moment1",
-                             "Moment2", "Moment", "Beta1Pow", "Beta2Pow"):
-                    for n in op_.input(slot):
-                        if not pblock.has_var(n):
-                            src = origin_block._find_var_recursive(n)
-                            if src is not None:
-                                pblock.create_var(
-                                    name=n, shape=src.shape, dtype=src.dtype,
-                                    persistable=src.persistable,
-                                )
-                pblock.append_op(
-                    type=op_.type,
-                    inputs={k: list(v) for k, v in op_.inputs.items()},
-                    outputs={k: list(v) for k, v in op_.outputs.items()},
-                    attrs=dict(op_.attrs),
-                )
+            if not (pnames and pnames[0] in owned):
+                continue
+            for slot in aux_slots:
+                for n in op_.input(slot):
+                    if not pblock.has_var(n):
+                        src = origin_block._find_var_recursive(n)
+                        if src is not None:
+                            pblock.create_var(
+                                name=n, shape=src.shape, dtype=src.dtype,
+                                persistable=src.persistable,
+                            )
+            sub = pserver_program._create_block(parent_idx=0)
+            sub.append_op(
+                type=op_.type,
+                inputs={k: list(v) for k, v in op_.inputs.items()},
+                outputs={k: list(v) for k, v in op_.outputs.items()},
+                attrs=dict(op_.attrs),
+            )
+            pserver_program.current_block_idx = 0
+            gname = grad_of_param.get(pnames[0])
+            if gname is None:
+                gnames = op_.input("Grad")
+                gname = gnames[0] if gnames else pnames[0] + "@GRAD"
+            grad_to_block_id.append("%s:%d" % (gname, sub.idx))
+
+        pblock.append_op(
+            type="listen_and_serv",
+            inputs={},
+            outputs={},
+            attrs={
+                "endpoint": endpoint,
+                "Fanin": self.trainer_num,
+                "sync_mode": self.sync_mode,
+                "grad_to_block_id": grad_to_block_id,
+                OP_ROLE_KEY: OpRole.RPC,
+            },
+        )
         pserver_program._ps_endpoint = endpoint
         pserver_program._ps_mode = "sync" if self.sync_mode else "async"
         return pserver_program
 
     def get_pserver_programs(self, endpoint):
-        return self.get_pserver_program(endpoint), self.get_startup_program(
-            endpoint
-        )
+        prog = self.get_pserver_program(endpoint)
+        return prog, self.get_startup_program(endpoint, prog)
 
     def get_startup_program(self, endpoint, pserver_program=None):
+        """Init ops for every persistable var the pserver program owns —
+        params AND optimizer aux vars (LR, moments); reference:
+        distribute_transpiler.py get_startup_program."""
+        if pserver_program is None:
+            pserver_program = self.get_pserver_program(endpoint)
         sp = Program()
+        # same seed as the trainer startup: with name-salted PRNG keys the
+        # pserver then initializes exactly the values the trainers compute
+        sp._seed = self.startup_program._seed
         block = sp.global_block()
-        mapping = self.param_grad_ep_mapping[endpoint]
         origin_startup = self.startup_program.global_block()
-        owned = {p.name for p in mapping["params"] if p is not None}
+        owned = {
+            v.name
+            for v in pserver_program.global_block().vars.values()
+            if v.persistable
+        }
         for op_ in origin_startup.ops:
+            if op_.attr(OP_ROLE_KEY, 0) & OpRole.RPC:
+                continue  # trainer-side startup recv ops, not init ops
             outs = op_.output_arg_names
             if outs and outs[0] in owned:
                 for n in outs:

@@ -1,0 +1,144 @@
+"""Subprocess runner for parameter-server distributed tests.
+
+The analogue of the reference's dist-test model files + runtime_main
+(python/paddle/fluid/tests/unittests/test_dist_base.py:891 and
+dist_mnist.py): one script that can run as LOCAL baseline, PSERVER, or
+TRAINER based on env vars, printing per-step losses as a parseable line.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+
+SEED = 90
+BATCH = 32
+STEPS = 5
+FEATURES = 20
+CLASSES = 10
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATURES], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=64, act="relu")
+        logits = fluid.layers.fc(input=h, size=CLASSES)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+def batch_for(step):
+    rs = np.random.RandomState(1234 + step)
+    x = rs.rand(BATCH, FEATURES).astype("float32")
+    y = rs.randint(0, CLASSES, (BATCH, 1)).astype("int64")
+    return x, y
+
+
+def run_local():
+    main_p, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for s in range(STEPS):
+        x, y = batch_for(s)
+        (l,) = exe.run(main_p, feed={"x": x, "y": y}, fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+def run_dist():
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    sync = os.environ.get("DIST_SYNC", "1") == "1"
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    main_p, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(
+        tid,
+        program=main_p,
+        pservers=eps,
+        trainers=trainers,
+        sync_mode=sync,
+        startup_program=startup,
+        current_endpoint=cur,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        ps_prog, ps_startup = t.get_pserver_programs(cur)
+        exe.run(ps_startup)
+        print("PSERVER READY", flush=True)
+        exe.run(ps_prog)  # listen_and_serv: blocks until trainers complete
+        print("PSERVER DONE", flush=True)
+        return
+
+    comm_mode = os.environ.get("DIST_COMM", "")
+    comm = None
+    if comm_mode == "geo":
+        # GEO-SGD: the trainer keeps its optimizer ops and runs local SGD;
+        # the communicator pushes param deltas every k steps
+        from paddle_tpu.fluid.communicator import GeoSgdCommunicator
+
+        trainer_prog = main_p
+        exe.run(startup)
+        scope = fluid.global_scope()
+        param_eps = {}
+        for ep, m in t.param_grad_ep_mapping.items():
+            for p in m["params"]:
+                if p is not None:
+                    param_eps[p.name] = ep
+        comm = GeoSgdCommunicator(scope, param_eps, trainer_id=tid,
+                                  push_interval=2)
+        comm.start()
+    else:
+        trainer_prog = t.get_trainer_program()
+        exe.run(startup)  # local init, then recv authoritative params
+        if comm_mode == "async":
+            from paddle_tpu.fluid.communicator import Communicator
+
+            comm = Communicator(program=trainer_prog, trainer_id=tid)
+            comm.start()
+    per = BATCH // trainers
+    losses = []
+    for s in range(STEPS):
+        x, y = batch_for(s)
+        xs = x[tid * per:(tid + 1) * per]
+        ys = y[tid * per:(tid + 1) * per]
+        (l,) = exe.run(trainer_prog, feed={"x": xs, "y": ys},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+        if comm_mode == "geo":
+            comm.on_step()
+    if comm is not None:
+        comm.stop()
+    exe.close()  # sends COMPLETE to pservers
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL") == "LOCAL":
+        run_local()
+    else:
+        run_dist()
