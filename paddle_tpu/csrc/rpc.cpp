@@ -353,8 +353,11 @@ int pt_rpc_server_end_step(void* h, int timeout_ms) {
   return 0;
 }
 
-// Read a received var (sync mode: name includes the @trainer_<i> suffix).
-// Returns 0 ok (*out malloc'd, caller pt_free), 1 not found.
+// Take a received var (sync mode: name includes the @trainer_<i> suffix).
+// Consumes the entry — a grad is merged into exactly one optimize round, so
+// a trainer that stops sending (COMPLETE) cannot leak its last gradient
+// into every later step. Returns 0 ok (*out malloc'd, caller pt_free),
+// 1 not found.
 int pt_rpc_server_get_recv(void* h, const char* name, uint8_t** out,
                            uint64_t* out_len) {
   auto* s = static_cast<RpcServer*>(h);
@@ -364,6 +367,7 @@ int pt_rpc_server_get_recv(void* h, const char* name, uint8_t** out,
   *out_len = it->second.size();
   *out = static_cast<uint8_t*>(std::malloc(it->second.size()));
   std::memcpy(*out, it->second.data(), it->second.size());
+  s->recv_store.erase(it);
   return 0;
 }
 

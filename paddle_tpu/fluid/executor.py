@@ -87,7 +87,9 @@ class _Segment(object):
 
 def _analyze_ops(ops, defined):
     """Return (external_reads, writes) for an op list given names already
-    defined upstream."""
+    defined upstream. Stateful input slots (OpDef.stateful_inputs — in-place
+    updates like spectral_norm's U/V power-iteration state) count as writes
+    so their new values persist to the scope."""
     reads, writes = [], []
     local = set()
     seen_r, seen_w = set(), set()
@@ -98,7 +100,16 @@ def _analyze_ops(ops, defined):
             if n not in local and n not in seen_r:
                 seen_r.add(n)
                 reads.append(n)
-        for n in op_.output_arg_names:
+        out_names = list(op_.output_arg_names)
+        opdef = _registry.get_op_def(op_.type)
+        if opdef is not None and opdef.stateful_inputs:
+            for slot in opdef.stateful_inputs:
+                # two forms: (in_slot, out_slot) pairs already surface the
+                # write through the output slot; bare strings are pure
+                # in-place inputs with no output alias
+                if isinstance(slot, str):
+                    out_names.extend(op_.inputs.get(slot) or [])
+        for n in out_names:
             if n == EMPTY_VAR:
                 continue
             local.add(n)
@@ -248,24 +259,30 @@ def lower_conditional_block(ctx, op_):
 # ---------------------------------------------------------------------------
 # host ops
 # ---------------------------------------------------------------------------
-def _run_host_op(op_, scope, place, local_env=None, block=None):
+def _run_host_op(op_, scope, place, local_env=None, block=None, feed=None):
     opdef = _registry.get_op_def(op_.type)
-    env = _ScopeEnv(scope, local_env)
-    ctx = LowerCtx(env=env, block=block, scope=_HostScope(scope, local_env))
+    env = _ScopeEnv(scope, local_env, feed)
+    ctx = LowerCtx(
+        env=env, block=block, scope=_HostScope(scope, local_env, feed)
+    )
     opdef.lower(ctx, op_)
 
 
 class _HostScope(object):
     """Scope view for host ops: reads see segment-local values from earlier
-    XLA segments first, writes land in both the local env and the Scope."""
+    XLA segments first, then feeds, then the Scope; writes land in both the
+    local env and the Scope."""
 
-    def __init__(self, scope, local_env):
+    def __init__(self, scope, local_env, feed=None):
         self._scope = scope
         self._local = local_env if local_env is not None else {}
+        self._feed = feed or {}
 
     def get(self, name, default=None):
         if name in self._local:
             return self._local[name]
+        if name in self._feed:
+            return self._feed[name]
         v = self._scope.get(name)
         return default if v is None else v
 
@@ -275,17 +292,20 @@ class _HostScope(object):
 
 
 class _ScopeEnv(dict):
-    """dict view over a Scope (+ local segment env) so host ops share the
-    LowerCtx interface."""
+    """dict view over a Scope (+ local segment env + feed) so host ops share
+    the LowerCtx interface."""
 
-    def __init__(self, scope, local_env=None):
+    def __init__(self, scope, local_env=None, feed=None):
         super().__init__()
         self._scope = scope
         self._local = local_env if local_env is not None else {}
+        self._feed = feed or {}
 
     def __missing__(self, key):
         if key in self._local:
             return self._local[key]
+        if key in self._feed:
+            return self._feed[key]
         v = self._scope.get(key)
         if v is None:
             raise KeyError(key)
@@ -296,6 +316,8 @@ class _ScopeEnv(dict):
             return dict.__getitem__(self, key)
         if key in self._local:
             return self._local[key]
+        if key in self._feed:
+            return self._feed[key]
         v = self._scope.get(key)
         return default if v is None else v
 
@@ -338,7 +360,29 @@ class _CompiledBlock(object):
         fetch_set = set(self.fetch_names)
         self._plans = []
         device_backend = core._jax_backend_for(place)
+        # `{name}@SEQ_LEN` companion availability: from LoD feeds and from
+        # sequence ops that emit companions (sequence_ops.SEQLEN_OUT_SLOTS);
+        # companions are threaded into segment inputs/outputs alongside their
+        # base var so ragged masking survives segment boundaries
+        from .ops.sequence_ops import SEQLEN_OUT_SLOTS
+
+        seg_companion_writes = []
+        for seg in self.segments:
+            writes_here = []
+            for op_ in seg.ops:
+                slot = SEQLEN_OUT_SLOTS.get(op_.type)
+                if slot:
+                    names = op_.outputs.get(slot) or []
+                    if names and names[0] != EMPTY_VAR:
+                        writes_here.append(names[0] + "@SEQ_LEN")
+            seg_companion_writes.append(writes_here)
+        # availability is cumulative in program order: a segment may only
+        # read companions from the feed or from EARLIER segments (a later
+        # write to the same base name must not create a phantom input)
+        companion_avail = {n for n in feed_set if n.endswith("@SEQ_LEN")}
+
         for i, seg in enumerate(self.segments):
+            companion_avail |= set(seg_companion_writes[i])
             if seg.kind == "host":
                 self._plans.append(("host", seg, None))
                 defined |= set(seg.writes)
@@ -346,16 +390,29 @@ class _CompiledBlock(object):
             # every external read is an input: from the feed, from earlier
             # segments (local_env at run time), or from the scope
             ext_reads = list(seg.reads)
+            local_companions = set(seg_companion_writes[i])
+            ext_reads += [
+                n + "@SEQ_LEN"
+                for n in seg.reads
+                if n + "@SEQ_LEN" in companion_avail
+                and n + "@SEQ_LEN" not in local_companions
+            ]
             feeds = [n for n in ext_reads if n in feed_set]
             state_reads = [n for n in ext_reads if n not in feed_set]
             writes = set(seg.writes)
             later_needed = set()
             for j in range(i + 1, len(self.segments)):
                 later_needed |= set(self.segments[j].reads)
+                later_needed |= {
+                    n + "@SEQ_LEN" for n in self.segments[j].reads
+                }
             out_names = [
                 n
                 for n in seg.writes
                 if n in fetch_set or n in persistable or n in later_needed
+            ]
+            out_names += [
+                n for n in seg_companion_writes[i] if n in later_needed
             ]
             mutable = [n for n in state_reads if n in writes]
             const = [n for n in state_reads if n not in writes]
@@ -454,7 +511,9 @@ class _CompiledBlock(object):
         for kind, seg, plan in self._plans:
             if kind == "host":
                 for op_ in seg.ops:
-                    _run_host_op(op_, scope, place, local_env, self.block)
+                    _run_host_op(
+                        op_, scope, place, local_env, self.block, feed
+                    )
                 continue
             feed_vals = []
             for n in plan["feeds"]:

@@ -25,3 +25,6 @@ from . import rnn_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import io_ops  # noqa: F401
 from . import distributed_ops  # noqa: F401
+from . import manip_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import rnn_fused_ops  # noqa: F401

@@ -120,17 +120,19 @@ def _merge_trainer_grads(server, grad_name, n_trainers):
     """Sum per-trainer copies and average (reference:
     _append_pserver_grad_merge_ops — sum op + scale 1/trainer_num)."""
     arrs = []
+    orig_dtype = None
     for t in range(n_trainers):
         payload = server.get_recv("%s@trainer_%d" % (grad_name, t))
         if payload is not None:
             arr, _lod, _used = native.deserialize_tensor(payload)
+            orig_dtype = arr.dtype
             arrs.append(arr.astype(np.float64))
     if not arrs:
         return None
     merged = arrs[0]
     for a in arrs[1:]:
         merged = merged + a
-    return (merged / float(len(arrs))).astype(np.float32)
+    return (merged / float(len(arrs))).astype(orig_dtype)
 
 
 def _listen_and_serv_lower(ctx, op_):

@@ -1,0 +1,265 @@
+"""Loss-op batch.
+
+Reference kernels: paddle/fluid/operators/kldiv_loss_op.cc, log_loss_op.cc,
+hinge_loss_op.cc, bpr_loss_op.cc, rank_loss_op.cc, margin_rank_loss_op.cc,
+center_loss_op.cc, sigmoid_focal_loss_op.cc (detection/), cross_entropy2
+(cross_entropy_op.cc), cvm_op.cc, warpctc_op.cc.
+
+warpctc: the reference links the external WarpCTC CUDA library; here CTC is
+a log-space forward algorithm as one lax.scan over time — a single fused XLA
+loop on TPU, differentiable by jax.vjp (no hand-written grad kernel).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import op, same_shape_infer
+
+
+@op("kldiv_loss", grad="generic")
+def _kldiv_loss(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # log-probabilities
+    target = ctx.in1(op_, "Target")
+    reduction = op_.attr("reduction", "mean")
+    loss = jnp.where(
+        target > 0, target * (jnp.log(jnp.maximum(target, 1e-30)) - x),
+        jnp.zeros_like(target),
+    )
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    elif reduction == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    ctx.out(op_, "Loss", loss if loss.ndim else loss.reshape(()))
+
+
+@op("log_loss", grad="generic")
+def _log_loss(ctx, op_):
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Predicted")
+    y = ctx.in1(op_, "Labels")
+    eps = float(op_.attr("epsilon", 1e-4))
+    ctx.out(
+        op_, "Loss",
+        -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps),
+    )
+
+
+@op("hinge_loss", grad="generic")
+def _hinge_loss(ctx, op_):
+    import jax.numpy as jnp
+
+    logits = ctx.in1(op_, "Logits")
+    labels = ctx.in1(op_, "Labels")
+    ctx.out(
+        op_, "Loss",
+        jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0),
+    )
+
+
+@op("bpr_loss", grad="generic")
+def _bpr_loss(ctx, op_):
+    """Bayesian personalized ranking (reference bpr_loss_op.cc):
+    loss[i] = -sum_{j != y_i} log(sigmoid(x[i,y_i] - x[i,j])) / (C-1)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C]
+    y = ctx.in1(op_, "Label").reshape(-1).astype(np.int32)
+    N, C = x.shape
+    xy = jnp.take_along_axis(x, y[:, None], axis=1)  # [N, 1]
+    diff = xy - x
+    logsig = -jnp.logaddexp(0.0, -diff)  # log(sigmoid(diff)), stable
+    mask = jnp.arange(C)[None, :] != y[:, None]
+    loss = -jnp.sum(jnp.where(mask, logsig, 0.0), axis=1) / (C - 1)
+    ctx.out(op_, "Y", loss[:, None])
+
+
+@op("rank_loss", grad="generic")
+def _rank_loss(ctx, op_):
+    import jax.numpy as jnp
+
+    label = ctx.in1(op_, "Label")
+    left = ctx.in1(op_, "Left")
+    right = ctx.in1(op_, "Right")
+    o = left - right
+    ctx.out(op_, "Out", jnp.logaddexp(0.0, o) - label * o)
+
+
+@op("margin_rank_loss", grad="generic")
+def _margin_rank_loss(ctx, op_):
+    import jax.numpy as jnp
+
+    label = ctx.in1(op_, "Label")
+    x1 = ctx.in1(op_, "X1")
+    x2 = ctx.in1(op_, "X2")
+    margin = float(op_.attr("margin", 0.0))
+    act = -label * (x1 - x2) + margin
+    out = jnp.maximum(act, 0.0)
+    ctx.out(op_, "Out", out)
+    ctx.out(op_, "Activated", (act > 0).astype(x1.dtype))
+
+
+@op("center_loss", grad="generic", stateful_inputs=("Centers",))
+def _center_loss(ctx, op_):
+    """reference: center_loss_op.cc — 0.5*||x - c_y||^2 plus in-op center
+    update when need_update."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, D]
+    y = ctx.in1(op_, "Label").reshape(-1).astype(np.int32)
+    centers = ctx.in1(op_, "Centers")  # [K, D]
+    rate = ctx.in1(op_, "CenterUpdateRate", optional=True)
+    need_update = bool(op_.attr("need_update", False))
+    cy = centers[y]
+    diff = x - cy
+    ctx.out(op_, "SampleCenterDiff", diff)
+    ctx.out(op_, "Loss", 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True))
+    if need_update and rate is not None:
+        # c_y -= rate * sum(diff over samples of class y) / (1 + count_y)
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[y].add(1.0)
+        sums = jnp.zeros_like(centers).at[y].add(diff)
+        upd = sums / (1.0 + counts[:, None])
+        new_centers = centers - jnp.asarray(rate).reshape(()) * upd
+        ctx.out(op_, "CentersOut", new_centers)
+    else:
+        ctx.out(op_, "CentersOut", centers)
+
+
+@op("sigmoid_focal_loss", grad="generic")
+def _sigmoid_focal_loss(ctx, op_):
+    """reference: operators/detection/sigmoid_focal_loss_op.cc — per-class
+    focal loss with background label 0 and fg normalization."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C]
+    y = ctx.in1(op_, "Label").reshape(-1).astype(np.int32)  # [N], 0 = bg
+    fg = ctx.in1(op_, "FgNum")
+    gamma = float(op_.attr("gamma", 2.0))
+    alpha = float(op_.attr("alpha", 0.25))
+    N, C = x.shape
+    fgn = jnp.maximum(jnp.asarray(fg, x.dtype).reshape(()), 1.0)
+    # target[i, c] = 1 if y[i] == c+1
+    t = (y[:, None] == (jnp.arange(C)[None, :] + 1)).astype(x.dtype)
+    p = 1.0 / (1.0 + jnp.exp(-x))
+    ce_pos = -jnp.log(jnp.maximum(p, 1e-30))
+    ce_neg = -jnp.log(jnp.maximum(1.0 - p, 1e-30))
+    loss = t * alpha * ((1.0 - p) ** gamma) * ce_pos + \
+        (1.0 - t) * (1.0 - alpha) * (p ** gamma) * ce_neg
+    ctx.out(op_, "Out", loss / fgn)
+
+
+@op("cross_entropy2", grad="generic")
+def _cross_entropy2(ctx, op_):
+    """reference: cross_entropy_op.cc CrossEntropyOp2 — hard-label CE with
+    the matched probability as a side output."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C] probabilities
+    y = ctx.in1(op_, "Label").reshape(-1).astype(np.int32)
+    matched = jnp.take_along_axis(x, y[:, None], axis=1)
+    ctx.out(op_, "Y", -jnp.log(jnp.maximum(matched, 1e-30)))
+    ctx.out(op_, "MatchX", matched)
+    ctx.out(op_, "XShape", jnp.zeros((0,), x.dtype))
+
+
+@op("cvm", grad="generic")
+def _cvm(ctx, op_):
+    """reference: cvm_op.cc — continuous-value-model feature transform on
+    the leading (show, click) columns."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, D], cols 0/1 = show/click
+    use_cvm = bool(op_.attr("use_cvm", True))
+    if use_cvm:
+        show = jnp.log(x[:, :1] + 1.0)
+        ctr = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, :1] + 1.0)
+        ctx.out(op_, "Y", jnp.concatenate([show, ctr, x[:, 2:]], axis=1))
+    else:
+        ctx.out(op_, "Y", x[:, 2:])
+
+
+@op("warpctc", grad="generic")
+def _warpctc(ctx, op_):
+    """CTC loss (reference warpctc_op.cc, external WarpCTC library).
+    TPU-native: log-space forward algorithm over the blank-interleaved label
+    sequence as one lax.scan — XLA fuses the whole recursion; the gradient
+    is jax.vjp of the scan (no hand-written backward).
+
+    Inputs (padded representation): Logits [B, T, C] (pre-softmax),
+    Label [B, L] with companion lengths; attrs blank, norm_by_times.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    logits = ctx.in1(op_, "Logits")
+    labels = ctx.in1(op_, "Label").astype(np.int32)
+    if labels.ndim == 3:
+        labels = labels[:, :, 0]
+    if logits.ndim == 2:
+        logits = logits[None]
+    blank = int(op_.attr("blank", 0))
+    lg_names = op_.inputs.get("Logits") or []
+    lb_names = op_.inputs.get("Label") or []
+    logit_lens = ctx.get_opt(lg_names[0] + "@SEQ_LEN") if lg_names else None
+    label_lens = ctx.get_opt(lb_names[0] + "@SEQ_LEN") if lb_names else None
+    B, T, C = logits.shape
+    L = labels.shape[1]
+    if logit_lens is None:
+        logit_lens = jnp.full((B,), T, jnp.int32)
+    if label_lens is None:
+        label_lens = jnp.full((B,), L, jnp.int32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, np.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_lens[:, None] + 1)
+    NEG = jnp.asarray(-1e30, logp.dtype)
+
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    has1 = label_lens > 0
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(has1, jnp.take_along_axis(
+            logp[:, 0, :], ext[:, 1:2], axis=1
+        )[:, 0], NEG)
+    )
+
+    def step(alpha, t):
+        prev1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=-1e30)[:, :S]
+        prev2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=-1e30)[:, :S]
+        acc = jnp.logaddexp(alpha, prev1)
+        acc = jnp.where(can_skip, jnp.logaddexp(acc, prev2), acc)
+        emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+        new = jnp.where(ext_valid, acc + emit, NEG)
+        # frames past the logit length freeze alpha
+        live = (t < logit_lens)[:, None]
+        new = jnp.where(live, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end = 2 * label_lens  # final blank index
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.where(
+        label_lens > 0,
+        jnp.take_along_axis(
+            alpha, jnp.maximum(end - 1, 0)[:, None], axis=1
+        )[:, 0],
+        NEG,
+    )
+    loglik = jnp.logaddexp(a_end, a_end1)
+    loss = -loglik
+    if bool(op_.attr("norm_by_times", False)):
+        loss = loss / logit_lens.astype(loss.dtype)
+    ctx.out(op_, "Loss", loss[:, None])
+    ctx.out(op_, "WarpCTCGrad", jnp.zeros_like(logits))

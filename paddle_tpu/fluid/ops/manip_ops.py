@@ -1,0 +1,429 @@
+"""Tensor-manipulation op batch: indexing, slicing, layout shuffles, norms.
+
+Reference kernels: paddle/fluid/operators/gather_nd_op.cc, scatter_nd_op.cc
+(scatter_nd_add_op), strided_slice_op.cc, expand_as_op.cc, multiplex_op.cc,
+crop_op.cc, crop_tensor_op.cc, pad_constant_like_op.cc, unique_op.cc,
+unique_with_counts_op.cc, shard_index_op.cc, space_to_depth_op.cc,
+pixel_shuffle_op.cc, shuffle_channel_op.cc, temporal_shift_op.cc,
+minus_op.cc, selu_op.cc, norm_op.cc, l1_norm_op.cc, affine_channel_op.cc,
+conv_shift_op.cc, spectral_norm_op.cc, grid_sampler_op.cc.
+
+All compiled XLA rules except unique/unique_with_counts, which have
+data-dependent output shapes and therefore run as host ops (the reference
+only ships CPU kernels for them either — unique_op.cc registers CPU only).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import (
+    SkipInferShape,
+    in_var,
+    op,
+    register_op,
+    same_shape_infer,
+    set_out,
+)
+
+
+# -- indexing ---------------------------------------------------------------
+def _gather_nd_infer(op_, block):
+    x = in_var(op_, block, "X")
+    idx = in_var(op_, block, "Index")
+    if x is None or idx is None:
+        raise SkipInferShape()
+    k = int(idx.shape[-1])
+    set_out(op_, block, "Out", tuple(idx.shape[:-1]) + tuple(x.shape[k:]),
+            x.dtype)
+
+
+@op("gather_nd", infer_shape=_gather_nd_infer, grad="generic")
+def _gather_nd(ctx, op_):
+    x = ctx.in1(op_, "X")
+    idx = ctx.in1(op_, "Index").astype(np.int32)
+    ctx.out(op_, "Out", x[tuple(idx[..., i] for i in range(idx.shape[-1]))])
+
+
+def _scatter_nd_add_infer(op_, block):
+    x = in_var(op_, block, "X")
+    if x is None:
+        raise SkipInferShape()
+    set_out(op_, block, "Out", x.shape, x.dtype)
+
+
+@op("scatter_nd_add", infer_shape=_scatter_nd_add_infer, grad="generic")
+def _scatter_nd_add(ctx, op_):
+    x = ctx.in1(op_, "X")
+    idx = ctx.in1(op_, "Index").astype(np.int32)
+    upd = ctx.in1(op_, "Updates")
+    ix = tuple(idx[..., i] for i in range(idx.shape[-1]))
+    ctx.out(op_, "Out", x.at[ix].add(upd))
+
+
+@op("scatter_nd", grad="generic")
+def _scatter_nd(ctx, op_):
+    import jax.numpy as jnp
+
+    idx = ctx.in1(op_, "Index").astype(np.int32)
+    upd = ctx.in1(op_, "Updates")
+    shape = [int(s) for s in op_.attr("shape")]
+    zeros = jnp.zeros(shape, upd.dtype)
+    ix = tuple(idx[..., i] for i in range(idx.shape[-1]))
+    ctx.out(op_, "Out", zeros.at[ix].add(upd))
+
+
+@op("strided_slice", grad="generic")
+def _strided_slice(ctx, op_):
+    x = ctx.in1(op_, "Input")
+    axes = [int(a) for a in op_.attr("axes")]
+    starts = [int(s) for s in op_.attr("starts")]
+    ends = [int(e) for e in op_.attr("ends")]
+    strides = [int(s) for s in (op_.attr("strides") or [1] * len(axes))]
+    sl = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        sl[a] = slice(s, e, st)
+    ctx.out(op_, "Out", x[tuple(sl)])
+
+
+@op("expand_as", grad="generic")
+def _expand_as(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "target_tensor", optional=True)
+    if y is None:
+        y = ctx.in1(op_, "Y")
+    reps = [t // s for t, s in zip(y.shape, x.shape)]
+    ctx.out(op_, "Out", jnp.tile(x, reps))
+
+
+@op("multiplex", grad="generic")
+def _multiplex(ctx, op_):
+    import jax.numpy as jnp
+
+    ids = ctx.in1(op_, "Ids").reshape(-1).astype(np.int32)
+    xs = jnp.stack(ctx.ins(op_, "X"), axis=0)  # [K, B, ...]
+    b = jnp.arange(xs.shape[1])
+    ctx.out(op_, "Out", xs[ids, b])
+
+
+# -- cropping / padding -----------------------------------------------------
+def _static_ints(v):
+    """Concrete (non-traced) tensor -> list of python ints, else None."""
+    import jax
+
+    if v is None or isinstance(v, jax.core.Tracer):
+        return None
+    return [int(s) for s in np.asarray(v).ravel()]
+
+
+@op("crop", grad="generic")
+def _crop(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "X")
+    offsets_t = ctx.in1(op_, "Offsets", optional=True)
+    if offsets_t is not None:
+        # traced offsets are fine: lax.dynamic_slice takes traced scalars
+        offsets = [offsets_t.reshape(-1)[i] for i in range(x.ndim)]
+    else:
+        offsets = [int(v) for v in (op_.attr("offsets") or [0] * x.ndim)]
+    y = ctx.in1(op_, "Y", optional=True)
+    shape = list(y.shape) if y is not None else [
+        int(s) for s in op_.attr("shape")
+    ]
+    ctx.out(op_, "Out", lax.dynamic_slice(x, offsets, shape))
+
+
+@op("crop_tensor", grad="generic")
+def _crop_tensor(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "X")
+    shape_t = ctx.in1(op_, "Shape", optional=True)
+    if shape_t is not None:
+        shape = _static_ints(shape_t)
+        if shape is None:
+            raise NotImplementedError(
+                "crop_tensor: a traced Shape tensor implies a dynamic "
+                "output shape, which XLA cannot compile; pass the shape "
+                "attr or a constant Shape"
+            )
+    else:
+        shape = [int(s) for s in op_.attr("shape")]
+    off_t = ctx.in1(op_, "Offsets", optional=True)
+    if off_t is not None:
+        offsets = [off_t.reshape(-1)[i] for i in range(x.ndim)]
+        if any(s == -1 for s in shape):
+            static_off = _static_ints(off_t)
+            if static_off is None:
+                raise NotImplementedError(
+                    "crop_tensor: shape -1 with traced Offsets is dynamic"
+                )
+            shape = [
+                x.shape[i] - static_off[i] if s == -1 else s
+                for i, s in enumerate(shape)
+            ]
+    else:
+        offsets = [int(v) for v in (op_.attr("offsets") or [0] * x.ndim)]
+        # -1 extends to the end of the dim (reference crop_tensor_op.cc)
+        shape = [
+            x.shape[i] - offsets[i] if s == -1 else s
+            for i, s in enumerate(shape)
+        ]
+    ctx.out(op_, "Out", lax.dynamic_slice(x, offsets, shape))
+
+
+@op("pad_constant_like", grad="generic")
+def _pad_constant_like(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # the large target-shaped tensor
+    y = ctx.in1(op_, "Y")  # the tensor to pad up to X's shape
+    pad_value = float(op_.attr("pad_value", 0.0))
+    pads = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    ctx.out(op_, "Out", jnp.pad(y, pads, constant_values=pad_value))
+
+
+# -- data-dependent-shape ops (host, like the reference's CPU-only kernels) -
+def _unique_host(ctx, op_):
+    x = np.asarray(ctx.scope.get(op_.input("X")[0]))
+    out, index = np.unique(x, return_inverse=True)
+    ctx.scope.set(op_.output("Out")[0], out.astype(x.dtype))
+    names = op_.outputs.get("Index") or []
+    if names:
+        ctx.scope.set(names[0], index.reshape(x.shape).astype(np.int64))
+
+
+def _unique_with_counts_host(ctx, op_):
+    x = np.asarray(ctx.scope.get(op_.input("X")[0]))
+    out, index, counts = np.unique(
+        x, return_inverse=True, return_counts=True
+    )
+    ctx.scope.set(op_.output("Out")[0], out.astype(x.dtype))
+    ctx.scope.set(op_.output("Index")[0],
+                  index.reshape(x.shape).astype(np.int64))
+    ctx.scope.set(op_.output("Count")[0], counts.astype(np.int64))
+
+
+register_op("unique", lower=_unique_host, host=True)
+register_op("unique_with_counts", lower=_unique_with_counts_host, host=True)
+
+
+@op("shard_index")
+def _shard_index(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    index_num = int(op_.attr("index_num"))
+    nshards = int(op_.attr("nshards"))
+    shard_id = int(op_.attr("shard_id"))
+    ignore_value = int(op_.attr("ignore_value", -1))
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    ctx.out(
+        op_, "Out",
+        jnp.where(in_shard, x % shard_size,
+                  jnp.full_like(x, ignore_value)),
+    )
+
+
+# -- layout shuffles --------------------------------------------------------
+@op("space_to_depth", grad="generic")
+def _space_to_depth(ctx, op_):
+    x = ctx.in1(op_, "X")  # NCHW
+    bs = int(op_.attr("blocksize"))
+    N, C, H, W = x.shape
+    out = x.reshape(N, C, H // bs, bs, W // bs, bs)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    ctx.out(op_, "Out", out.reshape(N, C * bs * bs, H // bs, W // bs))
+
+
+@op("pixel_shuffle", grad="generic")
+def _pixel_shuffle(ctx, op_):
+    x = ctx.in1(op_, "X")  # NCHW
+    r = int(op_.attr("upscale_factor"))
+    N, C, H, W = x.shape
+    out = x.reshape(N, C // (r * r), r, r, H, W)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    ctx.out(op_, "Out", out.reshape(N, C // (r * r), H * r, W * r))
+
+
+@op("shuffle_channel", grad="generic")
+def _shuffle_channel(ctx, op_):
+    x = ctx.in1(op_, "X")  # NCHW
+    g = int(op_.attr("group"))
+    N, C, H, W = x.shape
+    out = x.reshape(N, g, C // g, H, W).transpose(0, 2, 1, 3, 4)
+    ctx.out(op_, "Out", out.reshape(N, C, H, W))
+
+
+@op("temporal_shift", grad="generic")
+def _temporal_shift(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N*T, C, H, W]
+    T = int(op_.attr("seg_num"))
+    ratio = float(op_.attr("shift_ratio", 0.25))
+    NT, C, H, W = x.shape
+    N = NT // T
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    xt = x.reshape(N, T, C, H, W)
+    back = jnp.concatenate(
+        [xt[:, 1:, :c1], jnp.zeros_like(xt[:, :1, :c1])], axis=1
+    )
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xt[:, :1, c1:c2]), xt[:, :-1, c1:c2]], axis=1
+    )
+    out = jnp.concatenate([back, fwd, xt[:, :, c2:]], axis=2)
+    ctx.out(op_, "Out", out.reshape(NT, C, H, W))
+
+
+# -- arithmetic / norms -----------------------------------------------------
+@op("minus", infer_shape=same_shape_infer("X"), grad="generic")
+def _minus(ctx, op_):
+    ctx.out(op_, "Out", ctx.in1(op_, "X") - ctx.in1(op_, "Y"))
+
+
+@op("selu", infer_shape=same_shape_infer("X"), grad="generic")
+def _selu(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    scale = float(op_.attr("scale", 1.0507009873554805))
+    alpha = float(op_.attr("alpha", 1.6732632423543772))
+    ctx.out(
+        op_, "Out",
+        scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)),
+    )
+
+
+@op("norm", grad="generic")
+def _norm(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    axis = int(op_.attr("axis", -1))
+    eps = float(op_.attr("epsilon", 1e-10))
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    ctx.out(op_, "Out", x / norm)
+    ctx.out(op_, "Norm", norm)
+
+
+@op("l1_norm", grad="generic")
+def _l1_norm(ctx, op_):
+    import jax.numpy as jnp
+
+    ctx.out(op_, "Out", jnp.sum(jnp.abs(ctx.in1(op_, "X"))).reshape(1))
+
+
+@op("affine_channel", grad="generic")
+def _affine_channel(ctx, op_):
+    x = ctx.in1(op_, "X")
+    scale = ctx.in1(op_, "Scale").reshape(-1)
+    bias = ctx.in1(op_, "Bias").reshape(-1)
+    layout = op_.attr("data_layout", "NCHW")
+    if layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    ctx.out(op_, "Out", x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@op("conv_shift", grad="generic")
+def _conv_shift(ctx, op_):
+    """Circular correlation (reference conv_shift_op.cc):
+    out[b, i] = sum_j x[b, (i + j - W//2) mod N] * y[b, j]."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, N]
+    y = ctx.in1(op_, "Y")  # [B, W], W odd
+    B, N = x.shape
+    W = y.shape[1]
+    half = W // 2
+    out = jnp.zeros_like(x)
+    i = jnp.arange(N)
+    for j in range(W):
+        src = (i + j - half) % N
+        out = out + x[:, src] * y[:, j:j + 1]
+    ctx.out(op_, "Out", out)
+
+
+@op("spectral_norm", grad="generic", stateful_inputs=("U", "V"))
+def _spectral_norm(ctx, op_):
+    """reference: spectral_norm_op.cc — weight / sigma_max estimated by
+    power iteration on (U, V). The reference updates the persistable U/V
+    tensors in place each forward so the iteration converges across steps;
+    here the updated vectors are written back to the input names (the
+    executor persists stateful-input writes)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    w = ctx.in1(op_, "Weight")
+    u = ctx.in1(op_, "U").reshape(-1)
+    v = ctx.in1(op_, "V").reshape(-1)
+    dim = int(op_.attr("dim", 0))
+    power_iters = int(op_.attr("power_iters", 1))
+    eps = float(op_.attr("eps", 1e-12))
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def _l2(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    def body(_, uv):
+        u_, v_ = uv
+        v_ = _l2(wm.T @ u_)
+        u_ = _l2(wm @ v_)
+        return (u_, v_)
+
+    if power_iters > 0:
+        u, v = lax.fori_loop(0, power_iters, body, (u, v))
+        u_name = (op_.inputs.get("U") or [None])[0]
+        v_name = (op_.inputs.get("V") or [None])[0]
+        if u_name:
+            ctx.set(u_name, lax.stop_gradient(u))
+        if v_name:
+            ctx.set(v_name, lax.stop_gradient(v))
+    sigma = u @ (wm @ v)
+    ctx.out(op_, "Out", w / sigma)
+
+
+@op("grid_sampler", grad="generic")
+def _grid_sampler(ctx, op_):
+    """reference: grid_sampler_op.cc — bilinear sampling of X (NCHW) at
+    normalized [-1,1] grid locations."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C, H, W]
+    grid = ctx.in1(op_, "Grid")  # [N, Ho, Wo, 2] (x, y) in [-1, 1]
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    # gather per corner: [N, Ho, Wo] index maps; advanced indexing around
+    # the channel slice puts the index axes in front -> [N, Ho, Wo, C]
+    def gather(yi, xi):
+        ok = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+        xi_c = jnp.clip(xi, 0, W - 1).astype(np.int32)
+        yi_c = jnp.clip(yi, 0, H - 1).astype(np.int32)
+        b = jnp.arange(N).reshape(N, 1, 1)
+        v = x[b, :, yi_c, xi_c]  # [N, Ho, Wo, C]
+        return v * ok[..., None].astype(x.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    w00 = ((1 - wy) * (1 - wx))[..., None]
+    w01 = ((1 - wy) * wx)[..., None]
+    w10 = (wy * (1 - wx))[..., None]
+    w11 = (wy * wx)[..., None]
+    out = v00 * w00 + v01 * w01 + v10 * w10 + v11 * w11
+    ctx.out(op_, "Output", out.transpose(0, 3, 1, 2))

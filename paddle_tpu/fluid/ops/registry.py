@@ -377,13 +377,17 @@ def _generic_grad_lower(ctx, op):
 
     def fwd_fn(*vals):
         env = dict()
-        # base: all forward inputs from the outer env
+        # base: all forward inputs from the outer env (+ their `@SEQ_LEN`
+        # ragged-length companions, which sequence-op rules mask with)
         for slot, names in fwd_inputs.items():
             for n in names:
                 if n != EMPTY_VAR:
                     v = ctx.get_opt(n)
                     if v is not None:
                         env[n] = v
+                    lv = ctx.get_opt(n + "@SEQ_LEN")
+                    if lv is not None:
+                        env[n + "@SEQ_LEN"] = lv
         for (slot, idx, _), v in zip(wrt, vals):
             env[fwd_inputs[slot][idx]] = v
         # block threads through so ops with sub-blocks (recurrent,

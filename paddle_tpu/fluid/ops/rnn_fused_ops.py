@@ -1,0 +1,430 @@
+"""Fused RNN ops (lstm/lstmp/gru/gru_unit/lstm_unit) and 3D conv/pool.
+
+Reference kernels: paddle/fluid/operators/lstm_op.cc (+math/detail/
+lstm_kernel.h: gate layout [candidate, input, forget, output], peepholes on
+i/f from prev cell, on o from new cell), lstmp_op.cc (projection),
+gru_op.cc (+math/detail/gru_kernel.h: gate layout [update, reset,
+candidate]; origin_mode switches h = u*h_prev + (1-u)*c vs
+h = (1-u)*h_prev + u*c), gru_unit_op.cc, lstm_unit_op.cc (gate layout
+[i, f, o, g] with forget_bias), conv3d (conv_op.cc NCDHW), pool3d
+(pool_op.cc), conv3d_transpose, trilinear_interp_op.cc.
+
+TPU-native: each whole recurrence is ONE lax.scan over time — XLA keeps the
+[B, 4D] gate matmuls on the MXU and fuses the elementwise cell math; padded
+tails freeze the carry (the reference's LoD batch reordering is replaced by
+masking). Gradients via jax.vjp of the scan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import SkipInferShape, in_var, op, register_op, set_out
+
+
+def _act(name):
+    import jax
+    import jax.numpy as jnp
+
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda v: v,
+        "linear": lambda v: v,
+    }[name or "tanh"]
+
+
+def _seq_lens(ctx, op_, slot, B, T):
+    import jax.numpy as jnp
+
+    names = op_.inputs.get(slot) or []
+    lens = ctx.get_opt(names[0] + "@SEQ_LEN") if names else None
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    return lens
+
+
+def _lstm_impl(ctx, op_, with_projection):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [B, T, 4D] (x @ Wx + b precomputed outside)
+    w = ctx.in1(op_, "Weight")  # [D or P, 4D] hidden-to-hidden
+    bias = ctx.in1(op_, "Bias", optional=True)  # [1, 4D] or [1, 7D]
+    h0 = ctx.in1(op_, "H0", optional=True)
+    c0 = ctx.in1(op_, "C0", optional=True)
+    B, T = x.shape[0], x.shape[1]
+    D = x.shape[2] // 4
+    is_reverse = bool(op_.attr("is_reverse", False))
+    use_peepholes = bool(op_.attr("use_peepholes", False))
+    act_gate = _act(op_.attr("gate_activation", "sigmoid"))
+    act_cell = _act(op_.attr("cell_activation", "tanh"))
+    act_cand = _act(op_.attr("candidate_activation", "tanh"))
+    lens = _seq_lens(ctx, op_, "Input", B, T)
+
+    gate_bias = None
+    checkI = checkF = checkO = 0.0
+    if bias is not None:
+        b = bias.reshape(-1)
+        gate_bias = b[: 4 * D]
+        if use_peepholes and b.shape[0] >= 7 * D:
+            checkI = b[4 * D:5 * D]
+            checkF = b[5 * D:6 * D]
+            checkO = b[6 * D:7 * D]
+
+    if with_projection:
+        proj_w = ctx.in1(op_, "ProjWeight")  # [D, P]
+        P = proj_w.shape[1]
+        act_proj = _act(op_.attr("proj_activation", "tanh"))
+        h_init = h0 if h0 is not None else jnp.zeros((B, P), x.dtype)
+    else:
+        h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
+
+    if is_reverse:
+        # process each sequence back-to-front over its VALID prefix:
+        # flip the valid window per row, run forward, flip back
+        from .sequence_ops import reverse_valid_prefix
+
+        x = reverse_valid_prefix(x, lens)
+    xt_seq = jnp.swapaxes(x, 0, 1)  # [T, B, 4D]
+    tidx = jnp.arange(T)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, t = inp
+        gates = xt + h_prev @ w
+        if gate_bias is not None:
+            gates = gates + gate_bias
+        cand = act_cand(gates[:, :D])
+        ig = act_gate(gates[:, D:2 * D] + c_prev * checkI)
+        fg = act_gate(gates[:, 2 * D:3 * D] + c_prev * checkF)
+        c_new = cand * ig + fg * c_prev
+        og = act_gate(gates[:, 3 * D:] + c_new * checkO)
+        state_atv = act_cell(c_new)
+        h_new = og * state_atv
+        if with_projection:
+            h_new = act_proj(h_new @ proj_w)
+        live = (t < lens)[:, None]
+        h_new = jnp.where(live, h_new, h_prev)
+        c_new = jnp.where(live, c_new, c_prev)
+        out_h = jnp.where(live, h_new, jnp.zeros_like(h_new))
+        out_c = jnp.where(live, c_new, jnp.zeros_like(c_new))
+        return (h_new, c_new), (out_h, out_c, gates)
+
+    (_, _), (hs, cs, gates) = lax.scan(
+        step, (h_init, c_init), (xt_seq, tidx)
+    )
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        from .sequence_ops import reverse_valid_prefix
+
+        hidden = reverse_valid_prefix(hidden, lens)
+        cell = reverse_valid_prefix(cell, lens)
+    if with_projection:
+        ctx.out(op_, "Projection", hidden)
+    else:
+        ctx.out(op_, "Hidden", hidden)
+    ctx.out(op_, "Cell", cell)
+    ctx.out(op_, "BatchGate", jnp.swapaxes(gates, 0, 1))
+    ctx.out(op_, "BatchCellPreAct", cell)
+    out_slot = "Projection" if with_projection else "Hidden"
+    names = op_.outputs.get(out_slot) or []
+    if names:
+        ctx.set(names[0] + "@SEQ_LEN", lens)
+
+
+def _lstm_infer(op_, block):
+    x = in_var(op_, block, "Input")
+    if x is None or len(x.shape) != 3:
+        raise SkipInferShape()
+    B, T, D4 = x.shape
+    D = D4 // 4
+    set_out(op_, block, "Hidden", (B, T, D), x.dtype)
+    set_out(op_, block, "Cell", (B, T, D), x.dtype)
+
+
+@op("lstm", infer_shape=_lstm_infer, grad="generic")
+def _lstm(ctx, op_):
+    _lstm_impl(ctx, op_, with_projection=False)
+
+
+@op("lstmp", grad="generic")
+def _lstmp(ctx, op_):
+    _lstm_impl(ctx, op_, with_projection=True)
+
+
+@op("lstm_unit", grad="generic")
+def _lstm_unit(ctx, op_):
+    """One step; gate layout [i, f, o, g] (lstm_unit_op.h:63-71)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, 4D]
+    c_prev = ctx.in1(op_, "C_prev")
+    fb = float(op_.attr("forget_bias", 0.0))
+    D = x.shape[1] // 4
+    i = jax.nn.sigmoid(x[:, :D])
+    f = jax.nn.sigmoid(x[:, D:2 * D] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * D:3 * D])
+    g = jnp.tanh(x[:, 3 * D:])
+    c = f * c_prev + i * g
+    ctx.out(op_, "C", c)
+    ctx.out(op_, "H", o * jnp.tanh(c))
+
+
+def _gru_math(gates_xt, h_prev, w, D, act_gate, act_cand, origin_mode):
+    """One GRU step given xt pre-activations [B, 3D] and carry [B, D]
+    (gru_kernel.h gru_resetOutput/gru_finalOutput)."""
+    u = act_gate(gates_xt[:, :D] + h_prev @ w[:, :D])
+    r = act_gate(gates_xt[:, D:2 * D] + h_prev @ w[:, D:2 * D])
+    reset_h = r * h_prev
+    c = act_cand(gates_xt[:, 2 * D:] + reset_h @ w[:, 2 * D:])
+    if origin_mode:
+        h = u * h_prev + c - u * c
+    else:
+        h = h_prev - u * h_prev + u * c
+    return h, u, r, reset_h, c
+
+
+@op("gru", grad="generic")
+def _gru(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [B, T, 3D] (x @ Wx precomputed)
+    w = ctx.in1(op_, "Weight")  # [D, 3D]
+    bias = ctx.in1(op_, "Bias", optional=True)
+    h0 = ctx.in1(op_, "H0", optional=True)
+    B, T = x.shape[0], x.shape[1]
+    D = w.shape[0]
+    act_gate = _act(op_.attr("gate_activation", "sigmoid"))
+    act_cand = _act(op_.attr("activation", "tanh"))
+    origin_mode = bool(op_.attr("origin_mode", False))
+    is_reverse = bool(op_.attr("is_reverse", False))
+    lens = _seq_lens(ctx, op_, "Input", B, T)
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)
+    if is_reverse:
+        from .sequence_ops import reverse_valid_prefix
+
+        x = reverse_valid_prefix(x, lens)
+    h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    tidx = jnp.arange(T)
+
+    def step(h_prev, inp):
+        xt, t = inp
+        h, u, r, reset_h, c = _gru_math(
+            xt, h_prev, w, D, act_gate, act_cand, origin_mode
+        )
+        live = (t < lens)[:, None]
+        h = jnp.where(live, h, h_prev)
+        out_h = jnp.where(live, h, jnp.zeros_like(h))
+        return h, (out_h, reset_h)
+
+    _, (hs, resets) = lax.scan(step, h_init, (xt_seq, tidx))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        from .sequence_ops import reverse_valid_prefix
+
+        hidden = reverse_valid_prefix(hidden, lens)
+    ctx.out(op_, "Hidden", hidden)
+    ctx.out(op_, "BatchHidden", hidden)
+    ctx.out(op_, "BatchResetHiddenPrev", jnp.swapaxes(resets, 0, 1))
+    names = op_.outputs.get("Hidden") or []
+    if names:
+        ctx.set(names[0] + "@SEQ_LEN", lens)
+
+
+@op("gru_unit", grad="generic")
+def _gru_unit(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [B, 3D]
+    h_prev = ctx.in1(op_, "HiddenPrev")
+    w = ctx.in1(op_, "Weight")
+    bias = ctx.in1(op_, "Bias", optional=True)
+    D = w.shape[0]
+    # activation attrs are enum ints in the reference proto (gru_unit_op.cc):
+    # 0 identity, 1 sigmoid, 2 tanh, 3 relu
+    enum_map = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+    def act_of(v, default):
+        if isinstance(v, str) or v is None:
+            return _act(v or default)
+        return _act(enum_map.get(int(v), default))
+
+    act_gate = act_of(op_.attr("gate_activation", 1), "sigmoid")
+    act_cand = act_of(op_.attr("activation", 2), "tanh")
+    origin_mode = bool(op_.attr("origin_mode", False))
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    h, u, r, reset_h, c = _gru_math(
+        x, h_prev, w, D, act_gate, act_cand, origin_mode
+    )
+    ctx.out(op_, "Gate", jnp.concatenate([u, r, c], axis=1))
+    ctx.out(op_, "ResetHiddenPrev", reset_h)
+    ctx.out(op_, "Hidden", h)
+
+
+# ---------------------------------------------------------------------------
+# 3D conv / pool / interp
+# ---------------------------------------------------------------------------
+def _triple(v):
+    v = list(v) if isinstance(v, (list, tuple)) else [v]
+    return v * 3 if len(v) == 1 else v
+
+
+def _conv3d_lower(ctx, op_):
+    import jax.lax as lax
+
+    x = ctx.in1(op_, "Input")  # NCDHW
+    w = ctx.in1(op_, "Filter")  # OIDHW
+    strides = _triple(op_.attr("strides", [1, 1, 1]))
+    pads = _triple(op_.attr("paddings", [0, 0, 0]))
+    dil = _triple(op_.attr("dilations", [1, 1, 1]))
+    groups = int(op_.attr("groups", 1)) or 1
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+        preferred_element_type=x.dtype,
+    )
+    ctx.out(op_, "Output", out)
+
+
+register_op("conv3d", lower=_conv3d_lower, grad="generic")
+
+
+@op("conv3d_transpose", grad="generic")
+def _conv3d_transpose(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")
+    w = ctx.in1(op_, "Filter")  # [in_c, out_c/groups, kd, kh, kw]
+    strides = _triple(op_.attr("strides", [1, 1, 1]))
+    pads = _triple(op_.attr("paddings", [0, 0, 0]))
+    dil = _triple(op_.attr("dilations", [1, 1, 1]))
+    groups = int(op_.attr("groups", 1)) or 1
+    ks = w.shape[2:]
+    wk = jnp.flip(w, axis=(2, 3, 4))
+    wk = jnp.swapaxes(wk, 0, 1)
+    pad = [
+        (dil[i] * (ks[i] - 1) - pads[i], dil[i] * (ks[i] - 1) - pads[i])
+        for i in range(3)
+    ]
+    out = lax.conv_general_dilated(
+        x, wk,
+        window_strides=(1, 1, 1),
+        padding=pad,
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    ctx.out(op_, "Output", out)
+
+
+@op("pool3d", grad="generic")
+def _pool3d(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # NCDHW
+    ptype = op_.attr("pooling_type", "max")
+    if op_.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        ctx.out(op_, "Out", red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    ksize = _triple(op_.attr("ksize"))
+    strides = _triple(op_.attr("strides", [1, 1, 1]))
+    pads = _triple(op_.attr("paddings", [0, 0, 0]))
+    dims = (1, 1) + tuple(ksize)
+    strd = (1, 1) + tuple(strides)
+    padding = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if ptype == "max":
+        init = (
+            -jnp.inf
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min
+        )
+        out = lax.reduce_window(
+            x, np.asarray(init, x.dtype), lax.max, dims, strd, padding
+        )
+    else:
+        ssum = lax.reduce_window(
+            x, np.asarray(0, x.dtype), lax.add, dims, strd, padding
+        )
+        if op_.attr("exclusive", True):
+            cnt = lax.reduce_window(
+                jnp.ones_like(x), np.asarray(0, x.dtype), lax.add, dims,
+                strd, padding,
+            )
+            out = ssum / cnt
+        else:
+            out = ssum / float(ksize[0] * ksize[1] * ksize[2])
+    ctx.out(op_, "Out", out)
+
+
+@op("trilinear_interp", grad="generic")
+def _trilinear_interp(ctx, op_):
+    """reference: trilinear_interp (interpolate_op.cc) — NCDHW resize."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    N, C, D, H, W = x.shape
+    out_d = int(op_.attr("out_d", -1))
+    out_h = int(op_.attr("out_h", -1))
+    out_w = int(op_.attr("out_w", -1))
+    scale = op_.attr("scale", 0.0)
+    if out_d <= 0 and scale:
+        out_d, out_h, out_w = (
+            int(D * scale), int(H * scale), int(W * scale)
+        )
+    align = bool(op_.attr("align_corners", True))
+
+    def src_index(oi, osize, isize):
+        oi = oi.astype(x.dtype)
+        if align and osize > 1:
+            return oi * (isize - 1) / (osize - 1)
+        ratio = isize / osize
+        return jnp.maximum((oi + 0.5) * ratio - 0.5, 0.0)
+
+    dd = src_index(jnp.arange(out_d), out_d, D)
+    hh = src_index(jnp.arange(out_h), out_h, H)
+    ww = src_index(jnp.arange(out_w), out_w, W)
+
+    def axis_parts(v, size):
+        lo = jnp.clip(jnp.floor(v).astype(np.int32), 0, size - 1)
+        hi = jnp.clip(lo + 1, 0, size - 1)
+        frac = v - lo.astype(x.dtype)
+        return lo, hi, frac
+
+    d0, d1, fd = axis_parts(dd, D)
+    h0, h1, fh = axis_parts(hh, H)
+    w0, w1, fw = axis_parts(ww, W)
+
+    def gat(di, hi, wi):
+        return x[:, :, di[:, None, None], hi[None, :, None], wi[None, None, :]]
+
+    fd = fd[:, None, None]
+    fh = fh[None, :, None]
+    fw = fw[None, None, :]
+    out = (
+        gat(d0, h0, w0) * (1 - fd) * (1 - fh) * (1 - fw)
+        + gat(d0, h0, w1) * (1 - fd) * (1 - fh) * fw
+        + gat(d0, h1, w0) * (1 - fd) * fh * (1 - fw)
+        + gat(d0, h1, w1) * (1 - fd) * fh * fw
+        + gat(d1, h0, w0) * fd * (1 - fh) * (1 - fw)
+        + gat(d1, h0, w1) * fd * (1 - fh) * fw
+        + gat(d1, h1, w0) * fd * fh * (1 - fw)
+        + gat(d1, h1, w1) * fd * fh * fw
+    )
+    ctx.out(op_, "Out", out)

@@ -15,11 +15,57 @@ import numpy as np
 from .registry import op
 
 
+# ops whose listed output slot carries a `{name}@SEQ_LEN` companion in the
+# lowering env; the executor uses this to thread companions across segment
+# boundaries (see executor._seqlen_producers)
+SEQLEN_OUT_SLOTS = {
+    "sequence_pad": "Out",
+    "sequence_unpad": "Out",
+    "sequence_slice": "Out",
+    "sequence_reverse": "Y",
+    "sequence_erase": "Out",
+    "sequence_enumerate": "Out",
+    "sequence_conv": "Out",
+    "sequence_expand_as": "Out",
+    "lod_reset": "Out",
+    "row_conv": "Out",
+    "lstm": "Hidden",
+    "lstmp": "Projection",
+    "gru": "Hidden",
+}
+
+
+def reverse_valid_prefix(x, lengths):
+    """Reverse each row's valid prefix along the time dim (axis 1), keeping
+    padded tails in place; lengths None reverses the whole dim."""
+    import jax.numpy as jnp
+
+    t = jnp.arange(x.shape[1])
+    if lengths is None:
+        idx = jnp.broadcast_to(t[::-1][None, :], x.shape[:2])
+    else:
+        rev = lengths[:, None] - 1 - t[None, :]
+        idx = jnp.where(t[None, :] < lengths[:, None], rev, t[None, :])
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+
+
 def _lengths(ctx, op_, slot="X"):
     names = op_.inputs.get(slot) or []
     if not names:
         return None
     return ctx.get_opt(names[0] + "@SEQ_LEN")
+
+
+def _lengths_or_full(ctx, op_, x, slot="X"):
+    """Companion lengths, defaulting to the full padded time dim."""
+    import jax.numpy as jnp
+
+    lengths = _lengths(ctx, op_, slot)
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return lengths
 
 
 def _mask(x, lengths):
@@ -111,3 +157,336 @@ def _sequence_concat(ctx, op_):
 
     xs = ctx.ins(op_, "X")
     ctx.out(op_, "Out", jnp.concatenate(xs, axis=1))
+
+
+def _set_out_lengths(ctx, op_, lengths, slot="Out"):
+    """Propagate the companion length tensor to the output var."""
+    names = op_.outputs.get(slot) or []
+    if names and lengths is not None:
+        ctx.set(names[0] + "@SEQ_LEN", lengths)
+
+
+@op("sequence_pad", grad="generic")
+def _sequence_pad(ctx, op_):
+    """reference: operators/sequence_ops/sequence_pad_op.cc — LoD input +
+    PadValue -> dense [B, padded_len, ...] + Length. On the padded+lengths
+    representation the data is already dense; this masks the tail with
+    PadValue and emits Length."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    pad_value = ctx.in1(op_, "PadValue")
+    lengths = _lengths(ctx, op_)
+    padded_length = int(op_.attr("padded_length", -1))
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    m = _mask(x, lengths)
+    mexp = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    pv = jnp.broadcast_to(jnp.asarray(pad_value, x.dtype).reshape(
+        (1,) * (x.ndim - pad_value.ndim) + pad_value.shape
+        if pad_value.ndim and pad_value.size > 1 else (1,) * x.ndim
+    ), x.shape)
+    out = jnp.where(mexp, x, pv)
+    if padded_length > 0:
+        if padded_length < x.shape[1]:
+            out = out[:, :padded_length]
+        elif padded_length > x.shape[1]:
+            extra_shape = (
+                (x.shape[0], padded_length - x.shape[1]) + x.shape[2:]
+            )
+            out = jnp.concatenate(
+                [out, jnp.broadcast_to(pv[:, :1], extra_shape)], axis=1
+            )
+    ctx.out(op_, "Out", out)
+    ctx.out(op_, "Length", lengths.astype(np.int64))
+    _set_out_lengths(ctx, op_, lengths)
+
+
+@op("sequence_unpad", grad="generic")
+def _sequence_unpad(ctx, op_):
+    """reference: sequence_unpad_op.cc — padded + Length -> LoD. Here the
+    output stays dense; the Length input becomes the companion lengths the
+    downstream sequence ops mask with."""
+    x = ctx.in1(op_, "X")
+    lengths = ctx.in1(op_, "Length").reshape(-1).astype(np.int32)
+    m = _mask(x, lengths)
+    mexp = m.reshape(m.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+    ctx.out(op_, "Out", x * mexp)
+    _set_out_lengths(ctx, op_, lengths)
+
+
+@op("sequence_mask")
+def _sequence_mask(ctx, op_):
+    """reference: sequence_mask_op.cc."""
+    import jax
+    import jax.numpy as jnp
+
+    from .tensor_ops import _np_dtype
+
+    x = ctx.in1(op_, "X").reshape(-1)
+    maxlen = op_.attr("maxlen", -1)
+    ml = ctx.in1(op_, "MaxLenTensor", optional=True)
+    if ml is not None and not isinstance(ml, jax.core.Tracer):
+        maxlen = int(np.asarray(ml).ravel()[0])
+    if maxlen is None or int(maxlen) < 0:
+        # the reference sizes the mask by max(x) at run time — a dynamic
+        # shape XLA cannot compile; only concrete lengths allow it here
+        if isinstance(x, jax.core.Tracer):
+            raise NotImplementedError(
+                "sequence_mask needs a static maxlen attr (or concrete "
+                "lengths): dynamic max(x)-sized output can't compile to XLA"
+            )
+        maxlen = int(np.max(np.asarray(x)))
+    t = jnp.arange(int(maxlen))
+    m = t[None, :] < x[:, None]
+    dt = op_.attr("out_dtype", 5)
+    ctx.out(op_, "Y", m.astype(_np_dtype(dt)))
+
+
+@op("sequence_slice", grad="generic")
+def _sequence_slice(ctx, op_):
+    """reference: sequence_slice_op.cc — per-sequence [offset, offset+length)
+    subsequence. Padded rep: gather shifted time indices + remask."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, ...]
+    offset = ctx.in1(op_, "Offset").reshape(-1).astype(np.int32)
+    length = ctx.in1(op_, "Length").reshape(-1).astype(np.int32)
+    T = x.shape[1]
+    t = jnp.arange(T)
+    src = jnp.clip(offset[:, None] + t[None, :], 0, T - 1)
+    gathered = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+    m = (t[None, :] < length[:, None]).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 2)
+    )
+    ctx.out(op_, "Out", jnp.where(m, gathered, jnp.zeros_like(gathered)))
+    _set_out_lengths(ctx, op_, length)
+
+
+@op("sequence_reverse", grad="generic")
+def _sequence_reverse(ctx, op_):
+    """reference: sequence_reverse_op.cc — reverse the valid prefix of each
+    sequence, keep padding in place."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    lengths = _lengths(ctx, op_)
+    out_len = (
+        lengths if lengths is not None
+        else jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    )
+    ctx.out(op_, "Y", reverse_valid_prefix(x, lengths))
+    _set_out_lengths(ctx, op_, out_len, slot="Y")
+
+
+@op("sequence_erase")
+def _sequence_erase(ctx, op_):
+    """reference: sequence_erase_op.cc — drop listed tokens and compact each
+    sequence left (stable). Static-shape impl: stable argsort on the remove
+    flag keeps survivors in order at the front."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T] int tokens
+    squeeze_back = False
+    if x.ndim == 3 and x.shape[2] == 1:
+        x = x[:, :, 0]
+        squeeze_back = True
+    tokens = op_.attr("tokens") or []
+    lengths = _lengths(ctx, op_)
+    T = x.shape[1]
+    t = jnp.arange(T)
+    valid = (
+        t[None, :] < lengths[:, None]
+        if lengths is not None
+        else jnp.ones_like(x, dtype=bool)
+    )
+    remove = jnp.zeros_like(x, dtype=bool)
+    for tok in tokens:
+        remove = remove | (x == int(tok))
+    keep = valid & ~remove
+    # stable sort: kept tokens (key 0) first, in original order
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    out = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(np.int32)
+    out = jnp.where(t[None, :] < new_len[:, None], out, jnp.zeros_like(out))
+    if squeeze_back:
+        out = out[:, :, None]
+    ctx.out(op_, "Out", out)
+    _set_out_lengths(ctx, op_, new_len)
+
+
+@op("sequence_enumerate")
+def _sequence_enumerate(ctx, op_):
+    """reference: sequence_enumerate_op.cc — sliding windows of win_size,
+    positions past the sequence end filled with pad_value."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T] ids
+    squeeze_back = False
+    if x.ndim == 3 and x.shape[2] == 1:
+        x = x[:, :, 0]
+        squeeze_back = True
+    win = int(op_.attr("win_size"))
+    pad = int(op_.attr("pad_value", 0))
+    lengths = _lengths(ctx, op_)
+    B, T = x.shape
+    t = jnp.arange(T)
+    L = lengths[:, None] if lengths is not None else T
+    cols = []
+    for k in range(win):
+        src = jnp.clip(t + k, 0, T - 1)
+        v = x[:, src]
+        ok = (t[None, :] + k) < L
+        cols.append(jnp.where(ok, v, jnp.full_like(v, pad)))
+    out = jnp.stack(cols, axis=2)  # enumerate output is [B, T, win]
+    ctx.out(op_, "Out", out)
+    _set_out_lengths(ctx, op_, _lengths_or_full(ctx, op_, x))
+
+
+@op("sequence_conv", grad="generic")
+def _sequence_conv(ctx, op_):
+    """reference: sequence_conv_op.cc — context-window convolution over time:
+    rows of the im2col matrix [x_{t+start}, ..., x_{t+start+len-1}] * Filter.
+    Out-of-sequence context positions contribute zeros."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, D]
+    filt = ctx.in1(op_, "Filter")  # [context_length * D, M]
+    ctx_len = int(op_.attr("contextLength"))
+    ctx_start = int(op_.attr("contextStart", -((ctx_len - 1) // 2)))
+    lengths = _lengths(ctx, op_)
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)
+    L = lengths[:, None] if lengths is not None else T
+    pieces = []
+    for j in range(ctx_len):
+        shift = ctx_start + j
+        src = jnp.clip(t + shift, 0, T - 1)
+        v = x[:, src]
+        ok = ((t[None, :] + shift) >= 0) & ((t[None, :] + shift) < L)
+        pieces.append(jnp.where(ok[:, :, None], v, jnp.zeros_like(v)))
+    col = jnp.concatenate(pieces, axis=2)  # [B, T, ctx_len*D]
+    out = jnp.einsum("btk,km->btm", col, filt)
+    if lengths is not None:
+        m = _mask(out, lengths)[:, :, None].astype(out.dtype)
+        out = out * m
+    ctx.out(op_, "Out", out)
+    _set_out_lengths(ctx, op_, _lengths_or_full(ctx, op_, x))
+
+
+@op("sequence_expand_as", grad="generic")
+def _sequence_expand_as(ctx, op_):
+    """reference: sequence_expand_as_op.cc — expand each row of X along the
+    time dimension of Y."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    ylen = _lengths(ctx, op_, slot="Y")
+    if x.ndim == 2:  # [B, D] -> [B, T, D]
+        out = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
+    else:  # [B, 1, D] -> [B, T, D]
+        out = jnp.broadcast_to(x, (x.shape[0], y.shape[1]) + x.shape[2:])
+    if ylen is not None:
+        m = _mask(out, ylen)
+        out = out * m.reshape(m.shape + (1,) * (out.ndim - 2)).astype(out.dtype)
+    ctx.out(op_, "Out", out)
+    _set_out_lengths(ctx, op_, _lengths_or_full(ctx, op_, y, slot="Y"))
+
+
+@op("sequence_scatter", grad="generic")
+def _sequence_scatter(ctx, op_):
+    """reference: sequence_scatter_op.cc — per sequence i, X[i, ids] +=
+    updates over the sequence's tokens."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, D]
+    ids = ctx.in1(op_, "Ids").astype(np.int32)  # [B, S] (padded)
+    upd = ctx.in1(op_, "Updates")  # [B, S]
+    if ids.ndim == 3 and ids.shape[2] == 1:
+        ids = ids[:, :, 0]
+    if upd.ndim == 3 and upd.shape[2] == 1:
+        upd = upd[:, :, 0]
+    lengths = _lengths(ctx, op_, slot="Ids")
+    S = ids.shape[1]
+    if lengths is not None:
+        valid = jnp.arange(S)[None, :] < lengths[:, None]
+        upd = jnp.where(valid, upd, jnp.zeros_like(upd))
+    b = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], ids.shape)
+    out = x.at[b, ids].add(upd.astype(x.dtype))
+    ctx.out(op_, "Out", out)
+
+
+@op("lod_reset", grad="generic")
+def _lod_reset(ctx, op_):
+    """reference: lod_reset_op.cc — replace the LoD of X (data unchanged).
+    Here: replace the companion lengths from Y or the target_lod attr."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", x)
+    y = ctx.in1(op_, "Y", optional=True)
+    if y is not None:
+        lengths = jnp.asarray(y).reshape(-1).astype(np.int32)
+        _set_out_lengths(ctx, op_, lengths)
+        return
+    target = op_.attr("target_lod") or []
+    if target:
+        # offsets -> lengths
+        t = np.asarray(target, np.int64)
+        lengths = jnp.asarray((t[1:] - t[:-1]).astype(np.int32))
+        _set_out_lengths(ctx, op_, lengths)
+    else:
+        _set_out_lengths(ctx, op_, _lengths_or_full(ctx, op_, x))
+
+
+@op("im2sequence", grad="generic")
+def _im2sequence(ctx, op_):
+    """reference: im2sequence_op.cc — NCHW image -> [B, n_patches,
+    C*kh*kw] patch sequence (the conv-as-sequence trick)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, C, H, W]
+    kh, kw = [int(v) for v in op_.attr("kernels")]
+    strides = [int(v) for v in (op_.attr("strides") or [1, 1])]
+    pads = [int(v) for v in (op_.attr("paddings") or [0, 0, 0, 0])]
+    x = jnp.pad(
+        x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3]))
+    )
+    B, C, H, W = x.shape
+    oh = (H - kh) // strides[0] + 1
+    ow = (W - kw) // strides[1] + 1
+    patches = lax.conv_general_dilated_patches(
+        x.astype(jnp.float32),
+        (kh, kw),
+        tuple(strides),
+        "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [B, C*kh*kw, oh, ow]
+    out = patches.reshape(B, C * kh * kw, oh * ow).transpose(0, 2, 1)
+    ctx.out(op_, "Out", out.astype(x.dtype))
+
+
+@op("row_conv", grad="generic")
+def _row_conv(ctx, op_):
+    """reference: row_conv_op.cc — lookahead convolution
+    out[b,t] = sum_j x[b,t+j] * W[j] (future context only)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, D]
+    w = ctx.in1(op_, "Filter")  # [future_context + 1, D]
+    lengths = _lengths(ctx, op_)
+    T = x.shape[1]
+    t = jnp.arange(T)
+    L = lengths[:, None] if lengths is not None else T
+    out = jnp.zeros_like(x)
+    for j in range(w.shape[0]):
+        src = jnp.clip(t + j, 0, T - 1)
+        ok = (t[None, :] + j) < L
+        v = x[:, src] * w[j][None, None, :]
+        out = out + jnp.where(ok[:, :, None], v, jnp.zeros_like(v))
+    ctx.out(op_, "Out", out)
+    _set_out_lengths(ctx, op_, _lengths_or_full(ctx, op_, x))

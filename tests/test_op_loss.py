@@ -1,0 +1,261 @@
+"""Per-op tests for the loss-op batch (reference tests:
+test_kldiv_loss_op.py, test_log_loss_op.py, test_hinge_loss_op.py,
+test_bpr_loss_op.py, test_rank_loss_op.py, test_margin_rank_loss_op.py,
+test_center_loss.py, test_sigmoid_focal_loss_op.py, test_warpctc_op.py)."""
+
+import itertools
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestKLDivLoss(OpTest):
+    def setUp(self):
+        self.op_type = "kldiv_loss"
+        rs = np.random.RandomState(0)
+        x = np.log(rs.rand(4, 5).astype("float32") + 0.1)
+        t = rs.rand(4, 5).astype("float32")
+        loss = np.where(t > 0, t * (np.log(t) - x), 0.0)
+        self.inputs = {"X": x, "Target": t}
+        self.attrs = {"reduction": "mean"}
+        self.outputs = {"Loss": np.mean(loss).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Loss", max_relative_error=0.01)
+
+
+class TestLogLoss(OpTest):
+    def setUp(self):
+        self.op_type = "log_loss"
+        rs = np.random.RandomState(1)
+        p = rs.rand(6, 1).astype("float32") * 0.8 + 0.1
+        y = rs.randint(0, 2, (6, 1)).astype("float32")
+        eps = 1e-4
+        loss = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+        self.inputs = {"Predicted": p, "Labels": y}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Loss": loss.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Predicted"], "Loss", max_relative_error=0.01)
+
+
+class TestHingeLoss(OpTest):
+    def setUp(self):
+        self.op_type = "hinge_loss"
+        rs = np.random.RandomState(2)
+        logits = (rs.rand(5, 1).astype("float32") - 0.5) * 4
+        labels = rs.randint(0, 2, (5, 1)).astype("float32")
+        loss = np.maximum(1 - (2 * labels - 1) * logits, 0)
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.outputs = {"Loss": loss.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBprLoss(OpTest):
+    def setUp(self):
+        self.op_type = "bpr_loss"
+        rs = np.random.RandomState(3)
+        x = rs.rand(4, 5).astype("float32")
+        y = rs.randint(0, 5, (4, 1)).astype("int64")
+        loss = np.zeros((4, 1), "float32")
+        for i in range(4):
+            s = 0.0
+            for j in range(5):
+                if j != y[i, 0]:
+                    s += np.log(
+                        1.0 / (1.0 + np.exp(-(x[i, y[i, 0]] - x[i, j])))
+                    )
+            loss[i, 0] = -s / 4
+        self.inputs = {"X": x, "Label": y}
+        self.outputs = {"Y": loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Y", max_relative_error=0.01)
+
+
+class TestRankLoss(OpTest):
+    def setUp(self):
+        self.op_type = "rank_loss"
+        rs = np.random.RandomState(4)
+        label = rs.randint(0, 2, (5, 1)).astype("float32")
+        left = rs.rand(5, 1).astype("float32")
+        right = rs.rand(5, 1).astype("float32")
+        o = left - right
+        out = np.log(1 + np.exp(o)) - label * o
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Left", "Right"], "Out", max_relative_error=0.01)
+
+
+class TestMarginRankLoss(OpTest):
+    def setUp(self):
+        self.op_type = "margin_rank_loss"
+        rs = np.random.RandomState(5)
+        label = (rs.randint(0, 2, (5, 1)) * 2 - 1).astype("float32")
+        x1 = rs.rand(5, 1).astype("float32")
+        x2 = rs.rand(5, 1).astype("float32")
+        margin = 0.1
+        act = -label * (x1 - x2) + margin
+        self.inputs = {"Label": label, "X1": x1, "X2": x2}
+        self.attrs = {"margin": margin}
+        self.outputs = {
+            "Out": np.maximum(act, 0).astype("float32"),
+            "Activated": (act > 0).astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCenterLoss(OpTest):
+    def setUp(self):
+        self.op_type = "center_loss"
+        rs = np.random.RandomState(6)
+        x = rs.rand(4, 3).astype("float32")
+        y = np.array([0, 1, 0, 2], "int64")
+        centers = rs.rand(3, 3).astype("float32")
+        diff = x - centers[y]
+        loss = 0.5 * (diff * diff).sum(axis=1, keepdims=True)
+        self.inputs = {
+            "X": x, "Label": y, "Centers": centers,
+            "CenterUpdateRate": np.array([0.1], "float32"),
+        }
+        self.attrs = {"need_update": False}
+        self.outputs = {
+            "SampleCenterDiff": diff,
+            "Loss": loss,
+            "CentersOut": centers,
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSigmoidFocalLoss(OpTest):
+    def setUp(self):
+        self.op_type = "sigmoid_focal_loss"
+        rs = np.random.RandomState(7)
+        N, C = 4, 3
+        x = (rs.rand(N, C).astype("float32") - 0.5) * 2
+        y = rs.randint(0, C + 1, (N, 1)).astype("int64")
+        fg = np.array([max((y > 0).sum(), 1)], "int64")
+        gamma, alpha = 2.0, 0.25
+        p = 1 / (1 + np.exp(-x))
+        t = (y == np.arange(C)[None, :] + 1).astype("float32")
+        loss = (
+            t * alpha * (1 - p) ** gamma * (-np.log(np.maximum(p, 1e-30)))
+            + (1 - t) * (1 - alpha) * p ** gamma
+            * (-np.log(np.maximum(1 - p, 1e-30)))
+        ) / float(fg[0])
+        self.inputs = {"X": x, "Label": y, "FgNum": fg}
+        self.attrs = {"gamma": gamma, "alpha": alpha}
+        self.outputs = {"Out": loss.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestCrossEntropy2(OpTest):
+    def setUp(self):
+        self.op_type = "cross_entropy2"
+        rs = np.random.RandomState(8)
+        x = rs.rand(4, 5).astype("float32") + 0.1
+        x /= x.sum(axis=1, keepdims=True)
+        y = rs.randint(0, 5, (4, 1)).astype("int64")
+        matched = np.take_along_axis(x, y, axis=1)
+        self.inputs = {"X": x, "Label": y}
+        self.outputs = {"Y": -np.log(matched), "MatchX": matched}
+
+    def test_output(self):
+        self.check_output(no_check_set=["XShape"], atol=1e-5)
+
+
+class TestCvm(OpTest):
+    def setUp(self):
+        self.op_type = "cvm"
+        rs = np.random.RandomState(9)
+        x = rs.rand(3, 5).astype("float32") + 0.5
+        show = np.log(x[:, :1] + 1)
+        ctr = np.log(x[:, 1:2] + 1) - np.log(x[:, :1] + 1)
+        self.inputs = {"X": x}
+        self.attrs = {"use_cvm": True}
+        self.outputs = {
+            "Y": np.concatenate([show, ctr, x[:, 2:]], axis=1)
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def _ctc_brute_force(logp, label, blank=0):
+    """Sum path probabilities over all alignments (tiny cases only)."""
+    T, C = logp.shape
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(label):
+            total += np.exp(sum(logp[t, path[t]] for t in range(T)))
+    return -np.log(total)
+
+
+class TestWarpCTC(OpTest):
+    def setUp(self):
+        self.op_type = "warpctc"
+        rs = np.random.RandomState(10)
+        B, T, C, L = 2, 4, 3, 2
+        logits = rs.rand(B, T, C).astype("float32")
+        labels = np.array([[1, 2], [2, 0]], "int64")
+        label_lens = [2, 1]
+        logp = logits - np.log(
+            np.exp(logits).sum(axis=2, keepdims=True)
+        )
+        loss = np.array(
+            [
+                _ctc_brute_force(logp[0], [1, 2]),
+                _ctc_brute_force(logp[1], [2]),
+            ],
+            "float32",
+        )[:, None]
+        self.inputs = {
+            "Logits": logits,
+            "Label": (labels, [label_lens]),
+        }
+        self.attrs = {"blank": 0, "norm_by_times": False}
+        self.outputs = {"Loss": loss}
+
+    def test_output(self):
+        self.check_output(no_check_set=["WarpCTCGrad"], atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(
+            ["Logits"], "Loss", max_relative_error=0.03,
+            numeric_grad_delta=1e-3,
+        )

@@ -1,0 +1,264 @@
+"""Per-op tests for fused RNN ops and 3D conv/pool (reference tests:
+test_lstm_op.py, test_gru_op.py, test_gru_unit_op.py, test_lstm_unit_op.py,
+test_conv3d_op.py, test_pool3d_op.py, test_trilinear_interp_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _lstm_oracle(x, w, bias, lens, D):
+    """Gate layout [cand, i, f, o] (math/detail/lstm_kernel.h)."""
+    B, T, _ = x.shape
+    h = np.zeros((B, D), "float64")
+    c = np.zeros((B, D), "float64")
+    hs = np.zeros((B, T, D), "float64")
+    cs = np.zeros((B, T, D), "float64")
+    for t in range(T):
+        gates = x[:, t].astype("float64") + h @ w.astype("float64")
+        if bias is not None:
+            gates = gates + bias.reshape(-1)[: 4 * D]
+        cand = np.tanh(gates[:, :D])
+        i = _sigmoid(gates[:, D:2 * D])
+        f = _sigmoid(gates[:, 2 * D:3 * D])
+        c_new = cand * i + f * c
+        o = _sigmoid(gates[:, 3 * D:])
+        h_new = o * np.tanh(c_new)
+        live = (t < np.asarray(lens))[:, None]
+        h = np.where(live, h_new, h)
+        c = np.where(live, c_new, c)
+        hs[:, t] = np.where(live, h_new, 0.0)
+        cs[:, t] = np.where(live, c_new, 0.0)
+    return hs, cs
+
+
+class TestLstm(OpTest):
+    def setUp(self):
+        self.op_type = "lstm"
+        rs = np.random.RandomState(0)
+        B, T, D = 2, 4, 3
+        x = (rs.rand(B, T, 4 * D).astype("float32") - 0.5)
+        w = (rs.rand(D, 4 * D).astype("float32") - 0.5)
+        bias = (rs.rand(1, 4 * D).astype("float32") - 0.5)
+        lens = [4, 2]
+        hs, cs = _lstm_oracle(x, w, bias, lens, D)
+        self.inputs = {"Input": (x, [lens]), "Weight": w, "Bias": bias}
+        self.attrs = {
+            "use_peepholes": False,
+            "gate_activation": "sigmoid",
+            "cell_activation": "tanh",
+            "candidate_activation": "tanh",
+        }
+        self.outputs = {
+            "Hidden": hs.astype("float32"),
+            "Cell": cs.astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output(
+            no_check_set=["BatchGate", "BatchCellPreAct"], atol=1e-5
+        )
+
+    def test_grad(self):
+        self.check_grad(
+            ["Input", "Weight"], "Hidden", max_relative_error=0.02
+        )
+
+
+def _gru_oracle(x, w, bias, lens, D, origin_mode=False):
+    B, T, _ = x.shape
+    h = np.zeros((B, D), "float64")
+    hs = np.zeros((B, T, D), "float64")
+    for t in range(T):
+        xt = x[:, t].astype("float64")
+        if bias is not None:
+            xt = xt + bias.reshape(-1)
+        u = _sigmoid(xt[:, :D] + h @ w[:, :D].astype("float64"))
+        r = _sigmoid(xt[:, D:2 * D] + h @ w[:, D:2 * D].astype("float64"))
+        c = np.tanh(xt[:, 2 * D:] + (r * h) @ w[:, 2 * D:].astype("float64"))
+        if origin_mode:
+            h_new = u * h + (1 - u) * c
+        else:
+            h_new = (1 - u) * h + u * c
+        live = (t < np.asarray(lens))[:, None]
+        h = np.where(live, h_new, h)
+        hs[:, t] = np.where(live, h_new, 0.0)
+    return hs
+
+
+class TestGru(OpTest):
+    def setUp(self):
+        self.op_type = "gru"
+        rs = np.random.RandomState(1)
+        B, T, D = 2, 4, 3
+        x = (rs.rand(B, T, 3 * D).astype("float32") - 0.5)
+        w = (rs.rand(D, 3 * D).astype("float32") - 0.5)
+        bias = (rs.rand(1, 3 * D).astype("float32") - 0.5)
+        lens = [3, 4]
+        hs = _gru_oracle(x, w, bias, lens, D)
+        self.inputs = {"Input": (x, [lens]), "Weight": w, "Bias": bias}
+        self.attrs = {
+            "gate_activation": "sigmoid",
+            "activation": "tanh",
+            "origin_mode": False,
+        }
+        self.outputs = {"Hidden": hs.astype("float32")}
+
+    def test_output(self):
+        self.check_output(
+            no_check_set=["BatchHidden", "BatchResetHiddenPrev"], atol=1e-5
+        )
+
+    def test_grad(self):
+        self.check_grad(
+            ["Input", "Weight"], "Hidden", max_relative_error=0.02
+        )
+
+
+class TestGruUnit(OpTest):
+    def setUp(self):
+        self.op_type = "gru_unit"
+        rs = np.random.RandomState(2)
+        B, D = 3, 4
+        x = (rs.rand(B, 3 * D).astype("float32") - 0.5)
+        h_prev = (rs.rand(B, D).astype("float32") - 0.5)
+        w = (rs.rand(D, 3 * D).astype("float32") - 0.5)
+        u = _sigmoid(x[:, :D] + h_prev @ w[:, :D])
+        r = _sigmoid(x[:, D:2 * D] + h_prev @ w[:, D:2 * D])
+        c = np.tanh(x[:, 2 * D:] + (r * h_prev) @ w[:, 2 * D:])
+        h = (1 - u) * h_prev + u * c
+        self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w}
+        self.attrs = {"gate_activation": 1, "activation": 2,
+                      "origin_mode": False}
+        self.outputs = {
+            "Gate": np.concatenate([u, r, c], axis=1).astype("float32"),
+            "ResetHiddenPrev": (r * h_prev).astype("float32"),
+            "Hidden": h.astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(
+            ["Input", "HiddenPrev", "Weight"], "Hidden",
+            max_relative_error=0.02,
+        )
+
+
+class TestLstmUnit(OpTest):
+    def setUp(self):
+        self.op_type = "lstm_unit"
+        rs = np.random.RandomState(3)
+        B, D = 3, 4
+        x = (rs.rand(B, 4 * D).astype("float32") - 0.5)
+        c_prev = (rs.rand(B, D).astype("float32") - 0.5)
+        fb = 1.0
+        i = _sigmoid(x[:, :D])
+        f = _sigmoid(x[:, D:2 * D] + fb)
+        o = _sigmoid(x[:, 2 * D:3 * D])
+        g = np.tanh(x[:, 3 * D:])
+        c = f * c_prev + i * g
+        h = o * np.tanh(c)
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.attrs = {"forget_bias": fb}
+        self.outputs = {"C": c.astype("float32"), "H": h.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X", "C_prev"], "H", max_relative_error=0.02)
+
+
+class TestConv3d(OpTest):
+    def setUp(self):
+        self.op_type = "conv3d"
+        rs = np.random.RandomState(4)
+        x = rs.rand(1, 2, 4, 4, 4).astype("float32")
+        w = rs.rand(3, 2, 2, 2, 2).astype("float32")
+        out = np.zeros((1, 3, 3, 3, 3), "float32")
+        for oc in range(3):
+            for d in range(3):
+                for i in range(3):
+                    for j in range(3):
+                        out[0, oc, d, i, j] = np.sum(
+                            x[0, :, d:d + 2, i:i + 2, j:j + 2] * w[oc]
+                        )
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                      "dilations": [1, 1, 1], "groups": 1}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(
+            ["Input", "Filter"], "Output", max_relative_error=0.02
+        )
+
+
+class TestPool3d(OpTest):
+    def setUp(self):
+        self.op_type = "pool3d"
+        rs = np.random.RandomState(5)
+        x = rs.rand(1, 2, 4, 4, 4).astype("float32")
+        out = np.zeros((1, 2, 2, 2, 2), "float32")
+        for c in range(2):
+            for d in range(2):
+                for i in range(2):
+                    for j in range(2):
+                        out[0, c, d, i, j] = x[
+                            0, c, 2 * d:2 * d + 2, 2 * i:2 * i + 2,
+                            2 * j:2 * j + 2,
+                        ].max()
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestTrilinearInterp(OpTest):
+    def setUp(self):
+        self.op_type = "trilinear_interp"
+        rs = np.random.RandomState(6)
+        x = rs.rand(1, 2, 2, 2, 2).astype("float32")
+        od = oh = ow = 4
+        out = np.zeros((1, 2, od, oh, ow), "float32")
+        for d in range(od):
+            for i in range(oh):
+                for j in range(ow):
+                    sd = d * 1.0 / 3  # (D-1)/(out_d-1) = 1/3
+                    si = i * 1.0 / 3
+                    sj = j * 1.0 / 3
+                    d0, i0, j0 = int(sd), int(si), int(sj)
+                    d1, i1, j1 = min(d0 + 1, 1), min(i0 + 1, 1), min(j0 + 1, 1)
+                    fd, fi, fj = sd - d0, si - i0, sj - j0
+                    out[0, :, d, i, j] = (
+                        x[0, :, d0, i0, j0] * (1 - fd) * (1 - fi) * (1 - fj)
+                        + x[0, :, d0, i0, j1] * (1 - fd) * (1 - fi) * fj
+                        + x[0, :, d0, i1, j0] * (1 - fd) * fi * (1 - fj)
+                        + x[0, :, d0, i1, j1] * (1 - fd) * fi * fj
+                        + x[0, :, d1, i0, j0] * fd * (1 - fi) * (1 - fj)
+                        + x[0, :, d1, i0, j1] * fd * (1 - fi) * fj
+                        + x[0, :, d1, i1, j0] * fd * fi * (1 - fj)
+                        + x[0, :, d1, i1, j1] * fd * fi * fj
+                    )
+        self.inputs = {"X": x}
+        self.attrs = {"out_d": od, "out_h": oh, "out_w": ow,
+                      "align_corners": True}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)

@@ -1,0 +1,302 @@
+"""Per-op tests for the sequence-op family on the padded+lengths
+representation (reference tests: test_sequence_pad_op.py,
+test_sequence_unpad_op.py, test_sequence_slice_op.py, etc.)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _mask(B, T, lengths):
+    return np.arange(T)[None, :] < np.asarray(lengths)[:, None]
+
+
+class TestSequencePool(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pool"
+        x = np.random.RandomState(0).rand(3, 5, 4).astype("float32")
+        lengths = [2, 5, 3]
+        m = _mask(3, 5, lengths)[:, :, None]
+        self.inputs = {"X": (x, [lengths])}
+        self.attrs = {"pooltype": "SUM"}
+        self.outputs = {"Out": (x * m).sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSequencePad(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pad"
+        x = np.random.RandomState(1).rand(3, 4, 2).astype("float32")
+        lengths = [2, 4, 1]
+        pad = np.array([0.5], "float32")
+        m = _mask(3, 4, lengths)[:, :, None]
+        out = np.where(m, x, pad[0])
+        self.inputs = {"X": (x, [lengths]), "PadValue": pad}
+        self.attrs = {"padded_length": -1}
+        self.outputs = {
+            "Out": out.astype("float32"),
+            "Length": np.array(lengths, "int64"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSequenceUnpad(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_unpad"
+        x = np.random.RandomState(2).rand(3, 4, 2).astype("float32")
+        lengths = np.array([2, 4, 1], "int64")
+        m = _mask(3, 4, lengths)[:, :, None]
+        self.inputs = {"X": x, "Length": lengths}
+        self.outputs = {"Out": (x * m).astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSequenceMask(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_mask"
+        x = np.array([2, 4, 0], "int64")
+        self.inputs = {"X": x}
+        self.attrs = {"maxlen": 5, "out_dtype": 5}
+        self.outputs = {
+            "Y": (np.arange(5)[None, :] < x[:, None]).astype("float32")
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceSlice(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_slice"
+        x = np.random.RandomState(3).rand(2, 6, 3).astype("float32")
+        offset = np.array([[1], [2]], "int64")
+        length = np.array([[3], [2]], "int64")
+        out = np.zeros_like(x)
+        for b in range(2):
+            o, ln = int(offset[b, 0]), int(length[b, 0])
+            out[b, :ln] = x[b, o:o + ln]
+        self.inputs = {"X": x, "Offset": offset, "Length": length}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestSequenceReverse(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_reverse"
+        x = np.random.RandomState(4).rand(3, 4, 2).astype("float32")
+        lengths = [2, 4, 3]
+        out = x.copy()
+        for b, ln in enumerate(lengths):
+            out[b, :ln] = x[b, :ln][::-1]
+        self.inputs = {"X": (x, [lengths])}
+        self.outputs = {"Y": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Y")
+
+
+class TestSequenceErase(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_erase"
+        x = np.array(
+            [[3, 5, 3, 7, 0], [1, 3, 9, 0, 0]], "int64"
+        )
+        lengths = [5, 3]
+        tokens = [3, 0]
+        out = np.zeros_like(x)
+        for b, ln in enumerate(lengths):
+            kept = [v for v in x[b, :ln] if v not in tokens]
+            out[b, :len(kept)] = kept
+        self.inputs = {"X": (x, [lengths])}
+        self.attrs = {"tokens": tokens}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceEnumerate(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_enumerate"
+        x = np.array([[1, 2, 3, 4], [5, 6, 0, 0]], "int64")
+        lengths = [4, 2]
+        win, pad = 2, 9
+        out = np.full((2, 4, win), pad, "int64")
+        for b, ln in enumerate(lengths):
+            for t in range(4):
+                for k in range(win):
+                    if t + k < ln:
+                        out[b, t, k] = x[b, t + k]
+                    elif t >= ln:
+                        out[b, t, k] = pad
+        # positions entirely past the end stay pad; partial windows pad tail
+        self.inputs = {"X": (x, [lengths])}
+        self.attrs = {"win_size": win, "pad_value": pad}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceConv(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_conv"
+        rs = np.random.RandomState(5)
+        B, T, D, M, CL = 2, 5, 3, 4, 3
+        x = rs.rand(B, T, D).astype("float32")
+        filt = rs.rand(CL * D, M).astype("float32")
+        lengths = [5, 3]
+        start = -1
+        col = np.zeros((B, T, CL * D), "float32")
+        for b, ln in enumerate(lengths):
+            for t in range(T):
+                for j in range(CL):
+                    s = t + start + j
+                    if 0 <= s < ln:
+                        col[b, t, j * D:(j + 1) * D] = x[b, s]
+        out = col @ filt
+        m = _mask(B, T, lengths)[:, :, None]
+        out = out * m
+        self.inputs = {"X": (x, [lengths]), "Filter": filt}
+        self.attrs = {"contextLength": CL, "contextStart": start,
+                      "contextStride": 1}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=0.01)
+
+
+class TestSequenceExpandAs(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_expand_as"
+        rs = np.random.RandomState(6)
+        x = rs.rand(2, 3).astype("float32")
+        y = rs.rand(2, 4, 3).astype("float32")
+        lengths = [4, 2]
+        out = np.broadcast_to(x[:, None], (2, 4, 3)).copy()
+        out *= _mask(2, 4, lengths)[:, :, None]
+        self.inputs = {"X": x, "Y": (y, [lengths])}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSequenceScatter(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_scatter"
+        rs = np.random.RandomState(7)
+        x = rs.rand(2, 6).astype("float32")
+        ids = np.array([[1, 3, 1], [0, 5, 0]], "int64")
+        upd = rs.rand(2, 3).astype("float32")
+        lengths = [3, 2]
+        out = x.copy()
+        for b, ln in enumerate(lengths):
+            for s in range(ln):
+                out[b, ids[b, s]] += upd[b, s]
+        self.inputs = {
+            "X": x, "Ids": (ids, [lengths]), "Updates": upd,
+        }
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestLodReset(OpTest):
+    def setUp(self):
+        self.op_type = "lod_reset"
+        x = np.random.RandomState(8).rand(3, 4).astype("float32")
+        y = np.array([2, 1, 4], "int64")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestIm2Sequence(OpTest):
+    def setUp(self):
+        self.op_type = "im2sequence"
+        rs = np.random.RandomState(9)
+        x = rs.rand(2, 3, 4, 4).astype("float32")
+        kh = kw = 2
+        sh = sw = 2
+        oh = ow = 2
+        out = np.zeros((2, oh * ow, 3 * kh * kw), "float32")
+        for b in range(2):
+            p = 0
+            for i in range(oh):
+                for j in range(ow):
+                    patch = x[b, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+                    out[b, p] = patch.reshape(-1)
+                    p += 1
+        self.inputs = {"X": x}
+        self.attrs = {"kernels": [kh, kw], "strides": [sh, sw],
+                      "paddings": [0, 0, 0, 0]}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestRowConv(OpTest):
+    def setUp(self):
+        self.op_type = "row_conv"
+        rs = np.random.RandomState(10)
+        B, T, D, F = 2, 5, 3, 3
+        x = rs.rand(B, T, D).astype("float32")
+        w = rs.rand(F, D).astype("float32")
+        lengths = [5, 4]
+        out = np.zeros_like(x)
+        for b, ln in enumerate(lengths):
+            for t in range(T):
+                for j in range(F):
+                    if t + j < ln:
+                        out[b, t] += x[b, t + j] * w[j]
+        self.inputs = {"X": (x, [lengths]), "Filter": w}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=0.01)
