@@ -1,11 +1,12 @@
 """Metric ops (reference: paddle/fluid/operators/metrics/accuracy_op.cc,
-auc_op.cc)."""
+auc_op.cc, precision_recall_op.cc, operators/edit_distance_op.cc,
+operators/chunk_eval_op.cc, operators/positive_negative_pair_op.cc)."""
 
 from __future__ import annotations
 
 import numpy as np
 
-from .registry import op
+from .registry import op, register_op
 
 
 @op("accuracy")
@@ -43,3 +44,238 @@ def _mean_iou(ctx, op_):
     ctx.out(op_, "OutMeanIou", (jnp.sum(iou) / jnp.maximum(valid, 1.0)).reshape((1,)))
     ctx.out(op_, "OutWrong", (union - inter).astype(np.int32))
     ctx.out(op_, "OutCorrect", inter.astype(np.int32))
+
+
+@op("auc", stateful_inputs=(
+    ("StatPos", "StatPosOut"), ("StatNeg", "StatNegOut")))
+def _auc(ctx, op_):
+    """reference: metrics/auc_op.cc — bucketed ROC/PR statistics updated in
+    place; AUC from the trapezoid over cumulative buckets."""
+    import jax.numpy as jnp
+
+    preds = ctx.in1(op_, "Predict")  # [N, 2] (prob of neg, pos)
+    label = ctx.in1(op_, "Label").reshape(-1)
+    stat_pos = ctx.in1(op_, "StatPos").reshape(-1).astype(np.int64)
+    stat_neg = ctx.in1(op_, "StatNeg").reshape(-1).astype(np.int64)
+    num_thresholds = int(op_.attr("num_thresholds", 4095))
+    pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(np.int32), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(np.int64)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1 - is_pos)
+    # walk buckets high->low accumulating TP/FP (reference auc_op.h:statAuc)
+    pos_rev = jnp.cumsum(stat_pos[::-1])
+    neg_rev = jnp.cumsum(stat_neg[::-1])
+    tp = pos_rev
+    fp = neg_rev
+    tp_prev = jnp.concatenate([jnp.zeros((1,), tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros((1,), fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    total_pos = jnp.maximum(tp[-1], 1)
+    total_neg = jnp.maximum(fp[-1], 1)
+    auc = area / (total_pos * total_neg)
+    ctx.out(op_, "AUC", jnp.asarray(auc, np.float64).reshape(()))
+    ctx.out(op_, "StatPosOut", stat_pos)
+    ctx.out(op_, "StatNegOut", stat_neg)
+
+
+@op("precision_recall", stateful_inputs=(("StatesInfo", "AccumStatesInfo"),))
+def _precision_recall(ctx, op_):
+    """reference: metrics/precision_recall_op.cc — per-class TP/FP/TN/FN
+    with macro/micro averaged P/R/F1, batch and accumulated."""
+    import jax.numpy as jnp
+
+    max_probs = ctx.in1(op_, "MaxProbs", optional=True)
+    indices = ctx.in1(op_, "Indices").reshape(-1).astype(np.int32)
+    labels = ctx.in1(op_, "Labels").reshape(-1).astype(np.int32)
+    weights = ctx.in1(op_, "Weights", optional=True)
+    states = ctx.in1(op_, "StatesInfo")  # [C, 4] TP FP TN FN
+    C = states.shape[0]
+    w = (
+        weights.reshape(-1)
+        if weights is not None
+        else jnp.ones(labels.shape, np.float32)
+    )
+    cls = jnp.arange(C)
+    pred_oh = (indices[:, None] == cls[None, :]).astype(np.float32)
+    lab_oh = (labels[:, None] == cls[None, :]).astype(np.float32)
+    wc = w[:, None].astype(np.float32)
+    tp = jnp.sum(wc * pred_oh * lab_oh, axis=0)
+    fp = jnp.sum(wc * pred_oh, axis=0) - tp
+    fn = jnp.sum(wc * lab_oh, axis=0) - tp
+    tn = jnp.sum(w) - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+
+    def metrics(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-10), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-10), 0.0)
+        f1 = jnp.where(
+            prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-10), 0.0
+        )
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        tps, fps, fns = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mp = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, 1e-10), 0.0)
+        mr = jnp.where(tps + fns > 0, tps / jnp.maximum(tps + fns, 1e-10), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-10), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    accum = states.astype(np.float32) + batch_states
+    ctx.out(op_, "BatchMetrics", metrics(batch_states).reshape(1, 6))
+    ctx.out(op_, "AccumMetrics", metrics(accum).reshape(1, 6))
+    ctx.out(op_, "AccumStatesInfo", accum)
+    _ = max_probs
+
+
+def _edit_distance_host(ctx, op_):
+    """reference: edit_distance_op.cc (CPU kernel) — Levenshtein distance
+    per sequence pair, optionally normalized by reference length."""
+    hyp = np.asarray(ctx.scope.get(op_.input("Hyps")[0]))
+    ref = np.asarray(ctx.scope.get(op_.input("Refs")[0]))
+    hyp_lens = ctx.scope.get(op_.input("Hyps")[0] + "@SEQ_LEN")
+    ref_lens = ctx.scope.get(op_.input("Refs")[0] + "@SEQ_LEN")
+    normalized = bool(op_.attr("normalized", True))
+    if hyp.ndim == 3:
+        hyp = hyp[:, :, 0]
+    if ref.ndim == 3:
+        ref = ref[:, :, 0]
+    B = hyp.shape[0]
+    hl = (
+        np.asarray(hyp_lens) if hyp_lens is not None
+        else np.full(B, hyp.shape[1])
+    )
+    rl = (
+        np.asarray(ref_lens) if ref_lens is not None
+        else np.full(B, ref.shape[1])
+    )
+    out = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        h = hyp[b, : hl[b]]
+        r = ref[b, : rl[b]]
+        m, n = len(h), len(r)
+        dp = np.zeros((m + 1, n + 1), np.int64)
+        dp[:, 0] = np.arange(m + 1)
+        dp[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                cost = 0 if h[i - 1] == r[j - 1] else 1
+                dp[i, j] = min(
+                    dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                    dp[i - 1, j - 1] + cost,
+                )
+        d = float(dp[m, n])
+        out[b, 0] = d / max(n, 1) if normalized else d
+    ctx.scope.set(op_.output("Out")[0], out)
+    ctx.scope.set(
+        op_.output("SequenceNum")[0], np.asarray([B], np.int64)
+    )
+
+
+def _chunk_eval_host(ctx, op_):
+    """reference: chunk_eval_op.cc — chunk F1 for IOB-style tagging.
+    Supports the plain (IOB, chunk = maximal run of one type) scheme."""
+    inf = np.asarray(ctx.scope.get(op_.input("Inference")[0]))
+    lab = np.asarray(ctx.scope.get(op_.input("Label")[0]))
+    lens_v = ctx.scope.get(op_.input("Inference")[0] + "@SEQ_LEN")
+    num_chunk_types = int(op_.attr("num_chunk_types"))
+    scheme = op_.attr("chunk_scheme", "IOB")
+    if inf.ndim == 3:
+        inf = inf[:, :, 0]
+    if lab.ndim == 3:
+        lab = lab[:, :, 0]
+    B, T = inf.shape
+    lens = (
+        np.asarray(lens_v) if lens_v is not None else np.full(B, T)
+    )
+
+    if scheme not in ("IOB", "plain"):
+        raise NotImplementedError(
+            "chunk_eval: scheme %r not supported (IOB and plain only)"
+            % scheme
+        )
+
+    def chunks(tags, ln):
+        """IOB: tag = chunk_type*2 (+1 for I), B starts a chunk;
+        plain: tag = chunk_type, chunk = maximal same-type run."""
+        out = []
+        start, ctype = None, None
+        for t in range(int(ln)):
+            tag = int(tags[t])
+            outside = (
+                tag >= num_chunk_types * 2 if scheme == "IOB"
+                else tag >= num_chunk_types
+            )
+            if outside:
+                if start is not None:
+                    out.append((start, t, ctype))
+                    start = None
+                continue
+            if scheme == "IOB":
+                ty, begins = tag // 2, tag % 2 == 0
+            else:
+                ty, begins = tag, ctype != tag
+            if not begins and ctype == ty and start is not None:
+                continue
+            if start is not None:
+                out.append((start, t, ctype))
+            start, ctype = t, ty
+        if start is not None:
+            out.append((start, int(ln), ctype))
+        return set(out)
+    num_inf = num_lab = num_correct = 0
+    for b in range(B):
+        ic = chunks(inf[b], lens[b])
+        lc = chunks(lab[b], lens[b])
+        num_inf += len(ic)
+        num_lab += len(lc)
+        num_correct += len(ic & lc)
+    p = num_correct / num_inf if num_inf else 0.0
+    r = num_correct / num_lab if num_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    set_ = ctx.scope.set
+    set_(op_.output("Precision")[0], np.asarray([p], np.float32))
+    set_(op_.output("Recall")[0], np.asarray([r], np.float32))
+    set_(op_.output("F1-Score")[0], np.asarray([f1], np.float32))
+    set_(op_.output("NumInferChunks")[0], np.asarray([num_inf], np.int64))
+    set_(op_.output("NumLabelChunks")[0], np.asarray([num_lab], np.int64))
+    set_(
+        op_.output("NumCorrectChunks")[0],
+        np.asarray([num_correct], np.int64),
+    )
+
+
+def _positive_negative_pair_host(ctx, op_):
+    """reference: positive_negative_pair_op.cc — ranking pair statistics
+    per query."""
+    score = np.asarray(ctx.scope.get(op_.input("Score")[0])).reshape(-1)
+    label = np.asarray(ctx.scope.get(op_.input("Label")[0])).reshape(-1)
+    qid = np.asarray(ctx.scope.get(op_.input("QueryID")[0])).reshape(-1)
+    pos = neg = neu = 0.0
+    for q in np.unique(qid):
+        idx = np.where(qid == q)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if label[i] == label[j]:
+                    continue
+                ds = score[i] - score[j]
+                dl = label[i] - label[j]
+                if ds * dl > 0:
+                    pos += 1
+                elif ds == 0:
+                    neu += 1
+                else:
+                    neg += 1
+    set_ = ctx.scope.set
+    set_(op_.output("PositivePair")[0], np.asarray([pos], np.float32))
+    set_(op_.output("NegativePair")[0], np.asarray([neg], np.float32))
+    set_(op_.output("NeutralPair")[0], np.asarray([neu], np.float32))
+
+
+register_op("edit_distance", lower=_edit_distance_host, host=True)
+register_op("chunk_eval", lower=_chunk_eval_host, host=True)
+register_op(
+    "positive_negative_pair", lower=_positive_negative_pair_host, host=True
+)

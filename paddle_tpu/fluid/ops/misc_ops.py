@@ -1,0 +1,452 @@
+"""Misc op batch: CRF, proximal optimizers, data_norm, py_func, affine
+grid, SelectedRows utilities, pserver id sharding.
+
+Reference kernels: paddle/fluid/operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc, optimizers/proximal_gd_op.cc, proximal_adagrad_op.cc,
+data_norm_op.cc, py_func_op.cc, affine_grid_op.cc, hash_op.cc,
+sample_logits_op.cc, distributed_ops/split_ids_op.cc, merge_ids_op.cc,
+ref_by_trainer_id_op.cc, split_byref_op.cc, split_selected_rows_op.cc,
+merge_selected_rows_op.cc, get_tensor_from_selected_rows_op.cc,
+coalesce_tensor_op.cc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from .registry import op, register_op, same_shape_infer
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+@op("linear_chain_crf", grad="generic")
+def _linear_chain_crf(ctx, op_):
+    """reference: linear_chain_crf_op.cc — negative log-likelihood of the
+    gold path under a linear-chain CRF. Transition[0]/Transition[1] are the
+    start/end weights, rows 2.. the pairwise matrix (reference layout).
+    Padded rep: Emission [B, T, K] + lengths, Label [B, T]. The forward
+    (alpha) recursion is one lax.scan in log space."""
+    import jax
+    import jax.numpy as jnp
+
+    em = ctx.in1(op_, "Emission")  # [B, T, K]
+    trans = ctx.in1(op_, "Transition")  # [K+2, K]
+    label = ctx.in1(op_, "Label").astype(np.int32)
+    if label.ndim == 3:
+        label = label[:, :, 0]
+    names = op_.inputs.get("Emission") or []
+    lens = ctx.get_opt(names[0] + "@SEQ_LEN") if names else None
+    B, T, K = em.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    start_w, end_w, pairwise = trans[0], trans[1], trans[2:]
+
+    # log partition via forward recursion
+    alpha0 = start_w[None, :] + em[:, 0]  # [B, K]
+
+    def step(alpha, t):
+        # [B, K_prev, 1] + [K_prev, K] -> logsumexp over prev
+        scores = alpha[:, :, None] + pairwise[None, :, :]
+        new = jax.nn.logsumexp(scores, axis=1) + em[:, t]
+        live = (t < lens)[:, None]
+        return jnp.where(live, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    logz = jax.nn.logsumexp(alpha + end_w[None, :], axis=1)
+
+    # gold-path score
+    t_idx = jnp.arange(T)
+    valid = t_idx[None, :] < lens[:, None]
+    em_score = jnp.sum(
+        jnp.take_along_axis(em, label[:, :, None], axis=2)[:, :, 0]
+        * valid.astype(em.dtype),
+        axis=1,
+    )
+    prev_lab = label[:, :-1]
+    next_lab = label[:, 1:]
+    trans_valid = (t_idx[None, 1:] < lens[:, None]).astype(em.dtype)
+    pair_score = jnp.sum(
+        pairwise[prev_lab, next_lab] * trans_valid, axis=1
+    )
+    first = jnp.take_along_axis(
+        start_w[None, :].repeat(B, 0), label[:, :1], axis=1
+    )[:, 0]
+    last_idx = jnp.maximum(lens - 1, 0)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    last = end_w[last_lab]
+    gold = em_score + pair_score + first + last
+    ctx.out(op_, "LogLikelihood", (logz - gold)[:, None])
+    ctx.out(op_, "Alpha", alpha)
+    ctx.out(op_, "EmissionExps", jnp.exp(em))
+    ctx.out(op_, "TransitionExps", jnp.exp(trans))
+
+
+@op("crf_decoding")
+def _crf_decoding(ctx, op_):
+    """reference: crf_decoding_op.cc — Viterbi decode (lax.scan + backtrace
+    scan). With a Label input, outputs per-step correctness instead."""
+    import jax.numpy as jnp
+
+    em = ctx.in1(op_, "Emission")
+    trans = ctx.in1(op_, "Transition")
+    names = op_.inputs.get("Emission") or []
+    lens = ctx.get_opt(names[0] + "@SEQ_LEN") if names else None
+    B, T, K = em.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    start_w, end_w, pairwise = trans[0], trans[1], trans[2:]
+    import jax.lax as lax
+
+    v0 = start_w[None, :] + em[:, 0]
+
+    def fwd(v, t):
+        scores = v[:, :, None] + pairwise[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1).astype(np.int32)
+        new = jnp.max(scores, axis=1) + em[:, t]
+        live = (t < lens)[:, None]
+        return jnp.where(live, new, v), jnp.where(
+            live, best_prev, jnp.broadcast_to(jnp.arange(K, dtype=np.int32)[None, :], (B, K))
+        )
+
+    v, backptrs = lax.scan(fwd, v0, jnp.arange(1, T))  # backptrs [T-1, B, K]
+    final = v + end_w[None, :]
+    last = jnp.argmax(final, axis=1).astype(np.int32)  # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, prev  # emit path[i], carry it to step i-1
+
+    _, path_prefix = lax.scan(back, last, backptrs, reverse=True)
+    path = jnp.concatenate([path_prefix, last[None, :]], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1)  # [B, T]
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    path = jnp.where(valid, path, jnp.zeros_like(path))
+    label = ctx.in1(op_, "Label", optional=True)
+    if label is not None:
+        if label.ndim == 3:
+            label = label[:, :, 0]
+        out = (path == label.astype(np.int32)).astype(np.int64) * valid
+        ctx.out(op_, "ViterbiPath", out)
+    else:
+        ctx.out(op_, "ViterbiPath", path.astype(np.int64))
+    names_out = op_.outputs.get("ViterbiPath") or []
+    if names_out:
+        ctx.set(names_out[0] + "@SEQ_LEN", lens)
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+@op("proximal_gd", stateful_inputs=(("Param", "ParamOut"),))
+def _proximal_gd(ctx, op_):
+    """reference: optimizers/proximal_gd_op.cc — GD step then soft
+    threshold (l1) and shrink (l2)."""
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    g = ctx.in1(op_, "Grad")
+    lr = ctx.in1(op_, "LearningRate").reshape(())
+    l1 = float(op_.attr("l1", 0.0))
+    l2 = float(op_.attr("l2", 0.0))
+    prox = p - lr * g
+    out = (
+        jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+        / (1.0 + lr * l2)
+    )
+    ctx.out(op_, "ParamOut", out)
+
+
+@op("proximal_adagrad", stateful_inputs=(
+    ("Param", "ParamOut"), ("Moment", "MomentOut")))
+def _proximal_adagrad(ctx, op_):
+    """reference: optimizers/proximal_adagrad_op.cc."""
+    import jax.numpy as jnp
+
+    p = ctx.in1(op_, "Param")
+    m = ctx.in1(op_, "Moment")
+    g = ctx.in1(op_, "Grad")
+    lr = ctx.in1(op_, "LearningRate").reshape(())
+    l1 = float(op_.attr("l1", 0.0))
+    l2 = float(op_.attr("l2", 0.0))
+    m_new = m + g * g
+    eff_lr = lr / jnp.sqrt(m_new)
+    prox = p - eff_lr * g
+    out = (
+        jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)
+        / (1.0 + eff_lr * l2)
+    )
+    ctx.out(op_, "ParamOut", out)
+    ctx.out(op_, "MomentOut", m_new)
+
+
+@op("data_norm", grad="generic", stateful_inputs=())
+def _data_norm(ctx, op_):
+    """reference: data_norm_op.cc — normalization by accumulated batch
+    statistics (size/sum/square-sum), no learned scale."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C]
+    bsize = ctx.in1(op_, "BatchSize").reshape(-1)
+    bsum = ctx.in1(op_, "BatchSum").reshape(-1)
+    bsq = ctx.in1(op_, "BatchSquareSum").reshape(-1)
+    eps = float(op_.attr("epsilon", 1e-4))
+    means = bsum / jnp.maximum(bsize, 1.0)
+    scales = jnp.sqrt(
+        jnp.maximum(bsize, 1.0) / jnp.maximum(bsq - bsum * means, eps)
+    )
+    ctx.out(op_, "Y", (x - means[None, :]) * scales[None, :])
+    ctx.out(op_, "Means", means)
+    ctx.out(op_, "Scales", scales)
+
+
+# ---------------------------------------------------------------------------
+# host utility ops
+# ---------------------------------------------------------------------------
+_PY_FUNCS = {}
+
+
+def register_py_func(func_id, fn):
+    _PY_FUNCS[int(func_id)] = fn
+
+
+def _py_func_host(ctx, op_):
+    """reference: py_func_op.cc — call a registered Python callable on the
+    input tensors."""
+    fid = int(op_.attr("forward_callable_id", op_.attr("func_id", 0)))
+    fn = _PY_FUNCS.get(fid)
+    if fn is None:
+        raise KeyError("py_func: no callable registered under id %d" % fid)
+    ins = [np.asarray(ctx.scope.get(n)) for n in op_.input_arg_names]
+    outs = fn(*ins)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for name, v in zip(op_.output_arg_names, outs):
+        ctx.scope.set(name, np.asarray(v))
+
+
+register_op("py_func", lower=_py_func_host, host=True)
+
+
+@op("affine_grid", grad="generic")
+def _affine_grid(ctx, op_):
+    """reference: affine_grid_op.cc — 2x3 theta -> normalized sampling grid
+    (pairs with grid_sampler)."""
+    import jax.numpy as jnp
+
+    from .manip_ops import _static_ints
+
+    theta = ctx.in1(op_, "Theta")  # [N, 2, 3]
+    out_shape = _static_ints(ctx.in1(op_, "OutputShape", optional=True))
+    if out_shape is None:
+        out_shape = [int(v) for v in op_.attr("output_shape")]
+    N, _, H, W = out_shape
+    align = bool(op_.attr("align_corners", True))
+    if align:
+        xs = jnp.linspace(-1.0, 1.0, W)
+        ys = jnp.linspace(-1.0, 1.0, H)
+    else:
+        xs = (jnp.arange(W) * 2.0 + 1.0) / W - 1.0
+        ys = (jnp.arange(H) * 2.0 + 1.0) / H - 1.0
+    xg, yg = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,nak->nhwa", base, theta)  # [N, H, W, 2]
+    ctx.out(op_, "Output", grid)
+
+
+@op("hash")
+def _hash(ctx, op_):
+    """reference: hash_op.cc (xxhash). TPU-native stand-in: a splitmix-style
+    integer mix — deterministic and well-distributed, but NOT bit-compatible
+    with xxhash (documented deviation; the op contract is bucketized ids)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X").astype(np.uint32)
+    num_hash = int(op_.attr("num_hash", 1))
+    mod_by = int(op_.attr("mod_by", 100000000))
+    outs = []
+    for i in range(num_hash):
+        h = x * np.uint32(2654435761) + np.uint32(
+            (0x9E3779B9 * (i + 1)) & 0xFFFFFFFF
+        )
+        h = h ^ (h >> 16)
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        # combine the last-dim ids of one instance
+        v = h
+        while v.ndim > 2:
+            v = v.sum(axis=-1, dtype=np.uint32)
+        if v.ndim == 2:
+            v = v.sum(axis=-1, dtype=np.uint32)
+        outs.append((v % np.uint32(mod_by)).astype(np.int64))
+    ctx.out(op_, "Out", jnp.stack(outs, axis=-1)[:, None, :])
+
+
+@op("sample_logits")
+def _sample_logits(ctx, op_):
+    """reference: sample_logits_op.cc — gather true + sampled-class logits
+    for sampled softmax."""
+    import jax.numpy as jnp
+
+    logits = ctx.in1(op_, "Logits")  # [N, K]
+    labels = ctx.in1(op_, "Labels").astype(np.int32)  # [N, NT]
+    num_samples = int(op_.attr("num_samples"))
+    N, K = logits.shape
+    key = ctx.next_key() if ctx.base_key is not None else None
+    if key is not None:
+        import jax
+
+        samples = jax.random.randint(key, (N, num_samples), 0, K, np.int32)
+    else:
+        samples = jnp.zeros((N, num_samples), np.int32)
+    all_idx = jnp.concatenate([labels, samples], axis=1)
+    sampled = jnp.take_along_axis(logits, all_idx, axis=1)
+    ctx.out(op_, "SampledLogits", sampled)
+    ctx.out(op_, "Samples", all_idx.astype(np.int64))
+    ctx.out(
+        op_, "SampledLabels",
+        jnp.broadcast_to(
+            jnp.arange(labels.shape[1], dtype=np.int64)[None, :],
+            labels.shape,
+        ),
+    )
+    ctx.out(op_, "Probabilities", jnp.full(all_idx.shape, 1.0 / K, logits.dtype))
+
+
+# ---------------------------------------------------------------------------
+# pserver id sharding + SelectedRows utilities (host)
+# ---------------------------------------------------------------------------
+def _split_ids_host(ctx, op_):
+    """reference: distributed_ops/split_ids_op.cc — round-robin ids across
+    shards by id % n."""
+    ids = np.asarray(ctx.scope.get(op_.input("Ids")[0])).reshape(-1)
+    outs = op_.output_arg_names
+    n = len(outs)
+    for i, name in enumerate(outs):
+        ctx.scope.set(name, ids[ids % n == i].reshape(-1, 1))
+
+
+def _merge_ids_host(ctx, op_):
+    """reference: distributed_ops/merge_ids_op.cc — scatter per-shard rows
+    back into the original id order."""
+    ids = np.asarray(ctx.scope.get(op_.input("Ids")[0])).reshape(-1)
+    rows = [np.asarray(ctx.scope.get(n)) for n in op_.input("X")]
+    n = len(rows)
+    D = rows[0].shape[-1]
+    out = np.zeros((len(ids), D), rows[0].dtype)
+    counters = [0] * n
+    for i, idv in enumerate(ids):
+        shard = int(idv) % n
+        out[i] = rows[shard][counters[shard]]
+        counters[shard] += 1
+    ctx.scope.set(op_.output("Out")[0], out)
+
+
+def _ref_by_trainer_id_host(ctx, op_):
+    """reference: distributed_ops/ref_by_trainer_id_op.cc — select X[i]
+    by trainer id."""
+    tid = int(
+        np.asarray(ctx.scope.get(op_.input("TrainerId")[0])).ravel()[0]
+    )
+    xs = op_.input("X")
+    ctx.scope.set(
+        op_.output("Out")[0], np.asarray(ctx.scope.get(xs[tid]))
+    )
+
+
+def _split_byref_host(ctx, op_):
+    """reference: distributed_ops/split_byref_op.cc — split rows into the
+    output vars (by sections attr or evenly)."""
+    x = np.asarray(ctx.scope.get(op_.input("X")[0]))
+    outs = op_.output_arg_names
+    sections = op_.attr("sections") or []
+    if not sections:
+        per = x.shape[0] // len(outs)
+        sections = [per] * len(outs)
+        sections[-1] += x.shape[0] - per * len(outs)
+    start = 0
+    for name, s in zip(outs, sections):
+        ctx.scope.set(name, x[start:start + s])
+        start += s
+
+
+def _merge_selected_rows_host(ctx, op_):
+    """reference: merge_selected_rows_op.cc — combine duplicate rows by
+    summing values."""
+    sr = ctx.scope.get(op_.input("X")[0])
+    if isinstance(sr, core.SelectedRows):
+        rows, vals = np.asarray(sr.rows), np.asarray(sr.value)
+    else:
+        vals = np.asarray(sr)
+        rows = np.arange(vals.shape[0])
+    uniq, inv = np.unique(rows, return_inverse=True)
+    out = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(out, inv, vals)
+    res = core.SelectedRows(
+        rows=uniq.tolist(), height=getattr(sr, "height", len(uniq)),
+        value=out,
+    )
+    ctx.scope.set(op_.output("Out")[0], res)
+
+
+def _split_selected_rows_host(ctx, op_):
+    """reference: split_selected_rows_op.cc — split rows by height
+    sections."""
+    sr = ctx.scope.get(op_.input("X")[0])
+    rows = np.asarray(sr.rows)
+    vals = np.asarray(sr.value)
+    height_sections = [int(v) for v in op_.attr("height_sections")]
+    outs = op_.output_arg_names
+    start = 0
+    for name, h in zip(outs, height_sections):
+        m = (rows >= start) & (rows < start + h)
+        res = core.SelectedRows(
+            rows=(rows[m] - start).tolist(), height=h, value=vals[m]
+        )
+        ctx.scope.set(name, res)
+        start += h
+
+
+def _get_tensor_from_selected_rows_host(ctx, op_):
+    """reference: get_tensor_from_selected_rows_op.cc."""
+    sr = ctx.scope.get(op_.input("X")[0])
+    if isinstance(sr, core.SelectedRows):
+        ctx.scope.set(op_.output("Out")[0], np.asarray(sr.value))
+    else:
+        ctx.scope.set(op_.output("Out")[0], np.asarray(sr))
+
+
+register_op("split_ids", lower=_split_ids_host, host=True)
+register_op("merge_ids", lower=_merge_ids_host, host=True)
+register_op("ref_by_trainer_id", lower=_ref_by_trainer_id_host, host=True)
+register_op("split_byref", lower=_split_byref_host, host=True)
+register_op(
+    "merge_selected_rows", lower=_merge_selected_rows_host, host=True
+)
+register_op(
+    "split_selected_rows", lower=_split_selected_rows_host, host=True
+)
+register_op(
+    "get_tensor_from_selected_rows",
+    lower=_get_tensor_from_selected_rows_host,
+    host=True,
+)
+
+
+@op("coalesce_tensor")
+def _coalesce_tensor(ctx, op_):
+    """reference: coalesce_tensor_op.cc — fuse tensors into one flat buffer
+    (grad coalescing). Outputs the fused buffer and views per input."""
+    import jax.numpy as jnp
+
+    xs = ctx.ins(op_, "Input")
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    ctx.out(op_, "FusedOutput", flat)
+    offset = 0
+    out_names = op_.outputs.get("Output") or []
+    for name, x in zip(out_names, xs):
+        size = int(np.prod(x.shape))
+        ctx.set(name, flat[offset:offset + size].reshape(x.shape))
+        offset += size

@@ -315,7 +315,12 @@ def _conv3d_transpose(ctx, op_):
     groups = int(op_.attr("groups", 1)) or 1
     ks = w.shape[2:]
     wk = jnp.flip(w, axis=(2, 3, 4))
-    wk = jnp.swapaxes(wk, 0, 1)
+    wk = jnp.swapaxes(wk, 0, 1)  # -> [out_c/g, in_c, kd, kh, kw]
+    if groups > 1:
+        ic = x.shape[1]
+        wk = wk.reshape(
+            (groups, w.shape[1], ic // groups) + tuple(ks)
+        ).reshape((groups * w.shape[1], ic // groups) + tuple(ks))
     pad = [
         (dil[i] * (ks[i] - 1) - pads[i], dil[i] * (ks[i] - 1) - pads[i])
         for i in range(3)

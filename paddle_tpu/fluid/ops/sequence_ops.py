@@ -15,9 +15,10 @@ import numpy as np
 from .registry import op
 
 
-# ops whose listed output slot carries a `{name}@SEQ_LEN` companion in the
-# lowering env; the executor uses this to thread companions across segment
-# boundaries (see executor._seqlen_producers)
+# ops whose listed output slot carries a `{name}@SEQ_LEN` companion (XLA
+# ops set it in the lowering env; host ops write it to the scope). The
+# executor threads these across segment boundaries (_CompiledBlock.__init__
+# companion handling).
 SEQLEN_OUT_SLOTS = {
     "sequence_pad": "Out",
     "sequence_unpad": "Out",
@@ -32,6 +33,11 @@ SEQLEN_OUT_SLOTS = {
     "lstm": "Hidden",
     "lstmp": "Projection",
     "gru": "Hidden",
+    "crf_decoding": "ViterbiPath",
+    # host ops with ragged outputs
+    "multiclass_nms": "Out",
+    "generate_proposals": "RpnRois",
+    "mine_hard_examples": "NegIndices",
 }
 
 
@@ -429,8 +435,10 @@ def _lod_reset(ctx, op_):
     ctx.out(op_, "Out", x)
     y = ctx.in1(op_, "Y", optional=True)
     if y is not None:
-        lengths = jnp.asarray(y).reshape(-1).astype(np.int32)
-        _set_out_lengths(ctx, op_, lengths)
+        # Y's data is the target LoD as OFFSETS [0, n1, n1+n2, ...]
+        # (reference lod_reset_op.cc) -> convert to lengths
+        offs = jnp.asarray(y).reshape(-1).astype(np.int32)
+        _set_out_lengths(ctx, op_, offs[1:] - offs[:-1])
         return
     target = op_.attr("target_lod") or []
     if target:

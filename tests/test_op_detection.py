@@ -1,0 +1,396 @@
+"""Per-op tests for the detection + metric batches (reference tests:
+test_yolo_box_op.py, test_box_clip_op.py, test_anchor_generator_op.py,
+test_multiclass_nms_op.py, test_bipartite_match_op.py, test_roi_pool_op.py,
+test_auc_op.py, test_precision_recall_op.py, test_edit_distance_op.py,
+test_chunk_eval_op.py, test_positive_negative_pair_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestYoloBox(OpTest):
+    def setUp(self):
+        self.op_type = "yolo_box"
+        rs = np.random.RandomState(0)
+        N, an, cls, H, W = 1, 2, 3, 2, 2
+        anchors = [10, 13, 16, 30]
+        downsample = 32
+        x = rs.rand(N, an * (5 + cls), H, W).astype("float32")
+        img = np.array([[64, 64]], "int32")
+        xr = x.reshape(N, an, 5 + cls, H, W)
+        boxes = np.zeros((N, an * H * W, 4), "float32")
+        scores = np.zeros((N, an * H * W, cls), "float32")
+        k = 0
+        for a in range(an):
+            for i in range(H):
+                for j in range(W):
+                    cx = (_sigmoid(xr[0, a, 0, i, j]) + j) / W * 64
+                    cy = (_sigmoid(xr[0, a, 1, i, j]) + i) / H * 64
+                    bw = np.exp(xr[0, a, 2, i, j]) * anchors[2 * a] / (
+                        downsample * W
+                    ) * 64
+                    bh = np.exp(xr[0, a, 3, i, j]) * anchors[2 * a + 1] / (
+                        downsample * H
+                    ) * 64
+                    x0 = np.clip(cx - bw / 2, 0, 63)
+                    y0 = np.clip(cy - bh / 2, 0, 63)
+                    x1 = np.clip(cx + bw / 2, 0, 63)
+                    y1 = np.clip(cy + bh / 2, 0, 63)
+                    boxes[0, a * H * W + i * W + j] = [x0, y0, x1, y1]
+                    conf = _sigmoid(xr[0, a, 4, i, j])
+                    keep = 1.0 if conf > 0.01 else 0.0
+                    scores[0, a * H * W + i * W + j] = (
+                        _sigmoid(xr[0, a, 5:, i, j]) * conf * keep
+                    )
+                    k += 1
+        self.inputs = {"X": x, "ImgSize": img}
+        self.attrs = {"anchors": anchors, "class_num": cls,
+                      "conf_thresh": 0.01, "downsample_ratio": downsample,
+                      "clip_bbox": True}
+        self.outputs = {"Boxes": boxes, "Scores": scores}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestBoxClip(OpTest):
+    def setUp(self):
+        self.op_type = "box_clip"
+        boxes = np.array(
+            [[[-1.0, 2.0, 70.0, 70.0], [5.0, 5.0, 10.0, 10.0]]], "float32"
+        )
+        im_info = np.array([[64, 64, 1.0]], "float32")
+        out = boxes.copy()
+        out[0, 0] = [0, 2, 63, 63]
+        self.inputs = {"Input": boxes, "ImInfo": im_info}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAnchorGenerator(OpTest):
+    def setUp(self):
+        self.op_type = "anchor_generator"
+        x = np.zeros((1, 3, 2, 2), "float32")
+        sizes, ratios = [32.0], [1.0]
+        stride = [16.0, 16.0]
+        H = W = 2
+        anchors = np.zeros((H, W, 1, 4), "float32")
+        for i in range(H):
+            for j in range(W):
+                cx = j * 16 + 8.0
+                cy = i * 16 + 8.0
+                anchors[i, j, 0] = [cx - 16, cy - 16, cx + 16, cy + 16]
+        var = np.broadcast_to(
+            np.array([0.1, 0.1, 0.2, 0.2], "float32"), (H, W, 1, 4)
+        )
+        self.inputs = {"Input": x}
+        self.attrs = {"anchor_sizes": sizes, "aspect_ratios": ratios,
+                      "stride": stride, "offset": 0.5,
+                      "variances": [0.1, 0.1, 0.2, 0.2]}
+        self.outputs = {"Anchors": anchors, "Variances": var.copy()}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTargetAssign(OpTest):
+    def setUp(self):
+        self.op_type = "target_assign"
+        rs = np.random.RandomState(1)
+        x = rs.rand(5, 3).astype("float32")
+        match = np.array([[0, -1, 2], [4, 1, -1]], "int64")
+        out = np.zeros((2, 3, 3), "float32")
+        wt = np.zeros((2, 3, 1), "float32")
+        for n in range(2):
+            for p in range(3):
+                if match[n, p] >= 0:
+                    out[n, p] = x[match[n, p]]
+                    wt[n, p] = 1.0
+        self.inputs = {"X": x, "MatchIndices": match}
+        self.attrs = {"mismatch_value": 0}
+        self.outputs = {"Out": out, "OutWeight": wt}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPolygonBoxTransform(OpTest):
+    def setUp(self):
+        self.op_type = "polygon_box_transform"
+        rs = np.random.RandomState(2)
+        x = rs.rand(1, 4, 2, 3).astype("float32")
+        out = np.zeros_like(x)
+        for c in range(4):
+            for i in range(2):
+                for j in range(3):
+                    if c % 2 == 0:
+                        out[0, c, i, j] = 4 * j - x[0, c, i, j]
+                    else:
+                        out[0, c, i, j] = 4 * i - x[0, c, i, j]
+        self.inputs = {"Input": x}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestRoiAlign(OpTest):
+    def setUp(self):
+        self.op_type = "roi_align"
+        rs = np.random.RandomState(3)
+        x = rs.rand(1, 2, 8, 8).astype("float32")
+        rois = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+        ph = pw = 2
+        ratio = 2
+        out = np.zeros((1, 2, ph, pw), "float32")
+        bin_h = bin_w = 7.0 / 2
+        for c in range(2):
+            for py in range(ph):
+                for px in range(pw):
+                    acc = 0.0
+                    for iy in range(ratio):
+                        for ix in range(ratio):
+                            sy = 0 + (py + (iy + 0.5) / ratio) * bin_h
+                            sx = 0 + (px + (ix + 0.5) / ratio) * bin_w
+                            y0, x0 = int(sy), int(sx)
+                            y1, x1 = min(y0 + 1, 7), min(x0 + 1, 7)
+                            fy, fx = sy - y0, sx - x0
+                            acc += (
+                                x[0, c, y0, x0] * (1 - fy) * (1 - fx)
+                                + x[0, c, y0, x1] * (1 - fy) * fx
+                                + x[0, c, y1, x0] * fy * (1 - fx)
+                                + x[0, c, y1, x1] * fy * fx
+                            )
+                    out[0, c, py, px] = acc / (ratio * ratio)
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": ph, "pooled_width": pw,
+                      "spatial_scale": 1.0, "sampling_ratio": ratio}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestRoiPool(OpTest):
+    def setUp(self):
+        self.op_type = "roi_pool"
+        rs = np.random.RandomState(4)
+        x = rs.rand(1, 2, 4, 4).astype("float32")
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+        out = np.zeros((1, 2, 2, 2), "float32")
+        for c in range(2):
+            for py in range(2):
+                for px in range(2):
+                    out[0, c, py, px] = x[
+                        0, c, py * 2:py * 2 + 2, px * 2:px * 2 + 2
+                    ].max()
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMulticlassNMS(OpTest):
+    def setUp(self):
+        self.op_type = "multiclass_nms"
+        # 1 image, 2 classes (0 = background), 3 boxes
+        scores = np.array(
+            [[[0.9, 0.1, 0.2], [0.1, 0.8, 0.7]]], "float32"
+        )  # [N=1, C=2, M=3]
+        bboxes = np.array(
+            [[[0, 0, 10, 10], [0, 0, 10, 10], [50, 50, 60, 60]]],
+            "float32",
+        )
+        # class 1: boxes 0 (0.8) and 2 (0.7); box 1 overlaps box 0 fully
+        expect = np.array(
+            [[1.0, 0.8, 0, 0, 10, 10], [1.0, 0.7, 50, 50, 60, 60]],
+            "float32",
+        )
+        self.inputs = {"Scores": scores, "BBoxes": bboxes}
+        self.attrs = {"score_threshold": 0.3, "nms_top_k": 10,
+                      "keep_top_k": 10, "nms_threshold": 0.5,
+                      "background_label": 0, "normalized": True}
+        self.outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBipartiteMatch(OpTest):
+    def setUp(self):
+        self.op_type = "bipartite_match"
+        dist = np.array(
+            [[0.1, 0.9, 0.3], [0.8, 0.2, 0.4]], "float32"
+        )  # 2 gt x 3 priors
+        # greedy: max 0.9 at (0,1); then 0.8 at (1,0); col 2 unmatched
+        match = np.array([[1, 0, -1]], "int64")
+        mdist = np.array([[0.8, 0.9, 0.0]], "float32")
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "bipartite"}
+        self.outputs = {
+            "ColToRowMatchIndices": match,
+            "ColToRowMatchDist": mdist,
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAuc(OpTest):
+    def setUp(self):
+        self.op_type = "auc"
+        nt = 10
+        preds = np.array(
+            [[0.2, 0.8], [0.9, 0.1], [0.4, 0.6], [0.7, 0.3]], "float32"
+        )
+        labels = np.array([[1], [0], [1], [0]], "int64")
+        stat_pos = np.zeros(nt + 1, "int64")
+        stat_neg = np.zeros(nt + 1, "int64")
+        sp, sn = stat_pos.copy(), stat_neg.copy()
+        for p, l in zip(preds[:, 1], labels[:, 0]):
+            b = min(int(p * nt), nt)
+            if l:
+                sp[b] += 1
+            else:
+                sn[b] += 1
+        tp = np.cumsum(sp[::-1])
+        fp = np.cumsum(sn[::-1])
+        tp_prev = np.concatenate([[0], tp[:-1]])
+        fp_prev = np.concatenate([[0], fp[:-1]])
+        area = np.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+        auc = area / max(tp[-1], 1) / max(fp[-1], 1)
+        self.inputs = {
+            "Predict": preds, "Label": labels,
+            "StatPos": stat_pos, "StatNeg": stat_neg,
+        }
+        self.attrs = {"num_thresholds": nt}
+        self.outputs = {
+            "AUC": np.asarray(auc, "float64"),
+            "StatPosOut": sp, "StatNegOut": sn,
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPrecisionRecall(OpTest):
+    def setUp(self):
+        self.op_type = "precision_recall"
+        idx = np.array([[0], [1], [1], [2]], "int64")
+        lab = np.array([[0], [1], [2], [2]], "int64")
+        C = 3
+        states = np.zeros((C, 4), "float32")
+        tp = np.zeros(C)
+        fp = np.zeros(C)
+        fn = np.zeros(C)
+        for i, l in zip(idx[:, 0], lab[:, 0]):
+            if i == l:
+                tp[i] += 1
+            else:
+                fp[i] += 1
+                fn[l] += 1
+        tn = 4 - tp - fp - fn
+
+        def metr(tp, fp, fn):
+            prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-10), 0)
+            rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-10), 0)
+            f1 = np.where(prec + rec > 0,
+                          2 * prec * rec / np.maximum(prec + rec, 1e-10), 0)
+            macro = [prec.mean(), rec.mean(), f1.mean()]
+            tps, fps, fns = tp.sum(), fp.sum(), fn.sum()
+            mp = tps / max(tps + fps, 1e-10)
+            mr = tps / max(tps + fns, 1e-10)
+            mf = 2 * mp * mr / max(mp + mr, 1e-10)
+            return np.array(macro + [mp, mr, mf], "float32").reshape(1, 6)
+
+        batch = metr(tp, fp, fn)
+        self.inputs = {"Indices": idx, "Labels": lab, "StatesInfo": states}
+        self.outputs = {
+            "BatchMetrics": batch,
+            "AccumMetrics": batch,
+            "AccumStatesInfo": np.stack(
+                [tp, fp, tn, fn], axis=1
+            ).astype("float32"),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestEditDistance(OpTest):
+    def setUp(self):
+        self.op_type = "edit_distance"
+        hyp = np.array([[1, 2, 3, 0], [5, 6, 0, 0]], "int64")
+        ref = np.array([[1, 3, 3, 4], [5, 6, 7, 0]], "int64")
+        self.inputs = {
+            "Hyps": (hyp, [[3, 2]]),
+            "Refs": (ref, [[4, 3]]),
+        }
+        self.attrs = {"normalized": False}
+        # [1,2,3] vs [1,3,3,4] = 2 ; [5,6] vs [5,6,7] = 1
+        self.outputs = {
+            "Out": np.array([[2.0], [1.0]], "float32"),
+            "SequenceNum": np.array([2], "int64"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestChunkEval(OpTest):
+    def setUp(self):
+        self.op_type = "chunk_eval"
+        # tags: B-0=0, I-0=1, B-1=2, I-1=3, O=4
+        inf = np.array([[0, 1, 4, 2, 3]], "int64")
+        lab = np.array([[0, 1, 4, 0, 3]], "int64")
+        self.inputs = {
+            "Inference": (inf, [[5]]),
+            "Label": (lab, [[5]]),
+        }
+        self.attrs = {"num_chunk_types": 2, "chunk_scheme": "IOB"}
+        # inference chunks: (0,2,t0), (3,5,t1); label: (0,2,t0), (3,4,t0)+(4,5? ...)
+        # label: tags 0,1 -> chunk (0,2,0); tag 0 at 3 -> (3,4,0); tag 3 I-1 type
+        # mismatch starts new chunk (4,5,1). correct = {(0,2,0)} -> 1
+        self.outputs = {
+            "Precision": np.array([0.5], "float32"),
+            "Recall": np.array([1.0 / 3.0], "float32"),
+            "F1-Score": np.array([0.4], "float32"),
+            "NumInferChunks": np.array([2], "int64"),
+            "NumLabelChunks": np.array([3], "int64"),
+            "NumCorrectChunks": np.array([1], "int64"),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPositiveNegativePair(OpTest):
+    def setUp(self):
+        self.op_type = "positive_negative_pair"
+        score = np.array([[0.8], [0.2], [0.5], [0.6]], "float32")
+        label = np.array([[1], [0], [1], [0]], "float32")
+        qid = np.array([[0], [0], [1], [1]], "int64")
+        # q0: (0.8,1) vs (0.2,0): ds=0.6, dl=1 -> pos
+        # q1: (0.5,1) vs (0.6,0): ds=-0.1, dl=1 -> neg
+        self.inputs = {"Score": score, "Label": label, "QueryID": qid}
+        self.outputs = {
+            "PositivePair": np.array([1.0], "float32"),
+            "NegativePair": np.array([1.0], "float32"),
+            "NeutralPair": np.array([0.0], "float32"),
+        }
+
+    def test_output(self):
+        self.check_output()

@@ -1,0 +1,281 @@
+"""Per-op tests for the misc batch (reference tests:
+test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_proximal_gd_op.py, test_proximal_adagrad_op.py, test_data_norm_op.py,
+test_py_func_op.py, test_affine_grid_op.py, test_split_ids_op.py,
+test_merge_ids_op.py, test_coalesce_tensor_op.py)."""
+
+import itertools
+
+import numpy as np
+
+from op_test import OpTest
+from paddle_tpu.fluid.ops import misc_ops
+
+
+def _crf_path_score(em, trans, path):
+    start_w, end_w, pairwise = trans[0], trans[1], trans[2:]
+    s = start_w[path[0]] + em[0, path[0]]
+    for t in range(1, len(path)):
+        s += pairwise[path[t - 1], path[t]] + em[t, path[t]]
+    s += end_w[path[-1]]
+    return s
+
+
+class TestLinearChainCrf(OpTest):
+    def setUp(self):
+        self.op_type = "linear_chain_crf"
+        rs = np.random.RandomState(0)
+        B, T, K = 2, 3, 3
+        em = rs.rand(B, T, K).astype("float32")
+        trans = rs.rand(K + 2, K).astype("float32")
+        label = rs.randint(0, K, (B, T)).astype("int64")
+        lens = [3, 2]
+        ll = np.zeros((B, 1), "float32")
+        for b in range(B):
+            L = lens[b]
+            logz = np.log(
+                sum(
+                    np.exp(
+                        _crf_path_score(
+                            em[b, :L].astype("float64"),
+                            trans.astype("float64"), p,
+                        )
+                    )
+                    for p in itertools.product(range(K), repeat=L)
+                )
+            )
+            gold = _crf_path_score(
+                em[b, :L].astype("float64"), trans.astype("float64"),
+                label[b, :L],
+            )
+            ll[b, 0] = logz - gold
+        self.inputs = {"Emission": (em, [lens]), "Transition": trans,
+                       "Label": label}
+        self.outputs = {"LogLikelihood": ll}
+
+    def test_output(self):
+        self.check_output(
+            no_check_set=["Alpha", "EmissionExps", "TransitionExps"],
+            atol=1e-4,
+        )
+
+    def test_grad(self):
+        self.check_grad(
+            ["Emission", "Transition"], "LogLikelihood",
+            max_relative_error=0.02,
+        )
+
+
+class TestCrfDecoding(OpTest):
+    def setUp(self):
+        self.op_type = "crf_decoding"
+        rs = np.random.RandomState(1)
+        B, T, K = 2, 3, 3
+        em = rs.rand(B, T, K).astype("float32")
+        trans = rs.rand(K + 2, K).astype("float32")
+        lens = [3, 2]
+        path = np.zeros((B, T), "int64")
+        for b in range(B):
+            L = lens[b]
+            best, best_s = None, -1e30
+            for p in itertools.product(range(K), repeat=L):
+                s = _crf_path_score(
+                    em[b, :L].astype("float64"), trans.astype("float64"), p
+                )
+                if s > best_s:
+                    best, best_s = p, s
+            path[b, :L] = best
+        self.inputs = {"Emission": (em, [lens]), "Transition": trans}
+        self.outputs = {"ViterbiPath": path}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestProximalGD(OpTest):
+    def setUp(self):
+        self.op_type = "proximal_gd"
+        rs = np.random.RandomState(2)
+        p = rs.rand(4, 3).astype("float32")
+        g = rs.rand(4, 3).astype("float32")
+        lr = np.array([0.1], "float32")
+        l1, l2 = 0.05, 0.1
+        prox = p - 0.1 * g
+        out = (
+            np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0)
+            / (1 + 0.1 * l2)
+        )
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestProximalAdagrad(OpTest):
+    def setUp(self):
+        self.op_type = "proximal_adagrad"
+        rs = np.random.RandomState(3)
+        p = rs.rand(4, 3).astype("float32")
+        m = rs.rand(4, 3).astype("float32")
+        g = rs.rand(4, 3).astype("float32")
+        lr = np.array([0.1], "float32")
+        l1, l2 = 0.05, 0.1
+        m_new = m + g * g
+        eff = 0.1 / np.sqrt(m_new)
+        prox = p - eff * g
+        out = (
+            np.sign(prox) * np.maximum(np.abs(prox) - eff * l1, 0)
+            / (1 + eff * l2)
+        )
+        self.inputs = {"Param": p, "Moment": m, "Grad": g,
+                       "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": out.astype("float32"),
+                        "MomentOut": m_new}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestDataNorm(OpTest):
+    def setUp(self):
+        self.op_type = "data_norm"
+        rs = np.random.RandomState(4)
+        x = rs.rand(5, 3).astype("float32")
+        bsize = np.full(3, 10.0, "float32")
+        bsum = rs.rand(3).astype("float32") * 10
+        bsq = bsum ** 2 / 10 + np.abs(rs.rand(3).astype("float32")) * 10 + 1
+        means = bsum / bsize
+        scales = np.sqrt(bsize / (bsq - bsum * means))
+        self.inputs = {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+                       "BatchSquareSum": bsq}
+        self.outputs = {
+            "Y": (x - means) * scales,
+            "Means": means,
+            "Scales": scales,
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPyFunc(OpTest):
+    def setUp(self):
+        self.op_type = "py_func"
+        misc_ops.register_py_func(7, lambda a, b: a * 2 + b)
+        rs = np.random.RandomState(5)
+        a = rs.rand(3, 2).astype("float32")
+        b = rs.rand(3, 2).astype("float32")
+        self.inputs = {"X": [("pf_a", a), ("pf_b", b)]}
+        self.attrs = {"forward_callable_id": 7}
+        self.outputs = {"Out": a * 2 + b}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAffineGrid(OpTest):
+    def setUp(self):
+        self.op_type = "affine_grid"
+        theta = np.array(
+            [[[1.0, 0.0, 0.1], [0.0, 1.0, -0.2]]], "float32"
+        )
+        N, H, W = 1, 2, 3
+        xs = np.linspace(-1, 1, W)
+        ys = np.linspace(-1, 1, H)
+        out = np.zeros((N, H, W, 2), "float32")
+        for i in range(H):
+            for j in range(W):
+                base = np.array([xs[j], ys[i], 1.0])
+                out[0, i, j] = theta[0] @ base
+        self.inputs = {"Theta": theta}
+        self.attrs = {"output_shape": [1, 1, H, W], "align_corners": True}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Theta"], "Output", max_relative_error=0.01)
+
+
+class TestSplitIds(OpTest):
+    def setUp(self):
+        self.op_type = "split_ids"
+        ids = np.array([[4], [1], [3], [6], [0]], "int64")
+        self.inputs = {"Ids": ids}
+        self.outputs = {
+            "Out": [
+                ("shard0", np.array([[4], [6], [0]], "int64")),
+                ("shard1", np.array([[1], [3]], "int64")),
+            ]
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMergeIds(OpTest):
+    def setUp(self):
+        self.op_type = "merge_ids"
+        ids = np.array([[4], [1], [3], [6]], "int64")
+        rows0 = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")  # ids 4, 6
+        rows1 = np.array([[5.0, 6.0], [7.0, 8.0]], "float32")  # ids 1, 3
+        out = np.array(
+            [[1.0, 2.0], [5.0, 6.0], [7.0, 8.0], [3.0, 4.0]], "float32"
+        )
+        self.inputs = {
+            "Ids": ids,
+            "X": [("rows0", rows0), ("rows1", rows1)],
+        }
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCoalesceTensor(OpTest):
+    def setUp(self):
+        self.op_type = "coalesce_tensor"
+        rs = np.random.RandomState(6)
+        a = rs.rand(2, 3).astype("float32")
+        b = rs.rand(4).astype("float32")
+        fused = np.concatenate([a.reshape(-1), b])
+        self.inputs = {"Input": [("ct_a", a), ("ct_b", b)]}
+        self.outputs = {
+            "FusedOutput": fused,
+            "Output": [("ct_a_out", a), ("ct_b_out", b)],
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestHashDeterministic(OpTest):
+    def setUp(self):
+        self.op_type = "hash"
+        self.x = np.array([[1], [2], [3]], "int64")
+        self.inputs = {"X": self.x}
+        self.attrs = {"num_hash": 2, "mod_by": 1000}
+        self.outputs = {}
+
+    def test_output(self):
+        # only determinism + range (the mixer is documented as not
+        # bit-compatible with the reference's xxhash)
+        import paddle_tpu.fluid as fluid
+
+        main, startup = fluid.Program(), fluid.Program()
+        blk = main.global_block()
+        blk.create_var(name="hx", shape=self.x.shape, dtype="int64",
+                       is_data=True)
+        out = blk.create_var(name="hout", shape=[3, 1, 2], dtype="int64")
+        blk.append_op(type="hash", inputs={"X": ["hx"]},
+                      outputs={"Out": [out.name]}, attrs=self.attrs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        r1 = exe.run(main, feed={"hx": self.x}, fetch_list=[out])[0]
+        r2 = exe.run(main, feed={"hx": self.x}, fetch_list=[out])[0]
+        np.testing.assert_array_equal(r1, r2)
+        assert np.all(np.asarray(r1) >= 0) and np.all(np.asarray(r1) < 1000)
+        assert len(np.unique(np.asarray(r1)[:, 0, 0])) == 3
