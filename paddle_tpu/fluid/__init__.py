@@ -62,6 +62,8 @@ from . import dataset
 from . import dygraph
 from . import profiler
 from . import contrib
+from . import flags
+from .flags import get_flags, set_flags
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import incubate
@@ -69,18 +71,6 @@ from . import debugger
 from .debugger import set_check_nan_inf
 
 Tensor = LoDTensor
-
-
-def set_flags(flags):
-    for k, v in flags.items():
-        core.set_flag(k, v)
-
-
-def get_flags(flags):
-    if isinstance(flags, str):
-        flags = [flags]
-    return {k: core.get_flag(k) for k in flags}
-
 
 __all__ = [
     "core",
@@ -129,6 +119,9 @@ __all__ = [
     "dygraph",
     "profiler",
     "contrib",
+    "flags",
+    "get_flags",
+    "set_flags",
     "transpiler",
     "DistributeTranspiler",
     "DistributeTranspilerConfig",

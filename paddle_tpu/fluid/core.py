@@ -369,50 +369,36 @@ def _switch_scope(scope):
 
 
 # ---------------------------------------------------------------------------
-# Flags — gflags-compatible env parsing (reference: platform/flags.cc,
-# python/paddle/fluid/__init__.py:162-210 env whitelist).
+# Flags — gflags-compatible registry lives in fluid/flags.py (reference:
+# platform/flags.cc, python/paddle/fluid/__init__.py:162-210 env whitelist);
+# these shims keep the core.* surface of the reference's pybind layer.
 # ---------------------------------------------------------------------------
-_FLAGS_DEFAULTS = {
-    "FLAGS_check_nan_inf": False,
-    "FLAGS_benchmark": False,
-    "FLAGS_eager_delete_tensor_gb": 0.0,  # functional engine: always eager
-    "FLAGS_allocator_strategy": "xla",  # XLA owns device memory on TPU
-    "FLAGS_use_system_allocator": False,
-    "FLAGS_cudnn_deterministic": True,  # XLA is deterministic by construction
-    "FLAGS_paddle_num_threads": 1,
-    "FLAGS_max_inplace_grad_add": 0,
-    "FLAGS_sync_nccl_allreduce": True,
-    "FLAGS_fraction_of_gpu_memory_to_use": 1.0,
-}
-
-_flags = {}
 
 
 def globals_flags():
-    return dict(_FLAGS_DEFAULTS, **_flags)
+    from . import flags as _flags_mod
+
+    return {"FLAGS_" + k: v for k, v in _flags_mod._flags.items()}
 
 
 def get_flag(name):
-    if name in _flags:
-        return _flags[name]
-    env = os.environ.get(name)
-    if env is not None:
-        default = _FLAGS_DEFAULTS.get(name)
-        if isinstance(default, bool):
-            return env.lower() in ("1", "true", "yes")
-        if isinstance(default, float):
-            return float(env)
-        if isinstance(default, int):
-            return int(env)
-        return env
-    return _FLAGS_DEFAULTS.get(name)
+    """Delegates to the gflags-compatible registry (fluid/flags.py)."""
+    from . import flags as _flags_mod
+
+    return _flags_mod.get_flag(name)
 
 
 def set_flag(name, value):
-    _flags[name] = value
+    from . import flags as _flags_mod
+
+    if not _flags_mod.is_registered(name):
+        return  # unknown legacy flag names are accepted silently
+    _flags_mod.set_flags({name: value})
 
 
 def init_gflags(args):
+    """reference: pybind.cc:1375 / framework::InitGflags — parse
+    --FLAGS_x=y argv into the registry."""
     for a in args:
         a = a.lstrip("-")
         if "=" in a:

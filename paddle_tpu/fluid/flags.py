@@ -1,0 +1,176 @@
+"""Global flag system — the gflags-compatible env bridge.
+
+Reference: ~45 DEFINE_* gflags in paddle/fluid/platform/flags.cc, plus the
+env whitelist that Python forwards at import
+(python/paddle/fluid/__init__.py:162-210 read_env_flags ->
+core.init_gflags).
+
+TPU-native mapping: flags that configured CUDA memory/streams are accepted
+and recorded (scripts that set them keep working); flags with live TPU
+equivalents are wired up:
+
+- FLAGS_check_nan_inf      -> per-op NaN/Inf checking in the executor
+                              (reference operator.cc:945) + jax debug_nans
+- FLAGS_cudnn_deterministic / FLAGS_cpu_deterministic -> recorded; XLA
+                              compilation is deterministic by construction
+- FLAGS_fraction_of_gpu_memory_to_use -> XLA_PYTHON_CLIENT_MEM_FRACTION
+- communicator_* flags     -> defaults for fluid.communicator.Communicator
+- rpc_deadline             -> RPC client/server timeouts (distributed_ops)
+"""
+
+from __future__ import annotations
+
+import os
+
+# name -> default. The union of the reference's env-settable whitelist and
+# the flags its Python layer reads back.
+_DEFAULTS = {
+    # numerics / debugging
+    "check_nan_inf": False,
+    "fast_check_nan_inf": False,
+    "benchmark": False,
+    "cpu_deterministic": False,
+    "cudnn_deterministic": False,
+    # memory (recorded; XLA owns memory)
+    "eager_delete_scope": True,
+    "initial_cpu_memory_in_mb": 500,
+    "init_allocated_mem": False,
+    "eager_delete_tensor_gb": 0.0,
+    "fast_eager_deletion_mode": True,
+    "memory_fraction_of_eager_deletion": 1.0,
+    "allocator_strategy": "naive_best_fit",
+    "fraction_of_gpu_memory_to_use": 0.92,
+    "use_pinned_memory": True,
+    # threading
+    "paddle_num_threads": 1,
+    "dist_threadpool_size": 0,
+    "inner_op_parallelism": 0,
+    # reader
+    "reader_queue_speed_test_mode": False,
+    # profiling / graphs
+    "print_sub_graph_dir": "",
+    "pe_profile_fname": "",
+    "tracer_profile_fname": "",
+    "dygraph_debug": False,
+    "enable_parallel_graph": False,
+    "multiple_of_cupti_buffer_size": 1,
+    # fusion knobs (XLA fuses; recorded)
+    "fuse_parameter_groups_size": 3,
+    "fuse_parameter_memory_size": -1,
+    # distributed / rpc
+    "rpc_deadline": 180000,
+    "rpc_retry_times": 3,
+    "rpc_server_profile_path": "./profile_ps",
+    "enable_rpc_profiler": False,
+    "rpc_send_thread_num": 12,
+    "rpc_get_thread_num": 12,
+    "rpc_prefetch_thread_num": 12,
+    "rpc_disable_reuse_port": False,
+    "rpc_retry_bind_port": 3,
+    "worker_update_interval_secs": 900,
+    # communicator
+    "communicator_independent_recv_thread": True,
+    "communicator_send_queue_size": 20,
+    "communicator_min_send_grad_num_before_recv": 20,
+    "communicator_thread_pool_size": 5,
+    "communicator_max_merge_var_num": 20,
+    "communicator_merge_sparse_bucket": 2000,
+    "communicator_fake_rpc": False,
+    "communicator_send_wait_times": 5,
+    "communicator_merge_sparse_grad": True,
+    "communicator_is_sgd_optimizer": True,
+    # misc
+    "max_body_size": 2147483647,
+    "sync_nccl_allreduce": False,
+    "use_mkldnn": False,
+    "use_ngraph": False,
+}
+
+_flags = {}
+_explicit = set()  # flags set via env or set_flags (side effects key off it)
+
+
+def _coerce(default, text):
+    if isinstance(default, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(text)
+    if isinstance(default, float):
+        return float(text)
+    return text
+
+
+def _read_env():
+    _flags.clear()
+    _flags.update(_DEFAULTS)
+    _explicit.clear()
+    for name, default in _DEFAULTS.items():
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            try:
+                _flags[name] = _coerce(default, env)
+                _explicit.add(name)
+            except ValueError:
+                pass
+    _apply_side_effects()
+
+
+def _apply_side_effects():
+    if "check_nan_inf" in _explicit:
+        # per-op NaN propagation checks (reference operator.cc:945; jax
+        # re-runs the offending primitive un-jitted and points at it).
+        # Mirrors the current value, so turning the flag off works too.
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_debug_nans", bool(_flags.get("check_nan_inf"))
+            )
+        except Exception:
+            pass
+    if (
+        "fraction_of_gpu_memory_to_use" in _explicit
+        and "XLA_PYTHON_CLIENT_MEM_FRACTION" not in os.environ
+    ):
+        os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(
+            _flags.get("fraction_of_gpu_memory_to_use")
+        )
+
+
+def get_flags(names):
+    """paddle-compatible flag read: str or list -> {name: value}."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _flags:
+            raise ValueError("flag %r is not registered" % n)
+        out[n if n.startswith("FLAGS_") else "FLAGS_" + key] = _flags[key]
+    return out
+
+
+def set_flags(flags):
+    """paddle-compatible flag write: {FLAGS_name: value}."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _DEFAULTS:
+            raise ValueError("flag %r is not registered" % n)
+        _flags[key] = _coerce(_DEFAULTS[key], str(v)) if isinstance(
+            v, str
+        ) else v
+        _explicit.add(key)
+    _apply_side_effects()
+
+
+def is_registered(name):
+    key = name[6:] if name.startswith("FLAGS_") else name
+    return key in _DEFAULTS
+
+
+def get_flag(name, default=None):
+    key = name[6:] if name.startswith("FLAGS_") else name
+    return _flags.get(key, default)
+
+
+_read_env()

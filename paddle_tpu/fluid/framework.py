@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import os
 
 import numpy as np
 
@@ -240,6 +241,25 @@ class Parameter(Variable):
 # ---------------------------------------------------------------------------
 # Operator
 # ---------------------------------------------------------------------------
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _user_callstack(limit=4):
+    """Frames of the op's creation site OUTSIDE this package (the line the
+    user actually wrote), innermost last, formatted 'File "f", line N, in
+    fn'."""
+    import traceback
+
+    frames = []
+    for fs in traceback.extract_stack()[:-2]:
+        if fs.filename.startswith(_PKG_DIR):
+            continue
+        frames.append(
+            'File "%s", line %d, in %s' % (fs.filename, fs.lineno, fs.name)
+        )
+    return frames[-limit:]
+
+
 class Operator(object):
     """One op node (reference: framework.py:1680). inputs/outputs are
     dict slot-name -> list of var names; attrs is a plain dict."""
@@ -252,13 +272,29 @@ class Operator(object):
         self.attrs = dict(attrs or {})
         if OP_ROLE_KEY not in self.attrs:
             self.attrs[OP_ROLE_KEY] = current_op_role()
+        if "op_callstack" not in self.attrs:
+            # record the user code line that appended this op, so lowering/
+            # runtime errors can point at it (reference:
+            # framework/op_call_stack.cc + framework.py:1774
+            # kOpCreationCallstackAttrName)
+            self.attrs["op_callstack"] = _user_callstack()
         # compile-time shape/dtype inference through the registry
         from .ops import registry as _registry
 
         opdef = _registry.get_op_def(type)
-        if opdef is not None and opdef.infer_shape is not None:
+        if opdef is not None:
             try:
-                opdef.infer_shape(self, block)
+                if opdef.infer_shape is not None:
+                    opdef.infer_shape(self, block)
+                elif not (
+                    opdef.host
+                    or type.endswith("@GRAD")
+                    or type.endswith("_grad")
+                ):
+                    # no hand-written rule: abstract-evaluate the lowering
+                    # (grad-op shapes come from their forward vars, set by
+                    # append_backward)
+                    _registry.generic_infer_shape(self, block)
             except _registry.SkipInferShape:
                 pass
 

@@ -243,6 +243,11 @@ class LowerCtx(object):
         return self.mesh_axes.get(axis_name, 1)
 
 
+class OpError(RuntimeError):
+    """Lowering/runtime failure annotated with the op's Python creation
+    site (reference: framework/op_call_stack.cc InsertCallStackInfo)."""
+
+
 def run_op(ctx, op):
     """Lower a single op into the context environment."""
     d = get_op_def(op.type)
@@ -254,6 +259,19 @@ def run_op(ctx, op):
     ctx._cur_op = op
     try:
         d.lower(ctx, op)
+    except OpError:
+        raise
+    except Exception as e:
+        stack = op.attr("op_callstack") if hasattr(op, "attr") else None
+        site = (
+            "\n  defined at:\n    " + "\n    ".join(stack)
+            if stack
+            else ""
+        )
+        raise OpError(
+            "error lowering op %r: %s: %s%s"
+            % (op.type, type(e).__name__, e, site)
+        ) from e
     finally:
         ctx._cur_op = prev
 
@@ -417,6 +435,77 @@ def _generic_grad_lower(ctx, op):
     grads = vjp_fn(tuple(cots))
     for (slot, idx, gname), g in zip(wrt, grads):
         ctx.set(gname, g)
+
+
+# ---------------------------------------------------------------------------
+# generic infer_shape: abstract interpretation of the lowering rule
+# ---------------------------------------------------------------------------
+# dynamic dims (-1) are probed with this size; output dims equal to it are
+# mapped back to -1 (batch-dim propagation heuristic)
+_PROBE_DIM = 977
+
+
+def generic_infer_shape(op, block):
+    """Compile-time shape/dtype propagation with NO per-op rule: run the
+    op's own lowering under jax.eval_shape on ShapeDtypeStructs built from
+    the block's var metadata. The reference needed a hand-written
+    InferShape per op (framework/shape_inference.h); here the lowering IS
+    the shape function — abstract evaluation costs no FLOPs and cannot
+    disagree with runtime behavior."""
+    import jax
+
+    d = get_op_def(op.type)
+    if d is None or d.lower is None or d.host:
+        raise SkipInferShape()
+    if op.has_attr("sub_block"):
+        raise SkipInferShape()  # control flow resolves shapes at lowering
+
+    in_structs = {}
+    for name in op.input_arg_names:
+        if name == EMPTY_VAR:
+            continue
+        v = block._find_var_recursive(name)
+        if v is None or v.shape is None:
+            raise SkipInferShape()
+        shape = tuple(
+            _PROBE_DIM if int(s) < 0 else int(s) for s in v.shape
+        )
+        try:
+            dt = np.dtype(v.dtype) if not isinstance(v.dtype, int) else None
+        except TypeError:
+            dt = None
+        if dt is None:
+            from .. import core as _core
+
+            dt = _core.dtype_to_np(v.dtype)
+        in_structs[name] = jax.ShapeDtypeStruct(shape, dt)
+
+    out_names = [n for n in op.output_arg_names if n != EMPTY_VAR]
+
+    def trace(env_in):
+        env = dict(env_in)
+        ctx = LowerCtx(
+            env=env, base_key=jax.random.key(0), block=block
+        )
+        ctx._cur_op = op
+        d.lower(ctx, op)
+        return {n: env[n] for n in out_names if n in env}
+
+    try:
+        outs = jax.eval_shape(trace, in_structs)
+    except Exception:
+        raise SkipInferShape()
+
+    for n, st in outs.items():
+        v = block._find_var_recursive(n)
+        if v is None:
+            continue
+        from .. import core as _core
+
+        v.shape = tuple(
+            -1 if int(s) == _PROBE_DIM else int(s) for s in st.shape
+        )
+        v.dtype = _core.np_to_dtype(st.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -804,10 +804,19 @@ class LambOptimizer(AdamOptimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """Deep Gradient Compression momentum (reference: optimizer.py:870).
-    On TPU dense psum over ICI outperforms top-k sparsification at the scales
-    the reference targeted, so DGC runs as momentum + the same local-grad
-    clipping; the sparse path is kept API-compatible."""
+    """Deep Gradient Compression momentum (reference: optimizer.py:870,
+    dgc_momentum_op.h, dgc_op.cc, sparse_all_reduce_op_handle.cc).
+
+    Emits ``dgc_momentum`` ops carrying per-param U (momentum correction)
+    and V (error accumulation) state: plain momentum before
+    ``rampup_begin_step``, then top-k sparsified updates with momentum
+    factor masking; under data parallelism the sparsified tensor is psum'd
+    over the mesh instead of the dense grad (the collective transpiler
+    skips DGC grads). The ``sparsity`` schedule is honored at its final
+    value (the reference ramps through the list during rampup_step)."""
+
+    _u_acc_str = "dgc_u"
+    _v_acc_str = "dgc_v"
 
     def __init__(
         self,
@@ -823,8 +832,64 @@ class DGCMomentumOptimizer(MomentumOptimizer):
     ):
         super().__init__(learning_rate, momentum, use_nesterov, **kw)
         self._rampup_begin_step = rampup_begin_step
-        self._sparsity = sparsity
+        self._rampup_step = rampup_step
+        self._sparsity = list(sparsity)
         self._local_grad_clip_norm = local_grad_clip_norm
+        self._num_trainers = num_trainers
+        self._step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        super()._create_accumulators(block, parameters)
+        for p in parameters:
+            self._add_accumulator(self._u_acc_str, p)
+            self._add_accumulator(self._v_acc_str, p)
+        if self._step_var is None and not in_dygraph_mode():
+            self._step_var = self._add_accumulator(
+                "dgc_step", parameters[0], dtype="float32", shape=(1,)
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator(self._velocity_acc_str, param)
+        u = self._get_accumulator(self._u_acc_str, param)
+        v = self._get_accumulator(self._v_acc_str, param)
+        inputs = {
+            "Param": [param],
+            "Grad": [grad],
+            "Velocity": [velocity],
+            "U": [u],
+            "V": [v],
+            "LearningRate": [self._create_param_lr(param_and_grad)],
+        }
+        if self._step_var is not None:
+            inputs["CurrentStep"] = [self._step_var]
+        return block.append_op(
+            type="dgc_momentum",
+            inputs=inputs,
+            outputs={
+                "ParamOut": [param],
+                "VelocityOut": [velocity],
+                "UOut": [u],
+                "VOut": [v],
+            },
+            attrs={
+                "mu": self._momentum,
+                "use_nesterov": self._use_nesterov,
+                "sparsity_ratio": float(self._sparsity[-1]),
+                "rampup_begin_step": float(self._rampup_begin_step),
+                "local_grad_clip_norm": self._local_grad_clip_norm,
+                OP_ROLE_KEY: OpRole.Optimize,
+            },
+        )
+
+    def _finish_update(self, block, parameters_and_grads):
+        if self._step_var is not None:
+            block.append_op(
+                type="increment",
+                inputs={"X": [self._step_var]},
+                outputs={"Out": [self._step_var]},
+                attrs={"step": 1.0, OP_ROLE_KEY: OpRole.Optimize},
+            )
 
 
 class ModelAverage(Optimizer):

@@ -134,6 +134,16 @@ class GradAllReduce(Collective):
                 for i in range(1, len(role_vars), 2):
                     if role_vars[i] not in grad_names:
                         grad_names.append(role_vars[i])
+        # DGC grads communicate inside dgc_momentum (sparsified psum — the
+        # reference swaps AllReduceOpHandle for SparseAllReduceOpHandle,
+        # details/sparse_all_reduce_op_handle.cc); skip the dense allreduce
+        dgc_grads = {
+            n
+            for op_ in block.ops
+            if op_.type == "dgc_momentum"
+            for n in op_.input("Grad")
+        }
+        grad_names = [g for g in grad_names if g not in dgc_grads]
         if not grad_names:
             return
         # insert c_allreduce_sum right before the first optimizer op; XLA

@@ -1,0 +1,95 @@
+"""DGC momentum tests (reference: test_dgc_op.py, test_dgc_optimizer.py,
+test_dist_mnist with dgc flag)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _build(opt_factory, seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[10], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt_factory().minimize(loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _run(main, startup, loss, steps=6, compiled=False):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    prog = main
+    if compiled:
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name
+        )
+    rs = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        xb = rs.rand(16, 10).astype("float32")
+        yb = (xb.sum(1, keepdims=True) * 0.3).astype("float32")
+        (l,) = exe.run(
+            prog, feed={"x": xb, "y": yb}, fetch_list=[loss], scope=scope
+        )
+        losses.append(float(np.asarray(l).ravel().mean()))
+    return losses
+
+
+def test_dgc_sparsity_zero_equals_sgd():
+    """sparsity -> 0 sends everything every step; with momentum factor
+    masking that reduces exactly to SGD (DGC paper alg. 1 dense limit)."""
+    dgc = _run(*_build(lambda: fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+        sparsity=[0.0],
+    )))
+    sgd = _run(*_build(lambda: fluid.optimizer.SGD(learning_rate=0.05)))
+    np.testing.assert_allclose(dgc, sgd, rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_warmup_equals_momentum():
+    """Before rampup_begin_step the op is exact momentum
+    (dgc_momentum_op.h warmup branch)."""
+    dgc = _run(*_build(lambda: fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, rampup_begin_step=1000,
+        sparsity=[0.999],
+    )))
+    mom = _run(*_build(lambda: fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.05, momentum=0.9,
+    )))
+    np.testing.assert_allclose(dgc, mom, rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_sparse_converges():
+    losses = _run(*_build(lambda: fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+        sparsity=[0.5],
+    )), steps=12)
+    assert losses[-1] < losses[0], losses
+
+
+def test_dgc_data_parallel_skips_dense_allreduce():
+    """Under DP the collective transpiler must not insert c_allreduce_sum on
+    DGC grads (the op psums the sparsified tensor itself), and training must
+    still converge on the 8-device mesh."""
+    main, startup, loss = _build(
+        lambda: fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+            sparsity=[0.7],
+        )
+    )
+    losses = _run(main, startup, loss, steps=10, compiled=True)
+    assert losses[-1] < losses[0], losses
+    dgc_grads = {
+        n
+        for op_ in main.global_block().ops
+        if op_.type == "dgc_momentum"
+        for n in op_.input("Grad")
+    }
+    assert dgc_grads
+    for op_ in main.global_block().ops:
+        if op_.type == "c_allreduce_sum":
+            assert not (set(op_.input("X")) & dgc_grads), op_.input("X")

@@ -1,0 +1,61 @@
+"""Flags bridge + op-callstack error tests (reference:
+python/paddle/fluid/__init__.py:162-210 env whitelist,
+framework/op_call_stack.cc)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_get_set_flags():
+    out = fluid.get_flags("FLAGS_rpc_deadline")
+    assert out["FLAGS_rpc_deadline"] == 180000
+    fluid.set_flags({"FLAGS_rpc_deadline": 5000})
+    assert fluid.get_flags(["rpc_deadline"])["FLAGS_rpc_deadline"] == 5000
+    fluid.set_flags({"FLAGS_rpc_deadline": 180000})
+    with pytest.raises(ValueError):
+        fluid.get_flags("FLAGS_not_a_flag")
+
+
+def test_env_flag_read():
+    code = (
+        "import paddle_tpu.fluid as fluid;"
+        "print(fluid.get_flags('FLAGS_check_nan_inf')['FLAGS_check_nan_inf'])"
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(fluid.__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env={"FLAGS_check_nan_inf": "1", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin", "PYTHONPATH": repo},
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().endswith("True")
+
+
+def test_op_error_names_creation_site():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[5], dtype="float32")
+        bad = fluid.layers.elementwise_add(x, y)  # THE_BAD_LINE
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(Exception) as ei:
+        exe.run(
+            main,
+            feed={
+                "x": np.zeros((2, 4), "float32"),
+                "y": np.zeros((2, 5), "float32"),
+            },
+            fetch_list=[bad],
+        )
+    msg = str(ei.value)
+    assert "elementwise_add" in msg
+    assert "test_flags_callstack.py" in msg  # points at THE_BAD_LINE's file

@@ -1,0 +1,84 @@
+"""Shape-inference completeness (VERDICT item 10): every registered op
+must be coverable at build time — a hand-written infer_shape rule, host
+execution (shapes data-dependent by nature), or the generic
+abstract-evaluation path (registry.generic_infer_shape). Plus spot checks
+that build-time shapes match run-time shapes for rule-less ops."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.ops import registry
+
+
+def test_every_op_is_shape_coverable():
+    uncovered = []
+    for name in registry.all_op_types():
+        d = registry.get_op_def(name)
+        if (
+            d.infer_shape is not None
+            or d.host
+            or name.endswith("_grad")
+            or d.lower is not None  # generic_infer_shape path
+        ):
+            continue
+        uncovered.append(name)
+    assert not uncovered, (
+        "ops with no shape-inference coverage: %s" % uncovered
+    )
+
+
+def _build_time_shape(optype, inputs, attrs, out_slot="Out", extra_outs=()):
+    main = fluid.Program()
+    block = main.global_block()
+    in_spec = {}
+    for slot, (name, shape, dtype) in inputs.items():
+        block.create_var(name=name, shape=shape, dtype=dtype, is_data=True)
+        in_spec[slot] = [name]
+    outs = {out_slot: ["gis_out"]}
+    block.create_var(name="gis_out", shape=None, dtype="float32")
+    for slot in extra_outs:
+        vn = "gis_" + slot.lower()
+        block.create_var(name=vn, shape=None, dtype="float32")
+        outs[slot] = [vn]
+    block.append_op(type=optype, inputs=in_spec, outputs=outs, attrs=attrs)
+    return tuple(block.vars["gis_out"].shape)
+
+
+def test_generic_inference_static_shapes():
+    # ops registered WITHOUT a hand-written infer_shape rule
+    s = _build_time_shape(
+        "strided_slice",
+        {"Input": ("gx", [6, 8], "float32")},
+        {"axes": [0, 1], "starts": [0, 2], "ends": [6, 8], "strides": [2, 3]},
+    )
+    assert s == (3, 2), s
+
+    s = _build_time_shape(
+        "pixel_shuffle", {"X": ("px", [2, 8, 3, 3], "float32")},
+        {"upscale_factor": 2},
+    )
+    assert s == (2, 2, 6, 6), s
+
+    s = _build_time_shape(
+        "sequence_conv",
+        {
+            "X": ("sx", [4, 7, 3], "float32"),
+            "Filter": ("sf", [9, 5], "float32"),
+        },
+        {"contextLength": 3, "contextStart": -1},
+    )
+    assert s == (4, 7, 5), s
+
+
+def test_generic_inference_batch_dim_propagates():
+    s = _build_time_shape(
+        "selu", {"X": ("bx", [-1, 16], "float32")}, {},
+    )
+    assert s == (-1, 16), s
+
+    s = _build_time_shape(
+        "pool3d", {"X": ("p3", [-1, 2, 4, 4, 4], "float32")},
+        {"pooling_type": "max", "ksize": [2, 2, 2], "strides": [2, 2, 2],
+         "paddings": [0, 0, 0]},
+    )
+    assert s == (-1, 2, 2, 2, 2), s
