@@ -106,6 +106,24 @@ class CompiledProgram(object):
         self._places = places
         return self
 
+    def with_spmd(self, loss_name=None, mesh_axes=None, places=None,
+                  build_strategy=None, exec_strategy=None):
+        """TPU-native extension: hybrid-parallel SPMD over a multi-axis
+        mesh, e.g. ``mesh_axes={"data": 2, "model": 4}``. Feeds shard over
+        the ``data`` axis; parameters annotated with ``var.dist_attr``
+        (axis name per dim) shard over their axes, and the matmul lowering
+        applies the Megatron column/row-parallel collectives. The reference
+        (v1.6) had no TP — this is the north-star extension the survey's
+        parallelism inventory marks optional (SURVEY.md §2)."""
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        self._mesh_axes_req = dict(mesh_axes or {"data": None})
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._places = places
+        return self
+
     def with_inference_optimize(self, config):
         return self
 
@@ -123,31 +141,60 @@ class CompiledProgram(object):
 
     def _get_mesh(self):
         if self._mesh is None:
-            from ..parallel.mesh import build_data_mesh
+            from ..parallel.mesh import build_data_mesh, build_mesh
 
             devices = None
             if self._places:
                 first = self._places[0]
                 if hasattr(first, "platform"):  # jax Device objects
                     devices = list(self._places)
-            self._mesh = build_data_mesh(self._device_count(), devices=devices)
+            req = getattr(self, "_mesh_axes_req", None)
+            if req and any(v for v in req.values()):
+                import jax
+
+                axes = dict(req)
+                if axes.get("data") is None:
+                    used = int(
+                        np.prod([v for k, v in axes.items() if v])
+                    )
+                    n = len(devices) if devices else jax.device_count()
+                    axes["data"] = max(n // used, 1)
+                self._mesh = build_mesh(axes, devices=devices)
+            else:
+                self._mesh = build_data_mesh(
+                    self._device_count(), devices=devices
+                )
         return self._mesh
 
-    def _apply_grad_allreduce(self):
+    def _apply_grad_allreduce(self, mesh=None):
         """Insert c_allreduce_sum on every param gradient + loss scaling —
         the program-level contract of the reference's multi-device pass
         (multi_devices_graph_pass.cc:454 CreateAllReduceOp, ScaleLossGrad at
         :292,:514) realised with the collective transpiler (reference:
-        transpiler/collective.py:178 GradAllReduce)."""
+        transpiler/collective.py:178 GradAllReduce). The scale/psum ride the
+        data axis only — under dp x tp the model axis replicates the loss."""
         from .transpiler.collective import GradAllReduce
 
-        if getattr(self._program, "_grad_allreduce_applied", False):
+        nranks = self._device_count()
+        if mesh is not None and "data" in mesh.axis_names:
+            nranks = int(
+                mesh.devices.shape[list(mesh.axis_names).index("data")]
+            )
+        applied = getattr(self._program, "_grad_allreduce_applied", None)
+        if applied is not None:
+            if applied != nranks:
+                raise RuntimeError(
+                    "program was already transpiled for %d data-parallel "
+                    "ranks and cannot be re-targeted to %d (the 1/nranks "
+                    "loss scale is baked in); rebuild the program"
+                    % (applied, nranks)
+                )
             return
         t = GradAllReduce(nrings=1)
         t._transpile_main_program_inplace(
-            self._program, nranks=self._device_count(), loss_name=self._loss_name
+            self._program, nranks=nranks, loss_name=self._loss_name
         )
-        self._program._grad_allreduce_applied = True
+        self._program._grad_allreduce_applied = nranks
 
     def _run(self, executor, feed=None, fetch_list=None, scope=None,
              return_numpy=True):
@@ -183,24 +230,28 @@ class CompiledProgram(object):
                 return_numpy=return_numpy,
             )
 
-        self._apply_grad_allreduce()
         mesh = self._get_mesh()
+        self._apply_grad_allreduce(mesh)
         key = (
             id(self._program),
             self._program._version,
             tuple(sorted(feed.keys())),
             tuple(fetch_names),
-            "dp",
+            "spmd",
+            tuple(zip(mesh.axis_names, mesh.devices.shape)),
         )
         compiled = executor._cache.get(key)
         if compiled is None or compiled.version != self._program._version:
+            mesh_axes = dict(
+                zip(mesh.axis_names, mesh.devices.shape)
+            )
             compiled = _executor_mod._CompiledBlock(
                 self._program,
                 0,
                 list(feed.keys()),
                 fetch_names,
                 executor.place,
-                mesh_axes={"data": mesh.devices.size},
+                mesh_axes=mesh_axes,
                 mesh=mesh,
             )
             executor._cache[key] = compiled

@@ -360,6 +360,7 @@ class _CompiledBlock(object):
         fetch_set = set(self.fetch_names)
         self._plans = []
         device_backend = core._jax_backend_for(place)
+        self._check_tp_segment_safety()
         # `{name}@SEQ_LEN` companion availability: from LoD feeds and from
         # sequence ops that emit companions (sequence_ops.SEQLEN_OUT_SLOTS);
         # companions are threaded into segment inputs/outputs alongside their
@@ -415,13 +416,24 @@ class _CompiledBlock(object):
                 n for n in seg_companion_writes[i] if n in later_needed
             ]
             mutable = [n for n in state_reads if n in writes]
-            const = [n for n in state_reads if n not in writes]
+            const_all = [n for n in state_reads if n not in writes]
+            # TP-sharded read-only vars get their own positional group so
+            # shard_map can slice them (the const dict is a replicated
+            # pytree prefix whose keys may vary at run time)
+            sharded_const = [
+                n for n in const_all if self._has_dist_attr(n)
+            ]
+            const = [n for n in const_all if n not in sharded_const]
             needs_rng = any(o.type in _RANDOM_OPS for o in seg.ops)
 
-            fn = self._build_segment_fn(seg, feeds, mutable, const, out_names)
+            fn = self._build_segment_fn(
+                seg, feeds, mutable, sharded_const, const, out_names
+            )
             raw_fn = fn
             if self.mesh is not None:
-                fn = self._shard_map_wrap(fn, feeds, mutable, const, out_names)
+                fn = self._shard_map_wrap(
+                    fn, feeds, mutable, sharded_const, const, out_names
+                )
             donate = (1,) if device_backend not in (None, "cpu") else ()
             jfn = jax.jit(fn, donate_argnums=donate)
             self._plans.append(
@@ -431,6 +443,7 @@ class _CompiledBlock(object):
                     dict(
                         feeds=feeds,
                         mutable=mutable,
+                        sharded_const=sharded_const,
                         const=const,
                         outs=out_names,
                         fn=jfn,
@@ -441,12 +454,82 @@ class _CompiledBlock(object):
             )
             defined |= writes
 
-    def _shard_map_wrap(self, fn, feeds, mutable, const, out_names):
-        """SPMD data parallelism: trace the block under shard_map over the
-        mesh's `data` axis — feeds sharded on dim 0, state replicated,
-        collectives (c_allreduce_* -> psum) ride ICI. Per-shard fetch values
-        are concatenated on dim 0, matching the reference ParallelExecutor's
-        fetch merge (parallel_executor.cc FetchOpHandle)."""
+    def _check_tp_segment_safety(self):
+        """Model-sharded ACTIVATIONS (between a column-parallel and the
+        matching row-parallel matmul) only exist inside one traced XLA
+        segment; if a host op splits that window the P("data") boundary
+        spec would reassemble garbage. Detect statically and fail loudly."""
+        model_axes = {
+            a for a in self.mesh_axes if a not in ("data", "dp")
+        }
+        if not model_axes:
+            return
+        dist = {
+            v.name: tuple(v.dist_attr)
+            for v in self.program.list_vars()
+            if getattr(v, "dist_attr", None)
+        }
+        if not dist:
+            return
+        for seg in self.segments:
+            if seg.kind != "xla":
+                continue
+            sharded = set()
+            for op_ in seg.ops:
+                w = (op_.inputs.get("Y") or [None])[0]
+                spec = dist.get(w) if w else None
+                col = spec[-1] if spec else None
+                row = spec[-2] if spec and len(spec) >= 2 else None
+                if op_.type in ("mul", "matmul") and col in model_axes:
+                    sharded.update(op_.output_arg_names)
+                elif op_.type in ("mul", "matmul") and row in model_axes:
+                    sharded.difference_update(op_.output_arg_names)
+                elif any(n in sharded for n in op_.input_arg_names):
+                    sharded.update(op_.output_arg_names)
+            leak = sharded & set(seg.writes) & {
+                n
+                for s2 in self.segments
+                if s2 is not seg
+                for n in s2.reads
+            }
+            if leak:
+                raise NotImplementedError(
+                    "tensor-parallel activations %s cross an XLA segment "
+                    "boundary (a host op splits the column->row parallel "
+                    "window); move the host op outside the TP region"
+                    % sorted(leak)
+                )
+
+    def _has_dist_attr(self, name):
+        if not self.mesh_axes:
+            return False
+        v = self.block._find_var_recursive(name)
+        attr = getattr(v, "dist_attr", None) if v is not None else None
+        return bool(attr) and any(a in self.mesh_axes for a in attr if a)
+
+    def _dist_spec_of(self, name):
+        """PartitionSpec for a state var: its dist_attr (TP sharding) or
+        replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        v = self.block._find_var_recursive(name)
+        attr = getattr(v, "dist_attr", None) if v is not None else None
+        if attr:
+            axes = [
+                a if (a and a in self.mesh_axes) else None for a in attr
+            ]
+            return P(*axes)
+        return P()
+
+    def _shard_map_wrap(self, fn, feeds, mutable, sharded_const, const,
+                        out_names):
+        """SPMD execution: trace the block under shard_map over the mesh —
+        feeds sharded on dim 0 of the `data` axis, state vars placed by
+        their dist_attr (TP-sharded weights get their own axes, everything
+        else replicated), collectives (c_allreduce_* -> psum, TP matmul
+        rules) ride ICI. Per-shard fetch values are concatenated on dim 0,
+        matching the reference ParallelExecutor's fetch merge
+        (parallel_executor.cc FetchOpHandle)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import shard_map as _shard_map
@@ -456,28 +539,39 @@ class _CompiledBlock(object):
         }
         in_specs = (
             tuple(P("data") for _ in feeds),
-            tuple(P() for _ in mutable),
-            P(),  # pytree-prefix spec: whole const dict replicated
+            tuple(self._dist_spec_of(n) for n in mutable),
+            tuple(self._dist_spec_of(n) for n in sharded_const),
+            P(),  # pytree-prefix spec: const dict replicated
             P(),
         )
         out_specs = tuple(
-            P() if n in persistable else P("data") for n in out_names
+            self._dist_spec_of(n) if n in persistable else P("data")
+            for n in out_names
         )
         return _shard_map(fn, self.mesh, in_specs, out_specs)
 
-    def _build_segment_fn(self, seg, feeds, mutable, const, out_names):
+    def _build_segment_fn(self, seg, feeds, mutable, sharded_const, const,
+                          out_names):
         block = self.block
         mesh_axes = self.mesh_axes
+        dist_specs = {
+            v.name: tuple(v.dist_attr)
+            for v in self.program.list_vars()
+            if getattr(v, "dist_attr", None)
+        }
 
-        def fn(feed_vals, mutable_vals, const_map, rng_key):
+        def fn(feed_vals, mutable_vals, sharded_vals, const_map, rng_key):
             env = {}
             for n, v in zip(feeds, feed_vals):
                 env[n] = v
             for n, v in zip(mutable, mutable_vals):
                 env[n] = v
+            for n, v in zip(sharded_const, sharded_vals):
+                env[n] = v
             env.update(const_map)
             ctx = LowerCtx(
-                env=env, base_key=rng_key, mesh_axes=mesh_axes, block=block
+                env=env, base_key=rng_key, mesh_axes=mesh_axes, block=block,
+                dist_specs=dist_specs,
             )
             for op_ in seg.ops:
                 _registry.run_op(ctx, op_)
@@ -489,13 +583,20 @@ class _CompiledBlock(object):
         import jax
 
         if self.mesh is not None:
-            # sharded H2D: feeds split over the data axis, state replicated
+            # sharded H2D: feeds split over the data axis; state vars land
+            # with their dist_attr sharding (TP weights stay sharded
+            # between steps instead of being re-replicated)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             feed_dev = NamedSharding(self.mesh, P("data"))
-            state_dev = NamedSharding(self.mesh, P())
+
+            def state_dev_for(name):
+                return NamedSharding(self.mesh, self._dist_spec_of(name))
         else:
-            feed_dev = state_dev = core.get_jax_device(place)
+            feed_dev = core.get_jax_device(place)
+
+            def state_dev_for(name):
+                return core.get_jax_device(place)
 
         results = {}
         local_env = {}
@@ -531,7 +632,16 @@ class _CompiledBlock(object):
                         "variable %r is not initialized (run the startup "
                         "program first)" % n
                     )
-                mutable_vals.append(_to_device(v, state_dev))
+                mutable_vals.append(_to_device(v, state_dev_for(n)))
+            sharded_vals = []
+            for n in plan.get("sharded_const", ()):
+                v = lookup(n)
+                if v is None:
+                    raise ValueError(
+                        "variable %r is not initialized (run the startup "
+                        "program first)" % n
+                    )
+                sharded_vals.append(_to_device(v, state_dev_for(n)))
             const_map = {}
             for n in plan["const"]:
                 v = lookup(n)
@@ -542,9 +652,10 @@ class _CompiledBlock(object):
                         "variable %r is not initialized (run the startup "
                         "program first)" % n
                     )
-                const_map[n] = _to_device(v, state_dev)
+                const_map[n] = _to_device(v, state_dev_for(n))
             outs = plan["fn"](
-                tuple(feed_vals), tuple(mutable_vals), const_map, rng_key
+                tuple(feed_vals), tuple(mutable_vals), tuple(sharded_vals),
+                const_map, rng_key,
             )
             for n, v in zip(plan["outs"], outs):
                 local_env[n] = v
@@ -650,9 +761,16 @@ class Executor(object):
         key = self._cache_key(program, feed.keys(), fetch_names)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None or compiled.version != program._version:
-            compiled = _CompiledBlock(
-                program, 0, list(feed.keys()), fetch_names, self.place
-            )
+            if getattr(program, "_pipeline_config", None):
+                from . import pipeline as _pipeline
+
+                compiled = _pipeline.PipelineProgram(
+                    program, list(feed.keys()), fetch_names, self.place
+                )
+            else:
+                compiled = _CompiledBlock(
+                    program, 0, list(feed.keys()), fetch_names, self.place
+                )
             if use_program_cache:
                 self._cache[key] = compiled
 

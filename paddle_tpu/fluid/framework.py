@@ -164,6 +164,10 @@ class Variable(object):
         self.lod_level = lod_level if lod_level is not None else 0
         self.persistable = bool(persistable) if persistable is not None else False
         self.stop_gradient = stop_gradient
+        # SPMD sharding annotation: tuple of mesh-axis-name-or-None per dim
+        # (TPU-native extension; consumed by the executor's shard_map wrap
+        # and the matmul TP lowering rules — see compiler.with_spmd)
+        self.dist_attr = None
         self.is_data = is_data
         self.error_clip = error_clip
         self.need_check_feed = need_check_feed

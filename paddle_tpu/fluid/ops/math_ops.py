@@ -216,16 +216,67 @@ def _mul_infer(op_, block):
     set_out(op_, block, "Out", tuple(x.shape[:xnc]) + tuple(y.shape[ync:]), x.dtype)
 
 
+def _copy_to_tp(axis_name):
+    """Megatron's `f` operator: identity forward, psum backward over the
+    tensor-parallel axis. Placed on the input of a column-parallel matmul so
+    the replicated activation's gradient sums the per-shard partials —
+    differentiating our grad-op graph through it via jax.vjp reproduces
+    exactly Megatron-LM's hand-written backward all-reduce."""
+    import functools
+
+    import jax
+
+    @functools.partial(jax.custom_vjp)
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _tp_axes(ctx, w_name, ndims=2):
+    """-> (row_axis, col_axis) mesh-axis names from the weight's dist_attr
+    (None when unsharded or not under a mesh)."""
+    spec = ctx.dist_spec(w_name) if w_name else None
+    if not spec or not ctx.mesh_axes:
+        return None, None
+    spec = tuple(spec) + (None,) * (ndims - len(spec))
+    row = spec[-2] if ndims >= 2 else None
+    col = spec[-1]
+    row = row if row in ctx.mesh_axes else None
+    col = col if col in ctx.mesh_axes else None
+    return row, col
+
+
 @op("mul", infer_shape=_mul_infer, grad="generic")
 def _mul(ctx, op_):
+    import jax.lax as lax
+
     jnp = _jnp()
     x = ctx.in1(op_, "X")
     y = ctx.in1(op_, "Y")
     xnc = int(op_.attr("x_num_col_dims", 1))
     ync = int(op_.attr("y_num_col_dims", 1))
+    w_names = op_.inputs.get("Y") or [None]
+    row_axis, col_axis = _tp_axes(ctx, w_names[0])
+    if col_axis is not None:
+        # column-parallel: local matmul on the weight shard; grads of the
+        # replicated input psum over the TP axis (custom_vjp identity)
+        x = _copy_to_tp(col_axis)(x)
     xm = x.reshape((int(np.prod(x.shape[:xnc])), -1))
     ym = y.reshape((int(np.prod(y.shape[:ync])), -1))
     out = jnp.dot(xm, ym)
+    if row_axis is not None:
+        # row-parallel: each shard holds a slice of the contraction dim —
+        # partial products sum over the TP axis (Megatron's `g` operator);
+        # vjp of psum is identity per shard, which is the correct backward
+        out = lax.psum(out, row_axis)
     ctx.out(op_, "Out", out.reshape(tuple(x.shape[:xnc]) + tuple(y.shape[ync:])))
 
 
@@ -257,14 +308,23 @@ def _matmul_infer(op_, block):
 
 @op("matmul", infer_shape=_matmul_infer, grad="generic")
 def _matmul(ctx, op_):
+    import jax.lax as lax
+
     jnp = _jnp()
     x = ctx.in1(op_, "X")
     y = ctx.in1(op_, "Y")
+    w_names = op_.inputs.get("Y") or [None]
+    row_axis, col_axis = _tp_axes(ctx, w_names[0])
     if op_.attr("transpose_X", False):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if op_.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+        row_axis, col_axis = col_axis, row_axis
+    if col_axis is not None:
+        x = _copy_to_tp(col_axis)(x)
     out = jnp.matmul(x, y)
+    if row_axis is not None:
+        out = lax.psum(out, row_axis)
     alpha = float(op_.attr("alpha", 1.0))
     if alpha != 1.0:
         out = out * np.asarray(alpha, out.dtype)

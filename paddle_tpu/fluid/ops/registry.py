@@ -127,7 +127,7 @@ class LowerCtx(object):
     """
 
     def __init__(self, env=None, base_key=None, mesh_axes=None, block=None,
-                 scope=None):
+                 scope=None, dist_specs=None):
         self.env = env if env is not None else {}
         self.base_key = base_key
         self._key_counter = 0
@@ -135,6 +135,9 @@ class LowerCtx(object):
         self.block = block
         self.scope = scope  # host-side scope, only for host ops
         self._cur_op = None  # op currently being lowered (set by run_op)
+        # var name -> dist_attr tuple for TP-sharded vars (Megatron-style
+        # matmul rules consult this; empty when not tracing under a mesh)
+        self.dist_specs = dict(dist_specs or {})
 
     # -- env access --
     def get(self, name):
@@ -238,6 +241,9 @@ class LowerCtx(object):
             if name in self.mesh_axes:
                 return name
         return None
+
+    def dist_spec(self, name):
+        return self.dist_specs.get(name)
 
     def axis_size(self, axis_name):
         return self.mesh_axes.get(axis_name, 1)

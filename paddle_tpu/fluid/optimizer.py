@@ -1137,6 +1137,32 @@ class PipelineOptimizer(object):
         # may be called outside any program_guard
         program = loss.block.program
         startup = startup_program or default_startup_program()
+
+        if self._cut_list:
+            # REAL multi-stage pipeline: the program is cut into stages at
+            # the cut vars; fluid.pipeline.PipelineProgram compiles each
+            # stage onto its own device and the executor streams
+            # microbatches GPipe-style (grad accumulation happens in the
+            # pipeline executor, so the inner optimizer builds the plain
+            # update ops here)
+            with program_guard(program, startup):
+                ops, params_grads = self._optimizer.minimize(
+                    loss, startup_program=startup_program,
+                    parameter_list=parameter_list, no_grad_set=no_grad_set,
+                )
+            cut_names = []
+            for group in self._cut_list:
+                vs = group if isinstance(group, (list, tuple)) else [group]
+                last = vs[-1]
+                cut_names.append(
+                    last.name if hasattr(last, "name") else str(last)
+                )
+            program._pipeline_config = {
+                "cut_vars": cut_names,
+                "num_microbatches": max(k, 1),
+            }
+            return ops, params_grads
+
         with program_guard(program, startup):
             params_grads = self._optimizer.backward(
                 loss, startup_program=startup_program,

@@ -106,7 +106,7 @@ def _jaxpr_of(main, loss):
         for n in plan["const"]
     }
     rng = jax.random.key(0)
-    return jax.make_jaxpr(plan["raw_fn"])(feed_vals, mutable, const, rng)
+    return jax.make_jaxpr(plan["raw_fn"])(feed_vals, mutable, (), const, rng)
 
 
 def test_recompute_jaxpr_contains_barrier_and_replay():
@@ -169,7 +169,7 @@ def test_recompute_memory_is_checkpoint_bound():
             for n in plan["const"]
         }
         rng = jax.random.key(0)
-        lowered = jax.jit(plan["raw_fn"]).lower(feed_vals, mutable, const, rng)
+        lowered = jax.jit(plan["raw_fn"]).lower(feed_vals, mutable, (), const, rng)
         analysis = lowered.compile().memory_analysis()
         if analysis is None:
             pytest.skip("memory_analysis unavailable on this backend")

@@ -1,0 +1,84 @@
+"""Tensor parallelism through the fluid API (VERDICT item 7): dist_attr
+shardings on Program params + Megatron column/row-parallel matmul rules
+under CompiledProgram.with_spmd. Correctness contract: dp x tp losses and
+updates match the plain single-device program."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _build(tp, seed=31):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        out = fluid.layers.fc(input=h, size=8)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(out, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup
+        )
+        if tp:
+            blk = main.global_block()
+            # Megatron MLP: fc1 column-parallel (weight dim1 + bias on the
+            # model axis), fc2 row-parallel (weight dim0; bias replicated,
+            # added after the psum)
+            blk.vars["fc_0.w_0"].dist_attr = (None, "model")
+            blk.vars["fc_0.b_0"].dist_attr = ("model",)
+            blk.vars["fc_1.w_0"].dist_attr = ("model", None)
+    return main, startup, loss
+
+
+def _run(main, startup, loss, spmd_axes=None, steps=5):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    prog = main
+    if spmd_axes:
+        prog = fluid.CompiledProgram(main).with_spmd(
+            loss_name=loss.name, mesh_axes=spmd_axes
+        )
+    rs = np.random.RandomState(5)
+    losses = []
+    for _ in range(steps):
+        xb = rs.rand(8, 16).astype("float32")
+        yb = rs.randint(0, 8, (8, 1)).astype("int64")
+        (l,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                       scope=scope)
+        losses.append(float(np.asarray(l).ravel().mean()))
+    return losses, scope
+
+
+def test_tp_matches_single_device():
+    """dp2 x tp2: sharded weights + Megatron collectives must reproduce the
+    single-device losses step for step."""
+    base, _ = _run(*_build(tp=False))
+    tp, _ = _run(*_build(tp=True), spmd_axes={"data": 2, "model": 2})
+    np.testing.assert_allclose(tp, base, rtol=2e-4, atol=2e-5)
+
+
+def test_tp_params_update_sharded():
+    """After training, the TP weight in the scope keeps its GLOBAL shape
+    (shard_map reassembles on output) and has actually been updated."""
+    main, startup, loss = _build(tp=True)
+    _, scope = _run(main, startup, loss,
+                    spmd_axes={"data": 2, "model": 2}, steps=3)
+    w = np.asarray(scope.get("fc_0.w_0"))
+    assert w.shape == (16, 32), w.shape
+    main2, startup2, loss2 = _build(tp=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.core.Scope()
+    exe.run(startup2, scope=scope2)
+    w0 = np.asarray(scope2.get("fc_0.w_0"))
+    assert not np.allclose(w, w0)
+
+
+def test_dp_only_unaffected():
+    """Programs without dist_attr keep the plain DP behaviour."""
+    base, _ = _run(*_build(tp=False))
+    dp, _ = _run(*_build(tp=False), spmd_axes={"data": 4})
+    np.testing.assert_allclose(dp, base, rtol=2e-4, atol=2e-5)
