@@ -1,0 +1,964 @@
+"""Extended layer surface: RNN-family, loss, detection, metric, and
+tensor-indexing layer functions over the op corpus.
+
+Reference: python/paddle/fluid/layers/nn.py (dynamic_lstm:443,
+dynamic_lstmp, dynamic_gru, gru_unit, warpctc, kldiv_loss, ...),
+layers/detection.py (yolo_box, multiclass_nms, roi_align, ...),
+layers/metric_op.py (auc). Each builder appends the corresponding op with
+reference-compatible slots/attrs; compute lives in the op lowerings.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm",
+    "dynamic_lstmp",
+    "dynamic_gru",
+    "gru_unit",
+    "lstm_unit",
+    "warpctc",
+    "kldiv_loss",
+    "log_loss",
+    "rank_loss",
+    "margin_rank_loss",
+    "bpr_loss",
+    "center_loss",
+    "sigmoid_focal_loss",
+    "hinge_loss",
+    "hash",
+    "multiclass_nms",
+    "yolo_box",
+    "box_clip",
+    "anchor_generator",
+    "density_prior_box",
+    "bipartite_match",
+    "target_assign",
+    "polygon_box_transform",
+    "roi_align",
+    "roi_pool",
+    "generate_proposals",
+    "affine_grid",
+    "grid_sampler",
+    "auc",
+    "gather_nd",
+    "scatter_nd_add",
+    "scatter_nd",
+    "strided_slice",
+    "expand_as",
+    "multiplex",
+    "crop",
+    "crop_tensor",
+    "pad_constant_like",
+    "unique",
+    "unique_with_counts",
+    "shard_index",
+    "space_to_depth",
+    "pixel_shuffle",
+    "shuffle_channel",
+    "temporal_shift",
+    "selu",
+    "npair_loss",
+    "edit_distance",
+    "chunk_eval",
+    "conv3d",
+    "pool3d",
+    "conv3d_transpose",
+    "spectral_norm",
+    "data_norm",
+    "affine_channel",
+]
+
+
+def _simple(op_type, inputs, attrs=None, out_slots=("Out",), dtypes=None):
+    helper = LayerHelper(op_type)
+    first = next(iter(inputs.values()))[0]
+    outs = []
+    for i, slot in enumerate(out_slots):
+        dt = (dtypes or {}).get(slot, getattr(first, "dtype", "float32"))
+        outs.append(helper.create_variable_for_type_inference(dtype=dt))
+    helper.append_op(
+        type=op_type,
+        inputs=inputs,
+        outputs={s: [o] for s, o in zip(out_slots, outs)},
+        attrs=attrs or {},
+    )
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# -- RNN family -------------------------------------------------------------
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """reference: layers/nn.py dynamic_lstm:443 — input is the projected
+    [B, T, 4D] pre-activation (x @ Wx done by a preceding fc)."""
+    helper = LayerHelper("dynamic_lstm", **locals())
+    D = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[D, 4 * D], dtype=dtype
+    )
+    bias_size = [1, 7 * D] if use_peepholes else [1, 4 * D]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        type="lstm",
+        inputs=inputs,
+        outputs={
+            "Hidden": [hidden],
+            "Cell": [cell],
+            "BatchGate": [batch_gate],
+            "BatchCellPreAct": [batch_cell_pre_act],
+        },
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    helper = LayerHelper("dynamic_lstmp", **locals())
+    D = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[proj_size, 4 * D], dtype=dtype
+    )
+    proj_weight = helper.create_parameter(
+        attr=None, shape=[D, proj_size], dtype=dtype
+    )
+    bias_size = [1, 7 * D] if use_peepholes else [1, 4 * D]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True
+    )
+    projection = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "Input": [input], "Weight": [weight], "ProjWeight": [proj_weight],
+        "Bias": [bias],
+    }
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        type="lstmp",
+        inputs=inputs,
+        outputs={"Projection": [projection], "Cell": [cell]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    return projection, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False):
+    helper = LayerHelper("dynamic_gru", **locals())
+    dtype = helper.input_dtype()
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype,
+        is_bias=True,
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        type="gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+            "origin_mode": origin_mode,
+        },
+    )
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    helper = LayerHelper("gru_unit", **locals())
+    dtype = helper.input_dtype()
+    D = size // 3
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[D, 3 * D], dtype=dtype
+    )
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden_pre = helper.create_variable_for_type_inference(dtype)
+    updated_hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "Input": [input], "HiddenPrev": [hidden], "Weight": [weight]
+    }
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, 3 * D], dtype=dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        type="gru_unit",
+        inputs=inputs,
+        outputs={
+            "Gate": [gate],
+            "ResetHiddenPrev": [reset_hidden_pre],
+            "Hidden": [updated_hidden],
+        },
+        attrs={
+            "activation": activation,
+            "gate_activation": gate_activation,
+            "origin_mode": origin_mode,
+        },
+    )
+    return updated_hidden, reset_hidden_pre, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference: layers/nn.py lstm_unit — fc + lstm_unit op."""
+    from .nn import fc
+
+    helper = LayerHelper("lstm_unit", **locals())
+    size = cell_t_prev.shape[-1]
+    concat_in = fc(
+        input=[x_t, hidden_t_prev], size=4 * size,
+        param_attr=param_attr, bias_attr=bias_attr,
+    )
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [concat_in], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    return h, c
+
+
+# -- losses -----------------------------------------------------------------
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op(
+        type="warpctc",
+        inputs=inputs,
+        outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _simple("kldiv_loss", {"X": [x], "Target": [target]},
+                   {"reduction": reduction}, out_slots=("Loss",))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _simple("log_loss", {"Predicted": [input], "Labels": [label]},
+                   {"epsilon": epsilon}, out_slots=("Loss",))
+
+
+def hinge_loss(input, label, name=None):
+    return _simple("hinge_loss", {"Logits": [input], "Labels": [label]},
+                   out_slots=("Loss",))
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple(
+        "rank_loss",
+        {"Label": [label], "Left": [left], "Right": [right]},
+    )
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss")
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        type="margin_rank_loss",
+        inputs={"Label": [label], "X1": [left], "X2": [right]},
+        outputs={"Out": [out], "Activated": [act]},
+        attrs={"margin": float(margin)},
+    )
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    return _simple("bpr_loss", {"X": [input], "Label": [label]},
+                   out_slots=("Y",))
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss", **locals())
+    dtype = helper.input_dtype()
+    centers = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes, input.shape[-1]],
+        dtype=dtype,
+    )
+    from .tensor import fill_constant
+
+    rate = fill_constant(shape=[1], dtype="float32", value=float(alpha))
+    diff = helper.create_variable_for_type_inference(dtype)
+    loss = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="center_loss",
+        inputs={
+            "X": [input], "Label": [label], "Centers": [centers],
+            "CenterUpdateRate": [rate],
+        },
+        outputs={
+            "SampleCenterDiff": [diff], "Loss": [loss],
+            "CentersOut": [centers],
+        },
+        attrs={"need_update": update_center},
+    )
+    return loss
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return _simple(
+        "sigmoid_focal_loss",
+        {"X": [x], "Label": [label], "FgNum": [fg_num]},
+        {"gamma": gamma, "alpha": alpha},
+    )
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: layers/nn.py npair_loss — composed from matmul/softmax."""
+    import paddle_tpu.fluid.layers as L
+
+    similarity = L.matmul(anchor, positive, transpose_y=True)
+    ce = L.mean(L.softmax_with_cross_entropy(similarity, labels))
+    l2 = L.mean(L.reduce_sum(anchor * anchor + positive * positive, dim=[1]))
+    return ce + l2 * l2_reg * 0.25
+
+
+# -- metrics ----------------------------------------------------------------
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference: layers/metric_op.py auc — stateful bucket accumulators."""
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        persistable=True, dtype="int64", shape=[num_thresholds + 1]
+    )
+    stat_neg = helper.create_global_variable(
+        persistable=True, dtype="int64", shape=[num_thresholds + 1]
+    )
+    from ..initializer import Constant
+
+    for var in [stat_pos, stat_neg]:
+        helper.set_variable_initializer(var, Constant(value=0.0))
+    auc_out = helper.create_variable_for_type_inference(dtype="float64")
+    helper.append_op(
+        type="auc",
+        inputs={
+            "Predict": [input], "Label": [label],
+            "StatPos": [stat_pos], "StatNeg": [stat_neg],
+        },
+        outputs={
+            "AUC": [auc_out],
+            "StatPosOut": [stat_pos], "StatNegOut": [stat_neg],
+        },
+        attrs={"curve": curve, "num_thresholds": num_thresholds},
+    )
+    return auc_out, [stat_pos, stat_neg]
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    seq_num = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLength"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLength"] = [label_length]
+    helper.append_op(
+        type="edit_distance",
+        inputs=inputs,
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized,
+               "ignored_tokens": list(ignored_tokens or [])},
+    )
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference(dtype="float32")
+    recall = helper.create_variable_for_type_inference(dtype="float32")
+    f1 = helper.create_variable_for_type_inference(dtype="float32")
+    n_inf = helper.create_variable_for_type_inference(dtype="int64")
+    n_lab = helper.create_variable_for_type_inference(dtype="int64")
+    n_cor = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval",
+        inputs=inputs,
+        outputs={
+            "Precision": [precision], "Recall": [recall],
+            "F1-Score": [f1], "NumInferChunks": [n_inf],
+            "NumLabelChunks": [n_lab], "NumCorrectChunks": [n_cor],
+        },
+        attrs={
+            "num_chunk_types": num_chunk_types,
+            "chunk_scheme": chunk_scheme,
+            "excluded_chunk_types": excluded_chunk_types or [],
+        },
+    )
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+# -- detection --------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box")
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolo_box",
+        inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={
+            "anchors": anchors, "class_num": class_num,
+            "conf_thresh": conf_thresh,
+            "downsample_ratio": downsample_ratio, "clip_bbox": clip_bbox,
+        },
+    )
+    return boxes, scores
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    return _simple(
+        "multiclass_nms",
+        {"BBoxes": [bboxes], "Scores": [scores]},
+        {
+            "score_threshold": score_threshold, "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+            "normalized": normalized, "nms_eta": nms_eta,
+            "background_label": background_label,
+        },
+    )
+
+
+def box_clip(input, im_info, name=None):
+    return _simple("box_clip", {"Input": [input], "ImInfo": [im_info]},
+                   out_slots=("Output",))
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator")
+    anchors = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={
+            "anchor_sizes": anchor_sizes or [64.0, 128.0, 256.0, 512.0],
+            "aspect_ratios": aspect_ratios or [0.5, 1.0, 2.0],
+            "variances": variance or [0.1, 0.1, 0.2, 0.2],
+            "stride": stride or [16.0, 16.0],
+            "offset": offset,
+        },
+    )
+    return anchors, variances
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=None, clip=False,
+                      steps=None, offset=0.5, flatten_to_2d=False,
+                      name=None):
+    helper = LayerHelper("density_prior_box")
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={
+            "densities": densities or [],
+            "fixed_sizes": fixed_sizes or [],
+            "fixed_ratios": fixed_ratios or [],
+            "variances": variance or [0.1, 0.1, 0.2, 0.2],
+            "clip": clip,
+            "step_w": steps[0], "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    return boxes, variances
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match")
+    match_indices = helper.create_variable_for_type_inference("int64")
+    match_distance = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={
+            "ColToRowMatchIndices": [match_indices],
+            "ColToRowMatchDist": [match_distance],
+        },
+        attrs={
+            "match_type": match_type or "bipartite",
+            "dist_threshold": dist_threshold or 0.5,
+        },
+    )
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value or 0},
+    )
+    return out, out_weight
+
+
+def polygon_box_transform(input, name=None):
+    return _simple("polygon_box_transform", {"Input": [input]},
+                   out_slots=("Output",))
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    return _simple(
+        "roi_align", {"X": [input], "ROIs": [rois]},
+        {
+            "pooled_height": pooled_height, "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+            "sampling_ratio": sampling_ratio,
+        },
+    )
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    return _simple(
+        "roi_pool", {"X": [input], "ROIs": [rois]},
+        {
+            "pooled_height": pooled_height, "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    helper = LayerHelper("generate_proposals")
+    rois = helper.create_variable_for_type_inference("float32")
+    roi_probs = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={
+            "Scores": [scores], "BboxDeltas": [bbox_deltas],
+            "ImInfo": [im_info], "Anchors": [anchors],
+            "Variances": [variances],
+        },
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [roi_probs]},
+        attrs={
+            "pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+            "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta,
+        },
+    )
+    return rois, roi_probs
+
+
+# -- geometry / misc --------------------------------------------------------
+def affine_grid(theta, out_shape=None, name=None):
+    helper = LayerHelper("affine_grid")
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {}
+    if hasattr(out_shape, "name"):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = list(out_shape)
+    helper.append_op(
+        type="affine_grid", inputs=inputs,
+        outputs={"Output": [out]}, attrs=attrs,
+    )
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    return _simple("grid_sampler", {"X": [x], "Grid": [grid]},
+                   out_slots=("Output",))
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _simple(
+        "hash", {"X": [input]},
+        {"mod_by": hash_size, "num_hash": num_hash},
+        dtypes={"Out": "int64"},
+    )
+
+
+# -- tensor indexing / manipulation -----------------------------------------
+def gather_nd(input, index, name=None):
+    return _simple("gather_nd", {"X": [input], "Index": [index]})
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _simple(
+        "scatter_nd_add",
+        {"X": [ref], "Index": [index], "Updates": [updates]},
+    )
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _simple(
+        "scatter_nd", {"Index": [index], "Updates": [updates]},
+        {"shape": list(shape)},
+    )
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    return _simple(
+        "strided_slice", {"Input": [input]},
+        {"axes": axes, "starts": starts, "ends": ends, "strides": strides},
+    )
+
+
+def expand_as(x, target_tensor, name=None):
+    return _simple(
+        "expand_as", {"X": [x], "target_tensor": [target_tensor]}
+    )
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(
+        type="multiplex",
+        inputs={"X": inputs, "Ids": [index]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if hasattr(shape, "name"):
+        inputs["Y"] = [shape]
+    elif shape is not None:
+        attrs["shape"] = list(shape)
+    if hasattr(offsets, "name"):
+        inputs["Offsets"] = [offsets]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op(
+        type="crop", inputs=inputs, outputs={"Out": [out]}, attrs=attrs
+    )
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop_tensor")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if hasattr(shape, "name"):
+        inputs["Shape"] = [shape]
+    elif shape is not None:
+        attrs["shape"] = list(shape)
+    if hasattr(offsets, "name"):
+        inputs["Offsets"] = [offsets]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op(
+        type="crop_tensor", inputs=inputs, outputs={"Out": [out]},
+        attrs=attrs,
+    )
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple(
+        "pad_constant_like", {"X": [x], "Y": [y]},
+        {"pad_value": float(pad_value)},
+    )
+
+
+def unique(x, dtype="int64"):
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="unique", inputs={"X": [x]},
+        outputs={"Out": [out], "Index": [index]},
+    )
+    return out, index
+
+
+def unique_with_counts(x, dtype="int64"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="unique_with_counts", inputs={"X": [x]},
+        outputs={"Out": [out], "Index": [index], "Count": [count]},
+    )
+    return out, index, count
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _simple(
+        "shard_index", {"X": [input]},
+        {
+            "index_num": index_num, "nshards": nshards,
+            "shard_id": shard_id, "ignore_value": ignore_value,
+        },
+    )
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple("space_to_depth", {"X": [x]}, {"blocksize": blocksize})
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _simple("pixel_shuffle", {"X": [x]},
+                   {"upscale_factor": upscale_factor})
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple("shuffle_channel", {"X": [x]}, {"group": group})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _simple(
+        "temporal_shift", {"X": [x]},
+        {"seg_num": seg_num, "shift_ratio": shift_ratio},
+    )
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    return _simple("selu", {"X": [x]}, attrs)
+
+
+# -- 3D conv family ---------------------------------------------------------
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else [
+        filter_size] * 3
+    filter_shape = [num_filters, num_channels // groups] + list(ks)
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+    dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 3
+    helper.append_op(
+        type="conv3d",
+        inputs={"Input": [input], "Filter": [filter_param]},
+        outputs={"Output": [out]},
+        attrs={"strides": st, "paddings": pd, "dilations": dl,
+               "groups": groups},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    ks = pool_size if isinstance(pool_size, (list, tuple)) else [
+        pool_size] * 3
+    st = pool_stride if isinstance(pool_stride, (list, tuple)) else [
+        pool_stride] * 3
+    pd = pool_padding if isinstance(pool_padding, (list, tuple)) else [
+        pool_padding] * 3
+    return _simple(
+        "pool3d", {"X": [input]},
+        {
+            "pooling_type": pool_type, "ksize": ks, "strides": st,
+            "paddings": pd, "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode, "exclusive": exclusive,
+        },
+    )
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else [
+        filter_size] * 3
+    filter_shape = [num_channels, num_filters // groups] + list(ks)
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+    dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 3
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [filter_param]},
+        outputs={"Output": [out]},
+        attrs={"strides": st, "paddings": pd, "dilations": dl,
+               "groups": groups},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", **locals())
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w = int(np.prod(weight.shape)) // h if all(
+        s > 0 for s in weight.shape
+    ) else h
+    u = helper.create_parameter(
+        attr=None, shape=[h], dtype=dtype, default_initializer=None
+    )
+    v = helper.create_parameter(
+        attr=None, shape=[w], dtype=dtype, default_initializer=None
+    )
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="spectral_norm",
+        inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False, slot_dim=-1):
+    helper = LayerHelper("data_norm", **locals())
+    dtype = helper.input_dtype()
+    C = input.shape[-1]
+    from ..initializer import Constant
+
+    batch_size = helper.create_parameter(
+        attr=None, shape=[C], dtype=dtype,
+        default_initializer=Constant(value=1.0),
+    )
+    batch_sum = helper.create_parameter(
+        attr=None, shape=[C], dtype=dtype,
+        default_initializer=Constant(value=0.0),
+    )
+    batch_square_sum = helper.create_parameter(
+        attr=None, shape=[C], dtype=dtype,
+        default_initializer=Constant(value=1e4),
+    )
+    means = helper.create_variable_for_type_inference(dtype)
+    scales = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={
+            "X": [input], "BatchSize": [batch_size],
+            "BatchSum": [batch_sum], "BatchSquareSum": [batch_square_sum],
+        },
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    helper = LayerHelper("affine_channel", **locals())
+    from ..initializer import Constant
+
+    C = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    if scale is None:
+        scale = helper.create_parameter(
+            attr=None, shape=[C], dtype=x.dtype,
+            default_initializer=Constant(1.0),
+        )
+    if bias is None:
+        bias = helper.create_parameter(
+            attr=None, shape=[C], dtype=x.dtype,
+            default_initializer=Constant(0.0),
+        )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="affine_channel",
+        inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+        outputs={"Out": [out]},
+        attrs={"data_layout": data_layout},
+    )
+    return helper.append_activation(out)

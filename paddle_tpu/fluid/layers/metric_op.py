@@ -31,10 +31,11 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return acc_out
 
 
-def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
-    """Streaming AUC is stateful host-side; provided via fluid.metrics.Auc.
-    This in-graph version returns batch AUC from the confusion accumulation."""
-    raise NotImplementedError(
-        "in-graph streaming AUC is not supported on the XLA path; "
-        "use paddle_tpu.fluid.metrics.Auc on fetched predictions"
-    )
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """In-graph streaming AUC (reference: metric_op.py auc) — delegates to
+    the extended builder over the stateful ``auc`` op (metric_ops.py)."""
+    from .extended import auc as _auc
+
+    return _auc(input, label, curve=curve, num_thresholds=num_thresholds,
+                topk=topk, slide_steps=slide_steps)

@@ -13,6 +13,27 @@ from ..initializer import Constant, Normal, Xavier
 from ..param_attr import ParamAttr
 
 __all__ = [
+    "scale",
+    "sequence_pool",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_softmax",
+    "sequence_reshape",
+    "sequence_concat",
+    "sequence_expand",
+    "sequence_expand_as",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_slice",
+    "sequence_reverse",
+    "sequence_mask",
+    "sequence_enumerate",
+    "sequence_scatter",
+    "sequence_conv",
+    "row_conv",
+    "im2sequence",
+    "linear_chain_crf",
+    "crf_decoding",
     "fc",
     "embedding",
     "conv2d",
@@ -1295,3 +1316,295 @@ def _pair(v):
     if isinstance(v, (list, tuple)):
         return [int(x) for x in v]
     return [int(v), int(v)]
+
+
+# ---------------------------------------------------------------------------
+# sequence layers (reference: layers/nn.py sequence_* family and
+# layers/sequence_lod.py in later versions) — thin builders over the
+# padded+lengths sequence ops (ops/sequence_ops.py)
+# ---------------------------------------------------------------------------
+def _seq_one_in(op_type, x, attrs=None, out_slot="Out", extra_inputs=None,
+                extra_outputs=None, dtype=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype or x.dtype
+    )
+    inputs = {"X": [x]}
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    outputs = {out_slot: [out]}
+    if extra_outputs:
+        outputs.update(extra_outputs)
+    helper.append_op(
+        type=op_type, inputs=inputs, outputs=outputs, attrs=attrs or {}
+    )
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    """reference: layers/nn.py scale."""
+    helper = LayerHelper("scale", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="scale",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "bias": float(bias),
+               "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out) if act else out
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    """reference: layers/nn.py sequence_pool."""
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    max_index = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="sequence_pool",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "MaxIndex": [max_index]},
+        attrs={"pooltype": pool_type.upper(), "is_test": is_test,
+               "pad_value": pad_value},
+    )
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return _seq_one_in("sequence_softmax", input)
+
+
+def sequence_reshape(input, new_dim):
+    return _seq_one_in("sequence_reshape", input, {"new_dim": new_dim})
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat")
+    out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op(
+        type="sequence_concat", inputs={"X": input}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_expand",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"ref_level": ref_level},
+    )
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_expand_as",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper("sequence_pad")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    length = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="sequence_pad",
+        inputs={"X": [x], "PadValue": [pad_value]},
+        outputs={"Out": [out], "Length": [length]},
+        attrs={"padded_length": maxlen if maxlen is not None else -1},
+    )
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_unpad",
+        inputs={"X": [x], "Length": [length]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_reverse(x, name=None):
+    return _seq_one_in("sequence_reverse", x, out_slot="Y")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="sequence_mask",
+        inputs={"X": [x]},
+        outputs={"Y": [out]},
+        attrs={
+            "maxlen": maxlen if maxlen is not None else -1,
+            "out_dtype": core.np_to_dtype(dtype),
+        },
+    )
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    return _seq_one_in(
+        "sequence_enumerate", input,
+        {"win_size": win_size, "pad_value": pad_value},
+    )
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_scatter",
+        inputs={"X": [input], "Ids": [index], "Updates": [updates]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    """reference: layers/nn.py sequence_conv."""
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype
+    )
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [pre_bias]},
+        attrs={
+            "contextStride": filter_stride,
+            "contextStart": -int(filter_size // 2),
+            "contextLength": filter_size,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="row_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [out]},
+    )
+    return helper.append_activation(out)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else [
+        filter_size, filter_size
+    ]
+    st = stride if isinstance(stride, (list, tuple)) else [stride, stride]
+    pd = padding if isinstance(padding, (list, tuple)) else [
+        padding, padding, padding, padding
+    ]
+    helper.append_op(
+        type="im2sequence",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"kernels": fs, "strides": st, "paddings": pd},
+    )
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference: layers/nn.py linear_chain_crf."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype
+    )
+    alpha = helper.create_variable_for_type_inference(dtype=input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype
+    )
+    transition_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype
+    )
+    log_likelihood = helper.create_variable_for_type_inference(
+        dtype=input.dtype
+    )
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs=inputs,
+        outputs={
+            "Alpha": [alpha],
+            "EmissionExps": [emission_exps],
+            "TransitionExps": [transition_exps],
+            "LogLikelihood": [log_likelihood],
+        },
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding")
+    # look up the transition parameter trained by linear_chain_crf
+    tname = getattr(param_attr, "name", None) or str(param_attr)
+    transition = helper.main_program.global_block()._find_var_recursive(
+        tname
+    )
+    if transition is None:
+        raise ValueError(
+            "crf_decoding: transition parameter %r not found — pass the "
+            "ParamAttr (with its name) used by linear_chain_crf" % tname
+        )
+    viterbi_path = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(
+        type="crf_decoding",
+        inputs=inputs,
+        outputs={"ViterbiPath": [viterbi_path]},
+    )
+    return viterbi_path

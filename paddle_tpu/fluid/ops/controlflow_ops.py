@@ -108,3 +108,139 @@ def _cond_block_lower(ctx, op_):
 
 register_op("while", lower=_while_lower)
 register_op("conditional_block", lower=_cond_block_lower)
+
+
+# ---------------------------------------------------------------------------
+# TensorArray / LoD-array machinery (reference: operators/
+# lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc,
+# controlflow/tensor_array_read_write_op.cc write_to_array/read_from_array,
+# framework/lod_rank_table.cc, operators/shrink_rnn_memory_op.cc,
+# operators/max_sequence_len_op.cc, operators/lod_array_length_op.cc).
+#
+# TPU-native representation: a LOD_TENSOR_ARRAY is a TIME-MAJOR stacked
+# dense tensor [T, B, ...]; the reference's per-step shrinking batches
+# (rank-table bucketing) are replaced by full-batch steps + length masking,
+# which the recurrent/sequence ops already implement. write_to_array is an
+# APPEND (the i input orders writes but sizes are static under XLA);
+# read_from_array gathers a traced index.
+# ---------------------------------------------------------------------------
+def _lod_rank_table_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    names = op_.inputs.get("X") or []
+    lens = ctx.get_opt(names[0] + "@SEQ_LEN") if names else None
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    # rank table value = the length vector (identity order; masking replaces
+    # the reference's sort-by-length bucketing)
+    ctx.out(op_, "Out", lens)
+
+
+def _lod_tensor_to_array_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, ...]
+    ctx.out(op_, "Out", jnp.swapaxes(x, 0, 1))  # [T, B, ...]
+    names = op_.inputs.get("X") or []
+    lens = ctx.get_opt(names[0] + "@SEQ_LEN") if names else None
+    out_names = op_.outputs.get("Out") or []
+    if lens is not None and out_names:
+        ctx.set(out_names[0] + "@SEQ_LEN", lens)
+
+
+def _array_to_lod_tensor_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [T, B, ...]
+    ctx.out(op_, "Out", jnp.swapaxes(x, 0, 1))
+    rt = ctx.in1(op_, "RankTable", optional=True)
+    out_names = op_.outputs.get("Out") or []
+    if rt is not None and out_names:
+        ctx.set(out_names[0] + "@SEQ_LEN", rt.reshape(-1))
+
+
+def _write_to_array_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    arr = ctx.in1(op_, "Out", optional=True)
+    if arr is None:
+        names = op_.outputs.get("Out") or []
+        arr = ctx.get_opt(names[0]) if names else None
+    if arr is None or (hasattr(arr, "size") and arr.size == 0):
+        out = x[None]
+    else:
+        out = jnp.concatenate([arr, x[None]], axis=0)
+    ctx.out(op_, "Out", out)
+
+
+def _read_from_array_lower(ctx, op_):
+    x = ctx.in1(op_, "X")  # [T, ...]
+    i = ctx.in1(op_, "I").reshape(()).astype("int32")
+    ctx.out(op_, "Out", x[i])
+
+
+def _lod_array_length_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.full((1,), x.shape[0], jnp.int64))
+
+
+def _max_sequence_len_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    rt = ctx.in1(op_, "RankTable")
+    ctx.out(op_, "Out", jnp.max(rt).reshape(1).astype(jnp.int64))
+
+
+def _shrink_rnn_memory_lower(ctx, op_):
+    """reference shrinks the batch to sequences still alive at step I; with
+    full-batch masked steps the memory passes through unchanged (dead rows
+    are masked by the recurrent/sequence ops)."""
+    ctx.out(op_, "Out", ctx.in1(op_, "X"))
+
+
+def _is_empty_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "Out", jnp.asarray(x.size == 0).reshape(1))
+
+
+def _split_lod_tensor_lower(ctx, op_):
+    """reference: split_lod_tensor_op.cc routes rows by mask into two
+    tensors. Dense representation: both branches see the full batch; the
+    mask decides at merge time (merge_lod_tensor below)."""
+    x = ctx.in1(op_, "X")
+    ctx.out(op_, "OutTrue", x)
+    ctx.out(op_, "OutFalse", x)
+
+
+def _merge_lod_tensor_lower(ctx, op_):
+    import jax.numpy as jnp
+
+    mask = ctx.in1(op_, "Mask")
+    t = ctx.in1(op_, "InTrue")
+    f = ctx.in1(op_, "InFalse")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
+    ctx.out(op_, "Out", jnp.where(m, t, f))
+
+
+register_op("lod_rank_table", lower=_lod_rank_table_lower)
+register_op("lod_tensor_to_array", lower=_lod_tensor_to_array_lower,
+            grad="generic")
+register_op("array_to_lod_tensor", lower=_array_to_lod_tensor_lower,
+            grad="generic")
+register_op("write_to_array", lower=_write_to_array_lower, grad="generic")
+register_op("read_from_array", lower=_read_from_array_lower, grad="generic")
+register_op("lod_array_length", lower=_lod_array_length_lower)
+register_op("max_sequence_len", lower=_max_sequence_len_lower)
+register_op("shrink_rnn_memory", lower=_shrink_rnn_memory_lower,
+            grad="generic")
+register_op("is_empty", lower=_is_empty_lower)
+register_op("split_lod_tensor", lower=_split_lod_tensor_lower,
+            grad="generic")
+register_op("merge_lod_tensor", lower=_merge_lod_tensor_lower,
+            grad="generic")

@@ -136,6 +136,16 @@ def _edit_distance_host(ctx, op_):
     ref = np.asarray(ctx.scope.get(op_.input("Refs")[0]))
     hyp_lens = ctx.scope.get(op_.input("Hyps")[0] + "@SEQ_LEN")
     ref_lens = ctx.scope.get(op_.input("Refs")[0] + "@SEQ_LEN")
+    # explicit length tensors beat companions (padded-tensor API)
+    if op_.input("HypsLength"):
+        hyp_lens = np.asarray(
+            ctx.scope.get(op_.input("HypsLength")[0])
+        ).reshape(-1)
+    if op_.input("RefsLength"):
+        ref_lens = np.asarray(
+            ctx.scope.get(op_.input("RefsLength")[0])
+        ).reshape(-1)
+    ignored = set(int(t) for t in (op_.attr("ignored_tokens") or []))
     normalized = bool(op_.attr("normalized", True))
     if hyp.ndim == 3:
         hyp = hyp[:, :, 0]
@@ -152,8 +162,8 @@ def _edit_distance_host(ctx, op_):
     )
     out = np.zeros((B, 1), np.float32)
     for b in range(B):
-        h = hyp[b, : hl[b]]
-        r = ref[b, : rl[b]]
+        h = [t for t in hyp[b, : hl[b]] if int(t) not in ignored]
+        r = [t for t in ref[b, : rl[b]] if int(t) not in ignored]
         m, n = len(h), len(r)
         dp = np.zeros((m + 1, n + 1), np.int64)
         dp[:, 0] = np.arange(m + 1)
@@ -179,6 +189,10 @@ def _chunk_eval_host(ctx, op_):
     inf = np.asarray(ctx.scope.get(op_.input("Inference")[0]))
     lab = np.asarray(ctx.scope.get(op_.input("Label")[0]))
     lens_v = ctx.scope.get(op_.input("Inference")[0] + "@SEQ_LEN")
+    if op_.input("SeqLength"):
+        lens_v = np.asarray(
+            ctx.scope.get(op_.input("SeqLength")[0])
+        ).reshape(-1)
     num_chunk_types = int(op_.attr("num_chunk_types"))
     scheme = op_.attr("chunk_scheme", "IOB")
     if inf.ndim == 3:

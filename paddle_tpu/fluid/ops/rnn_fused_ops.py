@@ -38,8 +38,10 @@ def _act(name):
 def _seq_lens(ctx, op_, slot, B, T):
     import jax.numpy as jnp
 
+    from .sequence_ops import lengths_for
+
     names = op_.inputs.get(slot) or []
-    lens = ctx.get_opt(names[0] + "@SEQ_LEN") if names else None
+    lens = lengths_for(ctx, names[0]) if names else None
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
     return lens

@@ -106,6 +106,11 @@ def _recurrent_lower(ctx, op_):
     xs = ctx.ins(op_, "Inputs")
     states = tuple(ctx.ins(op_, "InitStates"))
     seq_len = ctx.in1(op_, "SequenceLength", optional=True)
+    if seq_len is None:
+        # ragged inputs carry lengths as @SEQ_LEN companions (DynamicRNN)
+        in_names = op_.inputs.get("Inputs") or []
+        if in_names:
+            seq_len = ctx.get_opt(in_names[0] + "@SEQ_LEN")
 
     if not time_major:
         xs = [jnp.swapaxes(x, 0, 1) for x in xs]  # -> [T, N, ...]
@@ -113,6 +118,10 @@ def _recurrent_lower(ctx, op_):
         xs = [jnp.flip(x, 0) for x in xs]
 
     frozen = _frozen_env(ctx, sub, step_in + st_in)
+    for n in op_.inputs.get("Parameters") or []:
+        v = ctx.get_opt(n)
+        if v is not None:
+            frozen[n] = v
     base_key = ctx.base_key
 
     def body(carry, xt):
@@ -143,7 +152,13 @@ def _recurrent_lower(ctx, op_):
                 cond = alive.reshape((-1,) + (1,) * (new.ndim - 1))
                 return jnp.where(cond, new, old)
             new_st = tuple(_mask(n_, o_) for n_, o_ in zip(new_st, st))
-        outs = tuple(env[n] for n in out_names)
+            # dead steps emit zeros (the reference's shrunken batches never
+            # produce rows past a sequence's end)
+            outs = tuple(
+                _mask(env[n], jnp.zeros_like(env[n])) for n in out_names
+            )
+        else:
+            outs = tuple(env[n] for n in out_names)
         return (t + 1, new_st), outs
 
     t0 = jnp.asarray(0, jnp.int32)
@@ -155,6 +170,10 @@ def _recurrent_lower(ctx, op_):
         ys = [jnp.swapaxes(y, 0, 1) for y in ys]
     ctx.outs(op_, "Outputs", ys)
     ctx.outs(op_, "FinalStates", list(final))
+    if seq_len is not None:
+        for n in op_.outputs.get("Outputs") or []:
+            if n != "@EMPTY@":
+                ctx.set(n + "@SEQ_LEN", seq_len.reshape(-1))
 
 
 # ---------------------------------------------------------------------------

@@ -57,11 +57,42 @@ def reverse_valid_prefix(x, lengths):
     )
 
 
+# ops that preserve the [B, T] leading layout, so a missing companion can
+# be inherited from their main input (e.g. the fc projection feeding an
+# lstm op keeps the time structure)
+_COMPANION_TRANSPARENT = {
+    # strictly [B, T]-layout-preserving ops only: concat/matmul can change
+    # the time axis and must NOT inherit companions
+    "mul", "elementwise_add", "elementwise_sub",
+    "elementwise_mul", "elementwise_div", "scale", "cast", "sum",
+    "dropout", "relu", "tanh", "sigmoid", "gelu", "leaky_relu",
+    "softmax", "layer_norm",
+}
+
+
+def lengths_for(ctx, name, _depth=8):
+    """Companion lengths for ``name``, chaining up through
+    layout-preserving producer ops when the direct companion is absent."""
+    v = ctx.get_opt(name + "@SEQ_LEN")
+    if v is not None or ctx.block is None or _depth <= 0:
+        return v
+    for op_ in ctx.block.ops:
+        if name in op_.output_arg_names:
+            if op_.type not in _COMPANION_TRANSPARENT:
+                return None
+            for n in op_.input_arg_names:
+                got = lengths_for(ctx, n, _depth - 1)
+                if got is not None:
+                    return got
+            return None
+    return None
+
+
 def _lengths(ctx, op_, slot="X"):
     names = op_.inputs.get(slot) or []
     if not names:
         return None
-    return ctx.get_opt(names[0] + "@SEQ_LEN")
+    return lengths_for(ctx, names[0])
 
 
 def _lengths_or_full(ctx, op_, x, slot="X"):

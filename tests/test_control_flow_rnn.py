@@ -1,0 +1,171 @@
+"""StaticRNN / DynamicRNN / IfElse + TensorArray machinery tests
+(reference tests: test_recurrent_op.py, test_dynrnn_static_input.py,
+test_ifelse.py, test_lod_tensor_array_ops.py, test_lod_rank_table.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def test_static_rnn_forward():
+    B, T, D, H = 3, 4, 5, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32")
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[H], value=0.0)
+            h = fluid.layers.fc(input=[xt, prev], size=H, act="tanh")
+            rnn.update_memory(prev, h)
+            rnn.output(h)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.random.RandomState(0).rand(B, T, D).astype("float32")
+    (o,) = exe.run(main, feed={"x": xb}, fetch_list=[out], scope=scope)
+    o = np.asarray(o)
+    assert o.shape == (B, T, H), o.shape
+
+    # numpy oracle: fluid fc sums one mul per input (w_0 for xt, w_1 for
+    # the memory), then adds the bias
+    w0 = np.asarray(scope.get("fc_0.w_0"))
+    w1 = np.asarray(scope.get("fc_0.w_1"))
+    b = np.asarray(scope.get("fc_0.b_0"))
+    h = np.zeros((B, H))
+    for t in range(T):
+        h = np.tanh(xb[:, t] @ w0 + h @ w1 + b)
+        np.testing.assert_allclose(o[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_rnn_masks_short_sequences():
+    B, T, D, H = 3, 5, 4, 4
+    lens = [5, 2, 3]
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32",
+                              lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            prev = drnn.memory(shape=[H], value=0.0)
+            h = fluid.layers.fc(input=[xt, prev], size=H, act="tanh")
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+        last = fluid.layers.sequence_pool(out, pool_type="last")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.random.RandomState(1).rand(B, T, D).astype("float32")
+    t = core.LoDTensor(xb)
+    t.set_recursive_sequence_lengths([lens])
+    o, lastv = exe.run(main, feed={"x": t}, fetch_list=[out, last],
+                       scope=scope)
+    o, lastv = np.asarray(o), np.asarray(lastv)
+    # outputs past each sequence's end are zero
+    for b_, ln in enumerate(lens):
+        assert np.allclose(o[b_, ln:], 0.0)
+        assert np.any(np.abs(o[b_, ln - 1]) > 0)
+        np.testing.assert_allclose(lastv[b_], o[b_, ln - 1], rtol=1e-5)
+
+
+def test_ifelse_row_select():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        limit = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=0.5)
+        row_mean = fluid.layers.reduce_mean(x, dim=[1], keep_dim=True)
+        cond = fluid.layers.less_than(row_mean, limit)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(fluid.layers.scale(d, scale=10.0))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(fluid.layers.scale(d, scale=-1.0))
+        (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xb = np.array([[0.1, 0.1, 0.1], [0.9, 0.9, 0.9], [0.2, 0.3, 0.1]],
+                  "float32")
+    (o,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    o = np.asarray(o)
+    np.testing.assert_allclose(o[0], xb[0] * 10.0, rtol=1e-5)
+    np.testing.assert_allclose(o[1], xb[1] * -1.0, rtol=1e-5)
+    np.testing.assert_allclose(o[2], xb[2] * 10.0, rtol=1e-5)
+
+
+def test_lod_tensor_array_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 3], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        n = fluid.layers.array_length(arr)
+        mx = fluid.layers.max_sequence_len(table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        pooled = fluid.layers.sequence_pool(back, pool_type="sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xb = np.random.RandomState(2).rand(2, 4, 3).astype("float32")
+    t = core.LoDTensor(xb)
+    t.set_recursive_sequence_lengths([[4, 2]])
+    nv, mv, bv, pv = exe.run(
+        main, feed={"x": t}, fetch_list=[n, mx, back, pooled]
+    )
+    assert int(np.asarray(nv)[0]) == 4  # time-major array length
+    assert int(np.asarray(mv)[0]) == 4  # longest sequence
+    np.testing.assert_allclose(np.asarray(bv), xb, rtol=1e-6)
+    # pooled respects the lengths recovered from the rank table
+    mask = (np.arange(4)[None, :] < np.array([4, 2])[:, None])[:, :, None]
+    np.testing.assert_allclose(
+        np.asarray(pv), (xb * mask).sum(1), rtol=1e-5
+    )
+
+
+def test_dynamic_rnn_trains():
+    B, T, D, H = 4, 5, 3, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            prev = drnn.memory(shape=[H], value=0.0)
+            h = fluid.layers.fc(input=[xt, prev], size=H, act="tanh")
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+        last = fluid.layers.sequence_pool(out, pool_type="last")
+        pred = fluid.layers.fc(input=last, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(3)
+    losses = []
+    for _ in range(10):
+        xb = rs.rand(B, T, D).astype("float32")
+        lens = rs.randint(2, T + 1, B).tolist()
+        yb = np.array(
+            [[xb[b, :lens[b]].mean()] for b in range(B)], "float32"
+        )
+        t = core.LoDTensor(xb)
+        t.set_recursive_sequence_lengths([lens])
+        (l,) = exe.run(main, feed={"x": t, "y": yb}, fetch_list=[loss],
+                       scope=scope)
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0], losses

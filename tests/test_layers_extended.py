@@ -1,0 +1,101 @@
+"""Extended layer surface smoke tests (reference: test_layers.py builds
+every layer into a Program and runs it)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def _run(main, startup, feed, fetch, scope=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = scope or core.Scope()
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=fetch, scope=scope)
+
+
+def test_dynamic_lstm_layer():
+    B, T, D = 2, 5, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32",
+                              lod_level=1)
+        proj = fluid.layers.fc(input=x, size=4 * D, num_flatten_dims=2,
+                               bias_attr=False)
+        hidden, cell = fluid.layers.dynamic_lstm(
+            input=proj, size=4 * D, use_peepholes=False
+        )
+        pooled = fluid.layers.sequence_pool(hidden, pool_type="last")
+    xb = np.random.RandomState(0).rand(B, T, D).astype("float32")
+    t = core.LoDTensor(xb)
+    t.set_recursive_sequence_lengths([[5, 3]])
+    (h, p) = _run(main, startup, {"x": t}, [hidden, pooled])
+    h = np.asarray(h)
+    assert h.shape == (B, T, D)
+    assert np.allclose(h[1, 3:], 0)  # masked past length 3
+    np.testing.assert_allclose(np.asarray(p)[1], h[1, 2], rtol=1e-5)
+
+
+def test_dynamic_gru_layer():
+    B, T, D = 2, 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, 3 * D], dtype="float32")
+        h = fluid.layers.dynamic_gru(input=x, size=D)
+    xb = np.random.RandomState(1).rand(B, T, 3 * D).astype("float32")
+    (o,) = _run(main, startup, {"x": xb}, [h])
+    assert np.asarray(o).shape == (B, T, D)
+
+
+def test_detection_layers_build_and_run():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        anchors, variances = fluid.layers.anchor_generator(
+            input=img, anchor_sizes=[32.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0],
+        )
+        theta = fluid.layers.data(name="theta", shape=[2, 3],
+                                  dtype="float32")
+        grid = fluid.layers.affine_grid(theta, out_shape=[1, 1, 4, 4])
+        sampled = fluid.layers.grid_sampler(img, grid)
+    feed = {
+        "img": np.random.RandomState(2).rand(1, 3, 8, 8).astype("float32"),
+        "theta": np.array([[[1, 0, 0], [0, 1, 0]]], "float32"),
+    }
+    a, g, s = _run(main, startup, feed, [anchors, grid, sampled])
+    assert np.asarray(a).shape == (8, 8, 1, 4)
+    assert np.asarray(s).shape == (1, 3, 4, 4)
+
+
+def test_gather_scatter_layers():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 3], dtype="float32")
+        idx = fluid.layers.data(name="idx", shape=[2, 2], dtype="int64")
+        g = fluid.layers.gather_nd(x, idx)
+        ss = fluid.layers.strided_slice(
+            x, axes=[1], starts=[0], ends=[4], strides=[2]
+        )
+    xb = np.arange(24).reshape(2, 4, 3).astype("float32")
+    ib = np.array([[[0, 1], [1, 0]], [[0, 0], [1, 2]]], "int64")
+    gv, sv = _run(main, startup, {"x": xb, "idx": ib}, [g, ss])
+    np.testing.assert_allclose(np.asarray(gv)[0, 0], xb[0, 1])
+    assert np.asarray(sv).shape == (2, 2, 3)
+
+
+def test_auc_layer_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        p = fluid.layers.data(name="p", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        auc_out, states = fluid.layers.auc(p, y, num_thresholds=100)
+    scope = core.Scope()
+    pb = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]],
+                  "float32")
+    yb = np.array([[1], [0], [1], [0]], "int64")
+    (a1,) = _run(main, startup, {"p": pb, "y": yb}, [auc_out], scope=scope)
+    assert 0.99 <= float(np.asarray(a1)) <= 1.0  # perfectly separable
