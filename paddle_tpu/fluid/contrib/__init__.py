@@ -4,3 +4,5 @@ from . import mixed_precision  # noqa: F401
 from .mixed_precision import decorate  # noqa: F401
 from . import memory_usage_calc  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
+
+from . import slim  # noqa: F401

@@ -1,0 +1,5 @@
+"""Slim: quantization-aware training, post-training quantization, pruning
+(reference: python/paddle/fluid/contrib/slim/)."""
+
+from . import quantization  # noqa: F401
+from .prune import prune_by_ratio, sensitivity  # noqa: F401

@@ -1,0 +1,7 @@
+from .quantization_pass import (  # noqa: F401
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+    convert,
+    quant_aware,
+)
+from .post_training_quantization import PostTrainingQuantization  # noqa: F401

@@ -1,0 +1,54 @@
+"""Post-training quantization (reference: contrib/slim/quantization/
+post_training_quantization.py): run calibration batches, collect
+activation abs-max ranges, then emit the quantized (frozen) program."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .quantization_pass import QuantizationTransformPass
+
+
+class PostTrainingQuantization(object):
+    def __init__(self, executor, program, feed_names, fetch_list,
+                 data_reader=None, batch_nums=10, scope=None,
+                 algo="abs_max", weight_bits=8, activation_bits=8):
+        self._executor = executor
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch_list = fetch_list
+        self._data_reader = data_reader
+        self._batch_nums = batch_nums
+        self._scope = scope
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+
+    def quantize(self):
+        """Rewrite with QAT observers, run calibration batches (observers
+        accumulate moving-average scales in the scope), then freeze."""
+        from . import convert
+
+        QuantizationTransformPass(
+            weight_bits=self._weight_bits,
+            activation_bits=self._activation_bits,
+        ).apply(self._program, None, for_test=False)
+        # calibration: scales initialize to 0 in the scope, observers fill
+        scope = self._scope
+        if scope is None:
+            from ....core import global_scope
+
+            scope = global_scope()
+            self._scope = scope
+        for v in self._program.list_vars():
+            if ".scale" in v.name and v.persistable:
+                if scope.get(v.name) is None:
+                    scope.set(v.name, np.zeros(1, np.float32))
+        if self._data_reader is not None:
+            for i, feed in enumerate(self._data_reader()):
+                if i >= self._batch_nums:
+                    break
+                self._executor.run(
+                    self._program, feed=feed,
+                    fetch_list=self._fetch_list, scope=self._scope,
+                )
+        return convert(self._program)

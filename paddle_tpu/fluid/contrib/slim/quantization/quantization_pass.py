@@ -1,0 +1,184 @@
+"""Quantization-aware training passes.
+
+Reference: contrib/slim/quantization/quantization_pass.py —
+QuantizationTransformPass (:118) inserts fake_quant on the inputs of
+quantizable ops and fake_dequant after, on the IrGraph;
+QuantizationFreezePass rewrites for inference.
+
+TPU-native: the rewrite happens on the Program (no IrGraph layer —
+fluid/framework.py Programs ARE the IR here); the inserted
+quantize-dequantize ops fuse into the surrounding matmul in XLA, and the
+straight-through estimator flows gradients (ops/quant_ops.py).
+"""
+
+from __future__ import annotations
+
+from ....framework import OP_ROLE_KEY, OpRole
+from .... import unique_name
+from ....initializer import Constant
+
+QUANTIZABLE_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y"}
+_ACT_SLOTS = {"conv2d": "Input", "depthwise_conv2d": "Input",
+              "mul": "X", "matmul": "X"}
+
+
+class QuantizationTransformPass(object):
+    """Insert fake quant-dequant on weights (abs_max, channel-wise for
+    convs) and activations (moving-average abs_max) of quantizable ops."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, skip_pattern="skip_quant",
+                 quantizable_op_type=QUANTIZABLE_OPS,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._quantizable = tuple(quantizable_op_type)
+        self._weight_quantize_type = weight_quantize_type
+        self._activation_quantize_type = activation_quantize_type
+        self._moving_rate = moving_rate
+        self._skip_pattern = skip_pattern
+        self._scope = scope
+        self._place = place
+
+    def apply(self, program, startup_program=None, for_test=False):
+        block = program.global_block()
+        quantized = {}  # var name -> qdq output name (shared across readers)
+        i = 0
+        while i < len(block.ops):
+            op_ = block.ops[i]
+            role = op_.attr(OP_ROLE_KEY, 0)
+            if (
+                op_.type not in self._quantizable
+                or role & (OpRole.Backward | OpRole.Optimize)
+                or op_.attr("skip_quant", False)
+            ):
+                i += 1
+                continue
+            n_inserted = 0
+            for slot, is_weight in (
+                (_ACT_SLOTS.get(op_.type), False),
+                (_WEIGHT_SLOTS.get(op_.type), True),
+            ):
+                names = op_.inputs.get(slot) or []
+                if not names:
+                    continue
+                name = names[0]
+                if name in quantized:
+                    op_.inputs[slot] = [quantized[name]]
+                    continue
+                qname = self._insert_qdq(
+                    program, block, i, name, is_weight, for_test,
+                    startup_program,
+                )
+                n_ops = 1
+                quantized[name] = qname
+                op_.inputs[slot] = [qname]
+                n_inserted += n_ops
+            i += 1 + n_inserted
+        program._bump_version()
+        return program
+
+    def _insert_qdq(self, program, block, idx, name, is_weight, for_test,
+                    startup_program):
+        src = block._find_var_recursive(name)
+        qname = unique_name.generate(name + ".quantized.dequantized")
+        block.create_var(name=qname, shape=src.shape if src else None,
+                         dtype=src.dtype if src else "float32")
+        scale_name = unique_name.generate(name + ".scale")
+        bits = self._weight_bits if is_weight else self._activation_bits
+        if is_weight and self._weight_quantize_type == "channel_wise_abs_max":
+            scale = block.create_var(
+                name=scale_name, shape=[src.shape[0]], dtype="float32"
+            )
+            block._insert_op(
+                idx,
+                type="fake_channel_wise_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [scale]},
+                attrs={"bit_length": bits, "quant_axis": 0},
+            )
+        elif is_weight:
+            scale = block.create_var(
+                name=scale_name, shape=[1], dtype="float32"
+            )
+            block._insert_op(
+                idx,
+                type="fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [scale]},
+                attrs={"bit_length": bits},
+            )
+        else:
+            # activations: stateful moving-average scale
+            scale = block.create_var(
+                name=scale_name, shape=[1], dtype="float32",
+                persistable=True,
+            )
+            if startup_program is not None:
+                sb = startup_program.global_block()
+                sb.create_var(name=scale_name, shape=[1], dtype="float32",
+                              persistable=True)
+                sb.append_op(
+                    type="fill_constant",
+                    inputs={},
+                    outputs={"Out": [scale_name]},
+                    attrs={"shape": [1], "value": 0.0, "dtype": 5},
+                )
+            block._insert_op(
+                idx,
+                type="fake_quantize_dequantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [scale_name]},
+                outputs={"Out": [qname], "OutScale": [scale_name]},
+                attrs={
+                    "bit_length": bits,
+                    "moving_rate": self._moving_rate,
+                    "is_test": for_test,
+                },
+            )
+        return qname
+
+
+class QuantizationFreezePass(object):
+    """reference: QuantizationFreezePass — for inference the QAT program
+    already simulates int8 exactly (qdq is pure function of frozen scales
+    with is_test=True); freezing flips the observers to test mode."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type="abs_max"):
+        pass
+
+    def apply(self, program):
+        for block in program.blocks:
+            for op_ in block.ops:
+                if op_.type.startswith("fake_quantize") and op_.has_attr(
+                    "is_test"
+                ):
+                    op_.attrs["is_test"] = True
+        program._bump_version()
+        return program
+
+
+def quant_aware(program, startup_program=None, weight_bits=8,
+                activation_bits=8, for_test=False,
+                weight_quantize_type="abs_max",
+                activation_quantize_type="moving_average_abs_max"):
+    """One-call QAT rewrite (the paddleslim-style facade)."""
+    QuantizationTransformPass(
+        weight_bits=weight_bits,
+        activation_bits=activation_bits,
+        weight_quantize_type=weight_quantize_type,
+        activation_quantize_type=activation_quantize_type,
+    ).apply(program, startup_program, for_test=for_test)
+    return program
+
+
+def convert(program):
+    """Freeze a QAT program for inference."""
+    return QuantizationFreezePass().apply(program)
+
+
+_ = Constant

@@ -67,9 +67,18 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             self._role = Role.WORKER
         else:
             role = os.getenv("TRAINING_ROLE", "TRAINER")
-            self._worker_endpoints = os.getenv(
-                "PADDLE_TRAINER_ENDPOINTS", ""
-            ).split(",")
+            self._worker_endpoints = [
+                e
+                for e in os.getenv(
+                    "PADDLE_TRAINER_ENDPOINTS", ""
+                ).split(",")
+                if e
+            ]
+            # pserver mode needs only a trainer COUNT, not endpoints
+            # (reference launch env sets PADDLE_TRAINERS_NUM)
+            n = int(os.getenv("PADDLE_TRAINERS_NUM", "0") or 0)
+            if n and len(self._worker_endpoints) != n:
+                self._worker_endpoints = ["w%d" % i for i in range(n)]
             self._server_endpoints = os.getenv(
                 "PADDLE_PSERVERS_IP_PORT_LIST", ""
             ).split(",")

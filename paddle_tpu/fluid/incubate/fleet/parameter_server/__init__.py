@@ -1,0 +1,156 @@
+"""Parameter-server fleet (reference: incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py — DistributedTranspiler fleet +
+TranspilerOptimizer).
+
+Wraps DistributeTranspiler over the native RPC pserver runtime
+(ops/distributed_ops.py listen_and_serv): workers train with
+send/recv-rewritten programs; servers block in the serve loop. The roles
+come from the role maker (env-driven PaddleCloudRoleMaker by default,
+reference role_maker.py).
+"""
+
+from __future__ import annotations
+
+from .... import io as _io
+from ....executor import Executor
+from ....framework import default_main_program, default_startup_program
+from ....transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+
+class DistributedTranspilerFleet(Fleet):
+    def __init__(self):
+        super().__init__(Mode.TRANSPILER)
+        self._transpiler = None
+        self._main_program = None
+        self._startup_program = None
+        self._pserver_program = None
+        self._pserver_startup = None
+        self._trainer_program = None
+        self._communicator = None
+
+    # -- lifecycle (reference fleet API) -----------------------------------
+    def init_worker(self):
+        """Run the startup program (local init + authoritative param pull
+        from the pservers; reference init_worker runs the recv startup)."""
+        exe = self._executor or Executor()
+        exe.run(self._startup_program or default_startup_program())
+        if not getattr(self._transpiler, "sync_mode", True):
+            from ....communicator import Communicator
+
+            self._communicator = Communicator(
+                program=self._trainer_program,
+                trainer_id=self.worker_index(),
+            )
+            self._communicator.start()
+
+    def init_server(self, model_dir=None):
+        t = self._require_transpiler()
+        ep = self._current_server_endpoint()
+        self._pserver_program, self._pserver_startup = t.get_pserver_programs(
+            ep
+        )
+        exe = self._executor or Executor()
+        exe.run(self._pserver_startup)
+        if model_dir:
+            _io.load_persistables(
+                exe, model_dir, main_program=self._pserver_program
+            )
+
+    def run_server(self):
+        """Blocks in listen_and_serv until every trainer COMPLETEs."""
+        exe = self._executor or Executor()
+        exe.run(self._pserver_program)
+
+    def stop_worker(self):
+        if self._communicator is not None:
+            self._communicator.stop()
+            self._communicator = None
+        exe = self._executor or Executor()
+        exe.close()
+
+    # -- program accessors --------------------------------------------------
+    def main_program(self):
+        return self._trainer_program
+
+    def startup_program(self):
+        return self._startup_program
+
+    def _current_server_endpoint(self):
+        eps = self.server_endpoints()
+        idx = self.server_index()
+        return eps[idx]
+
+    def _require_transpiler(self):
+        if self._transpiler is None:
+            raise RuntimeError(
+                "call fleet.distributed_optimizer(...).minimize(...) first"
+            )
+        return self._transpiler
+
+    # -- optimizer ----------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = TranspilerOptimizer(self, optimizer, strategy)
+        return self._optimizer
+
+    # -- persistence --------------------------------------------------------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        return _io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self._main_program,
+        )
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        return _io.save_persistables(
+            executor, dirname, main_program or self._main_program
+        )
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    """reference: TranspilerOptimizer — minimize then transpile by role."""
+
+    def __init__(self, fleet, optimizer, strategy=None):
+        super().__init__(optimizer, strategy)
+        self._fleet = fleet
+        if strategy is not None and not isinstance(
+            strategy, DistributeTranspilerConfig
+        ):
+            raise TypeError(
+                "strategy must be a DistributeTranspilerConfig"
+            )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+        )
+        fleet = self._fleet
+        fleet._main_program = loss.block.program
+        fleet._startup_program = (
+            startup_program or default_startup_program()
+        )
+        config = self._strategy or DistributeTranspilerConfig()
+        t = DistributeTranspiler(config=config)
+        t.transpile(
+            trainer_id=fleet.worker_index() if fleet.is_worker() else 0,
+            program=fleet._main_program,
+            pservers=fleet.server_endpoints(to_string=True),
+            trainers=fleet.worker_num(),
+            sync_mode=getattr(config, "sync_mode", True),
+            startup_program=fleet._startup_program,
+            current_endpoint=(
+                fleet._current_server_endpoint()
+                if fleet.is_server()
+                else ""
+            ),
+        )
+        fleet._transpiler = t
+        if fleet.is_worker():
+            fleet._trainer_program = t.get_trainer_program()
+        return ops, params_grads
+
+
+fleet = DistributedTranspilerFleet()

@@ -30,3 +30,4 @@ from . import loss_ops  # noqa: F401
 from . import rnn_fused_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

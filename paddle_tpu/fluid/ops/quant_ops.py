@@ -1,0 +1,135 @@
+"""Fake-quantization ops for QAT/PTQ.
+
+Reference kernels: paddle/fluid/operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_quantize_moving_average_abs_max,
+fake_channel_wise_quantize_abs_max, fake_quantize_range_abs_max,
+fake_quantize_dequantize_moving_average_abs_max — and
+fake_dequantize_op.cc (fake_dequantize_max_abs).
+
+TPU-native: quantize-dequantize simulation in one fused XLA expression
+with a straight-through estimator for the round (the reference's backward
+passes gradients straight through too — fake_quantize_grad). bf16/int8
+matmuls on the MXU consume the same scales at deployment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import op, same_shape_infer
+
+
+def _ste_round(x):
+    """Round with straight-through gradient."""
+    import jax
+
+    return x + jax.lax.stop_gradient(jax.numpy.round(x) - x)
+
+
+def _qdq(x, scale, bits):
+    """Quantize-dequantize: x -> round(x/scale * qmax) * scale / qmax."""
+    import jax.numpy as jnp
+
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(_ste_round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+@op("fake_quantize_abs_max", infer_shape=same_shape_infer("X"),
+    grad="generic")
+def _fake_quantize_abs_max(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    bits = int(op_.attr("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    ctx.out(op_, "Out", _qdq(x, scale, bits))
+    ctx.out(op_, "OutScale", scale.reshape(1))
+
+
+@op("fake_channel_wise_quantize_abs_max",
+    infer_shape=same_shape_infer("X"), grad="generic")
+def _fake_channel_wise_quantize_abs_max(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    bits = int(op_.attr("bit_length", 8))
+    axis = int(op_.attr("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _qdq(x, scale, bits)
+    ctx.out(op_, "Out", out)
+    ctx.out(op_, "OutScale", scale.reshape(-1))
+
+
+@op("fake_quantize_moving_average_abs_max",
+    infer_shape=same_shape_infer("X"), grad="generic",
+    stateful_inputs=(("InScale", "OutScale"),))
+def _fake_quantize_moving_average_abs_max(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    in_scale = ctx.in1(op_, "InScale").reshape(())
+    bits = int(op_.attr("bit_length", 8))
+    rate = float(op_.attr("moving_rate", 0.9))
+    is_test = bool(op_.attr("is_test", False))
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale
+    else:
+        scale = jnp.where(
+            in_scale > 0, rate * in_scale + (1 - rate) * cur, cur
+        )
+    ctx.out(op_, "Out", _qdq(x, scale, bits))
+    ctx.out(op_, "OutScale", scale.reshape(1))
+
+
+@op("fake_quantize_dequantize_moving_average_abs_max",
+    infer_shape=same_shape_infer("X"), grad="generic",
+    stateful_inputs=(("InScale", "OutScale"),))
+def _fake_qdq_moving_average(ctx, op_):
+    _fake_quantize_moving_average_abs_max(ctx, op_)
+
+
+@op("fake_quantize_range_abs_max", infer_shape=same_shape_infer("X"),
+    grad="generic", stateful_inputs=(("InScale", "OutScale"),))
+def _fake_quantize_range_abs_max(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    in_scale = ctx.in1(op_, "InScale").reshape(())
+    bits = int(op_.attr("bit_length", 8))
+    is_test = bool(op_.attr("is_test", False))
+    cur = jnp.max(jnp.abs(x))
+    scale = in_scale if is_test else jnp.maximum(in_scale, cur)
+    ctx.out(op_, "Out", _qdq(x, scale, bits))
+    ctx.out(op_, "OutScale", scale.reshape(1))
+
+
+@op("fake_dequantize_max_abs", infer_shape=same_shape_infer("X"),
+    grad="generic")
+def _fake_dequantize_max_abs(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    scale = ctx.in1(op_, "Scale").reshape(())
+    max_range = float(op_.attr("max_range", 127.0))
+    ctx.out(op_, "Out", x * scale / jnp.asarray(max_range, x.dtype))
+
+
+@op("moving_average_abs_max_scale", infer_shape=same_shape_infer("X"),
+    grad="generic", stateful_inputs=(("InScale", "OutScale"),))
+def _moving_average_abs_max_scale(ctx, op_):
+    """Scale observer only (reference: out = x unchanged)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    in_scale = ctx.in1(op_, "InScale").reshape(())
+    rate = float(op_.attr("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    scale = jnp.where(
+        in_scale > 0, rate * in_scale + (1 - rate) * cur, cur
+    )
+    ctx.out(op_, "Out", x)
+    ctx.out(op_, "OutScale", scale.reshape(1))

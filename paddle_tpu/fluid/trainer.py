@@ -1,39 +1,189 @@
-"""Dataset trainer loop (reference: the Trainer/DeviceWorker stack —
-framework/trainer.h:38-114 MultiTrainer/DistMultiTrainer, hogwild_worker.cc
-loop :163-186, entered via Executor::RunFromDataset executor.cc:157).
+"""Trainer / DeviceWorker stack for file-dataset training.
 
-TPU-native: "threads" of HogwildWorker become a single SPMD train step fed by
-host threads; lock-free CPU hogwild has no TPU analogue (replicas are
-synchronous by construction), so thread_num shards the input files only."""
+Reference: framework/trainer.h:38-114 (TrainerBase, MultiTrainer,
+DistMultiTrainer, PipelineTrainer), device workers hogwild_worker.cc:163
+(lock-free CPU loop), downpour_worker.cc (pserver sparse),
+section_worker.cc (pipeline), configured by trainer_desc.proto and entered
+via Executor::RunFromDataset (executor.cc:157).
+
+TPU-native redesign: lock-free hogwild threads have no TPU analogue — the
+chip executes one program at a time and replicas are synchronous by
+construction. What survives is the PIPELINE: reader threads parse/batch
+files ahead of the device while it runs the previous step — the same
+producer/consumer overlap HogwildWorker got from threads, applied where
+the bottleneck actually is on TPU (host input processing).
+DistMultiTrainer adds the pserver communicator push around the same loop;
+PipelineTrainer feeds the stage-partitioned executor (fluid/pipeline.py).
+"""
 
 from __future__ import annotations
 
+import queue as _queue
+import threading
+
 import numpy as np
+
+
+class TrainerBase(object):
+    """reference: trainer.h:38 TrainerBase."""
+
+    def __init__(self, thread_num=1):
+        self.thread_num = max(int(thread_num), 1)
+
+    def train(self, executor, program, dataset, scope=None, fetch_list=None,
+              fetch_info=None, print_period=100):
+        raise NotImplementedError
+
+
+class MultiTrainer(TrainerBase):
+    """reference: trainer.h:64 MultiTrainer + HogwildWorker loop
+    (hogwild_worker.cc:163). A reader thread streams the dataset's batches
+    through a bounded queue; the device consumes in order while the host
+    parses ahead."""
+
+    QUEUE_DEPTH = 8
+
+    def _producer(self, dataset, out_q, stop, error):
+        try:
+            for batch in dataset._iter_batches():
+                # bounded put that re-checks stop so an aborted consumer
+                # cannot strand this thread on a full queue
+                while not stop.is_set():
+                    try:
+                        out_q.put(batch, timeout=0.2)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # propagate to the consumer
+            error.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    out_q.put(None, timeout=0.2)
+                    break
+                except _queue.Full:
+                    continue
+
+    def train(self, executor, program, dataset, scope=None, fetch_list=None,
+              fetch_info=None, print_period=100, on_step=None):
+        feed_names = [
+            v.name if hasattr(v, "name") else str(v)
+            for v in dataset.use_var
+        ]
+        out_q = _queue.Queue(maxsize=self.QUEUE_DEPTH)
+        stop = threading.Event()
+        error = []
+        t = threading.Thread(
+            target=self._producer, args=(dataset, out_q, stop, error),
+            daemon=True,
+        )
+        t.start()
+        step = 0
+        try:
+            while True:
+                batch = out_q.get()
+                if batch is None:
+                    if error:
+                        raise error[0]
+                    break
+                feed = dict(zip(feed_names, batch))
+                outs = executor.run(
+                    program, feed=feed, fetch_list=fetch_list or [],
+                    scope=scope,
+                )
+                if fetch_list and print_period and step % print_period == 0:
+                    info = fetch_info or [
+                        getattr(f, "name", str(f)) for f in fetch_list
+                    ]
+                    msg = ", ".join(
+                        "%s=%s" % (n, np.asarray(o).ravel()[:4])
+                        for n, o in zip(info, outs)
+                    )
+                    print("step %d: %s" % (step, msg))
+                if on_step is not None:
+                    on_step(step)
+                step += 1
+        finally:
+            stop.set()
+        return step
+
+
+class DistMultiTrainer(MultiTrainer):
+    """reference: trainer.h:84 DistMultiTrainer — MultiTrainer plus the
+    pserver communicator; the send/recv ops in the transpiled program do
+    the push/pull, and an async communicator (fluid/communicator.py) can
+    batch them in the background."""
+
+    def __init__(self, thread_num=1, communicator=None):
+        super().__init__(thread_num)
+        self.communicator = communicator
+
+    def train(self, *args, **kwargs):
+        comm = self.communicator
+        started_here = comm is not None and not comm.is_running()
+        if started_here:
+            comm.start()
+        try:
+            return super().train(*args, **kwargs)
+        finally:
+            if started_here:
+                comm.stop()
+
+
+class PipelineTrainer(TrainerBase):
+    """reference: trainer.h:114 PipelineTrainer + SectionWorker — the
+    program must be marked by PipelineOptimizer(cut_list=...); execution
+    goes through the stage-partitioned GPipe executor (fluid/pipeline.py)
+    which the Executor dispatches to automatically."""
+
+    def train(self, executor, program, dataset, scope=None, fetch_list=None,
+              fetch_info=None, print_period=100):
+        if not getattr(program, "_pipeline_config", None):
+            raise ValueError(
+                "PipelineTrainer needs a program built with "
+                "PipelineOptimizer(cut_list=...)"
+            )
+        return MultiTrainer(self.thread_num).train(
+            executor, program, dataset, scope, fetch_list, fetch_info,
+            print_period,
+        )
+
+
+class TrainerFactory(object):
+    """reference: trainer_factory.py — trainer class by name."""
+
+    _TRAINERS = {
+        "MultiTrainer": MultiTrainer,
+        "DistMultiTrainer": DistMultiTrainer,
+        "PipelineTrainer": PipelineTrainer,
+    }
+
+    def create_trainer(self, opt_info=None):
+        opt_info = opt_info or {}
+        name = opt_info.get("trainer", "MultiTrainer")
+        cls = self._TRAINERS.get(name, MultiTrainer)
+        return cls(thread_num=opt_info.get("thread_num", 1))
 
 
 def train_from_dataset(
     executor, program, dataset, scope=None, fetch_list=None, fetch_info=None,
     print_period=100,
 ):
+    """Entry point behind Executor.train_from_dataset (reference:
+    Executor::RunFromDataset executor.cc:157)."""
     if dataset is None:
         raise ValueError("dataset must be provided")
-    feed_names = [
-        v.name if hasattr(v, "name") else str(v) for v in dataset.use_var
-    ]
-    step = 0
-    for batch in dataset._iter_batches():
-        feed = dict(zip(feed_names, batch))
-        outs = executor.run(
-            program, feed=feed, fetch_list=fetch_list or [], scope=scope
-        )
-        if fetch_list and print_period and step % print_period == 0:
-            info = fetch_info or [
-                getattr(f, "name", str(f)) for f in fetch_list
-            ]
-            msg = ", ".join(
-                "%s=%s" % (n, np.asarray(o).ravel()[:4])
-                for n, o in zip(info, outs)
-            )
-            print("step %d: %s" % (step, msg))
-        step += 1
-    return step
+    trainer_name = "MultiTrainer"
+    if getattr(program, "_pipeline_config", None):
+        trainer_name = "PipelineTrainer"
+    trainer = TrainerFactory().create_trainer(
+        {"trainer": trainer_name, "thread_num": getattr(
+            dataset, "thread_num", 1
+        )}
+    )
+    return trainer.train(
+        executor, program, dataset, scope, fetch_list, fetch_info,
+        print_period,
+    )

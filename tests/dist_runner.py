@@ -66,6 +66,50 @@ def run_local():
     print("LOSSES " + json.dumps(losses), flush=True)
 
 
+def run_fleet():
+    """Same cluster through the fleet parameter_server API (reference:
+    incubate/fleet/parameter_server)."""
+    os.environ["TRAINING_ROLE"] = (
+        "PSERVER" if os.environ["PADDLE_TRAINING_ROLE"] == "PSERVER"
+        else "TRAINER"
+    )
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = os.environ[
+        "PADDLE_PSERVER_ENDPOINTS"
+    ]
+    from paddle_tpu.fluid.incubate.fleet.parameter_server import fleet
+
+    main_p, startup, loss = build()
+    fleet.init()
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    fleet.distributed_optimizer(opt).minimize(
+        loss, startup_program=startup
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    fleet._executor = exe
+    if fleet.is_server():
+        fleet.init_server()
+        print("PSERVER READY", flush=True)
+        fleet.run_server()
+        print("PSERVER DONE", flush=True)
+        return
+    fleet.init_worker()
+    tid = fleet.worker_index()
+    trainers = fleet.worker_num()
+    per = BATCH // trainers
+    losses = []
+    for s in range(STEPS):
+        x, y = batch_for(s)
+        (l,) = exe.run(
+            fleet.main_program(),
+            feed={"x": x[tid * per:(tid + 1) * per],
+                  "y": y[tid * per:(tid + 1) * per]},
+            fetch_list=[loss],
+        )
+        losses.append(float(np.asarray(l).ravel()[0]))
+    fleet.stop_worker()
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
 def run_dist():
     role = os.environ["PADDLE_TRAINING_ROLE"]
     sync = os.environ.get("DIST_SYNC", "1") == "1"
@@ -140,5 +184,7 @@ def run_dist():
 if __name__ == "__main__":
     if os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL") == "LOCAL":
         run_local()
+    elif os.environ.get("DIST_FLEET") == "1":
+        run_fleet()
     else:
         run_dist()

@@ -60,7 +60,7 @@ def parse_losses(out):
     raise AssertionError("no LOSSES line in output:\n" + out)
 
 
-def run_cluster(sync, comm=""):
+def run_cluster(sync, comm="", extra_env=None):
     p1, p2 = free_ports(2)
     eps = "127.0.0.1:%d,127.0.0.1:%d" % (p1, p2)
     base = {
@@ -69,6 +69,7 @@ def run_cluster(sync, comm=""):
         "DIST_SYNC": "1" if sync else "0",
         "DIST_COMM": comm,
     }
+    base.update(extra_env or {})
     procs = []
     for ep in eps.split(","):
         procs.append(
@@ -136,3 +137,12 @@ def test_dist_pserver_geo_sgd():
     t0, t1 = run_cluster(sync=False, comm="geo")
     assert t0[-1] < t0[0] * 1.05
     assert t1[-1] < t1[0] * 1.05
+
+
+def test_fleet_parameter_server_matches_local():
+    """The same sync cluster through the fleet parameter_server facade
+    (reference: incubate/fleet/parameter_server TranspilerOptimizer)."""
+    local = local_losses()
+    t0, t1 = run_cluster(sync=True, extra_env={"DIST_FLEET": "1"})
+    dist = [(a + b) / 2.0 for a, b in zip(t0, t1)]
+    np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-4)

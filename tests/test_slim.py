@@ -1,0 +1,138 @@
+"""Slim quantization + pruning tests (reference:
+contrib/slim/tests/test_quantization_pass.py, test_post_training_quantization,
+test_filter_pruning)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.contrib.slim.quantization import (
+    PostTrainingQuantization,
+    convert,
+    quant_aware,
+)
+from paddle_tpu.fluid.contrib.slim.prune import prune_by_ratio, sensitivity
+
+
+def _build(seed=41):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def test_quant_aware_training_converges():
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(
+            loss, startup_program=startup
+        )
+    quant_aware(main, startup)
+    types = [o.type for o in main.global_block().ops]
+    assert "fake_quantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(0)
+    losses = []
+    for _ in range(15):
+        xb = rs.rand(16, 8).astype("float32")
+        yb = (xb.sum(1, keepdims=True) * 0.25).astype("float32")
+        (l,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                       scope=scope)
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0], losses
+    # activation scale observers accumulated something
+    scales = [
+        np.asarray(scope.get(v.name)).ravel()[0]
+        for v in main.list_vars()
+        if ".scale" in v.name and v.persistable
+        and scope.get(v.name) is not None
+    ]
+    assert scales and all(s > 0 for s in scales), scales
+
+
+def test_quantized_close_to_float():
+    """8-bit QDQ inference stays close to the float program."""
+    main, startup, loss = _build(seed=42)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(1)
+    xb = rs.rand(8, 8).astype("float32")
+    yb = (xb.sum(1, keepdims=True) * 0.25).astype("float32")
+    (f,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                   scope=scope)
+    # training-mode observers: on the first batch the moving-average scale
+    # snaps to the batch abs-max, giving calibrated 8-bit simulation
+    qmain = main.clone()
+    quant_aware(qmain, None, for_test=False)
+    # scale observer vars need an initial value in the scope
+    for v in qmain.list_vars():
+        if ".scale" in v.name and scope.get(v.name) is None:
+            scope.set(v.name, np.zeros(1, np.float32))
+    (q,) = exe.run(qmain, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                   scope=scope)
+    f, q = float(np.asarray(f)), float(np.asarray(q))
+    assert abs(f - q) / max(abs(f), 1e-6) < 0.1, (f, q)
+
+
+def test_post_training_quantization():
+    main, startup, loss = _build(seed=43)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(2)
+
+    def reader():
+        for _ in range(4):
+            xb = rs.rand(8, 8).astype("float32")
+            yb = (xb.sum(1, keepdims=True) * 0.25).astype("float32")
+            yield {"x": xb, "y": yb}
+
+    ptq = PostTrainingQuantization(
+        exe, main, ["x", "y"], [loss], data_reader=reader, batch_nums=4,
+        scope=scope,
+    )
+    qprog = ptq.quantize()
+    for op_ in qprog.global_block().ops:
+        if op_.has_attr("is_test") and op_.type.startswith("fake_quantize"):
+            assert op_.attrs["is_test"]
+    xb = rs.rand(8, 8).astype("float32")
+    yb = (xb.sum(1, keepdims=True) * 0.25).astype("float32")
+    (q,) = exe.run(qprog, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                   scope=scope)
+    assert np.isfinite(float(np.asarray(q)))
+
+
+def test_prune_and_sensitivity():
+    main, startup, loss = _build(seed=44)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.get("fc_0.w_0"))
+    masks = prune_by_ratio(scope, ["fc_0.w_0"], 0.5)
+    pruned = np.asarray(scope.get("fc_0.w_0"))
+    kept = masks["fc_0.w_0"]
+    assert kept.sum() == w.shape[0] - round(w.shape[0] * 0.5)
+    assert np.allclose(pruned[~kept], 0)
+    assert np.allclose(pruned[kept], w[kept])
+
+    rs = np.random.RandomState(3)
+    xb = rs.rand(8, 8).astype("float32")
+    yb = (xb.sum(1, keepdims=True) * 0.25).astype("float32")
+
+    def eval_fn():
+        (l,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                       scope=scope)
+        return float(np.asarray(l))
+
+    sens = sensitivity(exe, main, scope, ["fc_1.w_0"], eval_fn,
+                       ratios=(0.25, 0.75))
+    assert set(sens["fc_1.w_0"]) == {0.25, 0.75}

@@ -1,0 +1,90 @@
+"""Trainer/DeviceWorker stack tests (reference: framework/trainer.h,
+hogwild_worker.cc loop; entered via Executor::RunFromDataset)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import native
+from paddle_tpu.fluid.trainer import (
+    DistMultiTrainer,
+    MultiTrainer,
+    PipelineTrainer,
+    TrainerFactory,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def test_trainer_factory():
+    f = TrainerFactory()
+    assert isinstance(f.create_trainer({"trainer": "MultiTrainer"}),
+                      MultiTrainer)
+    assert isinstance(f.create_trainer({"trainer": "DistMultiTrainer"}),
+                      DistMultiTrainer)
+    assert isinstance(f.create_trainer({"trainer": "PipelineTrainer"}),
+                      PipelineTrainer)
+
+
+@needs_native
+def test_multitrainer_trains_from_dataset():
+    """Executor.train_from_dataset drives the reader-thread pipeline and
+    the loss goes down (reference: test the RunFromDataset path)."""
+    rs = np.random.RandomState(0)
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        for _ in range(64):
+            x = rs.rand(4)
+            y = x.sum() * 0.5
+            f.write("4 %f %f %f %f 1 %f\n" % (*x, y))
+        path = f.name
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 6
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y)
+            )
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(
+                loss, startup_program=startup
+            )
+        from paddle_tpu.fluid.dataset import DatasetFactory
+
+        losses = []
+
+        def run_epoch():
+            ds = DatasetFactory().create_dataset("QueueDataset")
+            ds.set_filelist([path])
+            ds.set_batch_size(16)
+            ds.set_multislot([True, True], dense_slots=[4, 1])
+            ds.set_use_var([x, y])
+            exe = fluid.Executor(fluid.CPUPlace())
+            trainer = MultiTrainer(thread_num=1)
+            steps = trainer.train(
+                exe, main, ds, fetch_list=[loss], print_period=0,
+                on_step=lambda s: None,
+            )
+            return steps
+
+        exe0 = fluid.Executor(fluid.CPUPlace())
+        exe0.run(startup)
+        # measure loss before and after two dataset epochs
+        xb = rs.rand(16, 4).astype("float32")
+        yb = (xb.sum(1, keepdims=True) * 0.5).astype("float32")
+        (l0,) = exe0.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        for _ in range(2):
+            steps = run_epoch()
+            assert steps == 4
+        (l1,) = exe0.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        assert float(np.asarray(l1)) < float(np.asarray(l0)), (l0, l1)
+        _ = losses
+    finally:
+        os.unlink(path)
