@@ -1,0 +1,106 @@
+/* C API implementation: embeds CPython and drives the Python-side bridge
+ * (paddle_tpu/capi/bridge.py). See header for the reference counterparts. */
+#include "paddle_tpu_c_api.h"
+
+#include <Python.h>
+
+#include <string>
+
+static PyObject* g_bridge = nullptr;
+
+int pt_capi_init(const char* repo_root) {
+  if (!Py_IsInitialized()) Py_Initialize();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (repo_root) {
+      PyObject* sys_path = PySys_GetObject("path");
+      PyObject* p = PyUnicode_FromString(repo_root);
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+    PyObject* mod = PyImport_ImportModule("paddle_tpu.capi.bridge");
+    if (!mod) {
+      PyErr_Print();
+      break;
+    }
+    g_bridge = mod;
+    rc = 0;
+  } while (0);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+static int64_t call_i64(const char* fn, PyObject* args) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t out = -1;
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (f) {
+    PyObject* r = PyObject_CallObject(f, args);
+    if (r) {
+      out = PyLong_AsLongLong(r);
+      Py_DECREF(r);
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(f);
+  }
+  Py_XDECREF(args);
+  PyGILState_Release(gil);
+  return out;
+}
+
+int64_t pt_capi_load_program(const char* path, int kind) {
+  return call_i64("load_program", Py_BuildValue("(si)", path, kind));
+}
+
+int64_t pt_capi_demo_program(void) {
+  return call_i64("demo_program", PyTuple_New(0));
+}
+
+int pt_capi_run(int64_t handle, const char** feed_names,
+                const float** feed_bufs, const int64_t* feed_shapes,
+                const int* feed_ndims, int n_feeds, double* out_loss) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    PyObject* feeds = PyDict_New();
+    int off = 0;
+    for (int i = 0; i < n_feeds; i++) {
+      PyObject* shape = PyList_New(feed_ndims[i]);
+      int64_t numel = 1;
+      for (int d = 0; d < feed_ndims[i]; d++) {
+        PyList_SetItem(shape, d, PyLong_FromLongLong(feed_shapes[off + d]));
+        numel *= feed_shapes[off + d];
+      }
+      off += feed_ndims[i];
+      PyObject* buf = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(feed_bufs[i]),
+          static_cast<Py_ssize_t>(numel * sizeof(float)));
+      PyObject* pair = PyTuple_Pack(2, buf, shape);
+      PyDict_SetItemString(feeds, feed_names[i], pair);
+      Py_DECREF(pair);
+      Py_DECREF(buf);
+      Py_DECREF(shape);
+    }
+    PyObject* f = PyObject_GetAttrString(g_bridge, "run_step");
+    if (!f) break;
+    PyObject* r = PyObject_CallFunction(f, "LO", (long long)handle, feeds);
+    Py_DECREF(f);
+    Py_DECREF(feeds);
+    if (!r) {
+      PyErr_Print();
+      break;
+    }
+    if (out_loss) *out_loss = PyFloat_AsDouble(r);
+    Py_DECREF(r);
+    rc = 0;
+  } while (0);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void pt_capi_destroy(void) {
+  Py_XDECREF(g_bridge);
+  g_bridge = nullptr;
+}

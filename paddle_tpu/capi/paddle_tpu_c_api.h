@@ -1,0 +1,41 @@
+/* C training/inference API (reference: paddle/fluid/framework/c/c_api.cc,
+ * inference/capi/, train/demo/demo_trainer.cc).
+ *
+ * The runtime is the Python/JAX engine embedded via CPython; this header is
+ * the stable C surface for embedding without writing Python. */
+#ifndef PADDLE_TPU_C_API_H_
+#define PADDLE_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialize the embedded runtime. repo_root may be NULL if paddle_tpu is
+ * importable from the default sys.path. Returns 0 on success. */
+int pt_capi_init(const char* repo_root);
+
+/* Load a program saved by fluid.io.save / save_inference_model.
+ * kind: 0 = program state dir (train), 1 = inference model dir.
+ * Returns a handle (>0) or -1. */
+int64_t pt_capi_load_program(const char* path, int kind);
+
+/* Build the reference train/demo program in-process: a linear regression
+ * y = xW + b with SGD, returns a handle usable with pt_capi_run. */
+int64_t pt_capi_demo_program(void);
+
+/* Run one step: feeds are float32 row-major buffers. Returns 0 on success
+ * and writes the first fetch value into *out_loss. */
+int pt_capi_run(int64_t handle, const char** feed_names,
+                const float** feed_bufs, const int64_t* feed_shapes,
+                const int* feed_ndims, int n_feeds, double* out_loss);
+
+/* Tear down the embedded runtime. */
+void pt_capi_destroy(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_C_API_H_ */

@@ -1,0 +1,98 @@
+/* Native self-test runner (reference: paddle/testing/paddle_gtest_main.cc
+ * + colocated *_test.cc files). Exercises the queue, tensor-stream
+ * serializer, and RPC loopback without Python. Build:
+ *   g++ -O2 -std=c++17 -pthread -DPT_NATIVE_TEST_MAIN \
+ *       native_test.cpp paddle_tpu_native.cpp rpc.cpp -o native_test */
+#ifdef PT_NATIVE_TEST_MAIN
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* pt_queue_create(uint64_t);
+int pt_queue_push(void*, const uint8_t*, uint64_t, int);
+int pt_queue_pop(void*, uint8_t**, uint64_t*, int);
+void pt_queue_close(void*);
+void pt_queue_destroy(void*);
+void pt_free(void*);
+int pt_tensor_serialize(int, int, const int64_t*, const uint8_t*, uint64_t,
+                        int, const uint64_t*, const uint64_t*, uint8_t**,
+                        uint64_t*);
+void* pt_tensor_read(const uint8_t*, uint64_t);
+int pt_tensor_dtype(void*);
+int pt_tensor_ndim(void*);
+const int64_t* pt_tensor_dims(void*);
+const uint8_t* pt_tensor_data(void*);
+uint64_t pt_tensor_nbytes(void*);
+void pt_tensor_destroy(void*);
+void* pt_rpc_server_create(int, int, int);
+int pt_rpc_server_port(void*);
+void pt_rpc_server_put_param(void*, const char*, const uint8_t*, uint64_t);
+void pt_rpc_server_destroy(void*);
+void* pt_rpc_connect(const char*, int, int);
+int pt_rpc_get_var(void*, uint32_t, const char*, uint8_t**, uint64_t*);
+void pt_rpc_close(void*);
+}
+
+static void test_queue() {
+  void* q = pt_queue_create(2);
+  uint8_t a[3] = {1, 2, 3};
+  assert(pt_queue_push(q, a, 3, 100) == 0);
+  uint8_t* out = nullptr;
+  uint64_t len = 0;
+  assert(pt_queue_pop(q, &out, &len, 100) == 0);
+  assert(len == 3 && out[2] == 3);
+  pt_free(out);
+  pt_queue_close(q);
+  pt_queue_destroy(q);
+  std::printf("queue ok\n");
+}
+
+static void test_serializer() {
+  float vals[4] = {1.f, 2.f, 3.f, 4.f};
+  int64_t dims[2] = {2, 2};
+  uint8_t* buf = nullptr;
+  uint64_t len = 0;
+  assert(pt_tensor_serialize(5, 2, dims,
+                             reinterpret_cast<uint8_t*>(vals), 16, 0,
+                             nullptr, nullptr, &buf, &len) == 0);
+  void* t = pt_tensor_read(buf, len);
+  assert(t != nullptr);
+  assert(pt_tensor_dtype(t) == 5 && pt_tensor_ndim(t) == 2);
+  assert(pt_tensor_dims(t)[1] == 2);
+  assert(pt_tensor_nbytes(t) == 16);
+  assert(std::memcmp(pt_tensor_data(t), vals, 16) == 0);
+  pt_tensor_destroy(t);
+  pt_free(buf);
+  std::printf("serializer ok\n");
+}
+
+static void test_rpc_loopback() {
+  void* srv = pt_rpc_server_create(0, 1, 0);  // async mode, 1 trainer
+  assert(srv != nullptr);
+  int port = pt_rpc_server_port(srv);
+  uint8_t payload[4] = {9, 8, 7, 6};
+  pt_rpc_server_put_param(srv, "w", payload, 4);
+  void* cli = pt_rpc_connect("127.0.0.1", port, 5000);
+  assert(cli != nullptr);
+  uint8_t* out = nullptr;
+  uint64_t len = 0;
+  assert(pt_rpc_get_var(cli, 0, "w", &out, &len) == 0);
+  assert(len == 4 && out[0] == 9 && out[3] == 6);
+  pt_free(out);
+  pt_rpc_close(cli);
+  pt_rpc_server_destroy(srv);
+  std::printf("rpc loopback ok\n");
+}
+
+int main() {
+  test_queue();
+  test_serializer();
+  test_rpc_loopback();
+  std::printf("ALL NATIVE TESTS PASS\n");
+  return 0;
+}
+#endif  /* PT_NATIVE_TEST_MAIN */

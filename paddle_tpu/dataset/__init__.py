@@ -7,3 +7,8 @@ from . import mnist  # noqa: F401
 from . import cifar  # noqa: F401
 from . import uci_housing  # noqa: F401
 from . import imdb  # noqa: F401
+from . import movielens  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import conll05  # noqa: F401
+from . import sentiment  # noqa: F401

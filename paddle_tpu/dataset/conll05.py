@@ -1,0 +1,39 @@
+"""CoNLL-2005 SRL readers (reference: python/paddle/dataset/conll05.py —
+yields (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_id, mark,
+label_ids)). Synthetic sentences with the real 9-slot structure when the
+corpus is absent (it is licensed + zero-egress here)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+WORD_DICT_LEN = 44068
+LABEL_DICT_LEN = 59
+PRED_DICT_LEN = 3162
+
+
+def get_dict():
+    word_dict = {"w%d" % i: i for i in range(WORD_DICT_LEN)}
+    verb_dict = {"v%d" % i: i for i in range(PRED_DICT_LEN)}
+    label_dict = {"l%d" % i: i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        ln = rng.randint(4, 15)
+        words = rng.randint(0, WORD_DICT_LEN, ln).tolist()
+        ctx = [rng.randint(0, WORD_DICT_LEN, ln).tolist() for _ in range(5)]
+        verb = [int(rng.randint(0, PRED_DICT_LEN))] * ln
+        mark = rng.randint(0, 2, ln).tolist()
+        labels = [(w + m) % LABEL_DICT_LEN for w, m in zip(words, mark)]
+        yield (words, *ctx, verb, mark, labels)
+
+
+def train():
+    return lambda: _make(2000, seed=40)
+
+
+def test():
+    return lambda: _make(200, seed=41)

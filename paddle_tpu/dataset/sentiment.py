@@ -1,0 +1,31 @@
+"""Sentiment (movie-review) readers (reference:
+python/paddle/dataset/sentiment.py over NLTK's corpus — yields
+(word_ids, label)). Synthetic class-separable sequences when the corpus is
+absent."""
+
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 5147
+
+
+def get_word_dict():
+    return {"w%d" % i: i for i in range(VOCAB)}
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        label = int(rng.randint(0, 2))
+        lo, hi = (0, VOCAB // 2) if label == 0 else (VOCAB // 2, VOCAB)
+        ln = rng.randint(5, 40)
+        yield rng.randint(lo, hi, ln).tolist(), label
+
+
+def train():
+    return lambda: _make(1600, seed=50)
+
+
+def test():
+    return lambda: _make(400, seed=51)

@@ -1,0 +1,11 @@
+"""WMT14 readers (reference: python/paddle/dataset/wmt14.py) — same framing
+as wmt16; shares the synthetic generator."""
+
+from . import wmt16 as _w
+
+train = _w.train
+test = _w.test
+
+
+def gen(): 
+    return _w.validation()

@@ -1,0 +1,44 @@
+"""WMT16 EN-DE readers (reference: python/paddle/dataset/wmt16.py — yields
+(src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> framing). Deterministic
+synthetic parallel corpus with the real framing when the archive is not
+present (zero-egress environment)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+BOS, EOS, UNK = 0, 1, 2
+
+
+def _make(n, src_dict_size, trg_dict_size, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        ln = rng.randint(3, 12)
+        src = rng.randint(3, max(src_dict_size, 4), ln).tolist()
+        # "translation": deterministic remap so seq2seq models can learn
+        trg_body = [
+            3 + ((t * 7 + 1) % max(trg_dict_size - 3, 1)) for t in src
+        ]
+        trg = [BOS] + trg_body
+        trg_next = trg_body + [EOS]
+        yield src, trg, trg_next
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return lambda: _make(4000, src_dict_size, trg_dict_size, seed=30)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return lambda: _make(400, src_dict_size, trg_dict_size, seed=31)
+
+
+def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return lambda: _make(400, src_dict_size, trg_dict_size, seed=32)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    words = {i: "w%d" % i for i in range(dict_size)}
+    words[BOS], words[EOS], words[UNK] = "<s>", "<e>", "<unk>"
+    return (
+        words if reverse else {v: k for k, v in words.items()}
+    )

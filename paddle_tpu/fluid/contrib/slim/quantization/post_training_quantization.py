@@ -13,9 +13,16 @@ class PostTrainingQuantization(object):
     def __init__(self, executor, program, feed_names, fetch_list,
                  data_reader=None, batch_nums=10, scope=None,
                  algo="abs_max", weight_bits=8, activation_bits=8):
+        if algo not in ("abs_max", "moving_average_abs_max"):
+            raise NotImplementedError(
+                "PTQ algo %r not supported (abs_max moving-average "
+                "observers only; the reference's KL/mse calibrators are "
+                "not implemented)" % algo
+            )
         self._executor = executor
-        self._program = program
-        self._feed_names = feed_names
+        # quantize a CLONE: the caller keeps the float program
+        self._program = program.clone()
+        self._feed_names = feed_names  # kept for API parity; feeds come from data_reader dicts
         self._fetch_list = fetch_list
         self._data_reader = data_reader
         self._batch_nums = batch_nums

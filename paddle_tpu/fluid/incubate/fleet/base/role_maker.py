@@ -75,13 +75,26 @@ class PaddleCloudRoleMaker(RoleMakerBase):
                 if e
             ]
             # pserver mode needs only a trainer COUNT, not endpoints
-            # (reference launch env sets PADDLE_TRAINERS_NUM)
+            # (reference launch env sets PADDLE_TRAINERS_NUM). Explicit
+            # endpoints win; the count only fills in when absent, and a
+            # conflict is a config error worth failing loudly on.
             n = int(os.getenv("PADDLE_TRAINERS_NUM", "0") or 0)
-            if n and len(self._worker_endpoints) != n:
+            if n and not self._worker_endpoints:
                 self._worker_endpoints = ["w%d" % i for i in range(n)]
-            self._server_endpoints = os.getenv(
-                "PADDLE_PSERVERS_IP_PORT_LIST", ""
-            ).split(",")
+            elif n and len(self._worker_endpoints) != n:
+                raise ValueError(
+                    "PADDLE_TRAINERS_NUM=%d disagrees with %d "
+                    "PADDLE_TRAINER_ENDPOINTS" % (
+                        n, len(self._worker_endpoints)
+                    )
+                )
+            self._server_endpoints = [
+                e
+                for e in os.getenv(
+                    "PADDLE_PSERVERS_IP_PORT_LIST", ""
+                ).split(",")
+                if e
+            ]
             if role == "TRAINER":
                 self._role = Role.WORKER
                 self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))

@@ -1,0 +1,2 @@
+from . import fs  # noqa: F401
+from . import hdfs  # noqa: F401

@@ -1,0 +1,86 @@
+"""Filesystem utilities (reference: incubate/fleet/utils/fs.py — FS base +
+LocalFS; C++ counterparts framework/io/fs.cc, shell.cc)."""
+
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+
+
+class FS(object, metaclass=abc.ABCMeta):
+    @abc.abstractmethod
+    def ls_dir(self, fs_path):
+        pass
+
+    @abc.abstractmethod
+    def is_dir(self, fs_path):
+        pass
+
+    @abc.abstractmethod
+    def is_file(self, fs_path):
+        pass
+
+    @abc.abstractmethod
+    def is_exist(self, fs_path):
+        pass
+
+    @abc.abstractmethod
+    def mkdirs(self, fs_path):
+        pass
+
+    @abc.abstractmethod
+    def delete(self, fs_path):
+        pass
+
+    @abc.abstractmethod
+    def rename(self, fs_src_path, fs_dst_path):
+        pass
+
+
+class LocalFS(FS):
+    """reference: incubate/fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if self.is_file(fs_path):
+            os.remove(fs_path)
+        else:
+            shutil.rmtree(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path):
+        with open(fs_path, "a"):
+            pass
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)

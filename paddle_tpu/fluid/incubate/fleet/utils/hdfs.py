@@ -1,0 +1,58 @@
+"""HDFS client (reference: incubate/fleet/utils/hdfs.py HDFSClient —
+shells out to `hadoop fs`; C++ counterpart framework/io/fs.cc hdfs_*)."""
+
+from __future__ import annotations
+
+import subprocess
+
+
+class HDFSClient(object):
+    def __init__(self, hadoop_home, configs):
+        self._bin = "%s/bin/hadoop" % hadoop_home
+        self._base = [self._bin, "fs"]
+        for k, v in (configs or {}).items():
+            self._base += ["-D", "%s=%s" % (k, v)]
+
+    def _run(self, *args, check=True):
+        proc = subprocess.run(
+            self._base + list(args), capture_output=True, text=True
+        )
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                "hadoop %s failed: %s" % (" ".join(args), proc.stderr)
+            )
+        return proc
+
+    def is_exist(self, hdfs_path):
+        return self._run("-test", "-e", hdfs_path, check=False).returncode == 0
+
+    def is_dir(self, hdfs_path):
+        return self._run("-test", "-d", hdfs_path, check=False).returncode == 0
+
+    def is_file(self, hdfs_path):
+        return self._run("-test", "-f", hdfs_path, check=False).returncode == 0
+
+    def ls(self, hdfs_path):
+        out = self._run("-ls", hdfs_path).stdout
+        return [
+            line.split()[-1]
+            for line in out.splitlines()
+            if line and not line.startswith("Found")
+        ]
+
+    def makedirs(self, hdfs_path):
+        self._run("-mkdir", "-p", hdfs_path)
+
+    def delete(self, hdfs_path):
+        self._run("-rm", "-r", "-skipTrash", hdfs_path, check=False)
+
+    def upload(self, hdfs_path, local_path, multi_processes=1, overwrite=False):
+        if overwrite:
+            self.delete(hdfs_path)
+        self._run("-put", local_path, hdfs_path)
+
+    def download(self, hdfs_path, local_path, multi_processes=1):
+        self._run("-get", hdfs_path, local_path)
+
+    def rename(self, src, dst):
+        self._run("-mv", src, dst)

@@ -25,6 +25,7 @@ __all__ = [
 ]
 
 _events = defaultdict(list)  # name -> [durations]
+_records = []  # (name, start, end, tid) — timeline source
 _active = threading.local()
 _trace_dir = None
 _profiling = False
@@ -43,7 +44,11 @@ class RecordEvent(object):
 
     def __exit__(self, *exc):
         if _profiling:
-            _events[self.name].append(time.perf_counter() - self._t0)
+            t1 = time.perf_counter()
+            _events[self.name].append(t1 - self._t0)
+            _records.append(
+                (self.name, self._t0, t1, threading.get_ident())
+            )
         return False
 
 
@@ -55,6 +60,13 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 def reset_profiler():
     _events.clear()
+    del _records[:]
+
+
+def get_records():
+    """Timeline source records [(name, start, end, tid)] — consumed by
+    tools/timeline.py."""
+    return list(_records)
 
 
 def start_profiler(state="All", tracer_option=None):
@@ -84,6 +96,15 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
             pass
         _trace_dir = None
     _print_summary(sorted_key)
+    if profile_path:
+        # the reference serializes profiler.proto here and tools/timeline.py
+        # converts it; we write the chrome trace directly
+        try:
+            from ..tools.timeline import save_chrome_trace
+
+            save_chrome_trace(_records, profile_path + ".json")
+        except Exception:
+            pass
 
 
 def _print_summary(sorted_key=None):

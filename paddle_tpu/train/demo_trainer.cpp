@@ -1,0 +1,55 @@
+/* C++ training demo (reference: paddle/fluid/train/demo/demo_trainer.cc +
+ * test_train_recognize_digits.cc): trains a regression through the C API
+ * without a line of user Python and asserts the loss decreases. */
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "../capi/paddle_tpu_c_api.h"
+
+int main(int argc, char** argv) {
+  const char* root = argc > 1 ? argv[1] : nullptr;
+  if (pt_capi_init(root) != 0) {
+    std::fprintf(stderr, "init failed\n");
+    return 1;
+  }
+  int64_t h = pt_capi_demo_program();
+  if (h < 0) {
+    std::fprintf(stderr, "program build failed\n");
+    return 1;
+  }
+  const int B = 16, D = 13;
+  std::vector<float> x(B * D), y(B);
+  unsigned seed = 7;
+  double first = 0.0, last = 0.0;
+  for (int step = 0; step < 30; step++) {
+    for (int i = 0; i < B; i++) {
+      float s = 0.f;
+      for (int d = 0; d < D; d++) {
+        seed = seed * 1664525u + 1013904223u;
+        float v = (seed >> 8) / 16777216.0f;
+        x[i * D + d] = v;
+        s += v;
+      }
+      y[i] = 0.3f * s;
+    }
+    const char* names[2] = {"x", "y"};
+    const float* bufs[2] = {x.data(), y.data()};
+    int64_t shapes[4] = {B, D, B, 1};
+    int ndims[2] = {2, 2};
+    double loss = 0.0;
+    if (pt_capi_run(h, names, bufs, shapes, ndims, 2, &loss) != 0) {
+      std::fprintf(stderr, "run failed at step %d\n", step);
+      return 1;
+    }
+    if (step == 0) first = loss;
+    last = loss;
+  }
+  std::printf("demo_trainer: loss %.6f -> %.6f\n", first, last);
+  if (!(last < first)) {
+    std::fprintf(stderr, "loss did not decrease\n");
+    return 1;
+  }
+  std::printf("PASS\n");
+  return 0;
+}

@@ -1,0 +1,104 @@
+"""Aux subsystem tests: timeline export, fs utils, datasets
+(reference: tools/timeline.py, incubate/fleet/utils/fs.py,
+python/paddle/dataset/*)."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import profiler
+from paddle_tpu.fluid.incubate.fleet.utils.fs import LocalFS
+from paddle_tpu.tools.timeline import save_chrome_trace
+
+
+def test_profiler_chrome_trace(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("step_a"):
+        x = np.random.rand(64, 64)
+        _ = x @ x
+    with profiler.RecordEvent("step_b"):
+        _ = x.sum()
+    path = str(tmp_path / "profile")
+    profiler.stop_profiler(sorted_key="total", profile_path=path)
+    out = path + ".json"
+    assert os.path.exists(out)
+    trace = json.load(open(out))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"step_a", "step_b"} <= names
+    for e in trace["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_local_fs(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f) and fs.is_exist(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"]
+    fs.rename(f, os.path.join(d, "y.txt"))
+    assert fs.is_file(os.path.join(d, "y.txt"))
+    fs.delete(str(tmp_path / "a"))
+    assert not fs.is_exist(str(tmp_path / "a"))
+
+
+def test_new_datasets_yield_proper_structure():
+    import paddle_tpu.dataset as dataset
+
+    s = next(dataset.movielens.train()())
+    assert len(s) == 8 and isinstance(s[5], list)
+    src, trg, trg_next = next(dataset.wmt16.train(100, 100)())
+    assert trg[0] == dataset.wmt16.BOS and trg_next[-1] == dataset.wmt16.EOS
+    assert len(trg) == len(trg_next)
+    srl = next(dataset.conll05.train()())
+    assert len(srl) == 9 and len(srl[0]) == len(srl[8])
+    words, label = next(dataset.sentiment.train()())
+    assert label in (0, 1) and len(words) >= 5
+
+
+def test_sentiment_dataset_learnable():
+    """The synthetic sentiment data must be class-separable so book-style
+    tests can train on it."""
+    import paddle_tpu.dataset as dataset
+
+    rd = dataset.sentiment.train()()
+    hi = lo = 0
+    for i, (words, label) in enumerate(rd):
+        if i >= 50:
+            break
+        mean = np.mean(words)
+        if (mean > dataset.sentiment.VOCAB // 2) == bool(label):
+            hi += 1
+        else:
+            lo += 1
+    assert hi > 45, (hi, lo)
+
+
+def test_inmemory_dataset_shuffle(tmp_path):
+    from paddle_tpu.fluid.dataset import DatasetFactory
+    from paddle_tpu.fluid import native
+    import pytest
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    p = tmp_path / "d.txt"
+    with open(p, "w") as f:
+        for i in range(20):
+            f.write("1 %d\n" % i)
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist([str(p)])
+    ds.set_batch_size(20)
+    ds.set_multislot([False])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 20
+    before = [int(np.asarray(s[0]).ravel()[0]) for s in ds._samples]
+    ds.local_shuffle()
+    after = [int(np.asarray(s[0]).ravel()[0]) for s in ds._samples]
+    assert sorted(after) == sorted(before)
+    assert after != before  # 20! permutations — astronomically unlikely

@@ -1,0 +1,114 @@
+"""C API + native test runner (reference: paddle/fluid/train/demo,
+test_train_recognize_digits.cc, paddle/testing/paddle_gtest_main.cc)."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "paddle_tpu", "csrc")
+CAPI = os.path.join(REPO, "paddle_tpu", "capi")
+
+
+def _gxx_available():
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True, check=True)
+        return True
+    except Exception:
+        return False
+
+
+needs_gxx = pytest.mark.skipif(not _gxx_available(), reason="no g++")
+
+
+@needs_gxx
+def test_native_test_runner(tmp_path):
+    exe = str(tmp_path / "native_test")
+    subprocess.run(
+        [
+            "g++", "-O1", "-std=c++17", "-pthread",
+            "-DPT_NATIVE_TEST_MAIN",
+            os.path.join(CSRC, "native_test.cpp"),
+            os.path.join(CSRC, "paddle_tpu_native.cpp"),
+            os.path.join(CSRC, "rpc.cpp"),
+            "-o", exe,
+        ],
+        check=True, capture_output=True,
+    )
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL NATIVE TESTS PASS" in r.stdout
+
+
+@needs_gxx
+def test_c_train_api_demo(tmp_path):
+    """Train through the embedded-runtime C API: the demo must report a
+    decreasing loss (reference train/demo contract)."""
+    import sysconfig
+
+    includes = subprocess.run(
+        ["python3-config", "--includes"], capture_output=True, text=True,
+        check=True,
+    ).stdout.split()
+    ldflags = subprocess.run(
+        ["python3-config", "--ldflags", "--embed"], capture_output=True,
+        text=True, check=True,
+    ).stdout.split()
+    exe = str(tmp_path / "demo_trainer")
+    subprocess.run(
+        [
+            "g++", "-O1", "-std=c++17",
+            *includes,
+            os.path.join(REPO, "paddle_tpu", "train", "demo_trainer.cpp"),
+            os.path.join(CAPI, "paddle_tpu_c_api.cpp"),
+            "-o", exe,
+            *ldflags,
+        ],
+        check=True, capture_output=True,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [exe, REPO], capture_output=True, text=True, timeout=300, env=env
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+    _ = sysconfig
+
+
+def test_bridge_train_program_roundtrip(tmp_path):
+    """kind=0 load path: save a training program with fluid.io.save, reload
+    through the bridge, and run a step."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.capi import bridge
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)  # global scope
+    base = str(tmp_path / "model")
+    fluid.io.save(main, base)
+
+    h = bridge.load_program(base, 0)
+    rs = np.random.RandomState(0)
+    xb = rs.rand(8, 5).astype("float32")
+    yb = (xb.sum(1, keepdims=True) * 0.2).astype("float32")
+    feeds = {
+        "x": (xb.tobytes(), [8, 5]),
+        "y": (yb.tobytes(), [8, 1]),
+    }
+    l1 = bridge.run_step(h, feeds)
+    for _ in range(10):
+        l2 = bridge.run_step(h, feeds)
+    assert l2 < l1
