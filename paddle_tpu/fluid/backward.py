@@ -118,33 +118,50 @@ def _addup_repetitive_outputs(specs):
     """Fan-out handling (reference: backward.py:324): when several grad ops
     write the same ``x@GRAD``, rename each write and insert a ``sum`` op after
     the last producer."""
-    producers = defaultdict(list)
+    # Writes to one grad name are grouped into GENERATIONS: a
+    # read-modify-write op (while_grad / conditional_block_grad consumes
+    # Out@GRAD and emits X@GRAD under the same name — the cotangent of a
+    # DIFFERENT SSA value of the var) closes the current generation and
+    # starts a new one. Producers are summed within a generation only;
+    # summing across generations would add the post-loop cotangent into the
+    # pre-loop one.
+    gens = defaultdict(lambda: [[]])  # gname -> [generation -> [(i,slot,j)]]
     for i, spec in enumerate(specs):
+        spec_reads = {
+            n
+            for names in spec["inputs"].values()
+            for n in names
+            if n != EMPTY_VAR
+        }
         for slot, names in spec["outputs"].items():
             for j, n in enumerate(names):
                 if n != EMPTY_VAR and n.endswith(GRAD_SUFFIX):
-                    producers[n].append((i, slot, j))
+                    if n in spec_reads:
+                        gens[n].append([(i, slot, j)])
+                    else:
+                        gens[n][-1].append((i, slot, j))
     insertions = []  # (after_idx, sum_spec)
-    for gname, plist in producers.items():
-        if len(plist) <= 1:
-            continue
-        new_names = []
-        for k, (i, slot, j) in enumerate(plist):
-            nn = "%s@RENAME@%d" % (gname, k)
-            specs[i]["outputs"][slot][j] = nn
-            new_names.append(nn)
-        last = max(i for i, _, _ in plist)
-        insertions.append(
-            (
-                last,
-                dict(
-                    type="sum",
-                    inputs={"X": new_names},
-                    outputs={"Out": [gname]},
-                    attrs={OP_ROLE_KEY: OpRole.Backward},
-                ),
+    for gname, generations in gens.items():
+        for g_id, plist in enumerate(generations):
+            if len(plist) <= 1:
+                continue
+            new_names = []
+            for k, (i, slot, j) in enumerate(plist):
+                nn = "%s@RENAME@%d_%d" % (gname, g_id, k)
+                specs[i]["outputs"][slot][j] = nn
+                new_names.append(nn)
+            last = max(i for i, _, _ in plist)
+            insertions.append(
+                (
+                    last,
+                    dict(
+                        type="sum",
+                        inputs={"X": new_names},
+                        outputs={"Out": [gname]},
+                        attrs={OP_ROLE_KEY: OpRole.Backward},
+                    ),
+                )
             )
-        )
     # apply insertions from the back so indices stay valid
     for after_idx, sum_spec in sorted(insertions, key=lambda t: -t[0]):
         specs.insert(after_idx + 1, sum_spec)

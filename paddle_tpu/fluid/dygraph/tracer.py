@@ -186,6 +186,10 @@ class Tracer(object):
             out_names[slot] = [v.name for v in vs]
 
         fake = _FakeOp(type, in_names, out_names, dict(attrs or {}))
+        import jax
+
+        # eager ops run on the default jax device; pick layouts for it
+        _registry.set_lowering_backend(jax.default_backend())
         ctx = LowerCtx(env=env, base_key=self._next_key())
         opdef.lower(ctx, fake)
 
@@ -254,6 +258,9 @@ class Tracer(object):
                     spec["type"], spec["inputs"], spec["outputs"], spec["attrs"]
                 )
                 gdef = _registry.get_op_def(spec["type"])
+                import jax
+
+                _registry.set_lowering_backend(jax.default_backend())
                 ctx = LowerCtx(env=env)
                 gdef.lower(ctx, gop)
                 for slot, names in spec["outputs"].items():

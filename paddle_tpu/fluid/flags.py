@@ -79,6 +79,9 @@ _DEFAULTS = {
     "communicator_send_wait_times": 5,
     "communicator_merge_sparse_grad": True,
     "communicator_is_sgd_optimizer": True,
+    # TPU layout: lower conv2d internally as NHWC/HWIO (channels on the
+    # lane dimension, the layout the MXU wants) while the API stays NCHW
+    "conv_nhwc": True,
     # misc
     "max_body_size": 2147483647,
     "sync_nccl_allreduce": False,

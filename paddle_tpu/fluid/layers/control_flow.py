@@ -213,12 +213,18 @@ class _SwitchCaseGuard(object):
         sub = main.current_block()
         main._rollback()
         parent = main.current_block()
-        inner_defined = set()
+        # every sub-block write is an output — branch temps created inside
+        # the case (e.g. by layers.cond) are read by later ops in the parent
+        # (the merge `where`), and the grad machinery gates on Out names
+        out_names = []
         for op_ in sub.ops:
-            inner_defined |= set(op_.output_arg_names)
-        out_names = [n for n in inner_defined if parent._find_var_recursive(n)]
+            for n in op_.output_arg_names:
+                if n not in out_names:
+                    out_names.append(n)
+        from .. import unique_name
+
         scope_var = parent.create_var(
-            name=self.switch.helper.name + ".scope",
+            name=unique_name.generate(self.switch.helper.name + ".scope"),
             type=core.VarDesc.VarType.STEP_SCOPES,
         )
         parent.append_op(

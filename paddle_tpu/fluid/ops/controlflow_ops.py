@@ -92,12 +92,41 @@ def _select_input(ctx, op_):
 
 
 # while / conditional_block lower through the executor, which owns sub-block
-# tracing (see executor.py _lower_while / _lower_cond); the registry entries
-# mark them lowerable so they don't split the XLA segment.
+# tracing (see executor.py lower_while_op / lower_conditional_block); the
+# registry entries mark them lowerable so they don't split the XLA segment.
+# Gradients are desc-level grad ops built by append_backward, matching the
+# reference's WhileGradOp / ConditionalBlockGradOp grad makers
+# (operators/controlflow/while_op.cc, conditional_block_op.cc); their
+# lowerings replay the sub-block under jax.vjp (executor.py).
 def _while_lower(ctx, op_):
     from .. import executor as _executor
 
     _executor.lower_while_op(ctx, op_)
+
+
+def _while_grad_lower(ctx, op_):
+    from .. import executor as _executor
+
+    _executor.lower_while_grad_op(ctx, op_)
+
+
+def _while_grad_maker(op_):
+    xs = list(op_.input("X"))
+    outs = list(op_.output("Out"))
+    return [
+        dict(
+            type="while_grad",
+            inputs={
+                "X": xs,
+                "Out": outs,
+                "Out@GRAD": [n + "@GRAD" for n in outs],
+                "Condition": list(op_.input("Condition")),
+                "StepScopes": list(op_.output("StepScopes")),
+            },
+            outputs={"X@GRAD": [n + "@GRAD" for n in xs]},
+            attrs=dict(op_.attrs),
+        )
+    ]
 
 
 def _cond_block_lower(ctx, op_):
@@ -106,8 +135,54 @@ def _cond_block_lower(ctx, op_):
     _executor.lower_conditional_block(ctx, op_)
 
 
-register_op("while", lower=_while_lower)
-register_op("conditional_block", lower=_cond_block_lower)
+def _cond_block_grad_lower(ctx, op_):
+    from .. import executor as _executor
+
+    _executor.lower_conditional_block_grad(ctx, op_)
+
+
+def _cond_block_grad_maker(op_):
+    # grads flow to the sub-block's external reads AND to pre-existing
+    # output vars (false-branch pass-through); the union forms X
+    program = op_.block.program
+    idx = op_.attr("sub_block")
+    sub = program.block(idx if isinstance(idx, int) else idx.idx)
+    from .. import executor as _executor
+
+    reads, _writes = _executor._analyze_ops(sub.ops, set())
+    outs = list(op_.output("Out"))
+    # X = sub-block reads + pass-through outputs, restricted to vars visible
+    # in the parent: branch-internal temps' grads are consumed inside the
+    # vjp replay and must not surface as never-produced @GRAD reads
+    xs = list(
+        dict.fromkeys(
+            n
+            for n in reads + outs
+            if op_.block._find_var_recursive(n) is not None
+        )
+    )
+    return [
+        dict(
+            type="conditional_block_grad",
+            inputs={
+                "X": xs,
+                "Cond": list(op_.input("Cond")),
+                "Out": outs,
+                "Out@GRAD": [n + "@GRAD" for n in outs],
+                "Scope": list(op_.output("Scope")),
+            },
+            outputs={"X@GRAD": [n + "@GRAD" for n in xs]},
+            attrs=dict(op_.attrs),
+        )
+    ]
+
+
+register_op("while", lower=_while_lower, grad=_while_grad_maker)
+register_op("while_grad", lower=_while_grad_lower)
+register_op(
+    "conditional_block", lower=_cond_block_lower, grad=_cond_block_grad_maker
+)
+register_op("conditional_block_grad", lower=_cond_block_grad_lower)
 
 
 # ---------------------------------------------------------------------------

@@ -61,8 +61,23 @@ def _conv2d_infer(op_, block):
     )
 
 
+def _use_nhwc():
+    """NHWC internal conv layout on TPU: channels land on the lane (minor)
+    dimension, which is what the MXU tiling wants — feeding NCHW makes XLA
+    insert its own layout conversions around every conv. The API contract
+    (Program-level shapes, feeds, saved weights) stays NCHW; transposes at
+    the conv boundary are folded into XLA's layout assignment."""
+    from .. import flags as _flags
+    from .registry import lowering_backend
+
+    return lowering_backend() in ("tpu", "axon") and bool(
+        _flags.get_flag("conv_nhwc", True)
+    )
+
+
 def _conv2d_lower(ctx, op_):
     import jax.lax as lax
+    import jax.numpy as jnp
 
     x = ctx.in1(op_, "Input")
     w = ctx.in1(op_, "Filter")
@@ -72,16 +87,29 @@ def _conv2d_lower(ctx, op_):
     groups = int(op_.attr("groups", 1)) or 1
     if op_.type == "depthwise_conv2d":
         groups = x.shape[1]
-    out = lax.conv_general_dilated(
-        x,
-        w,
-        window_strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=groups,
-        preferred_element_type=x.dtype,
-    )
+    if _use_nhwc():
+        out = lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(w, (2, 3, 1, 0)),  # OIHW -> HWIO
+            window_strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dil,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+            preferred_element_type=x.dtype,
+        )
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    else:
+        out = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dil,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups,
+            preferred_element_type=x.dtype,
+        )
     ctx.out(op_, "Output", out)
 
 

@@ -116,6 +116,22 @@ def all_op_types():
 # ---------------------------------------------------------------------------
 # Lowering context
 # ---------------------------------------------------------------------------
+# backend name ("cpu"/"tpu"/"axon") of the device the current trace targets.
+# Set by the executor/tracer at the top of each trace; lowering rules may
+# branch on it to pick device-native layouts (e.g. NHWC convs on TPU).
+# Lowering is single-threaded per trace, so a module global is sufficient.
+_lowering_backend = None
+
+
+def set_lowering_backend(backend):
+    global _lowering_backend
+    _lowering_backend = backend
+
+
+def lowering_backend():
+    return _lowering_backend
+
+
 class LowerCtx(object):
     """Environment threaded through the lowering of one block segment.
 

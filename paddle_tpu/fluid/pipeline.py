@@ -241,6 +241,9 @@ class PipelineProgram(object):
         block = self.block
 
         def fn(env_in, key):
+            import jax
+
+            _registry.set_lowering_backend(jax.default_backend())
             env = dict(env_in)
             ctx = LowerCtx(env=env, base_key=key, block=block)
             for o in ops:
