@@ -212,8 +212,11 @@ class Tracer(object):
 
     # -- backward (reference: BasicEngine::Execute, engine.cc) --
     def run_backward(self, loss):
+        import jax
         import jax.numpy as jnp
 
+        # eager grad ops run on the default jax device; set once per replay
+        _registry.set_lowering_backend(jax.default_backend())
         grads = {}  # VarBase id -> jax array
         grads[id(loss)] = jnp.ones_like(loss.value)
         holders = {id(loss): loss}
@@ -258,9 +261,6 @@ class Tracer(object):
                     spec["type"], spec["inputs"], spec["outputs"], spec["attrs"]
                 )
                 gdef = _registry.get_op_def(spec["type"])
-                import jax
-
-                _registry.set_lowering_backend(jax.default_backend())
                 ctx = LowerCtx(env=env)
                 gdef.lower(ctx, gop)
                 for slot, names in spec["outputs"].items():

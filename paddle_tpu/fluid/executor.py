@@ -253,6 +253,21 @@ def lower_while_op(ctx, op_):
         )
 
 
+def _check_no_nested_control_flow(sub, grad_kind):
+    """jax.vjp cannot reverse-differentiate a lax.while_loop traced inside
+    the body replay, so nested while/conditional_block under a grad raises
+    a guided error instead of JAX's opaque internal one."""
+    nested = [o.type for o in sub.ops if o.type in ("while", "conditional_block")]
+    if nested:
+        raise NotImplementedError(
+            "%s over a sub-block containing nested %s is not supported: the "
+            "body replay is differentiated with jax.vjp, which cannot "
+            "reverse-differentiate an inner lax.while_loop. Restructure the "
+            "inner loop as a DynamicRNN/StaticRNN (fused-scan) or hoist it "
+            "out of the differentiated region." % (grad_kind, sorted(set(nested)))
+        )
+
+
 def lower_while_grad_op(ctx, op_):
     """Gradient of `while` (reference: WhileGradOp in
     operators/controlflow/while_op.cc — replays the sub-block's grad ops
@@ -277,6 +292,7 @@ def lower_while_grad_op(ctx, op_):
     frozen = stash["frozen"]
     n_steps = stash["count"]
     sub = _resolve_sub_block(ctx, op_)
+    _check_no_nested_control_flow(sub, "while_grad")
     frozen_names = list(frozen.keys())
     frozen_vals = tuple(frozen[n] for n in frozen_names)
 
@@ -437,6 +453,7 @@ def lower_conditional_block_grad(ctx, op_):
     import jax.numpy as jnp
 
     sub = _resolve_sub_block(ctx, op_)
+    _check_no_nested_control_flow(sub, "conditional_block_grad")
     stash = ctx.get(op_.input("Scope")[0])
     cond = stash["cond"]
     reads_map = stash["reads"]

@@ -28,6 +28,7 @@ def _var_spec(v):
         dtype=v.dtype,
         lod_level=v.lod_level,
         persistable=v.persistable,
+        need_check_feed=getattr(v, "need_check_feed", False),
         stop_gradient=v.stop_gradient,
         is_data=v.is_data,
         type=v.type,
@@ -83,6 +84,7 @@ def program_from_spec(spec):
                 dtype=vs["dtype"],
                 lod_level=vs["lod_level"],
                 persistable=vs["persistable"],
+                need_check_feed=vs.get("need_check_feed", False),
                 stop_gradient=vs["stop_gradient"],
                 is_data=vs["is_data"],
                 type=vs["type"],
@@ -115,13 +117,26 @@ def program_from_spec(spec):
 
 
 def program_to_bytes(program):
-    return MAGIC + pickle.dumps(program_to_spec(program), protocol=2)
+    """Serialize to framework.proto wire-format bytes (proto_wire.py).
+
+    The output parses against the reference schema
+    (/root/reference/paddle/fluid/framework/framework.proto:43-217); extra
+    TPU-side metadata rides in an unknown field conformant parsers skip.
+    """
+    from . import proto_wire
+
+    return proto_wire.encode_program(program_to_spec(program))
 
 
 def program_from_bytes(data):
-    if not data.startswith(MAGIC):
-        raise ValueError("not a paddle_tpu program blob")
-    spec = pickle.loads(data[len(MAGIC):])
+    """Deserialize a program; accepts both the protobuf wire format and the
+    round-1 pickled-dict format (MAGIC-prefixed) for back-compat."""
+    if data.startswith(MAGIC):
+        spec = pickle.loads(data[len(MAGIC):])
+    else:
+        from . import proto_wire
+
+        spec = proto_wire.decode_program(data)
     return program_from_spec(spec)
 
 

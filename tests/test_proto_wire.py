@@ -1,0 +1,207 @@
+"""framework.proto wire-format serialization tests (VERDICT r2 item 4).
+
+The golden test compiles the reference schema
+(/root/reference/paddle/fluid/framework/framework.proto) with protoc into a
+FileDescriptorSet, loads it into a descriptor pool, and parses the bytes our
+hand-rolled encoder produced with google.protobuf — an independent decoder
+proving wire conformance with the reference contract (framework.proto:43-217).
+"""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, proto, proto_wire
+
+REF_PROTO = "/root/reference/paddle/fluid/framework/framework.proto"
+
+
+def _build_program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, pred
+
+
+def test_wire_round_trip_spec():
+    main, _, _ = _build_program()
+    spec = proto.program_to_spec(main)
+    data = proto_wire.encode_program(spec)
+    spec2 = proto_wire.decode_program(data)
+    assert len(spec2["blocks"]) == len(spec["blocks"])
+    b0, b0r = spec["blocks"][0], spec2["blocks"][0]
+    assert [o["type"] for o in b0r["ops"]] == [o["type"] for o in b0["ops"]]
+    assert {v["name"] for v in b0r["vars"]} == {v["name"] for v in b0["vars"]}
+    for v, vr in zip(
+        sorted(b0["vars"], key=lambda d: d["name"]),
+        sorted(b0r["vars"], key=lambda d: d["name"]),
+    ):
+        assert list(v["shape"]) == list(vr["shape"]), v["name"]
+        assert v["dtype"] == vr["dtype"]
+        assert v["persistable"] == vr["persistable"]
+        assert v["is_parameter"] == vr["is_parameter"]
+        assert v["stop_gradient"] == vr["stop_gradient"]
+    for o, orr in zip(b0["ops"], b0r["ops"]):
+        assert o["inputs"] == orr["inputs"]
+        assert o["outputs"] == orr["outputs"]
+        assert set(o["attrs"]) == set(orr["attrs"])
+        for k, val in o["attrs"].items():
+            got = orr["attrs"][k]
+            if isinstance(val, float):
+                assert got == pytest.approx(val, rel=1e-6)
+            elif isinstance(val, (list, tuple)) and val and isinstance(val[0], float):
+                assert list(got) == pytest.approx(list(val), rel=1e-6)
+            else:
+                assert list(got) == list(val) if isinstance(val, (list, tuple)) else got == val
+
+
+def test_attr_classification():
+    C = proto_wire.classify_attr
+    assert C("sub_block", 3) == 8  # BLOCK
+    assert C("x", True) == 6  # BOOLEAN comes before INT (bool is int subtype)
+    assert C("x", 7) == 0  # INT
+    assert C("x", 1 << 40) == 9  # LONG
+    assert C("x", 0.5) == 1  # FLOAT
+    assert C("x", "s") == 2  # STRING
+    assert C("x", []) == 3  # INTS (empty list default)
+    assert C("x", [True, False]) == 7  # BOOLEANS
+    assert C("x", [1, 2]) == 3  # INTS
+    assert C("x", [1 << 40]) == 11  # LONGS
+    assert C("x", [1.0, 2]) == 4  # FLOATS (mixed numeric)
+    assert C("x", ["a"]) == 5  # STRINGS
+    assert C("x", {"not": "encodable"}) is None
+
+
+def test_negative_and_signed_values_round_trip():
+    spec = dict(
+        version=1,
+        random_seed=0,
+        inference_io=None,
+        params_grads=[],
+        blocks=[
+            dict(
+                idx=0,
+                parent_idx=-1,
+                vars=[
+                    dict(
+                        name="v",
+                        shape=[-1, 3],
+                        dtype=core.VarDesc.VarType.INT64,
+                        lod_level=2,
+                        persistable=False,
+                        stop_gradient=False,
+                        is_data=True,
+                        type=core.VarDesc.VarType.LOD_TENSOR,
+                        is_parameter=False,
+                        trainable=None,
+                    )
+                ],
+                ops=[
+                    dict(
+                        type="t",
+                        inputs={"X": ["v"]},
+                        outputs={"Out": ["v"]},
+                        attrs={"neg": -7, "negs": [-1, -2], "axis": -1, "big": -(1 << 40)},
+                    )
+                ],
+            )
+        ],
+    )
+    spec2 = proto_wire.decode_program(proto_wire.encode_program(spec))
+    b = spec2["blocks"][0]
+    assert b["parent_idx"] == -1
+    assert list(b["vars"][0]["shape"]) == [-1, 3]
+    assert b["vars"][0]["lod_level"] == 2
+    assert b["vars"][0]["is_data"] is True
+    a = b["ops"][0]["attrs"]
+    assert a["neg"] == -7 and a["negs"] == [-1, -2] and a["axis"] == -1
+    assert a["big"] == -(1 << 40)
+
+
+@pytest.mark.skipif(
+    shutil.which("protoc") is None, reason="protoc not available"
+)
+def test_golden_bytes_parse_under_reference_schema():
+    """Independent decoder check: protoc-compiled reference schema parses our bytes."""
+    pb = pytest.importorskip("google.protobuf")
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    main, _, _ = _build_program()
+    data = main.desc.serialize_to_string() if hasattr(main, "desc") else proto.program_to_bytes(main)
+
+    with tempfile.TemporaryDirectory() as td:
+        # compile the reference schema without copying it into the repo
+        ds = os.path.join(td, "fd.bin")
+        shutil.copy(REF_PROTO, os.path.join(td, "framework.proto"))
+        subprocess.check_call(
+            ["protoc", "--proto_path", td, "--descriptor_set_out", ds, "framework.proto"]
+        )
+        fds = descriptor_pb2.FileDescriptorSet()
+        with open(ds, "rb") as fh:
+            fds.ParseFromString(fh.read())
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+    md = pool.FindMessageTypeByName("paddle.framework.proto.ProgramDesc")
+    ProgramDesc = message_factory.GetMessageClass(md)
+
+    msg = ProgramDesc()
+    msg.ParseFromString(data)  # raises on malformed wire data
+
+    # structural parity with what we encoded
+    spec = proto.program_to_spec(main)
+    assert len(msg.blocks) == len(spec["blocks"])
+    b0, m0 = spec["blocks"][0], msg.blocks[0]
+    assert m0.idx == b0["idx"]
+    assert [o.type for o in m0.ops] == [o["type"] for o in b0["ops"]]
+    assert {v.name for v in m0.vars} == {v["name"] for v in b0["vars"]}
+    # VarDesc details decode correctly under the reference schema
+    by_name = {v.name: v for v in m0.vars}
+    for vs in b0["vars"]:
+        v = by_name[vs["name"]]
+        assert v.persistable == bool(vs["persistable"])
+        if vs["type"] == core.VarDesc.VarType.LOD_TENSOR and vs["dtype"] != 22:
+            assert v.type.type == vs["type"]
+            assert v.type.lod_tensor.tensor.data_type == vs["dtype"]
+            dims = [int(d) if d is not None else -1 for d in vs["shape"]]
+            assert list(v.type.lod_tensor.tensor.dims) == dims
+    # op inputs/outputs/attrs decode correctly
+    for ospec, mop in zip(b0["ops"], m0.ops):
+        assert {iv.parameter: list(iv.arguments) for iv in mop.inputs} == ospec["inputs"]
+        assert {ov.parameter: list(ov.arguments) for ov in mop.outputs} == ospec["outputs"]
+        mattrs = {a.name: a for a in mop.attrs}
+        for k, val in ospec["attrs"].items():
+            if proto_wire.classify_attr(k, val) is not None:
+                assert k in mattrs
+
+
+def test_save_load_inference_model_round_trips_wire_format():
+    main, startup, pred = _build_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    infer_prog = main.clone(for_test=True)
+    pred_t = infer_prog.global_block().var(pred.name)
+    x = np.random.RandomState(0).rand(4, 13).astype(np.float32)
+    y0 = np.zeros((4, 1), np.float32)  # clone(for_test) keeps the loss ops
+    ref = exe.run(infer_prog, feed={"x": x, "y": y0}, fetch_list=[pred_t])[0]
+
+    with tempfile.TemporaryDirectory() as td:
+        fluid.io.save_inference_model(td, ["x"], [pred_t], exe, main_program=infer_prog)
+        # the saved __model__ must be wire-format, NOT the legacy pickle format
+        with open(os.path.join(td, "__model__"), "rb") as fh:
+            head = fh.read(16)
+        assert not head.startswith(proto.MAGIC)
+        prog2, feeds, fetches = fluid.io.load_inference_model(td, exe)
+        out = exe.run(prog2, feed={"x": x}, fetch_list=fetches)[0]
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
