@@ -36,6 +36,9 @@ _RANDOM_OPS = {
     "uniform_random",
     "gaussian_random",
     "truncated_gaussian_random",
+    "gaussian_random_batch_size_like",
+    "random_crop",
+    "nce",
     "dropout",
     "dpsgd",
 }
@@ -712,7 +715,14 @@ class _CompiledBlock(object):
                 n for n in const_all if self._has_dist_attr(n)
             ]
             const = [n for n in const_all if n not in sharded_const]
-            needs_rng = any(o.type in _RANDOM_OPS for o in seg.ops)
+            needs_rng = any(
+                o.type in _RANDOM_OPS
+                or (
+                    o.type.endswith("_grad")
+                    and o.type[: -len("_grad")] in _RANDOM_OPS
+                )
+                for o in seg.ops
+            )
 
             fn = self._build_segment_fn(
                 seg, feeds, mutable, sharded_const, const, out_names

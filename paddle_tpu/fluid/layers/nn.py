@@ -13,6 +13,8 @@ from ..initializer import Constant, Normal, Xavier
 from ..param_attr import ParamAttr
 
 __all__ = [
+    "hsigmoid",
+    "nce",
     "scale",
     "sequence_pool",
     "sequence_first_step",
@@ -1608,3 +1610,109 @@ def crf_decoding(input, param_attr, label=None, length=None):
         outputs={"ViterbiPath": [viterbi_path]},
     )
     return viterbi_path
+
+
+def hsigmoid(
+    input,
+    label,
+    num_classes,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+    path_table=None,
+    path_code=None,
+    is_custom=False,
+    is_sparse=False,
+):
+    """Hierarchical sigmoid loss (reference: layers/nn.py hsigmoid over
+    hierarchical_sigmoid_op.cc). Default = complete binary tree over
+    num_classes; custom trees pass path_table/path_code."""
+    helper = LayerHelper("hsigmoid", **locals())
+    dtype = helper.input_dtype()
+    num_nodes = num_classes - 1 if not is_custom else num_classes
+    w = helper.create_parameter(
+        attr=param_attr, shape=[max(num_nodes, 1), input.shape[-1]], dtype=dtype
+    )
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr, shape=[max(num_nodes, 1), 1], dtype=dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    if path_table is not None:
+        inputs["PathTable"] = [path_table]
+    if path_code is not None:
+        inputs["PathCode"] = [path_code]
+    out = helper.create_variable_for_type_inference(dtype)
+    pre_out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": num_classes, "is_sparse": is_sparse},
+    )
+    return out
+
+
+def nce(
+    input,
+    label,
+    num_total_classes,
+    sample_weight=None,
+    param_attr=None,
+    bias_attr=None,
+    num_neg_samples=None,
+    name=None,
+    sampler="uniform",
+    custom_dist=None,
+    seed=0,
+    is_sparse=False,
+):
+    """Noise-contrastive estimation loss (reference: layers/nn.py nce over
+    nce_op.cc)."""
+    helper = LayerHelper("nce", **locals())
+    dtype = helper.input_dtype()
+    dim = input.shape[-1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=[num_total_classes, dim], dtype=dtype
+    )
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr, shape=[num_total_classes, 1], dtype=dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    if custom_dist is not None:
+        block = helper.main_program.current_block()
+        probs = block.create_var(
+            name=helper.name + "_custom_dist", dtype=dtype,
+            shape=[num_total_classes], persistable=True,
+        )
+        from .tensor import assign
+
+        assign(np.asarray(custom_dist, dtype=np.float32), output=probs)
+        inputs["CustomDistProbs"] = [probs]
+    cost = helper.create_variable_for_type_inference(dtype)
+    sample_logits = helper.create_variable_for_type_inference(dtype)
+    sample_labels = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="nce",
+        inputs=inputs,
+        outputs={
+            "Cost": [cost],
+            "SampleLogits": [sample_logits],
+            "SampleLabels": [sample_labels],
+        },
+        attrs={
+            "num_total_classes": num_total_classes,
+            "num_neg_samples": num_neg_samples or 10,
+            "seed": seed,
+            "sampler": {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler],
+            "is_sparse": is_sparse,
+        },
+    )
+    return cost

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .registry import op, same_shape_infer
+from .registry import in_var, op, same_shape_infer, set_out
 
 
 @op("kldiv_loss", grad="generic")
@@ -263,3 +263,157 @@ def _warpctc(ctx, op_):
         loss = loss / logit_lens.astype(loss.dtype)
     ctx.out(op_, "Loss", loss[:, None])
     ctx.out(op_, "WarpCTCGrad", jnp.zeros_like(logits))
+
+
+# -- op-gap closure batch (OPS_AUDIT.md): losses ----------------------------
+@op("modified_huber_loss", grad="generic")
+def _modified_huber_loss(ctx, op_):
+    """Reference modified_huber_loss_op.cc: y in {0,1} -> s = 2y-1;
+    loss = max(0, 1-sx)^2 if sx >= -1 else -4sx."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    s = (2.0 * y - 1.0) * x
+    inter = jnp.maximum(0.0, 1.0 - s)
+    loss = jnp.where(s < -1.0, -4.0 * s, inter * inter)
+    ctx.out(op_, "IntermediateVal", inter)
+    ctx.out(op_, "Out", loss.reshape(-1, 1))
+
+
+@op("teacher_student_sigmoid_loss", grad="generic")
+def _teacher_student_sigmoid_loss(ctx, op_):
+    """Reference teacher_student_sigmoid_loss_op.cc (CTR distillation):
+    label < -1: teacher-only; -1 <= label < 0: click term; else combined."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X").reshape(-1)
+    label = ctx.in1(op_, "Label").reshape(-1)
+    soft_max_up = float(op_.attr("soft_max_up_bound", 15.0))
+    soft_max_lo = float(op_.attr("soft_max_lower_bound", -15.0))
+    # log(1+exp(x)) stable
+    softplus = jnp.logaddexp(0.0, x)
+    ce_neg = softplus  # -log(1-sigmoid(x))
+    ce_pos = softplus - x  # -log(sigmoid(x))
+    xc = jnp.clip(x, soft_max_lo, soft_max_up)
+    teacher = jnp.logaddexp(0.0, xc) - label * xc  # soft cross-entropy
+    loss = jnp.where(
+        label < -1.0,
+        ce_neg,
+        jnp.where(label < 0.0, ce_pos, ce_neg + teacher),
+    )
+    ctx.out(op_, "Y", loss.reshape(-1, 1))
+
+
+def _hsigmoid_infer(op_, block):
+    x = in_var(op_, block, "X")
+    set_out(op_, block, "Out", [x.shape[0], 1], x.dtype)
+
+
+@op("hierarchical_sigmoid", infer_shape=_hsigmoid_infer, grad="generic")
+def _hierarchical_sigmoid(ctx, op_):
+    """Reference hierarchical_sigmoid_op.cc: default complete binary tree
+    over num_classes leaves; loss = sum over path of softplus(+/- w.x).
+
+    TPU-native: the (code, path-node) walk is precomputable arithmetic on
+    the label id (complete-tree layout), so the whole loss is a masked
+    gather + matmul — no per-sample host loop. Custom trees
+    (PathTable/PathCode inputs) use the provided dense tables directly."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, D]
+    w = ctx.in1(op_, "W")  # [num_nodes, D]
+    label = ctx.in1(op_, "Label").reshape(-1).astype(jnp.int32)  # [B]
+    bias = ctx.in1(op_, "Bias", optional=True)
+    ptable = ctx.in1(op_, "PathTable", optional=True)
+    pcode = ctx.in1(op_, "PathCode", optional=True)
+    if ptable is not None:
+        nodes = ptable.astype(jnp.int32)  # [B, L] node ids, -1 pad
+        codes = pcode.astype(jnp.float32)  # [B, L] 0/1
+        valid = (nodes >= 0).astype(x.dtype)
+        nodes = jnp.maximum(nodes, 0)
+    else:
+        num_classes = int(op_.attr("num_classes"))
+        depth = max(1, int(np.ceil(np.log2(max(2, num_classes)))))
+        # complete binary tree: leaf id -> internal node index per level
+        node = label + num_classes  # 1-based heap position of the leaf
+        lvls = []
+        code_l = []
+        for _ in range(depth):
+            parent = node // 2
+            lvls.append(parent - 1)  # internal node row in W (0-based)
+            code_l.append((node % 2).astype(jnp.float32))
+            node = parent
+        nodes = jnp.stack(lvls[::-1], axis=1)  # [B, L] root-first
+        codes = jnp.stack(code_l[::-1], axis=1)
+        valid = (nodes >= 0).astype(x.dtype) * (nodes < w.shape[0]).astype(x.dtype)
+        nodes = jnp.clip(nodes, 0, w.shape[0] - 1)
+    wn = w[nodes]  # [B, L, D]
+    logits = jnp.einsum("bld,bd->bl", wn, x)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[nodes]
+    # code 1 -> positive branch: loss term softplus(-z) if code else softplus(z)
+    term = jnp.logaddexp(0.0, logits) - codes.astype(x.dtype) * logits
+    ctx.out(op_, "Out", jnp.sum(term * valid, axis=1).reshape(-1, 1))
+    ctx.out(op_, "PreOut", logits)
+
+
+def _nce_infer(op_, block):
+    x = in_var(op_, block, "Input")
+    set_out(op_, block, "Cost", [x.shape[0], 1], x.dtype)
+
+
+@op("nce", infer_shape=_nce_infer, grad="generic")
+def _nce(ctx, op_):
+    """Noise-contrastive estimation (reference: nce_op.cc). Uniform or
+    custom negative sampling; per-sample logistic loss vs noise prob."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [B, D]
+    label = ctx.in1(op_, "Label").astype(jnp.int32)  # [B, num_true]
+    w = ctx.in1(op_, "Weight")  # [num_classes, D]
+    bias = ctx.in1(op_, "Bias", optional=True)
+    dist = ctx.in1(op_, "CustomDistProbs", optional=True)
+    num_neg = int(op_.attr("num_neg_samples", 10))
+    num_classes = int(op_.attr("num_total_classes", w.shape[0]))
+    if label.ndim == 1:
+        label = label[:, None]
+    num_true = label.shape[1]
+    bsz = x.shape[0]
+    if dist is None:
+        samples = jax.random.randint(
+            ctx.next_key(), (bsz, num_neg), 0, num_classes
+        )
+        p_noise = jnp.full((), 1.0 / num_classes, x.dtype)
+        p_neg = jnp.broadcast_to(p_noise, samples.shape)
+        p_pos = jnp.broadcast_to(p_noise, label.shape)
+    else:
+        dist = dist.reshape(-1)
+        samples = jax.random.categorical(
+            ctx.next_key(), jnp.log(dist + 1e-20)[None], shape=(bsz, num_neg)
+        )
+        p_neg = dist[samples]
+        p_pos = dist[label]
+
+    def logit(ids):
+        wv = w[ids]  # [B, K, D]
+        z = jnp.einsum("bkd,bd->bk", wv, x)
+        if bias is not None:
+            z = z + bias.reshape(-1)[ids]
+        return z
+
+    z_pos = logit(label)  # [B, num_true]
+    z_neg = logit(samples)  # [B, num_neg]
+    # NCE logistic: P(d=1|z) = sigmoid(z - log(k*p_noise))
+    adj_pos = z_pos - jnp.log(num_neg * p_pos.astype(x.dtype))
+    adj_neg = z_neg - jnp.log(num_neg * p_neg.astype(x.dtype))
+    loss_pos = jnp.sum(jnp.logaddexp(0.0, -adj_pos), axis=1) / num_true
+    loss_neg = jnp.sum(jnp.logaddexp(0.0, adj_neg), axis=1)
+    cost = loss_pos + loss_neg
+    sw = ctx.in1(op_, "SampleWeight", optional=True)
+    if sw is not None:
+        cost = cost * sw.reshape(-1).astype(cost.dtype)
+    ctx.out(op_, "Cost", cost.reshape(-1, 1))
+    ctx.out(op_, "SampleLogits", z_neg)
+    ctx.out(op_, "SampleLabels", samples.astype(np.int64))

@@ -772,3 +772,121 @@ def _unfold(ctx, op_):
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
     ctx.out(op_, "Y", patches.reshape(n, c * ks[0] * ks[1], -1))
+
+
+# -- op-gap closure batch (OPS_AUDIT.md): similarity / products -------------
+def _cos_sim_infer(op_, block):
+    v = in_var(op_, block, "X")
+    set_out(op_, block, "Out", [v.shape[0], 1], v.dtype)
+    set_out(op_, block, "XNorm", [v.shape[0], 1], v.dtype)
+    yv = in_var(op_, block, "Y")
+    set_out(op_, block, "YNorm", [yv.shape[0], 1], yv.dtype)
+
+
+@op("cos_sim", infer_shape=_cos_sim_infer, grad="generic")
+def _cos_sim(ctx, op_):
+    """Row-wise cosine similarity (reference: cos_sim_op.cc); Y may have
+    batch 1 and broadcast against X."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
+    num = jnp.sum(x * y, axis=1, keepdims=True)
+    ctx.out(op_, "Out", num / (xn * yn + 1e-12))
+    ctx.out(op_, "XNorm", xn)
+    ctx.out(op_, "YNorm", yn)
+
+
+def _squared_l2_distance_infer(op_, block):
+    v = in_var(op_, block, "X")
+    set_out(op_, block, "Out", [v.shape[0], 1], v.dtype)
+    set_out(op_, block, "sub_result", list(v.shape), v.dtype)
+
+
+@op("squared_l2_distance", infer_shape=_squared_l2_distance_infer, grad="generic")
+def _squared_l2_distance(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    sub = x - y
+    ctx.out(op_, "sub_result", sub)
+    ctx.out(op_, "Out", jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim))).reshape(-1, 1))
+
+
+def _bilinear_tp_infer(op_, block):
+    x = in_var(op_, block, "X")
+    w = in_var(op_, block, "Weight")
+    set_out(op_, block, "Out", [x.shape[0], w.shape[0]], x.dtype)
+
+
+@op("bilinear_tensor_product", infer_shape=_bilinear_tp_infer, grad="generic")
+def _bilinear_tensor_product(ctx, op_):
+    """out[b, k] = x[b] . W[k] . y[b]^T (+ bias)
+    (reference: bilinear_tensor_product_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, M]
+    y = ctx.in1(op_, "Y")  # [B, N]
+    w = ctx.in1(op_, "Weight")  # [K, M, N]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    b = ctx.in1(op_, "Bias", optional=True)
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    ctx.out(op_, "Out", out)
+
+
+@op("add_position_encoding", infer_shape=same_shape_infer("X"), grad="generic")
+def _add_position_encoding(ctx, op_):
+    """out = alpha*x + beta*sinusoid(pos) (reference:
+    add_position_encoding_op.cc; Transformer positional encoding)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, D]
+    alpha = float(op_.attr("alpha", 1.0))
+    beta = float(op_.attr("beta", 1.0))
+    b, t, d = x.shape
+    half = d // 2
+    rest = d - half  # odd D: cos block carries the extra column
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]  # [T, 1]
+    wavelen = lambda n: jnp.power(  # noqa: E731
+        10000.0, jnp.arange(n, dtype=jnp.float32) / max(half, 1)
+    )
+    enc = jnp.concatenate(
+        [jnp.sin(pos / wavelen(half)), jnp.cos(pos / wavelen(rest))], axis=1
+    )  # [T, D]
+    ctx.out(op_, "Out", alpha * x + beta * enc[None].astype(x.dtype))
+
+
+@op("similarity_focus")
+def _similarity_focus(ctx, op_):
+    """Similarity-focus mask (reference: similarity_focus_op.cc): per
+    selected channel, greedily pick the largest remaining cell whose row AND
+    column are both unused, mark it, and retire that row+column — repeated
+    min(H, W) times (the reference walks cells in descending order with
+    row/col exclusivity)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, C, H, W] (axis must be 1 per reference)
+    axis = int(op_.attr("axis", 1))
+    idx = [int(i) for i in op_.attr("indexes", [])]
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: only axis=1 supported")
+    bsz, c, h, w = x.shape
+    neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+    mask = jnp.zeros((bsz, h, w), x.dtype)
+    for ch in idx:
+        fm = x[:, ch]  # [B, H, W]
+        row_used = jnp.zeros((bsz, h), bool)
+        col_used = jnp.zeros((bsz, w), bool)
+        for _ in range(min(h, w)):  # static trip count; XLA unrolls
+            avail = (~row_used)[:, :, None] & (~col_used)[:, None, :]
+            fa = jnp.where(avail, fm, neg)
+            flat = jnp.argmax(fa.reshape(bsz, -1), axis=1)
+            ri, ci = flat // w, flat % w
+            mask = mask.at[jnp.arange(bsz), ri, ci].set(1)
+            row_used = row_used.at[jnp.arange(bsz), ri].set(True)
+            col_used = col_used.at[jnp.arange(bsz), ci].set(True)
+    ctx.out(op_, "Out", jnp.broadcast_to(mask[:, None], x.shape).astype(x.dtype))

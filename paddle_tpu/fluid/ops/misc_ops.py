@@ -450,3 +450,89 @@ def _coalesce_tensor(ctx, op_):
         size = int(np.prod(x.shape))
         ctx.set(name, flat[offset:offset + size].reshape(x.shape))
         offset += size
+
+
+# -- op-gap closure batch (OPS_AUDIT.md) ------------------------------------
+@op("fake_init")
+def _fake_init(ctx, op_):
+    """reference: distributed_ops/fake_init_op.cc — placeholder init for
+    vars whose real values live on a pserver: allocate zeros of attr shape."""
+    import jax.numpy as jnp
+
+    shape = [int(s) for s in op_.attr("shape", [])]
+    ctx.out(op_, "Out", jnp.zeros(shape, np.float32))
+
+
+@op("ctc_align")
+def _ctc_align(ctx, op_):
+    """CTC decode alignment (reference: ctc_align_op.cc): merge repeats,
+    drop blanks. Dense form: output padded with -1 like the empty-LoD
+    convention, plus a length companion."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [B, T] int labels
+    blank = int(op_.attr("blank", 0))
+    merge = bool(op_.attr("merge_repeated", True))
+    pad_val = int(op_.attr("padding_value", 0))
+    xi = x.astype(jnp.int32)
+    prev = jnp.concatenate([jnp.full((xi.shape[0], 1), -1, jnp.int32), xi[:, :-1]], axis=1)
+    keep = xi != blank
+    if merge:
+        keep = keep & (xi != prev)
+    # stable left-pack of kept entries
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(xi, order, axis=1)
+    cnt = jnp.sum(keep, axis=1)
+    pos = jnp.arange(xi.shape[1])[None, :]
+    out = jnp.where(pos < cnt[:, None], packed, pad_val)
+    ctx.out(op_, "Output", out)
+    out_names = op_.outputs.get("Output") or []
+    if out_names:
+        ctx.set(out_names[0] + "@SEQ_LEN", cnt.astype(jnp.int32))
+
+
+def _filter_by_instag_host(ctx, op_):
+    """reference: filter_by_instag_op.cc — keep instances whose tag set
+    intersects filter_tag; emits filtered rows + per-instance index map +
+    loss weight. Host-side (CPU in the reference too). is_lod=True groups
+    Ins rows into instances by the `@SEQ_LEN` length companion; otherwise
+    each row is one instance."""
+    ins_name = op_.input("Ins")[0]
+    x1 = np.asarray(ctx.scope.get(ins_name))
+    x2 = np.asarray(ctx.scope.get(op_.input("Ins_tag")[0])).reshape(-1)
+    x3 = set(int(t) for t in op_.attr("filter_tag", []))
+    is_lod = bool(op_.attr("is_lod", True))
+    lens = None
+    if is_lod:
+        lens = ctx.scope.get(ins_name + "@SEQ_LEN")
+    if lens is not None:
+        lens = np.asarray(lens).reshape(-1).astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(lens)])
+    else:  # one row per instance
+        lens = np.ones(x1.shape[0], np.int64)
+        starts = np.arange(x1.shape[0] + 1)
+    n_inst = len(lens)
+    keep_inst = [i for i in range(n_inst) if int(x2[i]) in x3] if len(x2) >= n_inst else []
+    if not keep_inst:
+        out = np.zeros((1,) + x1.shape[1:], x1.dtype)
+        lw = np.zeros((1, 1), np.float32)
+        imap = np.zeros((1, 2), np.int64)
+        out_lens = np.asarray([1], np.int64)
+    else:
+        rows = np.concatenate(
+            [np.arange(starts[i], starts[i + 1]) for i in keep_inst]
+        )
+        out = x1[rows]
+        lw = np.ones((len(keep_inst), 1), np.float32)
+        imap = np.stack(
+            [np.arange(len(keep_inst)), np.asarray(keep_inst)], axis=1
+        ).astype(np.int64)
+        out_lens = lens[keep_inst]
+    out_name = op_.output("Out")[0]
+    ctx.scope.set(out_name, out)
+    ctx.scope.set(out_name + "@SEQ_LEN", out_lens.astype(np.int32))
+    ctx.scope.set(op_.output("LossWeight")[0], lw)
+    ctx.scope.set(op_.output("IndexMap")[0], imap)
+
+
+register_op("filter_by_instag", lower=_filter_by_instag_host, host=True)

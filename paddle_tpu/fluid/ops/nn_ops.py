@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import core
 from .registry import (
     SkipInferShape,
     in_var,
@@ -535,3 +536,225 @@ def _bilinear_interp(ctx, op_):
         oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
     out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
     ctx.out(op_, "Out", out)
+
+
+# -- op-gap closure batch (OPS_AUDIT.md): fc / indexed pooling / unpool -----
+def _fc_infer(op_, block):
+    x = in_var(op_, block, "Input")
+    w = in_var(op_, block, "W")
+    ncd = int(op_.attr("in_num_col_dims", 1))
+    set_out(op_, block, "Out", list(x.shape[:ncd]) + [w.shape[-1]], x.dtype)
+
+
+@op("fc", infer_shape=_fc_infer, grad="generic")
+def _fc(ctx, op_):
+    """Op-level fc (reference: fc_op.cc): flatten by in_num_col_dims, x.W
+    (+bias) (+relu). The Python fc layer composes mul+elementwise_add; this
+    op exists for fused-program and inference-model parity."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")
+    w = ctx.in1(op_, "W")
+    ncd = int(op_.attr("in_num_col_dims", 1))
+    lead = x.shape[:ncd]
+    x2 = x.reshape((int(np.prod(lead)) if lead else 1, -1))
+    out = x2 @ w.reshape(x2.shape[1], -1)
+    b = ctx.in1(op_, "Bias", optional=True)
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    if op_.attr("activation_type", "") == "relu":
+        out = jnp.maximum(out, 0)
+    ctx.out(op_, "Out", out.reshape(tuple(lead) + (w.shape[-1],)))
+
+
+def _pool_with_index_infer(op_, block):
+    x = in_var(op_, block, "X")
+    k = len(x.shape) - 2
+    ksize = [int(v) for v in op_.attr("ksize")]
+    if op_.attr("global_pooling", False):
+        ksize = [1] * k
+        shape = list(x.shape[:2]) + ksize
+    elif op_.attr("adaptive", False):
+        shape = list(x.shape[:2]) + ksize
+    else:
+        strides = [int(v) for v in op_.attr("strides", [1] * k)]
+        pads = [int(v) for v in op_.attr("paddings", [0] * k)]
+        shape = list(x.shape[:2]) + [
+            _conv_out_dim(x.shape[2 + i], ksize[i], pads[i], strides[i])
+            for i in range(k)
+        ]
+    set_out(op_, block, "Out", shape, x.dtype)
+    set_out(op_, block, "Mask", shape, core.VarDesc.VarType.INT32)
+
+
+def _max_pool_with_index(ctx, op_, nd):
+    """max_pool{2,3}d_with_index (reference: pool_with_index_op.cc).
+
+    TPU scheme: extract windows as patches (a strided gather XLA fuses),
+    then argmax over the patch axis — Out via take_along_axis so the
+    generic vjp routes gradients through the selected elements, Mask holds
+    flat spatial indices like the reference kernel."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C, *spatial]
+    spatial = x.shape[2:]
+    k = [int(v) for v in op_.attr("ksize")]
+    if op_.attr("adaptive", False) and not op_.attr("global_pooling", False):
+        # adaptive: bins of size spatial/ksize (divisibility required for a
+        # static lowering, same contract as pool2d's adaptive path)
+        for i in range(nd):
+            assert spatial[i] % k[i] == 0, (
+                "adaptive max_pool_with_index needs divisible dims"
+            )
+        bins = list(k)
+        k = [spatial[i] // bins[i] for i in range(nd)]
+        strides = list(k)
+        pads = [0] * nd
+    elif op_.attr("global_pooling", False):
+        k = list(spatial)
+        strides = [1] * nd
+        pads = [0] * nd
+    else:
+        strides = [int(v) for v in op_.attr("strides", [1] * nd)]
+        pads = [int(v) for v in op_.attr("paddings", [0] * nd)]
+    n, c = x.shape[:2]
+    neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+    xp = jnp.pad(
+        x,
+        [(0, 0), (0, 0)] + [(p, p) for p in pads],
+        constant_values=neg,
+    )
+    # window index grid -> gather patches [N, C, *out, prod(k)]
+    out_dims = [
+        (spatial[i] + 2 * pads[i] - k[i]) // strides[i] + 1 for i in range(nd)
+    ]
+    # window start coordinates per output position, in padded space
+    grids = jnp.meshgrid(
+        *[jnp.arange(out_dims[i]) * strides[i] for i in range(nd)], indexing="ij"
+    )
+    pshape = [xp.shape[2 + i] for i in range(nd)]
+    xf = xp.reshape(n, c, -1)
+    patch_list = []
+    for off in np.ndindex(*k):
+        pos = jnp.zeros_like(grids[0])
+        for i in range(nd):
+            pos = pos * pshape[i] + (grids[i] + off[i])
+        patch_list.append(xf[:, :, pos.reshape(-1)])
+    patches = jnp.stack(patch_list, axis=-1)  # [N, C, prod(out), K]
+    amax = jnp.argmax(patches, axis=-1)  # [N, C, prod(out)]
+    out = jnp.take_along_axis(patches, amax[..., None], axis=-1)[..., 0]
+    # mask: flat index into the UNPADDED input, reference contract
+    koffs = np.stack([o.reshape(-1) for o in np.meshgrid(*[np.arange(ki) for ki in k], indexing="ij")], 0)  # [nd, K]
+    koffs = jnp.asarray(koffs)
+    per_dim = []
+    for i in range(nd):
+        base_i = grids[i].reshape(-1)[None, :]  # [1, prod(out)]
+        off_i = koffs[i][:, None]  # [K, 1]
+        per_dim.append(base_i + off_i - pads[i])  # padded -> unpadded coord
+    sel = jnp.stack(per_dim, 0)  # [nd, K, prod(out)]
+    flat_unpad = jnp.zeros(sel.shape[1:], jnp.int32)
+    for i in range(nd):
+        flat_unpad = flat_unpad * spatial[i] + sel[i].astype(jnp.int32)
+    # pick the coordinate of the argmax patch element
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(flat_unpad.T[None, None], patches.shape),
+        amax[..., None],
+        axis=-1,
+    )[..., 0]
+    oshape = (n, c) + tuple(out_dims)
+    ctx.out(op_, "Out", out.reshape(oshape))
+    ctx.out(op_, "Mask", mask.reshape(oshape).astype(np.int32))
+
+
+@op("max_pool2d_with_index", infer_shape=_pool_with_index_infer, grad="generic")
+def _max_pool2d_with_index(ctx, op_):
+    _max_pool_with_index(ctx, op_, 2)
+
+
+@op("max_pool3d_with_index", infer_shape=_pool_with_index_infer, grad="generic")
+def _max_pool3d_with_index(ctx, op_):
+    _max_pool_with_index(ctx, op_, 3)
+
+
+@op("unpool", grad="generic")
+def _unpool(ctx, op_):
+    """Max-unpool2d (reference: unpool_op.cc): scatter values back to the
+    positions recorded in Indices."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C, H, W]
+    idx = ctx.in1(op_, "Indices").astype(jnp.int32)
+    out_hw = [int(v) for v in op_.attr("unpooled_size", op_.attr("ksize", []))]
+    n, c, h, w = x.shape
+    oh, ow = out_hw[-2], out_hw[-1]
+    zeros = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = zeros.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1),
+    ].set(x.reshape(n, c, -1))
+    ctx.out(op_, "Out", out.reshape(n, c, oh, ow))
+
+
+@op("spp", grad="generic")
+def _spp(ctx, op_):
+    """Spatial pyramid pooling (reference: spp_op.cc): pyramid_height
+    levels of adaptive pooling, flattened + concatenated."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C, H, W]
+    levels = int(op_.attr("pyramid_height", 1))
+    ptype = op_.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        # reference uses ceil-mode kernel with padding; static approximation:
+        # partition indices per bin via jnp.array_split semantics
+        hb = [h * i // bins for i in range(bins + 1)]
+        wb = [w * i // bins for i in range(bins + 1)]
+        cells = []
+        for i in range(bins):
+            for j in range(bins):
+                cell = x[:, :, hb[i]:max(hb[i + 1], hb[i] + 1), wb[j]:max(wb[j + 1], wb[j] + 1)]
+                if ptype == "max":
+                    cells.append(jnp.max(cell, axis=(2, 3)))
+                else:
+                    cells.append(jnp.mean(cell, axis=(2, 3)))
+        outs.append(jnp.stack(cells, axis=-1).reshape(n, -1))
+    ctx.out(op_, "Out", jnp.concatenate(outs, axis=1))
+
+
+@op("depthwise_conv2d_transpose", grad="generic")
+def _depthwise_conv2d_transpose(ctx, op_):
+    """Per-channel transposed conv (reference: conv_transpose_op.cc
+    registration depthwise_conv2d_transpose): lhs-dilated conv with
+    feature_group_count = C."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [N, C, H, W]
+    w = ctx.in1(op_, "Filter")  # [C, 1, kh, kw]
+    strides = _pair(op_.attr("strides", [1, 1]))
+    pads = _pair(op_.attr("paddings", [0, 0]))
+    dil = _pair(op_.attr("dilations", [1, 1]))
+    c = x.shape[1]
+    kh, kw = w.shape[2], w.shape[3]
+    # flip spatially; [C, 1, kh, kw] is already OIHW for groups=C
+    wf = jnp.flip(w, axis=(2, 3)).reshape(c, 1, kh, kw)
+    # transposed conv = conv with lhs_dilation=strides, padding k-1-p
+    out = lax.conv_general_dilated(
+        x,
+        wf,
+        window_strides=(1, 1),
+        padding=[
+            (dil[0] * (kh - 1) - pads[0], dil[0] * (kh - 1) - pads[0]),
+            (dil[1] * (kw - 1) - pads[1], dil[1] * (kw - 1) - pads[1]),
+        ],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=c,
+    )
+    ctx.out(op_, "Output", out)

@@ -431,9 +431,12 @@ def _generic_grad_lower(ctx, op):
         for (slot, idx, _), v in zip(wrt, vals):
             env[fwd_inputs[slot][idx]] = v
         # block threads through so ops with sub-blocks (recurrent,
-        # dynamic_decode) can resolve them during the vjp replay
+        # dynamic_decode) can resolve them during the vjp replay; base_key
+        # threads through so random forwards (nce sampling, dropout) replay
+        # the same draws under the vjp
         sub = LowerCtx(
-            env=env, base_key=None, mesh_axes=ctx.mesh_axes, block=ctx.block
+            env=env, base_key=ctx.base_key, mesh_axes=ctx.mesh_axes,
+            block=ctx.block
         )
         fake = _FakeOp(fwd_type, fwd_inputs, fwd_outputs, attrs)
         fwd_def.lower(sub, fake)

@@ -684,3 +684,126 @@ def _diag(ctx, op_):
     import jax.numpy as jnp
 
     ctx.out(op_, "Out", jnp.diag(ctx.in1(op_, "Diagonal")))
+
+
+# -- op-gap closure batch (OPS_AUDIT.md): creation/manipulation ------------
+@op("eye")
+def _eye(ctx, op_):
+    import jax.numpy as jnp
+
+    rows = int(op_.attr("num_rows"))
+    cols = int(op_.attr("num_columns", -1))
+    if cols < 0:
+        cols = rows
+    dt = _np_dtype(op_.attr("dtype", core.VarDesc.VarType.FP32))
+    ctx.out(op_, "Out", jnp.eye(rows, cols, dtype=dt))
+
+
+@op("fill")
+def _fill(ctx, op_):
+    """Reference fill_op.cc: buffer of attr floats reshaped to attr shape."""
+    import jax.numpy as jnp
+
+    shape = [int(s) for s in op_.attr("shape", [])]
+    dt = _np_dtype(op_.attr("dtype", core.VarDesc.VarType.FP32))
+    vals = np.asarray(op_.attr("value", []), np.float64)
+    ctx.out(op_, "Out", jnp.asarray(vals.reshape(shape), dt))
+
+
+@op("fill_zeros_like2", infer_shape=same_shape_infer("X"))
+def _fill_zeros_like2(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    dt = _np_dtype(op_.attr("dtype", core.np_to_dtype(x.dtype)))
+    ctx.out(op_, "Out", jnp.zeros(x.shape, dt))
+
+
+@op("size")
+def _size(ctx, op_):
+    import jax.numpy as jnp
+
+    ctx.out(op_, "Out", jnp.asarray(ctx.in1(op_, "Input").size, np.int64))
+
+
+def _one_hot_v2_infer(op_, block):
+    v = in_var(op_, block, "X")
+    set_out(op_, block, "Out", list(v.shape) + [op_.attr("depth", -1)])
+
+
+@op("one_hot_v2", infer_shape=_one_hot_v2_infer)
+def _one_hot_v2(ctx, op_):
+    """one_hot with the trailing singleton-dim requirement dropped
+    (reference: one_hot_v2_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    depth = int(op_.attr("depth", -1))
+    if op_.input("depth_tensor"):
+        depth = int(np.asarray(ctx.in1(op_, "depth_tensor")).ravel()[0])
+    ctx.out(op_, "Out", jax.nn.one_hot(x.astype(np.int32), depth, dtype=np.float32))
+
+
+@op("gaussian_random_batch_size_like")
+def _gaussian_random_bsl(ctx, op_):
+    import jax
+
+    ref = ctx.in1(op_, "Input")
+    shape = [int(s) for s in op_.attr("shape", [])]
+    shape[int(op_.attr("output_dim_idx", 0))] = ref.shape[
+        int(op_.attr("input_dim_idx", 0))
+    ]
+    dt = _np_dtype(op_.attr("dtype", core.VarDesc.VarType.FP32))
+    out = jax.random.normal(ctx.next_key(), shape, dt) * float(
+        op_.attr("std", 1.0)
+    ) + float(op_.attr("mean", 0.0))
+    ctx.out(op_, "Out", out)
+
+
+@op("random_crop")
+def _random_crop(ctx, op_):
+    """Crop the trailing len(shape) dims at a random offset
+    (reference: random_crop_op.cc; per-sample offsets)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    crop = [int(s) for s in op_.attr("shape", [])]
+    k = len(crop)
+    lead = x.ndim - k
+    maxoff = jnp.asarray([x.shape[lead + i] - crop[i] for i in range(k)], np.int32)
+    batch_dims = x.shape[:lead]
+
+    def crop_one(xi, key):
+        off = jax.random.randint(key, (k,), 0, maxoff + 1)
+        return jax.lax.dynamic_slice(xi, tuple(off[i] for i in range(k)), crop)
+
+    if lead == 0:
+        out = crop_one(x, ctx.next_key())
+    else:
+        flat = x.reshape((-1,) + x.shape[lead:])
+        keys = jax.random.split(ctx.next_key(), flat.shape[0])
+        out = jax.vmap(crop_one)(flat, keys).reshape(tuple(batch_dims) + tuple(crop))
+    ctx.out(op_, "Out", out)
+
+
+@op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, op_):
+    """Stack/concat a LOD_TENSOR_ARRAY (reference:
+    tensor_array_to_tensor_op.cc): axis-concat with OutIndex = sizes."""
+    import jax.numpy as jnp
+
+    arr = ctx.in1(op_, "X")  # TensorArray = time-major stack [T, ...]
+    axis = int(op_.attr("axis", 0))
+    use_stack = bool(op_.attr("use_stack", False))
+    n = arr.shape[0]
+    if use_stack:
+        out = jnp.moveaxis(arr, 0, axis)
+        sizes = np.ones(n, np.int32)
+    else:
+        out = jnp.concatenate([arr[i] for i in range(n)], axis=axis)
+        sizes = np.full(n, arr.shape[1 + axis], np.int32)
+    ctx.out(op_, "Out", out)
+    if op_.output("OutIndex"):
+        ctx.out(op_, "OutIndex", jnp.asarray(sizes))

@@ -136,7 +136,15 @@ def program_from_bytes(data):
     else:
         from . import proto_wire
 
-        spec = proto_wire.decode_program(data)
+        try:
+            spec = proto_wire.decode_program(data)
+        except Exception as e:
+            raise ValueError(
+                "not a paddle_tpu program blob (neither pickle-format nor "
+                "framework.proto wire bytes): %s" % e
+            )
+        if not spec.get("blocks"):
+            raise ValueError("not a paddle_tpu program blob (no blocks)")
     return program_from_spec(spec)
 
 

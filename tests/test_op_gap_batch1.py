@@ -1,0 +1,357 @@
+"""Numeric tests for the OPS_AUDIT.md closure batch 1 (creation/math/loss/
+pool ops), numpy oracles per the reference OpTest method."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from tests.op_test import OpTest
+
+
+class TestEye(OpTest):
+    def setUp(self):
+        self.op_type = "eye"
+        self.inputs = {}
+        self.attrs = {"num_rows": 3, "num_columns": 5, "dtype": 5}
+        self.outputs = {"Out": np.eye(3, 5, dtype=np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFill(OpTest):
+    def setUp(self):
+        self.op_type = "fill"
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "value": vals, "dtype": 5}
+        self.outputs = {"Out": np.asarray(vals, np.float32).reshape(2, 3)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSize(OpTest):
+    def setUp(self):
+        self.op_type = "size"
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        self.inputs = {"Input": x}
+        self.outputs = {"Out": np.asarray(60, np.int64)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestOneHotV2(OpTest):
+    def setUp(self):
+        self.op_type = "one_hot_v2"
+        x = np.asarray([1, 0, 3, 2], np.int64)
+        out = np.zeros((4, 4), np.float32)
+        out[np.arange(4), x] = 1
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCosSim(OpTest):
+    def setUp(self):
+        self.op_type = "cos_sim"
+        rng = np.random.RandomState(7)
+        x = rng.rand(5, 8).astype(np.float32) + 0.1
+        y = rng.rand(5, 8).astype(np.float32) + 0.1
+        xn = np.sqrt((x * x).sum(1, keepdims=True))
+        yn = np.sqrt((y * y).sum(1, keepdims=True))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {
+            "Out": (x * y).sum(1, keepdims=True) / (xn * yn + 1e-12),
+            "XNorm": xn,
+            "YNorm": yn,
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestSquaredL2Distance(OpTest):
+    def setUp(self):
+        self.op_type = "squared_l2_distance"
+        rng = np.random.RandomState(3)
+        x = rng.rand(4, 6).astype(np.float32)
+        y = rng.rand(4, 6).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {
+            "sub_result": x - y,
+            "Out": ((x - y) ** 2).sum(1, keepdims=True),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestBilinearTensorProduct(OpTest):
+    def setUp(self):
+        self.op_type = "bilinear_tensor_product"
+        rng = np.random.RandomState(5)
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 5).astype(np.float32)
+        w = rng.rand(6, 4, 5).astype(np.float32)
+        b = rng.rand(1, 6).astype(np.float32)
+        out = np.einsum("bm,kmn,bn->bk", x, w, y) + b
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+        self.outputs = {"Out": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y", "Weight"], "Out")
+
+
+class TestAddPositionEncoding(OpTest):
+    def setUp(self):
+        self.op_type = "add_position_encoding"
+        rng = np.random.RandomState(11)
+        x = rng.rand(2, 7, 8).astype(np.float32)
+        alpha, beta = 0.5, 2.0
+        b, t, d = x.shape
+        half = d // 2
+        pos = np.arange(t, dtype=np.float32)[:, None]
+        div = np.power(10000.0, np.arange(half, dtype=np.float32) / half)
+        enc = np.concatenate([np.sin(pos / div), np.cos(pos / div)], axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": alpha, "beta": beta}
+        self.outputs = {"Out": (alpha * x + beta * enc[None]).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestModifiedHuberLoss(OpTest):
+    def setUp(self):
+        self.op_type = "modified_huber_loss"
+        rng = np.random.RandomState(13)
+        x = rng.uniform(-2, 2, (10, 1)).astype(np.float32)
+        y = (rng.rand(10, 1) > 0.5).astype(np.float32)
+        s = (2 * y - 1) * x
+        inter = np.maximum(0.0, 1.0 - s)
+        loss = np.where(s < -1, -4.0 * s, inter ** 2)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": inter.astype(np.float32),
+                        "Out": loss.reshape(-1, 1).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMaxPool2dWithIndex(OpTest):
+    def setUp(self):
+        self.op_type = "max_pool2d_with_index"
+        rng = np.random.RandomState(17)
+        # well-separated values: finite-difference perturbation (delta=5e-3)
+        # must never flip a window argmax
+        x = (rng.permutation(2 * 3 * 6 * 6).astype(np.float32) * 0.05).reshape(
+            2, 3, 6, 6
+        )
+        k, s, p = 2, 2, 0
+        oh = ow = 3
+        out = np.zeros((2, 3, oh, ow), np.float32)
+        mask = np.zeros((2, 3, oh, ow), np.int32)
+        for n in range(2):
+            for c in range(3):
+                for i in range(oh):
+                    for j in range(ow):
+                        win = x[n, c, i * s:i * s + k, j * s:j * s + k]
+                        out[n, c, i, j] = win.max()
+                        a = np.unravel_index(win.argmax(), win.shape)
+                        mask[n, c, i, j] = (i * s + a[0]) * 6 + (j * s + a[1])
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [k, k], "strides": [s, s], "paddings": [p, p]}
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestUnpool(OpTest):
+    def setUp(self):
+        self.op_type = "unpool"
+        x = np.asarray([[[[1.0, 2.0], [3.0, 4.0]]]], np.float32)
+        idx = np.asarray([[[[0, 3], [8, 15]]]], np.int32)
+        out = np.zeros((1, 1, 16), np.float32)
+        out[0, 0, [0, 3, 8, 15]] = [1, 2, 3, 4]
+        self.inputs = {"X": x, "Indices": idx}
+        self.attrs = {"unpooling_type": "max", "unpooled_size": [4, 4]}
+        self.outputs = {"Out": out.reshape(1, 1, 4, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSpp(OpTest):
+    def setUp(self):
+        self.op_type = "spp"
+        rng = np.random.RandomState(19)
+        x = rng.rand(2, 3, 4, 4).astype(np.float32)
+        # level 0: global max [2,3,1]; level 1: 2x2 bins
+        l0 = x.max(axis=(2, 3)).reshape(2, -1)
+        cells = []
+        for i in range(2):
+            for j in range(2):
+                cells.append(x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].max(axis=(2, 3)))
+        l1 = np.stack(cells, axis=-1).reshape(2, -1)
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        self.outputs = {"Out": np.concatenate([l0, l1], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFcOp(OpTest):
+    def setUp(self):
+        self.op_type = "fc"
+        rng = np.random.RandomState(23)
+        x = rng.rand(4, 6).astype(np.float32)
+        w = rng.rand(6, 3).astype(np.float32)
+        b = rng.rand(3).astype(np.float32)
+        self.inputs = {"Input": x, "W": w, "Bias": b}
+        self.attrs = {"in_num_col_dims": 1, "activation_type": "relu"}
+        self.outputs = {"Out": np.maximum(x @ w + b, 0)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCtcAlign(OpTest):
+    def setUp(self):
+        self.op_type = "ctc_align"
+        x = np.asarray([[0, 1, 1, 0, 2, 2, 0, 3],
+                        [3, 3, 0, 0, 1, 0, 0, 0]], np.int32)
+        out = np.asarray([[1, 2, 3, 0, 0, 0, 0, 0],
+                          [3, 1, 0, 0, 0, 0, 0, 0]], np.int32)
+        self.inputs = {"Input": x}
+        self.attrs = {"blank": 0, "merge_repeated": True, "padding_value": 0}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTeacherStudentSigmoidLoss(OpTest):
+    def setUp(self):
+        self.op_type = "teacher_student_sigmoid_loss"
+        rng = np.random.RandomState(29)
+        x = rng.uniform(-3, 3, (8, 1)).astype(np.float32)
+        label = rng.uniform(0, 1, (8, 1)).astype(np.float32)
+        xv, lv = x.ravel(), label.ravel()
+        sp = np.logaddexp(0.0, xv)
+        loss = (sp) + (np.logaddexp(0.0, xv) - lv * xv)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": loss.reshape(-1, 1).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_hsigmoid_trains():
+    """hierarchical_sigmoid end-to-end: loss decreases on a toy problem."""
+    import paddle_tpu.fluid.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = layers.hsigmoid(input=x, label=y, num_classes=6)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = rng.randint(0, 6, (16, 1)).astype(np.int64)
+    losses = []
+    for _ in range(25):
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0], losses
+
+
+def test_nce_trains():
+    import paddle_tpu.fluid.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = layers.nce(input=x, label=y, num_total_classes=20,
+                          num_neg_samples=5)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = rng.randint(0, 20, (16, 1)).astype(np.int64)
+    losses = []
+    for _ in range(25):
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0], losses
+
+
+def test_random_crop_shape_and_content():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        out = fluid.default_main_program().current_block().create_var(
+            name="crop_out", dtype="float32", shape=[-1, 3, 5, 5])
+        fluid.default_main_program().current_block().append_op(
+            type="random_crop", inputs={"X": [x.name]},
+            outputs={"Out": [out.name]}, attrs={"shape": [5, 5]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    ov, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    ov = np.asarray(ov)
+    assert ov.shape == (2, 3, 5, 5)
+    # every crop row must appear somewhere in the source image
+    assert np.isin(np.round(ov, 5), np.round(xb, 5)).all()
+
+
+def test_tensor_array_to_tensor():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        arr = fluid.layers.array_write(x, i0)
+        fluid.layers.array_write(x * 2.0, i1, array=arr)
+        blk = main.current_block()
+        out = blk.create_var(name="ta_out", dtype="float32", shape=[-1, 4])
+        oidx = blk.create_var(name="ta_idx", dtype="int32", shape=[-1])
+        blk.append_op(
+            type="tensor_array_to_tensor",
+            inputs={"X": [arr.name]},
+            outputs={"Out": [out.name], "OutIndex": [oidx.name]},
+            attrs={"axis": 0, "use_stack": False},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    xb = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    ov, iv = exe.run(main, feed={"x": xb}, fetch_list=[out, oidx])
+    np.testing.assert_allclose(
+        np.asarray(ov), np.concatenate([xb, xb * 2.0], axis=0), rtol=1e-6
+    )
+    assert list(np.asarray(iv)) == [2, 2]
