@@ -28,6 +28,8 @@ from . import distributed_ops  # noqa: F401
 from . import manip_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import rnn_fused_ops  # noqa: F401
+from . import fused_ops  # noqa: F401
+from . import text_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import quant_ops  # noqa: F401

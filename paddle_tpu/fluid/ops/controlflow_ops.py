@@ -319,3 +319,19 @@ register_op("split_lod_tensor", lower=_split_lod_tensor_lower,
             grad="generic")
 register_op("merge_lod_tensor", lower=_merge_lod_tensor_lower,
             grad="generic")
+
+
+def _select_output_lower(ctx, op_):
+    """reference: controlflow/select_output_op.cc — route X to Out[Mask].
+    Static lowering writes every branch output: the selected one gets X,
+    the others zeros (downstream merge via select_input picks by the same
+    mask, so the zero branches are dead values)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    mask = ctx.in1(op_, "Mask").reshape(()).astype(jnp.int32)
+    for i, name in enumerate(op_.output("Out")):
+        ctx.set(name, jnp.where(mask == i, x, jnp.zeros_like(x)))
+
+
+register_op("select_output", lower=_select_output_lower)

@@ -415,9 +415,10 @@ def _nms(boxes, scores, nms_threshold, top_k, normalized=True, eta=1.0):
     return np.asarray(keep, np.int64)
 
 
-def _multiclass_nms_host(ctx, op_):
+def _multiclass_nms_core(ctx, op_, want_index):
     """reference: multiclass_nms_op.cc — per-class NMS + cross-class
-    keep_top_k; output [K, 6] (label, score, x0, y0, x1, y1)."""
+    keep_top_k; output [K, 6] (label, score, x0, y0, x1, y1). multiclass_nms2
+    additionally emits Index = flat row indices into BBoxes."""
     scores = _np_val(ctx, op_.input("Scores")[0])  # [N, C, M]
     bboxes = _np_val(ctx, op_.input("BBoxes")[0])  # [N, M, 4]
     score_threshold = float(op_.attr("score_threshold"))
@@ -431,10 +432,12 @@ def _multiclass_nms_host(ctx, op_):
         scores = scores[None]
     if bboxes.ndim == 2:
         bboxes = bboxes[None]
+    M = bboxes.shape[1]
     all_out = []
+    all_idx = []
     lens = []
     for n in range(scores.shape[0]):
-        dets = []
+        dets = []  # (row, global_index)
         for c in range(scores.shape[1]):
             if c == background:
                 continue
@@ -448,22 +451,32 @@ def _multiclass_nms_host(ctx, op_):
             )
             for k in keep:
                 i = sel[k]
-                dets.append(
-                    [float(c), float(s[i])] + [float(v) for v in bboxes[n][i]]
-                )
+                dets.append((
+                    [float(c), float(s[i])] + [float(v) for v in bboxes[n][i]],
+                    n * M + int(i),
+                ))
         if dets and keep_top_k > -1 and len(dets) > keep_top_k:
-            dets.sort(key=lambda d: -d[1])
+            dets.sort(key=lambda d: -d[0][1])
             dets = dets[:keep_top_k]
-        all_out.extend(dets)
+        all_out.extend(d[0] for d in dets)
+        all_idx.extend(d[1] for d in dets)
         lens.append(len(dets))
     if not all_out:
         out = np.full((1, 1), -1.0, np.float32)
+        idx = np.zeros((1, 1), np.int64)
         lens = [1]
     else:
         out = np.asarray(all_out, np.float32)
+        idx = np.asarray(all_idx, np.int64).reshape(-1, 1)
     name = op_.output("Out")[0]
     ctx.scope.set(name, out)
     ctx.scope.set(name + "@SEQ_LEN", np.asarray(lens, np.int32))
+    if want_index and op_.output("Index"):
+        ctx.scope.set(op_.output("Index")[0], idx)
+
+
+def _multiclass_nms_host(ctx, op_):
+    _multiclass_nms_core(ctx, op_, want_index=False)
 
 
 def _bipartite_match_host(ctx, op_):
@@ -599,3 +612,955 @@ register_op("multiclass_nms", lower=_multiclass_nms_host, host=True)
 register_op("bipartite_match", lower=_bipartite_match_host, host=True)
 register_op("mine_hard_examples", lower=_mine_hard_examples_host, host=True)
 register_op("generate_proposals", lower=_generate_proposals_host, host=True)
+
+
+# ===========================================================================
+# OPS_AUDIT.md closure: remaining detection corpus
+# ===========================================================================
+@op("box_decoder_and_assign", grad=None)
+def _box_decoder_and_assign(ctx, op_):
+    """reference: detection/box_decoder_and_assign_op.cc — decode per-class
+    box deltas against prior boxes, then pick each row's best-scoring
+    non-background class box."""
+    import jax.numpy as jnp
+
+    prior = ctx.in1(op_, "PriorBox")  # [R, 4]
+    pvar = ctx.in1(op_, "PriorBoxVar", optional=True)  # [4]
+    target = ctx.in1(op_, "TargetBox")  # [R, 4*C]
+    score = ctx.in1(op_, "BoxScore")  # [R, C]
+    clip = float(op_.attr("box_clip", 2.302585))
+    r = prior.shape[0]
+    c = score.shape[1]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    t = target.reshape(r, c, 4)
+    if pvar is not None:
+        v = pvar.reshape(1, 1, 4)
+        t = t * v
+    dx, dy, dw, dh = t[..., 0], t[..., 1], t[..., 2], t[..., 3]
+    dw = jnp.clip(dw, -clip, clip)
+    dh = jnp.clip(dh, -clip, clip)
+    cx = dx * pw[:, None] + px[:, None]
+    cy = dy * ph[:, None] + py[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1], axis=-1
+    )  # [R, C, 4]
+    ctx.out(op_, "DecodeBox", decoded.reshape(r, c * 4))
+    best = jnp.argmax(score[:, 1:], axis=1) + 1  # skip background class 0
+    assign = jnp.take_along_axis(decoded, best[:, None, None].repeat(4, 2), 1)
+    ctx.out(op_, "OutputAssignBox", assign[:, 0, :])
+
+
+@op("psroi_pool", grad="generic")
+def _psroi_pool(ctx, op_):
+    """reference: psroi_pool_op.cc — position-sensitive average pooling:
+    output channel c of bin (i,j) averages input channel c*ph*pw + i*pw + j
+    over that bin."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, OC*ph*pw, H, W]
+    rois = ctx.in1(op_, "ROIs")
+    lod = ctx.in1(op_, "RoisLod", optional=True)
+    oc = int(op_.attr("output_channels"))
+    ph = int(op_.attr("pooled_height"))
+    pw = int(op_.attr("pooled_width"))
+    scale = float(op_.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _rois_batch_index(lod, R, N)
+    x0 = jnp.round(rois[:, 0]) * scale
+    y0 = jnp.round(rois[:, 1]) * scale
+    x1 = (jnp.round(rois[:, 2]) + 1.0) * scale
+    y1 = (jnp.round(rois[:, 3]) + 1.0) * scale
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bw = rw / pw
+    bh = rh / ph
+    xs = x[bidx]  # [R, C, H, W]
+    ii = jnp.arange(H, dtype=jnp.float32)
+    jj = jnp.arange(W, dtype=jnp.float32)
+    outs = []
+    for i in range(ph):
+        for j in range(pw):
+            hs = jnp.floor(y0 + i * bh)
+            he = jnp.ceil(y0 + (i + 1) * bh)
+            ws = jnp.floor(x0 + j * bw)
+            we = jnp.ceil(x0 + (j + 1) * bw)
+            hm = (ii[None, :] >= hs[:, None]) & (ii[None, :] < he[:, None])
+            wm = (jj[None, :] >= ws[:, None]) & (jj[None, :] < we[:, None])
+            m = (hm[:, :, None] & wm[:, None, :]).astype(x.dtype)  # [R, H, W]
+            area = jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)
+            ch = jnp.arange(oc) * ph * pw + i * pw + j  # per-out-channel src
+            xsel = xs[:, ch]  # [R, OC, H, W]
+            outs.append(
+                jnp.sum(xsel * m[:, None], axis=(2, 3)) / area[:, None]
+            )
+    out = jnp.stack(outs, axis=-1).reshape(R, oc, ph, pw)
+    ctx.out(op_, "Out", out)
+
+
+@op("prroi_pool", grad="generic")
+def _prroi_pool(ctx, op_):
+    """reference: prroi_pool_op.cc — PRECISE RoI pooling: closed-form
+    integral of the bilinear interpolant over each bin. Separable weights:
+    wy[r,i,y] = ∫_bin_y max(0,1-|t-y|) dt, same for x; out = einsum."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C, H, W]
+    rois = ctx.in1(op_, "ROIs")
+    lod = ctx.in1(op_, "RoisLod", optional=True)
+    ph = int(op_.attr("pooled_height"))
+    pw = int(op_.attr("pooled_width"))
+    scale = float(op_.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _rois_batch_index(lod, R, N)
+
+    def hat_integral(a, b, p):
+        """∫_a^b max(0, 1-|t-p|) dt for scalars broadcast [R,bins,P]."""
+        lo = jnp.maximum(a, p - 1.0)
+        hi = jnp.minimum(b, p + 1.0)
+        # split at p: left ramp 1-(p-t), right ramp 1-(t-p)
+        l0 = jnp.clip(jnp.minimum(hi, p) - lo, 0.0, None)
+        lmid = (jnp.minimum(hi, p) + lo) / 2.0
+        left = l0 * (1.0 - (p - lmid))
+        r0 = jnp.clip(hi - jnp.maximum(lo, p), 0.0, None)
+        rmid = (hi + jnp.maximum(lo, p)) / 2.0
+        right = r0 * (1.0 - (rmid - p))
+        return jnp.where(hi > lo, left + right, 0.0)
+
+    x0 = rois[:, 0] * scale
+    y0 = rois[:, 1] * scale
+    x1 = rois[:, 2] * scale
+    y1 = rois[:, 3] * scale
+    bw = jnp.maximum((x1 - x0) / pw, 1e-6)
+    bh = jnp.maximum((y1 - y0) / ph, 1e-6)
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    ya = y0[:, None] + iy[None, :] * bh[:, None]  # [R, ph]
+    yb = ya + bh[:, None]
+    xa = x0[:, None] + ix[None, :] * bw[:, None]
+    xb = xa + bw[:, None]
+    py = jnp.arange(H, dtype=jnp.float32)
+    px = jnp.arange(W, dtype=jnp.float32)
+    wy = hat_integral(ya[:, :, None], yb[:, :, None], py[None, None, :])
+    wx = hat_integral(xa[:, :, None], xb[:, :, None], px[None, None, :])
+    xs = x[bidx]  # [R, C, H, W]
+    out = jnp.einsum("rchw,rih,rjw->rcij", xs, wy, wx)
+    out = out / (bh[:, None, None, None] * bw[:, None, None, None])
+    ctx.out(op_, "Out", out)
+
+
+def _bilinear_sample(img, yy, xx):
+    """img [C, H, W]; yy/xx [...]: bilinear sample with zero padding."""
+    import jax.numpy as jnp
+
+    C, H, W = img.shape
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    wy1 = yy - y0
+    wx1 = xx - x0
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yi = y0 + dy
+            xi = x0 + dx
+            wgt = (wy1 if dy else 1.0 - wy1) * (wx1 if dx else 1.0 - wx1)
+            ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            v = img[:, yc, xc]  # [C, ...]
+            out = out + jnp.where(ok[None], v * wgt[None], 0.0)
+    return out
+
+
+def _deformable_conv_impl(ctx, op_, modulated):
+    """reference: deformable_conv_op.cc (v2, modulated) /
+    deformable_conv_v1_op.cc — kernel sampling points shifted by learned
+    offsets, bilinear interpolation, optional per-point mask."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [B, Cin, H, W]
+    offset = ctx.in1(op_, "Offset")  # [B, dg*2*kh*kw, OH, OW]
+    mask = ctx.in1(op_, "Mask", optional=True) if modulated else None
+    w = ctx.in1(op_, "Filter")  # [Cout, Cin/g, kh, kw]
+    strides = [int(s) for s in op_.attr("strides", [1, 1])]
+    pads = [int(p) for p in op_.attr("paddings", [0, 0])]
+    dil = [int(d) for d in op_.attr("dilations", [1, 1])]
+    groups = int(op_.attr("groups", 1)) or 1
+    dg = int(op_.attr("deformable_groups", 1)) or 1
+    B, Cin, H, W = x.shape
+    Cout, _, kh, kw = w.shape
+    OH = (H + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    OW = (W + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+    base_y = jnp.arange(OH) * strides[0] - pads[0]
+    base_x = jnp.arange(OW) * strides[1] - pads[1]
+    off = offset.reshape(B, dg, kh * kw, 2, OH, OW)
+    cin_per_dg = Cin // dg
+
+    def one_image(xi, oi, mi):
+        cols = []
+        for k in range(kh * kw):
+            ki, kj = k // kw, k % kw
+            samples = []
+            for g in range(dg):
+                yy = (
+                    base_y[:, None]
+                    + ki * dil[0]
+                    + oi[g, k, 0]
+                )  # [OH, OW]
+                xx = base_x[None, :] + kj * dil[1] + oi[g, k, 1]
+                v = _bilinear_sample(
+                    xi[g * cin_per_dg:(g + 1) * cin_per_dg], yy, xx
+                )  # [cin/dg, OH, OW]
+                if mi is not None:
+                    v = v * mi[g, k][None]
+                samples.append(v)
+            cols.append(jnp.concatenate(samples, axis=0))  # [Cin, OH, OW]
+        return jnp.stack(cols, axis=1)  # [Cin, K, OH, OW]
+
+    if mask is not None:
+        mask_r = mask.reshape(B, dg, kh * kw, OH, OW)
+        col = jax.vmap(one_image)(x, off, mask_r)
+    else:
+        col = jax.vmap(lambda a, b: one_image(a, b, None))(x, off)
+    # grouped contraction: out[b, co, oh, ow]
+    cin_per_g = Cin // groups
+    cout_per_g = Cout // groups
+    outs = []
+    for g in range(groups):
+        cg = col[:, g * cin_per_g:(g + 1) * cin_per_g]  # [B, cin/g, K, OH, OW]
+        wg = w[g * cout_per_g:(g + 1) * cout_per_g].reshape(
+            cout_per_g, cin_per_g, kh * kw
+        )
+        outs.append(jnp.einsum("bikhw,oik->bohw", cg, wg))
+    ctx.out(op_, "Output", jnp.concatenate(outs, axis=1))
+
+
+@op("deformable_conv", grad="generic")
+def _deformable_conv(ctx, op_):
+    _deformable_conv_impl(ctx, op_, modulated=True)
+
+
+@op("deformable_conv_v1", grad="generic")
+def _deformable_conv_v1(ctx, op_):
+    _deformable_conv_impl(ctx, op_, modulated=False)
+
+
+@op("deformable_psroi_pooling", grad="generic")
+def _deformable_psroi_pooling(ctx, op_):
+    """reference: deformable_psroi_pooling_op.cc — psroi_pool whose bins are
+    shifted by learned offsets (trans input), sampled bilinearly."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "Input")  # [N, C, H, W]
+    rois = ctx.in1(op_, "ROIs")
+    trans = ctx.in1(op_, "Trans", optional=True)  # [R, 2, ph, pw]
+    lod = ctx.in1(op_, "RoisLod", optional=True)
+    no_trans = bool(op_.attr("no_trans", False))
+    scale = float(op_.attr("spatial_scale", 1.0))
+    oc = int(op_.attr("output_dim"))
+    gs = [int(g) for g in op_.attr("group_size", [1, 1])]
+    ph = int(op_.attr("pooled_height"))
+    pw = int(op_.attr("pooled_width"))
+    part = [int(p) for p in op_.attr("part_size", [ph, pw])]
+    sample_per_part = int(op_.attr("sample_per_part", 4))
+    trans_std = float(op_.attr("trans_std", 0.1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _rois_batch_index(lod, R, N)
+    x0 = rois[:, 0] * scale - 0.5
+    y0 = rois[:, 1] * scale - 0.5
+    x1 = (rois[:, 2] + 1.0) * scale - 0.5
+    y1 = (rois[:, 3] + 1.0) * scale - 0.5
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bw = rw / pw
+    bh = rh / ph
+    sub_y = (jnp.arange(sample_per_part) + 0.5) / sample_per_part
+    sub_x = (jnp.arange(sample_per_part) + 0.5) / sample_per_part
+
+    def one_roi(xi, b0, c0, w0, h0, tr):
+        # tr: [2, part_h, part_w] offsets
+        outs = jnp.zeros((oc, ph, pw), x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                pi = min(int(i * part[0] / ph), part[0] - 1)
+                pj = min(int(j * part[1] / pw), part[1] - 1)
+                if no_trans or tr is None:
+                    oy = 0.0
+                    ox = 0.0
+                else:
+                    oy = tr[0, pi, pj] * trans_std * h0
+                    ox = tr[1, pi, pj] * trans_std * w0
+                ys = c0 + i * (h0 / ph) + oy + sub_y * (h0 / ph)
+                xsm = b0 + j * (w0 / pw) + ox + sub_x * (w0 / pw)
+                yy, xx = jnp.meshgrid(ys, xsm, indexing="ij")
+                gi = min(int(i * gs[0] / ph), gs[0] - 1)
+                gj = min(int(j * gs[1] / pw), gs[1] - 1)
+                ch = jnp.arange(oc) * gs[0] * gs[1] + gi * gs[1] + gj
+                v = _bilinear_sample(xi[ch], yy, xx)  # [oc, s, s]
+                outs = outs.at[:, i, j].set(jnp.mean(v, axis=(1, 2)))
+        return outs
+
+    xs = x[bidx]
+    if trans is None or no_trans:
+        out = jax.vmap(lambda a, b, c, d, e: one_roi(a, b, c, d, e, None))(
+            xs, x0, y0, rw, rh
+        )
+    else:
+        out = jax.vmap(one_roi)(xs, x0, y0, rw, rh, trans)
+    ctx.out(op_, "Output", out)
+    if op_.output("TopCount"):
+        ctx.out(op_, "TopCount", jnp.ones((R, oc, ph, pw), x.dtype))
+
+
+@op("roi_perspective_transform", grad="generic")
+def _roi_perspective_transform(ctx, op_):
+    """reference: detection/roi_perspective_transform_op.cc — warp each quad
+    ROI (8 coords) to a rectangle via the quad->rect homography, bilinear
+    sampling. The 8x8 system per ROI is solved batched on device."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C, H, W]
+    rois = ctx.in1(op_, "ROIs")  # [R, 8] quad corners (x1 y1 ... x4 y4)
+    lod = ctx.in1(op_, "RoisLod", optional=True)
+    th = int(op_.attr("transformed_height"))
+    tw = int(op_.attr("transformed_width"))
+    scale = float(op_.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _rois_batch_index(lod, R, N)
+    quad = rois.reshape(R, 4, 2) * scale  # (x, y) x 4, clockwise from TL
+
+    # homography rect(tw x th) -> quad: solve A h = b with 8 unknowns
+    dst = jnp.asarray(
+        [[0.0, 0.0], [tw - 1.0, 0.0], [tw - 1.0, th - 1.0], [0.0, th - 1.0]]
+    )
+
+    def solve_h(q):
+        rows = []
+        rhs = []
+        for k in range(4):
+            X, Y = dst[k, 0], dst[k, 1]
+            u, v = q[k, 0], q[k, 1]
+            rows.append(jnp.stack([X, Y, 1.0, 0.0 * X, 0.0 * X, 0.0 * X, -u * X, -u * Y]))
+            rhs.append(u)
+            rows.append(jnp.stack([0.0 * X, 0.0 * X, 0.0 * X, X, Y, 1.0, -v * X, -v * Y]))
+            rhs.append(v)
+        A = jnp.stack(rows)
+        b = jnp.stack(rhs)
+        h = jnp.linalg.solve(A, b)
+        return jnp.concatenate([h, jnp.ones(1)])  # [9]
+
+    hs = jax.vmap(solve_h)(quad)  # [R, 9]
+    gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+
+    def warp(img, h):
+        Hm = h.reshape(3, 3)
+        den = Hm[2, 0] * gx + Hm[2, 1] * gy + Hm[2, 2]
+        sx = (Hm[0, 0] * gx + Hm[0, 1] * gy + Hm[0, 2]) / den
+        sy = (Hm[1, 0] * gx + Hm[1, 1] * gy + Hm[1, 2]) / den
+        return _bilinear_sample(img, sy, sx)  # [C, th, tw]
+
+    out = jax.vmap(warp)(x[bidx], hs)
+    ctx.out(op_, "Out", out)
+
+
+@op("yolov3_loss", grad="generic")
+def _yolov3_loss(ctx, op_):
+    """reference: detection/yolov3_loss_op.cc — per-cell YOLOv3 loss:
+    box (sx, sy sigmoid-bce; w, h L1), objectness bce (ignore if best IoU >
+    ignore_thresh), class bce; gt boxes matched to their best anchor."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, A*(5+nc), Gh, Gw]
+    gtbox = ctx.in1(op_, "GTBox")  # [B, M, 4] (cx, cy, w, h) normalized
+    gtlabel = ctx.in1(op_, "GTLabel").astype(jnp.int32)  # [B, M]
+    gtscore = ctx.in1(op_, "GTScore", optional=True)
+    anchors = [int(a) for a in op_.attr("anchors")]
+    amask = [int(a) for a in op_.attr("anchor_mask")]
+    nc = int(op_.attr("class_num"))
+    down = int(op_.attr("downsample_ratio", 32))
+    ignore = float(op_.attr("ignore_thresh", 0.7))
+    smooth = bool(op_.attr("use_label_smooth", True))
+    B, _, Gh, Gw = x.shape
+    A = len(amask)
+    M = gtbox.shape[1]
+    inp_h, inp_w = Gh * down, Gw * down
+    xr = x.reshape(B, A, 5 + nc, Gh, Gw)
+    px = jax.nn.sigmoid(xr[:, :, 0])
+    py = jax.nn.sigmoid(xr[:, :, 1])
+    pw_ = xr[:, :, 2]
+    ph_ = xr[:, :, 3]
+    pobj = xr[:, :, 4]
+    pcls = xr[:, :, 5:]  # [B, A, nc, Gh, Gw]
+    all_anch = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_anch = all_anch[amask]  # [A, 2]
+
+    # --- match each gt to its best anchor (by IoU of w/h at origin)
+    gw = gtbox[..., 2] * inp_w  # [B, M]
+    gh = gtbox[..., 3] * inp_h
+    aw = jnp.asarray(all_anch[:, 0])
+    ah = jnp.asarray(all_anch[:, 1])
+    inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah)
+    union = gw[..., None] * gh[..., None] + aw * ah - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [B, M]
+    amask_arr = jnp.asarray(amask)
+    # position of best anchor inside this level's mask, -1 if absent
+    match = (best_anchor[..., None] == amask_arr).astype(jnp.int32)  # [B,M,A]
+    has_match = jnp.sum(match, axis=-1) > 0
+    level_anchor = jnp.argmax(match, axis=-1)  # [B, M]
+    valid = (gw > 0) & has_match  # padded gt rows have w == 0
+
+    gi = jnp.clip((gtbox[..., 0] * Gw).astype(jnp.int32), 0, Gw - 1)  # [B, M]
+    gj = jnp.clip((gtbox[..., 1] * Gh).astype(jnp.int32), 0, Gh - 1)
+    tx = gtbox[..., 0] * Gw - gi
+    ty = gtbox[..., 1] * Gh - gj
+    aw_m = jnp.asarray(mask_anch[:, 0])[level_anchor]
+    ah_m = jnp.asarray(mask_anch[:, 1])[level_anchor]
+    tw_ = jnp.log(jnp.maximum(gw / jnp.maximum(aw_m, 1e-9), 1e-9))
+    th_ = jnp.log(jnp.maximum(gh / jnp.maximum(ah_m, 1e-9), 1e-9))
+    box_scale = 2.0 - gtbox[..., 2] * gtbox[..., 3]
+    score_w = (
+        gtscore if gtscore is not None else jnp.ones_like(gw)
+    )
+
+    def bce(p, t):
+        return jnp.maximum(p, 0) - p * t + jnp.log1p(jnp.exp(-jnp.abs(p)))
+
+    bi = jnp.arange(B)[:, None].repeat(M, 1)
+    sel = (bi, level_anchor, gj, gi)
+    vw = (valid.astype(x.dtype) * score_w * box_scale)
+    loss_xy = jnp.sum(
+        (bce(xr[:, :, 0][sel], tx) + bce(xr[:, :, 1][sel], ty)) * vw, axis=1
+    )
+    loss_wh = jnp.sum(
+        (jnp.abs(pw_[sel] - tw_) + jnp.abs(ph_[sel] - th_)) * vw, axis=1
+    )
+
+    # objectness target map
+    obj_t = jnp.zeros((B, A, Gh, Gw), x.dtype)
+    obj_t = obj_t.at[sel].add(
+        jnp.where(valid, score_w, 0.0), mode="drop"
+    )
+    obj_t = jnp.clip(obj_t, 0.0, 1.0)
+
+    # ignore mask: predicted boxes with IoU > thresh vs any gt
+    cell_x = (jnp.arange(Gw)[None, None, None, :] + px) / Gw
+    cell_y = (jnp.arange(Gh)[None, None, :, None] + py) / Gh
+    bw_p = jnp.exp(pw_) * jnp.asarray(mask_anch[:, 0])[None, :, None, None] / inp_w
+    bh_p = jnp.exp(ph_) * jnp.asarray(mask_anch[:, 1])[None, :, None, None] / inp_h
+    px0 = cell_x - bw_p / 2
+    px1 = cell_x + bw_p / 2
+    py0 = cell_y - bh_p / 2
+    py1 = cell_y + bh_p / 2
+    gx0 = (gtbox[..., 0] - gtbox[..., 2] / 2)[:, None, None, None, :]
+    gx1 = (gtbox[..., 0] + gtbox[..., 2] / 2)[:, None, None, None, :]
+    gy0 = (gtbox[..., 1] - gtbox[..., 3] / 2)[:, None, None, None, :]
+    gy1 = (gtbox[..., 1] + gtbox[..., 3] / 2)[:, None, None, None, :]
+    iw = jnp.clip(
+        jnp.minimum(px1[..., None], gx1) - jnp.maximum(px0[..., None], gx0),
+        0.0, None,
+    )
+    ih = jnp.clip(
+        jnp.minimum(py1[..., None], gy1) - jnp.maximum(py0[..., None], gy0),
+        0.0, None,
+    )
+    inter_p = iw * ih
+    area_p = (px1 - px0)[..., None] * (py1 - py0)[..., None]
+    area_g = ((gx1 - gx0) * (gy1 - gy0))
+    gvalid = (gw > 0)[:, None, None, None, :]
+    iou_p = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-9)
+    iou_p = jnp.where(gvalid, iou_p, 0.0)
+    best_iou = jnp.max(iou_p, axis=-1)  # [B, A, Gh, Gw]
+    noobj_mask = (best_iou < ignore).astype(x.dtype)
+    obj_mask = (obj_t > 0).astype(x.dtype)
+    loss_obj = jnp.sum(
+        bce(pobj, obj_t) * (obj_mask + (1 - obj_mask) * noobj_mask),
+        axis=(1, 2, 3),
+    )
+
+    # class loss at responsible cells
+    delta = 1.0 / nc if smooth and nc > 1 else 0.0
+    tcls_on = 1.0 - delta if smooth else 1.0
+    cls_sel = pcls[bi, level_anchor, :, gj, gi]  # [B, M, nc]
+    onehot = jax.nn.one_hot(gtlabel, nc, dtype=x.dtype)
+    tcl = onehot * tcls_on + (1 - onehot) * delta
+    loss_cls = jnp.sum(
+        jnp.sum(bce(cls_sel, tcl), axis=-1) * valid.astype(x.dtype) * score_w,
+        axis=1,
+    )
+    ctx.out(op_, "Loss", loss_xy + loss_wh + loss_obj + loss_cls)
+    ctx.out(op_, "ObjectnessMask", noobj_mask)
+    ctx.out(op_, "GTMatchMask", valid.astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# host-side detection ops (CPU kernels in the reference too): NMS variants,
+# FPN routing, training-time target sampling, mAP metric
+# ---------------------------------------------------------------------------
+def _multiclass_nms2_host(ctx, op_):
+    """reference: multiclass_nms_op.cc multiclass_nms2 registration — same
+    as multiclass_nms plus the Index output."""
+    _multiclass_nms_core(ctx, op_, want_index=True)
+
+
+register_op("multiclass_nms2", lower=_multiclass_nms2_host, host=True)
+
+
+def _distribute_fpn_proposals_host(ctx, op_):
+    """reference: detection/distribute_fpn_proposals_op.cc — route each roi
+    to level floor(refer_level + log2(sqrt(area)/refer_scale))."""
+    rois = _np_val(ctx, op_.input("FpnRois")[0])
+    min_l = int(op_.attr("min_level"))
+    max_l = int(op_.attr("max_level"))
+    refer_l = int(op_.attr("refer_level"))
+    refer_s = int(op_.attr("refer_scale"))
+    w = np.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = np.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_s + 1e-6)) + refer_l
+    lvl = np.clip(lvl, min_l, max_l).astype(np.int64)
+    outs = op_.output("MultiFpnRois")
+    order = []
+    for i, name in enumerate(outs):
+        sel = np.where(lvl == min_l + i)[0]
+        ctx.scope.set(
+            name,
+            rois[sel] if sel.size else np.zeros((0, 4), rois.dtype),
+        )
+        order.extend(sel.tolist())
+    restore = np.zeros((len(order), 1), np.int32)
+    for new_pos, old in enumerate(order):
+        restore[old] = new_pos
+    ctx.scope.set(op_.output("RestoreIndex")[0], restore)
+
+
+register_op(
+    "distribute_fpn_proposals", lower=_distribute_fpn_proposals_host, host=True
+)
+
+
+def _collect_fpn_proposals_host(ctx, op_):
+    """reference: detection/collect_fpn_proposals_op.cc — concat rois from
+    all levels, keep post_nms_topN by score."""
+    rois = [_np_val(ctx, n) for n in op_.input("MultiLevelRois")]
+    scores = [_np_val(ctx, n).reshape(-1) for n in op_.input("MultiLevelScores")]
+    topn = int(op_.attr("post_nms_topN"))
+    allr = np.concatenate([r.reshape(-1, 4) for r in rois], axis=0)
+    alls = np.concatenate(scores, axis=0)
+    order = np.argsort(-alls)[:topn]
+    order = np.sort(order)  # keep original relative order like the reference
+    ctx.scope.set(op_.output("FpnRois")[0], allr[order])
+
+
+register_op("collect_fpn_proposals", lower=_collect_fpn_proposals_host, host=True)
+
+
+# shared sampling engine: seeded once per process (the reference seeds from
+# std::random_device per run, rpn_target_assign_op.cc:384); successive calls
+# must draw DIFFERENT subsamples
+_DETECTION_RNG = np.random.RandomState(20190101)
+
+
+def _sample_idx(rng, pool, num, use_random):
+    if len(pool) <= num:
+        return pool
+    if use_random:
+        return rng.choice(pool, num, replace=False)
+    return pool[:num]
+
+
+def _rpn_target_assign_core(ctx, op_, retinanet):
+    """reference: detection/rpn_target_assign_op.cc (+ retinanet variant at
+    :875) — label anchors fg/bg by IoU vs gt, subsample (RPN only; retinanet
+    keeps all fg), emit sampled indices + box-regression targets. The
+    retinanet variant reads positive_overlap/negative_overlap attrs and
+    emits matched GT CLASS labels (for focal loss) + ForegroundNumber."""
+    anchors = _np_val(ctx, op_.input("Anchor")[0]).reshape(-1, 4)
+    gt = _np_val(ctx, op_.input("GtBoxes")[0]).reshape(-1, 4)
+    if retinanet:
+        pos_thresh = float(op_.attr("positive_overlap", 0.5))
+        neg_thresh = float(op_.attr("negative_overlap", 0.4))
+        gt_labels = (
+            _np_val(ctx, op_.input("GtLabels")[0]).reshape(-1)
+            if op_.input("GtLabels")
+            else np.ones(len(gt), np.int64)
+        )
+    else:
+        pos_thresh = float(op_.attr("rpn_positive_overlap", 0.7))
+        neg_thresh = float(op_.attr("rpn_negative_overlap", 0.3))
+        gt_labels = np.ones(len(gt), np.int64)
+    batch_per_im = int(op_.attr("rpn_batch_size_per_im", 256))
+    fg_frac = float(op_.attr("rpn_fg_fraction", 0.5))
+    use_random = bool(op_.attr("use_random", True))
+    rng = _DETECTION_RNG
+    iou = _iou_matrix(anchors, gt, normalized=False)  # [A, G]
+    amax = iou.max(axis=1) if gt.size else np.zeros(len(anchors))
+    aarg = iou.argmax(axis=1) if gt.size else np.zeros(len(anchors), np.int64)
+    labels = np.full(len(anchors), -1, np.int64)
+    labels[amax >= pos_thresh] = 1
+    if gt.size:
+        labels[iou.argmax(axis=0)] = 1  # best anchor per gt is fg
+    labels[(amax < neg_thresh) & (labels != 1)] = 0
+    fg = np.where(labels == 1)[0]
+    bg = np.where(labels == 0)[0]
+    if retinanet:
+        # retinanet keeps every fg/bg anchor (focal loss handles imbalance)
+        pass
+    else:
+        num_fg = int(batch_per_im * fg_frac)
+        fg = _sample_idx(rng, fg, num_fg, use_random)
+        num_bg = batch_per_im - len(fg)
+        bg = _sample_idx(rng, bg, num_bg, use_random)
+    loc_idx = fg
+    score_idx = np.concatenate([fg, bg]).astype(np.int64)
+    if retinanet:
+        fg_cls = gt_labels[aarg[fg]] if gt.size and len(fg) else np.zeros(0)
+        tgt_label = np.concatenate(
+            [np.asarray(fg_cls, np.int32), np.zeros(len(bg), np.int32)]
+        ).reshape(-1, 1)
+    else:
+        tgt_label = np.concatenate(
+            [np.ones(len(fg), np.int32), np.zeros(len(bg), np.int32)]
+        ).reshape(-1, 1)
+    # box targets for fg anchors: standard (dx, dy, dw, dh) encoding
+    if gt.size and len(fg):
+        a = anchors[fg]
+        g = gt[aarg[fg]]
+        aw = a[:, 2] - a[:, 0] + 1
+        ah = a[:, 3] - a[:, 1] + 1
+        ax = a[:, 0] + aw / 2
+        ay = a[:, 1] + ah / 2
+        gw = g[:, 2] - g[:, 0] + 1
+        gh = g[:, 3] - g[:, 1] + 1
+        gx = g[:, 0] + gw / 2
+        gy = g[:, 1] + gh / 2
+        tgt = np.stack(
+            [(gx - ax) / aw, (gy - ay) / ah, np.log(gw / aw), np.log(gh / ah)],
+            axis=1,
+        ).astype(np.float32)
+    else:
+        tgt = np.zeros((len(fg), 4), np.float32)
+    ctx.scope.set(op_.output("LocationIndex")[0], np.asarray(loc_idx, np.int32))
+    ctx.scope.set(op_.output("ScoreIndex")[0], np.asarray(score_idx, np.int32))
+    ctx.scope.set(op_.output("TargetBBox")[0], tgt)
+    ctx.scope.set(op_.output("TargetLabel")[0], tgt_label)
+    if op_.output("BBoxInsideWeight"):
+        ctx.scope.set(
+            op_.output("BBoxInsideWeight")[0], np.ones_like(tgt, np.float32)
+        )
+    if retinanet and op_.output("ForegroundNumber"):
+        ctx.scope.set(
+            op_.output("ForegroundNumber")[0],
+            np.asarray([[max(len(fg), 1)]], np.int32),
+        )
+
+
+def _rpn_target_assign_host(ctx, op_):
+    _rpn_target_assign_core(ctx, op_, retinanet=False)
+
+
+def _retinanet_target_assign_host(ctx, op_):
+    _rpn_target_assign_core(ctx, op_, retinanet=True)
+
+
+register_op("rpn_target_assign", lower=_rpn_target_assign_host, host=True)
+register_op(
+    "retinanet_target_assign", lower=_retinanet_target_assign_host, host=True
+)
+
+
+def _retinanet_detection_output_host(ctx, op_):
+    """reference: detection/retinanet_detection_output_op.cc — decode
+    per-level box deltas against anchors, threshold + top-k per level,
+    cross-level NMS per class."""
+    bboxes = [_np_val(ctx, n) for n in op_.input("BBoxes")]
+    scores = [_np_val(ctx, n) for n in op_.input("Scores")]
+    anchors = [_np_val(ctx, n).reshape(-1, 4) for n in op_.input("Anchors")]
+    iminfo = _np_val(ctx, op_.input("ImInfo")[0]).reshape(-1, 3)
+    score_thresh = float(op_.attr("score_threshold", 0.05))
+    nms_top_k = int(op_.attr("nms_top_k", 1000))
+    keep_top_k = int(op_.attr("keep_top_k", 100))
+    nms_threshold = float(op_.attr("nms_threshold", 0.3))
+    dets_all = []
+    lens = []
+    B = bboxes[0].shape[0] if bboxes[0].ndim == 3 else 1
+    for b in range(B):
+        cand_boxes, cand_scores, cand_cls = [], [], []
+        for lv in range(len(bboxes)):
+            delta = bboxes[lv][b].reshape(-1, 4)
+            sc = scores[lv][b]  # [A, C]
+            anc = anchors[lv]
+            aw = anc[:, 2] - anc[:, 0] + 1
+            ah = anc[:, 3] - anc[:, 1] + 1
+            ax = anc[:, 0] + aw / 2
+            ay = anc[:, 1] + ah / 2
+            cx = delta[:, 0] * aw + ax
+            cy = delta[:, 1] * ah + ay
+            w = np.exp(np.clip(delta[:, 2], -10, 10)) * aw
+            h = np.exp(np.clip(delta[:, 3], -10, 10)) * ah
+            boxes = np.stack(
+                [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1], 1
+            )
+            im_h, im_w = iminfo[min(b, len(iminfo) - 1), :2]
+            boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, im_w - 1)
+            boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, im_h - 1)
+            flat = sc.reshape(-1)
+            top = np.argsort(-flat)[:nms_top_k]
+            top = top[flat[top] > score_thresh]
+            cand_boxes.append(boxes[top // sc.shape[1]])
+            cand_scores.append(flat[top])
+            cand_cls.append(top % sc.shape[1])
+        cb = np.concatenate(cand_boxes)
+        cs = np.concatenate(cand_scores)
+        cc = np.concatenate(cand_cls)
+        dets = []
+        for c in np.unique(cc):
+            sel = np.where(cc == c)[0]
+            keep = _nms(cb[sel], cs[sel], nms_threshold, -1, False)
+            for k in keep:
+                i = sel[k]
+                dets.append([float(c), float(cs[i])] + cb[i].tolist())
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        dets_all.extend(dets)
+        lens.append(len(dets))
+    if not dets_all:
+        out = np.full((1, 1), -1.0, np.float32)
+        lens = [1]
+    else:
+        out = np.asarray(dets_all, np.float32)
+    ctx.scope.set(op_.output("Out")[0], out)
+    ctx.scope.set(op_.output("Out")[0] + "@SEQ_LEN", np.asarray(lens, np.int32))
+
+
+register_op(
+    "retinanet_detection_output",
+    lower=_retinanet_detection_output_host,
+    host=True,
+)
+
+
+def _generate_proposal_labels_host(ctx, op_):
+    """reference: detection/generate_proposal_labels_op.cc — sample rois
+    into fg/bg with labels + box targets for the second stage."""
+    rois = _np_val(ctx, op_.input("RpnRois")[0]).reshape(-1, 4)
+    gt_classes = _np_val(ctx, op_.input("GtClasses")[0]).reshape(-1)
+    gt_boxes = _np_val(ctx, op_.input("GtBoxes")[0]).reshape(-1, 4)
+    batch_size = int(op_.attr("batch_size_per_im", 256))
+    fg_frac = float(op_.attr("fg_fraction", 0.25))
+    fg_thresh = float(op_.attr("fg_thresh", 0.5))
+    bg_hi = float(op_.attr("bg_thresh_hi", 0.5))
+    bg_lo = float(op_.attr("bg_thresh_lo", 0.0))
+    class_nums = int(op_.attr("class_nums", 81))
+    use_random = bool(op_.attr("use_random", True))
+    rng = _DETECTION_RNG
+    allr = np.concatenate([rois, gt_boxes], axis=0)
+    iou = _iou_matrix(allr, gt_boxes, normalized=False)
+    amax = iou.max(axis=1) if gt_boxes.size else np.zeros(len(allr))
+    aarg = iou.argmax(axis=1) if gt_boxes.size else np.zeros(len(allr), np.int64)
+    fg_pool = np.where(amax >= fg_thresh)[0]
+    bg_pool = np.where((amax < bg_hi) & (amax >= bg_lo))[0]
+    n_fg = min(int(batch_size * fg_frac), len(fg_pool))
+    fg = _sample_idx(rng, fg_pool, n_fg, use_random)
+    n_bg = min(batch_size - n_fg, len(bg_pool))
+    bg = _sample_idx(rng, bg_pool, n_bg, use_random)
+    keep = np.concatenate([fg, bg]).astype(np.int64)
+    out_rois = allr[keep]
+    labels = np.zeros(len(keep), np.int64)
+    if gt_boxes.size:
+        labels[: len(fg)] = gt_classes[aarg[fg]]
+    tgt = np.zeros((len(keep), 4 * class_nums), np.float32)
+    inw = np.zeros_like(tgt)
+    outw = np.zeros_like(tgt)
+    for i in range(len(fg)):
+        g = gt_boxes[aarg[fg[i]]]
+        a = allr[fg[i]]
+        aw = max(a[2] - a[0] + 1, 1.0)
+        ah = max(a[3] - a[1] + 1, 1.0)
+        gw = max(g[2] - g[0] + 1, 1.0)
+        gh = max(g[3] - g[1] + 1, 1.0)
+        d = [
+            ((g[0] + gw / 2) - (a[0] + aw / 2)) / aw,
+            ((g[1] + gh / 2) - (a[1] + ah / 2)) / ah,
+            np.log(gw / aw),
+            np.log(gh / ah),
+        ]
+        c = int(labels[i])
+        tgt[i, 4 * c:4 * c + 4] = d
+        inw[i, 4 * c:4 * c + 4] = 1.0
+        outw[i, 4 * c:4 * c + 4] = 1.0
+    ctx.scope.set(op_.output("Rois")[0], out_rois.astype(np.float32))
+    ctx.scope.set(op_.output("LabelsInt32")[0], labels.reshape(-1, 1).astype(np.int32))
+    ctx.scope.set(op_.output("BboxTargets")[0], tgt)
+    ctx.scope.set(op_.output("BboxInsideWeights")[0], inw)
+    ctx.scope.set(op_.output("BboxOutsideWeights")[0], outw)
+
+
+register_op(
+    "generate_proposal_labels", lower=_generate_proposal_labels_host, host=True
+)
+
+
+def _point_in_poly_grid(poly, hh, ww):
+    """Vectorized even-odd rasterization of one polygon [[x, y], ...]."""
+    xs = np.arange(ww) + 0.5
+    ys = np.arange(hh) + 0.5
+    gx, gy = np.meshgrid(xs, ys)
+    inside = np.zeros((hh, ww), bool)
+    n = len(poly)
+    j = n - 1
+    for i in range(n):
+        xi, yi = poly[i]
+        xj, yj = poly[j]
+        cross = (yi > gy) != (yj > gy)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xint = (xj - xi) * (gy - yi) / (yj - yi + 1e-12) + xi
+        inside ^= cross & (gx < xint)
+        j = i
+    return inside
+
+
+def _generate_mask_labels_host(ctx, op_):
+    """reference: detection/generate_mask_label_op.cc — rasterize the gt
+    polygons of each fg roi into a resolution^2 binary mask target."""
+    im_info = _np_val(ctx, op_.input("ImInfo")[0]).reshape(-1, 3)
+    gt_classes = _np_val(ctx, op_.input("GtClasses")[0]).reshape(-1)
+    gt_segms = _np_val(ctx, op_.input("GtSegms")[0])
+    rois = _np_val(ctx, op_.input("Rois")[0]).reshape(-1, 4)
+    label_int32 = _np_val(ctx, op_.input("LabelsInt32")[0]).reshape(-1)
+    num_classes = int(op_.attr("num_classes", 81))
+    resolution = int(op_.attr("resolution", 14))
+    fg = np.where(label_int32 > 0)[0]
+    if fg.size == 0:
+        fg = np.asarray([0])
+    mask_rois = rois[fg]
+    masks = np.zeros((len(fg), num_classes * resolution * resolution), np.float32)
+    # split GtSegms into per-instance polygons: vertices-per-gt companion,
+    # else distribute vertices evenly across the gt instances
+    flat = gt_segms.reshape(-1, 2) if gt_segms.size else np.zeros((0, 2))
+    seg_lens = ctx.scope.get(op_.input("GtSegms")[0] + "@SEQ_LEN")
+    n_gt = max(len(gt_classes), 1)
+    if seg_lens is not None:
+        seg_lens = np.asarray(seg_lens).reshape(-1).astype(np.int64)
+        seg_starts = np.concatenate([[0], np.cumsum(seg_lens)])
+    else:
+        per = len(flat) // n_gt if len(flat) else 0
+        seg_starts = np.arange(n_gt + 1) * per
+    polys = [
+        flat[seg_starts[g]:seg_starts[g + 1]]
+        for g in range(min(n_gt, len(seg_starts) - 1))
+    ]
+    poly_boxes = np.asarray(
+        [
+            [p[:, 0].min(), p[:, 1].min(), p[:, 0].max(), p[:, 1].max()]
+            if len(p) >= 3
+            else [0, 0, 0, 0]
+            for p in polys
+        ],
+        np.float32,
+    ) if polys else np.zeros((0, 4), np.float32)
+    for i, ri in enumerate(fg):
+        x0, y0, x1, y1 = rois[ri]
+        w = max(x1 - x0, 1.0)
+        h = max(y1 - y0, 1.0)
+        # match this roi to its gt instance by IoU against the polygon bbox
+        seg = None
+        if len(poly_boxes):
+            ious = _iou_matrix(rois[ri][None], poly_boxes, normalized=False)[0]
+            g = int(np.argmax(ious))
+            if ious[g] > 0 and len(polys[g]) >= 3:
+                seg = polys[g]
+        if seg is not None:
+            poly = (seg - [x0, y0]) / [w / resolution, h / resolution]
+            m = _point_in_poly_grid(poly, resolution, resolution)
+        else:
+            m = np.ones((resolution, resolution), bool)
+        c = int(label_int32[ri]) % num_classes
+        masks[
+            i, c * resolution * resolution:(c + 1) * resolution * resolution
+        ] = m.astype(np.float32).reshape(-1)
+    ctx.scope.set(op_.output("MaskRois")[0], mask_rois.astype(np.float32))
+    ctx.scope.set(
+        op_.output("RoiHasMaskInt32")[0],
+        np.arange(len(fg), dtype=np.int32).reshape(-1, 1),
+    )
+    ctx.scope.set(op_.output("MaskInt32")[0], masks.astype(np.int32))
+    _ = im_info
+
+
+register_op("generate_mask_labels", lower=_generate_mask_labels_host, host=True)
+
+
+def _detection_map_host(ctx, op_):
+    """reference: metrics/detection_map_op.cc — mAP over detections
+    [label, score, box] vs gt [label, box]; integral or 11point."""
+    dets = _np_val(ctx, op_.input("DetectRes")[0])
+    gts = _np_val(ctx, op_.input("Label")[0])
+    overlap = float(op_.attr("overlap_threshold", 0.5))
+    ap_type = op_.attr("ap_type", "integral")
+    # single-image evaluation (LoD batches concatenate)
+    classes = np.unique(gts[:, 0]).astype(int) if gts.size else []
+    aps = []
+    for c in classes:
+        gt_c = gts[gts[:, 0] == c][:, 1:5]
+        det_c = dets[dets[:, 0] == c]
+        if not len(gt_c):
+            continue
+        det_c = det_c[np.argsort(-det_c[:, 1])]
+        matched = np.zeros(len(gt_c), bool)
+        tp = np.zeros(len(det_c))
+        fp = np.zeros(len(det_c))
+        for i, d in enumerate(det_c):
+            if not len(gt_c):
+                fp[i] = 1
+                continue
+            ious = _iou_matrix(d[None, 2:6], gt_c, normalized=False)[0]
+            j = int(np.argmax(ious))
+            if ious[j] >= overlap and not matched[j]:
+                tp[i] = 1
+                matched[j] = True
+            else:
+                fp[i] = 1
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        rec = ctp / len(gt_c)
+        prec = ctp / np.maximum(ctp + cfp, 1e-9)
+        if ap_type == "11point":
+            ap = 0.0
+            for t in np.arange(0.0, 1.1, 0.1):
+                p = prec[rec >= t].max() if np.any(rec >= t) else 0.0
+                ap += p / 11.0
+        else:
+            ap = 0.0
+            mrec = np.concatenate([[0.0], rec, [1.0]])
+            mpre = np.concatenate([[0.0], prec, [0.0]])
+            for i in range(len(mpre) - 2, -1, -1):
+                mpre[i] = max(mpre[i], mpre[i + 1])
+            idx = np.where(mrec[1:] != mrec[:-1])[0]
+            ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+        aps.append(ap)
+    mval = float(np.mean(aps)) if aps else 0.0
+    ctx.scope.set(op_.output("MAP")[0], np.asarray([mval], np.float32))
+    if op_.output("AccumPosCount"):
+        ctx.scope.set(
+            op_.output("AccumPosCount")[0], np.zeros((1, 1), np.int32)
+        )
+    if op_.output("AccumTruePos"):
+        ctx.scope.set(op_.output("AccumTruePos")[0], np.zeros((1, 2), np.float32))
+    if op_.output("AccumFalsePos"):
+        ctx.scope.set(op_.output("AccumFalsePos")[0], np.zeros((1, 2), np.float32))
+
+
+register_op("detection_map", lower=_detection_map_host, host=True)

@@ -1,0 +1,534 @@
+"""Fused-op corpus (reference: paddle/fluid/operators/fused/ — 17 hand-fused
+x86/CUDA kernels). On TPU these are COMPOSITE lowerings: each emits the
+constituent jnp/lax graph inside one XLA segment and XLA performs the fusion
+the reference hand-wrote (SURVEY §2 #29). They exist for op-level program
+parity — models saved with fused ops load and run.
+
+Padded-representation note: LoD inputs here are [B, T, ...] with a
+``@SEQ_LEN`` companion (see ops/sequence_ops.py), not the reference's
+packed [T_total, ...] rows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from .registry import SkipInferShape, in_var, op, register_op, set_out
+from .sequence_ops import _lengths_or_full, _mask, lengths_for
+
+
+def _act(name):
+    import jax
+    import jax.numpy as jnp
+
+    return {
+        "": lambda x: x,
+        "identity": lambda x: x,
+        "relu": lambda x: jnp.maximum(x, 0),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "gelu": jax.nn.gelu,
+    }[name]
+
+
+# ---------------------------------------------------------------------------
+# fused_elemwise_activation (fused_elemwise_activation_op.cc): functor_list
+# = [f_outer, f_inner]; out = f_outer(x, f_inner(y)) when f_inner is unary
+# ("binary(x, unary(y))") or f_outer(f_inner(x, y)) when f_outer is unary.
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "elementwise_add": lambda a, b: a + b,
+    "elementwise_sub": lambda a, b: a - b,
+    "elementwise_mul": lambda a, b: a * b,
+}
+
+
+def _unary_fn(name, scale):
+    import jax
+    import jax.numpy as jnp
+
+    if name == "scale":
+        return lambda v: v * scale
+    return {
+        "relu": lambda v: jnp.maximum(v, 0),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "gelu": jax.nn.gelu,
+    }[name]
+
+
+@op("fused_elemwise_activation", grad="generic")
+def _fused_elemwise_activation(ctx, op_):
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    f1, f2 = [s for s in op_.attr("functor_list")]
+    scale = float(op_.attr("scale", 0.0))
+    if f1 in _BINARY:  # binary(x, unary(y))
+        inter = _unary_fn(f2, scale)(y)
+        out = _BINARY[f1](x, inter)
+    else:  # unary(binary(x, y))
+        inter = _BINARY[f2](x, y)
+        out = _unary_fn(f1, scale)(inter)
+    ctx.out(op_, "Out", out)
+    if op_.output("IntermediateOut"):
+        ctx.out(op_, "IntermediateOut", inter)
+
+
+# ---------------------------------------------------------------------------
+@op("fused_fc_elementwise_layernorm", grad="generic")
+def _fused_fc_elementwise_layernorm(ctx, op_):
+    """fc(X,W,Bias0) + Y, then layer_norm with Scale/Bias1
+    (fused_fc_elementwise_layernorm_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    w = ctx.in1(op_, "W")
+    y = ctx.in1(op_, "Y")
+    ncd = int(op_.attr("x_num_col_dims", 1))
+    lead = x.shape[:ncd]
+    x2 = x.reshape((int(np.prod(lead)), -1))
+    z = x2 @ w
+    b0 = ctx.in1(op_, "Bias0", optional=True)
+    if b0 is not None:
+        z = z + b0.reshape(1, -1)
+    if op_.attr("activation_type", "") == "relu":
+        z = jnp.maximum(z, 0)
+    z = z.reshape(y.shape) + y
+    eps = float(op_.attr("epsilon", 1e-5))
+    mean = jnp.mean(z, axis=-1, keepdims=True)
+    var = jnp.var(z, axis=-1, keepdims=True)
+    norm = (z - mean) / jnp.sqrt(var + eps)
+    scale = ctx.in1(op_, "Scale", optional=True)
+    b1 = ctx.in1(op_, "Bias1", optional=True)
+    if scale is not None:
+        norm = norm * scale.reshape(1, -1)
+    if b1 is not None:
+        norm = norm + b1.reshape(1, -1)
+    ctx.out(op_, "Out", norm)
+    if op_.output("Mean"):
+        ctx.out(op_, "Mean", mean.reshape(-1))
+    if op_.output("Variance"):
+        ctx.out(op_, "Variance", var.reshape(-1))
+
+
+# ---------------------------------------------------------------------------
+@op("fusion_repeated_fc_relu", grad="generic")
+def _fusion_repeated_fc_relu(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    ws = [ctx.get(n) for n in op_.input("W")]
+    bs = [ctx.get(n) for n in op_.input("Bias")]
+    relu_outs = []
+    h = x.reshape(x.shape[0], -1)
+    for w, b in zip(ws, bs):
+        h = jnp.maximum(h @ w + b.reshape(1, -1), 0)
+        relu_outs.append(h)
+    for n, v in zip(op_.output("ReluOut") or [], relu_outs[:-1]):
+        ctx.set(n, v)
+    ctx.out(op_, "Out", relu_outs[-1])
+
+
+@op("fusion_squared_mat_sub", grad="generic")
+def _fusion_squared_mat_sub(ctx, op_):
+    """(X.Y)^2 - X^2.Y^2, scaled (fusion_squared_mat_sub_op.cc)."""
+    x = ctx.in1(op_, "X")
+    y = ctx.in1(op_, "Y")
+    scalar = float(op_.attr("scalar", 1.0))
+    xy = x @ y
+    sx = x * x
+    sy = y * y
+    ctx.out(op_, "SquaredX", sx)
+    ctx.out(op_, "SquaredY", sy)
+    ctx.out(op_, "SquaredXY", xy * xy)
+    ctx.out(op_, "Out", scalar * (xy * xy - sx @ sy))
+
+
+@op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, op_):
+    import jax.numpy as jnp
+
+    trans = [int(a) for a in op_.attr("trans_axis")]
+    fax = int(op_.attr("flatten_axis"))
+    cax = int(op_.attr("concat_axis"))
+    outs = []
+    for n in op_.input("X"):
+        v = jnp.transpose(ctx.get(n), trans)
+        lead = int(np.prod(v.shape[:fax])) if fax else 1
+        outs.append(v.reshape(lead, -1) if fax else v.reshape(1, -1))
+    ctx.out(op_, "Out", jnp.concatenate(outs, axis=cax))
+
+
+# ---------------------------------------------------------------------------
+# sequence-fused ops
+# ---------------------------------------------------------------------------
+@op("fused_embedding_seq_pool", grad="generic")
+def _fused_embedding_seq_pool(ctx, op_):
+    """lookup_table + sequence_pool(SUM) in one segment
+    (fused_embedding_seq_pool_op.cc). Ids: [B, T] padded + lengths."""
+    import jax.numpy as jnp
+
+    w = ctx.in1(op_, "W")
+    ids = ctx.in1(op_, "Ids")
+    if ids.ndim > 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    ids_i = ids.astype(jnp.int32)
+    emb = w[jnp.clip(ids_i, 0, w.shape[0] - 1)]  # [B, T, D]
+    pad_idx = int(op_.attr("padding_idx", -1))
+    valid = jnp.ones(ids_i.shape, emb.dtype)
+    if pad_idx >= 0:
+        valid = valid * (ids_i != pad_idx).astype(emb.dtype)
+    names = op_.inputs.get("Ids") or []
+    lens = lengths_for(ctx, names[0]) if names else None
+    if lens is not None:
+        t = jnp.arange(ids_i.shape[1])[None, :]
+        valid = valid * (t < lens[:, None]).astype(emb.dtype)
+    ctx.out(op_, "Out", jnp.sum(emb * valid[..., None], axis=1))
+
+
+def _seqpool(ctx, name, ptype):
+    import jax.numpy as jnp
+
+    x = ctx.get(name)  # [B, T, D]
+    lens = lengths_for(ctx, name)
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    m = (jnp.arange(x.shape[1])[None, :] < lens[:, None]).astype(x.dtype)[..., None]
+    if ptype == "SUM":
+        return jnp.sum(x * m, axis=1)
+    if ptype == "AVERAGE":
+        return jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    if ptype == "SQRT":
+        return jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(jnp.sum(m, axis=1), 1.0))
+    if ptype == "MAX":
+        neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+        return jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    raise NotImplementedError("pooltype %r" % ptype)
+
+
+@op("fusion_seqpool_concat", grad="generic")
+def _fusion_seqpool_concat(ctx, op_):
+    import jax.numpy as jnp
+
+    ptype = op_.attr("pooltype", "SUM").upper()
+    axis = int(op_.attr("axis", 1))
+    outs = [_seqpool(ctx, n, ptype) for n in op_.input("X")]
+    ctx.out(op_, "Out", jnp.concatenate(outs, axis=axis))
+
+
+@op("fusion_seqpool_cvm_concat", grad="generic")
+def _fusion_seqpool_cvm_concat(ctx, op_):
+    """seqpool + CVM (show/click feature handling, cvm_op.cc semantics) +
+    concat (fusion_seqpool_cvm_concat_op.cc)."""
+    import jax.numpy as jnp
+
+    ptype = op_.attr("pooltype", "SUM").upper()
+    axis = int(op_.attr("axis", 1))
+    use_cvm = bool(op_.attr("use_cvm", True))
+    outs = []
+    for n in op_.input("X"):
+        v = _seqpool(ctx, n, ptype)  # [B, D]; D >= 2, first two = show/clk
+        if use_cvm:
+            show = jnp.log(jnp.maximum(v[:, :1], 0) + 1.0)
+            ctr = jnp.log(jnp.maximum(v[:, 1:2], 0) + 1.0) - show
+            v = jnp.concatenate([show, ctr, v[:, 2:]], axis=1)
+        else:
+            v = v[:, 2:]
+        outs.append(v)
+    ctx.out(op_, "Out", jnp.concatenate(outs, axis=axis))
+
+
+@op("fusion_seqconv_eltadd_relu", grad="generic")
+def _fusion_seqconv_eltadd_relu(ctx, op_):
+    """sequence_conv + bias + relu (fusion_seqconv_eltadd_relu_op.cc).
+    Context window gathers within each sequence (zero beyond bounds)."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, M]
+    filt = ctx.in1(op_, "Filter")  # [M*ctx_len, D]
+    bias = ctx.in1(op_, "Bias")
+    clen = int(op_.attr("contextLength"))
+    cstart = int(op_.attr("contextStart", -(clen - 1) // 2))
+    lens = _lengths_or_full(ctx, op_, x)
+    b, t, m = x.shape
+    tpos = jnp.arange(t)
+    cols = []
+    for j in range(clen):
+        shift = cstart + j
+        src = tpos + shift
+        ok = (src >= 0) & (src < lens[:, None])
+        g = x[jnp.arange(b)[:, None], jnp.clip(src, 0, t - 1)[None, :].repeat(b, 0)]
+        cols.append(jnp.where(ok[..., None], g, 0))
+    colmat = jnp.concatenate(cols, axis=2)  # [B, T, M*clen]
+    out = jnp.maximum(colmat @ filt + bias.reshape(1, 1, -1), 0)
+    valid = (tpos[None, :] < lens[:, None])[..., None]
+    out = jnp.where(valid, out, 0)
+    ctx.out(op_, "Out", out)
+    if op_.output("ColMat"):
+        ctx.out(op_, "ColMat", colmat)
+    names = op_.outputs.get("Out") or []
+    if names:
+        ctx.set(names[0] + "@SEQ_LEN", lens)
+
+
+@op("fusion_seqexpand_concat_fc", grad="generic")
+def _fusion_seqexpand_concat_fc(ctx, op_):
+    """First input [B, T, D0] LoD; rest [B, Di] expanded over T; concat on
+    features; fc + activation (fusion_seqexpand_concat_fc_op.cc)."""
+    import jax.numpy as jnp
+
+    names = op_.input("X")
+    x0 = ctx.get(names[0])  # [B, T, D0]
+    b, t = x0.shape[0], x0.shape[1]
+    parts = [x0]
+    for n in names[1:]:
+        v = ctx.get(n)  # [B, Di]
+        parts.append(jnp.broadcast_to(v[:, None, :], (b, t, v.shape[-1])))
+    cat = jnp.concatenate(parts, axis=2)
+    w = ctx.in1(op_, "FCWeight")
+    z = cat @ w
+    fb = ctx.in1(op_, "FCBias", optional=True)
+    if fb is not None:
+        z = z + fb.reshape(1, 1, -1)
+    out = _act(op_.attr("fc_activation", ""))(z)
+    ctx.out(op_, "Out", out)
+    if op_.output("FCOut"):
+        ctx.out(op_, "FCOut", z)
+    lens = lengths_for(ctx, names[0])
+    onames = op_.outputs.get("Out") or []
+    if lens is not None and onames:
+        ctx.set(onames[0] + "@SEQ_LEN", lens)
+
+
+# ---------------------------------------------------------------------------
+# fusion_gru / fusion_lstm: raw X projected by WeightX, then the scan core
+# shared with ops/rnn_fused_ops.py (the reference fuses exactly this).
+# ---------------------------------------------------------------------------
+@op("fusion_gru", grad="generic")
+def _fusion_gru(ctx, op_):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from .rnn_fused_ops import _act as _ract, _gru_math
+
+    x = ctx.in1(op_, "X")  # [B, T, M]
+    wx = ctx.in1(op_, "WeightX")  # [M, 3D]
+    wh = ctx.in1(op_, "WeightH")  # [D, 3D]
+    bias = ctx.in1(op_, "Bias", optional=True)
+    h0 = ctx.in1(op_, "H0", optional=True)
+    D = wh.shape[0]
+    b, t = x.shape[0], x.shape[1]
+    act_gate = _ract(op_.attr("gate_activation", "sigmoid"))
+    act_cand = _ract(op_.attr("activation", "tanh"))
+    origin_mode = bool(op_.attr("origin_mode", False))
+    is_reverse = bool(op_.attr("is_reverse", False))
+    lens = _lengths_or_full(ctx, op_, x)
+    xx = x @ wx  # [B, T, 3D]
+    if bias is not None:
+        xx = xx + bias.reshape(1, 1, -1)
+    ctx.out(op_, "XX", xx)
+    if is_reverse:
+        from .sequence_ops import reverse_valid_prefix
+
+        xx = reverse_valid_prefix(xx, lens)
+    h_init = h0 if h0 is not None else jnp.zeros((b, D), x.dtype)
+    seq = jnp.swapaxes(xx, 0, 1)
+    tidx = jnp.arange(t)
+
+    def step(h_prev, inp):
+        gx, ti = inp
+        h_new = _gru_math(gx, h_prev, wh, D, act_gate, act_cand, origin_mode)[0]
+        live = (ti < lens)[:, None]
+        h_new = jnp.where(live, h_new, h_prev)
+        return h_new, jnp.where(live, h_new, jnp.zeros_like(h_new))
+
+    _, hs = lax.scan(step, h_init, (seq, tidx))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        from .sequence_ops import reverse_valid_prefix
+
+        hidden = reverse_valid_prefix(hidden, lens)
+    ctx.out(op_, "Hidden", hidden)
+    names = op_.outputs.get("Hidden") or []
+    if names:
+        ctx.set(names[0] + "@SEQ_LEN", lens)
+
+
+@op("fusion_lstm", grad="generic")
+def _fusion_lstm(ctx, op_):
+    import jax.numpy as jnp
+
+    from . import registry as _registry
+    from .rnn_fused_ops import _lstm_impl
+
+    x = ctx.in1(op_, "X")  # [B, T, M]
+    wx = ctx.in1(op_, "WeightX")  # [M, 4D]
+    bias = ctx.in1(op_, "Bias", optional=True)
+    xx = x @ wx
+    ctx.out(op_, "XX", xx)
+    xx_name = (op_.outputs.get("XX") or ["@fusion_lstm_xx@"])[0]
+    ctx.set(xx_name, xx)
+    lens = _lengths_or_full(ctx, op_, x)
+    ctx.set(xx_name + "@SEQ_LEN", lens)
+    # delegate to the shared scan core with Input = xx, Weight = WeightH;
+    # peephole layout matches (Bias carries gates[+peepholes])
+    inner = _registry._FakeOp(
+        "lstm",
+        {
+            "Input": [xx_name],
+            "Weight": op_.inputs.get("WeightH", []),
+            "Bias": op_.inputs.get("Bias", []),
+            "H0": op_.inputs.get("H0", []),
+            "C0": op_.inputs.get("C0", []),
+        },
+        {
+            "Hidden": op_.outputs.get("Hidden", []),
+            "Cell": op_.outputs.get("Cell", []),
+            "BatchGate": op_.outputs.get("BatchedInput", []),
+            "BatchCellPreAct": op_.outputs.get("BatchedCell", []),
+        },
+        dict(op_.attrs),
+    )
+    _lstm_impl(ctx, inner, with_projection=False)
+
+
+# ---------------------------------------------------------------------------
+# multihead_matmul: the transformer attention block as ONE op — Q/K/V
+# projections already applied; computes softmax(alpha.QK^T + BiasQK).V
+# reshaped over heads (multihead_matmul_op.cu). On TPU this is the
+# MXU-friendly einsum+softmax XLA fuses end-to-end.
+# ---------------------------------------------------------------------------
+@op("multihead_matmul", grad="generic")
+def _multihead_matmul(ctx, op_):
+    import jax
+    import jax.numpy as jnp
+
+    q = ctx.in1(op_, "Q")
+    k = ctx.in1(op_, "K")
+    v = ctx.in1(op_, "V")
+    bq = ctx.in1(op_, "BiasQ", optional=True)
+    bk = ctx.in1(op_, "BiasK", optional=True)
+    bv = ctx.in1(op_, "BiasV", optional=True)
+    bqk = ctx.in1(op_, "BiasQK", optional=True)
+    alpha = float(op_.attr("alpha", 1.0))
+    heads = int(op_.attr("head_number", 1))
+    if bq is not None:
+        q = q + bq.reshape((1,) * (q.ndim - 1) + (-1,))
+    if bk is not None:
+        k = k + bk.reshape((1,) * (k.ndim - 1) + (-1,))
+    if bv is not None:
+        v = v + bv.reshape((1,) * (v.ndim - 1) + (-1,))
+    b, s, hd = q.shape
+    d = hd // heads
+
+    def split(x):
+        return jnp.transpose(x.reshape(b, s, heads, d), (0, 2, 1, 3))
+
+    qh, kh, vh = split(q), split(k), split(v)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * alpha
+    if bqk is not None:
+        scores = scores + bqk.reshape(scores.shape[0], -1, scores.shape[2], scores.shape[3])
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, hd)
+    ctx.out(op_, "Out", out)
+
+
+# attention_lstm (attention_lstm_op.cc): per-step attention over the
+# sequence + LSTM cell; composite scan.
+@op("attention_lstm", grad="generic")
+def _attention_lstm(ctx, op_):
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, T, M]
+    c0 = ctx.in1(op_, "C0")
+    h0 = ctx.in1(op_, "H0", optional=True)
+    att_w = ctx.in1(op_, "AttentionWeight")  # [M+D, 1]
+    att_b = ctx.in1(op_, "AttentionBias", optional=True)
+    scalar = ctx.in1(op_, "AttentionScalar", optional=True)
+    scalar_b = ctx.in1(op_, "AttentionScalarBias", optional=True)
+    lstm_w = ctx.in1(op_, "LSTMWeight")  # [M+D, 4D]
+    lstm_b = ctx.in1(op_, "LSTMBias")  # [1, 4D]
+    b, t, m = x.shape
+    D = lstm_w.shape[1] // 4
+    lens = _lengths_or_full(ctx, op_, x)
+    h_init = h0 if h0 is not None else jnp.zeros((b, D), x.dtype)
+    act = jax.nn.sigmoid
+
+    def step(carry, ti):
+        h_prev, c_prev = carry
+        # attention: score each timestep from [x_t, h_prev]
+        hexp = jnp.broadcast_to(h_prev[:, None, :], (b, t, D))
+        cat = jnp.concatenate([x, hexp], axis=2)  # [B, T, M+D]
+        e = cat.reshape(-1, m + D) @ att_w  # [B*T, 1]
+        if att_b is not None:
+            e = e + att_b.reshape(1, -1)
+        e = jnp.tanh(e)
+        if scalar is not None:
+            e = e * scalar.reshape(1, -1)
+        if scalar_b is not None:
+            e = e + scalar_b.reshape(1, -1)
+        e = e.reshape(b, t)
+        neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+        e = jnp.where(jnp.arange(t)[None, :] < lens[:, None], e, neg)
+        a = jax.nn.softmax(e, axis=1)
+        xt = jnp.einsum("bt,btm->bm", a, x)  # attended input
+        gates = jnp.concatenate([xt, h_prev], axis=1) @ lstm_w + lstm_b.reshape(1, -1)
+        cand = jnp.tanh(gates[:, :D])
+        ig = act(gates[:, D:2 * D])
+        fg = act(gates[:, 2 * D:3 * D])
+        og = act(gates[:, 3 * D:])
+        c_new = cand * ig + fg * c_prev
+        h_new = og * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h_init, c0), jnp.arange(t))
+    ctx.out(op_, "Hidden", jnp.swapaxes(hs, 0, 1))
+    ctx.out(op_, "Cell", jnp.swapaxes(cs, 0, 1))
+
+
+_ = (core, in_var, register_op, set_out, SkipInferShape, _mask)
+
+
+@op("fused_embedding_fc_lstm", grad="generic")
+def _fused_embedding_fc_lstm(ctx, op_):
+    """embedding lookup + fc + lstm in one segment
+    (fused_embedding_fc_lstm_op.cc). Ids: [B, T] padded + lengths."""
+    import jax.numpy as jnp
+
+    from . import registry as _registry
+    from .rnn_fused_ops import _lstm_impl
+
+    ids = ctx.in1(op_, "Ids")
+    if ids.ndim > 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    emb = ctx.in1(op_, "Embeddings")  # [V, 4D] (embedding pre-multiplied by Wx)
+    xx = emb[jnp.clip(ids.astype(jnp.int32), 0, emb.shape[0] - 1)]
+    xx_name = (op_.outputs.get("XX") or ["@fused_emb_fc_lstm_xx@"])[0]
+    ctx.set(xx_name, xx)
+    names = op_.inputs.get("Ids") or []
+    lens = lengths_for(ctx, names[0]) if names else None
+    if lens is None:
+        lens = jnp.full((xx.shape[0],), xx.shape[1], jnp.int32)
+    ctx.set(xx_name + "@SEQ_LEN", lens)
+    inner = _registry._FakeOp(
+        "lstm",
+        {
+            "Input": [xx_name],
+            "Weight": op_.inputs.get("WeightH", []),
+            "Bias": op_.inputs.get("Bias", []),
+            "H0": op_.inputs.get("H0", []),
+            "C0": op_.inputs.get("C0", []),
+        },
+        {
+            "Hidden": op_.outputs.get("Hidden", []),
+            "Cell": op_.outputs.get("Cell", []),
+            "BatchGate": op_.outputs.get("BatchedInput", []),
+            "BatchCellPreAct": op_.outputs.get("BatchedCell", []),
+        },
+        dict(op_.attrs),
+    )
+    _lstm_impl(ctx, inner, with_projection=False)

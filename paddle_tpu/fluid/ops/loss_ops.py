@@ -381,20 +381,43 @@ def _nce(ctx, op_):
         label = label[:, None]
     num_true = label.shape[1]
     bsz = x.shape[0]
-    if dist is None:
-        samples = jax.random.randint(
-            ctx.next_key(), (bsz, num_neg), 0, num_classes
+    sampler = int(op_.attr("sampler", 0))  # 0 uniform, 1 log_uniform, 2 custom
+    if sampler == 2 and dist is None:
+        raise ValueError(
+            "nce: sampler='custom_dist' requires CustomDistProbs"
         )
-        p_noise = jnp.full((), 1.0 / num_classes, x.dtype)
-        p_neg = jnp.broadcast_to(p_noise, samples.shape)
-        p_pos = jnp.broadcast_to(p_noise, label.shape)
-    else:
+    if dist is not None:
         dist = dist.reshape(-1)
         samples = jax.random.categorical(
             ctx.next_key(), jnp.log(dist + 1e-20)[None], shape=(bsz, num_neg)
         )
         p_neg = dist[samples]
         p_pos = dist[label]
+    elif sampler == 1:
+        # log-uniform (Zipfian): P(k) = log((k+2)/(k+1)) / log(N+1)
+        # via inverse-CDF sampling (the reference's LogUniformSampler)
+        u = jax.random.uniform(ctx.next_key(), (bsz, num_neg))
+        samples = jnp.clip(
+            (jnp.exp(u * np.log(num_classes + 1.0)) - 1.0).astype(jnp.int32),
+            0,
+            num_classes - 1,
+        )
+
+        def zipf_p(ids):
+            idf = ids.astype(x.dtype)
+            return jnp.log((idf + 2.0) / (idf + 1.0)) / np.log(
+                num_classes + 1.0
+            )
+
+        p_neg = zipf_p(samples)
+        p_pos = zipf_p(label)
+    else:
+        samples = jax.random.randint(
+            ctx.next_key(), (bsz, num_neg), 0, num_classes
+        )
+        p_noise = jnp.full((), 1.0 / num_classes, x.dtype)
+        p_neg = jnp.broadcast_to(p_noise, samples.shape)
+        p_pos = jnp.broadcast_to(p_noise, label.shape)
 
     def logit(ids):
         wv = w[ids]  # [B, K, D]

@@ -890,3 +890,15 @@ def _similarity_focus(ctx, op_):
             row_used = row_used.at[jnp.arange(bsz), ri].set(True)
             col_used = col_used.at[jnp.arange(bsz), ci].set(True)
     ctx.out(op_, "Out", jnp.broadcast_to(mask[:, None], x.shape).astype(x.dtype))
+
+
+@op("fsp", grad="generic")
+def _fsp(ctx, op_):
+    """FSP (flow of solution procedure) matrix for distillation
+    (reference: fsp_op.cc): out[n, ci, cj] = mean_hw x[n,ci,h,w]*y[n,cj,h,w]."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [N, C1, H, W]
+    y = ctx.in1(op_, "Y")  # [N, C2, H, W]
+    hw = x.shape[2] * x.shape[3]
+    ctx.out(op_, "Out", jnp.einsum("nihw,njhw->nij", x, y) / hw)

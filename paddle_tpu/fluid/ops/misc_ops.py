@@ -498,8 +498,9 @@ def _filter_by_instag_host(ctx, op_):
     Ins rows into instances by the `@SEQ_LEN` length companion; otherwise
     each row is one instance."""
     ins_name = op_.input("Ins")[0]
+    tag_name = op_.input("Ins_tag")[0]
     x1 = np.asarray(ctx.scope.get(ins_name))
-    x2 = np.asarray(ctx.scope.get(op_.input("Ins_tag")[0])).reshape(-1)
+    x2 = np.asarray(ctx.scope.get(tag_name)).reshape(-1)
     x3 = set(int(t) for t in op_.attr("filter_tag", []))
     is_lod = bool(op_.attr("is_lod", True))
     lens = None
@@ -512,7 +513,23 @@ def _filter_by_instag_host(ctx, op_):
         lens = np.ones(x1.shape[0], np.int64)
         starts = np.arange(x1.shape[0] + 1)
     n_inst = len(lens)
-    keep_inst = [i for i in range(n_inst) if int(x2[i]) in x3] if len(x2) >= n_inst else []
+    # each instance may carry several tags: group x2 by its own companion
+    tag_lens = ctx.scope.get(tag_name + "@SEQ_LEN")
+    if tag_lens is not None:
+        tag_lens = np.asarray(tag_lens).reshape(-1).astype(np.int64)
+        tag_starts = np.concatenate([[0], np.cumsum(tag_lens)])
+    elif len(x2) == n_inst:
+        tag_starts = np.arange(n_inst + 1)
+    else:
+        raise ValueError(
+            "filter_by_instag: Ins_tag has %d tags for %d instances and no "
+            "@SEQ_LEN companion to group them" % (len(x2), n_inst)
+        )
+    keep_inst = [
+        i
+        for i in range(n_inst)
+        if x3 & {int(t) for t in x2[tag_starts[i]:tag_starts[i + 1]]}
+    ]
     if not keep_inst:
         out = np.zeros((1,) + x1.shape[1:], x1.dtype)
         lw = np.zeros((1, 1), np.float32)

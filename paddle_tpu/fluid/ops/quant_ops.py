@@ -133,3 +133,23 @@ def _moving_average_abs_max_scale(ctx, op_):
     )
     ctx.out(op_, "Out", x)
     ctx.out(op_, "OutScale", scale.reshape(1))
+
+
+@op("fake_channel_wise_dequantize_max_abs", grad="generic")
+def _fake_channel_wise_dequantize_max_abs(ctx, op_):
+    """reference: fake_dequantize_op.cc (channel-wise variant): out =
+    x * prod(scales) / prod(quant_ranges); first scale is per-channel."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    scale_names = op_.input("Scales")
+    qbits = [int(b) for b in op_.attr("quant_bits", [8])]
+    s0 = ctx.get(scale_names[0]).reshape(-1)
+    # per-output-channel scale on axis 0 (weights) with broadcast
+    shape = [1] * x.ndim
+    shape[0] = s0.shape[0]
+    out = x.astype(jnp.float32) * s0.reshape(shape) / ((1 << (qbits[0] - 1)) - 1)
+    if len(scale_names) > 1:
+        s1 = ctx.get(scale_names[1]).reshape(())
+        out = out * s1 / ((1 << (qbits[1] - 1)) - 1)
+    ctx.out(op_, "Out", out)

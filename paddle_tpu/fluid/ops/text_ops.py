@@ -1,0 +1,184 @@
+"""Text-matching op corpus (reference: match_matrix_tensor_op.cc,
+var_conv_2d_op.cc, tree_conv_op.cc, sequence_ops/
+sequence_topk_avg_pooling_op.cc — the PSLib-era text/match models).
+
+Dense TPU forms: ragged inputs are [B, T, ...] padded with ``@SEQ_LEN``
+companions. tree_conv/var_conv_2d keep data-dependent structure walking on
+the host (they are CPU kernels in the reference deployments too)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import op, register_op
+from .sequence_ops import lengths_for
+
+
+@op("match_matrix_tensor", grad="generic")
+def _match_matrix_tensor(ctx, op_):
+    """out[b, t, i, j] = x[b, i] . W[:, t, :] . y[b, j]
+    (match_matrix_tensor_op.cc); padded positions masked to 0."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, Tx, D1]
+    y = ctx.in1(op_, "Y")  # [B, Ty, D2]
+    w = ctx.in1(op_, "W")  # [D1, dim_t, D2]
+    xn = (op_.inputs.get("X") or [None])[0]
+    yn = (op_.inputs.get("Y") or [None])[0]
+    lx = lengths_for(ctx, xn) if xn else None
+    ly = lengths_for(ctx, yn) if yn else None
+    tmp = jnp.einsum("bid,dte->bite", x, w)  # [B, Tx, dim_t, D2]
+    out = jnp.einsum("bite,bje->btij", tmp, y)  # [B, dim_t, Tx, Ty]
+    if lx is not None:
+        out = out * (
+            jnp.arange(x.shape[1])[None, None, :, None] < lx[:, None, None, None]
+        ).astype(out.dtype)
+    if ly is not None:
+        out = out * (
+            jnp.arange(y.shape[1])[None, None, None, :] < ly[:, None, None, None]
+        ).astype(out.dtype)
+    ctx.out(op_, "Out", out)
+    if op_.output("Tmp"):
+        ctx.out(op_, "Tmp", tmp)
+
+
+@op("sequence_topk_avg_pooling")
+def _sequence_topk_avg_pooling(ctx, op_):
+    """Per row of each channel's [R, C] matrix, average of the top-k column
+    values, one output column per k in `topks`
+    (sequence_topk_avg_pooling_op.cc). Dense: X [B, ch, R, C] + ROW/COLUMN
+    length companions."""
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")  # [B, ch, R, C]
+    topks = [int(k) for k in op_.attr("topks")]
+    ch = int(op_.attr("channel_num", x.shape[1]))
+    rn = (op_.inputs.get("ROW") or [None])[0]
+    cn = (op_.inputs.get("COLUMN") or [None])[0]
+    lr = lengths_for(ctx, rn) if rn else None
+    lc = lengths_for(ctx, cn) if cn else None
+    b, _, r, c = x.shape
+    neg = jnp.asarray(np.finfo(np.float32).min, x.dtype)
+    if lc is not None:
+        colmask = jnp.arange(c)[None, None, None, :] < lc[:, None, None, None]
+        xm = jnp.where(colmask, x, neg)
+    else:
+        lc_full = jnp.full((b,), c, jnp.int32)
+        lc = lc_full
+        xm = x
+    sorted_desc = -jnp.sort(-xm, axis=-1)  # [B, ch, R, C] descending
+    cols = []
+    pos_idx = jnp.arange(c)
+    for k in topks:
+        kk = min(k, c)
+        cnt = jnp.minimum(lc, kk).astype(x.dtype)  # [B]
+        take = jnp.where(pos_idx[None, None, None, :] < kk, sorted_desc, 0)
+        take = jnp.where(take == neg, 0, take)
+        s = jnp.sum(take, axis=-1)  # [B, ch, R]
+        cols.append(s / jnp.maximum(cnt, 1.0)[:, None, None])
+    out = jnp.stack(cols, axis=-1)  # [B, ch, R, K]
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, r, ch * len(topks))
+    if lr is not None:
+        out = out * (jnp.arange(r)[None, :, None] < lr[:, None, None]).astype(out.dtype)
+    ctx.out(op_, "Out", out)
+    onames = op_.outputs.get("Out") or []
+    if lr is not None and onames:
+        ctx.set(onames[0] + "@SEQ_LEN", lr)
+
+
+def _var_conv_2d_host(ctx, op_):
+    """var_conv_2d_op.cc: per-instance conv over a [C_in, H_b, W_b] image
+    whose H/W come from ROW/COLUMN lengths. Host op (CPU in the
+    reference); output padded to the max H/W."""
+    x = np.asarray(ctx.scope.get(op_.input("X")[0]))  # [B, Cin, H, W] padded
+    w = np.asarray(ctx.scope.get(op_.input("W")[0]))
+    oc = int(op_.attr("OutputChannel"))
+    ic = int(op_.attr("InputChannel"))
+    kh, kw = int(op_.attr("KernelH")), int(op_.attr("KernelW"))
+    sh, sw = int(op_.attr("StrideH", 1)), int(op_.attr("StrideW", 1))
+    rows = ctx.scope.get(op_.input("ROW")[0] + "@SEQ_LEN")
+    cols = ctx.scope.get(op_.input("COLUMN")[0] + "@SEQ_LEN")
+    b = x.shape[0]
+    rows = (
+        np.asarray(rows).reshape(-1)
+        if rows is not None
+        else np.full(b, x.shape[2], np.int64)
+    )
+    cols = (
+        np.asarray(cols).reshape(-1)
+        if cols is not None
+        else np.full(b, x.shape[3], np.int64)
+    )
+    wk = w.reshape(oc, ic, kh, kw)
+    oh_max = (x.shape[2] + sh - 1) // sh
+    ow_max = (x.shape[3] + sw - 1) // sw
+    out = np.zeros((b, oc, oh_max, ow_max), np.float32)
+    ph, pw = (kh - 1) // 2, (kw - 1) // 2  # same-padding as reference
+    for n in range(b):
+        h, wid = int(rows[n]), int(cols[n])
+        if h <= 0 or wid <= 0:
+            continue
+        img = x[n, :, :h, :wid]
+        imgp = np.pad(img, [(0, 0), (ph, kh - 1 - ph), (pw, kw - 1 - pw)])
+        oh, ow = (h + sh - 1) // sh, (wid + sw - 1) // sw
+        for i in range(oh):
+            for j in range(ow):
+                patch = imgp[:, i * sh:i * sh + kh, j * sw:j * sw + kw]
+                out[n, :, i, j] = np.tensordot(wk, patch, 3)
+    ctx.scope.set(op_.output("Out")[0], out)
+    if op_.output("Col"):
+        ctx.scope.set(op_.output("Col")[0], out.reshape(b, -1))
+
+
+register_op("var_conv_2d", lower=_var_conv_2d_host, host=True)
+
+
+def _tree_conv_host(ctx, op_):
+    """tree_conv_op.cc (TBCNN): continuous binary-tree convolution. For
+    each node, gather its subtree up to max_depth and mix W_top/W_left/
+    W_right by the eta coefficients; host op (data-dependent tree walk)."""
+    nodes = np.asarray(ctx.scope.get(op_.input("NodesVector")[0]))  # [B, N, F]
+    edges = np.asarray(ctx.scope.get(op_.input("EdgeSet")[0]))  # [B, E, 2]
+    filt = np.asarray(ctx.scope.get(op_.input("Filter")[0]))  # [F, 3, out, nf]
+    max_depth = int(op_.attr("max_depth"))
+    b, n, f = nodes.shape
+    _, _, osz, nf = filt.shape
+    wt, wl, wr = filt[:, 0], filt[:, 1], filt[:, 2]  # [F, out, nf]
+    out = np.zeros((b, n, osz, nf), np.float32)
+    for bi in range(b):
+        children = {}
+        for e in edges[bi]:
+            p, ch = int(e[0]), int(e[1])
+            if p == 0 and ch == 0:
+                continue  # padding
+            children.setdefault(p, []).append(ch)
+        for root in range(n):
+            # BFS the subtree collecting (node, depth, child_index, n_sib)
+            patch = [(root, 1, 1, 1)]
+            frontier = [(root, 1)]
+            for _d in range(max_depth - 1):
+                nxt = []
+                for (nd, dep) in frontier:
+                    chs = children.get(nd, [])
+                    for ci, chd in enumerate(chs):
+                        patch.append((chd, dep + 1, ci + 1, len(chs)))
+                        nxt.append((chd, dep + 1))
+                frontier = nxt
+            acc = np.zeros((osz, nf), np.float32)
+            for (nd, dep, ci, nsib) in patch:
+                if nd >= n:
+                    continue
+                eta_t = 1.0 - (dep - 1.0) / max(max_depth - 1.0, 1.0)
+                if nsib > 1:
+                    frac = (ci - 1.0) / (nsib - 1.0)
+                else:
+                    frac = 0.5
+                eta_r = (1.0 - eta_t) * frac
+                eta_l = (1.0 - eta_t) * (1.0 - frac)
+                wmix = eta_t * wt + eta_l * wl + eta_r * wr  # [F, out, nf]
+                acc += np.einsum("f,fon->on", nodes[bi, nd], wmix)
+            out[bi, root] = acc
+    ctx.scope.set(op_.output("Out")[0], out.reshape(b, n, osz * nf))
+
+
+register_op("tree_conv", lower=_tree_conv_host, host=True)

@@ -1,0 +1,285 @@
+"""Fused-op composite lowerings vs numpy oracles (OPS_AUDIT.md batch 2;
+reference: operators/fused/)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from tests.op_test import OpTest
+
+
+class TestFusedElemwiseActivation(OpTest):
+    def setUp(self):
+        self.op_type = "fused_elemwise_activation"
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        y = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"functor_list": ["relu", "elementwise_add"]}
+        self.outputs = {
+            "Out": np.maximum(x + y, 0),
+            "IntermediateOut": x + y,
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestFusedElemwiseActivationBinaryOuter(OpTest):
+    def setUp(self):
+        self.op_type = "fused_elemwise_activation"
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        y = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"functor_list": ["elementwise_add", "scale"], "scale": 2.0}
+        self.outputs = {"Out": x + 2.0 * y, "IntermediateOut": 2.0 * y}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusedFcElementwiseLayernorm(OpTest):
+    def setUp(self):
+        self.op_type = "fused_fc_elementwise_layernorm"
+        rng = np.random.RandomState(2)
+        x = rng.rand(4, 5).astype(np.float32)
+        w = rng.rand(5, 6).astype(np.float32)
+        b0 = rng.rand(6).astype(np.float32)
+        y = rng.rand(4, 6).astype(np.float32)
+        scale = rng.rand(6).astype(np.float32)
+        b1 = rng.rand(6).astype(np.float32)
+        z = x @ w + b0 + y
+        mean = z.mean(-1, keepdims=True)
+        var = z.var(-1, keepdims=True)
+        out = (z - mean) / np.sqrt(var + 1e-5) * scale + b1
+        self.inputs = {"X": x, "W": w, "Bias0": b0, "Y": y, "Scale": scale, "Bias1": b1}
+        self.attrs = {"epsilon": 1e-5, "x_num_col_dims": 1}
+        self.outputs = {"Out": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusionSquaredMatSub(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_squared_mat_sub"
+        rng = np.random.RandomState(3)
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        xy = x @ y
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"scalar": 0.5}
+        self.outputs = {
+            "SquaredX": x * x,
+            "SquaredY": y * y,
+            "SquaredXY": xy * xy,
+            "Out": 0.5 * (xy * xy - (x * x) @ (y * y)),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusionRepeatedFcRelu(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_repeated_fc_relu"
+        rng = np.random.RandomState(4)
+        x = rng.rand(4, 5).astype(np.float32)
+        w1 = rng.rand(5, 6).astype(np.float32)
+        b1 = rng.rand(6).astype(np.float32)
+        w2 = rng.rand(6, 3).astype(np.float32)
+        b2 = rng.rand(3).astype(np.float32)
+        h1 = np.maximum(x @ w1 + b1, 0)
+        out = np.maximum(h1 @ w2 + b2, 0)
+        self.inputs = {"X": x, "W": [("w1", w1), ("w2", w2)],
+                       "Bias": [("b1", b1), ("b2", b2)]}
+        self.attrs = {}
+        self.outputs = {"Out": out, "ReluOut": [("ro1", h1)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusionTransposeFlattenConcat(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_transpose_flatten_concat"
+        rng = np.random.RandomState(5)
+        a = rng.rand(2, 3, 4).astype(np.float32)
+        b = rng.rand(2, 3, 4).astype(np.float32)
+        ta = np.transpose(a, (0, 2, 1)).reshape(2, -1)
+        tb = np.transpose(b, (0, 2, 1)).reshape(2, -1)
+        self.inputs = {"X": [("xa", a), ("xb", b)]}
+        self.attrs = {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 1}
+        self.outputs = {"Out": np.concatenate([ta, tb], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusedEmbeddingSeqPool(OpTest):
+    def setUp(self):
+        self.op_type = "fused_embedding_seq_pool"
+        rng = np.random.RandomState(6)
+        w = rng.rand(10, 4).astype(np.float32)
+        ids = np.asarray([[1, 2, 3], [4, 5, 0]], np.int64)
+        lens = [3, 2]
+        out = np.stack([w[ids[i, :lens[i]]].sum(0) for i in range(2)])
+        self.inputs = {"W": w, "Ids": (ids.reshape(2, 3, 1), [lens])}
+        self.attrs = {"combiner": "sum"}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusionSeqpoolConcat(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_seqpool_concat"
+        rng = np.random.RandomState(7)
+        a = rng.rand(2, 3, 4).astype(np.float32)
+        b = rng.rand(2, 2, 5).astype(np.float32)
+        la, lb = [3, 2], [1, 2]
+        pa = np.stack([a[i, :la[i]].sum(0) for i in range(2)])
+        pb = np.stack([b[i, :lb[i]].sum(0) for i in range(2)])
+        self.inputs = {"X": [("sa", (a, [la])), ("sb", (b, [lb]))]}
+        self.attrs = {"pooltype": "SUM", "axis": 1}
+        self.outputs = {"Out": np.concatenate([pa, pb], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMultiheadMatmul(OpTest):
+    def setUp(self):
+        self.op_type = "multihead_matmul"
+        rng = np.random.RandomState(8)
+        b, s, h, d = 2, 5, 2, 4
+        q = rng.rand(b, s, h * d).astype(np.float32)
+        k = rng.rand(b, s, h * d).astype(np.float32)
+        v = rng.rand(b, s, h * d).astype(np.float32)
+        bq = rng.rand(h * d).astype(np.float32)
+        bk = rng.rand(h * d).astype(np.float32)
+        bv = rng.rand(h * d).astype(np.float32)
+        alpha = 1.0 / np.sqrt(d)
+
+        def split(x):
+            return np.transpose(x.reshape(b, s, h, d), (0, 2, 1, 3))
+
+        qh, kh, vh = split(q + bq), split(k + bk), split(v + bv)
+        sc = np.einsum("bhsd,bhtd->bhst", qh, kh) * alpha
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        out = np.einsum("bhst,bhtd->bhsd", p, vh)
+        out = np.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * d)
+        self.inputs = {"Q": q, "K": k, "V": v, "BiasQ": bq, "BiasK": bk, "BiasV": bv}
+        self.attrs = {"alpha": float(alpha), "head_number": h}
+        self.outputs = {"Out": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # softmax curvature makes the float32 finite difference noisy
+        self.check_grad(["Q", "K", "V"], "Out", max_relative_error=0.03)
+
+
+def test_fusion_gru_matches_gru_layer():
+    """fusion_gru == x@Wx+b fed into the plain gru op."""
+    rng = np.random.RandomState(9)
+    b, t, m, d = 2, 4, 3, 5
+    x = rng.rand(b, t, m).astype(np.float32)
+    wx = rng.rand(m, 3 * d).astype(np.float32)
+    wh = rng.rand(d, 3 * d).astype(np.float32) * 0.1
+    bias = rng.rand(3 * d).astype(np.float32) * 0.1
+
+    def run(op_type):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data(name="x", shape=[t, m], dtype="float32")
+            blk = main.current_block()
+            for nm, val in [("wx", wx), ("wh", wh), ("bb", bias)]:
+                blk.create_var(name=nm, dtype="float32", shape=list(val.shape))
+            out = blk.create_var(name="hid", dtype="float32", shape=[-1, t, d])
+            xx = blk.create_var(name="xx", dtype="float32", shape=[-1, t, 3 * d])
+            if op_type == "fusion_gru":
+                blk.append_op(
+                    type="fusion_gru",
+                    inputs={"X": [xv.name], "WeightX": ["wx"], "WeightH": ["wh"],
+                            "Bias": ["bb"]},
+                    outputs={"Hidden": [out.name], "XX": [xx.name]},
+                    attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                           "is_reverse": False, "origin_mode": False},
+                )
+            else:
+                mm = blk.create_var(name="mm", dtype="float32", shape=[-1, t, 3 * d])
+                blk.append_op(type="mul", inputs={"X": [xv.name], "Y": ["wx"]},
+                              outputs={"Out": [mm.name]},
+                              attrs={"x_num_col_dims": 2, "y_num_col_dims": 1})
+                blk.append_op(type="gru",
+                              inputs={"Input": [mm.name], "Weight": ["wh"],
+                                      "Bias": ["bb"]},
+                              outputs={"Hidden": [out.name]},
+                              attrs={"activation": "tanh",
+                                     "gate_activation": "sigmoid",
+                                     "is_reverse": False, "origin_mode": False})
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.core.Scope()
+            exe.run(startup, scope=scope)
+            scope.set("wx", wx); scope.set("wh", wh); scope.set("bb", bias)
+            return np.asarray(
+                exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)[0]
+            )
+
+    np.testing.assert_allclose(run("fusion_gru"), run("gru"), rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_lstm_matches_lstm_op():
+    rng = np.random.RandomState(10)
+    b, t, m, d = 2, 4, 3, 5
+    x = rng.rand(b, t, m).astype(np.float32)
+    wx = rng.rand(m, 4 * d).astype(np.float32)
+    wh = rng.rand(d, 4 * d).astype(np.float32) * 0.1
+    bias = rng.rand(4 * d).astype(np.float32) * 0.1
+
+    def run(fused):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data(name="x", shape=[t, m], dtype="float32")
+            blk = main.current_block()
+            for nm, val in [("wx", wx), ("wh", wh), ("bb", bias.reshape(1, -1))]:
+                blk.create_var(name=nm, dtype="float32", shape=list(np.asarray(val).shape))
+            hid = blk.create_var(name="hid", dtype="float32", shape=[-1, t, d])
+            cell = blk.create_var(name="cel", dtype="float32", shape=[-1, t, d])
+            if fused:
+                xx = blk.create_var(name="xx", dtype="float32", shape=[-1, t, 4 * d])
+                blk.append_op(
+                    type="fusion_lstm",
+                    inputs={"X": [xv.name], "WeightX": ["wx"], "WeightH": ["wh"],
+                            "Bias": ["bb"]},
+                    outputs={"Hidden": [hid.name], "Cell": [cell.name],
+                             "XX": [xx.name]},
+                    attrs={"use_peepholes": False},
+                )
+            else:
+                mm = blk.create_var(name="mm", dtype="float32", shape=[-1, t, 4 * d])
+                blk.append_op(type="mul", inputs={"X": [xv.name], "Y": ["wx"]},
+                              outputs={"Out": [mm.name]},
+                              attrs={"x_num_col_dims": 2, "y_num_col_dims": 1})
+                blk.append_op(type="lstm",
+                              inputs={"Input": [mm.name], "Weight": ["wh"],
+                                      "Bias": ["bb"]},
+                              outputs={"Hidden": [hid.name], "Cell": [cell.name]},
+                              attrs={"use_peepholes": False})
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.core.Scope()
+            exe.run(startup, scope=scope)
+            scope.set("wx", wx); scope.set("wh", wh)
+            scope.set("bb", bias.reshape(1, -1))
+            return np.asarray(
+                exe.run(main, feed={"x": x}, fetch_list=[hid], scope=scope)[0]
+            )
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)

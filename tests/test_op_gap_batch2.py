@@ -1,0 +1,419 @@
+"""Numeric tests for OPS_AUDIT.md closure batches 2-3: detection corpus,
+text-matching ops, fsp/select_output. Oracles are naive numpy."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from tests.op_test import OpTest
+
+
+class TestFsp(OpTest):
+    def setUp(self):
+        self.op_type = "fsp"
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        y = rng.rand(2, 6, 4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.einsum("nihw,njhw->nij", x, y) / 20.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestBoxDecoderAndAssign(OpTest):
+    def setUp(self):
+        self.op_type = "box_decoder_and_assign"
+        rng = np.random.RandomState(1)
+        R, C = 4, 3
+        prior = np.abs(rng.rand(R, 4).astype(np.float32)) * 10
+        prior[:, 2:] += prior[:, :2] + 2
+        pvar = np.asarray([0.1, 0.1, 0.2, 0.2], np.float32)
+        target = rng.uniform(-1, 1, (R, C * 4)).astype(np.float32)
+        score = rng.rand(R, C).astype(np.float32)
+        pw = prior[:, 2] - prior[:, 0] + 1
+        ph = prior[:, 3] - prior[:, 1] + 1
+        px = prior[:, 0] + pw / 2
+        py = prior[:, 1] + ph / 2
+        t = target.reshape(R, C, 4) * pvar
+        dw = np.clip(t[..., 2], -2.302585, 2.302585)
+        dh = np.clip(t[..., 3], -2.302585, 2.302585)
+        cx = t[..., 0] * pw[:, None] + px[:, None]
+        cy = t[..., 1] * ph[:, None] + py[:, None]
+        w = np.exp(dw) * pw[:, None]
+        h = np.exp(dh) * ph[:, None]
+        dec = np.stack([cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1], -1)
+        best = score[:, 1:].argmax(1) + 1
+        assign = dec[np.arange(R), best]
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar,
+                       "TargetBox": target, "BoxScore": score}
+        self.attrs = {"box_clip": 2.302585}
+        self.outputs = {"DecodeBox": dec.reshape(R, C * 4).astype(np.float32),
+                        "OutputAssignBox": assign.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPsroiPool(OpTest):
+    def setUp(self):
+        self.op_type = "psroi_pool"
+        rng = np.random.RandomState(2)
+        oc, ph, pw = 2, 2, 2
+        x = rng.rand(1, oc * ph * pw, 8, 8).astype(np.float32)
+        rois = np.asarray([[0, 0, 3, 3], [2, 2, 7, 7]], np.float32)
+        out = np.zeros((2, oc, ph, pw), np.float32)
+        for r in range(2):
+            x0, y0 = rois[r, 0], rois[r, 1]
+            x1, y1 = rois[r, 2] + 1, rois[r, 3] + 1
+            bw, bh = (x1 - x0) / pw, (y1 - y0) / ph
+            for c in range(oc):
+                for i in range(ph):
+                    for j in range(pw):
+                        hs = int(np.floor(y0 + i * bh))
+                        he = int(np.ceil(y0 + (i + 1) * bh))
+                        ws = int(np.floor(x0 + j * bw))
+                        we = int(np.ceil(x0 + (j + 1) * bw))
+                        region = x[0, c * ph * pw + i * pw + j, hs:he, ws:we]
+                        out[r, c, i, j] = region.mean() if region.size else 0
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"output_channels": oc, "pooled_height": ph,
+                      "pooled_width": pw, "spatial_scale": 1.0}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPrroiPool(OpTest):
+    def setUp(self):
+        self.op_type = "prroi_pool"
+        # constant input: integral average must equal that constant
+        x = np.full((1, 2, 6, 6), 3.0, np.float32)
+        rois = np.asarray([[1.0, 1.0, 5.0, 5.0]], np.float32)
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0}
+        self.outputs = {"Out": np.full((1, 2, 2, 2), 3.0, np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_deformable_conv_zero_offsets_equals_conv():
+    """With zero offsets and mask=1, deformable conv == plain conv (up to
+    the half-pixel-free bilinear sampling at integer coords)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rng = np.random.RandomState(3)
+    B, Cin, H, W, Cout, k = 1, 2, 6, 6, 3, 3
+    x = rng.rand(B, Cin, H, W).astype(np.float32)
+    w = rng.rand(Cout, Cin, k, k).astype(np.float32)
+    OH = OW = H - k + 1
+    offset = np.zeros((B, 2 * k * k, OH, OW), np.float32)
+    mask = np.ones((B, k * k, OH, OW), np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[Cin, H, W], dtype="float32")
+        ov = fluid.layers.data(name="off", shape=[2 * k * k, OH, OW], dtype="float32")
+        mv = fluid.layers.data(name="msk", shape=[k * k, OH, OW], dtype="float32")
+        blk = main.current_block()
+        blk.create_var(name="w", dtype="float32", shape=[Cout, Cin, k, k])
+        out = blk.create_var(name="o", dtype="float32", shape=[-1, Cout, OH, OW])
+        blk.append_op(
+            type="deformable_conv",
+            inputs={"Input": [xv.name], "Offset": [ov.name], "Mask": [mv.name],
+                    "Filter": ["w"]},
+            outputs={"Output": [out.name]},
+            attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+                   "groups": 1, "deformable_groups": 1},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    scope.set("w", w)
+    got = np.asarray(exe.run(main, feed={"x": x, "off": offset, "msk": mask},
+                             fetch_list=[out], scope=scope)[0])
+    # naive conv oracle
+    ref = np.zeros((B, Cout, OH, OW), np.float32)
+    for co in range(Cout):
+        for i in range(OH):
+            for j in range(OW):
+                ref[0, co, i, j] = np.sum(x[0, :, i:i + k, j:j + k] * w[co])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_perspective_transform_identity():
+    """A rectangular quad equal to the output rect size crops that region."""
+    rng = np.random.RandomState(4)
+    x = rng.rand(1, 1, 8, 8).astype(np.float32)
+    th = tw = 4
+    # quad corners clockwise from top-left covering rows 2..5, cols 1..4
+    rois = np.asarray([[1, 2, 4, 2, 4, 5, 1, 5]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[1, 8, 8], dtype="float32")
+        rv = fluid.layers.data(name="r", shape=[8], dtype="float32")
+        blk = main.current_block()
+        out = blk.create_var(name="o", dtype="float32", shape=[-1, 1, th, tw])
+        blk.append_op(
+            type="roi_perspective_transform",
+            inputs={"X": [xv.name], "ROIs": [rv.name]},
+            outputs={"Out": [out.name]},
+            attrs={"transformed_height": th, "transformed_width": tw,
+                   "spatial_scale": 1.0},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = np.asarray(
+        exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out])[0]
+    )
+    np.testing.assert_allclose(got[0, 0], x[0, 0, 2:6, 1:5], rtol=1e-4, atol=1e-5)
+
+
+def test_yolov3_loss_finite_and_positive():
+    rng = np.random.RandomState(5)
+    B, nc, Gh = 2, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    amask = [0, 1, 2]
+    A = 3
+    x = rng.uniform(-1, 1, (B, A * (5 + nc), Gh, Gh)).astype(np.float32)
+    gt = np.zeros((B, 3, 4), np.float32)
+    gt[:, 0] = [0.5, 0.5, 0.3, 0.4]
+    lbl = np.zeros((B, 3), np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[A * (5 + nc), Gh, Gh], dtype="float32")
+        gv = fluid.layers.data(name="g", shape=[3, 4], dtype="float32")
+        lv = fluid.layers.data(name="l", shape=[3], dtype="int64")
+        blk = main.current_block()
+        loss = blk.create_var(name="loss", dtype="float32", shape=[-1])
+        om = blk.create_var(name="om", dtype="float32", shape=[-1, A, Gh, Gh])
+        mm = blk.create_var(name="mm", dtype="int32", shape=[-1, 3])
+        blk.append_op(
+            type="yolov3_loss",
+            inputs={"X": [xv.name], "GTBox": [gv.name], "GTLabel": [lv.name]},
+            outputs={"Loss": [loss.name], "ObjectnessMask": [om.name],
+                     "GTMatchMask": [mm.name]},
+            attrs={"class_num": nc, "anchors": anchors, "anchor_mask": amask,
+                   "downsample_ratio": 32, "ignore_thresh": 0.7,
+                   "use_label_smooth": True},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    lv_, = exe.run(main, feed={"x": x, "g": gt, "l": lbl}, fetch_list=[loss])
+    lv_ = np.asarray(lv_)
+    assert lv_.shape == (B,)
+    assert np.isfinite(lv_).all() and (lv_ > 0).all()
+
+
+def test_multiclass_nms2_index_points_at_boxes():
+    scores = np.asarray([[
+        [0.1, 0.2],   # class 0 (background)
+        [0.9, 0.05],  # class 1
+    ]], np.float32)  # [1, C=2, M=2]
+    boxes = np.asarray([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        sv = fluid.layers.data(name="s", shape=[2, 2], dtype="float32")
+        bv = fluid.layers.data(name="b", shape=[2, 4], dtype="float32")
+        blk = main.current_block()
+        out = blk.create_var(name="o", dtype="float32", shape=[-1, 6])
+        idx = blk.create_var(name="i", dtype="int64", shape=[-1, 1])
+        blk.append_op(
+            type="multiclass_nms2",
+            inputs={"Scores": [sv.name], "BBoxes": [bv.name]},
+            outputs={"Out": [out.name], "Index": [idx.name]},
+            attrs={"score_threshold": 0.01, "nms_top_k": 10, "keep_top_k": 10,
+                   "nms_threshold": 0.3, "background_label": 0,
+                   "normalized": True, "nms_eta": 1.0},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    ov, iv = exe.run(main, feed={"s": scores, "b": boxes}, fetch_list=[out, idx])
+    ov, iv = np.asarray(ov), np.asarray(iv)
+    assert ov.shape[1] == 6
+    assert ov[0, 0] == 1.0  # class 1 kept
+    assert iv.ravel()[0] == 0  # best det is box 0
+    np.testing.assert_allclose(ov[0, 2:], [0, 0, 10, 10])
+
+
+def test_distribute_and_collect_fpn_proposals():
+    rois = np.asarray([
+        [0, 0, 10, 10],     # small -> low level
+        [0, 0, 300, 300],   # large -> high level
+    ], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rv = fluid.layers.data(name="r", shape=[4], dtype="float32")
+        blk = main.current_block()
+        l2 = blk.create_var(name="l2", dtype="float32", shape=[-1, 4])
+        l3 = blk.create_var(name="l3", dtype="float32", shape=[-1, 4])
+        ri = blk.create_var(name="ri", dtype="int32", shape=[-1, 1])
+        blk.append_op(
+            type="distribute_fpn_proposals",
+            inputs={"FpnRois": [rv.name]},
+            outputs={"MultiFpnRois": [l2.name, l3.name], "RestoreIndex": [ri.name]},
+            attrs={"min_level": 2, "max_level": 3, "refer_level": 3,
+                   "refer_scale": 224},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    a, b, r = exe.run(main, feed={"r": rois}, fetch_list=[l2, l3, ri])
+    np.testing.assert_allclose(np.asarray(a), rois[:1])
+    np.testing.assert_allclose(np.asarray(b), rois[1:])
+    assert list(np.asarray(r).ravel()) == [0, 1]
+
+
+def test_match_matrix_tensor_oracle():
+    rng = np.random.RandomState(6)
+    b, tx, ty, d1, d2, dt = 2, 3, 4, 5, 6, 2
+    x = rng.rand(b, tx, d1).astype(np.float32)
+    y = rng.rand(b, ty, d2).astype(np.float32)
+    w = rng.rand(d1, dt, d2).astype(np.float32)
+    ref = np.einsum("bid,dte,bje->btij", x, w, y)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[tx, d1], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[ty, d2], dtype="float32")
+        blk = main.current_block()
+        blk.create_var(name="w", dtype="float32", shape=[d1, dt, d2])
+        out = blk.create_var(name="o", dtype="float32", shape=[-1, dt, tx, ty])
+        tmp = blk.create_var(name="t", dtype="float32", shape=[-1, tx, dt, d2])
+        blk.append_op(
+            type="match_matrix_tensor",
+            inputs={"X": [xv.name], "Y": [yv.name], "W": ["w"]},
+            outputs={"Out": [out.name], "Tmp": [tmp.name]},
+            attrs={"dim_t": dt},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    scope.set("w", w)
+    got = np.asarray(exe.run(main, feed={"x": x, "y": y}, fetch_list=[out],
+                             scope=scope)[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_topk_avg_pooling_oracle():
+    x = np.asarray([[[[3.0, 1.0, 2.0],
+                      [6.0, 5.0, 4.0]]]], np.float32)  # [1, 1, 2, 3]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[1, 2, 3], dtype="float32")
+        blk = main.current_block()
+        out = blk.create_var(name="o", dtype="float32", shape=[-1, 2, 2])
+        blk.append_op(
+            type="sequence_topk_avg_pooling",
+            inputs={"X": [xv.name]},
+            outputs={"Out": [out.name]},
+            attrs={"topks": [1, 2], "channel_num": 1},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = np.asarray(exe.run(main, feed={"x": x}, fetch_list=[out])[0])
+    # row 0: top1 = 3, top2 avg = 2.5; row 1: top1 = 6, top2 avg = 5.5
+    np.testing.assert_allclose(got[0], [[3.0, 2.5], [6.0, 5.5]])
+
+
+def test_select_output_routes_by_mask():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        mv = fluid.layers.data(name="m", shape=[1], dtype="int32")
+        blk = main.current_block()
+        o0 = blk.create_var(name="o0", dtype="float32", shape=[-1, 3])
+        o1 = blk.create_var(name="o1", dtype="float32", shape=[-1, 3])
+        blk.append_op(
+            type="select_output",
+            inputs={"X": [xv.name], "Mask": [mv.name]},
+            outputs={"Out": [o0.name, o1.name]},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    x = np.ones((2, 3), np.float32)
+    a, b = exe.run(main, feed={"x": x, "m": np.asarray([1], np.int32)},
+                   fetch_list=[o0, o1])
+    assert np.all(np.asarray(a) == 0) and np.all(np.asarray(b) == 1)
+
+
+def test_rpn_target_assign_shapes():
+    rng = np.random.RandomState(7)
+    anchors = np.asarray([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 40, 40],
+                          [100, 100, 110, 110]], np.float32)
+    gt = np.asarray([[4, 4, 14, 14]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        av = fluid.layers.data(name="a", shape=[4], dtype="float32")
+        gv = fluid.layers.data(name="g", shape=[4], dtype="float32")
+        blk = main.current_block()
+        li = blk.create_var(name="li", dtype="int32", shape=[-1])
+        si = blk.create_var(name="si", dtype="int32", shape=[-1])
+        tb = blk.create_var(name="tb", dtype="float32", shape=[-1, 4])
+        tl = blk.create_var(name="tl", dtype="int32", shape=[-1, 1])
+        bw = blk.create_var(name="bw", dtype="float32", shape=[-1, 4])
+        blk.append_op(
+            type="rpn_target_assign",
+            inputs={"Anchor": [av.name], "GtBoxes": [gv.name]},
+            outputs={"LocationIndex": [li.name], "ScoreIndex": [si.name],
+                     "TargetBBox": [tb.name], "TargetLabel": [tl.name],
+                     "BBoxInsideWeight": [bw.name]},
+            attrs={"rpn_batch_size_per_im": 4, "rpn_positive_overlap": 0.5,
+                   "rpn_negative_overlap": 0.3, "rpn_fg_fraction": 0.5,
+                   "use_random": False},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    liv, siv, tbv, tlv = exe.run(
+        main, feed={"a": anchors, "g": gt},
+        fetch_list=[li, si, tb, tl],
+    )
+    liv = np.asarray(liv)
+    assert liv.size >= 1  # the overlapping anchor is fg
+    assert np.asarray(tbv).shape == (liv.size, 4)
+    tlv = np.asarray(tlv).ravel()
+    assert set(tlv.tolist()) <= {0, 1}
+
+
+def test_detection_map_perfect_predictions():
+    dets = np.asarray([[1, 0.9, 0, 0, 10, 10], [2, 0.8, 20, 20, 30, 30]], np.float32)
+    gts = np.asarray([[1, 0, 0, 10, 10], [2, 20, 20, 30, 30]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dv = fluid.layers.data(name="d", shape=[6], dtype="float32")
+        gv = fluid.layers.data(name="g", shape=[5], dtype="float32")
+        blk = main.current_block()
+        mp = blk.create_var(name="mp", dtype="float32", shape=[1])
+        blk.append_op(
+            type="detection_map",
+            inputs={"DetectRes": [dv.name], "Label": [gv.name]},
+            outputs={"MAP": [mp.name]},
+            attrs={"overlap_threshold": 0.5, "ap_type": "integral"},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = np.asarray(exe.run(main, feed={"d": dets, "g": gts}, fetch_list=[mp])[0])
+    np.testing.assert_allclose(got, [1.0], rtol=1e-6)
+
+
+def test_tree_conv_smoke():
+    rng = np.random.RandomState(8)
+    nodes = rng.rand(1, 4, 3).astype(np.float32)
+    edges = np.asarray([[[0, 1], [0, 2], [1, 3]]], np.int32)
+    filt = rng.rand(3, 3, 2, 2).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nv = fluid.layers.data(name="n", shape=[4, 3], dtype="float32")
+        ev = fluid.layers.data(name="e", shape=[3, 2], dtype="int32")
+        blk = main.current_block()
+        blk.create_var(name="f", dtype="float32", shape=[3, 3, 2, 2])
+        out = blk.create_var(name="o", dtype="float32", shape=[-1, 4, 4])
+        blk.append_op(
+            type="tree_conv",
+            inputs={"NodesVector": [nv.name], "EdgeSet": [ev.name],
+                    "Filter": ["f"]},
+            outputs={"Out": [out.name]},
+            attrs={"max_depth": 2},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    exe.run(startup, scope=scope)
+    scope.set("f", filt)
+    got = np.asarray(exe.run(main, feed={"n": nodes, "e": edges},
+                             fetch_list=[out], scope=scope)[0])
+    assert got.shape == (1, 4, 4)
+    assert np.isfinite(got).all() and np.abs(got).sum() > 0
