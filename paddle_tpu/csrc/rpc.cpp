@@ -49,7 +49,21 @@ enum Opcode : uint8_t {
   kSendBarrier = 3,
   kFetchBarrier = 4,
   kComplete = 5,
+  // sparse-table row fetch (reference: request_handler_impl.cc
+  // RequestPrefetchHandler + parameter_prefetch.cc): name = table name,
+  // payload = raw little-endian int64 LOCAL row ids; response = the
+  // concatenated raw row bytes from the registered table buffer.
+  kPrefetch = 6,
+  // checkpoint-on-demand (reference: checkpoint_notify_op.cc +
+  // request_handler_impl.cc RequestCheckpointHandler): name = directory.
+  kCheckpointNotify = 7,
 };
+
+int64_t steady_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 bool write_full(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -128,6 +142,17 @@ struct RpcServer {
   bool shutting_down = false;
   // async mode: FIFO of received (name, trainer, payload)
   std::deque<Request> async_q;
+  // sparse tables served by kPrefetch: raw row-major buffer + row stride
+  struct Table {
+    std::vector<uint8_t> data;
+    uint64_t row_bytes = 0;
+  };
+  std::map<std::string, Table> table_store;
+  // checkpoint_notify queue (directory names)
+  std::deque<std::string> notify_q;
+  // worker liveness: last request timestamp per trainer (HeartBeatMonitor,
+  // operators/distributed/heart_beat_monitor.h:54 — sends count as beats)
+  std::vector<int64_t> last_active_ms;
 
   std::thread accept_thread;
   std::vector<std::thread> conn_threads;
@@ -144,6 +169,10 @@ struct RpcServer {
     Request req;
     while (read_request(fd, &req)) {
       uint32_t t = req.trainer_id < (uint32_t)n_trainers ? req.trainer_id : 0;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        last_active_ms[t] = steady_ms();
+      }
       switch (req.opcode) {
         case kSendVar: {
           std::unique_lock<std::mutex> lk(mu);
@@ -218,6 +247,48 @@ struct RpcServer {
           if (!write_response(fd, 0, nullptr, 0)) goto done;
           break;
         }
+        case kPrefetch: {
+          std::vector<uint8_t> rows;
+          uint8_t status = 0;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = table_store.find(req.name);
+            if (it == table_store.end() || it->second.row_bytes == 0 ||
+                req.payload.size() % 8 != 0) {
+              status = 1;
+            } else {
+              const Table& tab = it->second;
+              uint64_t n_ids = req.payload.size() / 8;
+              uint64_t n_rows = tab.data.size() / tab.row_bytes;
+              rows.resize(n_ids * tab.row_bytes);
+              const int64_t* ids =
+                  reinterpret_cast<const int64_t*>(req.payload.data());
+              for (uint64_t i = 0; i < n_ids; i++) {
+                int64_t r = ids[i];
+                if (r < 0 || (uint64_t)r >= n_rows) {
+                  std::memset(rows.data() + i * tab.row_bytes, 0,
+                              tab.row_bytes);
+                } else {
+                  std::memcpy(rows.data() + i * tab.row_bytes,
+                              tab.data.data() + (uint64_t)r * tab.row_bytes,
+                              tab.row_bytes);
+                }
+              }
+            }
+          }
+          if (!write_response(fd, status, rows.data(), rows.size()))
+            goto done;
+          break;
+        }
+        case kCheckpointNotify: {
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            notify_q.push_back(req.name);
+          }
+          cv.notify_all();
+          if (!write_response(fd, 0, nullptr, 0)) goto done;
+          break;
+        }
         default:
           goto done;
       }
@@ -271,6 +342,7 @@ void* pt_rpc_server_create(int port, int n_trainers, int sync_mode) {
   s->send_counts.assign(s->n_trainers, 0);
   s->fetch_counts.assign(s->n_trainers, 0);
   s->completed.assign(s->n_trainers, 0);
+  s->last_active_ms.assign(s->n_trainers, 0);
 
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -409,6 +481,37 @@ int pt_rpc_server_pop_send(void* h, char* name_out, int name_cap,
   return 0;
 }
 
+// Register/refresh a sparse table served by kPrefetch. data is the raw
+// row-major value buffer; row_bytes the stride of one row.
+void pt_rpc_server_put_table(void* h, const char* name, const uint8_t* data,
+                             uint64_t len, uint64_t row_bytes) {
+  auto* s = static_cast<RpcServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto& t = s->table_store[name];
+  t.data.assign(data, data + len);
+  t.row_bytes = row_bytes;
+}
+
+// Pop one checkpoint_notify directory. Returns 0 ok, 1 empty.
+int pt_rpc_server_pop_notify(void* h, char* dir_out, int cap) {
+  auto* s = static_cast<RpcServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (s->notify_q.empty()) return 1;
+  std::snprintf(dir_out, cap, "%s", s->notify_q.front().c_str());
+  s->notify_q.pop_front();
+  return 0;
+}
+
+// Worker liveness snapshot: out[t] = ms since trainer t's last request
+// (-1 = never heard from). HeartBeatMonitor's data source.
+void pt_rpc_server_worker_idle_ms(void* h, int64_t* out) {
+  auto* s = static_cast<RpcServer*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  int64_t now = steady_ms();
+  for (int t = 0; t < s->n_trainers; t++)
+    out[t] = s->last_active_ms[t] ? now - s->last_active_ms[t] : -1;
+}
+
 int pt_rpc_server_n_complete(void* h) {
   auto* s = static_cast<RpcServer*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
@@ -517,6 +620,29 @@ int pt_rpc_fetch_barrier(void* h, uint32_t trainer_id) {
 int pt_rpc_complete(void* h, uint32_t trainer_id) {
   return rpc_call(static_cast<RpcClient*>(h), kComplete, trainer_id, nullptr,
                   nullptr, 0, nullptr, nullptr);
+}
+
+// Fetch table rows: ids = raw int64 array, *out = raw row bytes.
+int pt_rpc_prefetch(void* h, uint32_t trainer_id, const char* table,
+                    const uint8_t* ids, uint64_t ids_len, uint8_t** out,
+                    uint64_t* out_len) {
+  return rpc_call(static_cast<RpcClient*>(h), kPrefetch, trainer_id, table,
+                  ids, ids_len, out, out_len);
+}
+
+int pt_rpc_checkpoint_notify(void* h, uint32_t trainer_id, const char* dir) {
+  return rpc_call(static_cast<RpcClient*>(h), kCheckpointNotify, trainer_id,
+                  dir, nullptr, 0, nullptr, nullptr);
+}
+
+// Honor FLAGS rpc_deadline: bound every send/recv on this connection.
+void pt_rpc_set_deadline(void* h, int deadline_ms) {
+  auto* c = static_cast<RpcClient*>(h);
+  timeval tv{};
+  tv.tv_sec = deadline_ms / 1000;
+  tv.tv_usec = (deadline_ms % 1000) * 1000;
+  setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 }
 
 void pt_rpc_close(void* h) {

@@ -68,6 +68,11 @@ _DEFAULTS = {
     "rpc_disable_reuse_port": False,
     "rpc_retry_bind_port": 3,
     "worker_update_interval_secs": 900,
+    # pserver liveness + serve-loop bound (HeartBeatMonitor,
+    # heart_beat_monitor.h:54; stale threshold is 2 min in the reference)
+    "pserver_heartbeat_timeout_s": 120.0,
+    "pserver_heartbeat_interval_s": 10.0,
+    "pserver_timeout_ms": 600000,
     # communicator
     "communicator_independent_recv_thread": True,
     "communicator_send_queue_size": 20,

@@ -155,6 +155,16 @@ def _load():
         lib.pt_rpc_fetch_barrier.argtypes = [c, u32]
         lib.pt_rpc_complete.argtypes = [c, u32]
         lib.pt_rpc_close.argtypes = [c]
+        lib.pt_rpc_server_put_table.argtypes = [
+            c, ctypes.c_char_p, u8p, u64, u64
+        ]
+        lib.pt_rpc_server_pop_notify.argtypes = [c, ctypes.c_char_p, ctypes.c_int]
+        lib.pt_rpc_server_worker_idle_ms.argtypes = [c, i64p]
+        lib.pt_rpc_prefetch.argtypes = [
+            c, u32, ctypes.c_char_p, u8p, u64, ctypes.POINTER(u8p), u64p
+        ]
+        lib.pt_rpc_checkpoint_notify.argtypes = [c, u32, ctypes.c_char_p]
+        lib.pt_rpc_set_deadline.argtypes = [c, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -233,6 +243,46 @@ def deserialize_tensor(buf, pos=0):
         return arr, lod, int(lib.pt_tensor_consumed(h))
     finally:
         lib.pt_tensor_destroy(h)
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows serialization (reference: operators/distributed/
+# variable_response.cc SelectedRows branch — rows vector + height + value
+# tensor). Wire form: magic | u64 height | u64 n_rows | rows (i64 each) |
+# tensor-stream value payload.
+# ---------------------------------------------------------------------------
+SELECTED_ROWS_MAGIC = b"PTSR\x01"
+
+
+def serialize_selected_rows(sr):
+    import struct as _struct
+
+    rows = np.asarray(sr.rows, np.int64)
+    value = np.asarray(sr.value)
+    head = SELECTED_ROWS_MAGIC + _struct.pack(
+        "<QQ", int(sr.height), len(rows)
+    )
+    return head + rows.tobytes() + serialize_tensor(value)
+
+
+def is_selected_rows_payload(buf):
+    return buf[: len(SELECTED_ROWS_MAGIC)] == SELECTED_ROWS_MAGIC
+
+
+def deserialize_selected_rows(buf):
+    import struct as _struct
+
+    from . import core as _core
+
+    if not is_selected_rows_payload(buf):
+        raise ValueError("not a SelectedRows payload")
+    off = len(SELECTED_ROWS_MAGIC)
+    height, n_rows = _struct.unpack_from("<QQ", buf, off)
+    off += 16
+    rows = np.frombuffer(buf, np.int64, n_rows, off)
+    off += 8 * n_rows
+    value, _lod, _used = deserialize_tensor(buf, off)
+    return _core.SelectedRows(rows=list(rows), height=height, value=value)
 
 
 # ---------------------------------------------------------------------------
@@ -362,6 +412,7 @@ class RpcServer(object):
                 "native library unavailable: %s" % _compile_error
             )
         self._lib = lib
+        self._n_trainers = int(n_trainers)
         self._h = lib.pt_rpc_server_create(
             int(port), int(n_trainers), 1 if sync_mode else 0
         )
@@ -425,6 +476,32 @@ class RpcServer(object):
         finally:
             self._lib.pt_free(out)
 
+    def put_table(self, name, arr):
+        """Serve ``arr``'s rows to kPrefetch requests (sparse lookup).
+        Zero Python-side copies: the C++ side assigns straight from the
+        array's buffer (held alive across the call by `arr`)."""
+        arr = np.ascontiguousarray(arr)
+        row_bytes = arr.strides[0] if arr.ndim > 0 else arr.itemsize
+        ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        self._lib.pt_rpc_server_put_table(
+            self._h, name.encode(), ptr, arr.nbytes, row_bytes
+        )
+
+    def pop_notify(self):
+        """-> checkpoint directory string or None."""
+        buf = ctypes.create_string_buffer(4096)
+        rc = self._lib.pt_rpc_server_pop_notify(self._h, buf, len(buf))
+        return buf.value.decode() if rc == 0 else None
+
+    def worker_idle_ms(self):
+        """-> list of per-trainer ms since last request (-1 = never)."""
+        n = getattr(self, "_n_trainers", None)
+        if n is None:
+            return []
+        arr = (ctypes.c_int64 * n)()
+        self._lib.pt_rpc_server_worker_idle_ms(self._h, arr)
+        return list(arr)
+
     def n_complete(self):
         return int(self._lib.pt_rpc_server_n_complete(self._h))
 
@@ -444,7 +521,7 @@ class RpcClient(object):
     """Trainer-side connection to one pserver endpoint (reference:
     RPCClient, operators/distributed/rpc_client.h / grpc/grpc_client.cc)."""
 
-    def __init__(self, endpoint, trainer_id=0, timeout_ms=60000):
+    def __init__(self, endpoint, trainer_id=0, timeout_ms=None):
         lib = _load()
         if lib is None:
             raise RuntimeError(
@@ -456,16 +533,64 @@ class RpcClient(object):
             host = "127.0.0.1"
         self.endpoint = endpoint
         self.trainer_id = int(trainer_id)
-        self._h = lib.pt_rpc_connect(host.encode(), int(port), timeout_ms)
+        # FLAGS rpc_deadline / rpc_retry_times (reference:
+        # python/paddle/fluid/__init__.py:187 whitelists both; grpc client
+        # honors them per call) — env-bridged via fluid.flags
+        from . import flags as _flags
+
+        self._deadline_ms = int(
+            timeout_ms
+            if timeout_ms is not None
+            else _flags.get_flag("rpc_deadline", 180000)
+        )
+        self._retry_times = int(_flags.get_flag("rpc_retry_times", 3))
+        self._host, self._port = host, int(port)
+        self._h = lib.pt_rpc_connect(
+            host.encode(), int(port), self._deadline_ms
+        )
         if not self._h:
             raise ConnectionError(
                 "cannot connect to pserver at %s" % endpoint
             )
+        lib.pt_rpc_set_deadline(self._h, self._deadline_ms)
+
+    def _reconnect(self):
+        try:
+            if self._h:
+                self._lib.pt_rpc_close(self._h)
+        except Exception:
+            pass
+        self._h = self._lib.pt_rpc_connect(
+            self._host.encode(), self._port, self._deadline_ms
+        )
+        if self._h:
+            self._lib.pt_rpc_set_deadline(self._h, self._deadline_ms)
+        return bool(self._h)
+
+    def _with_retry(self, fn, what):
+        """FLAGS_rpc_retry_times semantics: a deadline/io failure (-1)
+        reconnects and retries; other statuses surface immediately."""
+        last_rc = -1
+        for attempt in range(self._retry_times + 1):
+            if not self._h and not self._reconnect():
+                continue
+            rc = fn()
+            if rc != -1:
+                return rc
+            last_rc = rc
+            self._reconnect()
+        raise ConnectionError(
+            "%s failed after %d retries (rpc_deadline=%dms) -> rc %d"
+            % (what, self._retry_times, self._deadline_ms, last_rc)
+        )
 
     def send_var(self, name, payload):
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        rc = self._lib.pt_rpc_send_var(
-            self._h, self.trainer_id, name.encode(), buf, len(payload)
+        rc = self._with_retry(
+            lambda: self._lib.pt_rpc_send_var(
+                self._h, self.trainer_id, name.encode(), buf, len(payload)
+            ),
+            "send_var(%s)" % name,
         )
         if rc != 0:
             raise ConnectionError("send_var(%s) -> rc %d" % (name, rc))
@@ -473,10 +598,14 @@ class RpcClient(object):
     def get_var(self, name):
         out = ctypes.POINTER(ctypes.c_uint8)()
         out_len = ctypes.c_uint64()
-        rc = self._lib.pt_rpc_get_var(
-            self._h, self.trainer_id, name.encode(), ctypes.byref(out),
-            ctypes.byref(out_len),
-        )
+
+        def call():
+            return self._lib.pt_rpc_get_var(
+                self._h, self.trainer_id, name.encode(), ctypes.byref(out),
+                ctypes.byref(out_len),
+            )
+
+        rc = self._with_retry(call, "get_var(%s)" % name)
         if rc != 0:
             if bool(out):
                 self._lib.pt_free(out)
@@ -485,6 +614,43 @@ class RpcClient(object):
             return ctypes.string_at(out, out_len.value)
         finally:
             self._lib.pt_free(out)
+
+    def prefetch(self, table, ids):
+        """Fetch table rows by LOCAL row id (kPrefetch; reference:
+        parameter_prefetch.cc). ids: int64 array -> raw row bytes."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64))
+        data = ids.tobytes()
+        buf = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(
+            data or b"\0"
+        )
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+
+        def call():
+            return self._lib.pt_rpc_prefetch(
+                self._h, self.trainer_id, table.encode(), buf, len(data),
+                ctypes.byref(out), ctypes.byref(out_len),
+            )
+
+        rc = self._with_retry(call, "prefetch(%s)" % table)
+        if rc != 0:
+            if bool(out):
+                self._lib.pt_free(out)
+            raise ConnectionError("prefetch(%s) -> rc %d" % (table, rc))
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.pt_free(out)
+
+    def checkpoint_notify(self, dirname):
+        rc = self._with_retry(
+            lambda: self._lib.pt_rpc_checkpoint_notify(
+                self._h, self.trainer_id, dirname.encode()
+            ),
+            "checkpoint_notify",
+        )
+        if rc != 0:
+            raise ConnectionError("checkpoint_notify -> rc %d" % rc)
 
     def send_barrier(self):
         self._lib.pt_rpc_send_barrier(self._h, self.trainer_id)

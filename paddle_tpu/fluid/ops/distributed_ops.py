@@ -65,6 +65,8 @@ def _scope_value(ctx, name):
 
 
 def _send_lower(ctx, op_):
+    from .. import core as _core
+
     eps = op_.attr("endpoints") or op_.attr("epmap") or []
     tid = int(op_.attr("trainer_id", 0))
     names = [n for n in op_.input_arg_names]
@@ -76,14 +78,42 @@ def _send_lower(ctx, op_):
 
         c = _comm.global_communicator()
         if c is not None and c.is_running():
+            rest = []
             for n in names:
-                c.push(n, _scope_value(ctx, n))
-            return
-    for ep in eps:
-        client = get_client(ep, tid)
-        for n in names:
-            payload = native.serialize_tensor(_scope_value(ctx, n))
-            client.send_var(n, payload)
+                # SelectedRows bypass the communicator's dense merge and go
+                # straight out row-sharded (below)
+                if isinstance(ctx.scope.get(n), _core.SelectedRows):
+                    rest.append(n)
+                else:
+                    c.push(n, _scope_value(ctx, n))
+            if not rest:
+                return
+            names = rest
+    for n in names:
+        v = ctx.scope.get(n)
+        if v is None:
+            raise KeyError("send: var %r not found in scope" % n)
+        if isinstance(v, _core.SelectedRows):
+            # row-sharded sparse send (reference parameter_send.cc sliced
+            # SelectedRows path): pserver k gets rows with id % n == k,
+            # re-indexed to the shard-local id // n
+            rows = np.asarray(v.rows, np.int64)
+            vals = np.asarray(v.value)
+            n_eps = len(eps)
+            for k, ep in enumerate(eps):
+                sel = np.nonzero(rows % n_eps == k)[0]
+                shard = _core.SelectedRows(
+                    rows=list(rows[sel] // n_eps),
+                    height=(v.height + n_eps - 1 - k) // n_eps,
+                    value=vals[sel],
+                )
+                get_client(ep, tid).send_var(
+                    n, native.serialize_selected_rows(shard)
+                )
+            continue
+        payload = native.serialize_tensor(np.asarray(v))
+        for ep in eps:
+            get_client(ep, tid).send_var(n, payload)
 
 
 def _recv_lower(ctx, op_):
@@ -118,21 +148,145 @@ def _compile_optimize_block(program, block_idx, place):
 
 def _merge_trainer_grads(server, grad_name, n_trainers):
     """Sum per-trainer copies and average (reference:
-    _append_pserver_grad_merge_ops — sum op + scale 1/trainer_num)."""
+    _append_pserver_grad_merge_ops — sum op + scale 1/trainer_num). Sparse
+    (SelectedRows) payloads merge by row concatenation with 1/n scaling
+    (reference MergeSelectedRows + scale)."""
+    from .. import core as _core
+
     arrs = []
+    sparse = []
     orig_dtype = None
     for t in range(n_trainers):
         payload = server.get_recv("%s@trainer_%d" % (grad_name, t))
-        if payload is not None:
+        if payload is None:
+            continue
+        if native.is_selected_rows_payload(payload):
+            sparse.append(native.deserialize_selected_rows(payload))
+        else:
             arr, _lod, _used = native.deserialize_tensor(payload)
             orig_dtype = arr.dtype
             arrs.append(arr.astype(np.float64))
+    if sparse:
+        n = len(sparse)
+        rows = np.concatenate([np.asarray(s.rows, np.int64) for s in sparse])
+        vals = np.concatenate(
+            [np.asarray(s.value, np.float64) for s in sparse], axis=0
+        ) / float(n)
+        return _core.SelectedRows(
+            rows=list(rows), height=sparse[0].height,
+            value=vals.astype(np.asarray(sparse[0].value).dtype),
+        )
     if not arrs:
         return None
     merged = arrs[0]
     for a in arrs[1:]:
         merged = merged + a
     return (merged / float(len(arrs))).astype(orig_dtype)
+
+
+def _apply_sparse_update(scope, program, bidx, grad_name, sr):
+    """Apply a SelectedRows grad to its table shard. sgd gets the direct
+    scatter rule (reference: sgd_op.h SelectedRows branch); other optimizer
+    rules fall back to densifying the grad into the shard's shape and
+    running the compiled optimize block."""
+    rows = np.asarray(sr.rows, np.int64)
+    vals = np.asarray(sr.value)
+    blk = program.block(bidx)
+    opt_op = next((o for o in blk.ops if o.input("Param")), None)
+    if opt_op is None:
+        return None
+    pname = opt_op.input("Param")[0]
+    table = np.asarray(scope.get(pname))
+    if opt_op.type == "sgd":
+        lr = float(np.asarray(scope.get(opt_op.input("LearningRate")[0])).ravel()[0])
+        upd = table.copy()
+        np.subtract.at(
+            upd, rows, (lr * vals).astype(table.dtype)
+        )
+        scope.set(pname, upd)
+        return pname
+    # generic fallback: densify into the shard shape, run the XLA block
+    dense = np.zeros_like(table)
+    np.add.at(dense, rows, vals.astype(table.dtype))
+    scope.set(grad_name, dense)
+    return "__dense_fallback__"
+
+
+class HeartBeatMonitor(object):
+    """Pserver-side worker-liveness watchdog (reference:
+    operators/distributed/heart_beat_monitor.h:54 — every worker request
+    counts as a beat; a background thread logs workers stale beyond the
+    threshold)."""
+
+    def __init__(self, server, n_trainers, threshold_s=120.0, interval_s=10.0):
+        self.server = server
+        self.n = n_trainers
+        self.threshold_ms = threshold_s * 1000.0
+        self.interval_s = interval_s
+        self.lost = set()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import logging
+
+        log = logging.getLogger("paddle_tpu.pserver")
+        while not self._stop.wait(self.interval_s):
+            try:
+                idle = self.server.worker_idle_ms()
+            except Exception:
+                if not self._stop.is_set():
+                    log.exception(
+                        "HeartBeatMonitor: liveness poll failed; watchdog "
+                        "exiting — lost workers will no longer be flagged"
+                    )
+                return
+            for t, ms in enumerate(idle):
+                if ms >= 0 and ms > self.threshold_ms and t not in self.lost:
+                    self.lost.add(t)
+                    log.warning(
+                        "HeartBeatMonitor: worker %d lost (no request for "
+                        "%.1fs > %.1fs)", t, ms / 1000.0,
+                        self.threshold_ms / 1000.0,
+                    )
+                elif ms >= 0 and ms <= self.threshold_ms and t in self.lost:
+                    self.lost.discard(t)
+                    log.warning("HeartBeatMonitor: worker %d recovered", t)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def _save_shard(scope, names, dirname, sparse_tables=(), shard_idx=0):
+    """checkpoint_notify handler: save this pserver's persistables into
+    `dirname` in the save_vars tensor-stream format (reference:
+    request_handler_impl.cc CHECKPOINT handler -> save ops). Row-sharded
+    tables get a per-server ``.block<k>`` suffix (the reference's sliced-var
+    naming) so shards from different pservers cannot clobber each other."""
+    import os
+
+    os.makedirs(dirname, exist_ok=True)
+    for n in names:
+        v = scope.get(n)
+        if v is None:
+            continue
+        from .. import core as _core
+
+        if isinstance(v, _core.SelectedRows):
+            data = native.serialize_selected_rows(v)
+        else:
+            data = native.serialize_tensor(np.asarray(v))
+        fname = n
+        if n in sparse_tables:
+            fname = "%s.block%d" % (n, shard_idx)
+        with open(os.path.join(dirname, fname), "wb") as f:
+            f.write(data)
 
 
 def _listen_and_serv_lower(ctx, op_):
@@ -147,7 +301,11 @@ def _listen_and_serv_lower(ctx, op_):
     n_trainers = int(op_.attr("Fanin", 1))
     sync_mode = bool(op_.attr("sync_mode", True))
     grad_to_block_id = op_.attr("grad_to_block_id") or []
-    timeout_ms = int(op_.attr("rpc_timeout_ms", 600000))
+    from .. import flags as _flags0
+
+    timeout_ms = int(
+        op_.attr("rpc_timeout_ms", _flags0.get_flag("pserver_timeout_ms", 600000))
+    )
 
     port = int(endpoint.rsplit(":", 1)[1])
     scope = ctx.scope
@@ -174,33 +332,71 @@ def _listen_and_serv_lower(ctx, op_):
         if v.persistable and not v.name.startswith("__")
     ]
 
+    from .. import core as _core_mod
+    from .. import flags as _flags
+
+    sparse_tables = set(op_.attr("sparse_tables") or [])
+
     server = native.RpcServer(port, n_trainers, sync_mode)
     compiled = {}
     rng = jax.random.key(0)
+    monitor = HeartBeatMonitor(
+        server,
+        n_trainers,
+        threshold_s=float(_flags.get_flag("pserver_heartbeat_timeout_s", 120)),
+        interval_s=float(_flags.get_flag("pserver_heartbeat_interval_s", 10)),
+    )
+    monitor.start()
 
     def publish(names):
         for pname in names:
             v = scope.get(pname)
-            if v is not None:
+            if v is None:
+                continue
+            if pname in sparse_tables:
+                # row-sharded tables serve kPrefetch row reads, not full GETs
+                server.put_table(pname, np.asarray(v))
+            else:
                 server.put_param(pname, native.serialize_tensor(np.asarray(v)))
+
+    shard_idx = int(op_.attr("shard_idx", 0))
+
+    def drain_notifies():
+        while True:
+            d = server.pop_notify()
+            if d is None:
+                return
+            _save_shard(scope, served_params, d, sparse_tables, shard_idx)
+
+    def run_block(bidx):
+        cb = compiled.get(bidx)
+        if cb is None:
+            cb = _compile_optimize_block(program, bidx, place)
+            compiled[bidx] = cb
+        cb.run(scope, {}, rng, place)
+
+    def apply_grad(gname, bidx, merged):
+        if isinstance(merged, _core_mod.SelectedRows):
+            res = _apply_sparse_update(scope, program, bidx, gname, merged)
+            if res == "__dense_fallback__":
+                run_block(bidx)
+        else:
+            scope.set(gname, merged)
+            run_block(bidx)
 
     try:
         publish(served_params)
         if sync_mode:
             while True:
                 rc = server.wait_sends(timeout_ms)
+                drain_notifies()
                 if rc != 0:
                     break
                 for gname, (bidx, _pname) in grad_map.items():
                     merged = _merge_trainer_grads(server, gname, n_trainers)
                     if merged is None:
                         continue
-                    scope.set(gname, merged)
-                    cb = compiled.get(bidx)
-                    if cb is None:
-                        cb = _compile_optimize_block(program, bidx, place)
-                        compiled[bidx] = cb
-                    cb.run(scope, {}, rng, place)
+                    apply_grad(gname, bidx, merged)
                 publish(served_params)
                 server.begin_serve()
                 rc = server.end_step(timeout_ms)
@@ -209,6 +405,7 @@ def _listen_and_serv_lower(ctx, op_):
         else:
             while True:
                 item = server.pop_send(timeout_ms)
+                drain_notifies()
                 if item == "timeout" or item is None:
                     break
                 gname, _tid, payload = item
@@ -224,16 +421,16 @@ def _listen_and_serv_lower(ctx, op_):
                     continue
                 if gname not in grad_map:
                     continue
-                arr, _lod, _used = native.deserialize_tensor(payload)
-                scope.set(gname, arr)
                 bidx, pname = grad_map[gname]
-                cb = compiled.get(bidx)
-                if cb is None:
-                    cb = _compile_optimize_block(program, bidx, place)
-                    compiled[bidx] = cb
-                cb.run(scope, {}, rng, place)
+                if native.is_selected_rows_payload(payload):
+                    merged = native.deserialize_selected_rows(payload)
+                else:
+                    merged, _lod, _used = native.deserialize_tensor(payload)
+                apply_grad(gname, bidx, merged)
                 publish([pname] if pname else served_params)
+        drain_notifies()
     finally:
+        monitor.stop()
         server.shutdown()
 
 
@@ -242,3 +439,131 @@ register_op("recv", lower=_recv_lower, host=True)
 register_op("send_barrier", lower=_send_barrier_lower, host=True)
 register_op("fetch_barrier", lower=_fetch_barrier_lower, host=True)
 register_op("listen_and_serv", lower=_listen_and_serv_lower, host=True)
+
+
+# ---------------------------------------------------------------------------
+# sparse-table ops (OPS_AUDIT.md pserver trio)
+# ---------------------------------------------------------------------------
+def _prefetch_rows(table_name, eps, tid, ids, width, dtype):
+    """Gather table rows for global ids sharded id%n -> pserver, id//n ->
+    local row (reference: operators/distributed/parameter_prefetch.cc)."""
+    import time as _time
+
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    out = np.zeros((len(ids), width), dtype)
+    n_eps = len(eps)
+    for k, ep in enumerate(eps):
+        sel = np.nonzero(ids % n_eps == k)[0]
+        if sel.size == 0:
+            continue
+        local = ids[sel] // n_eps
+        client = get_client(ep, tid)
+        last_err = None
+        for _attempt in range(50):  # table may not be published yet
+            try:
+                raw = client.prefetch(table_name, local)
+                break
+            except ConnectionError as e:
+                last_err = e
+                _time.sleep(0.1)
+        else:
+            raise last_err
+        rows = np.frombuffer(raw, dtype).reshape(len(local), width)
+        out[sel] = rows
+    return out
+
+
+def _distributed_lookup_table_lower(ctx, op_):
+    """reference: distributed_ops/distributed_lookup_table_op.cc — remote
+    embedding lookup against row-sharded pserver tables."""
+    ids_name = op_.input("Ids")[0]
+    ids = np.asarray(ctx.scope.get(ids_name))
+    table_name = op_.attr("table_name") or op_.input("W")[0]
+    eps = op_.attr("endpoints") or []
+    tid = int(op_.attr("trainer_id", 0))
+    width = int(op_.attr("table_width"))
+    dtype = np.dtype(op_.attr("table_dtype", "float32"))
+    lead_shape = ids.shape
+    if lead_shape and lead_shape[-1] == 1:
+        lead_shape = lead_shape[:-1]
+    rows = _prefetch_rows(
+        table_name, eps, tid, ids, width, dtype
+    )
+    out = rows.reshape(tuple(lead_shape) + (width,))
+    pad = int(op_.attr("padding_idx", -1))
+    if pad >= 0:
+        mask = ids.reshape(lead_shape) != pad
+        out = out * mask[..., None].astype(out.dtype)
+    ctx.scope.set(op_.output("Outputs" if op_.output("Outputs") else "Out")[0], out)
+
+
+def _prefetch_op_lower(ctx, op_):
+    """reference: distributed_ops/prefetch_op.cc — raw row fetch into a
+    scope var (rows for the ids in X)."""
+    ids = np.asarray(ctx.scope.get(op_.input("X")[0]))
+    table_name = op_.attr("table_name")
+    eps = op_.attr("endpoints") or op_.attr("epmap") or []
+    tid = int(op_.attr("trainer_id", 0))
+    width = int(op_.attr("table_width"))
+    dtype = np.dtype(op_.attr("table_dtype", "float32"))
+    out = _prefetch_rows(table_name, eps, tid, ids, width, dtype)
+    ctx.scope.set(op_.output("Out")[0], out)
+
+
+def _lookup_table_grad_sparse_lower(ctx, op_):
+    """Sparse gradient of a (remote) embedding: SelectedRows(rows=ids,
+    values=dOut) — the reference's lookup_table_grad SelectedRows branch
+    (lookup_table_op.cc grad kernel, is_sparse=True)."""
+    from .. import core as _core
+
+    ids = np.asarray(ctx.scope.get(op_.input("Ids")[0])).reshape(-1)
+    g = np.asarray(ctx.scope.get(op_.input("Out@GRAD")[0]))
+    height = int(op_.attr("table_height"))
+    width = g.shape[-1]
+    ctx.scope.set(
+        op_.output("W@GRAD")[0],
+        _core.SelectedRows(
+            rows=list(ids.astype(np.int64)),
+            height=height,
+            value=g.reshape(-1, width),
+        ),
+    )
+
+
+def _checkpoint_notify_lower(ctx, op_):
+    """reference: distributed_ops/checkpoint_notify_op.cc — ask every
+    pserver to save its shard into `dirname`."""
+    eps = op_.attr("endpoints") or op_.attr("epmap") or []
+    dirname = op_.attr("dirname") or op_.attr("dir") or ""
+    tid = int(op_.attr("trainer_id", 0))
+    for ep in eps:
+        get_client(ep, tid).checkpoint_notify(dirname)
+
+
+register_op(
+    "distributed_lookup_table",
+    lower=_distributed_lookup_table_lower,
+    host=True,
+)
+register_op("prefetch", lower=_prefetch_op_lower, host=True)
+register_op(
+    "lookup_table_grad_sparse",
+    lower=_lookup_table_grad_sparse_lower,
+    host=True,
+)
+register_op("checkpoint_notify", lower=_checkpoint_notify_lower, host=True)
+
+
+def _shard_table_rows_lower(ctx, op_):
+    """Pserver startup helper: replace a freshly full-initialized table with
+    this server's row shard (rows r with r %% n == k, local index r // n).
+    Initializing FULL-then-slice keeps the name-salted PRNG draws identical
+    to the single-process baseline, so dist training matches it exactly
+    (the reference distributes slices of the same initialized buffer)."""
+    x = np.asarray(ctx.scope.get(op_.input("X")[0]))
+    n = int(op_.attr("n_shards"))
+    k = int(op_.attr("shard_idx"))
+    ctx.scope.set(op_.output("Out")[0], np.ascontiguousarray(x[k::n]))
+
+
+register_op("shard_table_rows", lower=_shard_table_rows_lower, host=True)

@@ -137,16 +137,48 @@ class DistributeTranspiler(object):
         return self._transpile_collective(trainers, trainer_id)
 
     # -- pserver mode ------------------------------------------------------
+    def _find_sparse_tables(self):
+        """Tables used by ``lookup_table(..., is_sparse=True)`` whose grad is
+        in params_grads: these are row-sharded across ALL pservers and
+        trained via the remote-prefetch path (reference:
+        distributed_lookup_table_op.cc + parameter_prefetch.cc)."""
+        program = self.origin_program
+        grads = dict(getattr(program, "_params_grads", []))
+        tables = {}
+        for op_ in program.global_block().ops:
+            if op_.type not in ("lookup_table", "lookup_table_v2"):
+                continue
+            if not op_.attr("is_sparse", False):
+                continue
+            pname = op_.input("W")[0]
+            if pname not in grads:
+                continue
+            v = program.global_block()._find_var_recursive(pname)
+            tables[pname] = dict(
+                grad=grads[pname],
+                height=int(v.shape[0]),
+                width=int(v.shape[1]),
+                dtype=v.dtype,
+                padding_idx=int(op_.attr("padding_idx", -1)),
+            )
+        return tables
+
     def _build_pserver_artifacts(self):
         program = self.origin_program
         params_grads = getattr(program, "_params_grads", [])
         block = program.global_block()
+        self._origin_startup = self.startup_program.clone()
+        self.sparse_tables = self._find_sparse_tables()
         self.param_grad_ep_mapping = {
             ep: {"params": [], "grads": []} for ep in self.pserver_endpoints
         }
         # round-robin whole params across pservers (slicing handled by the
-        # param service itself; the wire format carries offsets)
-        for i, (pname, gname) in enumerate(params_grads):
+        # param service itself; the wire format carries offsets); sparse
+        # tables are excluded — every pserver owns a row shard of them
+        dense_pg = [
+            (p, g) for p, g in params_grads if p not in self.sparse_tables
+        ]
+        for i, (pname, gname) in enumerate(dense_pg):
             ep = self.pserver_endpoints[i % len(self.pserver_endpoints)]
             self.param_grad_ep_mapping[ep]["params"].append(
                 block._find_var_recursive(pname)
@@ -169,6 +201,58 @@ class DistributeTranspiler(object):
         for i in reversed(opt_idx):
             tblock._remove_op(i)
         all_eps = list(self.pserver_endpoints)
+        # sparse-table rewrite: lookup_table -> distributed_lookup_table
+        # (remote prefetch) and its grad -> SelectedRows producer; the table
+        # itself never lives on the trainer
+        for i, op_ in enumerate(list(tblock.ops)):
+            if (
+                op_.type in ("lookup_table", "lookup_table_v2")
+                and op_.input("W")
+                and op_.input("W")[0] in self.sparse_tables
+            ):
+                from .. import core as _core
+
+                pname = op_.input("W")[0]
+                info = self.sparse_tables[pname]
+                op_.type = "distributed_lookup_table"
+                op_.attrs.update(
+                    table_name=pname,
+                    endpoints=all_eps,
+                    trainer_id=self.trainer_id,
+                    table_width=info["width"],
+                    table_dtype=_core.dtype_name(info["dtype"]),
+                    padding_idx=info["padding_idx"],
+                )
+            elif (
+                op_.type in ("lookup_table_grad", "lookup_table_v2_grad")
+                and op_.input("W")
+                and op_.input("W")[0] in self.sparse_tables
+            ):
+                pname = op_.input("W")[0]
+                info = self.sparse_tables[pname]
+                ids = op_.input("Ids")[0]
+                out_g = op_.input("Out@GRAD")[0]
+                w_g = op_.output("W@GRAD")[0]
+                op_.type = "lookup_table_grad_sparse"
+                op_.inputs = {"Ids": [ids], "Out@GRAD": [out_g]}
+                op_.outputs = {"W@GRAD": [w_g]}
+                op_.attrs = {
+                    "table_height": info["height"],
+                    OP_ROLE_KEY: OpRole.Backward,
+                }
+        # one row-sharded send (to ALL pservers) per sparse-table grad
+        for pname, info in self.sparse_tables.items():
+            tblock.append_op(
+                type="send",
+                inputs={"X": [info["grad"]]},
+                outputs={},
+                attrs={
+                    "endpoints": all_eps,
+                    "sync_mode": self.sync_mode,
+                    "trainer_id": self.trainer_id,
+                    OP_ROLE_KEY: OpRole.RPC,
+                },
+            )
         for ep in all_eps:
             grads = [g.name for g in self.param_grad_ep_mapping[ep]["grads"] if g]
             if grads:
@@ -223,6 +307,15 @@ class DistributeTranspiler(object):
         # (reference: startup-program rewrite in transpile(); the server's
         # GET handler serves pre-step-0 reads immediately)
         sblock = self.startup_program.global_block()
+        # sparse tables never live on the trainer: drop their init ops
+        if self.sparse_tables:
+            drop = [
+                i
+                for i, op_ in enumerate(sblock.ops)
+                if any(n in self.sparse_tables for n in op_.output_arg_names)
+            ]
+            for i in reversed(drop):
+                sblock._remove_op(i)
         for ep in all_eps:
             params = [p.name for p in self.param_grad_ep_mapping[ep]["params"] if p]
             if params:
@@ -253,6 +346,8 @@ class DistributeTranspiler(object):
         pblock = pserver_program.global_block()
         mapping = self.param_grad_ep_mapping[endpoint]
         origin_block = self.origin_program.global_block()
+        shard_idx = self.pserver_endpoints.index(endpoint)
+        n_shards = len(self.pserver_endpoints)
         for p in mapping["params"]:
             if p is None:
                 continue
@@ -263,8 +358,20 @@ class DistributeTranspiler(object):
             if g is None:
                 continue
             pblock.create_var(name=g.name, shape=g.shape, dtype=g.dtype)
+        # sparse tables: every pserver owns the row shard r % n == shard_idx
+        for pname, info in getattr(self, "sparse_tables", {}).items():
+            local_rows = len(range(shard_idx, info["height"], n_shards))
+            pblock.create_var(
+                name=pname, shape=(local_rows, info["width"]),
+                dtype=info["dtype"], persistable=True,
+            )
+            pblock.create_var(
+                name=info["grad"], shape=(local_rows, info["width"]),
+                dtype=info["dtype"],
+            )
 
         owned = {p.name for p in mapping["params"] if p is not None}
+        owned |= set(getattr(self, "sparse_tables", {}))
         grad_of_param = dict(
             (p, g) for p, g in getattr(self.origin_program, "_params_grads", [])
         )
@@ -284,13 +391,32 @@ class DistributeTranspiler(object):
             pnames = op_.input("Param")
             if not (pnames and pnames[0] in owned):
                 continue
+            sp_info = getattr(self, "sparse_tables", {}).get(pnames[0])
             for slot in aux_slots:
                 for n in op_.input(slot):
                     if not pblock.has_var(n):
                         src = origin_block._find_var_recursive(n)
                         if src is not None:
+                            shape = src.shape
+                            if (
+                                sp_info is not None
+                                and tuple(shape)
+                                == (sp_info["height"], sp_info["width"])
+                            ):
+                                # table-shaped aux accumulator (Velocity,
+                                # Moment, ...) is row-sharded like the table
+                                shape = (
+                                    len(
+                                        range(
+                                            shard_idx,
+                                            sp_info["height"],
+                                            n_shards,
+                                        )
+                                    ),
+                                    sp_info["width"],
+                                )
                             pblock.create_var(
-                                name=n, shape=src.shape, dtype=src.dtype,
+                                name=n, shape=shape, dtype=src.dtype,
                                 persistable=src.persistable,
                             )
             sub = pserver_program._create_block(parent_idx=0)
@@ -316,6 +442,8 @@ class DistributeTranspiler(object):
                 "Fanin": self.trainer_num,
                 "sync_mode": self.sync_mode,
                 "grad_to_block_id": grad_to_block_id,
+                "sparse_tables": sorted(getattr(self, "sparse_tables", {})),
+                "shard_idx": shard_idx,
                 OP_ROLE_KEY: OpRole.RPC,
             },
         )
@@ -338,12 +466,17 @@ class DistributeTranspiler(object):
         # pserver then initializes exactly the values the trainers compute
         sp._seed = self.startup_program._seed
         block = sp.global_block()
-        origin_startup = self.startup_program.global_block()
+        origin_startup = getattr(
+            self, "_origin_startup", self.startup_program
+        ).global_block()
         owned = {
             v.name
             for v in pserver_program.global_block().vars.values()
             if v.persistable
         }
+        sparse = getattr(self, "sparse_tables", {})
+        shard_idx = self.pserver_endpoints.index(endpoint)
+        n_shards = len(self.pserver_endpoints)
         for op_ in origin_startup.ops:
             if op_.attr(OP_ROLE_KEY, 0) & OpRole.RPC:
                 continue  # trainer-side startup recv ops, not init ops
@@ -362,4 +495,20 @@ class DistributeTranspiler(object):
                     outputs={k: list(v) for k, v in op_.outputs.items()},
                     attrs=dict(op_.attrs),
                 )
+                pvar = pserver_program.global_block().vars.get(outs[0])
+                src0 = origin_startup._find_var_recursive(outs[0])
+                if (
+                    pvar is not None
+                    and src0 is not None
+                    and tuple(pvar.shape) != tuple(src0.shape)
+                ):
+                    # row-sharded var (sparse table or its table-shaped
+                    # optimizer accumulator): full init (name-salted PRNG ==
+                    # baseline values), then keep this server's row shard
+                    block.append_op(
+                        type="shard_table_rows",
+                        inputs={"X": [outs[0]]},
+                        outputs={"Out": [outs[0]]},
+                        attrs={"n_shards": n_shards, "shard_idx": shard_idx},
+                    )
         return sp

@@ -30,25 +30,49 @@ FEATURES = 20
 CLASSES = 10
 
 
+VOCAB = 50
+EMB_D = 16
+SPARSE = os.environ.get("DIST_SPARSE") == "1"
+
+
 def build():
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = SEED
     startup.random_seed = SEED
     with fluid.program_guard(main, startup):
-        x = fluid.layers.data(name="x", shape=[FEATURES], dtype="float32")
-        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
-        h = fluid.layers.fc(input=x, size=64, act="relu")
+        if SPARSE:
+            # giant-embedding CTR shape: ids -> embedding(is_sparse=True)
+            # -> fc; the table is row-sharded across pservers and trained
+            # via SelectedRows grads (VERDICT r2 item 5)
+            ids = fluid.layers.data(name="x", shape=[1], dtype="int64")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(
+                input=ids, size=[VOCAB, EMB_D], is_sparse=True,
+                param_attr="emb_table",
+            )
+            h = fluid.layers.fc(input=emb, size=32, act="relu")
+        else:
+            x = fluid.layers.data(name="x", shape=[FEATURES], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(input=x, size=64, act="relu")
         logits = fluid.layers.fc(input=h, size=CLASSES)
         loss = fluid.layers.mean(
             fluid.layers.softmax_with_cross_entropy(logits, y)
         )
-        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if os.environ.get("DIST_OPT") == "momentum":
+            opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        else:
+            opt = fluid.optimizer.SGD(learning_rate=0.1)
         opt.minimize(loss, startup_program=startup)
     return main, startup, loss
 
 
 def batch_for(step):
     rs = np.random.RandomState(1234 + step)
+    if SPARSE:
+        x = rs.randint(0, VOCAB, (BATCH, 1)).astype("int64")
+        y = (x % CLASSES).astype("int64")  # learnable mapping
+        return x, y
     x = rs.rand(BATCH, FEATURES).astype("float32")
     y = rs.randint(0, CLASSES, (BATCH, 1)).astype("int64")
     return x, y
@@ -165,6 +189,7 @@ def run_dist():
             comm = Communicator(program=trainer_prog, trainer_id=tid)
             comm.start()
     per = BATCH // trainers
+    die_after = int(os.environ.get("DIST_DIE_AFTER_STEP", "-1"))
     losses = []
     for s in range(STEPS):
         x, y = batch_for(s)
@@ -175,8 +200,25 @@ def run_dist():
         losses.append(float(np.asarray(l).ravel()[0]))
         if comm_mode == "geo":
             comm.on_step()
+        if die_after >= 0 and s >= die_after:
+            # abrupt worker death: no COMPLETE, no barriers — the pserver's
+            # HeartBeatMonitor must flag the lost worker and survive
+            print("LOSSES " + json.dumps(losses), flush=True)
+            os._exit(0)
     if comm is not None:
         comm.stop()
+    ckpt_dir = os.environ.get("DIST_CKPT_DIR")
+    if ckpt_dir and tid == 0:
+        # checkpoint-on-demand: every pserver saves its shard into ckpt_dir
+        notify_prog = fluid.Program()
+        notify_prog.global_block().append_op(
+            type="checkpoint_notify",
+            inputs={},
+            outputs={},
+            attrs={"endpoints": eps.split(","), "dirname": ckpt_dir,
+                   "trainer_id": tid},
+        )
+        exe.run(notify_prog)
     exe.close()  # sends COMPLETE to pservers
     print("LOSSES " + json.dumps(losses), flush=True)
 
