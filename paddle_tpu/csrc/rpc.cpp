@@ -167,8 +167,12 @@ struct RpcServer {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     Request req;
+    uint32_t t = 0;
     while (read_request(fd, &req)) {
-      uint32_t t = req.trainer_id < (uint32_t)n_trainers ? req.trainer_id : 0;
+      // an out-of-range trainer_id must NOT alias trainer 0 (it would both
+      // beat 0's heartbeat and corrupt barrier accounting) — drop the conn
+      if (req.trainer_id >= (uint32_t)n_trainers) goto done;
+      t = req.trainer_id;
       {
         std::lock_guard<std::mutex> lk(mu);
         last_active_ms[t] = steady_ms();
@@ -482,13 +486,16 @@ int pt_rpc_server_pop_send(void* h, char* name_out, int name_cap,
 }
 
 // Register/refresh a sparse table served by kPrefetch. data is the raw
-// row-major value buffer; row_bytes the stride of one row.
+// row-major value buffer; row_bytes the stride of one row. The O(table)
+// copy happens OUTSIDE the server mutex (a giant table under the global
+// lock would stall every request handler); only the swap is locked.
 void pt_rpc_server_put_table(void* h, const char* name, const uint8_t* data,
                              uint64_t len, uint64_t row_bytes) {
   auto* s = static_cast<RpcServer*>(h);
+  std::vector<uint8_t> staged(data, data + len);
   std::lock_guard<std::mutex> lk(s->mu);
   auto& t = s->table_store[name];
-  t.data.assign(data, data + len);
+  t.data.swap(staged);
   t.row_bytes = row_bytes;
 }
 

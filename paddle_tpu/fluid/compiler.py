@@ -137,7 +137,11 @@ class CompiledProgram(object):
 
         if self._places:
             return len(self._places)
-        return max(jax.local_device_count(), 1)
+        # GLOBAL device count: under jax.distributed (launch.py multi-proc)
+        # the data mesh spans every process's devices so grad psums cross
+        # the process boundary (reference: nranks = num_trainers x ndev,
+        # parallel_executor.cc:407)
+        return max(jax.device_count(), 1)
 
     def _get_mesh(self):
         if self._mesh is None:
@@ -257,6 +261,9 @@ class CompiledProgram(object):
             executor._cache[key] = compiled
         rng_key = executor._next_rng(self._program)
         outs = compiled.run(scope, feed, rng_key, executor.place)
+        from .executor import _fetch_to_host
+
+        outs = [None if o is None else _fetch_to_host(o) for o in outs]
         if return_numpy:
             return [None if o is None else np.asarray(o) for o in outs]
         return [

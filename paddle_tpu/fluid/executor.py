@@ -649,8 +649,9 @@ class _CompiledBlock(object):
             seg_companion_writes.append(writes_here)
         # availability is cumulative in program order: a segment may only
         # read companions from the feed or from EARLIER segments (a later
-        # write to the same base name must not create a phantom input)
-        companion_avail = {n for n in feed_set if n.endswith("@SEQ_LEN")}
+        # write to the same base name must not create a phantom input);
+        # multi-level feeds add `@SEQ_LEN@L{k}` outer-level companions
+        companion_avail = {n for n in feed_set if "@SEQ_LEN" in n}
 
         for i, seg in enumerate(self.segments):
             companion_avail |= set(seg_companion_writes[i])
@@ -662,12 +663,13 @@ class _CompiledBlock(object):
             # segments (local_env at run time), or from the scope
             ext_reads = list(seg.reads)
             local_companions = set(seg_companion_writes[i])
-            ext_reads += [
-                n + "@SEQ_LEN"
-                for n in seg.reads
-                if n + "@SEQ_LEN" in companion_avail
-                and n + "@SEQ_LEN" not in local_companions
-            ]
+            for n in seg.reads:
+                prefix = n + "@SEQ_LEN"
+                ext_reads += [
+                    c
+                    for c in companion_avail
+                    if c.startswith(prefix) and c not in local_companions
+                ]
             feeds = [n for n in ext_reads if n in feed_set]
             state_reads = [n for n in ext_reads if n not in feed_set]
             writes = set(seg.writes)
@@ -981,11 +983,37 @@ def _to_device(val, device):
 
     if isinstance(val, core.LoDTensor):
         val = val.numpy()
+    from jax.sharding import Sharding
+
+    if isinstance(device, Sharding) and not device.is_fully_addressable:
+        # multi-process mesh (launch.py -> jax.distributed.initialize):
+        # this process contributes its LOCAL block of the global array —
+        # feeds are per-trainer batch shards, replicated state is the same
+        # value everywhere (reference: each trainer feeds its own data
+        # shard; params broadcast, parallel_executor.cc:634)
+        if isinstance(val, jax.Array) and not val.is_fully_addressable:
+            return jax.device_put(val, device)  # already global: reshard
+        return jax.make_array_from_process_local_data(
+            device, np.asarray(val)
+        )
     if isinstance(val, jax.Array):
         # no-op when placement already matches; reshards otherwise (a
         # committed single-device array fed to a mesh-sharded computation)
         return jax.device_put(val, device)
     return jax.device_put(np.asarray(val), device)
+
+
+def _fetch_to_host(v):
+    """Fetch-side conversion: a multi-process global array materializes on
+    every host via allgather (the reference's FetchOpHandle merges
+    per-device copies; allgather is its DCN-spanning equivalent)."""
+    import jax
+
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils as mhu
+
+        return np.asarray(mhu.process_allgather(v, tiled=True))
+    return v
 
 
 # ---------------------------------------------------------------------------
@@ -1049,13 +1077,20 @@ class Executor(object):
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
         feed = {k: _feed_value(v, feed, k) for k, v in feed.items()}
-        # LoD feeds contribute companion length entries for sequence ops
+        # LoD feeds contribute companion length entries for sequence ops.
+        # The FULL offset stack survives (reference lod_tensor.h:52
+        # LoD = vector<Vector<size_t>>): the innermost level rides
+        # `{name}@SEQ_LEN`; outer level k rides `{name}@SEQ_LEN@L{k}`.
         extra = {}
         for k, v in list(feed.items()):
             if isinstance(v, core.LoDTensor):
                 lens = v.recursive_sequence_lengths()
                 if lens:
                     extra[k + "@SEQ_LEN"] = np.asarray(lens[-1], np.int32)
+                    for lv_i, lv in enumerate(lens[:-1]):
+                        extra[k + "@SEQ_LEN@L%d" % lv_i] = np.asarray(
+                            lv, np.int32
+                        )
                 feed[k] = v.numpy()
         feed.update(extra)
 
@@ -1077,6 +1112,7 @@ class Executor(object):
 
         rng_key = self._next_rng(program)
         outs = compiled.run(scope, feed, rng_key, self.place)
+        outs = [None if o is None else _fetch_to_host(o) for o in outs]
         if return_numpy:
             return [None if o is None else np.asarray(o) for o in outs]
         return [

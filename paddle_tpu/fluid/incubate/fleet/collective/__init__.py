@@ -111,14 +111,19 @@ class CollectiveOptimizer(DistributedOptimizer):
                          k_steps=strategy.local_sgd_k_steps)
         else:
             t = GradAllReduce(nrings=strategy.nccl_comm_num)
+        import jax
+
         t.transpile(
             startup_program=startup_program,
             main_program=main_program,
             rank=trainer_id,
             endpoints=worker_endpoints or [current_endpoint],
             current_endpoint=current_endpoint,
+            # total data shards = every process's devices (the reference's
+            # nranks = num_trainers x ndev, parallel_executor.cc:407)
+            nranks=jax.device_count(),
         )
-        main_program._grad_allreduce_applied = True
+        main_program._grad_allreduce_applied = jax.device_count()
         fleet.main_program = main_program
         fleet.startup_program = startup_program
         return optimize_ops, params_grads

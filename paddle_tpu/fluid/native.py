@@ -478,8 +478,8 @@ class RpcServer(object):
 
     def put_table(self, name, arr):
         """Serve ``arr``'s rows to kPrefetch requests (sparse lookup).
-        Zero Python-side copies: the C++ side assigns straight from the
-        array's buffer (held alive across the call by `arr`)."""
+        One copy total: C++ stages from the array's buffer outside the
+        server lock, then swaps it in (`arr` keeps the buffer alive)."""
         arr = np.ascontiguousarray(arr)
         row_bytes = arr.strides[0] if arr.ndim > 0 else arr.itemsize
         ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
@@ -545,6 +545,10 @@ class RpcClient(object):
         )
         self._retry_times = int(_flags.get_flag("rpc_retry_times", 3))
         self._host, self._port = host, int(port)
+        # serializes every call AND reconnection on this shared client
+        # (clients are cached per (endpoint, trainer_id) and used from the
+        # communicator's background threads concurrently)
+        self._call_lock = threading.Lock()
         self._h = lib.pt_rpc_connect(
             host.encode(), int(port), self._deadline_ms
         )
@@ -569,16 +573,18 @@ class RpcClient(object):
 
     def _with_retry(self, fn, what):
         """FLAGS_rpc_retry_times semantics: a deadline/io failure (-1)
-        reconnects and retries; other statuses surface immediately."""
+        reconnects (which also resyncs the request/response stream) and
+        retries; other statuses surface immediately."""
         last_rc = -1
-        for attempt in range(self._retry_times + 1):
-            if not self._h and not self._reconnect():
-                continue
-            rc = fn()
-            if rc != -1:
-                return rc
-            last_rc = rc
-            self._reconnect()
+        with self._call_lock:
+            for attempt in range(self._retry_times + 1):
+                if not self._h and not self._reconnect():
+                    continue
+                rc = fn()
+                if rc != -1:
+                    return rc
+                last_rc = rc
+                self._reconnect()
         raise ConnectionError(
             "%s failed after %d retries (rpc_deadline=%dms) -> rc %d"
             % (what, self._retry_times, self._deadline_ms, last_rc)
@@ -653,18 +659,34 @@ class RpcClient(object):
             raise ConnectionError("checkpoint_notify -> rc %d" % rc)
 
     def send_barrier(self):
-        self._lib.pt_rpc_send_barrier(self._h, self.trainer_id)
+        rc = self._with_retry(
+            lambda: self._lib.pt_rpc_send_barrier(self._h, self.trainer_id),
+            "send_barrier",
+        )
+        if rc != 0:
+            raise ConnectionError("send_barrier -> rc %d" % rc)
 
     def fetch_barrier(self):
-        self._lib.pt_rpc_fetch_barrier(self._h, self.trainer_id)
+        rc = self._with_retry(
+            lambda: self._lib.pt_rpc_fetch_barrier(self._h, self.trainer_id),
+            "fetch_barrier",
+        )
+        if rc != 0:
+            raise ConnectionError("fetch_barrier -> rc %d" % rc)
 
     def complete(self):
-        self._lib.pt_rpc_complete(self._h, self.trainer_id)
+        rc = self._with_retry(
+            lambda: self._lib.pt_rpc_complete(self._h, self.trainer_id),
+            "complete",
+        )
+        if rc != 0:
+            raise ConnectionError("complete -> rc %d" % rc)
 
     def close(self):
-        if self._h:
-            self._lib.pt_rpc_close(self._h)
-            self._h = None
+        with self._call_lock:
+            if self._h:
+                self._lib.pt_rpc_close(self._h)
+                self._h = None
 
     def __del__(self):
         try:

@@ -285,8 +285,14 @@ def _save_shard(scope, names, dirname, sparse_tables=(), shard_idx=0):
         fname = n
         if n in sparse_tables:
             fname = "%s.block%d" % (n, shard_idx)
-        with open(os.path.join(dirname, fname), "wb") as f:
+        # atomic write: replicated persistables (lr, aux vars) exist on
+        # every pserver and get written to the SAME path concurrently;
+        # tmp+rename keeps the last writer's bytes intact
+        path = os.path.join(dirname, fname)
+        tmp = "%s.tmp.%d.%d" % (path, shard_idx, os.getpid())
+        with open(tmp, "wb") as f:
             f.write(data)
+        os.replace(tmp, path)
 
 
 def _listen_and_serv_lower(ctx, op_):
@@ -519,14 +525,19 @@ def _lookup_table_grad_sparse_lower(ctx, op_):
     ids = np.asarray(ctx.scope.get(op_.input("Ids")[0])).reshape(-1)
     g = np.asarray(ctx.scope.get(op_.input("Out@GRAD")[0]))
     height = int(op_.attr("table_height"))
+    pad = int(op_.attr("padding_idx", -1))
     width = g.shape[-1]
+    ids = ids.astype(np.int64)
+    vals = g.reshape(-1, width)
+    if pad >= 0:
+        # padding rows are masked in the forward; their grad must not train
+        # the table (matches the local baseline's grad-through-mask zeros)
+        keep = ids != pad
+        ids = ids[keep]
+        vals = vals[keep]
     ctx.scope.set(
         op_.output("W@GRAD")[0],
-        _core.SelectedRows(
-            rows=list(ids.astype(np.int64)),
-            height=height,
-            value=g.reshape(-1, width),
-        ),
+        _core.SelectedRows(rows=list(ids), height=height, value=vals),
     )
 
 

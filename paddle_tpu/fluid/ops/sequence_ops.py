@@ -95,6 +95,29 @@ def _lengths(ctx, op_, slot="X"):
     return lengths_for(ctx, names[0])
 
 
+def lod_level_count(ctx, name):
+    """Number of LoD levels carried by ``name``'s companions (reference
+    lod_tensor.h:52 — a full offset stack; here outer level k rides
+    `{name}@SEQ_LEN@L{k}`, the innermost rides `{name}@SEQ_LEN`)."""
+    n = 0
+    while ctx.get_opt(name + "@SEQ_LEN@L%d" % n) is not None:
+        n += 1
+    return n + (1 if lengths_for(ctx, name) is not None else 0)
+
+
+def lengths_level(ctx, name, level):
+    """Length vector of LoD level ``level`` (reference numbering: 0 =
+    outermost, last = innermost; -1 = innermost)."""
+    n_levels = lod_level_count(ctx, name)
+    if n_levels == 0:
+        return None
+    if level < 0:
+        level += n_levels
+    if level == n_levels - 1:
+        return lengths_for(ctx, name)
+    return ctx.get_opt(name + "@SEQ_LEN@L%d" % level)
+
+
 def _lengths_or_full(ctx, op_, x, slot="X"):
     """Companion lengths, defaulting to the full padded time dim."""
     import jax.numpy as jnp
@@ -169,11 +192,37 @@ def _sequence_softmax(ctx, op_):
 
 @op("sequence_expand", grad="generic")
 def _sequence_expand(ctx, op_):
-    # padded representation: broadcast along time of Y
+    """reference: sequence_ops/sequence_expand_op.cc — repeat each X entry
+    by the matching Y lod[ref_level] length. On the padded representation
+    the output instance count equals Y's (static) instance count, so the
+    data-dependent expansion becomes a static-shape gather: out[j] =
+    x[group(j)], group(j) = searchsorted(cumsum(ref_lens), j, 'right')."""
     import jax.numpy as jnp
 
     x = ctx.in1(op_, "X")
     y = ctx.in1(op_, "Y")
+    ref_level = int(op_.attr("ref_level", -1))
+    ynames = op_.inputs.get("Y") or []
+    multi = ynames and lod_level_count(ctx, ynames[0]) >= 2
+    ref_lens = (
+        lengths_level(ctx, ynames[0], ref_level) if multi else None
+    )
+    if ref_lens is not None and x.shape[0] == ref_lens.shape[0]:
+        # level-aware expansion over the instance axis
+        cum = jnp.cumsum(ref_lens)
+        grp = jnp.searchsorted(cum, jnp.arange(y.shape[0]), side="right")
+        out = x[jnp.clip(grp, 0, x.shape[0] - 1)]
+        valid = jnp.arange(y.shape[0]) < cum[-1]
+        out = jnp.where(
+            valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0
+        )
+        ctx.out(op_, "Out", out)
+        inner = _lengths(ctx, op_, "Y")
+        names = op_.outputs.get("Out") or []
+        if inner is not None and names:
+            ctx.set(names[0] + "@SEQ_LEN", inner)
+        return
+    # legacy single-level form: broadcast along time of Y
     if x.ndim < y.ndim:
         x = x[:, None]
     reps = [1] * x.ndim

@@ -33,7 +33,13 @@ class Collective(object):
         endpoints,
         current_endpoint,
         wait_port=True,
+        nranks=None,
     ):
+        """``nranks`` defaults to len(endpoints) (reference semantics: one
+        rank per process-device). Under the SPMD executor one process drives
+        MANY mesh shards, so callers pass the global shard count
+        (jax.device_count()) — the reference's nranks = num_trainers x ndev
+        (parallel_executor.cc:407)."""
         self.startup_program = startup_program
         self.main_program = main_program
         self.rank = rank
@@ -41,7 +47,7 @@ class Collective(object):
             endpoints = endpoints.split(",")
         self.endpoints = endpoints
         self.current_endpoint = current_endpoint
-        self.nranks = len(endpoints)
+        self.nranks = int(nranks) if nranks else len(endpoints)
         self._transpile_startup_program()
         self._transpile_main_program()
 

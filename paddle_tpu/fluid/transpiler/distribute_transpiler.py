@@ -238,6 +238,7 @@ class DistributeTranspiler(object):
                 op_.outputs = {"W@GRAD": [w_g]}
                 op_.attrs = {
                     "table_height": info["height"],
+                    "padding_idx": info["padding_idx"],
                     OP_ROLE_KEY: OpRole.Backward,
                 }
         # one row-sharded send (to ALL pservers) per sparse-table grad

@@ -1,0 +1,62 @@
+"""Multi-process data-parallel test (VERDICT r2 item 7): 2 OS processes x
+4 virtual CPU devices through distributed/launch.py ->
+jax.distributed.initialize -> fleet CollectiveOptimizer, compared against
+the identical model on a single-process 8-device mesh. This is the only
+pre-hardware validation the launch.py env contract can get (reference
+methodology: test_collective_base.py:140)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+RUNNER = os.path.join(HERE, "mp_dp_runner.py")
+
+
+def _parse(path_or_text, from_file=True):
+    text = open(path_or_text).read() if from_file else path_or_text
+    for line in text.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):])
+    raise AssertionError("no LOSSES line:\n" + text)
+
+
+def test_launch_two_process_dp_matches_single_process(tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    # single-process 8-device baseline
+    base_env = dict(env)
+    base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    base_env["PADDLE_TRAINERS_NUM"] = "1"
+    base_env["PADDLE_TRAINER_ID"] = "0"
+    p = subprocess.run(
+        [sys.executable, RUNNER], env=base_env, capture_output=True,
+        text=True, timeout=300, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    local = _parse(p.stdout, from_file=False)
+
+    # 2 processes x 4 devices via the real launcher
+    log_dir = str(tmp_path / "logs")
+    p = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2", "--started_port", "7160",
+            "--log_dir", log_dir, RUNNER,
+        ],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    losses = [
+        _parse(os.path.join(log_dir, "workerlog.%d" % i)) for i in range(2)
+    ]
+    # every process computes the same global mean loss (psum'd grads +
+    # allgathered fetch), and it matches the single-process mesh exactly
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    np.testing.assert_allclose(losses[0], local, rtol=1e-4, atol=1e-5)
