@@ -42,6 +42,8 @@ from . import unique_name
 from .executor import Executor, global_scope, scope_guard
 from .compiler import CompiledProgram, ExecutionStrategy, BuildStrategy
 from .parallel_executor import ParallelExecutor
+from . import ir
+from .ir import IrGraph, Pass, PassBuilder
 from .data_feeder import DataFeeder
 from . import io
 from .io import (

@@ -75,6 +75,19 @@ class BuildStrategy(object):
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False
         self.hierarchical_allreduce_inter_nranks = 0
+        self._pass_builder = None
+
+    def _finalize_strategy_and_create_passes(self):
+        """reference: pybind.cc BuildStrategy binding — returns the pass
+        builder so scripts can inject custom passes; strategy toggles that
+        map to real passes are materialized here (the rest are XLA's job)."""
+        from .ir import PassBuilder
+
+        if self._pass_builder is None:
+            self._pass_builder = PassBuilder()
+            if self.fuse_elewise_add_act_ops:
+                self._pass_builder.append_pass("fuse_elewise_add_act_pass")
+        return self._pass_builder
 
 
 class CompiledProgram(object):
@@ -204,6 +217,12 @@ class CompiledProgram(object):
              return_numpy=True):
         from . import executor as _executor_mod
 
+        # user-injected pass pipeline (BuildStrategy pass builder,
+        # pybind.cc:1547 parity) rewrites the program once, pre-compile
+        pb = getattr(self._build_strategy, "_pass_builder", None)
+        if pb is not None and not getattr(self, "_passes_applied", False):
+            pb.apply(self._program)
+            self._passes_applied = True
         scope = scope or core.global_scope()
         feed = dict(feed or {})
         fetch_list = fetch_list or []

@@ -1,5 +1,12 @@
-"""Slim: quantization-aware training, post-training quantization, pruning
-(reference: python/paddle/fluid/contrib/slim/)."""
+"""Slim: quantization-aware training, post-training quantization, pruning,
+distillation, NAS (reference: python/paddle/fluid/contrib/slim/)."""
 
 from . import quantization  # noqa: F401
 from .prune import prune_by_ratio, sensitivity  # noqa: F401
+from .distillation import (  # noqa: F401
+    FSPDistiller,
+    L2Distiller,
+    SoftLabelDistiller,
+    merge_programs,
+)
+from .nas import LightNAS, SAController, SearchSpace  # noqa: F401

@@ -1,0 +1,180 @@
+"""Knowledge distillation (reference: contrib/slim/distillation/distiller.py
+— L2Distiller :25, FSPDistiller :103, SoftLabelDistiller :195, each applied
+by a *Pass over the reference's GraphWrapper).
+
+TPU-native redesign: no IrGraph wrapper — the teacher program's ops/vars are
+merged into the student Program directly (teacher params renamed under a
+``teacher_`` scope prefix, feed vars shared), then the distiller appends
+its loss ops so the whole student+teacher+loss graph compiles as ONE XLA
+program. Teacher params are marked stop_gradient so XLA drops their
+backward graph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework import Parameter, Program
+
+TEACHER_PREFIX = "teacher_"
+
+
+def merge_programs(student, teacher, feed_names, prefix=TEACHER_PREFIX):
+    """Clone teacher ops/vars into the student program's global block.
+
+    Teacher vars get ``prefix`` prepended (reference merge semantics);
+    vars named in ``feed_names`` are shared with the student. Returns the
+    {teacher_var_name -> merged_name} map.
+    """
+    sblock = student.global_block()
+    tblock = teacher.global_block()
+    rename = {}
+    for name, v in tblock.vars.items():
+        if name in feed_names:
+            rename[name] = name
+            continue
+        new_name = prefix + name
+        rename[name] = new_name
+        if sblock.has_var(new_name):
+            continue
+        if isinstance(v, Parameter):
+            p = Parameter(
+                sblock,
+                list(v.shape),
+                v.dtype,
+                name=new_name,
+                trainable=False,  # teacher is frozen
+                persistable=True,
+            )
+            p.stop_gradient = True
+            sblock.vars[new_name] = p
+        else:
+            nv = sblock.create_var(
+                name=new_name, shape=v.shape, dtype=v.dtype,
+                persistable=v.persistable,
+            )
+            nv.stop_gradient = True
+    for op_ in tblock.ops:
+        sblock.append_op(
+            type=op_.type,
+            inputs={
+                k: [rename.get(n, n) for n in ns]
+                for k, ns in op_.inputs.items()
+            },
+            outputs={
+                k: [rename.get(n, n) for n in ns]
+                for k, ns in op_.outputs.items()
+            },
+            attrs=dict(op_.attrs),
+        )
+    return rename
+
+
+class L2Distiller(object):
+    """L2 feature-map matching (reference: distiller.py:25)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from ... import layers
+
+        block = program.global_block()
+        s = block.var(self.student_feature_map)
+        t = block.var(self.teacher_feature_map)
+        from ...framework import program_guard
+
+        with program_guard(program):
+            diff = layers.elementwise_sub(s, t)
+            loss = layers.reduce_mean(layers.square(diff))
+            out = layers.scale(loss, scale=float(self.distillation_loss_weight))
+        out.stop_gradient = False
+        return out
+
+
+class FSPDistiller(object):
+    """Flow-of-solution-procedure matching (reference: distiller.py:103):
+    for each (layer_a, layer_b) pair the FSP matrix einsum('nihw,njhw')/HW
+    of student and teacher are L2-matched — rides the new fsp op."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def _fsp(self, program, a, b):
+        block = program.global_block()
+        va, vb = block.var(a), block.var(b)
+        out = block.create_var(
+            name="%s_%s_fsp" % (a, b), dtype=va.dtype,
+            shape=[-1, va.shape[1], vb.shape[1]],
+        )
+        block.append_op(
+            type="fsp", inputs={"X": [va.name], "Y": [vb.name]},
+            outputs={"Out": [out.name]},
+        )
+        return out
+
+    def distiller_loss(self, program):
+        from ... import layers
+        from ...framework import program_guard
+
+        with program_guard(program):
+            losses = []
+            for (sa, sb), (ta, tb) in zip(
+                self.student_pairs, self.teacher_pairs
+            ):
+                sm = self._fsp(program, sa, sb)
+                tm = self._fsp(program, ta, tb)
+                diff = layers.elementwise_sub(sm, tm)
+                losses.append(layers.reduce_mean(layers.square(diff)))
+            total = losses[0]
+            for l in losses[1:]:
+                total = layers.elementwise_add(total, l)
+            out = layers.scale(
+                total, scale=float(self.distillation_loss_weight)
+            )
+        return out
+
+
+class SoftLabelDistiller(object):
+    """Softened-logit cross entropy (reference: distiller.py:195):
+    loss = CE(softmax(student/T_s), softmax(teacher/T_t))."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from ... import layers
+        from ...framework import program_guard
+
+        block = program.global_block()
+        s = block.var(self.student_feature_map)
+        t = block.var(self.teacher_feature_map)
+        with program_guard(program):
+            s_soft = layers.softmax(
+                layers.scale(s, scale=1.0 / self.student_temperature)
+            )
+            t_soft = layers.softmax(
+                layers.scale(t, scale=1.0 / self.teacher_temperature)
+            )
+            t_soft.stop_gradient = True
+            ce = layers.cross_entropy(s_soft, t_soft, soft_label=True)
+            out = layers.scale(
+                layers.reduce_mean(ce),
+                scale=float(self.distillation_loss_weight),
+            )
+        return out
+
+
+_ = (np, Program)

@@ -1,0 +1,148 @@
+"""Neural architecture search (reference: contrib/slim/nas/ — SearchSpace
+search_space.py:19, LightNASStrategy light_nas_strategy.py:34 — driven by
+the simulated-annealing controller searcher/controller.py:59 SAController
+behind a socket ControllerServer).
+
+TPU-native redesign: the controller runs in-process (no socket server —
+the reference's controller_server.py exists to share one controller across
+data-parallel trainers; under SPMD one process drives the search), and
+candidate evaluation compiles each architecture as its own XLA program.
+The SAController's annealing-acceptance semantics are kept exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+
+class SearchSpace(object):
+    """User-implemented architecture space (reference: search_space.py:19)."""
+
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError()
+
+    def range_table(self):
+        """list<int>: token i ranges over [0, range_table()[i])."""
+        raise NotImplementedError()
+
+    def create_net(self, tokens):
+        """tokens -> (train_program, eval_program, startup_program,
+        train_fetch_list, eval_fetch_list)."""
+        raise NotImplementedError()
+
+    def get_model_latency(self, program):
+        """Optional latency estimate used as a search constraint."""
+        raise NotImplementedError()
+
+
+class EvolutionaryController(object):
+    def update(self, tokens, reward):
+        raise NotImplementedError()
+
+    def next_tokens(self):
+        raise NotImplementedError()
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing (reference: controller.py:59 — accept better
+    rewards always, worse ones with exp((r - r_prev)/T), T decaying by
+    reduce_rate per iteration; one random token mutated per proposal)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -1
+        self._tokens = None
+        self._max_reward = -1
+        self._best_tokens = None
+        self._iter = 0
+        self._constrain_func = None
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * self._reduce_rate ** self._iter
+        if (reward > self._reward) or (
+            self._rng.random_sample()
+            <= math.exp(
+                min((reward - self._reward) / max(temperature, 1e-10), 0.0)
+            )
+        ):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+        _logger.info(
+            "iter %d: max_reward=%s best_tokens=%s", self._iter,
+            self._max_reward, self._best_tokens,
+        )
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token) if control_token else list(self._tokens)
+        new_tokens = self._mutate(tokens)
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(new_tokens):
+                return new_tokens
+            new_tokens = self._mutate(tokens)
+        return new_tokens
+
+    def _mutate(self, tokens):
+        new_tokens = list(tokens)
+        index = int(len(self._range_table) * self._rng.random_sample())
+        span = max(self._range_table[index] - 1, 1)
+        new_tokens[index] = (
+            new_tokens[index] + self._rng.randint(span) + 1
+        ) % self._range_table[index]
+        return new_tokens
+
+
+class LightNAS(object):
+    """The search driver (reference: light_nas_strategy.py:34, minus the
+    socket controller server): loop next_tokens -> create_net -> short
+    train -> eval reward -> controller.update."""
+
+    def __init__(self, search_space, controller=None, search_steps=10,
+                 train_fn=None):
+        """train_fn(train_program, eval_program, startup_program,
+        train_fetches, eval_fetches) -> float reward."""
+        self.space = search_space
+        self.controller = controller or SAController()
+        self.search_steps = search_steps
+        self.train_fn = train_fn
+
+    def search(self):
+        init = self.space.init_tokens()
+        self.controller.reset(self.space.range_table(), init)
+        tokens = list(init)
+        for _ in range(self.search_steps):
+            nets = self.space.create_net(tokens)
+            reward = float(self.train_fn(*nets))
+            self.controller.update(tokens, reward)
+            tokens = self.controller.next_tokens()
+        return self.controller.best_tokens, self.controller.max_reward

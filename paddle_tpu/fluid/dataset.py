@@ -192,13 +192,75 @@ class InMemoryDataset(DatasetBase):
         random.shuffle(self._samples)
 
     def global_shuffle(self, fleet=None, thread_num=12):
-        # same-seed permutation of file assignment on every worker; each
-        # worker keeps its rank's share (reference coordinates via fleet)
-        rng = random.Random(len(self.filelist))
-        rng.shuffle(self.filelist)
-        if self._loaded:
-            self.load_into_memory()
-        self.local_shuffle()
+        """Fleet-coordinated SAMPLE shuffle (reference: data_set.h:226
+        MultiSlotDataset::GlobalShuffle — every in-memory sample is re-routed
+        to a random worker, so the global sample multiset is re-partitioned,
+        not merely the file assignment).
+
+        Without a fleet (single worker) this degrades to local_shuffle.
+        With a fleet: all-to-all over the native RPC transport — each
+        worker hashes every sample to a destination worker (shared seed, so
+        all workers agree), pushes the per-destination batches to its
+        peers' shuffle servers, and keeps what lands on it."""
+        if fleet is None or fleet.worker_num() <= 1:
+            rng = random.Random(len(self.filelist))
+            rng.shuffle(self.filelist)
+            if self._loaded:
+                self.load_into_memory()
+            self.local_shuffle()
+            return
+        import pickle
+
+        from . import native
+
+        rank = fleet.worker_index()
+        n = fleet.worker_num()
+        endpoints = fleet.worker_endpoints()
+        seed = len(self.filelist) + 1013904223
+
+        def shuffle_endpoint(ep):
+            host, port = ep.rsplit(":", 1)
+            return host, int(port) + 1317  # shuffle-service port offset
+
+        _host, my_port = shuffle_endpoint(endpoints[rank])
+        server = native.RpcServer(my_port, n, sync_mode=False)
+        try:
+            # per-SENDER random destinations (the reference GlobalShuffle
+            # behavior): only the owner routes each sample, so no
+            # cross-worker agreement is needed — and unlike content
+            # hashing, duplicate samples spread out and the partition
+            # re-randomizes every call
+            rng = random.Random((seed, rank, len(self._samples)))
+            buckets = [[] for _ in range(n)]
+            for s in self._samples:
+                buckets[rng.randrange(n)].append(s)
+            for dst in range(n):
+                if dst == rank:
+                    continue
+                host, port = shuffle_endpoint(endpoints[dst])
+                client = native.RpcClient("%s:%d" % (host, port), rank)
+                client.send_var(
+                    "shuffle_samples",
+                    pickle.dumps(buckets[dst], protocol=2),
+                )
+                client.close()
+            mine = list(buckets[rank])
+            received = 0
+            while received < n - 1:
+                item = server.pop_send(timeout_ms=120000)
+                if item == "timeout" or item is None:
+                    raise RuntimeError(
+                        "global_shuffle: got %d/%d peer payloads"
+                        % (received, n - 1)
+                    )
+                _name, _tid, payload = item
+                mine.extend(pickle.loads(payload))
+                received += 1
+            self._samples = mine
+            self._loaded = True
+            self.local_shuffle()
+        finally:
+            server.shutdown()
 
     def release_memory(self):
         self._samples = []

@@ -752,3 +752,10 @@ cuda_places = tpu_places
 
 def is_compiled_with_cuda():
     return False
+
+
+def _ir_graph(program, for_test=False):
+    """fluid.framework.IrGraph parity shim (reference framework.py:3125)."""
+    from .ir import IrGraph
+
+    return IrGraph(program, for_test=for_test)

@@ -203,10 +203,25 @@ def _sequence_expand(ctx, op_):
     y = ctx.in1(op_, "Y")
     ref_level = int(op_.attr("ref_level", -1))
     ynames = op_.inputs.get("Y") or []
-    multi = ynames and lod_level_count(ctx, ynames[0]) >= 2
-    ref_lens = (
-        lengths_level(ctx, ynames[0], ref_level) if multi else None
-    )
+    n_levels = lod_level_count(ctx, ynames[0]) if ynames else 0
+    resolved = ref_level + n_levels if ref_level < 0 else ref_level
+    ref_lens = None
+    if n_levels >= 2:
+        if resolved == n_levels - 1:
+            raise NotImplementedError(
+                "sequence_expand by the INNERMOST level of a multi-level "
+                "LoD Y has a data-dependent output length (sum of inner "
+                "lens) that cannot be a static XLA shape; use "
+                "ref_level <= %d (group levels) or restructure"
+                % (n_levels - 2)
+            )
+        if resolved != n_levels - 2:
+            raise NotImplementedError(
+                "sequence_expand ref_level=%d of a %d-level Y: only the "
+                "level counting Y's instances (level %d) maps to the "
+                "padded representation" % (ref_level, n_levels, n_levels - 2)
+            )
+        ref_lens = lengths_level(ctx, ynames[0], resolved)
     if ref_lens is not None and x.shape[0] == ref_lens.shape[0]:
         # level-aware expansion over the instance axis
         cum = jnp.cumsum(ref_lens)

@@ -58,6 +58,7 @@ __all__ = [
     "RecomputeOptimizer",
     "DGCMomentumOptimizer",
     "PipelineOptimizer",
+    "GradientMergeOptimizer",
 ]
 
 
@@ -1278,3 +1279,35 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
+
+
+class GradientMergeOptimizer(object):
+    """Standalone gradient accumulation / multi-batch merge (reference:
+    framework/ir/multi_batch_merge_pass.cc — replicates forward-backward
+    k times and merges grads; exercised by dist_mnist_batch_merge.py).
+
+    TPU-native realisation: instead of replicating the graph, grads
+    accumulate into persistable buffers every step and a conditional block
+    applies the inner optimizer on the averaged merge every ``k_steps``-th
+    step — the same in-graph machinery PipelineOptimizer uses for
+    microbatching, exposed as the first-class capability."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self._k = max(int(k_steps), 1)
+        self._avg = bool(avg)  # reference pass averages merged grads
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if not self._avg:
+            raise NotImplementedError(
+                "GradientMergeOptimizer(avg=False) (summed merged grads) is "
+                "not supported: the in-graph merge averages; scale the "
+                "learning rate by k_steps for equivalent SGD-family updates"
+            )
+        return PipelineOptimizer(
+            self._inner, num_microbatches=self._k
+        ).minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+        )

@@ -102,3 +102,70 @@ def test_inmemory_dataset_shuffle(tmp_path):
     after = [int(np.asarray(s[0]).ravel()[0]) for s in ds._samples]
     assert sorted(after) == sorted(before)
     assert after != before  # 20! permutations — astronomically unlikely
+
+
+def test_inmemory_dataset_global_sample_shuffle():
+    """data_set.h:226 GlobalShuffle parity: samples re-partition across
+    workers (all-to-all over the RPC transport), preserving the global
+    multiset."""
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu.fluid.dataset import InMemoryDataset
+
+    class FakeFleet(object):
+        def __init__(self, rank, eps):
+            self._rank, self._eps = rank, eps
+
+        def worker_index(self):
+            return self._rank
+
+        def worker_num(self):
+            return len(self._eps)
+
+        def worker_endpoints(self):
+            return self._eps
+
+    import socket
+
+    socks = []
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1] - 1317)
+        socks.append(s)
+    for s in socks:
+        s.close()
+    eps = ["127.0.0.1:%d" % p for p in ports]
+
+    ds = [InMemoryDataset() for _ in range(2)]
+    ds[0]._samples = [("a", i) for i in range(40)]
+    ds[1]._samples = [("b", i) for i in range(40)]
+    for d in ds:
+        d._loaded = True
+        d.set_filelist(["f0", "f1"])
+
+    errs = []
+
+    def run(rank):
+        try:
+            ds[rank].global_shuffle(FakeFleet(rank, eps))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errs, errs
+    merged = sorted(ds[0]._samples + ds[1]._samples)
+    assert merged == sorted([("a", i) for i in range(40)] +
+                            [("b", i) for i in range(40)])
+    # a true sample shuffle mixes sources on each worker
+    src0 = {s[0] for s in ds[0]._samples}
+    src1 = {s[0] for s in ds[1]._samples}
+    assert src0 == {"a", "b"} and src1 == {"a", "b"}
+    assert 10 <= len(ds[0]._samples) <= 70  # crc32 split is roughly balanced

@@ -55,10 +55,8 @@ def test_sequence_expand_ref_level_zero():
     np.testing.assert_allclose(ov, [xv[0], xv[0], xv[1]], rtol=1e-6)
 
 
-def test_sequence_expand_ref_level_inner():
-    """ref_level=-1 with a 2-level Y uses the innermost level: each X row
-    maps to one inner sequence group of Y tokens... with x rows == inner
-    count the gather is the identity grouping by token counts."""
+def test_sequence_expand_outer_groups_three_sequences():
+    """ref_level=0 with unequal outer groups gathers x rows per group."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data(name="x", shape=[3], dtype="float32")
@@ -73,6 +71,24 @@ def test_sequence_expand_ref_level_inner():
     np.testing.assert_allclose(
         np.asarray(ov), [xv[0], xv[1], xv[1], xv[2]], rtol=1e-6
     )
+
+
+def test_sequence_expand_innermost_multilevel_raises_guided_error():
+    """Expanding by the innermost level of a multi-level Y is inherently
+    data-dependent in output length: a guided error, not silent truncation."""
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4, 2], dtype="float32",
+                              lod_level=2)
+        out = fluid.layers.sequence_expand(x, y, ref_level=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((3, 3), np.float32)
+    yfeed = _lod_feed(np.zeros((3, 4, 2), np.float32), [[2, 1], [2, 3, 4]])
+    with pytest.raises(Exception, match="INNERMOST|data-dependent"):
+        exe.run(main, feed={"x": xv, "y": yfeed}, fetch_list=[out])
 
 
 def test_sequence_pad_on_two_level_input():

@@ -1,0 +1,208 @@
+"""Slim NAS + distillation tests (VERDICT r2 missing item 8; reference:
+contrib/slim/{nas,distillation,searcher}/)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib.slim import (
+    FSPDistiller,
+    L2Distiller,
+    LightNAS,
+    SAController,
+    SearchSpace,
+    SoftLabelDistiller,
+    merge_programs,
+)
+
+
+def _teacher_student_programs():
+    """Student program (trainable) + frozen teacher merged in."""
+    teacher = fluid.Program()
+    t_startup = fluid.Program()
+    teacher.random_seed = t_startup.random_seed = 7
+    with fluid.program_guard(teacher, t_startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        th = fluid.layers.fc(input=x, size=16, act="relu")
+        tlogits = fluid.layers.fc(input=th, size=4)
+
+    student = fluid.Program()
+    s_startup = fluid.Program()
+    student.random_seed = s_startup.random_seed = 11
+    with fluid.program_guard(student, s_startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        sh = fluid.layers.fc(input=x, size=16, act="relu")
+        slogits = fluid.layers.fc(input=sh, size=4)
+    rename = merge_programs(student, teacher, feed_names={"x"})
+    return (student, s_startup, t_startup, slogits.name,
+            rename[tlogits.name], sh.name, rename[th.name])
+
+
+def _init_teacher(exe, t_startup, student_scope):
+    """Teacher params initialize under their renamed (prefixed) names by
+    running the teacher startup with renamed outputs."""
+    renamed = fluid.Program()
+    rb = renamed.global_block()
+    src = t_startup.global_block()
+    for op_ in src.ops:
+        outs = {
+            k: ["teacher_" + n for n in ns] for k, ns in op_.outputs.items()
+        }
+        for ns in outs.values():
+            for n in ns:
+                if not rb.has_var(n):
+                    v = src._find_var_recursive(n[len("teacher_"):])
+                    rb.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                  persistable=True)
+        rb.append_op(type=op_.type, inputs=dict(op_.inputs), outputs=outs,
+                     attrs=dict(op_.attrs))
+    exe.run(renamed, scope=student_scope)
+
+
+def test_soft_label_distillation_trains_student_towards_teacher():
+    (student, s_startup, t_startup, s_name, t_name, _sh, _th) = (
+        _teacher_student_programs()
+    )
+    dist = SoftLabelDistiller(s_name, t_name, student_temperature=1.0,
+                              teacher_temperature=1.0,
+                              distillation_loss_weight=1.0)
+    loss = dist.distiller_loss(student)
+    with fluid.program_guard(student, s_startup):
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(s_startup, scope=scope)
+    _init_teacher(exe, t_startup, scope)
+    rng = np.random.RandomState(0)
+    xb = rng.rand(32, 8).astype(np.float32)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(student, feed={"x": xb}, fetch_list=[loss],
+                        scope=scope)
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    # soft CE is floored at the teacher distribution's entropy, so assert a
+    # meaningful decrease toward that floor, not a fixed ratio
+    assert losses[-1] < losses[0] - 0.02, (losses[0], losses[-1])
+    assert losses[-1] <= min(losses) + 0.005, (losses[-1], min(losses))
+
+
+def test_l2_and_fsp_distiller_losses_build_and_decrease():
+    (student, s_startup, t_startup, s_name, t_name, sh, th) = (
+        _teacher_student_programs()
+    )
+    l2 = L2Distiller(s_name, t_name, distillation_loss_weight=0.5)
+    l2_loss = l2.distiller_loss(student)
+    with fluid.program_guard(student, s_startup):
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(l2_loss)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(s_startup, scope=scope)
+    _init_teacher(exe, t_startup, scope)
+    xb = np.random.RandomState(1).rand(16, 8).astype(np.float32)
+    first = last = None
+    for _ in range(30):
+        (lv,) = exe.run(student, feed={"x": xb}, fetch_list=[l2_loss],
+                        scope=scope)
+        last = float(np.asarray(lv).ravel()[0])
+        first = first if first is not None else last
+    assert last < first * 0.7, (first, last)
+
+
+def test_fsp_distiller_on_conv_features():
+    teacher = fluid.Program()
+    t_startup = fluid.Program()
+    teacher.random_seed = t_startup.random_seed = 3
+    with fluid.program_guard(teacher, t_startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        ta = fluid.layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        tb = fluid.layers.conv2d(ta, num_filters=6, filter_size=3, padding=1)
+    student = fluid.Program()
+    s_startup = fluid.Program()
+    student.random_seed = s_startup.random_seed = 5
+    with fluid.program_guard(student, s_startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        sa = fluid.layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        sb = fluid.layers.conv2d(sa, num_filters=6, filter_size=3, padding=1)
+    rename = merge_programs(student, teacher, feed_names={"img"})
+    fsp = FSPDistiller([(sa.name, sb.name)],
+                       [(rename[ta.name], rename[tb.name])])
+    loss = fsp.distiller_loss(student)
+    with fluid.program_guard(student, s_startup):
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(s_startup, scope=scope)
+    _init_teacher(exe, t_startup, scope)
+    xb = np.random.RandomState(2).rand(4, 3, 8, 8).astype(np.float32)
+    first = last = None
+    for _ in range(25):
+        (lv,) = exe.run(student, feed={"img": xb}, fetch_list=[loss],
+                        scope=scope)
+        last = float(np.asarray(lv).ravel()[0])
+        first = first if first is not None else last
+    assert np.isfinite(last) and last < first, (first, last)
+
+
+def test_sa_controller_finds_optimum_on_toy_reward():
+    """SA over a 4-token space; reward peaks at all-max tokens."""
+    rt = [5, 5, 5, 5]
+    ctrl = SAController(reduce_rate=0.7, init_temperature=10.0, seed=0)
+    ctrl.reset(rt, [0, 0, 0, 0])
+    tokens = [0, 0, 0, 0]
+    for _ in range(60):
+        reward = sum(tokens) / float(sum(r - 1 for r in rt))
+        ctrl.update(tokens, reward)
+        tokens = ctrl.next_tokens()
+    assert ctrl.max_reward >= 0.75, (ctrl.max_reward, ctrl.best_tokens)
+
+
+def test_light_nas_search_loop():
+    """End-to-end mini-NAS: search fc widths; reward = -eval loss. The
+    search must return tokens whose net trains at least as well as the
+    initial ones."""
+
+    class FcSpace(SearchSpace):
+        widths = [4, 8, 16, 32]
+
+        def init_tokens(self):
+            return [0]
+
+        def range_table(self):
+            return [len(self.widths)]
+
+        def create_net(self, tokens):
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 42
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+                h = fluid.layers.fc(input=x, size=self.widths[tokens[0]],
+                                    act="relu")
+                pred = fluid.layers.fc(input=h, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(input=pred, label=y)
+                )
+                fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+            return main, None, startup, [loss], [loss]
+
+    rng = np.random.RandomState(0)
+    w = rng.rand(6, 1).astype(np.float32)
+
+    def train_fn(main, _eval_p, startup, train_f, _eval_f):
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        last = None
+        for s in range(15):
+            xb = rng.rand(16, 6).astype(np.float32)
+            yb = (xb @ w) ** 2
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=train_f, scope=scope)
+            last = float(np.asarray(lv).ravel()[0])
+        return -last
+
+    nas = LightNAS(FcSpace(), controller=SAController(seed=1),
+                   search_steps=6, train_fn=train_fn)
+    best_tokens, best_reward = nas.search()
+    assert best_tokens is not None
+    assert np.isfinite(best_reward)
