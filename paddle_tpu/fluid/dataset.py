@@ -230,7 +230,7 @@ class InMemoryDataset(DatasetBase):
             # cross-worker agreement is needed — and unlike content
             # hashing, duplicate samples spread out and the partition
             # re-randomizes every call
-            rng = random.Random((seed, rank, len(self._samples)))
+            rng = random.Random(seed * 1000003 + rank * 7919 + len(self._samples))
             buckets = [[] for _ in range(n)]
             for s in self._samples:
                 buckets[rng.randrange(n)].append(s)
