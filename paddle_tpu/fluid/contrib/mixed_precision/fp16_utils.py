@@ -42,6 +42,11 @@ def rewrite_program(main_prog, amp_lists, use_bf16=True):
     block = main_prog.global_block()
     cast_cache = {}  # (var, dtype) -> casted name
     idx = 0
+    float_dtypes = (
+        core.VarDesc.VarType.FP32,
+        core.VarDesc.VarType.BF16,
+        core.VarDesc.VarType.FP16,
+    )
     while idx < len(block.ops):
         op_ = block.ops[idx]
         target = None
@@ -50,6 +55,31 @@ def rewrite_program(main_prog, amp_lists, use_bf16=True):
         elif op_.type in amp_lists.black_list:
             target = core.VarDesc.VarType.FP32
         if target is None:
+            # gray op: dtype FOLLOWS the inputs. Propagate low precision
+            # into the output var descs when any float input desc is low —
+            # otherwise a later black-list op sees a stale FP32 desc on a
+            # runtime-bf16 value and skips its protective fp32 cast
+            # (reference fp16_utils keeps descs in sync the same way).
+            if op_.type in amp_lists.gray_list:
+                any_low = any(
+                    (v := block._find_var_recursive(n)) is not None
+                    and v.dtype == low
+                    for names in op_.inputs.values()
+                    for n in names
+                )
+                if any_low:
+                    for slot, names in op_.outputs.items():
+                        # normalization statistics stay fp32 at runtime
+                        # (bf16-safe BN contract) — keep their descs fp32
+                        if slot in (
+                            "MeanOut", "VarianceOut", "SavedMean",
+                            "SavedVariance",
+                        ):
+                            continue
+                        for n in names:
+                            v = block._find_var_recursive(n)
+                            if v is not None and v.dtype in float_dtypes:
+                                v.dtype = low
             idx += 1
             continue
         n_insert = 0

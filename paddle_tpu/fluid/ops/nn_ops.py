@@ -296,26 +296,41 @@ def _batch_norm(ctx, op_):
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     bshape = tuple(x.shape[i] if i == ch_axis else 1 for i in range(x.ndim))
 
+    # bf16-safe BN (the AMP gray-list contract): statistics accumulate in
+    # fp32 (XLA fuses the upcast INTO the reduction — the [N,C,H,W]
+    # activation never round-trips HBM in fp32), the normalize runs in the
+    # input dtype so the whole conv-bn-relu chain stays bf16 on the MXU
+    # path. State vars (Mean/Variance) keep their own (fp32) dtype.
+    f32 = jnp.float32
+    mean32 = mean.astype(f32)
+    var32 = var.astype(f32)
     if use_global:
-        use_mean, use_var = mean, var
-        new_mean, new_var = mean, var
-        saved_mean = jnp.zeros_like(mean)
-        saved_var = jnp.zeros_like(var)
+        use_mean, use_var = mean32, var32
+        new_mean, new_var = mean32, var32
+        saved_mean = jnp.zeros_like(mean32)
+        saved_var = jnp.zeros_like(var32)
     else:
-        bmean = jnp.mean(x, axis=axes)
-        bvar = jnp.mean(jnp.square(x), axis=axes) - jnp.square(bmean)
+        bmean = jnp.mean(x, axis=axes, dtype=f32)
+        bvar = jnp.mean(jnp.square(x.astype(f32)), axis=axes) - jnp.square(
+            bmean
+        )
         use_mean, use_var = bmean, bvar
-        new_mean = mean * momentum + bmean * (1.0 - momentum)
-        new_var = var * momentum + bvar * (1.0 - momentum)
+        new_mean = mean32 * momentum + bmean * (1.0 - momentum)
+        new_var = var32 * momentum + bvar * (1.0 - momentum)
         saved_mean = bmean
         saved_var = 1.0 / jnp.sqrt(bvar + eps)
 
     inv = 1.0 / jnp.sqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    # per-channel affine folded AND applied in fp32 (rounding g/b to bf16
+    # before the multiply-add would inject an offset of up to ~|mean|/std
+    # ulps per channel); only the final store drops to x.dtype — XLA fuses
+    # this into one elementwise kernel with bf16-sized HBM traffic
+    g = (scale.astype(f32) * inv).reshape(bshape)
+    b = (bias.astype(f32) - scale.astype(f32) * use_mean * inv).reshape(bshape)
+    y = (x.astype(f32) * g + b).astype(x.dtype)
     ctx.out(op_, "Y", y)
-    ctx.out(op_, "MeanOut", new_mean)
-    ctx.out(op_, "VarianceOut", new_var)
+    ctx.out(op_, "MeanOut", new_mean.astype(mean.dtype))
+    ctx.out(op_, "VarianceOut", new_var.astype(var.dtype))
     ctx.out(op_, "SavedMean", saved_mean)
     ctx.out(op_, "SavedVariance", saved_var)
 
@@ -340,28 +355,35 @@ def _sync_batch_norm(ctx, op_):
     ch_axis = 1
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     bshape = tuple(x.shape[i] if i == ch_axis else 1 for i in range(x.ndim))
+    # same bf16-safe contract as _batch_norm: fp32 statistics (the
+    # E[x^2]-E[x]^2 cancellation is catastrophic in bf16), fp32 affine,
+    # output stored in x.dtype
+    f32 = jnp.float32
+    mean32, var32 = mean.astype(f32), var.astype(f32)
     if is_test:
-        use_mean, use_var = mean, var
-        new_mean, new_var = mean, var
-        saved_mean, saved_var = jnp.zeros_like(mean), jnp.zeros_like(var)
+        use_mean, use_var = mean32, var32
+        new_mean, new_var = mean32, var32
+        saved_mean = jnp.zeros_like(mean32)
+        saved_var = jnp.zeros_like(var32)
     else:
-        bmean = jnp.mean(x, axis=axes)
-        bsq = jnp.mean(jnp.square(x), axis=axes)
+        bmean = jnp.mean(x, axis=axes, dtype=f32)
+        bsq = jnp.mean(jnp.square(x.astype(f32)), axis=axes)
         if axis is not None:
             bmean = lax.pmean(bmean, axis)
             bsq = lax.pmean(bsq, axis)
         bvar = bsq - jnp.square(bmean)
         use_mean, use_var = bmean, bvar
-        new_mean = mean * momentum + bmean * (1.0 - momentum)
-        new_var = var * momentum + bvar * (1.0 - momentum)
+        new_mean = mean32 * momentum + bmean * (1.0 - momentum)
+        new_var = var32 * momentum + bvar * (1.0 - momentum)
         saved_mean = bmean
         saved_var = 1.0 / jnp.sqrt(bvar + eps)
     inv = 1.0 / jnp.sqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    g = (scale.astype(f32) * inv).reshape(bshape)
+    b = (bias.astype(f32) - scale.astype(f32) * use_mean * inv).reshape(bshape)
+    y = (x.astype(f32) * g + b).astype(x.dtype)
     ctx.out(op_, "Y", y)
-    ctx.out(op_, "MeanOut", new_mean)
-    ctx.out(op_, "VarianceOut", new_var)
+    ctx.out(op_, "MeanOut", new_mean.astype(mean.dtype))
+    ctx.out(op_, "VarianceOut", new_var.astype(var.dtype))
     ctx.out(op_, "SavedMean", saved_mean)
     ctx.out(op_, "SavedVariance", saved_var)
 
