@@ -132,6 +132,39 @@ class DistMultiTrainer(MultiTrainer):
                 comm.stop()
 
 
+class DownpourTrainer(DistMultiTrainer):
+    """reference: trainer.h:84 DistMultiTrainer + downpour_worker.cc — the
+    sparse-CTR device worker: per batch, PULL the touched rows of the
+    row-sharded embedding tables from the pservers (FillSparseValue),
+    compute forward/backward locally, PUSH the SelectedRows grads back to
+    the owning shards (push_sparse) and dense grads via the async
+    communicator (push_dense).
+
+    TPU-native realisation: pull/push are OPS in the sparse-transpiled
+    program (distributed_lookup_table prefetches over kPrefetch; the send
+    op row-shards the SelectedRows grad), so the worker loop is the
+    Hogwild-style batch stream — the data-dependent table traffic stays on
+    the host/DCN side while the dense math is one XLA program."""
+
+    def train(self, executor, program, dataset, scope=None, fetch_list=None,
+              fetch_info=None, print_period=100, on_step=None):
+        sparse_pulls = [
+            op_
+            for op_ in program.global_block().ops
+            if op_.type == "distributed_lookup_table"
+        ]
+        if not sparse_pulls:
+            raise ValueError(
+                "DownpourTrainer needs a sparse-transpiled program "
+                "(embedding(is_sparse=True) + DistributeTranspiler): no "
+                "distributed_lookup_table ops found"
+            )
+        return super().train(
+            executor, program, dataset, scope, fetch_list, fetch_info,
+            print_period, on_step=on_step,
+        )
+
+
 class PipelineTrainer(TrainerBase):
     """reference: trainer.h:114 PipelineTrainer + SectionWorker — the
     program must be marked by PipelineOptimizer(cut_list=...); execution
@@ -157,6 +190,7 @@ class TrainerFactory(object):
     _TRAINERS = {
         "MultiTrainer": MultiTrainer,
         "DistMultiTrainer": DistMultiTrainer,
+        "DownpourTrainer": DownpourTrainer,
         "PipelineTrainer": PipelineTrainer,
     }
 

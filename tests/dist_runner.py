@@ -188,6 +188,42 @@ def run_dist():
 
             comm = Communicator(program=trainer_prog, trainer_id=tid)
             comm.start()
+    if os.environ.get("DIST_DATASET") == "1":
+        # Downpour path: dataset-driven async sparse-CTR training
+        # (reference downpour_worker.cc); pull/push ride the program's ops
+        from paddle_tpu.fluid.dataset import InMemoryDataset
+        from paddle_tpu.fluid.trainer import DownpourTrainer
+
+        ds = InMemoryDataset()
+        ds.set_batch_size(BATCH // trainers)
+        samples = []
+        for s in range(STEPS):
+            x, y = batch_for(s)
+            per_t = BATCH // trainers
+            xs = x[tid * per_t:(tid + 1) * per_t]
+            ys = y[tid * per_t:(tid + 1) * per_t]
+            samples.extend(zip(xs, ys))
+        ds._samples = samples
+        ds._loaded = True
+        ds.use_var = ["x", "y"]
+        losses_box = []
+
+        class _FetchingExec(object):
+            def run(self, program, feed=None, fetch_list=None, scope=None):
+                outs = exe.run(program, feed=feed, fetch_list=[loss],
+                               scope=scope)
+                losses_box.append(float(np.asarray(outs[0]).ravel()[0]))
+                return outs
+
+        DownpourTrainer(thread_num=1).train(
+            _FetchingExec(), trainer_prog, ds, fetch_list=None,
+        )
+        if comm is not None:
+            comm.stop()
+        exe.close()
+        print("LOSSES " + json.dumps(losses_box), flush=True)
+        return
+
     per = BATCH // trainers
     die_after = int(os.environ.get("DIST_DIE_AFTER_STEP", "-1"))
     losses = []

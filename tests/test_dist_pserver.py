@@ -227,3 +227,18 @@ def test_dist_pserver_sparse_momentum_matches_local():
     t0, t1 = run_cluster(sync=True, extra_env=env)
     dist = [(a + b) / 2.0 for a, b in zip(t0, t1)]
     np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-4)
+
+
+def test_downpour_trainer_dataset_sparse_async():
+    """Downpour worker parity (reference downpour_worker.cc): dataset-driven
+    async training of a sparse embedding across 2 pservers — the trainer
+    pulls touched rows per batch and pushes SelectedRows grads; loss stays
+    finite and training progresses."""
+    t0, t1 = run_cluster(
+        sync=False,
+        extra_env={"DIST_SPARSE": "1", "DIST_DATASET": "1"},
+    )
+    for ls in (t0, t1):
+        assert len(ls) >= 4, ls
+        assert all(np.isfinite(ls)), ls
+        assert min(ls) < ls[0], ls

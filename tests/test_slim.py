@@ -136,3 +136,49 @@ def test_prune_and_sensitivity():
     sens = sensitivity(exe, main, scope, ["fc_1.w_0"], eval_fn,
                        ratios=(0.25, 0.75))
     assert set(sens["fc_1.w_0"]) == {0.25, 0.75}
+
+
+def test_amp_rewrite_bf16_bn_chain_matches_fp32():
+    """AMP gray-propagation + bf16-safe BN (PERF.md): a conv->bn->relu->
+    mean program rewritten to bf16 must stay numerically close to the fp32
+    run, and the desc dtypes must track the runtime (black-list ops get
+    their protective fp32 cast)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.contrib.mixed_precision import fp16_lists, fp16_utils
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 21
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+            c = fluid.layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+            b = fluid.layers.batch_norm(c, act="relu")
+            m = fluid.layers.reduce_mean(b)
+        return main, startup, m, b
+
+    xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main32, startup32, m32, _ = build()
+    s32 = fluid.core.Scope()
+    exe.run(startup32, scope=s32)
+    ref = float(np.asarray(exe.run(main32, feed={"x": xb}, fetch_list=[m32],
+                                   scope=s32)[0]).ravel()[0])
+
+    main16, startup16, m16, bn_out = build()
+    fp16_utils.rewrite_program(main16, fp16_lists.AutoMixedPrecisionLists())
+    blk = main16.global_block()
+    # gray propagation: BN's data output desc follows the bf16 conv...
+    assert blk.var(bn_out.name).dtype == core.VarDesc.VarType.BF16
+    # ...and the black-listed reduce_mean got a protective fp32 cast input
+    rm = next(o for o in blk.ops if o.type == "reduce_mean")
+    cast_in = blk.var(rm.input("X")[0])
+    assert cast_in.dtype == core.VarDesc.VarType.FP32
+    s16 = fluid.core.Scope()
+    exe.run(startup16, scope=s16)
+    got = float(np.asarray(exe.run(main16, feed={"x": xb}, fetch_list=[m16],
+                                   scope=s16)[0]).ravel()[0])
+    assert abs(got - ref) < 2e-2 * max(abs(ref), 1.0), (got, ref)
