@@ -531,32 +531,71 @@ def _lrn(ctx, op_):
     ctx.out(op_, "Out", x / jnp.power(mid, beta))
 
 
-@op("interp_nearest", grad="generic")
-@op("nearest_interp", grad="generic")
-def _nearest_interp(ctx, op_):
-    import jax
-
-    x = ctx.in1(op_, "X")
+def _interp_out_hw(op_, x):
     oh = int(op_.attr("out_h", 0))
     ow = int(op_.attr("out_w", 0))
     scale = op_.attr("scale", 0.0)
     if (not oh or not ow) and scale:
         oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
-    ctx.out(op_, "Out", out)
+    return oh, ow
+
+
+def _src_coords(out_n, in_n, align_corners, align_mode):
+    """Paddle interp_op.h coordinate mapping: align_corners uses the
+    corner-anchored ratio (in-1)/(out-1); else align_mode==1 is the legacy
+    src = dst*scale, align_mode==0 the half-pixel mapping."""
+    import jax.numpy as jnp
+
+    d = jnp.arange(out_n, dtype=jnp.float32)
+    if align_corners:
+        ratio = (in_n - 1.0) / (out_n - 1.0) if out_n > 1 else 0.0
+        return d * ratio
+    ratio = in_n / float(out_n)
+    if align_mode == 1:
+        return d * ratio
+    return jnp.maximum((d + 0.5) * ratio - 0.5, 0.0)
+
+
+@op("interp_nearest", grad="generic")
+@op("nearest_interp", grad="generic")
+def _nearest_interp(ctx, op_):
+    import jax.numpy as jnp
+
+    x = ctx.in1(op_, "X")
+    oh, ow = _interp_out_hw(op_, x)
+    ac = bool(op_.attr("align_corners", True))
+    sy = _src_coords(oh, x.shape[2], ac, 1)
+    sx = _src_coords(ow, x.shape[3], ac, 1)
+    iy = (jnp.round(sy) if ac else jnp.floor(sy)).astype(jnp.int32)
+    ix = (jnp.round(sx) if ac else jnp.floor(sx)).astype(jnp.int32)
+    iy = jnp.clip(iy, 0, x.shape[2] - 1)
+    ix = jnp.clip(ix, 0, x.shape[3] - 1)
+    ctx.out(op_, "Out", x[:, :, iy][:, :, :, ix])
 
 
 @op("bilinear_interp", grad="generic")
 def _bilinear_interp(ctx, op_):
-    import jax
+    import jax.numpy as jnp
 
     x = ctx.in1(op_, "X")
-    oh = int(op_.attr("out_h", 0))
-    ow = int(op_.attr("out_w", 0))
-    scale = op_.attr("scale", 0.0)
-    if (not oh or not ow) and scale:
-        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    oh, ow = _interp_out_hw(op_, x)
+    ac = bool(op_.attr("align_corners", True))
+    am = int(op_.attr("align_mode", 1))
+    sy = _src_coords(oh, x.shape[2], ac, am)
+    sx = _src_coords(ow, x.shape[3], ac, am)
+    y0 = jnp.clip(jnp.floor(sy).astype(jnp.int32), 0, x.shape[2] - 1)
+    x0 = jnp.clip(jnp.floor(sx).astype(jnp.int32), 0, x.shape[3] - 1)
+    y1 = jnp.clip(y0 + 1, 0, x.shape[2] - 1)
+    x1 = jnp.clip(x0 + 1, 0, x.shape[3] - 1)
+    wy = (sy - y0).astype(x.dtype)[None, None, :, None]
+    wx = (sx - x0).astype(x.dtype)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy][:, :, :, xx]  # noqa: E731
+    out = (
+        g(y0, x0) * (1 - wy) * (1 - wx)
+        + g(y1, x0) * wy * (1 - wx)
+        + g(y0, x1) * (1 - wy) * wx
+        + g(y1, x1) * wy * wx
+    )
     ctx.out(op_, "Out", out)
 
 

@@ -34,7 +34,7 @@ def _router(x, wg, capacity):
     # the row-sum: doing it before adds E-1 spurious -1 terms per row)
     pos_t = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1.0
     keep = (pos_t < capacity) & (pos_t >= 0)
-    pos_oh = jax.nn.one_hot(pos_t, capacity, dtype=x.dtype)  # [T, C]
+    pos_oh = jax.nn.one_hot(pos_t.astype(jnp.int32), capacity, dtype=x.dtype)  # [T, C]
     dispatch = (
         onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
     )  # [T, E, C]
