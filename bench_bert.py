@@ -1,0 +1,180 @@
+"""Secondary benchmark: BERT-base fine-tune throughput (sequences/sec) on
+one chip (BASELINE.md metric 2). Same hardened architecture as bench.py:
+the parent never imports jax; each attempt is a child process with a hard
+wall-clock timeout, demoting batch on OOM/timeout with a labeled CPU
+fallback. Prints ONE JSON line.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# commonly reported V100 fp32 BERT-base seq128 fine-tune rate (~40 seq/s)
+V100_BERT_BASE_SEQ_PER_SEC = 40.0
+METRIC = "bert_base_finetune_throughput"
+UNIT = "sequences/sec/chip"
+SEQ_LEN = 128
+
+
+def _hb(msg):
+    print("HB %s" % msg, file=sys.stderr, flush=True)
+
+
+def child_main(cfg):
+    if cfg["platform"]:
+        os.environ["JAX_PLATFORMS"] = cfg["platform"]
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+
+    if cfg["platform"] == "cpu":
+        place = fluid.CPUPlace()
+        device = "cpu"
+    elif fluid.core.get_tpu_device_count() == 0:
+        print("CHILDERR " + json.dumps({"kind": "no_tpu", "msg": "no tpu"}),
+              flush=True)
+        sys.exit(1)
+    else:
+        place = fluid.TPUPlace(0)
+        device = "tpu"
+    dev = fluid.core.get_jax_device(place)
+    import jax.numpy as jnp
+
+    _hb("probe start")
+    jax.jit(lambda a: (a @ a).sum())(
+        jax.device_put(jnp.ones((256, 256), jnp.bfloat16), dev)
+    ).block_until_ready()
+    _hb("probe ok")
+
+    batch = cfg["batch"]
+    bcfg = (
+        bert.BertConfig() if cfg["full"] else bert.BertConfig(
+            hidden_size=256, num_layers=4, num_heads=4,
+            intermediate_size=1024,
+        )
+    )
+    bcfg.hidden_dropout = 0.0
+    bcfg.attention_dropout = 0.0
+    _hb("build start")
+    main, startup, feeds, loss, acc = bert.build_bert_classifier(
+        bcfg, SEQ_LEN, learning_rate=2e-5
+    )
+    if cfg["amp"]:
+        pass  # build path already runs matmuls bf16 under the AMP lists
+    exe = fluid.Executor(place)
+    _hb("startup start")
+    exe.run(startup)
+    _hb("startup ok")
+    rs = np.random.RandomState(0)
+    feed = {
+        "src_ids": jax.device_put(
+            rs.randint(0, bcfg.vocab_size, (batch, SEQ_LEN, 1)).astype("int64"), dev
+        ),
+        "pos_ids": jax.device_put(
+            np.tile(np.arange(SEQ_LEN)[None, :, None], (batch, 1, 1)).astype("int64"),
+            dev,
+        ),
+        "sent_ids": jax.device_put(
+            np.zeros((batch, SEQ_LEN, 1), "int64"), dev
+        ),
+        "input_mask": jax.device_put(
+            np.ones((batch, SEQ_LEN, 1), "float32"), dev
+        ),
+        "label": jax.device_put(rs.randint(0, 2, (batch, 1)).astype("int64"), dev),
+    }
+    _hb("warmup start")
+    for i in range(cfg["warmup"]):
+        exe.run(main, feed=feed, fetch_list=[loss])
+        _hb("warmup %d done" % i)
+    exe.run(main, feed=feed, fetch_list=[])
+    _hb("timed start")
+    t0 = time.perf_counter()
+    steps = cfg["steps"]
+    out = None
+    for i in range(steps):
+        out = exe.run(
+            main, feed=feed, fetch_list=[loss] if i == steps - 1 else []
+        )
+    lval = float(np.asarray(out[0]).ravel()[0])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lval), lval
+    sps = batch * steps / dt
+    _hb("timed ok %.2fs loss=%.4f sps=%.1f" % (dt, lval, sps))
+    print("RESULT " + json.dumps({"sps": sps, "device": device, "loss": lval}),
+          flush=True)
+
+
+def run_attempt(cfg, timeout_s):
+    code = (
+        "import json, sys; sys.path.insert(0, %r); import bench_bert; "
+        "bench_bert.child_main(json.loads(%r))"
+        % (os.path.dirname(os.path.abspath(__file__)), json.dumps(cfg))
+    )
+    t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        print("bench_bert: attempt timed out after %ds" % timeout_s,
+              file=sys.stderr, flush=True)
+        return None
+    for line in err.splitlines():
+        if line.startswith("HB "):
+            print("bench_bert[+%ds]: %s" % (time.time() - t0, line),
+                  file=sys.stderr, flush=True)
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def main():
+    attempts = [
+        (dict(platform="", batch=64, steps=10, warmup=2, amp=True,
+              full=True), 420),
+        (dict(platform="", batch=16, steps=10, warmup=2, amp=True,
+              full=True), 360),
+        (dict(platform="cpu", batch=4, steps=3, warmup=1, amp=False,
+              full=False), 280),
+    ]
+    for cfg, slot in attempts:
+        res = run_attempt(cfg, slot)
+        if res:
+            degraded = cfg["platform"] == "cpu" or not cfg["full"]
+            out = {
+                "metric": METRIC,
+                "value": round(res["sps"], 2),
+                "unit": UNIT,
+                "vs_baseline": round(res["sps"] / V100_BERT_BASE_SEQ_PER_SEC, 3),
+                "batch": cfg["batch"],
+                "seq_len": SEQ_LEN,
+                "device": res["device"],
+            }
+            if degraded:
+                out["degraded"] = "cpu-fallback tiny-config"
+            print(json.dumps(out), flush=True)
+            return
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
+        "error": "all attempts failed",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
