@@ -134,8 +134,11 @@ def child_main(cfg):
         exe.run(main_prog, feed=feed, fetch_list=[loss])
         _hb("warmup step %d/%d done %.1fs" % (i + 1, warmup, time.time() - t0))
     # the executor cache key includes the fetch list, so the fetch-free
-    # variant used by the timed loop must be compiled here, not inside it
+    # variant used by the timed loop must be compiled here, not inside it;
+    # the follow-up fetching run DRAINS the async queue so none of that
+    # work leaks into the timed window
     exe.run(main_prog, feed=feed, fetch_list=[])
+    exe.run(main_prog, feed=feed, fetch_list=[loss])
     _hb("warmup fetch-free variant done %.1fs" % (time.time() - t0))
 
     _hb("timed run start (%d steps)" % steps)
@@ -210,10 +213,12 @@ def _timeout_slots():
     return slots, cpu_slot
 
 
-def _run_attempt(label, cfg, timeout, deadline):
+def _run_attempt(label, cfg, timeout, deadline, script=None):
     """Spawn one child attempt; kill its whole process group on timeout.
     Returns (result_dict_or_None, kind, error_str). kind in
-    {"", "killed", "no_tpu", "oom", "transient", "other", "skipped"}."""
+    {"", "killed", "no_tpu", "oom", "transient", "other", "skipped"}.
+    ``script`` lets sibling harnesses (bench_bert.py) reuse this exact
+    streaming-relay + kill-timer machinery with their own --child entry."""
     budget = min(timeout, deadline - time.time())
     if budget < 30:
         return None, "skipped", "skipped: <30s left in budget"
@@ -224,7 +229,12 @@ def _run_attempt(label, cfg, timeout, deadline):
         flush=True,
     )
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--child", json.dumps(cfg)],
+        [
+            sys.executable,
+            script or os.path.abspath(__file__),
+            "--child",
+            json.dumps(cfg),
+        ],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,

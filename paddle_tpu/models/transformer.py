@@ -189,3 +189,113 @@ def _causal_const(tgt_len):
     v = fluid.layers.assign(table)
     v.stop_gradient = True
     return v
+
+
+def build_transformer_infer(cfg, src_len, tgt_len):
+    """Inference graph (BASELINE config 5): next-token logits for a partial
+    target prefix. The causal mask makes position t's logits depend only on
+    tgt_ids[:t+1], so one fixed-shape program serves every decode step —
+    the TPU-friendly form of the reference's step-wise beam loop (the jit
+    cache sees ONE shape instead of T shapes).
+
+    Returns (program, feed names, logits var [N, T, V])."""
+    main, startup = fluid.Program(), fluid.Program()
+    # fresh name scope: parameter names must match a train program built
+    # in its own scope (enc_0_att_q.w_0 etc.), not continue the counters
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src_ids = fluid.layers.data(name="src_ids", shape=[src_len, 1], dtype="int64")
+        src_pos = fluid.layers.data(name="src_pos", shape=[src_len, 1], dtype="int64")
+        src_mask = fluid.layers.data(name="src_mask", shape=[src_len, 1], dtype="float32")
+        tgt_ids = fluid.layers.data(name="tgt_ids", shape=[tgt_len, 1], dtype="int64")
+        tgt_pos = fluid.layers.data(name="tgt_pos", shape=[tgt_len, 1], dtype="int64")
+        tgt_mask = fluid.layers.data(name="tgt_mask", shape=[tgt_len, 1], dtype="float32")
+        causal = _causal_const(tgt_len)
+        logits = transformer(
+            cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask, causal
+        )
+    feeds = ["src_ids", "src_pos", "src_mask", "tgt_ids", "tgt_pos", "tgt_mask"]
+    return main, feeds, logits
+
+
+def beam_search_decode(exe, infer_prog, logits, cfg, src, bos_id, eos_id,
+                       beam_size=4, max_len=None, scope=None,
+                       length_penalty=0.0, src_pad_id=None):
+    """Beam-search NMT decoding over the fixed-shape inference program
+    (reference: beam_search_op.cc + beam_search_decode_op.cc semantics —
+    log-prob accumulated beams, finished-beam freezing, length penalty).
+
+    src: [N, S] int64. Returns (sequences [N, beam, max_len] int64,
+    scores [N, beam]) sorted best-first."""
+    import numpy as np
+
+    N, S = src.shape
+    # the infer program's target length is baked into its shapes
+    T = infer_prog.global_block().var("tgt_ids").shape[1]
+    if max_len is not None and max_len != T:
+        raise ValueError(
+            "max_len=%d but the infer program was built with tgt_len=%d"
+            % (max_len, T)
+        )
+    K = beam_size
+    V = cfg.tgt_vocab
+
+    src_b = np.repeat(src, K, axis=0)  # [N*K, S]
+    src_pos = np.tile(np.arange(S, dtype=np.int64), (N * K, 1))
+    if src_pad_id is not None:  # variable-length sources padded with pad_id
+        src_mask = (src_b != src_pad_id).astype("float32")
+    else:
+        src_mask = np.ones((N * K, S), "float32")
+
+    seqs = np.full((N * K, T), eos_id, np.int64)
+    seqs[:, 0] = bos_id
+    scores = np.full((N, K), -1e9, np.float64)
+    scores[:, 0] = 0.0  # first step expands only beam 0 (identical prefixes)
+    finished = np.zeros((N, K), bool)
+
+    for t in range(T - 1):
+        feed = {
+            "src_ids": src_b[..., None],
+            "src_pos": src_pos[..., None],
+            "src_mask": src_mask[..., None],
+            "tgt_ids": seqs[..., None],
+            "tgt_pos": np.tile(np.arange(T, dtype=np.int64), (N * K, 1))[..., None],
+            "tgt_mask": (np.arange(T) <= t)[None, :].repeat(N * K, 0).astype(
+                "float32"
+            )[..., None],
+        }
+        (lg,) = exe.run(infer_prog, feed=feed, fetch_list=[logits], scope=scope)
+        lg = np.asarray(lg).reshape(N, K, T, V)[:, :, t, :]  # [N, K, V]
+        logp = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1, keepdims=True)) - lg.max(-1, keepdims=True)
+        # frozen beams only extend with eos at no cost
+        logp = np.where(
+            finished[..., None],
+            np.where(np.arange(V)[None, None, :] == eos_id, 0.0, -1e9),
+            logp,
+        )
+        total = scores[..., None] + logp  # [N, K, V]
+        flat = total.reshape(N, K * V)
+        top = np.argsort(-flat, axis=1)[:, :K]  # [N, K]
+        new_scores = np.take_along_axis(flat, top, axis=1)
+        beam_idx = top // V
+        tok = top % V
+        new_seqs = np.empty_like(seqs.reshape(N, K, T))
+        for n in range(N):
+            new_seqs[n] = seqs.reshape(N, K, T)[n, beam_idx[n]]
+            new_seqs[n, :, t + 1] = tok[n]
+        seqs = new_seqs.reshape(N * K, T)
+        finished = np.take_along_axis(finished, beam_idx, axis=1) | (
+            tok == eos_id
+        )
+        scores = new_scores
+        if finished.all():
+            break
+
+    if length_penalty > 0:
+        lens = (seqs.reshape(N, K, T) != eos_id).sum(-1)
+        scores = scores / ((5.0 + lens) / 6.0) ** length_penalty
+    order = np.argsort(-scores, axis=1)
+    seqs = np.take_along_axis(
+        seqs.reshape(N, K, T), order[..., None], axis=1
+    )
+    scores = np.take_along_axis(scores, order, axis=1)
+    return seqs, scores
