@@ -1,12 +1,15 @@
-"""Headline benchmark: ResNet-50 training throughput (images/sec) on one
-chip (BASELINE.md metric 1).
+"""Headline benchmarks: ResNet-50 training throughput (BASELINE.md metric
+1) and BERT-base fine-tune throughput (metric 2) on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints TWO JSON lines, ResNet-50 (the headline) first:
+  {"metric": "resnet50_train_throughput", "value", "unit", "vs_baseline", ...}
+  {"metric": "bert_base_finetune_throughput", ...}
 
 ``vs_baseline`` compares against the reference's V100+NCCL path. The
 reference publishes no numbers in-repo (BASELINE.md), so the baseline
-constant below is the commonly reported PaddlePaddle-era ResNet-50 fp32
-V100 figure (~360 images/sec/GPU); the north-star target is >=0.9x.
+constants below are the commonly reported PaddlePaddle-era V100 figures
+(~360 images/sec ResNet-50 fp32, ~40 seq/s BERT-base seq128); the
+north-star target is >=0.9x.
 
 Architecture (hardened for the axon TPU tunnel, which can HANG — not
 raise — inside device discovery or compilation, where no in-process
@@ -15,19 +18,25 @@ watchdog can interrupt the C++ call):
 - The parent process never imports jax. It spawns one child process per
   attempt with a HARD wall-clock timeout; on expiry the whole child
   process group is SIGKILLed.
-- Attempt policy: start at batch 1024; a transient backend error (the
-  tunnel's UNAVAILABLE) retries the SAME batch once; an OOM or hard
-  timeout demotes to the next smaller batch (1024 -> 256 -> 64); a
-  missing TPU skips straight to a clearly-labeled degraded CPU fallback
-  so the driver always records a nonzero number when any backend works.
+- Cheap-first ladder (VERDICT r3 #1): batch 64 first (small compile,
+  short slot) to BANK a TPU number, then escalate 256 -> 1024 only
+  after a success. Results accumulate; the best per metric is emitted
+  at the end, so a later failure can never lose a banked number.
+- Every child enables a persistent XLA compilation cache
+  (.jax_cache/, git-ignored) so a retry after a tunnel hiccup — or the
+  driver's end-of-round run after an interactive warm-up — skips
+  recompilation entirely.
+- Hang detection: if the FIRST TPU attempt is killed before its
+  "probe ok" heartbeat (the r3 failure mode: hung at device discovery),
+  the parent stops trusting the tunnel, banks degraded CPU fallbacks
+  immediately, then spreads short (150s) TPU retries across the rest
+  of the watchdog window in case the tunnel comes back.
 - The child emits "HB <phase> ..." heartbeat lines on stderr at every
   phase transition (probe / build / startup / warmup / step k/N); the
   parent relays them with elapsed timestamps, so a tail of the driver
   log shows exactly where a dead attempt died.
-- The timeout slots are budgeted to fit the driver's 1500s watchdog
-  with margin (420+380+320 TPU slots + a reserved 280s CPU slot,
-  1400 < 1440), and the CPU fallback's slot is reserved up front so
-  TPU failures can never starve it.
+- All slots are scheduled against the driver's 1500s watchdog minus a
+  60s margin; an attempt never starts unless its slot fits.
 """
 
 import json
@@ -42,6 +51,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 V100_RESNET50_FP32_IMG_PER_SEC = 360.0
 METRIC = "resnet50_train_throughput"
 UNIT = "images/sec/chip"
+
+
+def enable_compilation_cache(jax):
+    """Persistent XLA compilation cache shared by every bench child, so
+    retries (and the driver's end-of-round run) skip recompilation."""
+    cache_dir = os.environ.get(
+        "BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        print("HB compilation cache unavailable: %s" % e, file=sys.stderr, flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -70,6 +94,7 @@ def child_main(cfg):
         # honor the explicit platform choice even when the axon
         # sitecustomize pinned jax_platforms via config (config beats env)
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    enable_compilation_cache(jax)
 
     import numpy as np
 
@@ -199,29 +224,17 @@ def _base_cfg():
     }
 
 
-def _timeout_slots():
-    """TPU timeout slots + reserved CPU-fallback slot. Overridable via
-    BENCH_ATTEMPT_TIMEOUTS=t1,t2,...,tcpu (last value is the CPU slot)."""
-    slots = [420.0, 380.0, 320.0]
-    cpu_slot = 280.0
-    if os.environ.get("BENCH_ATTEMPT_TIMEOUTS"):
-        vals = [float(t) for t in os.environ["BENCH_ATTEMPT_TIMEOUTS"].split(",") if t]
-        if len(vals) == 1:
-            slots, cpu_slot = [vals[0]], vals[0]
-        else:
-            slots, cpu_slot = vals[:-1], vals[-1]
-    return slots, cpu_slot
-
-
 def _run_attempt(label, cfg, timeout, deadline, script=None):
     """Spawn one child attempt; kill its whole process group on timeout.
-    Returns (result_dict_or_None, kind, error_str). kind in
-    {"", "killed", "no_tpu", "oom", "transient", "other", "skipped"}.
+    Returns (result_dict_or_None, kind, error_str, probe_ok). kind in
+    {"", "killed", "no_tpu", "oom", "transient", "other", "skipped"};
+    probe_ok is True once the child's device-discovery probe heartbeat
+    was seen (False on a pre-probe hang — the r3 tunnel failure mode).
     ``script`` lets sibling harnesses (bench_bert.py) reuse this exact
     streaming-relay + kill-timer machinery with their own --child entry."""
     budget = min(timeout, deadline - time.time())
     if budget < 30:
-        return None, "skipped", "skipped: <30s left in budget"
+        return None, "skipped", "skipped: <30s left in budget", False
     t0 = time.time()
     print(
         "bench[%s]: starting (hard timeout %.0fs)" % (label, budget),
@@ -243,6 +256,7 @@ def _run_attempt(label, cfg, timeout, deadline, script=None):
     )
     result, childerr, lines = None, None, []
     killed = False
+    probe_ok = False
 
     import threading
 
@@ -272,6 +286,8 @@ def _run_attempt(label, cfg, timeout, deadline, script=None):
                     lines.append(line)
             else:
                 lines.append(line)
+                if "probe ok" in line:
+                    probe_ok = True
                 # relay heartbeats (and any backend noise) with timestamps
                 print(
                     "bench[%s +%.0fs]: %s" % (label, time.time() - t0, line[:300]),
@@ -283,19 +299,26 @@ def _run_attempt(label, cfg, timeout, deadline, script=None):
         timer.cancel()
     if result is not None:
         # a valid result beats a kill flag set in the exit race window
-        return result, "", ""
+        return result, "", "", probe_ok
     if childerr is not None:
-        return None, childerr.get("kind", "other"), childerr.get("msg", "")
+        return None, childerr.get("kind", "other"), childerr.get("msg", ""), probe_ok
     if killed:
         last = lines[-1] if lines else "(no output)"
-        return None, "killed", "killed at %.0fs hard timeout; last: %s" % (budget, last)
+        return (
+            None,
+            "killed",
+            "killed at %.0fs hard timeout; last: %s" % (budget, last),
+            probe_ok,
+        )
     last = next(
         (l for l in reversed(lines) if "Error" in l or "error" in l),
         lines[-1] if lines else "(no output)",
     )
-    return None, "other", "exit rc=%d without result; last: %s" % (
-        proc.returncode,
-        last[:300],
+    return (
+        None,
+        "other",
+        "exit rc=%d without result; last: %s" % (proc.returncode, last[:300]),
+        probe_ok,
     )
 
 
@@ -303,84 +326,220 @@ def _emit(out):
     print(json.dumps(out), flush=True)
 
 
+V100_BERT_BASE_SEQ_PER_SEC = 40.0
+BERT_METRIC = "bert_base_finetune_throughput"
+BERT_UNIT = "sequences/sec/chip"
+
+
+def _bert_script():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_bert.py")
+
+
+def _resnet_line(result, batch, errors, degraded):
+    line = {
+        "metric": METRIC,
+        "value": round(result["ips"], 2),
+        "unit": UNIT,
+        "vs_baseline": round(result["ips"] / V100_RESNET50_FP32_IMG_PER_SEC, 3),
+        "batch": batch,
+        "device": result["device"],
+    }
+    if degraded:
+        line["degraded"] = "cpu fallback (TPU attempts failed: %s)" % (
+            "; ".join(errors)[:400] or "none tried"
+        )
+    return line
+
+
+def _bert_line(result, batch, errors, degraded):
+    line = {
+        "metric": BERT_METRIC,
+        "value": round(result["sps"], 2),
+        "unit": BERT_UNIT,
+        "vs_baseline": round(result["sps"] / V100_BERT_BASE_SEQ_PER_SEC, 3),
+        "batch": batch,
+        "seq_len": 128,
+        "device": result["device"],
+    }
+    if degraded:
+        line["degraded"] = "cpu-fallback tiny-config (TPU attempts failed: %s)" % (
+            "; ".join(errors)[:400] or "none tried"
+        )
+    return line
+
+
 def parent_main():
     total = float(os.environ.get("BENCH_TIMEOUT", "1500"))
     hard_deadline = time.time() + total - 60.0
     base = _base_cfg()
-    slots, cpu_slot = _timeout_slots()
-    # reserve the CPU slot so TPU failures can never starve the fallback
-    tpu_deadline = hard_deadline - cpu_slot
 
-    first_batch = int(os.environ.get("BENCH_BATCH", "1024"))
-    batches = [first_batch] + [b for b in (256, 64) if b < first_batch]
-    errors = []
-    bi = 0  # index into batches
-    transient_retried = set()  # batches that already got their one retry
-    slot_i = 0
-    while bi < len(batches) and slot_i < len(slots):
-        b = batches[bi]
-        label = "tpu-b%d" % b
-        result, kind, err = _run_attempt(
-            label, dict(base, batch=b), slots[slot_i], tpu_deadline
+    banked = {"resnet": None, "bert": None}  # best emitted-line per metric
+    tpu_ok = {"resnet": False, "bert": False}
+    errors = {"resnet": [], "bert": []}
+    tunnel_suspect = False
+    # test hook: shrink TPU slots (hang-path tests shouldn't take 20 min)
+    tpu_scale = float(os.environ.get("BENCH_TPU_SLOT_SCALE", "1"))
+
+    def note_fail(metric, label, kind, err):
+        errors[metric].append("%s: [%s] %s" % (label, kind, err))
+        print(
+            "bench[%s]: FAILED — [%s] %s" % (label, kind, err),
+            file=sys.stderr,
+            flush=True,
         )
-        slot_i += 1
-        if result is not None:
-            _emit(
-                {
-                    "metric": METRIC,
-                    "value": round(result["ips"], 2),
-                    "unit": UNIT,
-                    "vs_baseline": round(
-                        result["ips"] / V100_RESNET50_FP32_IMG_PER_SEC, 3
-                    ),
-                    "batch": b,
-                    "device": result["device"],
-                }
-            )
-            return 0
-        errors.append("%s: [%s] %s" % (label, kind, err))
-        print("bench[%s]: FAILED — [%s] %s" % (label, kind, err), file=sys.stderr, flush=True)
-        if kind == "no_tpu":
-            break  # straight to the CPU fallback
-        if kind == "transient" and b not in transient_retried:
-            transient_retried.add(b)  # retry the SAME batch once
-            continue
-        bi += 1  # oom / killed / other / repeat-transient: demote
 
-    # degraded fallback: a clearly-labeled nonzero number beats a zero
-    cpu_cfg = dict(
-        base,
-        batch=int(os.environ.get("BENCH_CPU_BATCH", "8")),
-        steps=min(base["steps"], 3),
-        warmup=1,
-        platform="cpu",
-    )
-    result, kind, err = _run_attempt("cpu-degraded", cpu_cfg, cpu_slot, hard_deadline)
-    if result is not None:
+    def try_resnet_tpu(batch, slot, steps=None):
+        nonlocal tunnel_suspect
+        cfg = dict(base, batch=batch)
+        if steps is not None:
+            cfg["steps"] = steps
+        label = "tpu-b%d" % batch
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, hard_deadline
+        )
+        if result is not None:
+            prev = banked["resnet"]
+            # bank-the-best: a slower later success (e.g. a bigger batch
+            # that thrashes) never overwrites a faster banked TPU number
+            if (
+                prev is None
+                or prev.get("degraded")
+                or result["ips"] > prev["value"]
+            ):
+                banked["resnet"] = _resnet_line(result, batch, [], False)
+            tpu_ok["resnet"] = True
+            tunnel_suspect = False
+            return True
+        note_fail("resnet", label, kind, err)
+        if kind == "killed" and not probe_ok:
+            tunnel_suspect = True
+        if kind == "no_tpu":
+            tunnel_suspect = True
+        return False
+
+    def try_bert_tpu(slot, batch=64):
+        nonlocal tunnel_suspect
+        cfg = dict(platform="", batch=batch, steps=10, warmup=2, full=True)
+        label = "bert-tpu-b%d" % batch
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, hard_deadline, script=_bert_script()
+        )
+        if result is not None:
+            prev = banked["bert"]
+            if (
+                prev is None
+                or prev.get("degraded")
+                or result["sps"] > prev["value"]
+            ):
+                banked["bert"] = _bert_line(result, batch, [], False)
+            tpu_ok["bert"] = True
+            tunnel_suspect = False
+            return True
+        note_fail("bert", label, kind, err)
+        if kind in ("no_tpu",) or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
+    def bank_cpu_fallbacks():
+        if banked["resnet"] is None:
+            cpu_cfg = dict(
+                base,
+                batch=int(os.environ.get("BENCH_CPU_BATCH", "8")),
+                steps=min(base["steps"], 3),
+                warmup=1,
+                platform="cpu",
+            )
+            result, kind, err, _ = _run_attempt(
+                "cpu-degraded", cpu_cfg, 170.0, hard_deadline
+            )
+            if result is not None:
+                banked["resnet"] = _resnet_line(
+                    result, cpu_cfg["batch"], errors["resnet"], True
+                )
+            else:
+                note_fail("resnet", "cpu-degraded", kind, err)
+        if banked["bert"] is None:
+            cfg = dict(platform="cpu", batch=4, steps=3, warmup=1, full=False)
+            result, kind, err, _ = _run_attempt(
+                "bert-cpu-degraded", cfg, 150.0, hard_deadline, script=_bert_script()
+            )
+            if result is not None:
+                banked["bert"] = _bert_line(result, 4, errors["bert"], True)
+            else:
+                note_fail("bert", "bert-cpu-degraded", kind, err)
+
+    # ---- phase A: cheap-first TPU ladder — bank b64, then escalate ----
+    escalation = [(256, 240.0), (1024, 280.0)]
+    if try_resnet_tpu(64, 260.0):
+        for b, slot in escalation:
+            if not try_resnet_tpu(b, slot):
+                break
+    # ---- phase B: BERT on TPU (skip if the tunnel looks dead) ----
+    if not tunnel_suspect:
+        try_bert_tpu(260.0)
+
+    # ---- phase C: degraded CPU fallbacks for anything still missing ----
+    bank_cpu_fallbacks()
+
+    # ---- phase D: spend the remaining window on short TPU retries ----
+    # (tunnel may come back mid-window; a banked CPU number is replaced
+    # by any TPU success, and an existing TPU number is escalated)
+    escalated = set()
+    while time.time() < hard_deadline - 160.0:
+        round_start = time.time()
+        did_something = False
+        if not tpu_ok["resnet"]:
+            try_resnet_tpu(64, 150.0, steps=10)
+            did_something = True
+        elif not tpu_ok["bert"]:
+            pass  # handled below
+        elif banked["resnet"].get("batch", 0) < 1024:
+            nxt = 256 if banked["resnet"]["batch"] < 256 else 1024
+            if nxt not in escalated:
+                escalated.add(nxt)
+                try_resnet_tpu(nxt, 150.0)
+                did_something = True
+        if time.time() >= hard_deadline - 160.0:
+            break
+        if not tpu_ok["bert"]:
+            try_bert_tpu(150.0)
+            did_something = True
+        if not did_something:
+            break  # nothing left worth retrying — emit now
+        # fast failures (e.g. instant no_tpu) must still SPREAD retries
+        # across the window rather than hammering child spawns back-to-back
+        spent = time.time() - round_start
+        if spent < 120.0:
+            time.sleep(min(120.0 - spent, max(0.0, hard_deadline - 160.0 - time.time())))
+
+    # ---- emit: resnet (headline) first, bert second ----
+    rc = 0
+    if banked["resnet"] is not None:
+        _emit(banked["resnet"])
+    else:
         _emit(
             {
                 "metric": METRIC,
-                "value": round(result["ips"], 2),
+                "value": 0.0,
                 "unit": UNIT,
-                "vs_baseline": round(result["ips"] / V100_RESNET50_FP32_IMG_PER_SEC, 3),
-                "batch": cpu_cfg["batch"],
-                "device": "cpu",
-                "degraded": "cpu fallback (TPU attempts failed: %s)"
-                % ("; ".join(errors)[:400] or "none tried"),
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors["resnet"])[:800],
             }
         )
-        return 0
-    errors.append("cpu-degraded: [%s] %s" % (kind, err))
-    _emit(
-        {
-            "metric": METRIC,
-            "value": 0.0,
-            "unit": UNIT,
-            "vs_baseline": 0.0,
-            "error": "; ".join(errors)[:800],
-        }
-    )
-    return 1
+        rc = 1
+    if banked["bert"] is not None:
+        _emit(banked["bert"])
+    else:
+        _emit(
+            {
+                "metric": BERT_METRIC,
+                "value": 0.0,
+                "unit": BERT_UNIT,
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors["bert"])[:800],
+            }
+        )
+    return rc
 
 
 def main():

@@ -32,6 +32,9 @@ def child_main(cfg):
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import bench
+
+    bench.enable_compilation_cache(jax)
     import numpy as np
 
     import paddle_tpu.fluid as fluid
@@ -147,7 +150,7 @@ def main():
     ]
     for cfg, slot in attempts:
         label = "bert-%s-b%d" % (cfg["platform"] or "tpu", cfg["batch"])
-        res, _kind, err = bench._run_attempt(
+        res, _kind, err, _probe_ok = bench._run_attempt(
             label, cfg, slot, deadline,
             script=os.path.abspath(__file__),
         )

@@ -19,10 +19,20 @@
 // the stage machine.
 //
 // Wire protocol, all little-endian:
-//   request:  u8 opcode | u32 trainer_id | u32 name_len | name bytes
-//             | u64 payload_len | payload
+//   request:  u8 opcode | u32 trainer_id | u64 seq | u32 name_len
+//             | name bytes | u64 payload_len | payload
 //   response: u8 status (0 ok, 1 not-found, 2 shutdown) | u64 payload_len
 //             | payload
+//
+// seq is a client-assigned per-logical-operation id (0 = read-only, not
+// tracked; clients seed randomly and increment). Mutating ops
+// (SEND/barriers/COMPLETE/CHECKPOINT) are deduped server-side against a
+// bounded per-trainer window of recently applied seqs, making the client's
+// deadline-retry loop safe: a retry after an ambiguous failure (request
+// applied but the response lost to SO_RCVTIMEO) re-sends the same seq and
+// is acked without being applied twice — a duplicated send_barrier would
+// otherwise wedge the sync-mode kGetVar wait predicate, and a duplicated
+// async send_var would double-apply a gradient.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -37,6 +47,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -90,6 +101,7 @@ bool read_full(int fd, void* buf, size_t n) {
 struct Request {
   uint8_t opcode;
   uint32_t trainer_id;
+  uint64_t seq = 0;
   std::string name;
   std::vector<uint8_t> payload;
 };
@@ -97,9 +109,10 @@ struct Request {
 bool read_request(int fd, Request* req) {
   uint8_t op;
   uint32_t tid, name_len;
-  uint64_t payload_len;
+  uint64_t seq, payload_len;
   if (!read_full(fd, &op, 1)) return false;
   if (!read_full(fd, &tid, 4)) return false;
+  if (!read_full(fd, &seq, 8)) return false;
   if (!read_full(fd, &name_len, 4)) return false;
   if (name_len > (64u << 10)) return false;
   req->name.resize(name_len);
@@ -111,6 +124,7 @@ bool read_request(int fd, Request* req) {
     return false;
   req->opcode = op;
   req->trainer_id = tid;
+  req->seq = seq;
   return true;
 }
 
@@ -153,6 +167,17 @@ struct RpcServer {
   // worker liveness: last request timestamp per trainer (HeartBeatMonitor,
   // operators/distributed/heart_beat_monitor.h:54 — sends count as beats)
   std::vector<int64_t> last_active_ms;
+  // retry-dedup: bounded window of recently applied mutating-op seqs per
+  // trainer. Exact-match (not a high-water mark) so correctness needs only
+  // seq UNIQUENESS — concurrent client threads may transmit out of
+  // allocation order, and a restarted trainer reseeds randomly, neither of
+  // which may cause a live op to be mistaken for a duplicate.
+  struct SeqWindow {
+    std::deque<uint64_t> order;
+    std::set<uint64_t> seen;
+  };
+  std::vector<SeqWindow> seq_windows;
+  static constexpr size_t kSeqWindowCap = 4096;
 
   std::thread accept_thread;
   std::vector<std::thread> conn_threads;
@@ -176,6 +201,31 @@ struct RpcServer {
       {
         std::lock_guard<std::mutex> lk(mu);
         last_active_ms[t] = steady_ms();
+      }
+      {
+        // retry dedup: a mutating op whose seq was already applied (the
+        // client re-sent it after losing the response to its deadline) is
+        // acked without being applied again. The window is bounded; a
+        // client retry always lands within a handful of intervening ops.
+        bool mutating = req.opcode == kSendVar || req.opcode == kSendBarrier ||
+                        req.opcode == kFetchBarrier ||
+                        req.opcode == kComplete ||
+                        req.opcode == kCheckpointNotify;
+        if (mutating && req.seq != 0) {
+          std::unique_lock<std::mutex> lk(mu);
+          SeqWindow& w = seq_windows[t];
+          if (w.seen.count(req.seq)) {
+            lk.unlock();
+            if (!write_response(fd, 0, nullptr, 0)) goto done;
+            continue;
+          }
+          w.seen.insert(req.seq);
+          w.order.push_back(req.seq);
+          if (w.order.size() > kSeqWindowCap) {
+            w.seen.erase(w.order.front());
+            w.order.pop_front();
+          }
+        }
       }
       switch (req.opcode) {
         case kSendVar: {
@@ -347,6 +397,7 @@ void* pt_rpc_server_create(int port, int n_trainers, int sync_mode) {
   s->fetch_counts.assign(s->n_trainers, 0);
   s->completed.assign(s->n_trainers, 0);
   s->last_active_ms.assign(s->n_trainers, 0);
+  s->seq_windows.assign(s->n_trainers, RpcServer::SeqWindow());
 
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -499,12 +550,18 @@ void pt_rpc_server_put_table(void* h, const char* name, const uint8_t* data,
   t.row_bytes = row_bytes;
 }
 
-// Pop one checkpoint_notify directory. Returns 0 ok, 1 empty.
+// Pop one checkpoint_notify directory. Returns 0 ok, 1 empty; if the name
+// does not fit in cap (including the NUL), returns the negated required
+// capacity WITHOUT popping, so the caller can retry with a larger buffer
+// instead of silently saving the shard to a truncated path.
 int pt_rpc_server_pop_notify(void* h, char* dir_out, int cap) {
   auto* s = static_cast<RpcServer*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
   if (s->notify_q.empty()) return 1;
-  std::snprintf(dir_out, cap, "%s", s->notify_q.front().c_str());
+  const std::string& dir = s->notify_q.front();
+  if (dir.size() + 1 > static_cast<size_t>(cap))
+    return -static_cast<int>(dir.size() + 1);
+  std::snprintf(dir_out, cap, "%s", dir.c_str());
   s->notify_q.pop_front();
   return 0;
 }
@@ -577,12 +634,13 @@ void* pt_rpc_connect(const char* host, int port, int timeout_ms) {
 }
 
 static int rpc_call(RpcClient* c, uint8_t opcode, uint32_t trainer_id,
-                    const char* name, const uint8_t* payload, uint64_t plen,
-                    uint8_t** out, uint64_t* out_len) {
+                    uint64_t seq, const char* name, const uint8_t* payload,
+                    uint64_t plen, uint8_t** out, uint64_t* out_len) {
   std::lock_guard<std::mutex> lk(c->mu);
   uint32_t name_len = name ? static_cast<uint32_t>(std::strlen(name)) : 0;
   if (!write_full(c->fd, &opcode, 1)) return -1;
   if (!write_full(c->fd, &trainer_id, 4)) return -1;
+  if (!write_full(c->fd, &seq, 8)) return -1;
   if (!write_full(c->fd, &name_len, 4)) return -1;
   if (name_len && !write_full(c->fd, name, name_len)) return -1;
   if (!write_full(c->fd, &plen, 8)) return -1;
@@ -601,45 +659,50 @@ static int rpc_call(RpcClient* c, uint8_t opcode, uint32_t trainer_id,
   return status;
 }
 
-int pt_rpc_send_var(void* h, uint32_t trainer_id, const char* name,
-                    const uint8_t* payload, uint64_t len) {
-  return rpc_call(static_cast<RpcClient*>(h), kSendVar, trainer_id, name,
+// Mutating calls take the client-assigned per-operation seq (see the
+// wire-protocol note); a retry of the same logical op MUST pass the same
+// seq so the server can dedup it. Read-only calls pass no seq (0).
+
+int pt_rpc_send_var(void* h, uint32_t trainer_id, uint64_t seq,
+                    const char* name, const uint8_t* payload, uint64_t len) {
+  return rpc_call(static_cast<RpcClient*>(h), kSendVar, trainer_id, seq, name,
                   payload, len, nullptr, nullptr);
 }
 
 // returns 0 ok (*out malloc'd), 1 not found, 2 shutdown, -1 io error
 int pt_rpc_get_var(void* h, uint32_t trainer_id, const char* name,
                    uint8_t** out, uint64_t* out_len) {
-  return rpc_call(static_cast<RpcClient*>(h), kGetVar, trainer_id, name,
+  return rpc_call(static_cast<RpcClient*>(h), kGetVar, trainer_id, 0, name,
                   nullptr, 0, out, out_len);
 }
 
-int pt_rpc_send_barrier(void* h, uint32_t trainer_id) {
-  return rpc_call(static_cast<RpcClient*>(h), kSendBarrier, trainer_id,
+int pt_rpc_send_barrier(void* h, uint32_t trainer_id, uint64_t seq) {
+  return rpc_call(static_cast<RpcClient*>(h), kSendBarrier, trainer_id, seq,
                   nullptr, nullptr, 0, nullptr, nullptr);
 }
 
-int pt_rpc_fetch_barrier(void* h, uint32_t trainer_id) {
-  return rpc_call(static_cast<RpcClient*>(h), kFetchBarrier, trainer_id,
+int pt_rpc_fetch_barrier(void* h, uint32_t trainer_id, uint64_t seq) {
+  return rpc_call(static_cast<RpcClient*>(h), kFetchBarrier, trainer_id, seq,
                   nullptr, nullptr, 0, nullptr, nullptr);
 }
 
-int pt_rpc_complete(void* h, uint32_t trainer_id) {
-  return rpc_call(static_cast<RpcClient*>(h), kComplete, trainer_id, nullptr,
-                  nullptr, 0, nullptr, nullptr);
+int pt_rpc_complete(void* h, uint32_t trainer_id, uint64_t seq) {
+  return rpc_call(static_cast<RpcClient*>(h), kComplete, trainer_id, seq,
+                  nullptr, nullptr, 0, nullptr, nullptr);
 }
 
 // Fetch table rows: ids = raw int64 array, *out = raw row bytes.
 int pt_rpc_prefetch(void* h, uint32_t trainer_id, const char* table,
                     const uint8_t* ids, uint64_t ids_len, uint8_t** out,
                     uint64_t* out_len) {
-  return rpc_call(static_cast<RpcClient*>(h), kPrefetch, trainer_id, table,
+  return rpc_call(static_cast<RpcClient*>(h), kPrefetch, trainer_id, 0, table,
                   ids, ids_len, out, out_len);
 }
 
-int pt_rpc_checkpoint_notify(void* h, uint32_t trainer_id, const char* dir) {
+int pt_rpc_checkpoint_notify(void* h, uint32_t trainer_id, uint64_t seq,
+                             const char* dir) {
   return rpc_call(static_cast<RpcClient*>(h), kCheckpointNotify, trainer_id,
-                  dir, nullptr, 0, nullptr, nullptr);
+                  seq, dir, nullptr, 0, nullptr, nullptr);
 }
 
 // Honor FLAGS rpc_deadline: bound every send/recv on this connection.

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import subprocess
 import threading
 
@@ -147,13 +148,13 @@ def _load():
         lib.pt_rpc_connect.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int
         ]
-        lib.pt_rpc_send_var.argtypes = [c, u32, ctypes.c_char_p, u8p, u64]
+        lib.pt_rpc_send_var.argtypes = [c, u32, u64, ctypes.c_char_p, u8p, u64]
         lib.pt_rpc_get_var.argtypes = [
             c, u32, ctypes.c_char_p, ctypes.POINTER(u8p), u64p
         ]
-        lib.pt_rpc_send_barrier.argtypes = [c, u32]
-        lib.pt_rpc_fetch_barrier.argtypes = [c, u32]
-        lib.pt_rpc_complete.argtypes = [c, u32]
+        lib.pt_rpc_send_barrier.argtypes = [c, u32, u64]
+        lib.pt_rpc_fetch_barrier.argtypes = [c, u32, u64]
+        lib.pt_rpc_complete.argtypes = [c, u32, u64]
         lib.pt_rpc_close.argtypes = [c]
         lib.pt_rpc_server_put_table.argtypes = [
             c, ctypes.c_char_p, u8p, u64, u64
@@ -163,7 +164,7 @@ def _load():
         lib.pt_rpc_prefetch.argtypes = [
             c, u32, ctypes.c_char_p, u8p, u64, ctypes.POINTER(u8p), u64p
         ]
-        lib.pt_rpc_checkpoint_notify.argtypes = [c, u32, ctypes.c_char_p]
+        lib.pt_rpc_checkpoint_notify.argtypes = [c, u32, u64, ctypes.c_char_p]
         lib.pt_rpc_set_deadline.argtypes = [c, ctypes.c_int]
         _lib = lib
         return _lib
@@ -491,6 +492,10 @@ class RpcServer(object):
         """-> checkpoint directory string or None."""
         buf = ctypes.create_string_buffer(4096)
         rc = self._lib.pt_rpc_server_pop_notify(self._h, buf, len(buf))
+        if rc < 0:
+            # name didn't fit: -rc is the required capacity (incl. NUL)
+            buf = ctypes.create_string_buffer(-rc)
+            rc = self._lib.pt_rpc_server_pop_notify(self._h, buf, len(buf))
         return buf.value.decode() if rc == 0 else None
 
     def worker_idle_ms(self):
@@ -549,6 +554,12 @@ class RpcClient(object):
         # (clients are cached per (endpoint, trainer_id) and used from the
         # communicator's background threads concurrently)
         self._call_lock = threading.Lock()
+        # per-logical-operation sequence ids for server-side retry dedup.
+        # The server dedups by EXACT match in a bounded window, so all that
+        # matters is uniqueness: seed randomly (safe across trainer
+        # restarts — no wall-clock monotonicity assumption) and increment.
+        self._seq_lock = threading.Lock()
+        self._next_seq = random.getrandbits(63) | 1
         self._h = lib.pt_rpc_connect(
             host.encode(), int(port), self._deadline_ms
         )
@@ -571,10 +582,18 @@ class RpcClient(object):
             self._lib.pt_rpc_set_deadline(self._h, self._deadline_ms)
         return bool(self._h)
 
+    def _new_seq(self):
+        with self._seq_lock:
+            self._next_seq += 1
+            return self._next_seq
+
     def _with_retry(self, fn, what):
         """FLAGS_rpc_retry_times semantics: a deadline/io failure (-1)
         reconnects (which also resyncs the request/response stream) and
-        retries; other statuses surface immediately."""
+        retries; other statuses surface immediately. Retrying a MUTATING op
+        after an ambiguous rc=-1 (request applied, response lost to the
+        deadline) is safe because ``fn`` re-sends the same per-operation seq
+        and the server dedups it (rpc.cpp handle_conn seq_windows)."""
         last_rc = -1
         with self._call_lock:
             for attempt in range(self._retry_times + 1):
@@ -592,9 +611,10 @@ class RpcClient(object):
 
     def send_var(self, name, payload):
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        seq = self._new_seq()
         rc = self._with_retry(
             lambda: self._lib.pt_rpc_send_var(
-                self._h, self.trainer_id, name.encode(), buf, len(payload)
+                self._h, self.trainer_id, seq, name.encode(), buf, len(payload)
             ),
             "send_var(%s)" % name,
         )
@@ -649,9 +669,10 @@ class RpcClient(object):
             self._lib.pt_free(out)
 
     def checkpoint_notify(self, dirname):
+        seq = self._new_seq()
         rc = self._with_retry(
             lambda: self._lib.pt_rpc_checkpoint_notify(
-                self._h, self.trainer_id, dirname.encode()
+                self._h, self.trainer_id, seq, dirname.encode()
             ),
             "checkpoint_notify",
         )
@@ -659,24 +680,27 @@ class RpcClient(object):
             raise ConnectionError("checkpoint_notify -> rc %d" % rc)
 
     def send_barrier(self):
+        seq = self._new_seq()
         rc = self._with_retry(
-            lambda: self._lib.pt_rpc_send_barrier(self._h, self.trainer_id),
+            lambda: self._lib.pt_rpc_send_barrier(self._h, self.trainer_id, seq),
             "send_barrier",
         )
         if rc != 0:
             raise ConnectionError("send_barrier -> rc %d" % rc)
 
     def fetch_barrier(self):
+        seq = self._new_seq()
         rc = self._with_retry(
-            lambda: self._lib.pt_rpc_fetch_barrier(self._h, self.trainer_id),
+            lambda: self._lib.pt_rpc_fetch_barrier(self._h, self.trainer_id, seq),
             "fetch_barrier",
         )
         if rc != 0:
             raise ConnectionError("fetch_barrier -> rc %d" % rc)
 
     def complete(self):
+        seq = self._new_seq()
         rc = self._with_retry(
-            lambda: self._lib.pt_rpc_complete(self._h, self.trainer_id),
+            lambda: self._lib.pt_rpc_complete(self._h, self.trainer_id, seq),
             "complete",
         )
         if rc != 0:

@@ -63,19 +63,18 @@ def _sequence_topk_avg_pooling(ctx, op_):
         colmask = jnp.arange(c)[None, None, None, :] < lc[:, None, None, None]
         xm = jnp.where(colmask, x, neg)
     else:
-        lc_full = jnp.full((b,), c, jnp.int32)
-        lc = lc_full
         xm = x
     sorted_desc = -jnp.sort(-xm, axis=-1)  # [B, ch, R, C] descending
     cols = []
     pos_idx = jnp.arange(c)
     for k in topks:
         kk = min(k, c)
-        cnt = jnp.minimum(lc, kk).astype(x.dtype)  # [B]
         take = jnp.where(pos_idx[None, None, None, :] < kk, sorted_desc, 0)
         take = jnp.where(take == neg, 0, take)
         s = jnp.sum(take, axis=-1)  # [B, ch, R]
-        cols.append(s / jnp.maximum(cnt, 1.0)[:, None, None])
+        # the reference divides by the FIXED k (sequence_topk_avg_pooling_op.h
+        # :147), not by the number of valid columns actually summed
+        cols.append(s / float(max(k, 1)))
     out = jnp.stack(cols, axis=-1)  # [B, ch, R, K]
     out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, r, ch * len(topks))
     if lr is not None:

@@ -20,10 +20,13 @@ skip unknown fields, so the bytes still fully decode against the reference
 .proto (proven by tests/test_proto_wire.py, which compiles the reference
 schema with protoc into a descriptor pool and parses our bytes with it).
 
-bf16 note: VarType.Type here can carry the TPU extension value 22 (BF16,
-core.py); proto2 treats unknown enum values as unknown fields on decode,
-which generic parsers preserve — acceptable for a dtype the CUDA-era
-reference cannot represent anyway.
+bf16 note: the TPU extension dtype BF16 (value 22, core.py) has no slot in
+the reference enum, and TensorDesc.data_type is a REQUIRED proto2 field —
+an unknown enum value there would fail the required-field check in
+conformant parsers. BF16 vars therefore encode FP16 as a schema-valid
+stand-in in TensorDesc.data_type and carry the true dtype in the
+field-1000 extras, restored on decode (round-trip + protoc cross-parse
+proven in tests/test_proto_wire.py).
 """
 
 from __future__ import annotations
@@ -324,7 +327,14 @@ _TENSOR_SLOT = {
 def _encode_var(vs):
     vtype = vs["type"]
     dims = [int(d) if d is not None else -1 for d in vs.get("shape") or ()]
-    tensor_desc = _vi(1, vs["dtype"]) + b"".join(_vi(2, d) for d in dims)
+    # TensorDesc.data_type is a REQUIRED proto2 enum: the TPU extension
+    # value 22 (BF16) would decode as an unknown field and fail the
+    # required-field check under the reference schema. Encode a
+    # schema-valid stand-in (FP16, the closest 16-bit type the CUDA-era
+    # schema has) and carry the true dtype in the field-1000 extras
+    # (_var_extras), restored by _decode_var.
+    wire_dtype = _VT.FP16 if vs["dtype"] == _VT.BF16 else vs["dtype"]
+    tensor_desc = _vi(1, wire_dtype) + b"".join(_vi(2, d) for d in dims)
     vt = _vi(1, vtype)
     slot = _TENSOR_SLOT.get(vtype)
     if slot == 2:
@@ -350,6 +360,9 @@ def _var_extras(vs):
         ex["stop_gradient"] = True
     if vs.get("is_data"):
         ex["is_data"] = True
+    if vs.get("dtype") == _VT.BF16:
+        # true dtype for the FP16 stand-in written into TensorDesc.data_type
+        ex["dtype"] = vs["dtype"]
     if _TENSOR_SLOT.get(vs["type"]) is None:
         # no TensorDesc slot for this var type: keep dtype/shape out-of-band
         if vs.get("dtype") != _VT.FP32:

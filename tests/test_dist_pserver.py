@@ -242,3 +242,49 @@ def test_downpour_trainer_dataset_sparse_async():
         assert len(ls) >= 4, ls
         assert all(np.isfinite(ls)), ls
         assert min(ls) < ls[0], ls
+
+
+def test_rpc_retry_dedup_barrier_and_async_send():
+    """ADVICE r3 (native.py _with_retry): a mutating RPC retried after an
+    ambiguous failure must not be applied twice. The client re-sends the
+    same per-operation seq; the server's per-trainer high-water mark dedups
+    it (rpc.cpp handle_conn). Exercised at the wire level by issuing the
+    SAME seq twice: a duplicated send_barrier must leave send_counts at 1
+    (a double increment would wedge the sync-mode kGetVar predicate), and a
+    duplicated async send_var must enqueue one gradient, not two."""
+    lib = native._load()
+
+    # sync mode: duplicated send_barrier
+    srv = native.RpcServer(0, n_trainers=1, sync_mode=True)
+    cli = native.RpcClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+    seq = cli._new_seq()
+    for _ in range(2):  # original + retry with the SAME seq
+        rc = lib.pt_rpc_send_barrier(cli._h, 0, seq)
+        assert rc == 0  # the duplicate is acked, not errored
+    assert srv.wait_sends(timeout_ms=2000) == 0  # one barrier arrived
+    srv.begin_serve()
+    seqf = cli._new_seq()
+    for _ in range(2):
+        assert lib.pt_rpc_fetch_barrier(cli._h, 0, seqf) == 0
+    assert srv.end_step(timeout_ms=2000) == 0  # now step=1
+    # if the duplicate had incremented send_counts to 2, step-1 sends would
+    # already satisfy the predicate; with dedup it must time out
+    assert srv.wait_sends(timeout_ms=300) == 1
+    cli.close()
+    srv.shutdown()
+
+    # async mode: duplicated send_var must enqueue exactly one payload
+    srv = native.RpcServer(0, n_trainers=1, sync_mode=False)
+    cli = native.RpcClient("127.0.0.1:%d" % srv.port, trainer_id=0)
+    payload = b"\x01\x02\x03"
+    buf = (__import__("ctypes").c_uint8 * len(payload)).from_buffer_copy(payload)
+    seq2 = cli._new_seq()
+    for _ in range(2):
+        rc = lib.pt_rpc_send_var(cli._h, 0, seq2, b"g", buf, len(payload))
+        assert rc == 0
+    first = srv.pop_send(timeout_ms=2000)
+    assert first is not None and first != "timeout"
+    assert first[0] == "g" and first[2] == payload
+    assert srv.pop_send(timeout_ms=300) == "timeout"  # no duplicate queued
+    cli.close()
+    srv.shutdown()
