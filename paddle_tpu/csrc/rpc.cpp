@@ -174,10 +174,33 @@ struct RpcServer {
   // which may cause a live op to be mistaken for a duplicate.
   struct SeqWindow {
     std::deque<uint64_t> order;
-    std::set<uint64_t> seen;
+    std::set<uint64_t> seen;       // applied (ack of a dup is safe)
+    std::set<uint64_t> in_flight;  // checked-in but not yet applied
   };
   std::vector<SeqWindow> seq_windows;
   static constexpr size_t kSeqWindowCap = 4096;
+
+  // mark a mutating op applied: retries blocked in the in-flight wait may
+  // now be acked (an ack must IMPLY the apply happened — ack-before-apply
+  // would let a retried send_barrier satisfy the sync predicate while the
+  // original gradient store is still pending on a descheduled thread)
+  void seq_applied(uint32_t t, uint64_t seq) {
+    if (!seq) return;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      SeqWindow& w = seq_windows[t];
+      w.in_flight.erase(seq);
+      if (!w.seen.count(seq)) {
+        w.seen.insert(seq);
+        w.order.push_back(seq);
+        if (w.order.size() > kSeqWindowCap) {
+          w.seen.erase(w.order.front());
+          w.order.pop_front();
+        }
+      }
+    }
+    cv.notify_all();
+  }
 
   std::thread accept_thread;
   std::vector<std::thread> conn_threads;
@@ -205,8 +228,10 @@ struct RpcServer {
       {
         // retry dedup: a mutating op whose seq was already applied (the
         // client re-sent it after losing the response to its deadline) is
-        // acked without being applied again. The window is bounded; a
-        // client retry always lands within a handful of intervening ops.
+        // acked without being applied again; a retry racing the ORIGINAL's
+        // in-flight apply waits for it, so an ack always implies applied.
+        // The window is bounded; a client retry always lands within a
+        // handful of intervening ops.
         bool mutating = req.opcode == kSendVar || req.opcode == kSendBarrier ||
                         req.opcode == kFetchBarrier ||
                         req.opcode == kComplete ||
@@ -214,16 +239,21 @@ struct RpcServer {
         if (mutating && req.seq != 0) {
           std::unique_lock<std::mutex> lk(mu);
           SeqWindow& w = seq_windows[t];
+          bool duplicate = false;
           if (w.seen.count(req.seq)) {
+            duplicate = true;
+          } else if (w.in_flight.count(req.seq)) {
+            cv.wait(lk, [&] {
+              return shutting_down || w.seen.count(req.seq) > 0;
+            });
+            duplicate = true;
+          } else {
+            w.in_flight.insert(req.seq);
+          }
+          if (duplicate) {
             lk.unlock();
             if (!write_response(fd, 0, nullptr, 0)) goto done;
             continue;
-          }
-          w.seen.insert(req.seq);
-          w.order.push_back(req.seq);
-          if (w.order.size() > kSeqWindowCap) {
-            w.seen.erase(w.order.front());
-            w.order.pop_front();
           }
         }
       }
@@ -238,6 +268,7 @@ struct RpcServer {
           }
           cv.notify_all();
           lk.unlock();
+          seq_applied(t, req.seq);
           if (!write_response(fd, 0, nullptr, 0)) goto done;
           break;
         }
@@ -280,6 +311,7 @@ struct RpcServer {
             send_counts[t]++;
           }
           cv.notify_all();
+          seq_applied(t, req.seq);
           if (!write_response(fd, 0, nullptr, 0)) goto done;
           break;
         }
@@ -289,6 +321,7 @@ struct RpcServer {
             fetch_counts[t]++;
           }
           cv.notify_all();
+          seq_applied(t, req.seq);
           if (!write_response(fd, 0, nullptr, 0)) goto done;
           break;
         }
@@ -298,6 +331,7 @@ struct RpcServer {
             completed[t] = 1;
           }
           cv.notify_all();
+          seq_applied(t, req.seq);
           if (!write_response(fd, 0, nullptr, 0)) goto done;
           break;
         }
@@ -340,6 +374,7 @@ struct RpcServer {
             notify_q.push_back(req.name);
           }
           cv.notify_all();
+          seq_applied(t, req.seq);
           if (!write_response(fd, 0, nullptr, 0)) goto done;
           break;
         }

@@ -190,8 +190,6 @@ class CompiledProgram(object):
         :292,:514) realised with the collective transpiler (reference:
         transpiler/collective.py:178 GradAllReduce). The scale/psum ride the
         data axis only — under dp x tp the model axis replicates the loss."""
-        from .transpiler.collective import GradAllReduce
-
         nranks = self._device_count()
         if mesh is not None and "data" in mesh.axis_names:
             nranks = int(
@@ -207,10 +205,17 @@ class CompiledProgram(object):
                     % (applied, nranks)
                 )
             return
-        t = GradAllReduce(nrings=1)
-        t._transpile_main_program_inplace(
-            self._program, nranks=nranks, loss_name=self._loss_name
-        )
+        # routed through the Pass registry (ir.py
+        # collective_grad_allreduce_pass) — PassBuilder users see the same
+        # pipeline surface as the reference's build_strategy.cc:299
+        from .ir import get_pass
+
+        get_pass(
+            "collective_grad_allreduce_pass",
+            nranks=nranks,
+            loss_name=self._loss_name,
+            nrings=1,
+        ).apply_program(self._program)
         self._program._grad_allreduce_applied = nranks
 
     def _run(self, executor, feed=None, fetch_list=None, scope=None,

@@ -49,9 +49,15 @@ class OptimizerWithMixedPrecision(object):
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
-        fp16_utils.rewrite_program(
-            loss.block.program, self._amp_lists, use_bf16=self._use_bf16
-        )
+        # routed through the Pass registry so PassBuilder pipelines can
+        # inspect/reorder/disable the AMP rewrite (ir.py amp_rewrite_pass)
+        from ...ir import get_pass
+
+        get_pass(
+            "amp_rewrite_pass",
+            amp_lists=self._amp_lists,
+            use_bf16=self._use_bf16,
+        ).apply_program(loss.block.program)
         self._loss_scaling = ltensor.create_global_var(
             name="loss_scaling",
             shape=[1],

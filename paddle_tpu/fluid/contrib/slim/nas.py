@@ -84,6 +84,10 @@ class SAController(EvolutionaryController):
 
     def update(self, tokens, reward):
         self._iter += 1
+        if not math.isfinite(reward):
+            # a diverged candidate (NaN/inf loss) must not poison the
+            # annealing walk — treat it as the worst possible reward
+            reward = float("-inf")
         temperature = self._init_temperature * self._reduce_rate ** self._iter
         if (reward > self._reward) or (
             self._rng.random_sample()

@@ -166,13 +166,20 @@ def quant_aware(program, startup_program=None, weight_bits=8,
                 activation_bits=8, for_test=False,
                 weight_quantize_type="abs_max",
                 activation_quantize_type="moving_average_abs_max"):
-    """One-call QAT rewrite (the paddleslim-style facade)."""
-    QuantizationTransformPass(
+    """One-call QAT rewrite (the paddleslim-style facade), routed through
+    the Pass registry (ir.py quantization_transform_pass) so PassBuilder
+    pipelines see it like any other pass."""
+    from ....ir import get_pass
+
+    get_pass(
+        "quantization_transform_pass",
         weight_bits=weight_bits,
         activation_bits=activation_bits,
         weight_quantize_type=weight_quantize_type,
         activation_quantize_type=activation_quantize_type,
-    ).apply(program, startup_program, for_test=for_test)
+        for_test=for_test,
+        startup_program=startup_program,
+    ).apply_program(program)
     return program
 
 

@@ -247,8 +247,11 @@ def test_downpour_trainer_dataset_sparse_async():
 def test_rpc_retry_dedup_barrier_and_async_send():
     """ADVICE r3 (native.py _with_retry): a mutating RPC retried after an
     ambiguous failure must not be applied twice. The client re-sends the
-    same per-operation seq; the server's per-trainer high-water mark dedups
-    it (rpc.cpp handle_conn). Exercised at the wire level by issuing the
+    same per-operation seq; the server dedups by EXACT match in a bounded
+    per-trainer window (NOT a high-water mark — out-of-order seqs from
+    concurrent client threads and randomly reseeded restarted trainers must
+    never be mistaken for duplicates; rpc.cpp handle_conn). Exercised at
+    the wire level by issuing the
     SAME seq twice: a duplicated send_barrier must leave send_counts at 1
     (a double increment would wedge the sync-mode kGetVar predicate), and a
     duplicated async send_var must enqueue one gradient, not two."""

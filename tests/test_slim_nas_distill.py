@@ -173,7 +173,10 @@ def test_light_nas_search_loop():
         def create_net(self, tokens):
             main, startup = fluid.Program(), fluid.Program()
             main.random_seed = startup.random_seed = 42
-            with fluid.program_guard(main, startup):
+            # unique_name.guard: param names (which salt the seeded init)
+            # must not depend on how many layers earlier tests created
+            with fluid.unique_name.guard(), fluid.program_guard(main,
+                                                                startup):
                 x = fluid.layers.data(name="x", shape=[6], dtype="float32")
                 y = fluid.layers.data(name="y", shape=[1], dtype="float32")
                 h = fluid.layers.fc(input=x, size=self.widths[tokens[0]],
@@ -182,7 +185,7 @@ def test_light_nas_search_loop():
                 loss = fluid.layers.mean(
                     fluid.layers.square_error_cost(input=pred, label=y)
                 )
-                fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+                fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
             return main, None, startup, [loss], [loss]
 
     rng = np.random.RandomState(0)
