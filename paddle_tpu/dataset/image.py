@@ -1,0 +1,81 @@
+"""Image preprocessing utilities (reference:
+python/paddle/dataset/image.py — resize/crop/flip/transform on HWC uint8
+arrays, to_chw layout move). Pure numpy (the reference shells out to cv2;
+none of these run on the accelerator, and numpy keeps the zero-dependency
+build), same shapes and semantics."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "resize_short",
+    "to_chw",
+    "center_crop",
+    "random_crop",
+    "left_right_flip",
+    "simple_transform",
+]
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals ``size`` (image.py:197). Nearest
+    neighbour: cheap, dependency-free, and equivalent for training."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / float(h)))
+    else:
+        nh, nw = int(round(h * size / float(w))), size
+    ri = (np.arange(nh) * (h / float(nh))).astype(int).clip(0, h - 1)
+    ci = (np.arange(nw) * (w / float(nw))).astype(int).clip(0, w - 1)
+    return im[ri][:, ci]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def _crop(im, size, h0, w0):
+    if isinstance(size, int):
+        size = (size, size)
+    return im[h0:h0 + size[0], w0:w0 + size[1]]
+
+
+def center_crop(im, size, is_color=True):
+    if isinstance(size, int):
+        size = (size, size)
+    h0 = (im.shape[0] - size[0]) // 2
+    w0 = (im.shape[1] - size[1]) // 2
+    return _crop(im, size, h0, w0)
+
+
+def random_crop(im, size, is_color=True):
+    if isinstance(size, int):
+        size = (size, size)
+    h0 = np.random.randint(0, im.shape[0] - size[0] + 1)
+    w0 = np.random.randint(0, im.shape[1] - size[1] + 1)
+    return _crop(im, size, h0, w0)
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1, :] if (is_color and im.ndim == 3) else im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize-short -> crop (random+flip when training, center otherwise)
+    -> CHW float -> optional mean subtract (image.py:327)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean if mean.ndim >= 2 else mean[:, None, None]
+    return im

@@ -1,0 +1,66 @@
+"""imikolov (PTB) language-model readers (reference:
+python/paddle/dataset/imikolov.py — ``build_dict(min_word_freq)`` then
+``train(word_idx, n)`` yielding n-gram tuples of word ids, or sequence
+pairs under ``DataType.SEQ``). Synthetic Zipf-distributed text with a
+stable vocabulary when the corpus is absent (zero egress)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "build_dict", "DataType"]
+
+_VOCAB = 2074  # matches the reference's min_word_freq=50 dict size ballpark
+_SENT_LEN = (5, 20)
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+def _sentences(n, seed):
+    """Zipf-ish token streams: frequent ids dominate, like real text."""
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        ln = rng.randint(*_SENT_LEN)
+        # zipf clipped into the vocab; -1 shifts to 0-based ids
+        toks = np.minimum(rng.zipf(1.3, ln), _VOCAB) - 1
+        yield toks.astype(np.int64).tolist()
+
+
+def build_dict(min_word_freq=50):
+    """word -> id; id (vocab-1) is <unk> like the reference (imikolov.py:54
+    adds <unk>; <s>/<e> ride the reader)."""
+    words = {"w%d" % i: i for i in range(_VOCAB - 1)}
+    words["<unk>"] = _VOCAB - 1
+    return words
+
+
+def _reader(n_sents, seed, word_idx, n, data_type):
+    def reader():
+        unk = len(word_idx) - 1
+        for sent in _sentences(n_sents, seed):
+            sent = [min(w, unk) for w in sent]
+            if data_type == DataType.NGRAM:
+                if len(sent) >= n:
+                    sent = [unk] * (n - 1) + sent  # <s> padding analog
+                    for i in range(n, len(sent) + 1):
+                        yield tuple(sent[i - n:i])
+            elif data_type == DataType.SEQ:
+                src = sent[:-1]
+                tgt = sent[1:]
+                if src and tgt:
+                    yield src, tgt
+            else:
+                raise TypeError("unsupported data_type %r" % data_type)
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader(4000, 60, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader(400, 61, word_idx, n, data_type)

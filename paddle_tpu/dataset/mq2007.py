@@ -1,0 +1,71 @@
+"""MQ2007 learning-to-rank readers (reference:
+python/paddle/dataset/mq2007.py — ``train(format=...)`` generators over
+query groups in pointwise / pairwise / listwise form, 46-dim features,
+relevance labels in {0,1,2}). Synthetic query groups when the corpus is
+absent (zero egress): relevance is a noisy linear function of the
+features, so ranking losses genuinely order documents."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+FEATURE_DIM = 46
+_DOCS_PER_QUERY = (5, 15)
+
+_w = None
+
+
+def _score_weights():
+    global _w
+    if _w is None:
+        _w = np.random.RandomState(7).uniform(-1, 1, FEATURE_DIM).astype(
+            np.float64
+        )
+    return _w
+
+
+def _query_groups(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    w = _score_weights()
+    for _ in range(n_queries):
+        nd = rng.randint(*_DOCS_PER_QUERY)
+        feats = rng.uniform(0, 1, (nd, FEATURE_DIM))
+        score = feats @ w + rng.normal(0, 0.1, nd)
+        # bucket scores into relevance {0, 1, 2} like the corpus labels
+        q = np.quantile(score, [0.5, 0.85])
+        labels = (score > q[0]).astype(int) + (score > q[1]).astype(int)
+        yield labels, feats.astype(np.float32)
+
+
+def _reader(n_queries, seed, format, fill_missing=-1):
+    def reader():
+        for labels, feats in _query_groups(n_queries, seed):
+            if format == "pointwise":
+                for i in range(len(labels)):
+                    yield float(labels[i]), feats[i]
+            elif format == "pairwise":
+                # all ordered pairs with strictly higher relevance first
+                # (reference gen_pair, mq2007.py:188)
+                for i in range(len(labels)):
+                    for j in range(len(labels)):
+                        if labels[i] > labels[j]:
+                            yield 1.0, feats[i], feats[j]
+            elif format == "listwise":
+                yield [float(l) for l in labels], feats
+            elif format == "plain_txt":
+                for i in range(len(labels)):
+                    yield "qid", float(labels[i]), feats[i]
+            else:
+                raise ValueError("unknown format %r" % format)
+
+    return reader
+
+
+def train(format="pairwise", shuffle=False, fill_missing=-1):
+    return _reader(300, seed=90, format=format, fill_missing=fill_missing)
+
+
+def test(format="pairwise", shuffle=False, fill_missing=-1):
+    return _reader(50, seed=91, format=format, fill_missing=fill_missing)

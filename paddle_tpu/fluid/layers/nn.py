@@ -15,6 +15,7 @@ from ..param_attr import ParamAttr
 __all__ = [
     "hsigmoid",
     "nce",
+    "cos_sim",
     "scale",
     "sequence_pool",
     "sequence_first_step",
@@ -1339,6 +1340,21 @@ def _seq_one_in(op_type, x, attrs=None, out_slot="Out", extra_inputs=None,
         outputs.update(extra_outputs)
     helper.append_op(
         type=op_type, inputs=inputs, outputs=outputs, attrs=attrs or {}
+    )
+    return out
+
+
+def cos_sim(X, Y):
+    """Row-wise cosine similarity (reference: layers/nn.py cos_sim over
+    cos_sim_op.cc); Y may have batch 1 and broadcast against X."""
+    helper = LayerHelper("cos_sim", **locals())
+    out = helper.create_variable_for_type_inference(dtype=X.dtype)
+    xnorm = helper.create_variable_for_type_inference(dtype=X.dtype)
+    ynorm = helper.create_variable_for_type_inference(dtype=X.dtype)
+    helper.append_op(
+        type="cos_sim",
+        inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xnorm], "YNorm": [ynorm]},
     )
     return out
 

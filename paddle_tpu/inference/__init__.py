@@ -425,11 +425,13 @@ class AnalysisPredictor(object):
                 "needs_rng": needs_rng,
             })
             xla_i += 1
-            # execute for real so downstream segments see concrete values
+            # execute for real so downstream segments see concrete values —
+            # through the just-exported executable, not the raw per-op
+            # interpreter (which would re-lower the whole segment eagerly)
             call_args = list(feed_vals) + list(mutable_vals) + list(extra_vals)
             if needs_rng:
                 call_args.append(jax.random.key_data(rng))
-            outs = efn(*call_args)
+            outs = exported.call(*call_args)
             for n, v in zip(plan["outs"], outs):
                 local_env[n] = v
 
@@ -552,7 +554,7 @@ class _ExecutablePredictor(object):
                 if self._bridge_block is None:
                     raise RuntimeError("bundle has host segments but no "
                                        "bridge program")
-                scope = _BundleScope(self._state)
+                scope = _BundleScope(self._state, self._persistable)
                 for i in s["op_indices"]:
                     _run_host_op(
                         self._bridge_block.ops[i], scope, core.CPUPlace(),
@@ -609,16 +611,22 @@ class _ExecutablePredictor(object):
 
 
 class _BundleScope(object):
-    """Minimal Scope view over the bundle's state dict for host-op replay."""
+    """Minimal Scope view over the bundle's state dict for host-op replay.
+    Only PERSISTABLE writes reach the cross-run state — host-op
+    intermediates already land in the run's local_env, and letting them
+    linger in the state would grow it unboundedly and mask a later run's
+    missing-input error with a stale value."""
 
-    def __init__(self, state):
+    def __init__(self, state, persistable):
         self._state = state
+        self._persistable = persistable
 
     def get(self, name, default=None):
         return self._state.get(name, default)
 
     def set(self, name, value):
-        self._state[name] = value
+        if name in self._persistable:
+            self._state[name] = value
 
 
 def create_paddle_predictor(config):

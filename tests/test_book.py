@@ -285,4 +285,261 @@ def test_book_understand_sentiment_lstm():
     assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
+def _lod(arr, lengths):
+    t = fluid.core.LoDTensor(arr)
+    t.set_recursive_sequence_lengths([lengths])
+    return t
+
+
+def test_book_recommender_system():
+    """reference: tests/book/test_recommender_system.py — user-tower and
+    movie-tower embeddings (category/title as LoD sum-pooled sequences),
+    cos_sim match score scaled to the 1..5 rating range, square error."""
+    ml = dataset.movielens
+    EMB = 16
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 90
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+        gender = fluid.layers.data(name="gender_id", shape=[1], dtype="int64")
+        age = fluid.layers.data(name="age_id", shape=[1], dtype="int64")
+        job = fluid.layers.data(name="job_id", shape=[1], dtype="int64")
+        mid = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+        # padded-LoD convention (test_multilevel_lod): [N, T, 1] feeds
+        cats = fluid.layers.data(name="category_id", shape=[4, 1],
+                                 dtype="int64", lod_level=1)
+        title = fluid.layers.data(name="movie_title", shape=[6, 1],
+                                  dtype="int64", lod_level=1)
+        rating = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+        def tower(parts):
+            fcs = [fluid.layers.fc(input=p, size=32) for p in parts]
+            return fluid.layers.fc(
+                input=fluid.layers.concat(fcs, axis=1), size=64, act="tanh"
+            )
+
+        usr = tower([
+            fluid.layers.embedding(uid, size=[ml.max_user_id() + 1, EMB]),
+            fluid.layers.embedding(gender, size=[2, EMB]),
+            fluid.layers.embedding(age, size=[ml.AGE_BUCKETS, EMB]),
+            fluid.layers.embedding(job, size=[ml.max_job_id() + 1, EMB]),
+        ])
+        cat_emb = fluid.layers.embedding(cats, size=[ml.CATEGORIES, EMB])
+        title_emb = fluid.layers.embedding(title, size=[ml.TITLE_VOCAB, EMB])
+        mov = tower([
+            fluid.layers.embedding(mid, size=[ml.max_movie_id() + 1, EMB]),
+            fluid.layers.sequence_pool(cat_emb, "sum"),
+            fluid.layers.sequence_pool(title_emb, "sum"),
+        ])
+        sim = fluid.layers.cos_sim(X=usr, Y=mov)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=rating)
+        )
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+
+    def batches(n_batches, bs=16):
+        it = ml.train()()
+        for _ in range(n_batches):
+            rows = [next(it) for _ in range(bs)]
+            ids = {
+                k: np.array([r[i] for r in rows], np.int64)
+                for i, k in enumerate(
+                    ["user_id", "gender_id", "age_id", "job_id", "movie_id"]
+                )
+            }
+            feed = dict(ids)
+
+            def ragged(col, t):
+                lens = [min(len(r[col]), t) for r in rows]
+                pad = np.zeros((bs, t, 1), np.int64)
+                for j, r in enumerate(rows):
+                    pad[j, :lens[j], 0] = r[col][:t]
+                return _lod(pad, lens)
+
+            feed["category_id"] = ragged(5, 4)
+            feed["movie_title"] = ragged(6, 6)
+            feed["score"] = np.array([r[7] for r in rows], np.float32)
+            yield feed
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for feed in batches(30):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_book_machine_translation():
+    """reference: tests/book/test_machine_translation.py — seq2seq with
+    attention on wmt14: GRU encoder, per-step dot attention over encoder
+    states, teacher-forced decoder, cross-entropy; greedy decode produces
+    token ids after training on the deterministic synthetic corpus."""
+    V, EMB, HID, TS, TT = 60, 16, 32, 8, 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 91
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[TS], dtype="int64")
+        tgt_in = fluid.layers.data(name="tgt_in", shape=[TT], dtype="int64")
+        tgt_out = fluid.layers.data(name="tgt_out", shape=[TT, 1],
+                                    dtype="int64")
+        semb = fluid.layers.embedding(src, size=[V, EMB])
+        enc_proj = fluid.layers.fc(input=semb, size=3 * HID,
+                                   num_flatten_dims=2)
+        enc = fluid.layers.dynamic_gru(enc_proj, size=HID)  # [N, TS, HID]
+        temb = fluid.layers.embedding(tgt_in, size=[V, EMB])
+        dec_proj = fluid.layers.fc(input=temb, size=3 * HID,
+                                   num_flatten_dims=2)
+        dec = fluid.layers.dynamic_gru(dec_proj, size=HID)  # [N, TT, HID]
+        # dot attention: scores [N, TT, TS] -> context [N, TT, HID]
+        scores = fluid.layers.matmul(dec, enc, transpose_y=True)
+        attn = fluid.layers.softmax(scores)
+        ctx = fluid.layers.matmul(attn, enc)
+        feat = fluid.layers.concat([dec, ctx], axis=2)
+        logits = fluid.layers.fc(input=feat, size=V, num_flatten_dims=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, tgt_out)
+        )
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    def batch_iter(n_batches, bs=16):
+        it = dataset.wmt14.train(V, V)()
+        for _ in range(n_batches):
+            rows = [next(it) for _ in range(bs)]
+
+            def pad(col, t):
+                out = np.ones((bs, t), np.int64)  # EOS pad
+                for j, r in enumerate(rows):
+                    seq = r[col][:t]
+                    out[j, :len(seq)] = seq
+                return out
+
+            yield {
+                "src": pad(0, TS),
+                "tgt_in": pad(1, TT),
+                "tgt_out": pad(2, TT)[..., None],
+            }
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for feed in batch_iter(40):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+    # greedy decode from the trained graph (fixed-shape decode program:
+    # feed the prefix, read the next-token argmax — TPU-friendly form of
+    # the book's step-wise decoder loop)
+    infer = main.clone(for_test=True)
+    feed = next(batch_iter(1, bs=4))
+    prefix = np.zeros((4, TT), np.int64)  # BOS = 0
+    for t in range(TT - 1):
+        (lg,) = exe.run(
+            infer,
+            feed={"src": feed["src"], "tgt_in": prefix,
+                  "tgt_out": feed["tgt_out"]},
+            fetch_list=[logits],
+        )
+        nxt = np.asarray(lg)[:, t, :].argmax(-1)
+        prefix[:, t + 1] = nxt
+    # decode smoke: valid ids, not all BOS/EOS (the reference book test
+    # gates on training cost, not decode accuracy — test_machine_translation
+    # asserts cost < threshold then runs the decoder for shape sanity)
+    assert prefix.min() >= 0 and prefix.max() < V
+    assert (prefix[:, 1:] > 2).any(), prefix
+
+
+def test_book_label_semantic_roles():
+    """reference: tests/book/test_label_semantic_roles.py — SRL on conll05:
+    8 feature embeddings, stacked bidirectional LSTM, per-token emission,
+    linear_chain_crf loss + crf_decoding viterbi labels."""
+    co = dataset.conll05
+    WORD_V, LAB_V, PRED_V = 200, 12, 40  # compacted synthetic vocabs
+    EMB, HID, T = 12, 16, 10
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 92
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        slots = [
+            fluid.layers.data(name=n, shape=[T], dtype="int64")
+            for n in ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+                      "ctx_p2", "verb", "mark"]
+        ]
+        label = fluid.layers.data(name="label", shape=[T, 1], dtype="int64")
+        length = fluid.layers.data(name="length", shape=[1], dtype="int64")
+        embs = [
+            fluid.layers.embedding(
+                s,
+                size=[
+                    PRED_V if n == "verb" else (2 if n == "mark" else WORD_V),
+                    EMB,
+                ],
+            )
+            for s, n in zip(slots, ["word", "ctx_n2", "ctx_n1", "ctx_0",
+                                    "ctx_p1", "ctx_p2", "verb", "mark"])
+        ]
+        x = fluid.layers.concat(embs, axis=2)
+        fwd_in = fluid.layers.fc(input=x, size=4 * HID, num_flatten_dims=2)
+        fwd, _ = fluid.layers.dynamic_lstm(fwd_in, size=4 * HID,
+                                           use_peepholes=False)
+        bwd_in = fluid.layers.fc(input=x, size=4 * HID, num_flatten_dims=2)
+        bwd, _ = fluid.layers.dynamic_lstm(bwd_in, size=4 * HID,
+                                           use_peepholes=False,
+                                           is_reverse=True)
+        feat = fluid.layers.concat([fwd, bwd], axis=2)
+        emission = fluid.layers.fc(input=feat, size=LAB_V,
+                                   num_flatten_dims=2)
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=emission, label=label,
+            param_attr=fluid.ParamAttr(name="crfw"), length=length,
+        )
+        loss = fluid.layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        decode = fluid.layers.crf_decoding(
+            input=emission, param_attr=fluid.ParamAttr(name="crfw"),
+            length=length,
+        )
+
+    def batch_iter(n_batches, bs=8):
+        it = co.train()()
+        for _ in range(n_batches):
+            rows = [next(it) for _ in range(bs)]
+            feed = {}
+            names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+                     "ctx_p2", "verb", "mark"]
+            caps = {"word": WORD_V, "ctx_n2": WORD_V, "ctx_n1": WORD_V,
+                    "ctx_0": WORD_V, "ctx_p1": WORD_V, "ctx_p2": WORD_V,
+                    "verb": PRED_V, "mark": 2}
+            for col, n in enumerate(names):
+                pad = np.zeros((bs, T), np.int64)
+                for j, r in enumerate(rows):
+                    seq = [v % caps[n] for v in r[col][:T]]
+                    pad[j, :len(seq)] = seq
+                feed[n] = pad
+            lab = np.zeros((bs, T, 1), np.int64)
+            lens = np.zeros((bs, 1), np.int64)
+            for j, r in enumerate(rows):
+                seq = [v % LAB_V for v in r[8][:T]]
+                lab[j, :len(seq), 0] = seq
+                lens[j, 0] = len(seq)
+            feed["label"] = lab
+            feed["length"] = lens
+            yield feed
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for feed in batch_iter(25):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    # viterbi decode emits in-range labels with the trained transitions
+    (path,) = exe.run(main, feed=feed, fetch_list=[decode])
+    path = np.asarray(path)
+    assert path.shape[0] == 8 and (path >= 0).all() and (path < LAB_V).all()
+
+
 _ = (os, pt_reader)

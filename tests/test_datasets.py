@@ -1,0 +1,99 @@
+"""Dataset corpus surface tests (VERDICT r3 #5 missing-datasets item):
+every reference dataset module exists with the reference reader interface,
+deterministic samples, and the documented shapes/dtypes."""
+
+import numpy as np
+
+import paddle_tpu.dataset as dataset
+
+
+def _first(reader, n=3):
+    out = []
+    for s in reader():
+        out.append(s)
+        if len(out) == n:
+            break
+    return out
+
+
+def test_imikolov_ngram_and_seq():
+    d = dataset.imikolov.build_dict()
+    assert "<unk>" in d
+    grams = _first(dataset.imikolov.train(d, 5), 10)
+    assert all(len(g) == 5 for g in grams)
+    assert all(0 <= w < len(d) for g in grams for w in g)
+    seqs = _first(
+        dataset.imikolov.test(d, 5, dataset.imikolov.DataType.SEQ), 4
+    )
+    for src, tgt in seqs:
+        assert len(src) == len(tgt) and len(src) > 0
+    # deterministic across invocations
+    again = _first(dataset.imikolov.train(d, 5), 10)
+    assert grams == again
+
+
+def test_flowers_shapes_and_labels():
+    samples = _first(dataset.flowers.train(), 5)
+    for img, label in samples:
+        assert img.shape[0] == 3 and img.dtype == np.float32
+        assert 0 <= label < dataset.flowers.CLASS_NUM
+    v = _first(dataset.flowers.valid(), 2)
+    assert len(v) == 2
+
+
+def test_voc2012_segmentation_pairs():
+    for img, mask in _first(dataset.voc2012.train(), 4):
+        assert img.shape[0] == 3 and img.dtype == np.float32
+        assert mask.shape == img.shape[1:] and mask.dtype == np.int32
+        assert mask.min() >= 0 and mask.max() < dataset.voc2012.CLASS_NUM
+    assert len(_first(dataset.voc2012.val(), 2)) == 2
+
+
+def test_mq2007_formats():
+    pairs = _first(dataset.mq2007.train(format="pairwise"), 6)
+    for lab, left, right in pairs:
+        assert lab == 1.0
+        assert left.shape == (dataset.mq2007.FEATURE_DIM,)
+        assert right.shape == (dataset.mq2007.FEATURE_DIM,)
+    points = _first(dataset.mq2007.test(format="pointwise"), 6)
+    for lab, feat in points:
+        assert lab in (0.0, 1.0, 2.0)
+        assert feat.shape == (dataset.mq2007.FEATURE_DIM,)
+    lists = _first(dataset.mq2007.train(format="listwise"), 2)
+    for labs, feats in lists:
+        assert len(labs) == feats.shape[0]
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    pattern = str(tmp_path / "part-%05d.pickle")
+
+    def reader():
+        for i in range(10):
+            yield (i, i * i)
+
+    dataset.common.split(reader, 4, suffix=pattern)
+    got = list(
+        dataset.common.cluster_files_reader(
+            str(tmp_path / "part-*.pickle"), trainer_count=1, trainer_id=0
+        )()
+    )
+    assert got == [(i, i * i) for i in range(10)]
+    # round-robin sharding across two trainers covers everything once
+    a = list(dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 0)())
+    b = list(dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 1)())
+    assert sorted(a + b) == [(i, i * i) for i in range(10)]
+
+
+def test_image_transforms():
+    im = np.arange(40 * 30 * 3, dtype=np.uint8).reshape(40, 30, 3)
+    r = dataset.image.resize_short(im, 24)
+    assert min(r.shape[:2]) == 24
+    c = dataset.image.center_crop(r, 20)
+    assert c.shape[:2] == (20, 20)
+    chw = dataset.image.simple_transform(im, 24, 20, is_train=False,
+                                         mean=[1.0, 2.0, 3.0])
+    assert chw.shape == (3, 20, 20) and chw.dtype == np.float32
+    t = dataset.image.simple_transform(im, 24, 20, is_train=True)
+    assert t.shape == (3, 20, 20)

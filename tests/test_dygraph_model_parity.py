@@ -72,7 +72,7 @@ def test_dygraph_static_parity_mnist():
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 5
-    with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
         x = fluid.layers.data(name="x", shape=[1, 12, 12], dtype="float32")
         y = fluid.layers.data(name="y", shape=[1], dtype="int64")
         h = fluid.layers.conv2d(x, num_filters=4, filter_size=3, act="relu")
@@ -125,8 +125,6 @@ def test_dygraph_static_parity_mnist():
 def _scope_of_init(main, startup, seed):
     """Fresh scope holding exactly the startup-program init values (the
     static run above has already stepped its own scope's params)."""
-    prog_s = fluid.Program()
-    prog_s.random_seed = seed
     scope = fluid.core.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(scope):
@@ -196,7 +194,7 @@ def test_dygraph_static_parity_resnet():
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 6
-    with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
         x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
         y = fluid.layers.data(name="y", shape=[1], dtype="int64")
         h = fluid.layers.conv2d(x, num_filters=8, filter_size=3, padding=1,
@@ -336,7 +334,7 @@ def test_dygraph_static_parity_ptb_lstm():
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 7
-    with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
         tokens = fluid.layers.data(name="tokens", shape=[SEQ], dtype="int64")
         labels = fluid.layers.data(name="labels", shape=[SEQ, 1],
                                    dtype="int64")
