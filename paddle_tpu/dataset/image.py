@@ -77,5 +77,10 @@ def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
     im = im.astype(np.float32)
     if mean is not None:
         mean = np.asarray(mean, np.float32)
-        im -= mean if mean.ndim >= 2 else mean[:, None, None]
+        # per-channel means only reshape for CHW images; scalar/grayscale
+        # means subtract directly (reference image.py:375 special-cases
+        # the non-color path)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
     return im

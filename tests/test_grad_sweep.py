@@ -1,0 +1,601 @@
+"""Finite-difference gradient sweep over the op corpus (VERDICT r3 #4).
+
+The reference's OpTest harness grad-checks nearly every differentiable op
+(python/paddle/fluid/tests/unittests/op_test.py:896 check_grad). This sweep
+closes the same bar here: every registered op is either
+
+  * grad-checked — by a compact case in ``CASES`` below (analytic grad via
+    the real grad makers / append_backward vs central finite differences of
+    the op's own forward, tests/op_test.py), or by a dedicated test
+    elsewhere in the suite (scanned from the test sources), or
+  * dispositioned — ``DISPOSITIONS`` records WHY a finite-difference check
+    is not applicable (no grad maker by design, integer/selection output,
+    stochastic, collective context, control-flow engine, ...), in the same
+    auditable style as OPS_AUDIT.md.
+
+``test_every_op_is_checked_or_dispositioned`` enforces that the accounting
+is total: a newly registered op fails the suite until it is covered.
+"""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+from paddle_tpu.fluid.ops import registry
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def U(seed, shape, lo=-1.0, hi=1.0, dtype="float32"):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype(dtype)
+
+
+def I(seed, shape, lo, hi):
+    return np.random.RandomState(seed).randint(lo, hi, shape).astype("int64")
+
+
+def away(x, points, gap=0.15):
+    """Push values away from non-smooth points so central differences
+    don't straddle a kink."""
+    x = np.asarray(x, np.float64)
+    for p in points:
+        m = np.abs(x - p) < gap
+        x = np.where(m, p + np.where(x >= p, gap, -gap), x)
+    return x.astype("float32")
+
+
+def Z(*shape):
+    """Output placeholder: check_grad only uses outputs for slot naming."""
+    return np.zeros(shape, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# case table: op_type -> spec
+#   inputs / attrs / outputs : as in OpTest
+#   check : input slots to grad-check (default: all float inputs)
+#   outs  : output slots the objective sums over (default: ["Out"])
+#   tol / delta / max_elements : tolerances and FD budget
+# ---------------------------------------------------------------------------
+
+X34 = U(1, (3, 4))
+CASES = {}
+
+
+def case(op, **spec):
+    assert op not in CASES, op
+    spec.setdefault("attrs", {})
+    spec.setdefault("outputs", {"Out": Z(1)})
+    spec.setdefault("outs", list(spec["outputs"]))
+    CASES[op] = spec
+
+
+# -- unary elementwise -------------------------------------------------------
+_UNARY = {
+    "abs": away(U(2, (3, 4)), [0.0]),
+    "acos": U(3, (3, 4), -0.8, 0.8),
+    "asin": U(4, (3, 4), -0.8, 0.8),
+    "atan": U(5, (3, 4), -2, 2),
+    "brelu": away(U(6, (3, 4), 1.0, 20.0), [0.0, 24.0]),
+    "ceil": U(7, (3, 4), 0.1, 0.9) + np.arange(12).reshape(3, 4),
+    "cos": U(8, (3, 4), -2, 2),
+    "elu": away(U(9, (3, 4), -2, 2), [0.0]),
+    "erf": U(10, (3, 4), -2, 2),
+    "exp": U(11, (3, 4), -1, 1),
+    "floor": U(12, (3, 4), 0.1, 0.9) + np.arange(12).reshape(3, 4),
+    "gelu": U(13, (3, 4), -2, 2),
+    "hard_shrink": away(U(14, (3, 4), -2, 2), [-0.5, 0.5]),
+    "hard_sigmoid": away(U(15, (3, 4), -2, 2), [-2.5, 2.5]),
+    "hard_swish": away(U(16, (3, 4), -5, 5), [-3.0, 3.0]),
+    "leaky_relu": away(U(17, (3, 4), -2, 2), [0.0]),
+    "log": U(18, (3, 4), 0.5, 3.0),
+    "logsigmoid": U(19, (3, 4), -2, 2),
+    "reciprocal": U(20, (3, 4), 0.5, 2.0),
+    "relu6": away(U(21, (3, 4), 0.5, 5.5), [0.0, 6.0]),
+    "round": U(22, (3, 4), 0.1, 0.4) + np.arange(12).reshape(3, 4),
+    "rsqrt": U(23, (3, 4), 0.5, 2.0),
+    "sin": U(24, (3, 4), -2, 2),
+    "soft_relu": U(25, (3, 4), -2, 2),
+    "softplus": U(26, (3, 4), -2, 2),
+    "softshrink": away(U(27, (3, 4), -2, 2), [-0.5, 0.5]),
+    "softsign": U(28, (3, 4), -2, 2),
+    "sqrt": U(29, (3, 4), 0.5, 3.0),
+    "square": U(30, (3, 4), -2, 2),
+    "stanh": U(31, (3, 4), -2, 2),
+    "swish": U(32, (3, 4), -2, 2),
+    "tanh_shrink": U(33, (3, 4), -2, 2),
+    "thresholded_relu": away(U(34, (3, 4), -2, 2), [1.0]),
+}
+for _op, _x in _UNARY.items():
+    case(_op, inputs={"X": _x}, outputs={"Out": Z(3, 4)})
+
+case("scale", inputs={"X": U(35, (3, 4))}, outputs={"Out": Z(3, 4)},
+     attrs={"scale": 1.7, "bias": 0.3})
+case("pow", inputs={"X": U(36, (3, 4), 0.5, 2.0)},
+     outputs={"Out": Z(3, 4)}, attrs={"factor": 2.5})
+case("clip", inputs={"X": away(U(37, (3, 4), -1, 1), [-0.6, 0.6])},
+     outputs={"Out": Z(3, 4)}, attrs={"min": -0.6, "max": 0.6})
+case("clip_by_norm", inputs={"X": U(38, (3, 4), 0.5, 1.0)},
+     outputs={"Out": Z(3, 4)}, attrs={"max_norm": 1.0})
+case("cast", inputs={"X": U(39, (3, 4))}, outputs={"Out": Z(3, 4)},
+     attrs={"in_dtype": 5, "out_dtype": 5})
+case("label_smooth", inputs={"X": U(40, (3, 4), 0.0, 1.0)},
+     outputs={"Out": Z(3, 4)}, attrs={"epsilon": 0.1})
+case("l2_normalize", inputs={"X": U(41, (3, 4), 0.5, 1.5)},
+     outputs={"Out": Z(3, 4), "Norm": Z(3, 1)}, outs=["Out"],
+     attrs={"axis": 1, "epsilon": 1e-10})
+case("l1_norm", inputs={"X": away(U(42, (3, 4)), [0.0])},
+     outputs={"Out": Z(1)})
+case("frobenius_norm", inputs={"X": U(43, (3, 4), 0.2, 1.0)},
+     outputs={"Out": Z(1)}, attrs={"dim": [0, 1], "keep_dim": False,
+                                   "reduce_all": True})
+case("squared_l2_norm", inputs={"X": U(44, (3, 4))}, outputs={"Out": Z(1)})
+case("cumsum", inputs={"X": U(45, (3, 4))}, outputs={"Out": Z(3, 4)},
+     attrs={"axis": 1})
+
+# -- binary elementwise ------------------------------------------------------
+_YSEP = U(46, (3, 4)) + np.where(U(47, (3, 4)) > 0, 0.6, -0.6)
+case("elementwise_max", inputs={"X": U(46, (3, 4)), "Y": _YSEP.astype("float32")},
+     outputs={"Out": Z(3, 4)})
+case("elementwise_min", inputs={"X": U(48, (3, 4)),
+                                "Y": (U(48, (3, 4)) + np.where(U(49, (3, 4)) > 0, 0.6, -0.6)).astype("float32")},
+     outputs={"Out": Z(3, 4)})
+case("elementwise_pow", inputs={"X": U(50, (3, 4), 0.5, 2.0),
+                                "Y": U(51, (3, 4), 0.5, 2.0)},
+     outputs={"Out": Z(3, 4)})
+case("maximum", inputs={"X": U(52, (3, 4)),
+                        "Y": (U(52, (3, 4)) + np.where(U(53, (3, 4)) > 0, 0.6, -0.6)).astype("float32")},
+     outputs={"Out": Z(3, 4)})
+case("dot", inputs={"X": U(54, (3, 4)), "Y": U(55, (3, 4))},
+     outputs={"Out": Z(3, 1)})
+case("bmm", inputs={"X": U(56, (2, 3, 4)), "Y": U(57, (2, 4, 2))},
+     outputs={"Out": Z(2, 3, 2)})
+
+# -- reductions --------------------------------------------------------------
+_RED = U(58, (3, 4)) + np.arange(12).reshape(3, 4) * 0.05  # unique extrema
+for _op in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+            "reduce_prod"):
+    case(_op, inputs={"X": (_RED + (2.0 if _op == "reduce_prod" else 0.0)).astype("float32")},
+         outputs={"Out": Z(3)}, attrs={"dim": [1], "keep_dim": False})
+
+# -- shape manipulation (grad = routing) ------------------------------------
+case("reshape", inputs={"X": U(60, (3, 4))}, outputs={"Out": Z(4, 3)},
+     attrs={"shape": [4, 3]})
+case("reshape2", inputs={"X": U(61, (3, 4))},
+     outputs={"Out": Z(4, 3), "XShape": Z(3, 4)}, outs=["Out"],
+     attrs={"shape": [4, 3]})
+case("flatten", inputs={"X": U(62, (2, 3, 2))}, outputs={"Out": Z(2, 6)},
+     attrs={"axis": 1})
+case("flatten2", inputs={"X": U(63, (2, 3, 2))},
+     outputs={"Out": Z(2, 6), "XShape": Z(2, 3, 2)}, outs=["Out"],
+     attrs={"axis": 1})
+case("squeeze", inputs={"X": U(64, (3, 1, 4))}, outputs={"Out": Z(3, 4)},
+     attrs={"axes": [1]})
+case("squeeze2", inputs={"X": U(65, (3, 1, 4))},
+     outputs={"Out": Z(3, 4), "XShape": Z(3, 1, 4)}, outs=["Out"],
+     attrs={"axes": [1]})
+case("unsqueeze", inputs={"X": U(66, (3, 4))}, outputs={"Out": Z(3, 1, 4)},
+     attrs={"axes": [1]})
+case("unsqueeze2", inputs={"X": U(67, (3, 4))},
+     outputs={"Out": Z(3, 1, 4), "XShape": Z(3, 4)}, outs=["Out"],
+     attrs={"axes": [1]})
+case("transpose", inputs={"X": U(68, (3, 4))}, outputs={"Out": Z(4, 3)},
+     attrs={"axis": [1, 0]})
+case("transpose2", inputs={"X": U(69, (3, 4))},
+     outputs={"Out": Z(4, 3), "XShape": Z(3, 4)}, outs=["Out"],
+     attrs={"axis": [1, 0]})
+case("stack", inputs={"X": [("sx0", U(70, (3, 4))), ("sx1", U(71, (3, 4)))]},
+     outputs={"Y": Z(2, 3, 4)}, attrs={"axis": 0})
+case("unstack", inputs={"X": U(72, (2, 3, 4))},
+     outputs={"Y": [("uy0", Z(3, 4)), ("uy1", Z(3, 4))]}, outs=["Y"],
+     attrs={"axis": 0, "num": 2})
+case("concat", inputs={"X": [("cx0", U(73, (3, 2))), ("cx1", U(74, (3, 3)))]},
+     outputs={"Out": Z(3, 5)}, attrs={"axis": 1})
+case("split", inputs={"X": U(75, (3, 4))},
+     outputs={"Out": [("spo0", Z(3, 2)), ("spo1", Z(3, 2))]}, outs=["Out"],
+     attrs={"num": 2, "axis": 1})
+case("expand", inputs={"X": U(76, (3, 1))}, outputs={"Out": Z(3, 4)},
+     attrs={"expand_times": [1, 4]})
+case("gather", inputs={"X": U(77, (5, 3)), "Index": I(78, (4,), 0, 5)},
+     outputs={"Out": Z(4, 3)}, check=["X"])
+case("scatter", inputs={"X": U(79, (5, 3)),
+                        "Ids": np.array([1, 3], np.int64),
+                        "Updates": U(80, (2, 3))},
+     outputs={"Out": Z(5, 3)}, check=["X", "Updates"])
+case("scatter_nd", inputs={"Index": np.array([[1], [3]], np.int64),
+                           "Updates": U(81, (2, 3))},
+     outputs={"Out": Z(5, 3)}, check=["Updates"],
+     attrs={"shape": [5, 3]})
+case("slice", inputs={"Input": U(82, (4, 5))}, outputs={"Out": Z(2, 3)},
+     attrs={"axes": [0, 1], "starts": [1, 1], "ends": [3, 4]})
+case("pad", inputs={"X": U(83, (3, 4))}, outputs={"Out": Z(5, 6)},
+     attrs={"paddings": [1, 1, 1, 1], "pad_value": 0.0})
+case("pad2d", inputs={"X": U(84, (2, 3, 4, 4))},
+     outputs={"Out": Z(2, 3, 6, 6)},
+     attrs={"paddings": [1, 1, 1, 1], "mode": "constant",
+            "pad_value": 0.0, "data_format": "NCHW"})
+case("reverse", inputs={"X": U(85, (3, 4))}, outputs={"Out": Z(3, 4)},
+     attrs={"axis": [1]})
+case("crop_tensor", inputs={"X": U(86, (4, 5))}, outputs={"Out": Z(2, 3)},
+     attrs={"offsets": [1, 1], "shape": [2, 3]})
+case("shuffle_channel", inputs={"X": U(87, (2, 4, 3, 3))},
+     outputs={"Out": Z(2, 4, 3, 3)}, attrs={"group": 2})
+case("assign", inputs={"X": U(88, (3, 4))}, outputs={"Out": Z(3, 4)})
+case("share_data", inputs={"X": U(89, (3, 4))}, outputs={"Out": Z(3, 4)})
+case("sum", inputs={"X": [("sux0", U(90, (3, 4))), ("sux1", U(91, (3, 4)))]},
+     outputs={"Out": Z(3, 4)})
+case("multiplex", inputs={"X": [("mpa", U(92, (3, 4))), ("mpb", U(93, (3, 4)))],
+                          "Ids": np.array([[0], [1], [0]], np.int64)},
+     outputs={"Out": Z(3, 4)}, check=["X"])
+case("where", inputs={"Condition": (U(94, (3, 4)) > 0),
+                      "X": U(95, (3, 4)), "Y": U(96, (3, 4))},
+     outputs={"Out": Z(3, 4)}, check=["X", "Y"])
+
+
+# -- convolution / pooling / norm family ------------------------------------
+case("conv2d", inputs={"Input": U(100, (2, 3, 5, 5)),
+                       "Filter": U(101, (4, 3, 3, 3), -0.5, 0.5)},
+     outputs={"Output": Z(2, 4, 3, 3)}, outs=["Output"],
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1}, tol=0.02)
+case("depthwise_conv2d", inputs={"Input": U(102, (2, 3, 5, 5)),
+                                 "Filter": U(103, (3, 1, 3, 3), -0.5, 0.5)},
+     outputs={"Output": Z(2, 3, 3, 3)}, outs=["Output"],
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 3}, tol=0.02)
+case("conv2d_transpose", inputs={"Input": U(104, (2, 3, 4, 4)),
+                                 "Filter": U(105, (3, 4, 3, 3), -0.5, 0.5)},
+     outputs={"Output": Z(2, 4, 6, 6)}, outs=["Output"],
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1}, tol=0.02)
+case("depthwise_conv2d_transpose",
+     inputs={"Input": U(106, (2, 3, 4, 4)),
+             "Filter": U(107, (3, 1, 3, 3), -0.5, 0.5)},
+     outputs={"Output": Z(2, 3, 6, 6)}, outs=["Output"],
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 3}, tol=0.02)
+case("conv3d_transpose", inputs={"Input": U(108, (1, 2, 3, 3, 3)),
+                                 "Filter": U(109, (2, 3, 2, 2, 2), -0.5, 0.5)},
+     outputs={"Output": Z(1, 3, 4, 4, 4)}, outs=["Output"],
+     attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1], "groups": 1}, tol=0.02)
+case("fc", inputs={"Input": U(110, (3, 4)), "W": U(111, (4, 5)),
+                   "Bias": U(112, (5,))},
+     outputs={"Out": Z(3, 5)}, attrs={"in_num_col_dims": 1})
+case("pool2d", inputs={"X": U(113, (2, 3, 4, 4))},
+     outputs={"Out": Z(2, 3, 2, 2)},
+     attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0], "exclusive": True})
+_MP3 = (U(114, (1, 2, 4, 4, 4)) + np.arange(128).reshape(1, 2, 4, 4, 4) * 0.03)
+case("max_pool3d_with_index", inputs={"X": _MP3.astype("float32")},
+     outputs={"Out": Z(1, 2, 2, 2, 2), "Mask": Z(1, 2, 2, 2, 2)},
+     outs=["Out"],
+     attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+     tol=0.02)
+_BN_KW = dict(
+    inputs={"X": U(115, (2, 3, 4, 4)), "Scale": U(116, (3,), 0.5, 1.5),
+            "Bias": U(117, (3,)), "Mean": np.zeros(3, np.float32),
+            "Variance": np.ones(3, np.float32)},
+    outputs={"Y": Z(2, 3, 4, 4), "MeanOut": Z(3), "VarianceOut": Z(3),
+             "SavedMean": Z(3), "SavedVariance": Z(3)},
+    outs=["Y"], check=["X", "Scale", "Bias"],
+    attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+           "data_layout": "NCHW"},
+    tol=0.02,
+)
+case("batch_norm", **_BN_KW)
+case("sync_batch_norm", **_BN_KW)
+case("instance_norm", inputs={"X": U(118, (2, 3, 4, 4)),
+                              "Scale": U(119, (3,), 0.5, 1.5),
+                              "Bias": U(120, (3,))},
+     outputs={"Y": Z(2, 3, 4, 4), "SavedMean": Z(2, 3),
+              "SavedVariance": Z(2, 3)},
+     outs=["Y"], check=["X", "Scale", "Bias"],
+     attrs={"epsilon": 1e-5}, tol=0.02)
+case("data_norm", inputs={"X": U(121, (3, 4)),
+                          "BatchSize": np.full(4, 10.0, np.float32),
+                          "BatchSum": U(122, (4,)),
+                          "BatchSquareSum": np.full(4, 12.0, np.float32)},
+     outputs={"Y": Z(3, 4), "Means": Z(4), "Scales": Z(4)},
+     outs=["Y"], check=["X"], attrs={"epsilon": 1e-4})
+case("lrn", inputs={"X": U(123, (2, 4, 3, 3))},
+     outputs={"Out": Z(2, 4, 3, 3), "MidOut": Z(2, 4, 3, 3)}, outs=["Out"],
+     attrs={"n": 3, "k": 1.0, "alpha": 1e-2, "beta": 0.75})
+_MXO = (U(124, (2, 4, 3, 3)) + np.arange(72).reshape(2, 4, 3, 3) * 0.05)
+case("maxout", inputs={"X": _MXO.astype("float32")},
+     outputs={"Out": Z(2, 2, 3, 3)}, attrs={"groups": 2}, tol=0.02)
+case("prelu", inputs={"X": away(U(125, (2, 3, 2, 2), -1, 1), [0.0]),
+                      "Alpha": U(126, (1,), 0.1, 0.5)},
+     outputs={"Out": Z(2, 3, 2, 2)}, attrs={"mode": "all"})
+case("grid_sampler", inputs={"X": U(127, (1, 2, 3, 3)),
+                             "Grid": U(128, (1, 3, 3, 2), -0.7, 0.7)},
+     outputs={"Output": Z(1, 2, 3, 3)}, outs=["Output"], tol=0.02)
+case("unfold", inputs={"X": U(129, (1, 2, 4, 4))},
+     outputs={"Y": Z(1, 8, 9)}, outs=["Y"],
+     attrs={"kernel_sizes": [2, 2], "strides": [1, 1], "paddings": [0, 0, 0, 0],
+            "dilations": [1, 1]})
+case("unpool", inputs={"X": U(130, (1, 2, 2, 2)),
+                       "Indices": np.array(
+                           [[[[0, 3], [10, 13]], [[2, 5], [8, 15]]]],
+                           np.int32)},
+     outputs={"Out": Z(1, 2, 4, 4)}, check=["X"],
+     attrs={"unpooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]})
+case("spp", inputs={"X": U(131, (1, 2, 4, 4))},
+     outputs={"Out": Z(1, 10)},
+     attrs={"pyramid_height": 2, "pooling_type": "avg"})
+case("bilinear_interp", inputs={"X": U(132, (1, 2, 3, 3))},
+     outputs={"Out": Z(1, 2, 5, 5)},
+     attrs={"out_h": 5, "out_w": 5, "align_corners": True,
+            "interp_method": "bilinear"}, tol=0.02)
+case("nearest_interp", inputs={"X": U(133, (1, 2, 3, 3))},
+     outputs={"Out": Z(1, 2, 5, 5)},
+     attrs={"out_h": 5, "out_w": 5, "align_corners": True,
+            "interp_method": "nearest"})
+case("interp_nearest", inputs={"X": U(134, (1, 2, 3, 3))},
+     outputs={"Out": Z(1, 2, 5, 5)},
+     attrs={"out_h": 5, "out_w": 5, "align_corners": True,
+            "interp_method": "nearest"})
+case("trilinear_interp", inputs={"X": U(135, (1, 2, 3, 3, 3))},
+     outputs={"Out": Z(1, 2, 4, 4, 4)},
+     attrs={"out_d": 4, "out_h": 4, "out_w": 4, "align_corners": True,
+            "interp_method": "trilinear"}, tol=0.02)
+
+# -- embeddings --------------------------------------------------------------
+case("lookup_table", inputs={"W": U(140, (10, 4)),
+                             "Ids": I(141, (3, 1), 0, 10)},
+     outputs={"Out": Z(3, 4)}, check=["W"], attrs={"padding_idx": -1},
+     max_elements=40)
+case("lookup_table_v2", inputs={"W": U(142, (10, 4)),
+                                "Ids": I(143, (3,), 0, 10)},
+     outputs={"Out": Z(3, 4)}, check=["W"], attrs={"padding_idx": -1},
+     max_elements=40)
+
+# -- losses ------------------------------------------------------------------
+case("hinge_loss", inputs={"Logits": away(U(150, (3, 1), -2, 2), [-1.0, 1.0]),
+                           "Labels": np.array([[0.0], [1.0], [1.0]], np.float32)},
+     outputs={"Loss": Z(3, 1)}, outs=["Loss"], check=["Logits"])
+case("huber_loss", inputs={"X": np.array([[0.1], [2.3], [-1.8]], np.float32),
+                           "Y": np.array([[0.4], [0.2], [0.3]], np.float32)},
+     outputs={"Out": Z(3, 1), "Residual": Z(3, 1)}, outs=["Out"],
+     check=["X"], attrs={"delta": 1.0})
+case("margin_rank_loss", inputs={"X1": np.array([[0.9], [0.1], [1.4]], np.float32),
+                                 "X2": np.array([[0.2], [0.8], [0.3]], np.float32),
+                                 "Label": np.array([[1.0], [-1.0], [1.0]], np.float32)},
+     outputs={"Out": Z(3, 1), "Activated": Z(3, 1)}, outs=["Out"],
+     check=["X1", "X2"], attrs={"margin": 0.1})
+case("modified_huber_loss",
+     inputs={"X": np.array([[0.3], [-0.4], [2.2]], np.float32),
+             "Y": np.array([[1.0], [0.0], [1.0]], np.float32)},
+     outputs={"Out": Z(3, 1), "IntermediateVal": Z(3, 1)}, outs=["Out"],
+     check=["X"])
+case("smooth_l1_loss", inputs={"X": np.array([[0.2, 2.0], [-1.6, 0.1]], np.float32),
+                               "Y": np.array([[0.1, 0.2], [0.1, 0.3]], np.float32)},
+     outputs={"Out": Z(2, 1), "Diff": Z(2, 2)}, outs=["Out"],
+     check=["X"], attrs={"sigma": 1.0})
+_CE2X = np.abs(U(151, (3, 4), 0.1, 1.0))
+_CE2X = (_CE2X / _CE2X.sum(1, keepdims=True)).astype("float32")
+case("cross_entropy2", inputs={"X": _CE2X, "Label": I(152, (3, 1), 0, 4)},
+     outputs={"Y": Z(3, 1), "XShift": Z(3, 1), "MatchX": Z(3, 1)},
+     outs=["Y"], check=["X"])
+case("teacher_student_sigmoid_loss",
+     inputs={"X": U(153, (3, 1), -2, 2),
+             "Label": np.array([[0.2], [0.7], [1.0]], np.float32)},
+     outputs={"Y": Z(3, 1)}, outs=["Y"], check=["X"])
+case("center_loss", inputs={"X": U(154, (3, 4)),
+                            "Label": I(155, (3, 1), 0, 5),
+                            "Centers": U(156, (5, 4)),
+                            "CenterUpdateRate": np.array([0.5], np.float32)},
+     outputs={"Loss": Z(3, 1), "SampleCenterDiff": Z(3, 4),
+              "CentersOut": Z(5, 4)},
+     outs=["Loss"], check=["X"], attrs={"cluster_num": 5, "need_update": True})
+case("cvm", inputs={"X": U(157, (3, 4), 0.1, 1.0),
+                    "CVM": U(158, (3, 2), 0.1, 1.0)},
+     outputs={"Y": Z(3, 4)}, outs=["Y"], check=["X"],
+     attrs={"use_cvm": True})
+case("hierarchical_sigmoid",
+     inputs={"X": U(159, (3, 4)), "W": U(160, (4, 4), -0.5, 0.5),
+             "Label": I(161, (3, 1), 0, 5),
+             "Bias": U(162, (4, 1))},
+     outputs={"Out": Z(3, 1), "PreOut": Z(3, 4)}, outs=["Out"],
+     check=["X", "W", "Bias"], attrs={"num_classes": 5}, tol=0.02)
+
+# -- sequence (LoD) ops ------------------------------------------------------
+case("sequence_softmax",
+     inputs={"X": (U(170, (2, 3)), [[3, 2]])},
+     outputs={"Out": Z(2, 3)}, tol=0.02)
+case("sequence_concat",
+     inputs={"X": [("sqc0", (U(171, (2, 3, 2)), [[3, 2]])),
+                   ("sqc1", (U(172, (2, 2, 2)), [[1, 2]]))]},
+     outputs={"Out": Z(2, 5, 2)})
+case("sequence_expand",
+     inputs={"X": (U(173, (2, 1, 3)), [[1, 1]]),
+             "Y": (U(174, (2, 3, 1)), [[2, 3]])},
+     outputs={"Out": Z(2, 5, 3)}, check=["X"], attrs={"ref_level": 0})
+case("sequence_reshape",
+     inputs={"X": (U(175, (2, 4, 2)), [[4, 2]])},
+     outputs={"Out": Z(2, 8, 1)}, attrs={"new_dim": 1}, tol=0.02)
+
+# ---------------------------------------------------------------------------
+# sweep runner
+# ---------------------------------------------------------------------------
+
+
+class _SweepCase(OpTest):
+    def runTest(self):  # pragma: no cover - pytest uses check()
+        pass
+
+
+def _run_case(op_type, spec):
+    t = _SweepCase()
+    t.op_type = op_type
+    t.inputs = spec["inputs"]
+    t.attrs = spec.get("attrs", {})
+    t.outputs = spec["outputs"]
+    def _arr(v):
+        return np.asarray(v[0] if isinstance(v, tuple) else v)
+
+    check = spec.get("check")
+    if check is None:
+        check = [
+            s for s, v in spec["inputs"].items()
+            if (isinstance(v, list) and v and _arr(v[0][1]).dtype.kind == "f")
+            or (not isinstance(v, list) and _arr(v).dtype.kind == "f")
+        ]
+    t.check_grad(
+        check,
+        spec.get("outs", ["Out"]),
+        max_relative_error=spec.get("tol", 0.01),
+        numeric_grad_delta=spec.get("delta", 0.005),
+        no_grad_set=spec.get("no_grad_set"),
+        max_elements=spec.get("max_elements", 24),
+    )
+
+
+@pytest.mark.parametrize("op_type", sorted(CASES))
+def test_grad_sweep(op_type):
+    _run_case(op_type, CASES[op_type])
+
+
+# ---------------------------------------------------------------------------
+# dispositions: grad-bearing ops excluded from the FD sweep, with reasons,
+# plus the no-grad-maker population (reason derived automatically)
+# ---------------------------------------------------------------------------
+
+DISPOSITIONS = {
+    # collective / multi-device: grads are identity/psum routings that only
+    # mean something on a mesh; verified end-to-end by the DP/TP parity
+    # tests (test_spmd_parallel, test_multiprocess_dp, dryrun parity)
+    "allreduce": "collective (DP parity tests)",
+    "broadcast": "collective (DP parity tests)",
+    "c_allgather": "collective (DP parity tests)",
+    "c_allreduce_max": "collective (DP parity tests)",
+    "c_allreduce_min": "collective (DP parity tests)",
+    "c_allreduce_prod": "collective (DP parity tests)",
+    "c_allreduce_sum": "collective (DP parity tests)",
+    "c_broadcast": "collective (DP parity tests)",
+    "c_reducescatter": "collective (DP parity tests)",
+    # control-flow / TensorArray engine: grads run the reversed-loop replay
+    # machinery; dedicated tests assert them (test_while_cond_grad,
+    # test_control_flow_rnn, test_rnn)
+    "while": "control-flow grad (test_while_cond_grad)",
+    "conditional_block": "control-flow grad (test_while_cond_grad)",
+    "recurrent": "control-flow grad (test_control_flow_rnn)",
+    "array_to_lod_tensor": "TensorArray plumbing (test_control_flow_rnn)",
+    "lod_tensor_to_array": "TensorArray plumbing (test_control_flow_rnn)",
+    "read_from_array": "TensorArray plumbing (test_control_flow_rnn)",
+    "write_to_array": "TensorArray plumbing (test_control_flow_rnn)",
+    "merge_lod_tensor": "control-flow routing (IfElse tests)",
+    "split_lod_tensor": "control-flow routing (IfElse tests)",
+    "shrink_rnn_memory": "control-flow plumbing (test_control_flow_rnn)",
+    # stochastic forward: finite differences of a resampled mask/path are
+    # meaningless; grads verified with fixed masks at layer level
+    "dropout": "stochastic mask (layer-level tests with fixed seed)",
+    "nce": "stochastic negative sampling (layer-level oracle test)",
+    "sampling_id": "sampler (non-differentiable draw)",
+    # straight-through estimators: the quantized forward is a step
+    # function, FD yields 0/inf by construction; STE contract is grad =
+    # identity, asserted by the QAT training tests (test_slim)
+    "fake_quantize_abs_max": "straight-through estimator (test_slim)",
+    "fake_quantize_range_abs_max": "straight-through estimator (test_slim)",
+    "fake_quantize_moving_average_abs_max":
+        "straight-through estimator (test_slim)",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "straight-through estimator (test_slim)",
+    "fake_channel_wise_quantize_abs_max":
+        "straight-through estimator (test_slim)",
+    "fake_channel_wise_dequantize_max_abs":
+        "straight-through estimator (test_slim)",
+    "fake_dequantize_max_abs": "straight-through estimator (test_slim)",
+    "moving_average_abs_max_scale": "observer op (stats only, test_slim)",
+    "spectral_norm": "stateful power iteration (U/V are in-place buffers, "
+                     "registry stateful_inputs; FD through mutated state is "
+                     "ill-posed — forward oracle-tested, grad is the "
+                     "generic vjp with U/V stopped)",
+    # fused training kernels exercised end-to-end by their dedicated
+    # numeric tests (test_op_rnn_fused / test_op_fused compare against
+    # step-by-step oracles; training convergence covered by layer tests)
+    "attention_lstm": "fused recurrence (test_op_rnn_fused oracle)",
+    "fused_embedding_fc_lstm": "fused recurrence (test_op_rnn_fused oracle)",
+    "fusion_gru": "fused recurrence (test_op_rnn_fused oracle)",
+    "fusion_lstm": "fused recurrence (test_op_rnn_fused oracle)",
+    "lstmp": "fused recurrence (test_op_rnn_fused oracle)",
+    "fusion_repeated_fc_relu": "fused inference op (test_op_fused oracle)",
+    "fusion_seqconv_eltadd_relu": "fused inference op (test_op_fused oracle)",
+    "fusion_seqexpand_concat_fc": "fused inference op (test_op_fused oracle)",
+    "fusion_seqpool_concat": "fused inference op (test_op_fused oracle)",
+    "fusion_seqpool_cvm_concat": "fused inference op (test_op_fused oracle)",
+    "fusion_squared_mat_sub": "fused inference op (test_op_fused oracle)",
+    "fused_embedding_seq_pool": "fused embedding (test_op_fused oracle)",
+    "fused_fc_elementwise_layernorm":
+        "fused inference op (test_op_fused oracle)",
+    # ROI / deformable-sampling detection ops: forward is oracle-tested in
+    # test_op_detection; the grad is the generic vjp of that SAME jax
+    # lowering (registry grad='generic' differentiates the tested forward),
+    # and FD around the ROI max/bin boundaries is numerically ill-posed
+    "roi_pool": "ROI sampling (forward oracle in test_op_detection; generic vjp)",
+    "prroi_pool": "ROI sampling (forward oracle; generic vjp)",
+    "psroi_pool": "ROI sampling (forward oracle; generic vjp)",
+    "roi_perspective_transform": "ROI sampling (forward oracle; generic vjp)",
+    "deformable_conv": "deformable sampling (forward oracle; generic vjp)",
+    "deformable_conv_v1": "deformable sampling (forward oracle; generic vjp)",
+    "deformable_psroi_pooling": "deformable sampling (forward oracle; generic vjp)",
+    "yolov3_loss": "detection loss with target assignment (forward oracle "
+                   "in test_op_detection; generic vjp)",
+    "match_matrix_tensor": "LoD text-matching op (forward oracle in "
+                           "test_op_gap_batch2; generic vjp)",
+}
+
+
+def _ops_grad_checked_elsewhere():
+    """op_types with a check_grad call in any OTHER test module."""
+    found = set()
+    for path in glob.glob(os.path.join(HERE, "test_op_*.py")):
+        if path.endswith("test_grad_sweep.py"):
+            continue
+        src = open(path).read()
+        for m in re.finditer(
+            r"class (\w+)\(.*?\):(.*?)(?=\nclass |\Z)", src, re.S
+        ):
+            body = m.group(2)
+            if "check_grad" in body:
+                t = re.search(r"op_type = [\"'](\w+)[\"']", body)
+                if t:
+                    found.add(t.group(1))
+    return found
+
+
+def test_every_op_is_checked_or_dispositioned():
+    """Total accounting: each registered op must be FD-grad-checked (here
+    or in a dedicated test) or carry a recorded disposition."""
+    R = registry._REGISTRY
+    elsewhere = _ops_grad_checked_elsewhere()
+    missing = []
+    for op, d in sorted(R.items()):
+        if op in CASES or op in elsewhere or op in DISPOSITIONS:
+            continue
+        if d.grad_maker is None:
+            # no grad maker: non-differentiable by design (optimizer
+            # updates, integer/bool outputs, IO/collective runtime, *_grad
+            # bodies). The forward is still oracle-tested where it computes.
+            continue
+        missing.append(op)
+    assert not missing, (
+        "grad-bearing ops with neither an FD check nor a disposition: %s"
+        % missing
+    )
+
+
+def test_sweep_plus_dispositions_cover_target():
+    """VERDICT r3 #4 bar. Current accounting of the 397 registered ops:
+    189 FD-grad-checked (123 sweep cases + 66 dedicated tests), 52
+    grad-bearing ops dispositioned with recorded reasons, and 156 ops with
+    no grad maker by design (optimizer updates, integer/bool outputs,
+    IO/collective runtime, *_grad bodies) — the differentiable corpus is
+    241 ops, so 189/241 = 78% carries a direct finite-difference check."""
+    elsewhere = _ops_grad_checked_elsewhere()
+    checked = set(CASES) | elsewhere
+    assert len(checked) >= 185, len(checked)
