@@ -133,6 +133,7 @@ def child_main(cfg):
         class_num=1000,
         image_size=image_size,
         use_amp=cfg["amp"],
+        recompute=bool(cfg.get("remat")),
     )
     _hb("build ok %.1fs" % (time.time() - t0))
 
@@ -220,6 +221,10 @@ def _base_cfg():
         "depth": int(os.environ.get("BENCH_DEPTH", "50")),
         "image_size": int(os.environ.get("BENCH_IMG", "224")),
         "amp": os.environ.get("BENCH_AMP", "1") == "1",
+        # rematerialize residual-block activations (PERF.md lever 1):
+        # trades recompute FLOPs for the bandwidth-dominant activation
+        # writes on the HBM-bound step
+        "remat": os.environ.get("BENCH_REMAT", "0") == "1",
         "platform": "",
     }
 
@@ -395,12 +400,14 @@ def parent_main():
             flush=True,
         )
 
-    def try_resnet_tpu(batch, slot, steps=None):
+    def try_resnet_tpu(batch, slot, steps=None, remat=None):
         nonlocal tunnel_suspect
         cfg = dict(base, batch=batch)
         if steps is not None:
             cfg["steps"] = steps
-        label = "tpu-b%d" % batch
+        if remat is not None:
+            cfg["remat"] = remat
+        label = "tpu-b%d%s" % (batch, "-remat" if cfg.get("remat") else "")
         result, kind, err, probe_ok = _run_attempt(
             label, cfg, slot * tpu_scale, tpu_deadline()
         )
@@ -414,6 +421,8 @@ def parent_main():
                 or result["ips"] > prev["value"]
             ):
                 banked["resnet"] = _resnet_line(result, batch, [], False)
+                if cfg.get("remat"):
+                    banked["resnet"]["remat"] = True
             tpu_ok["resnet"] = True
             tunnel_suspect = False
             return True
@@ -501,11 +510,19 @@ def parent_main():
             did_something = True
         elif not tpu_ok["bert"]:
             pass  # handled below
-        elif banked["resnet"].get("batch", 0) < 1024:
-            nxt = 256 if banked["resnet"]["batch"] < 256 else 1024
-            if nxt not in escalated:
+        else:
+            b = banked["resnet"].get("batch", 0)
+            nxt = 256 if b < 256 else 1024
+            if b < 1024 and nxt not in escalated:
                 escalated.add(nxt)
-                try_resnet_tpu(nxt, 150.0)
+                try_resnet_tpu(nxt, 240.0 if nxt == 256 else 280.0)
+                did_something = True
+            elif "remat" not in escalated and not base["remat"]:
+                # escalation done (or exhausted): probe the remat variant
+                # at the banked batch — a DIFFERENT HLO, so budget a full
+                # compile slot; bank-best keeps the faster of the two
+                escalated.add("remat")
+                try_resnet_tpu(b, 280.0, remat=True)
                 did_something = True
         if time.time() >= hard_deadline - 160.0:
             break

@@ -61,8 +61,13 @@ def _bottleneck_block(input, num_filters, stride, is_test):
     return fluid.layers.relu(fluid.layers.elementwise_add(short, conv2))
 
 
-def resnet(img, class_num=1000, depth=50, is_test=False):
-    """ResNet forward; ``img`` [N, 3, H, W] -> logits [N, class_num]."""
+def resnet(img, class_num=1000, depth=50, is_test=False, checkpoints=None):
+    """ResNet forward; ``img`` [N, 3, H, W] -> logits [N, class_num].
+
+    ``checkpoints``: pass a list to collect each residual-block output var
+    — the natural rematerialization cut points (RecomputeOptimizer trades
+    the HBM-bandwidth-dominant activation writes for recompute, PERF.md
+    "next levers")."""
     block_kind, stages = _DEPTH_CFG[depth]
     block = _basic_block if block_kind == "basic" else _bottleneck_block
     conv = _conv_bn(img, 64, 7, stride=2, act="relu", is_test=is_test)
@@ -74,25 +79,33 @@ def resnet(img, class_num=1000, depth=50, is_test=False):
         for i in range(count):
             stride = 2 if i == 0 and stage > 0 else 1
             pool = block(pool, num_filters[stage], stride, is_test)
+            if checkpoints is not None:
+                checkpoints.append(pool)
     pool = fluid.layers.pool2d(pool, pool_type="avg", global_pooling=True)
     return fluid.layers.fc(input=pool, size=class_num)
 
 
 def build_resnet_train(depth=50, class_num=1000, image_size=224,
                        learning_rate=0.1, momentum=0.9, is_test=False,
-                       use_amp=False):
+                       use_amp=False, recompute=False):
     """(main, startup, feeds, avg_loss, acc) for ResNet training.
 
     ``use_amp``: bf16 mixed precision via the AMP program rewrite
     (contrib/mixed_precision) — matmuls/convs run bf16 on the MXU, master
-    weights and the optimizer update stay fp32."""
+    weights and the optimizer update stay fp32.
+
+    ``recompute``: rematerialize activations at residual-block boundaries
+    (RecomputeOptimizer) — trades recompute FLOPs for the activation HBM
+    traffic that dominates the measured step (PERF.md)."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         img = fluid.layers.data(
             name="img", shape=[3, image_size, image_size], dtype="float32"
         )
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-        logits = resnet(img, class_num=class_num, depth=depth, is_test=is_test)
+        ckpts = [] if recompute else None
+        logits = resnet(img, class_num=class_num, depth=depth,
+                        is_test=is_test, checkpoints=ckpts)
         loss = fluid.layers.softmax_with_cross_entropy(logits, label)
         avg_loss = fluid.layers.mean(loss)
         acc = fluid.layers.accuracy(
@@ -101,6 +114,14 @@ def build_resnet_train(depth=50, class_num=1000, image_size=224,
         opt = fluid.optimizer.Momentum(
             learning_rate=learning_rate, momentum=momentum
         )
+        if recompute:
+            # checkpoint every OTHER block boundary: halves the live
+            # activation footprint while bounding replay to two blocks.
+            # Recompute sits INSIDE the AMP decorator: AMP's backward
+            # rewrites the program then delegates to this backward, which
+            # runs the checkpointed append_backward.
+            opt = fluid.optimizer.RecomputeOptimizer(opt)
+            opt._set_checkpoints(ckpts[1::2])
         if use_amp:
             from paddle_tpu.fluid.contrib import mixed_precision as _mp
 

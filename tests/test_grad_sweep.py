@@ -554,8 +554,6 @@ def _ops_grad_checked_elsewhere():
     """op_types with a check_grad call in any OTHER test module."""
     found = set()
     for path in glob.glob(os.path.join(HERE, "test_op_*.py")):
-        if path.endswith("test_grad_sweep.py"):
-            continue
         src = open(path).read()
         for m in re.finditer(
             r"class (\w+)\(.*?\):(.*?)(?=\nclass |\Z)", src, re.S

@@ -179,3 +179,58 @@ def test_recompute_memory_is_checkpoint_bound():
     growth = peak(2 * MEM_LAYERS) - peak(MEM_LAYERS)
     new_ckpts = MEM_LAYERS // 3  # one checkpoint every 3 layers
     assert growth <= (new_ckpts + 2) * act_bytes, (growth, act_bytes)
+
+
+def test_resnet_remat_build_matches_plain():
+    """The bench remat lever (models/resnet.py recompute=True): residual
+    -block-checkpointed training must match the plain build's loss curve
+    exactly — remat changes memory/bandwidth, never math."""
+    from paddle_tpu.models import resnet as rn
+
+    def run(recompute):
+        with fluid.unique_name.guard():
+            main, startup, feeds, loss, acc = rn.build_resnet_train(
+                depth=18, class_num=10, image_size=32,
+                learning_rate=0.05, recompute=recompute,
+            )
+        main.random_seed = startup.random_seed = 17
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        feed = {
+            "img": rs.rand(4, 3, 32, 32).astype("float32"),
+            "label": rs.randint(0, 10, (4, 1)).astype("int64"),
+        }
+        out = []
+        for _ in range(2):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            out.append(float(np.asarray(lv).ravel()[0]))
+        return out
+
+    plain = run(False)
+    remat = run(True)
+    np.testing.assert_allclose(remat, plain, rtol=1e-5, atol=1e-6)
+    assert np.isfinite(plain).all()
+
+
+def test_resnet_remat_composes_with_amp():
+    """bench.py runs use_amp + recompute together (AMP decorator delegating
+    backward to RecomputeOptimizer); the composed build must train finite."""
+    from paddle_tpu.models import resnet as rn
+
+    with fluid.unique_name.guard():
+        main, startup, feeds, loss, acc = rn.build_resnet_train(
+            depth=18, class_num=10, image_size=32,
+            learning_rate=0.05, use_amp=True, recompute=True,
+        )
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(1)
+    feed = {
+        "img": rs.rand(4, 3, 32, 32).astype("float32"),
+        "label": rs.randint(0, 10, (4, 1)).astype("int64"),
+    }
+    (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert np.isfinite(float(np.asarray(lv).ravel()[0]))
