@@ -484,11 +484,14 @@ def parent_main():
             else:
                 note_fail("bert", "bert-cpu-degraded", kind, err)
 
+    # full-compile slot budget per batch — shared by phase A and the
+    # phase-D retries so the two paths can never drift apart
+    slot_for = {64: 260.0, 256: 240.0, 1024: 280.0}
+
     # ---- phase A: cheap-first TPU ladder — bank b64, then escalate ----
-    escalation = [(256, 240.0), (1024, 280.0)]
-    if try_resnet_tpu(64, 260.0):
-        for b, slot in escalation:
-            if not try_resnet_tpu(b, slot):
+    if try_resnet_tpu(64, slot_for[64]):
+        for b in (256, 1024):
+            if not try_resnet_tpu(b, slot_for[b]):
                 break
     # ---- phase B: BERT on TPU (skip if the tunnel looks dead) ----
     if not tunnel_suspect:
@@ -515,14 +518,14 @@ def parent_main():
             nxt = 256 if b < 256 else 1024
             if b < 1024 and nxt not in escalated:
                 escalated.add(nxt)
-                try_resnet_tpu(nxt, 240.0 if nxt == 256 else 280.0)
+                try_resnet_tpu(nxt, slot_for[nxt])
                 did_something = True
             elif "remat" not in escalated and not base["remat"]:
                 # escalation done (or exhausted): probe the remat variant
                 # at the banked batch — a DIFFERENT HLO, so budget a full
                 # compile slot; bank-best keeps the faster of the two
                 escalated.add("remat")
-                try_resnet_tpu(b, 280.0, remat=True)
+                try_resnet_tpu(b, slot_for.get(b, 280.0), remat=True)
                 did_something = True
         if time.time() >= hard_deadline - 160.0:
             break

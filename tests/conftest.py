@@ -24,20 +24,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: multi-process / e2e-training tests (deselect with -m 'not slow' "
-        "for a fast inner loop; the full suite always runs them)",
+        "for a fast inner loop; the full suite always runs them). Heavy "
+        "modules mark themselves at the source via pytestmark.",
     )
-
-
-def pytest_collection_modifyitems(config, items):
-    """Auto-mark the heavy modules: subprocess clusters and full training
-    scripts dominate suite wall-clock (VERDICT r3 weak #8)."""
-    import pytest as _pytest
-
-    slow_modules = {
-        "test_dist_pserver", "test_book", "test_transformer_nmt",
-        "test_multiprocess_dp", "test_dygraph_model_parity",
-        "test_trainer_stack", "test_slim_nas_distill",
-    }
-    for item in items:
-        if item.module.__name__ in slow_modules:
-            item.add_marker(_pytest.mark.slow)

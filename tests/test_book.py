@@ -12,6 +12,10 @@ import numpy as np
 import paddle_tpu.fluid as fluid
 import paddle_tpu.dataset as dataset
 import paddle_tpu.reader as pt_reader
+import pytest
+
+# heavy: subprocess clusters / full training scripts
+pytestmark = pytest.mark.slow
 
 
 def _train_loop(main, startup, feeder_names, loss, reader, epochs, exe,

@@ -21,9 +21,9 @@ from paddle_tpu.fluid import native
 HERE = os.path.dirname(os.path.abspath(__file__))
 RUNNER = os.path.join(HERE, "dist_runner.py")
 
-pytestmark = pytest.mark.skipif(
+pytestmark = [pytest.mark.slow, pytest.mark.skipif(
     not native.available(), reason="native library unavailable"
-)
+)]
 
 
 def free_ports(n):

@@ -12,6 +12,10 @@ these tests pin down.
 import numpy as np
 
 import paddle_tpu.fluid as fluid
+import pytest
+
+# heavy: subprocess clusters / full training scripts
+pytestmark = pytest.mark.slow
 
 
 def _static_params(main):

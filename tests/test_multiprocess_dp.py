@@ -11,6 +11,10 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
+
+# heavy: subprocess clusters / full training scripts
+pytestmark = pytest.mark.slow
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)

@@ -181,6 +181,7 @@ def test_recompute_memory_is_checkpoint_bound():
     assert growth <= (new_ckpts + 2) * act_bytes, (growth, act_bytes)
 
 
+@pytest.mark.slow  # ~50s of CPU resnet training
 def test_resnet_remat_build_matches_plain():
     """The bench remat lever (models/resnet.py recompute=True): residual
     -block-checkpointed training must match the plain build's loss curve
@@ -214,6 +215,7 @@ def test_resnet_remat_build_matches_plain():
     assert np.isfinite(plain).all()
 
 
+@pytest.mark.slow  # ~30s of CPU resnet training
 def test_resnet_remat_composes_with_amp():
     """bench.py runs use_amp + recompute together (AMP decorator delegating
     backward to RecomputeOptimizer); the composed build must train finite."""

@@ -13,6 +13,10 @@ from paddle_tpu.fluid.contrib.slim import (
     SoftLabelDistiller,
     merge_programs,
 )
+import pytest
+
+# heavy: subprocess clusters / full training scripts
+pytestmark = pytest.mark.slow
 
 
 def _teacher_student_programs():

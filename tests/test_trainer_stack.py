@@ -16,6 +16,9 @@ from paddle_tpu.fluid.trainer import (
     TrainerFactory,
 )
 
+# heavy: subprocess clusters / full training scripts
+pytestmark = pytest.mark.slow
+
 needs_native = pytest.mark.skipif(
     not native.available(), reason="native library unavailable"
 )

@@ -6,6 +6,10 @@ import numpy as np
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.models import transformer as tfm
+import pytest
+
+# heavy: subprocess clusters / full training scripts
+pytestmark = pytest.mark.slow
 
 BOS, EOS = 1, 0
 VOCAB = 20
