@@ -70,7 +70,9 @@ def child_main(cfg):
     bcfg.attention_dropout = 0.0
     _hb("build start")
     main, startup, feeds, loss, acc = bert.build_bert_classifier(
-        bcfg, SEQ_LEN, learning_rate=2e-5
+        bcfg, SEQ_LEN, learning_rate=2e-5,
+        # bf16 matmuls on the MXU (BENCH_AMP=0 opts out, bench.py parity)
+        use_amp=os.environ.get("BENCH_AMP", "1") == "1",
     )
     exe = fluid.Executor(place)
     _hb("startup start")

@@ -165,8 +165,13 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
     return x, pooled
 
 
-def build_bert_classifier(cfg, seq_len, num_classes=2, learning_rate=2e-5):
+def build_bert_classifier(cfg, seq_len, num_classes=2, learning_rate=2e-5,
+                          use_amp=False):
     """Sequence-classification fine-tune graph (config 3 / SQuAD-style head).
+
+    ``use_amp``: bf16 mixed precision via the AMP program rewrite — the
+    attention/FFN matmuls run bf16 on the MXU, layer-norm statistics and
+    the Adam update stay fp32 (gray-list propagation).
 
     Returns (main, startup, feeds, avg_loss, acc)."""
     main, startup = fluid.Program(), fluid.Program()
@@ -187,6 +192,10 @@ def build_bert_classifier(cfg, seq_len, num_classes=2, learning_rate=2e-5):
             input=fluid.layers.softmax(logits), label=label
         )
         opt = fluid.optimizer.Adam(learning_rate=learning_rate)
+        if use_amp:
+            from paddle_tpu.fluid.contrib import mixed_precision as _mp
+
+            opt = _mp.decorate(opt)
         opt.minimize(avg_loss)
     feeds = [src_ids, pos_ids, sent_ids, input_mask, label]
     return main, startup, feeds, avg_loss, acc

@@ -1,0 +1,37 @@
+"""BERT bf16 AMP build (the bench_bert.py path): the AMP rewrite must
+compose with the attention/FFN/layer-norm stack and train finite with a
+decreasing loss (BASELINE metric 2 runs this graph on the MXU)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import bert
+
+
+def test_bert_classifier_amp_trains():
+    cfg = bert.BertConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    S, N = 16, 8
+    with fluid.unique_name.guard():
+        main, startup, feeds, loss, acc = bert.build_bert_classifier(
+            cfg, S, learning_rate=1e-3, use_amp=True
+        )
+    main.random_seed = startup.random_seed = 21
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    feed = {
+        "src_ids": rs.randint(0, cfg.vocab_size, (N, S, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(S)[None, :, None], (N, 1, 1)).astype("int64"),
+        "sent_ids": np.zeros((N, S, 1), "int64"),
+        "input_mask": np.ones((N, S, 1), "float32"),
+        "label": rs.randint(0, 2, (N, 1)).astype("int64"),
+    }
+    losses = []
+    for _ in range(6):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # AMP actually rewrote the graph: bf16 casts present
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types

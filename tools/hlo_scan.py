@@ -1,0 +1,147 @@
+"""Structural scan of the compiled training step's optimized HLO + cost
+analysis (the PERF.md methodology, reproducible).
+
+Builds the ResNet-50 or BERT-base training step exactly as bench.py does,
+compiles the executor's main XLA segment ahead-of-time on the current
+backend, and prints ONE JSON line:
+
+  {"model", "batch", "backend", "flops", "bytes_accessed",
+   "hlo_ops": {"transpose": N, "convert": N, "copy": N, "fusion": N,
+               "dot": N, "convolution": N, "all-reduce": N}}
+
+Usage (CPU structural scan — fusion hygiene and op census only):
+  JAX_PLATFORMS=cpu python tools/hlo_scan.py --model resnet --batch 32
+On a live TPU the same command (without JAX_PLATFORMS) gives the real
+per-step FLOP / HBM-byte counts used for the MFU math in PERF.md.
+NOTE: transpose/copy elimination is a TPU-backend layout-assignment
+property — the CPU backend legitimately keeps them, so only the TPU run
+can reproduce PERF.md's "0 transposes" claim.
+"""
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(model, batch, amp, remat):
+    import numpy as np
+
+    if model == "resnet":
+        from paddle_tpu.models import resnet
+
+        main, startup, feeds, loss, acc = resnet.build_resnet_train(
+            depth=50, class_num=1000, image_size=224, use_amp=amp,
+            recompute=remat,
+        )
+        rs = np.random.RandomState(0)
+        feed = {
+            "img": rs.rand(batch, 3, 224, 224).astype("float32"),
+            "label": rs.randint(0, 1000, (batch, 1)).astype("int64"),
+        }
+    elif model == "bert":
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig()
+        cfg.hidden_dropout = 0.0
+        cfg.attention_dropout = 0.0
+        S = 128
+        main, startup, feeds, loss, acc = bert.build_bert_classifier(
+            cfg, S, learning_rate=2e-5, use_amp=amp
+        )
+        rs = np.random.RandomState(0)
+        feed = {
+            "src_ids": rs.randint(0, cfg.vocab_size, (batch, S, 1)).astype("int64"),
+            "pos_ids": np.tile(
+                np.arange(S)[None, :, None], (batch, 1, 1)
+            ).astype("int64"),
+            "sent_ids": np.zeros((batch, S, 1), "int64"),
+            "input_mask": np.ones((batch, S, 1), "float32"),
+            "label": rs.randint(0, 2, (batch, 1)).astype("int64"),
+        }
+    else:
+        raise SystemExit("unknown model %r" % model)
+    return main, startup, feed, loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet", choices=["resnet", "bert"])
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--amp", type=int, default=1)
+    ap.add_argument("--remat", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon sitecustomize pins jax_platforms via config, which beats
+        # the env var — honor the explicit choice (bench.py child convention)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import executor as _ex
+
+    prog, startup, feed, loss = build(
+        args.model, args.batch, bool(args.amp), bool(args.remat)
+    )
+    place = fluid.CPUPlace()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(place)
+    exe.run(startup, scope=scope)
+
+    cb = _ex._CompiledBlock(prog, 0, list(feed), [loss.name], place)
+    xla = [p for k, _s, p in cb._plans if k == "xla"]
+    # the training step is the LARGEST segment (feed/fetch host ops aside)
+    plan = max(xla, key=lambda p: len(p["feeds"]) + len(p["mutable"])
+               + len(p["const"]))
+
+    import numpy as np
+
+    feed_vals = tuple(feed[n] for n in plan["feeds"])
+    mutable_vals = tuple(np.asarray(scope.get(n)) for n in plan["mutable"])
+    const_map = {
+        n: np.asarray(scope.get(n))
+        for n in plan["const"]
+        if scope.get(n) is not None
+    }
+    rng = jax.random.key(0)
+    lowered = jax.jit(plan["raw_fn"]).lower(
+        feed_vals, mutable_vals, (), const_map, rng
+    )
+    compiled = lowered.compile()
+
+    raw_cost = compiled.cost_analysis()
+    if isinstance(raw_cost, (list, tuple)):
+        cost = raw_cost[0] if raw_cost else {}
+    else:
+        cost = raw_cost or {}
+    hlo = compiled.as_text()
+    hist = collections.Counter()
+    # `%name = <type> opcode(...)`; the type may be a tuple `(f32[..], ..)`
+    # for multi-output fusions, so the type part must admit parentheses
+    for m in re.finditer(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\],{}()\s/]*\s"
+                         r"([a-z][a-z\-]*)\(", hlo, re.M):
+        hist[m.group(1)] += 1
+    interesting = {
+        k: hist.get(k, 0)
+        for k in ("transpose", "convert", "copy", "fusion", "dot",
+                  "convolution", "all-reduce", "custom-call")
+    }
+    print(json.dumps({
+        "model": args.model,
+        "batch": args.batch,
+        "backend": jax.default_backend(),
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes accessed"),
+        "hlo_ops": interesting,
+        "total_hlo_ops": sum(hist.values()),
+    }))
+
+
+if __name__ == "__main__":
+    main()
