@@ -172,7 +172,14 @@ def get_jax_device(place):
     import jax
 
     backend = _jax_backend_for(place)
-    devices = jax.devices(backend) if backend else jax.devices()
+    # LOCAL devices: under jax.distributed (multi-process launch) the
+    # global jax.devices() list starts with process 0's devices, and
+    # placing eager values there from another process would create
+    # non-addressable global arrays — a Place always names a device THIS
+    # process owns (the reference's Place is per-process too)
+    devices = (
+        jax.local_devices(backend=backend) if backend else jax.local_devices()
+    )
     idx = getattr(place, "_device_id", 0)
     return devices[idx % len(devices)]
 

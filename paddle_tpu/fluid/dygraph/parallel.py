@@ -82,27 +82,41 @@ class DataParallel(Layer):
         return loss * (1.0 / self._env.nranks)
 
     def apply_collective_grads(self):
-        """psum grads across processes (reference: parallel.py:201
-        _coalesce_tensors + c_allreduce; XLA handles coalescing)."""
+        """Allreduce-sum grads across trainer processes (reference:
+        parallel.py:201 _coalesce_tensors + c_allreduce over the NCCL ring;
+        with scale_loss(1/nranks) applied before backward the result is the
+        reference's averaged data-parallel gradient)."""
         if self._env.nranks <= 1:
+            return
+        from jax.experimental import multihost_utils as mhu
+
+        params = [
+            p for p in self._layers.parameters() if p._grad is not None
+        ]
+        if not params:
             return
         import jax
 
-        grads = [
-            p._grad for p in self._layers.parameters() if p._grad is not None
-        ]
-        if not grads:
-            return
-        # one fused psum over the process group via pmap-less collective:
-        # jax.distributed-backed global devices, single-axis mesh
-        summed = jax.tree.map(
-            lambda g: np.asarray(g), grads
-        )  # host fallback when no multiprocess runtime is active
-        for p, g in zip(
-            [p for p in self._layers.parameters() if p._grad is not None],
-            summed,
-        ):
-            p._grad = g
+        # a mismatch between the env contract and the actual runtime would
+        # silently train on 1/nranks-scaled gradients (scale_loss divided,
+        # nobody summed) — fail loudly instead
+        if jax.process_count() != self._env.nranks:
+            raise RuntimeError(
+                "DataParallel: PADDLE_TRAINERS_NUM=%d but the jax.distributed "
+                "runtime spans %d process(es) — call "
+                "dygraph.parallel.prepare_context() before the first "
+                "computation" % (self._env.nranks, jax.process_count())
+            )
+        # each process contributes its local grad; process_allgather rides
+        # the jax.distributed runtime booted by prepare_context (numpy in,
+        # stacked numpy out), and the sum over the gathered leading axis IS
+        # the cross-process allreduce (coalescing is left to XLA, as the
+        # reference leaves it to NCCL grouping)
+        gathered = mhu.process_allgather(
+            [np.asarray(p._grad) for p in params], tiled=False
+        )
+        for p, g in zip(params, gathered):
+            p._grad = np.asarray(g).sum(axis=0)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

@@ -1,0 +1,87 @@
+"""Dygraph data-parallel runner: executed by distributed/launch.py. Each
+process trains the SAME eager model on its batch shard through
+dygraph.DataParallel (scale_loss + apply_collective_grads); the per-step
+losses must average to the single-process full-batch run (reference
+methodology: test_parallel_dygraph_mnist.py over NCCLParallelContext)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+
+SEED = 31
+GLOBAL_BATCH = 16
+STEPS = 4
+FEATURES = 8
+
+
+def batch_for(step):
+    rs = np.random.RandomState(50 + step)
+    x = rs.rand(GLOBAL_BATCH, FEATURES).astype("float32")
+    w = np.random.RandomState(9).rand(FEATURES, 1).astype("float32")
+    y = (x @ w).astype("float32")
+    return x, y
+
+
+def main():
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    # boot jax.distributed BEFORE any backend-touching call (the guard
+    # resolves devices) — reference orders prepare_context first too
+    strategy = (
+        fluid.dygraph.parallel.prepare_context() if nproc > 1 else None
+    )
+    with fluid.dygraph.guard(fluid.CPUPlace()):
+        lin = fluid.dygraph.Linear(FEATURES, 1)
+        # identical init on every process: overwrite with a seeded draw
+        rs = np.random.RandomState(SEED)
+        lin.weight.set_value(rs.rand(FEATURES, 1).astype("float32") * 0.1)
+        lin.bias.set_value(np.zeros(1, np.float32))
+        model = (
+            fluid.dygraph.parallel.DataParallel(lin, strategy)
+            if nproc > 1
+            else lin
+        )
+        opt = fluid.optimizer.SGD(
+            learning_rate=0.02, parameter_list=lin.parameters()
+        )
+        per = GLOBAL_BATCH // nproc
+        losses = []
+        for s in range(STEPS):
+            x, y = batch_for(s)
+            xs = x[rank * per:(rank + 1) * per]
+            ys = y[rank * per:(rank + 1) * per]
+            pred = model(fluid.dygraph.to_variable(xs))
+            diff = fluid.layers.elementwise_sub(
+                pred, fluid.dygraph.to_variable(ys)
+            )
+            loss = fluid.layers.mean(
+                fluid.layers.elementwise_mul(diff, diff)
+            )
+            if nproc > 1:
+                loss = model.scale_loss(loss)
+            loss.backward()
+            if nproc > 1:
+                model.apply_collective_grads()
+            opt.minimize(loss)
+            lin.clear_gradients()
+            # report the UNSCALED shard loss so ranks average to the
+            # full-batch loss
+            lv = float(loss.numpy().ravel()[0]) * (nproc if nproc > 1 else 1)
+            losses.append(lv)
+        print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

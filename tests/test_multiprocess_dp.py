@@ -64,3 +64,41 @@ def test_launch_two_process_dp_matches_single_process(tmp_path):
     # allgathered fetch), and it matches the single-process mesh exactly
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
     np.testing.assert_allclose(losses[0], local, rtol=1e-4, atol=1e-5)
+
+
+def test_launch_two_process_dygraph_dp_matches_single_process(tmp_path):
+    """Dygraph DataParallel (scale_loss + apply_collective_grads over the
+    jax.distributed runtime): 2 eager trainer processes on batch shards
+    must reproduce the single-process full-batch loss curve exactly —
+    allreduced-mean gradients == full-batch gradient for a linear model."""
+    runner = os.path.join(HERE, "dyg_dp_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    base_env = dict(env)
+    base_env["PADDLE_TRAINERS_NUM"] = "1"
+    base_env["PADDLE_TRAINER_ID"] = "0"
+    p = subprocess.run(
+        [sys.executable, runner], env=base_env, capture_output=True,
+        text=True, timeout=300, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    local = _parse(p.stdout, from_file=False)
+
+    log_dir = str(tmp_path / "dyg_logs")
+    p = subprocess.run(
+        [
+            sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nproc_per_node", "2", "--started_port", "7260",
+            "--log_dir", log_dir, runner,
+        ],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    shard_losses = []
+    for r in range(2):
+        shard_losses.append(_parse(os.path.join(log_dir, "workerlog.%d" % r)))
+    dist = [(a + b) / 2.0 for a, b in zip(*shard_losses)]
+    np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-5)
+    assert local[-1] < local[0]

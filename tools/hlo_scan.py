@@ -89,7 +89,14 @@ def main():
     prog, startup, feed, loss = build(
         args.model, args.batch, bool(args.amp), bool(args.remat)
     )
-    place = fluid.CPUPlace()
+    # mirror bench.py's place choice: on a live TPU the lowering backend
+    # (and with it the NHWC conv path) must match what bench.py compiles,
+    # or the census describes a program the bench never runs
+    place = (
+        fluid.TPUPlace(0)
+        if fluid.core.get_tpu_device_count() > 0
+        else fluid.CPUPlace()
+    )
     scope = fluid.core.Scope()
     exe = fluid.Executor(place)
     exe.run(startup, scope=scope)
