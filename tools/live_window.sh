@@ -1,0 +1,29 @@
+#!/bin/bash
+# Live-TPU-window playbook: the moment the axon tunnel answers, bank
+# everything a short window can give us:
+#   1. the full bench ladder (resnet 64->256->1024 + remat probe + BERT),
+#      which also leaves a warm persistent compile cache for the driver's
+#      end-of-round run;
+#   2. TPU cost/HLO census for both bench models (the PERF.md MFU inputs).
+# Everything runs with hard timeouts; partial results are kept.
+set -u
+cd "$(dirname "$0")/.."
+OUT=MEASURED_r04
+mkdir -p "$OUT"
+stamp() { date -u +%H:%M:%S; }
+
+echo "$(stamp) live window: starting bench ladder" | tee -a "$OUT/log.txt"
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-1100} timeout 1150 python bench.py \
+  > "$OUT/bench.json" 2> "$OUT/bench.log"
+echo "$(stamp) bench rc=$? ->" | tee -a "$OUT/log.txt"
+cat "$OUT/bench.json" | tee -a "$OUT/log.txt"
+
+for spec in "resnet 256" "bert 64"; do
+  set -- $spec
+  echo "$(stamp) hlo_scan $1 b$2" | tee -a "$OUT/log.txt"
+  timeout 700 python tools/hlo_scan.py --model "$1" --batch "$2" \
+    > "$OUT/hlo_$1.json" 2>> "$OUT/bench.log"
+  echo "$(stamp) hlo_scan $1 rc=$?" | tee -a "$OUT/log.txt"
+  cat "$OUT/hlo_$1.json" | tee -a "$OUT/log.txt"
+done
+echo "$(stamp) live window playbook done" | tee -a "$OUT/log.txt"
