@@ -68,6 +68,10 @@ def child_main(cfg):
     )
     bcfg.hidden_dropout = 0.0
     bcfg.attention_dropout = 0.0
+    # fused Pallas flash attention (opt-in probe: BENCH_FLASH=1 or cfg)
+    bcfg.use_flash_attention = bool(
+        cfg.get("flash", os.environ.get("BENCH_FLASH", "0") == "1")
+    )
     _hb("build start")
     main, startup, feeds, loss, acc = bert.build_bert_classifier(
         bcfg, SEQ_LEN, learning_rate=2e-5,

@@ -16,6 +16,7 @@ __all__ = [
     "hsigmoid",
     "nce",
     "cos_sim",
+    "flash_attention",
     "scale",
     "sequence_pool",
     "sequence_first_step",
@@ -1340,6 +1341,26 @@ def _seq_one_in(op_type, x, attrs=None, out_slot="Out", extra_inputs=None,
         outputs.update(extra_outputs)
     helper.append_op(
         type=op_type, inputs=inputs, outputs=outputs, attrs=attrs or {}
+    )
+    return out
+
+
+def flash_attention(q, k, v, key_bias=None, causal=False, scale=0.0,
+                    name=None):
+    """Fused online-softmax attention over [N, heads, S, d_head] tensors
+    (Pallas kernel on TPU, jnp reference elsewhere; reference analog: the
+    fused_multihead_matmul CUDA op). ``key_bias``: optional [N, S]
+    additive key mask; ``scale`` 0 means 1/sqrt(d_head)."""
+    helper = LayerHelper("flash_attention", **locals())
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if key_bias is not None:
+        inputs["KeyBias"] = [key_bias]
+    helper.append_op(
+        type="flash_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": float(scale)},
     )
     return out
 

@@ -819,3 +819,38 @@ def _depthwise_conv2d_transpose(ctx, op_):
         feature_group_count=c,
     )
     ctx.out(op_, "Output", out)
+
+
+# ---------------------------------------------------------------------------
+# fused flash attention (Pallas kernel; the TPU-native counterpart of the
+# reference's fused_multihead_matmul_op.cu CUDA kernel)
+# ---------------------------------------------------------------------------
+def _flash_attention_infer(op_, block):
+    q = in_var(op_, block, "Q")
+    set_out(op_, block, "Out", list(q.shape), q.dtype)
+
+
+@op("flash_attention", infer_shape=_flash_attention_infer, grad="generic")
+def _flash_attention(ctx, op_):
+    """Online-softmax fused attention on [N, heads, S, d_head] inputs
+    (paddle_tpu/kernels/flash_attention.py): the [S, S] score matrix never
+    touches HBM. Differentiable through the kernel's custom VJP, so the
+    generic grad maker Just Works."""
+    from ...kernels import flash_attention as _fa
+
+    q = ctx.in1(op_, "Q")
+    k = ctx.in1(op_, "K")
+    v = ctx.in1(op_, "V")
+    kb_names = op_.inputs.get("KeyBias") or []
+    key_bias = ctx.in1(op_, "KeyBias") if kb_names else None
+    scale = op_.attr("scale", 0.0)
+    ctx.out(
+        op_,
+        "Out",
+        _fa(
+            q, k, v,
+            key_bias=key_bias,
+            causal=bool(op_.attr("causal", False)),
+            scale=float(scale) if scale else None,
+        ),
+    )

@@ -1,0 +1,6 @@
+"""Hand-written Pallas TPU kernels for the hot ops the compiler can't
+fuse optimally on its own. Each kernel ships with a pure-jnp reference
+(used for the backward pass and for CPU fallback) and interpret-mode
+tests."""
+
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,214 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of every transformer (reference target: the CUDA
+`multihead_matmul` fused kernel, fused_multihead_matmul_op.cu, built for
+exactly this BERT attention pattern). A naive attention materializes the
+[S, S] score matrix in HBM twice (write after QK^T, read for @V) — at
+seq 512+ that dwarfs the useful traffic. This kernel keeps the whole
+softmax(QK^T/sqrt(d) + bias)V pipeline in VMEM with the online-softmax
+recurrence, writing only the [S, D] output per head:
+
+  for each K/V block:  m' = max(m, rowmax(s))
+                       acc = acc * e^(m-m') + e^(s-m') @ v_block
+                       l   = l * e^(m-m') + rowsum(e^(s-m'))
+
+Layout [B, N, S, D] (batch, heads, seq, head_dim); fp32 accumulation
+regardless of input dtype (MXU `preferred_element_type`).
+
+Backward: jax.custom_vjp recomputes through the pure-jnp reference —
+activation-light (no S×S residual is saved), numerically identical to
+differentiating the reference, and XLA already fuses the backward matmul
+chain well; the forward is where the hand-scheduling pays.
+
+The kernel runs on the TPU backend (or anywhere under ``interpret=True``
+for tests); ``flash_attention`` transparently falls back to the jnp
+reference on other backends so models stay portable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG = -1e30
+
+
+def reference_attention(q, k, v, bias=None, causal=False, scale=None):
+    """Pure-jnp oracle, [B, N, S, D]; bias broadcastable to [B, N, S, S]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k).astype(jnp.float32)
+    s = s * (scale if scale is not None else 1.0 / np.sqrt(d))
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
+
+
+def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, causal,
+            seq_len, block_q, block_k):
+    """One (head, q-block) program: online softmax over k blocks."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    qi = pl.program_id(1)
+    n_kb = seq_len // block_k
+
+    m = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    row = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    for kb in range(n_kb):
+        kblk = k_ref[0, kb * block_k:(kb + 1) * block_k, :].astype(jnp.float32)
+        vblk = v_ref[0, kb * block_k:(kb + 1) * block_k, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        s = s + bias_ref[0, kb * block_k:(kb + 1) * block_k][None, :]
+        if causal:
+            col = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col <= row, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m = m_new
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, key_bias, causal, scale, interpret):
+    """q/k/v [BN, S, D] (S % block == 0), key_bias [BN, S] additive."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BN, S, D = q.shape
+    bq = min(BLOCK_Q, S)
+    bk = min(BLOCK_K, S)
+    grid = (BN, S // bq)
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal, seq_len=S,
+        block_q=bq, block_k=bk,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BN, S, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), lambda h, i: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), lambda h, i: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S), lambda h, i: (h, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(q, k, v, key_bias)
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, key_bias, causal, scale, interpret):
+    return _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret)
+
+
+def _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret):
+    B, N, S, D = q.shape
+    Sp = _round_up(S, min(BLOCK_Q, _round_up(S, 8)))
+    if Sp % 8:
+        Sp = _round_up(Sp, 8)
+    qf = q.reshape(B * N, S, D)
+    kf = k.reshape(B * N, S, D)
+    vf = v.reshape(B * N, S, D)
+    bias = jnp.broadcast_to(key_bias, (B * N, S))
+    if Sp != S:
+        pad = ((0, 0), (0, Sp - S), (0, 0))
+        qf = jnp.pad(qf, pad)
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+        # padded KEYS must never receive weight; padded QUERY rows are
+        # sliced away below (their uniform softmax is harmless)
+        bias = jnp.pad(bias, ((0, 0), (0, Sp - S)), constant_values=_NEG)
+    out = _pallas_forward(qf, kf, vf, bias, causal, scale, interpret)
+    return out[:, :S, :].reshape(B, N, S, D)
+
+
+def _flash_fwd(q, k, v, key_bias, causal, scale, interpret):
+    return _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret), (
+        q, k, v, key_bias,
+    )
+
+
+def _flash_bwd(causal, scale, interpret, res, g):
+    q, k, v, key_bias = res
+    B, N, S, _ = q.shape
+
+    def ref(q, k, v, key_bias):
+        return reference_attention(
+            q, k, v, bias=key_bias.reshape(B, N, 1, S),
+            causal=causal, scale=scale,
+        )
+
+    _, vjp = jax.vjp(ref, q, k, v, key_bias)
+    dq, dk, dv, dbias = vjp(g)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, key_bias=None, causal=False, scale=None,
+                    interpret=None):
+    """Fused attention, [B, N, S, D] -> [B, N, S, D].
+
+    ``key_bias``: optional additive mask over KEYS, shape [B*N, S] or
+    broadcastable — BERT-style padding masks ((mask-1)*1e4 per key).
+    ``interpret``: force the Pallas interpreter (tests); default runs the
+    kernel on TPU and the jnp reference elsewhere.
+    """
+    B, N, S, d = q.shape
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
+    kb = None
+    if key_bias is not None:
+        # normalize [S] / [B, S] / [B*N, S] / [B, N, S] -> [B*N, S]
+        kb = key_bias.astype(jnp.float32)
+        if kb.ndim == 1:
+            kb = kb[None]
+        kb = kb.reshape(-1, S)
+        if kb.shape[0] == B and N > 1:
+            kb = jnp.broadcast_to(kb[:, None, :], (B, N, S)).reshape(-1, S)
+        kb = jnp.broadcast_to(kb, (B * N, S))
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None and not on_tpu:
+        return reference_attention(
+            q, k, v,
+            bias=None if kb is None else kb.reshape(B, N, 1, S),
+            causal=causal, scale=scale,
+        )
+    if kb is None:
+        kb = jnp.zeros((B * N, S), jnp.float32)
+    return _flash(q, k, v, kb, causal, scale, bool(interpret))

@@ -19,7 +19,8 @@ class BertConfig(object):
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072,
                  max_position_embeddings=512, type_vocab_size=2,
-                 hidden_dropout=0.1, attention_dropout=0.1, is_test=False):
+                 hidden_dropout=0.1, attention_dropout=0.1, is_test=False,
+                 use_flash_attention=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -30,6 +31,7 @@ class BertConfig(object):
         self.hidden_dropout = hidden_dropout
         self.attention_dropout = attention_dropout
         self.is_test = is_test
+        self.use_flash_attention = use_flash_attention
 
     @classmethod
     def base(cls, **kw):
@@ -67,8 +69,13 @@ def mask_to_bias(mask_2d):
     return bias
 
 
-def multi_head_attention(q_in, kv_in, attn_bias, cfg, name):
-    """Self/cross attention on [N, S, H] inputs."""
+def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None):
+    """Self/cross attention on [N, S, H] inputs.
+
+    With ``cfg.use_flash_attention`` (and no attention dropout to apply)
+    the score/softmax/context chain runs as ONE fused flash-attention op
+    — the Pallas kernel keeps the [S, S] scores in VMEM; ``key_bias``
+    [N, S] carries the padding mask in key-only form."""
     d_head = cfg.hidden_size // cfg.num_heads
 
     def _proj(x, suffix):
@@ -85,14 +92,24 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name):
     q = _split_heads(_proj(q_in, "q"))
     k = _split_heads(_proj(kv_in, "k"))
     v = _split_heads(_proj(kv_in, "v"))
-    scores = fluid.layers.matmul(
-        q, k, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
+    use_flash = (
+        getattr(cfg, "use_flash_attention", False)
+        and key_bias is not None
+        and (cfg.attention_dropout <= 0.0 or cfg.is_test)
     )
-    if attn_bias is not None:
-        scores = fluid.layers.elementwise_add(scores, attn_bias)
-    weights = fluid.layers.softmax(scores, axis=-1)
-    weights = _dropout(weights, cfg.attention_dropout, cfg.is_test)
-    ctxt = fluid.layers.matmul(weights, v)  # [N, heads, S, d_head]
+    if use_flash:
+        ctxt = fluid.layers.flash_attention(
+            q, k, v, key_bias=key_bias, scale=1.0 / math.sqrt(d_head)
+        )
+    else:
+        scores = fluid.layers.matmul(
+            q, k, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
+        )
+        if attn_bias is not None:
+            scores = fluid.layers.elementwise_add(scores, attn_bias)
+        weights = fluid.layers.softmax(scores, axis=-1)
+        weights = _dropout(weights, cfg.attention_dropout, cfg.is_test)
+        ctxt = fluid.layers.matmul(weights, v)  # [N, heads, S, d_head]
     ctxt = fluid.layers.transpose(ctxt, perm=[0, 2, 1, 3])
     ctxt = fluid.layers.reshape(ctxt, shape=[0, 0, cfg.hidden_size])
     return fluid.layers.fc(
@@ -112,8 +129,9 @@ def _ffn(x, cfg, name):
     )
 
 
-def encoder_layer(x, attn_bias, cfg, name):
-    attn = multi_head_attention(x, x, attn_bias, cfg, "%s_att" % name)
+def encoder_layer(x, attn_bias, cfg, name, key_bias=None):
+    attn = multi_head_attention(x, x, attn_bias, cfg, "%s_att" % name,
+                                key_bias=key_bias)
     attn = _dropout(attn, cfg.hidden_dropout, cfg.is_test)
     x = fluid.layers.layer_norm(
         fluid.layers.elementwise_add(x, attn), begin_norm_axis=2,
@@ -152,10 +170,20 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
     mask_t = fluid.layers.transpose(input_mask, perm=[0, 2, 1])
     attn_mask = fluid.layers.matmul(input_mask, mask_t)  # [N, S, S]
     attn_bias = mask_to_bias(attn_mask)
+    # key-only form of the same padding mask for the fused flash path:
+    # (mask - 1) * 1e4 per KEY position, [N, S]
+    key_bias = None
+    if getattr(cfg, "use_flash_attention", False):
+        key_bias = fluid.layers.scale(
+            fluid.layers.reshape(input_mask, shape=[0, -1]), scale=1e4,
+            bias=-1e4,
+        )
+        key_bias.stop_gradient = True
 
     x = emb
     for i in range(cfg.num_layers):
-        x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i)
+        x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i,
+                          key_bias=key_bias)
 
     first_tok = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1])
     first_tok = fluid.layers.reshape(first_tok, shape=[-1, cfg.hidden_size])

@@ -15,15 +15,26 @@ stamp() { date -u +%H:%M:%S; }
 echo "$(stamp) live window: starting bench ladder" | tee -a "$OUT/log.txt"
 BENCH_TIMEOUT=${BENCH_TIMEOUT:-1100} timeout 1150 python bench.py \
   > "$OUT/bench.json" 2> "$OUT/bench.log"
-echo "$(stamp) bench rc=$? ->" | tee -a "$OUT/log.txt"
+rc=$?
+echo "$(stamp) bench rc=$rc ->" | tee -a "$OUT/log.txt"
 cat "$OUT/bench.json" | tee -a "$OUT/log.txt"
+
+# flash-attention probe: the fused Pallas kernel vs the banked dense
+# number (bank-best in bench.py does NOT see this; recorded separately)
+echo "$(stamp) bert flash-attention probe" | tee -a "$OUT/log.txt"
+BENCH_FLASH=1 BENCH_BUDGET_S=500 timeout 550 python bench_bert.py \
+  > "$OUT/bench_bert_flash.json" 2>> "$OUT/bench.log"
+rc=$?
+echo "$(stamp) flash probe rc=$rc ->" | tee -a "$OUT/log.txt"
+cat "$OUT/bench_bert_flash.json" | tee -a "$OUT/log.txt"
 
 for spec in "resnet 256" "bert 64"; do
   set -- $spec
   echo "$(stamp) hlo_scan $1 b$2" | tee -a "$OUT/log.txt"
   timeout 700 python tools/hlo_scan.py --model "$1" --batch "$2" \
     > "$OUT/hlo_$1.json" 2>> "$OUT/bench.log"
-  echo "$(stamp) hlo_scan $1 rc=$?" | tee -a "$OUT/log.txt"
+  rc=$?
+  echo "$(stamp) hlo_scan $1 rc=$rc" | tee -a "$OUT/log.txt"
   cat "$OUT/hlo_$1.json" | tee -a "$OUT/log.txt"
 done
 echo "$(stamp) live window playbook done" | tee -a "$OUT/log.txt"
