@@ -344,6 +344,12 @@ case("trilinear_interp", inputs={"X": U(135, (1, 2, 3, 3, 3))},
      attrs={"out_d": 4, "out_h": 4, "out_w": 4, "align_corners": True,
             "interp_method": "trilinear"}, tol=0.02)
 
+case("flash_attention",
+     inputs={"Q": U(180, (2, 2, 8, 4)), "K": U(181, (2, 2, 8, 4)),
+             "V": U(182, (2, 2, 8, 4))},
+     outputs={"Out": Z(2, 2, 8, 4)}, attrs={"causal": True, "scale": 0.5},
+     tol=0.02)
+
 # -- embeddings --------------------------------------------------------------
 case("lookup_table", inputs={"W": U(140, (10, 4)),
                              "Ids": I(141, (3, 1), 0, 10)},
@@ -589,7 +595,7 @@ def test_every_op_is_checked_or_dispositioned():
 
 def test_sweep_plus_dispositions_cover_target():
     """VERDICT r3 #4 bar. Current accounting of the 397 registered ops:
-    189 FD-grad-checked (123 sweep cases + 66 dedicated tests), 52
+    190 FD-grad-checked (124 sweep cases + 66 dedicated tests), 52
     grad-bearing ops dispositioned with recorded reasons, and 156 ops with
     no grad maker by design (optimizer updates, integer/bool outputs,
     IO/collective runtime, *_grad bodies) — the differentiable corpus is
