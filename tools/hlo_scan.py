@@ -28,7 +28,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build(model, batch, amp, remat):
+def build(model, batch, amp, remat, flash=False):
     import numpy as np
 
     if model == "resnet":
@@ -49,6 +49,7 @@ def build(model, batch, amp, remat):
         cfg = bert.BertConfig()
         cfg.hidden_dropout = 0.0
         cfg.attention_dropout = 0.0
+        cfg.use_flash_attention = flash
         S = 128
         main, startup, feeds, loss, acc = bert.build_bert_classifier(
             cfg, S, learning_rate=2e-5, use_amp=amp
@@ -74,6 +75,7 @@ def main():
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--amp", type=int, default=1)
     ap.add_argument("--remat", type=int, default=0)
+    ap.add_argument("--flash", type=int, default=0)
     args = ap.parse_args()
 
     import jax
@@ -87,7 +89,8 @@ def main():
     from paddle_tpu.fluid import executor as _ex
 
     prog, startup, feed, loss = build(
-        args.model, args.batch, bool(args.amp), bool(args.remat)
+        args.model, args.batch, bool(args.amp), bool(args.remat),
+        flash=bool(args.flash),
     )
     # mirror bench.py's place choice: on a live TPU the lowering backend
     # (and with it the NHWC conv path) must match what bench.py compiles,
@@ -141,6 +144,7 @@ def main():
     }
     print(json.dumps({
         "model": args.model,
+        "flash": bool(args.flash),
         "batch": args.batch,
         "backend": jax.default_backend(),
         "flops": cost.get("flops"),

@@ -28,13 +28,15 @@ rc=$?
 echo "$(stamp) flash probe rc=$rc ->" | tee -a "$OUT/log.txt"
 cat "$OUT/bench_bert_flash.json" | tee -a "$OUT/log.txt"
 
-for spec in "resnet 256" "bert 64"; do
+for spec in "resnet 256" "bert 64" "bert 64 --flash 1"; do
   set -- $spec
-  echo "$(stamp) hlo_scan $1 b$2" | tee -a "$OUT/log.txt"
-  timeout 700 python tools/hlo_scan.py --model "$1" --batch "$2" \
-    > "$OUT/hlo_$1.json" 2>> "$OUT/bench.log"
+  model=$1; batch=$2; shift 2
+  tag=$model${1:+_flash}
+  echo "$(stamp) hlo_scan $tag b$batch" | tee -a "$OUT/log.txt"
+  timeout 700 python tools/hlo_scan.py --model "$model" --batch "$batch" "$@" \
+    > "$OUT/hlo_$tag.json" 2>> "$OUT/bench.log"
   rc=$?
-  echo "$(stamp) hlo_scan $1 rc=$rc" | tee -a "$OUT/log.txt"
-  cat "$OUT/hlo_$1.json" | tee -a "$OUT/log.txt"
+  echo "$(stamp) hlo_scan $tag rc=$rc" | tee -a "$OUT/log.txt"
+  cat "$OUT/hlo_$tag.json" | tee -a "$OUT/log.txt"
 done
 echo "$(stamp) live window playbook done" | tee -a "$OUT/log.txt"
