@@ -54,13 +54,13 @@ def reference_attention(q, k, v, bias=None, causal=False, scale=None):
 
 
 def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, causal,
-            seq_len, block_q, block_k):
+            kv_len, block_q, block_k):
     """One (head, q-block) program: online softmax over k blocks."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
     qi = pl.program_id(1)
-    n_kb = seq_len // block_k
+    n_kb = kv_len // block_k
 
     m = jnp.full((block_q, 1), _NEG, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -95,30 +95,33 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, causal,
 
 
 def _pallas_forward(q, k, v, key_bias, causal, scale, interpret):
-    """q/k/v [BN, S, D] (S % block == 0), key_bias [BN, S] additive."""
+    """q [BN, Sq, D], k/v [BN, Sk, D] (both block-multiples), key_bias
+    [BN, Sk] additive."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    BN, S, D = q.shape
-    bq = min(BLOCK_Q, S)
-    bk = min(BLOCK_K, S)
-    grid = (BN, S // bq)
+    BN, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq = min(BLOCK_Q, Sq)
+    bk = min(BLOCK_K, Sk)
+    assert Sq % bq == 0 and Sk % bk == 0, (Sq, bq, Sk, bk)
+    grid = (BN, Sq // bq)
     kernel = functools.partial(
-        _kernel, scale=scale, causal=causal, seq_len=S,
+        _kernel, scale=scale, causal=causal, kv_len=Sk,
         block_q=bq, block_k=bk,
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BN, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BN, Sq, D), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda h, i: (h, 0, 0),
+            pl.BlockSpec((1, Sk, D), lambda h, i: (h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda h, i: (h, 0, 0),
+            pl.BlockSpec((1, Sk, D), lambda h, i: (h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S), lambda h, i: (h, 0),
+            pl.BlockSpec((1, Sk), lambda h, i: (h, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
@@ -137,24 +140,31 @@ def _flash(q, k, v, key_bias, causal, scale, interpret):
 
 
 def _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret):
-    B, N, S, D = q.shape
-    Sp = _round_up(S, min(BLOCK_Q, _round_up(S, 8)))
-    if Sp % 8:
-        Sp = _round_up(Sp, 8)
-    qf = q.reshape(B * N, S, D)
-    kf = k.reshape(B * N, S, D)
-    vf = v.reshape(B * N, S, D)
-    bias = jnp.broadcast_to(key_bias, (B * N, S))
-    if Sp != S:
-        pad = ((0, 0), (0, Sp - S), (0, 0))
-        qf = jnp.pad(qf, pad)
-        kf = jnp.pad(kf, pad)
-        vf = jnp.pad(vf, pad)
-        # padded KEYS must never receive weight; padded QUERY rows are
-        # sliced away below (their uniform softmax is harmless)
-        bias = jnp.pad(bias, ((0, 0), (0, Sp - S)), constant_values=_NEG)
+    B, N, Sq, D = q.shape
+    Sk = k.shape[2]
+
+    def pad_to(S, block):
+        Sp = _round_up(S, 8)
+        return _round_up(Sp, min(block, Sp))
+
+    # queries pad to the q-tile, keys to the K-TILE — n_kb = Skp // bk in
+    # the kernel truncates silently if this invariant ever breaks
+    Sqp, Skp = pad_to(Sq, BLOCK_Q), pad_to(Sk, BLOCK_K)
+    qf = q.reshape(B * N, Sq, D)
+    kf = k.reshape(B * N, Sk, D)
+    vf = v.reshape(B * N, Sk, D)
+    bias = jnp.broadcast_to(key_bias, (B * N, Sk))
+    if Sqp != Sq:
+        # padded QUERY rows are sliced away below (their uniform/empty
+        # softmax is harmless)
+        qf = jnp.pad(qf, ((0, 0), (0, Sqp - Sq), (0, 0)))
+    if Skp != Sk:
+        # padded KEYS must never receive weight
+        kf = jnp.pad(kf, ((0, 0), (0, Skp - Sk), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, Skp - Sk), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, Skp - Sk)), constant_values=_NEG)
     out = _pallas_forward(qf, kf, vf, bias, causal, scale, interpret)
-    return out[:, :S, :].reshape(B, N, S, D)
+    return out[:, :Sq, :].reshape(B, N, Sq, D)
 
 
 def _flash_fwd(q, k, v, key_bias, causal, scale, interpret):
@@ -165,11 +175,12 @@ def _flash_fwd(q, k, v, key_bias, causal, scale, interpret):
 
 def _flash_bwd(causal, scale, interpret, res, g):
     q, k, v, key_bias = res
-    B, N, S, _ = q.shape
+    B, N = q.shape[:2]
+    Sk = k.shape[2]
 
     def ref(q, k, v, key_bias):
         return reference_attention(
-            q, k, v, bias=key_bias.reshape(B, N, 1, S),
+            q, k, v, bias=key_bias.reshape(B, N, 1, Sk),
             causal=causal, scale=scale,
         )
 
@@ -190,25 +201,30 @@ def flash_attention(q, k, v, key_bias=None, causal=False, scale=None,
     ``interpret``: force the Pallas interpreter (tests); default runs the
     kernel on TPU and the jnp reference elsewhere.
     """
-    B, N, S, d = q.shape
+    B, N, Sq, d = q.shape
+    Sk = k.shape[2]  # key length (cross attention: != query length)
+    if causal and Sq != Sk:
+        # guard here so the non-TPU reference fallback can't silently
+        # mis-mask (a 1-query causal call would broadcast tril((1,1)))
+        raise ValueError("causal flash attention needs Sq == Sk")
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
     kb = None
     if key_bias is not None:
-        # normalize [S] / [B, S] / [B*N, S] / [B, N, S] -> [B*N, S]
+        # normalize [Sk] / [B, Sk] / [B*N, Sk] / [B, N, Sk] -> [B*N, Sk]
         kb = key_bias.astype(jnp.float32)
         if kb.ndim == 1:
             kb = kb[None]
-        kb = kb.reshape(-1, S)
+        kb = kb.reshape(-1, Sk)
         if kb.shape[0] == B and N > 1:
-            kb = jnp.broadcast_to(kb[:, None, :], (B, N, S)).reshape(-1, S)
-        kb = jnp.broadcast_to(kb, (B * N, S))
+            kb = jnp.broadcast_to(kb[:, None, :], (B, N, Sk)).reshape(-1, Sk)
+        kb = jnp.broadcast_to(kb, (B * N, Sk))
     on_tpu = jax.default_backend() == "tpu"
     if interpret is None and not on_tpu:
         return reference_attention(
             q, k, v,
-            bias=None if kb is None else kb.reshape(B, N, 1, S),
+            bias=None if kb is None else kb.reshape(B, N, 1, Sk),
             causal=causal, scale=scale,
         )
     if kb is None:
-        kb = jnp.zeros((B * N, S), jnp.float32)
+        kb = jnp.zeros((B * N, Sk), jnp.float32)
     return _flash(q, k, v, kb, causal, scale, bool(interpret))

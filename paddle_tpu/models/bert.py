@@ -69,7 +69,20 @@ def mask_to_bias(mask_2d):
     return bias
 
 
-def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None):
+def mask_to_key_bias(mask):
+    """[N, S, 1] 0/1 token mask -> key-only additive bias [N, S]
+    ((m-1)*1e4: 0 where attendable, -1e4 on padded keys) for the fused
+    flash-attention path; the query side needs no mask because padded-
+    query rows never reach a loss term."""
+    b = fluid.layers.scale(
+        fluid.layers.reshape(mask, shape=[0, -1]), scale=1e4, bias=-1e4
+    )
+    b.stop_gradient = True
+    return b
+
+
+def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
+                         causal=False):
     """Self/cross attention on [N, S, H] inputs.
 
     With ``cfg.use_flash_attention`` (and no attention dropout to apply)
@@ -98,8 +111,10 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None):
         and (cfg.attention_dropout <= 0.0 or cfg.is_test)
     )
     if use_flash:
+        # ``causal`` rides the kernel flag instead of a dense [T, T] bias
         ctxt = fluid.layers.flash_attention(
-            q, k, v, key_bias=key_bias, scale=1.0 / math.sqrt(d_head)
+            q, k, v, key_bias=key_bias, causal=causal,
+            scale=1.0 / math.sqrt(d_head),
         )
     else:
         scores = fluid.layers.matmul(
@@ -170,15 +185,11 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
     mask_t = fluid.layers.transpose(input_mask, perm=[0, 2, 1])
     attn_mask = fluid.layers.matmul(input_mask, mask_t)  # [N, S, S]
     attn_bias = mask_to_bias(attn_mask)
-    # key-only form of the same padding mask for the fused flash path:
-    # (mask - 1) * 1e4 per KEY position, [N, S]
-    key_bias = None
-    if getattr(cfg, "use_flash_attention", False):
-        key_bias = fluid.layers.scale(
-            fluid.layers.reshape(input_mask, shape=[0, -1]), scale=1e4,
-            bias=-1e4,
-        )
-        key_bias.stop_gradient = True
+    key_bias = (
+        mask_to_key_bias(input_mask)
+        if getattr(cfg, "use_flash_attention", False)
+        else None
+    )
 
     x = emb
     for i in range(cfg.num_layers):

@@ -11,13 +11,15 @@ import math
 import numpy as np
 
 import paddle_tpu.fluid as fluid
-from .bert import multi_head_attention, _ffn, _dropout, mask_to_bias
+from .bert import (multi_head_attention, _ffn, _dropout, mask_to_bias,
+                   mask_to_key_bias)
 
 
 class TransformerConfig(object):
     def __init__(self, src_vocab=30000, tgt_vocab=30000, hidden_size=512,
                  num_heads=8, num_layers=6, intermediate_size=2048,
-                 max_len=256, dropout=0.1, label_smooth=0.1, is_test=False):
+                 max_len=256, dropout=0.1, label_smooth=0.1, is_test=False,
+                 use_flash_attention=False):
         self.src_vocab = src_vocab
         self.tgt_vocab = tgt_vocab
         self.hidden_size = hidden_size
@@ -28,6 +30,7 @@ class TransformerConfig(object):
         self.dropout = dropout
         self.label_smooth = label_smooth
         self.is_test = is_test
+        self.use_flash_attention = use_flash_attention
         # bert.multi_head_attention reads these names:
         self.hidden_dropout = dropout
         self.attention_dropout = dropout
@@ -98,10 +101,18 @@ def transformer(cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask,
         src_mask, fluid.layers.transpose(src_mask, perm=[0, 2, 1])
     )
     enc_bias = mask_to_bias(src_self)
+    # key-only padding masks for the fused flash path ((m-1)*1e4 per key):
+    # encoder/cross keys are SRC positions, decoder-self keys are TGT
+    # positions with causality riding the kernel's causal flag
+    src_key_bias = tgt_key_bias = None
+    if getattr(cfg, "use_flash_attention", False):
+        src_key_bias = mask_to_key_bias(src_mask)
+        tgt_key_bias = mask_to_key_bias(tgt_mask)
     enc = _embed(src_ids, src_pos, cfg.src_vocab, cfg, "src")
     for i in range(cfg.num_layers):
         name = "enc_%d" % i
-        attn = multi_head_attention(enc, enc, enc_bias, cfg, name + "_att")
+        attn = multi_head_attention(enc, enc, enc_bias, cfg, name + "_att",
+                                    key_bias=src_key_bias)
         enc = _residual_ln(enc, attn, cfg, name + "_ln1")
         enc = _residual_ln(enc, _ffn(enc, cfg, name + "_ffn"), cfg, name + "_ln2")
 
@@ -119,9 +130,12 @@ def transformer(cfg, src_ids, src_pos, src_mask, tgt_ids, tgt_pos, tgt_mask,
     dec = _embed(tgt_ids, tgt_pos, cfg.tgt_vocab, cfg, "tgt")
     for i in range(cfg.num_layers):
         name = "dec_%d" % i
-        attn = multi_head_attention(dec, dec, dec_self_bias, cfg, name + "_satt")
+        attn = multi_head_attention(dec, dec, dec_self_bias, cfg,
+                                    name + "_satt", key_bias=tgt_key_bias,
+                                    causal=True)
         dec = _residual_ln(dec, attn, cfg, name + "_ln1")
-        xatt = multi_head_attention(dec, enc, cross_bias, cfg, name + "_xatt")
+        xatt = multi_head_attention(dec, enc, cross_bias, cfg, name + "_xatt",
+                                    key_bias=src_key_bias)
         dec = _residual_ln(dec, xatt, cfg, name + "_ln2")
         dec = _residual_ln(dec, _ffn(dec, cfg, name + "_ffn"), cfg, name + "_ln3")
 

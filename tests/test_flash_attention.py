@@ -153,3 +153,99 @@ def test_bert_flash_flag_matches_dense_path():
     dense = run(False)
     flash = run(True)
     np.testing.assert_allclose(flash, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_flash_flag_matches_dense_path():
+    """Transformer NMT with use_flash_attention (causal decoder self-attn
+    via the kernel's causal flag, padding via key-only biases) must match
+    the dense-mask path's masked training loss on padded batches."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import transformer as tfm
+
+    S, T, N = 8, 8, 4
+
+    def run(flash):
+        cfg = tfm.TransformerConfig(
+            src_vocab=30, tgt_vocab=30, hidden_size=16, num_heads=2,
+            num_layers=1, intermediate_size=32, dropout=0.0,
+            label_smooth=0.0, use_flash_attention=flash,
+        )
+        with fluid.unique_name.guard():
+            main, startup, feeds, loss = tfm.build_transformer_train(
+                cfg, S, T, learning_rate=0.1
+            )
+        main.random_seed = startup.random_seed = 44
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        src_mask = np.ones((N, S, 1), "float32")
+        src_mask[:, 6:] = 0.0
+        tgt_mask = np.ones((N, T, 1), "float32")
+        tgt_mask[:, 5:] = 0.0
+        feed = {
+            "src_ids": rs.randint(2, 30, (N, S, 1)).astype("int64"),
+            "src_pos": np.tile(np.arange(S)[None, :, None],
+                               (N, 1, 1)).astype("int64"),
+            "src_mask": src_mask,
+            "tgt_ids": rs.randint(2, 30, (N, T, 1)).astype("int64"),
+            "tgt_pos": np.tile(np.arange(T)[None, :, None],
+                               (N, 1, 1)).astype("int64"),
+            "tgt_mask": tgt_mask,
+            "labels": rs.randint(2, 30, (N, T, 1)).astype("int64"),
+        }
+        out = []
+        for _ in range(3):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            out.append(float(np.asarray(lv).ravel()[0]))
+        return out
+
+    dense = run(False)
+    flash = run(True)
+    np.testing.assert_allclose(flash, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_cross_attention_different_kv_length():
+    """Cross attention (decoder->encoder): S_q != S_kv, with a key-side
+    padding mask on the encoder length."""
+    B, N, Sq, Sk, D = 2, 2, 24, 40, 16
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(B, N, Sq, D).astype("float32") * 0.5)
+    k = jnp.asarray(rs.randn(B, N, Sk, D).astype("float32") * 0.5)
+    v = jnp.asarray(rs.randn(B, N, Sk, D).astype("float32") * 0.5)
+    kb = np.zeros((B, Sk), np.float32)
+    kb[:, 30:] = -1e9
+    out = flash_attention(q, k, v, key_bias=jnp.asarray(kb), interpret=True)
+    ref = reference_attention(
+        q, k, v,
+        bias=jnp.broadcast_to(jnp.asarray(kb)[:, None, None, :],
+                              (B, N, 1, Sk)),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_causal_with_key_bias_and_odd_length():
+    """The decoder-self configuration: causal flag combined with a key
+    padding bias, at a non-multiple-of-8 length (exercising the internal
+    pad path), through the KERNEL (interpret mode)."""
+    B, N, S, D = 2, 2, 21, 16
+    rs = np.random.RandomState(11)
+    q = jnp.asarray(rs.randn(B, N, S, D).astype("float32") * 0.5)
+    k = jnp.asarray(rs.randn(B, N, S, D).astype("float32") * 0.5)
+    v = jnp.asarray(rs.randn(B, N, S, D).astype("float32") * 0.5)
+    kb = np.zeros((B, S), np.float32)
+    kb[:, 15:] = -1e9
+    out = flash_attention(q, k, v, key_bias=jnp.asarray(kb), causal=True,
+                          interpret=True)
+    ref = reference_attention(
+        q, k, v,
+        bias=jnp.broadcast_to(jnp.asarray(kb)[:, None, None, :],
+                              (B, N, 1, S)),
+        causal=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # causal cross-length must refuse loudly on every backend
+    with pytest.raises(ValueError):
+        flash_attention(q[:, :, :8], k, v, causal=True)
