@@ -52,6 +52,90 @@ V100_RESNET50_FP32_IMG_PER_SEC = 360.0
 METRIC = "resnet50_train_throughput"
 UNIT = "images/sec/chip"
 
+# --------------------------------------------------------------------------
+# persistent TPU results-bank (VERDICT r4 task 1)
+#
+# Any successful TPU measurement — this run, a previous driver run, or the
+# background watcher's live-window playbook (tools/tpu_watcher.py) — is
+# recorded in the committed BENCH_BANK.json with its git sha and UTC
+# timestamp. When every live TPU attempt in a run dies (the axon tunnel
+# has hung through entire rounds), the emitted line falls back to the
+# banked number with "banked": true + provenance instead of a meaningless
+# CPU figure; a CPU fallback is only emitted when the bank is empty, and
+# then with vs_baseline: null (a CPU number has no defensible relation to
+# the V100 baseline).
+# --------------------------------------------------------------------------
+
+BANK_PATH = os.environ.get(
+    "BENCH_BANK_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BANK.json"),
+)
+
+
+def load_bank():
+    try:
+        with open(BANK_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _bank_entry(line):
+    """Bank entry from an emit line: keep the measurement facts, drop the
+    run-relative fields (vs_baseline is recomputed at emit time)."""
+    keep = ("metric", "value", "unit", "batch", "device", "seq_len",
+            "remat", "flash_attention")
+    return {k: line[k] for k in keep if k in line}
+
+
+def bank_write(slot, entry):
+    """Record a successful TPU measurement under ``slot`` (bank-the-best:
+    a slower re-measurement never overwrites a faster banked one).
+    Locked read-modify-write: the background watcher (tools/tpu_watcher.py)
+    and a driver/interactive bench run may bank concurrently.
+    Returns True if the bank changed."""
+    import fcntl
+
+    with open(BANK_PATH + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        bank = load_bank()
+        prev = bank.get(slot)
+        if prev is not None and prev.get("value", 0.0) >= entry["value"]:
+            return False
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=10,
+            ).stdout.strip() or "unknown"
+        except (OSError, subprocess.SubprocessError):
+            sha = "unknown"
+        bank[slot] = dict(
+            entry,
+            git_sha=sha,
+            measured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        )
+        tmp = BANK_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bank, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, BANK_PATH)
+    return True
+
+
+def bank_best(prefix):
+    """Best banked TPU entry whose slot starts with ``prefix`` (or None)."""
+    cands = [
+        (slot, e)
+        for slot, e in load_bank().items()
+        if slot.startswith(prefix) and e.get("device") == "tpu"
+    ]
+    if not cands:
+        return None, None
+    return max(cands, key=lambda kv: kv[1].get("value", 0.0))
+
 
 def enable_compilation_cache(jax):
     """Persistent XLA compilation cache shared by every bench child, so
@@ -331,7 +415,12 @@ def _emit(out):
     print(json.dumps(out), flush=True)
 
 
-V100_BERT_BASE_SEQ_PER_SEC = 40.0
+# Per-seq-len V100 fp32 BERT-base fine-tune baselines (BASELINE.md metric
+# 2 provenance note): seq128 is the commonly reported ~40 seq/s figure;
+# seq384 (the SQuAD convention) is FLOPs-scaled from it — per-sequence
+# transformer FLOPs scale as S*(24*H^2 + 4*S*H), giving a 3.16x ratio
+# between seq384 and seq128 for H=768, hence 40/3.16 = 12.7 seq/s.
+V100_BERT_BASE_SEQ_PER_SEC = {128: 40.0, 384: 12.7}
 BERT_METRIC = "bert_base_finetune_throughput"
 BERT_UNIT = "sequences/sec/chip"
 
@@ -350,25 +439,88 @@ def _resnet_line(result, batch, errors, degraded):
         "device": result["device"],
     }
     if degraded:
+        # a CPU number has no defensible relation to the V100 baseline
+        line["vs_baseline"] = None
         line["degraded"] = "cpu fallback (TPU attempts failed: %s)" % (
             "; ".join(errors)[:400] or "none tried"
         )
     return line
 
 
-def _bert_line(result, batch, errors, degraded):
+def _bert_line(result, batch, seq_len, errors, degraded, flash=False):
+    baseline = V100_BERT_BASE_SEQ_PER_SEC.get(seq_len)
     line = {
         "metric": BERT_METRIC,
         "value": round(result["sps"], 2),
         "unit": BERT_UNIT,
-        "vs_baseline": round(result["sps"] / V100_BERT_BASE_SEQ_PER_SEC, 3),
+        # null for a seq len with no documented baseline constant
+        "vs_baseline": round(result["sps"] / baseline, 3) if baseline else None,
         "batch": batch,
-        "seq_len": 128,
+        "seq_len": seq_len,
         "device": result["device"],
     }
+    if flash:
+        line["flash_attention"] = True
     if degraded:
+        line["vs_baseline"] = None
         line["degraded"] = "cpu-fallback tiny-config (TPU attempts failed: %s)" % (
             "; ".join(errors)[:400] or "none tried"
+        )
+    return line
+
+
+def _banked_resnet_line(errors):
+    """Emit-line from the best banked ResNet TPU measurement, or None."""
+    slot, e = bank_best("resnet50")
+    if e is None:
+        return None
+    line = {
+        "metric": METRIC,
+        "value": e["value"],
+        "unit": UNIT,
+        "vs_baseline": round(e["value"] / V100_RESNET50_FP32_IMG_PER_SEC, 3),
+        "batch": e.get("batch"),
+        "device": "tpu",
+        "banked": True,
+        "git_sha": e.get("git_sha"),
+        "measured_at": e.get("measured_at"),
+    }
+    if e.get("remat"):
+        line["remat"] = True
+    if errors:
+        line["note"] = "banked TPU measurement; live attempts this run failed: %s" % (
+            "; ".join(errors)[:300]
+        )
+    return line
+
+
+def _banked_bert_line(errors):
+    """Emit-line from the best banked BERT TPU measurement; prefers the
+    defensible seq-384 config over the cheap seq-128 rung."""
+    slot, e = bank_best("bert_seq384")
+    seq = 384
+    if e is None:
+        slot, e = bank_best("bert_seq128")
+        seq = 128
+    if e is None:
+        return None
+    line = {
+        "metric": BERT_METRIC,
+        "value": e["value"],
+        "unit": BERT_UNIT,
+        "vs_baseline": round(e["value"] / V100_BERT_BASE_SEQ_PER_SEC[seq], 3),
+        "batch": e.get("batch"),
+        "seq_len": seq,
+        "device": "tpu",
+        "banked": True,
+        "git_sha": e.get("git_sha"),
+        "measured_at": e.get("measured_at"),
+    }
+    if slot.endswith("_flash"):
+        line["flash_attention"] = True
+    if errors:
+        line["note"] = "banked TPU measurement; live attempts this run failed: %s" % (
+            "; ".join(errors)[:300]
         )
     return line
 
@@ -412,6 +564,14 @@ def parent_main():
             label, cfg, slot * tpu_scale, tpu_deadline()
         )
         if result is not None:
+            if result["device"] == "tpu":
+                line = _resnet_line(result, batch, [], False)
+                if cfg.get("remat"):
+                    line["remat"] = True
+                bank_write(
+                    "resnet50" + ("_remat" if cfg.get("remat") else ""),
+                    _bank_entry(line),
+                )
             prev = banked["resnet"]
             # bank-the-best: a slower later success (e.g. a bigger batch
             # that thrashes) never overwrites a faster banked TPU number
@@ -433,21 +593,37 @@ def parent_main():
             tunnel_suspect = True
         return False
 
-    def try_bert_tpu(slot, batch=64):
+    def try_bert_tpu(slot, batch=64, seq_len=128, flash=False):
         nonlocal tunnel_suspect
-        cfg = dict(platform="", batch=batch, steps=10, warmup=2, full=True)
-        label = "bert-tpu-b%d" % batch
+        cfg = dict(
+            platform="",
+            batch=batch,
+            steps=10,
+            warmup=2,
+            full=True,
+            seq_len=seq_len,
+            flash=flash,
+        )
+        label = "bert-tpu-b%d-s%d%s" % (batch, seq_len, "-flash" if flash else "")
         result, kind, err, probe_ok = _run_attempt(
             label, cfg, slot * tpu_scale, tpu_deadline(), script=_bert_script()
         )
         if result is not None:
+            if result["device"] == "tpu":
+                bank_write(
+                    "bert_seq%d%s" % (seq_len, "_flash" if flash else ""),
+                    _bank_entry(_bert_line(result, batch, seq_len, [], False, flash)),
+                )
             prev = banked["bert"]
+            # a seq-384 number (the defensible SQuAD config) always beats
+            # a banked seq-128 rung; within a seq len, bank-the-best
             if (
                 prev is None
                 or prev.get("degraded")
-                or result["sps"] > prev["value"]
+                or seq_len > prev.get("seq_len", 0)
+                or (seq_len == prev.get("seq_len") and result["sps"] > prev["value"])
             ):
-                banked["bert"] = _bert_line(result, batch, [], False)
+                banked["bert"] = _bert_line(result, batch, seq_len, [], False, flash)
             tpu_ok["bert"] = True
             tunnel_suspect = False
             return True
@@ -457,7 +633,9 @@ def parent_main():
         return False
 
     def bank_cpu_fallbacks():
-        if banked["resnet"] is None:
+        # a banked TPU number makes the CPU fallback pointless — skip it
+        # and leave the window to phase-D TPU retries
+        if banked["resnet"] is None and bank_best("resnet50")[1] is None:
             cpu_cfg = dict(
                 base,
                 batch=int(os.environ.get("BENCH_CPU_BATCH", "8")),
@@ -474,13 +652,15 @@ def parent_main():
                 )
             else:
                 note_fail("resnet", "cpu-degraded", kind, err)
-        if banked["bert"] is None:
-            cfg = dict(platform="cpu", batch=4, steps=3, warmup=1, full=False)
+        if banked["bert"] is None and bank_best("bert_seq")[1] is None:
+            cfg = dict(
+                platform="cpu", batch=4, steps=3, warmup=1, full=False, seq_len=128
+            )
             result, kind, err, _ = _run_attempt(
                 "bert-cpu-degraded", cfg, 150.0, hard_deadline, script=_bert_script()
             )
             if result is not None:
-                banked["bert"] = _bert_line(result, 4, errors["bert"], True)
+                banked["bert"] = _bert_line(result, 4, 128, errors["bert"], True)
             else:
                 note_fail("bert", "bert-cpu-degraded", kind, err)
 
@@ -494,8 +674,11 @@ def parent_main():
             if not try_resnet_tpu(b, slot_for[b]):
                 break
     # ---- phase B: BERT on TPU (skip if the tunnel looks dead) ----
+    # cheap seq-128 rung first to bank *something*, then the defensible
+    # SQuAD-convention seq-384 config (VERDICT r4 task 4)
     if not tunnel_suspect:
-        try_bert_tpu(260.0)
+        if try_bert_tpu(260.0, batch=64, seq_len=128):
+            try_bert_tpu(280.0, batch=24, seq_len=384)
 
     # ---- phase C: degraded CPU fallbacks for anything still missing ----
     bank_cpu_fallbacks()
@@ -532,6 +715,23 @@ def parent_main():
         if not tpu_ok["bert"]:
             try_bert_tpu(150.0)
             did_something = True
+        elif banked["bert"] is not None and not banked["bert"].get("degraded"):
+            # BERT banked: escalate seq 384, then the flash-attention rung
+            # (VERDICT r4's own mitigation: probe flash only after a dense
+            # number is banked, so a kernel failure can't zero the metric)
+            if banked["bert"].get("seq_len") != 384 and "bert384" not in escalated:
+                escalated.add("bert384")
+                try_bert_tpu(280.0, batch=24, seq_len=384)
+                did_something = True
+            elif "bertflash" not in escalated:
+                escalated.add("bertflash")
+                try_bert_tpu(
+                    280.0,
+                    batch=banked["bert"].get("batch", 24),
+                    seq_len=banked["bert"].get("seq_len", 384),
+                    flash=True,
+                )
+                did_something = True
         if not did_something:
             break  # nothing left worth retrying — emit now
         # fast failures (e.g. instant no_tpu) must still SPREAD retries
@@ -541,29 +741,37 @@ def parent_main():
             time.sleep(min(120.0 - spent, max(0.0, hard_deadline - 160.0 - time.time())))
 
     # ---- emit: resnet (headline) first, bert second ----
+    # preference per metric: live TPU line > banked TPU line (with
+    # provenance) > degraded CPU line (vs_baseline null) > error line
     rc = 0
-    if banked["resnet"] is not None:
-        _emit(banked["resnet"])
+    line = banked["resnet"]
+    if line is None or line.get("degraded"):
+        line = _banked_resnet_line(errors["resnet"]) or line
+    if line is not None:
+        _emit(line)
     else:
         _emit(
             {
                 "metric": METRIC,
                 "value": 0.0,
                 "unit": UNIT,
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
                 "error": "; ".join(errors["resnet"])[:800],
             }
         )
         rc = 1
-    if banked["bert"] is not None:
-        _emit(banked["bert"])
+    line = banked["bert"]
+    if line is None or line.get("degraded"):
+        line = _banked_bert_line(errors["bert"]) or line
+    if line is not None:
+        _emit(line)
     else:
         _emit(
             {
                 "metric": BERT_METRIC,
                 "value": 0.0,
                 "unit": BERT_UNIT,
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
                 "error": "; ".join(errors["bert"])[:800],
             }
         )
@@ -583,7 +791,7 @@ def main():
                 "metric": METRIC,
                 "value": 0.0,
                 "unit": UNIT,
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
                 "error": "parent crash: %s"
                 % traceback.format_exc().strip().splitlines()[-1][:300],
             }

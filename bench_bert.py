@@ -14,11 +14,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# commonly reported V100 fp32 BERT-base seq128 fine-tune rate (~40 seq/s)
-V100_BERT_BASE_SEQ_PER_SEC = 40.0
+# per-seq-len V100 fp32 baselines — see bench.py for the seq-384
+# FLOPs-scaling derivation and BASELINE.md for provenance
+V100_BERT_BASE_SEQ_PER_SEC = {128: 40.0, 384: 12.7}
 METRIC = "bert_base_finetune_throughput"
 UNIT = "sequences/sec/chip"
-SEQ_LEN = 128
+DEFAULT_SEQ_LEN = int(os.environ.get("BENCH_BERT_SEQ", "128"))
 
 
 def _hb(msg):
@@ -60,6 +61,7 @@ def child_main(cfg):
     _hb("probe ok")
 
     batch = cfg["batch"]
+    seq_len = int(cfg.get("seq_len", DEFAULT_SEQ_LEN))
     bcfg = (
         bert.BertConfig() if cfg["full"] else bert.BertConfig(
             hidden_size=256, num_layers=4, num_heads=4,
@@ -74,7 +76,7 @@ def child_main(cfg):
     )
     _hb("build start")
     main, startup, feeds, loss, acc = bert.build_bert_classifier(
-        bcfg, SEQ_LEN, learning_rate=2e-5,
+        bcfg, seq_len, learning_rate=2e-5,
         # bf16 matmuls on the MXU (BENCH_AMP=0 opts out, bench.py parity)
         use_amp=os.environ.get("BENCH_AMP", "1") == "1",
     )
@@ -85,17 +87,17 @@ def child_main(cfg):
     rs = np.random.RandomState(0)
     feed = {
         "src_ids": jax.device_put(
-            rs.randint(0, bcfg.vocab_size, (batch, SEQ_LEN, 1)).astype("int64"), dev
+            rs.randint(0, bcfg.vocab_size, (batch, seq_len, 1)).astype("int64"), dev
         ),
         "pos_ids": jax.device_put(
-            np.tile(np.arange(SEQ_LEN)[None, :, None], (batch, 1, 1)).astype("int64"),
+            np.tile(np.arange(seq_len)[None, :, None], (batch, 1, 1)).astype("int64"),
             dev,
         ),
         "sent_ids": jax.device_put(
-            np.zeros((batch, SEQ_LEN, 1), "int64"), dev
+            np.zeros((batch, seq_len, 1), "int64"), dev
         ),
         "input_mask": jax.device_put(
-            np.ones((batch, SEQ_LEN, 1), "float32"), dev
+            np.ones((batch, seq_len, 1), "float32"), dev
         ),
         "label": jax.device_put(rs.randint(0, 2, (batch, 1)).astype("int64"), dev),
     }
@@ -149,13 +151,23 @@ def main():
     import bench
 
     deadline = time.time() + int(os.environ.get("BENCH_BUDGET_S", "1400"))
+    seq = DEFAULT_SEQ_LEN
+    flash = os.environ.get("BENCH_FLASH", "0") == "1"
+    # batch scales down with seq len so the attempt fits the same slot
+    big, small = (64, 16) if seq <= 128 else (24, 8)
     attempts = [
-        (dict(platform="", batch=64, steps=10, warmup=2, full=True), 420),
-        (dict(platform="", batch=16, steps=10, warmup=2, full=True), 360),
-        (dict(platform="cpu", batch=4, steps=3, warmup=1, full=False), 280),
+        (dict(platform="", batch=big, steps=10, warmup=2, full=True,
+              seq_len=seq, flash=flash), 420),
+        (dict(platform="", batch=small, steps=10, warmup=2, full=True,
+              seq_len=seq, flash=flash), 360),
+        (dict(platform="cpu", batch=4, steps=3, warmup=1, full=False,
+              seq_len=128, flash=flash), 280),
     ]
     for cfg, slot in attempts:
-        label = "bert-%s-b%d" % (cfg["platform"] or "tpu", cfg["batch"])
+        label = "bert-%s-b%d-s%d%s" % (
+            cfg["platform"] or "tpu", cfg["batch"], cfg["seq_len"],
+            "-flash" if cfg["flash"] else "",
+        )
         res, _kind, err, _probe_ok = bench._run_attempt(
             label, cfg, slot, deadline,
             script=os.path.abspath(__file__),
@@ -165,21 +177,33 @@ def main():
                   flush=True)
         if res:
             degraded = cfg["platform"] == "cpu" or not cfg["full"]
+            baseline = V100_BERT_BASE_SEQ_PER_SEC.get(cfg["seq_len"])
             out = {
                 "metric": METRIC,
                 "value": round(res["sps"], 2),
                 "unit": UNIT,
-                "vs_baseline": round(res["sps"] / V100_BERT_BASE_SEQ_PER_SEC, 3),
+                # null when degraded OR the seq len has no documented constant
+                "vs_baseline": (
+                    round(res["sps"] / baseline, 3)
+                    if baseline and not degraded else None
+                ),
                 "batch": cfg["batch"],
-                "seq_len": SEQ_LEN,
+                "seq_len": cfg["seq_len"],
                 "device": res["device"],
             }
+            if cfg["flash"]:
+                out["flash_attention"] = True
+            if res["device"] == "tpu" and not degraded:
+                bench.bank_write(
+                    "bert_seq%d%s" % (cfg["seq_len"], "_flash" if cfg["flash"] else ""),
+                    bench._bank_entry(out),
+                )
             if degraded:
                 out["degraded"] = "cpu-fallback tiny-config"
             print(json.dumps(out), flush=True)
             return
     print(json.dumps({
-        "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
+        "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": None,
         "error": "all attempts failed",
     }), flush=True)
 

@@ -28,7 +28,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build(model, batch, amp, remat, flash=False):
+def build(model, batch, amp, remat, flash=False, seq=128):
     import numpy as np
 
     if model == "resnet":
@@ -50,7 +50,7 @@ def build(model, batch, amp, remat, flash=False):
         cfg.hidden_dropout = 0.0
         cfg.attention_dropout = 0.0
         cfg.use_flash_attention = flash
-        S = 128
+        S = seq
         main, startup, feeds, loss, acc = bert.build_bert_classifier(
             cfg, S, learning_rate=2e-5, use_amp=amp
         )
@@ -76,6 +76,8 @@ def main():
     ap.add_argument("--amp", type=int, default=1)
     ap.add_argument("--remat", type=int, default=0)
     ap.add_argument("--flash", type=int, default=0)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--out", default="", help="also write the JSON line here")
     args = ap.parse_args()
 
     import jax
@@ -90,7 +92,7 @@ def main():
 
     prog, startup, feed, loss = build(
         args.model, args.batch, bool(args.amp), bool(args.remat),
-        flash=bool(args.flash),
+        flash=bool(args.flash), seq=args.seq,
     )
     # mirror bench.py's place choice: on a live TPU the lowering backend
     # (and with it the NHWC conv path) must match what bench.py compiles,
@@ -142,16 +144,21 @@ def main():
         for k in ("transpose", "convert", "copy", "fusion", "dot",
                   "convolution", "all-reduce", "custom-call")
     }
-    print(json.dumps({
+    line = json.dumps({
         "model": args.model,
         "flash": bool(args.flash),
         "batch": args.batch,
+        "seq": args.seq if args.model == "bert" else None,
         "backend": jax.default_backend(),
         "flops": cost.get("flops"),
         "bytes_accessed": cost.get("bytes accessed"),
         "hlo_ops": interesting,
         "total_hlo_ops": sum(hist.values()),
-    }))
+    })
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

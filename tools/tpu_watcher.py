@@ -1,0 +1,242 @@
+"""TPU-window watcher: catch a live axon-tunnel window and bank it.
+
+The tunnel to the real chip has hung through entire rounds (VERDICT r2-r4:
+every driver bench attempt `[killed]` at its hard timeout), while
+interactive windows do open occasionally (round 3 measured 1384 img/s in
+one). This daemon makes sure no window is ever missed again:
+
+  loop:
+    probe the TPU in a CHILD process with a hard wall-clock kill
+    (the tunnel HANGS rather than erroring — memory/axon-tpu-tunnel-
+    flakiness — so an in-process timeout can never fire);
+    if dead  -> sleep and re-probe;
+    if alive -> run the measurement playbook, cheapest-first, each step
+                its own hard-timeout child:
+                  1. bench.py ladder (banks resnet b64->256->1024 + remat
+                     and bert seq128 -> seq384 -> flash into
+                     BENCH_BANK.json with git_sha + timestamp)
+                  2. bench_bert.py seq-384 flash probe
+                  3. hlo_scan cost census (PERF.md MFU inputs)
+                commit the bank + MEASURED_r05/ after every step that
+                changed something — a window can die mid-playbook and we
+                keep what was banked.
+
+Exits 0 once every goal is banked (so a supervising session is notified),
+or at the lifetime deadline. Run:  python tools/tpu_watcher.py &
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, os.environ.get("WATCH_OUT", "MEASURED_r05"))
+LOG = os.path.join(OUT, "watcher.log")
+PROBE_INTERVAL = float(os.environ.get("WATCH_PROBE_INTERVAL", "300"))
+PROBE_TIMEOUT = float(os.environ.get("WATCH_PROBE_TIMEOUT", "120"))
+LIFETIME_H = float(os.environ.get("WATCH_HOURS", "11"))
+
+PROBE_SRC = r"""
+import jax, jax.numpy as jnp
+devs = [d for d in jax.devices() if d.platform != "cpu"]
+assert devs, "no accelerator device"
+x = jax.device_put(jnp.ones((256, 256), jnp.bfloat16), devs[0])
+jax.jit(lambda a: (a @ a).sum())(x).block_until_ready()
+print("PROBE_OK", devs[0].platform, flush=True)
+"""
+
+
+def log(msg):
+    line = "%s %s" % (time.strftime("%H:%M:%S", time.gmtime()), msg)
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def run_killable(cmd, timeout, env=None, log_name=None):
+    """Run cmd in its own process group; SIGKILL the whole group on
+    timeout (a hung tunnel call cannot be interrupted any other way).
+    Returns (rc, tail_of_output)."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    logf = open(os.path.join(OUT, log_name), "ab") if log_name else None
+    try:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=logf or subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+            cwd=ROOT,
+            env=full_env,
+            start_new_session=True,
+        )
+        try:
+            proc.wait(timeout=timeout)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            rc = -9
+    finally:
+        if logf:
+            logf.close()
+    tail = ""
+    if log_name:
+        try:
+            with open(os.path.join(OUT, log_name), "rb") as f:
+                f.seek(max(0, os.path.getsize(os.path.join(OUT, log_name)) - 2000))
+                tail = f.read().decode("utf-8", "replace")
+        except OSError:
+            pass
+    return rc, tail
+
+
+def probe():
+    rc, _ = run_killable(
+        [sys.executable, "-c", PROBE_SRC], PROBE_TIMEOUT, log_name="probe.log"
+    )
+    return rc == 0
+
+
+import bench  # the bank module (repo root); honors BENCH_BANK_PATH
+
+HLO_GOALS = ("hlo_resnet", "hlo_bert", "hlo_bert_flash")
+
+
+def goals_state():
+    bank = bench.load_bank()
+    return {
+        "resnet": any(k.startswith("resnet50") for k in bank),
+        "resnet_big": any(
+            k.startswith("resnet50") and bank[k].get("batch", 0) >= 256 for k in bank
+        ),
+        "bert384": "bert_seq384" in bank,
+        "bert384_flash": "bert_seq384_flash" in bank,
+        "hlo": all(
+            os.path.exists(os.path.join(OUT, n + ".json")) for n in HLO_GOALS
+        ),
+    }
+
+
+def commit_if_changed(msg):
+    """Commit the bank + measured dir; retry briefly on index.lock races
+    with a concurrently working session."""
+    paths = [os.path.relpath(OUT, ROOT)]
+    bank_rel = os.path.relpath(bench.BANK_PATH, ROOT)
+    if not bank_rel.startswith(".."):  # only committable when inside the repo
+        paths.insert(0, bank_rel)
+    existing = [p for p in paths if os.path.exists(os.path.join(ROOT, p))]
+    if not existing:
+        return
+    for attempt in range(5):
+        st = subprocess.run(
+            ["git", "status", "--porcelain", "--"] + existing,
+            capture_output=True, text=True, cwd=ROOT,
+        )
+        if not st.stdout.strip():
+            return  # nothing new
+        add = subprocess.run(["git", "add", "--"] + existing, cwd=ROOT,
+                             capture_output=True, text=True)
+        com = subprocess.run(
+            ["git", "commit", "-m", msg, "--"] + existing,
+            cwd=ROOT, capture_output=True, text=True,
+        )
+        if com.returncode == 0:
+            log("committed: %s" % msg)
+            return
+        if "index.lock" in (add.stderr + com.stderr + com.stdout):
+            time.sleep(3 + attempt * 3)
+            continue
+        log("commit failed: %s" % (com.stderr or com.stdout)[:200])
+        return
+
+
+def playbook():
+    """One live-window measurement pass; returns True if all goals met."""
+    g0 = goals_state()
+    log("window open; goals before: %s" % g0)
+
+    # 1. the full bench ladder — banks everything it measures
+    rc, tail = run_killable(
+        [sys.executable, "bench.py"],
+        1550,
+        env={"BENCH_TIMEOUT": "1500"},
+        log_name="bench_ladder.log",
+    )
+    log("bench ladder rc=%s" % rc)
+    commit_if_changed("bank TPU measurements from live window (bench ladder)")
+
+    # 2. flash probe at seq 384 if the ladder didn't get to it
+    if goals_state()["bert384"] and not goals_state()["bert384_flash"]:
+        rc, _ = run_killable(
+            [sys.executable, "bench_bert.py"],
+            600,
+            env={"BENCH_BERT_SEQ": "384", "BENCH_FLASH": "1",
+                 "BENCH_BUDGET_S": "550"},
+            log_name="bench_bert_flash.log",
+        )
+        log("bert flash probe rc=%s" % rc)
+        commit_if_changed("bank TPU flash-attention measurement from live window")
+
+    # 3. HLO cost census for the PERF.md MFU numbers
+    hlo_args = {
+        "hlo_resnet": ["--model", "resnet", "--batch", "256"],
+        "hlo_bert": ["--model", "bert", "--batch", "24", "--seq", "384"],
+        "hlo_bert_flash":
+            ["--model", "bert", "--batch", "24", "--seq", "384", "--flash", "1"],
+    }
+    for name in HLO_GOALS:
+        args = hlo_args[name]
+        dst = os.path.join(OUT, name + ".json")
+        if os.path.exists(dst):
+            continue
+        rc, _ = run_killable(
+            [sys.executable, "tools/hlo_scan.py"] + args + ["--out", dst],
+            700,
+            log_name="hlo_scan.log",
+        )
+        log("hlo_scan %s rc=%s" % (name, rc))
+    commit_if_changed("record TPU HLO cost census from live window")
+
+    g1 = goals_state()
+    log("goals after playbook: %s" % g1)
+    return all(g1.values())
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    deadline = time.time() + LIFETIME_H * 3600
+    log("watcher start; lifetime %.1fh, probe every %.0fs" % (LIFETIME_H, PROBE_INTERVAL))
+    if all(goals_state().values()):
+        log("all goals already banked; exiting")
+        return 0
+    n = 0
+    while time.time() < deadline:
+        n += 1
+        if probe():
+            log("probe #%d: TPU ALIVE" % n)
+            if playbook():
+                log("all goals banked; watcher done")
+                return 0
+            # partial window — re-probe soon in case it is still open
+            time.sleep(60)
+        else:
+            if n % 6 == 1:
+                log("probe #%d: tunnel dead (and %d more silent probes)" % (n, 5))
+            time.sleep(max(0.0, min(PROBE_INTERVAL, deadline - time.time())))
+    log("lifetime deadline reached; exiting with goals: %s" % goals_state())
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
