@@ -43,6 +43,11 @@ gray_list = {
     # body (the CUDA-era reference black-listed BN because fp16 lacks
     # the exponent range; bf16 does not)
     "batch_norm",
+    # follows its Q/K/V dtype (the Pallas kernel accumulates fp32
+    # internally); without this the rewrite would leave a stale fp32
+    # desc on a bf16 runtime value, skipping a protective cast at the
+    # next black-list consumer
+    "flash_attention",
     "elementwise_add",
     "elementwise_sub",
     "elementwise_mul",

@@ -1345,22 +1345,28 @@ def _seq_one_in(op_type, x, attrs=None, out_slot="Out", extra_inputs=None,
     return out
 
 
-def flash_attention(q, k, v, key_bias=None, causal=False, scale=0.0,
-                    name=None):
+def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
+                    scale=0.0, interpret=False, name=None):
     """Fused online-softmax attention over [N, heads, S, d_head] tensors
-    (Pallas kernel on TPU, jnp reference elsewhere; reference analog: the
+    (Pallas kernel on TPU — forward and backward, no [S, S] tensor ever
+    reaches HBM; jnp reference elsewhere; reference analog: the
     fused_multihead_matmul CUDA op). ``key_bias``: optional [N, S]
-    additive key mask; ``scale`` 0 means 1/sqrt(d_head)."""
+    additive key mask; ``bias``: optional general additive bias
+    broadcastable to [N, heads, S, S] (relative-position / ALiBi);
+    ``scale`` 0 means 1/sqrt(d_head)."""
     helper = LayerHelper("flash_attention", **locals())
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if key_bias is not None:
         inputs["KeyBias"] = [key_bias]
+    if bias is not None:
+        inputs["Bias"] = [bias]
     helper.append_op(
         type="flash_attention",
         inputs=inputs,
         outputs={"Out": [out]},
-        attrs={"causal": causal, "scale": float(scale)},
+        attrs={"causal": causal, "scale": float(scale),
+               "interpret": bool(interpret)},
     )
     return out
 

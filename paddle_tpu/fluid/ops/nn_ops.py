@@ -843,14 +843,21 @@ def _flash_attention(ctx, op_):
     v = ctx.in1(op_, "V")
     kb_names = op_.inputs.get("KeyBias") or []
     key_bias = ctx.in1(op_, "KeyBias") if kb_names else None
+    bias_names = op_.inputs.get("Bias") or []
+    bias = ctx.in1(op_, "Bias") if bias_names else None
     scale = op_.attr("scale", 0.0)
+    # interpret=True forces the Pallas kernels off-TPU (tests/FD sweep);
+    # default (None) runs kernels on TPU, dense reference elsewhere
+    interpret = bool(op_.attr("interpret", False)) or None
     ctx.out(
         op_,
         "Out",
         _fa(
             q, k, v,
             key_bias=key_bias,
+            bias=bias,
             causal=bool(op_.attr("causal", False)),
             scale=float(scale) if scale else None,
+            interpret=interpret,
         ),
     )

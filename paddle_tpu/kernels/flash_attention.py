@@ -1,26 +1,47 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as a Pallas TPU kernel — forward AND backward.
 
 The hot op of every transformer (reference target: the CUDA
 `multihead_matmul` fused kernel, fused_multihead_matmul_op.cu, built for
 exactly this BERT attention pattern). A naive attention materializes the
-[S, S] score matrix in HBM twice (write after QK^T, read for @V) — at
-seq 512+ that dwarfs the useful traffic. This kernel keeps the whole
-softmax(QK^T/sqrt(d) + bias)V pipeline in VMEM with the online-softmax
-recurrence, writing only the [S, D] output per head:
+[S, S] score matrix in HBM twice per direction — at seq 384+ that dwarfs
+the useful traffic. These kernels keep the whole
+softmax(QK^T·scale + bias)V pipeline in VMEM in both directions:
 
+forward (online softmax, per (head, q-block) program):
   for each K/V block:  m' = max(m, rowmax(s))
-                       acc = acc * e^(m-m') + e^(s-m') @ v_block
-                       l   = l * e^(m-m') + rowsum(e^(s-m'))
+                       acc = acc·e^(m-m') + e^(s-m') @ v_blk
+                       l   = l·e^(m-m') + rowsum(e^(s-m'))
+  o = acc / l;  lse = m + log(l)          (lse saved for the backward)
+
+backward (two kernels, scores recomputed blockwise from q,k + lse — the
+standard FlashAttention backward):
+  delta = rowsum(dO ∘ O)                  (== rowsum(dP ∘ P), so the
+                                           softmax jacobian needs no [S,S])
+  p  = e^(s − lse)
+  dq-kernel  (per q-block, sweep kv):  ds = p ∘ (dO V^T − delta)
+                                       dq += ds @ K · scale
+  dkv-kernel (per kv-block, sweep q):  dv += p^T @ dO
+                                       dk += ds^T @ (q·scale)
+                                       d(bias) accumulated blockwise
 
 Layout [B, N, S, D] (batch, heads, seq, head_dim); fp32 accumulation
-regardless of input dtype (MXU `preferred_element_type`).
+regardless of input dtype (MXU ``preferred_element_type``).
 
-Backward: jax.custom_vjp recomputes through the pure-jnp reference —
-activation-light (no S×S residual is saved), numerically identical to
-differentiating the reference, and XLA already fuses the backward matmul
-chain well; the forward is where the hand-scheduling pays.
+Bias comes in two flavors, usable together:
+- ``key_bias`` [B*N, Sk]: additive per KEY (BERT padding masks) —
+  broadcast over query rows inside the kernel; gradient accumulated to
+  the same [B*N, Sk] shape in the dkv kernel.
+- ``bias``: a general additive tensor broadcastable to [B, N, Sq, Sk]
+  (relative-position tables, ALiBi slopes). Normalized to [G, Sq, Sk]
+  with G ∈ {1, B, B·N}; flat head h reads row h // (B·N // G), so heads
+  sharing a row are CONSECUTIVE, and the dkv grid is transposed (kv-block
+  axis outermost, head axis innermost) so its gradient block is revisited
+  by consecutive programs — the TPU grid is a sequential loop, which
+  makes blockwise accumulation across programs well-defined. A per-head
+  bias shared across the batch ([1, N, Sq, Sk]) is handled by running
+  the whole attention head-major (role swap B↔N in ``flash_attention``).
 
-The kernel runs on the TPU backend (or anywhere under ``interpret=True``
+The kernels run on the TPU backend (or anywhere under ``interpret=True``
 for tests); ``flash_attention`` transparently falls back to the jnp
 reference on other backends so models stay portable.
 """
@@ -53,9 +74,38 @@ def reference_attention(q, k, v, bias=None, causal=False, scale=None):
     return jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
 
 
-def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, causal,
-            kv_len, block_q, block_k):
-    """One (head, q-block) program: online softmax over k blocks."""
+def _scores(q_scaled, kblk, key_bias_vec, bias_blk, row_off, col_off,
+            causal, block_q, block_k):
+    """[BQ, BK] masked scores (q_scaled already carries the softmax
+    scale). Shared by all three kernels so forward and backward can never
+    disagree on masking."""
+    s = jax.lax.dot_general(
+        q_scaled, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = s + key_bias_vec[None, :]
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
+    if causal:
+        row = row_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        col = col_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(col <= row, s, _NEG)
+    return s
+
+
+# --------------------------------------------------------------------------
+# kernels
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, o_ref, lse_ref,
+                *, scale, causal, kv_len, block_q, block_k):
+    """One (head, q-block) program: online softmax over kv blocks; also
+    writes the per-row logsumexp residual for the backward."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
@@ -66,140 +116,427 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale, causal,
     l = jnp.zeros((block_q, 1), jnp.float32)
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
-    row = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
     for kb in range(n_kb):
-        kblk = k_ref[0, kb * block_k:(kb + 1) * block_k, :].astype(jnp.float32)
-        vblk = v_ref[0, kb * block_k:(kb + 1) * block_k, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [BQ, BK]
-        s = s + bias_ref[0, kb * block_k:(kb + 1) * block_k][None, :]
-        if causal:
-            col = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(col <= row, s, _NEG)
+        ks = slice(kb * block_k, (kb + 1) * block_k)
+        s = _scores(
+            q, k_ref[0, ks, :].astype(jnp.float32), key_bias_ref[0, ks],
+            None if bias_ref is None else bias_ref[0, :, ks],
+            qi * block_q, kb * block_k, causal, block_q, block_k,
+        )
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l = l * alpha + p.sum(axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p, v_ref[0, ks, :].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m = m_new
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _pallas_forward(q, k, v, key_bias, causal, scale, interpret):
-    """q [BN, Sq, D], k/v [BN, Sk, D] (both block-multiples), key_bias
-    [BN, Sk] additive."""
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, *, scale, causal, kv_len,
+                   block_q, block_k):
+    """One (head, q-block) program: dq = Σ_kv (p∘(dO V^T − delta)) K·scale."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    BN, Sq, D = q.shape
-    Sk = k.shape[1]
-    bq = min(BLOCK_Q, Sq)
-    bk = min(BLOCK_K, Sk)
-    assert Sq % bq == 0 and Sk % bk == 0, (Sq, bq, Sk, bk)
-    grid = (BN, Sq // bq)
-    kernel = functools.partial(
-        _kernel, scale=scale, causal=causal, kv_len=Sk,
-        block_q=bq, block_k=bk,
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)          # [BQ, D]
+    lse = lse_ref[0][:, None]                   # [BQ, 1]
+    delta = delta_ref[0][:, None]               # [BQ, 1]
+    qi = pl.program_id(1)
+    n_kb = kv_len // block_k
+
+    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    for kb in range(n_kb):
+        ks = slice(kb * block_k, (kb + 1) * block_k)
+        kblk = k_ref[0, ks, :].astype(jnp.float32)
+        s = _scores(
+            q, kblk, key_bias_ref[0, ks],
+            None if bias_ref is None else bias_ref[0, :, ks],
+            qi * block_q, kb * block_k, causal, block_q, block_k,
+        )
+        p = jnp.exp(s - lse)                    # [BQ, BK]
+        dp = jax.lax.dot_general(               # dO @ V^T
+            do, v_ref[0, ks, :].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq = dq + jax.lax.dot_general(          # ds @ K
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dkb_ref, dbias_ref,
+                    *, scale, causal, q_len, block_q, block_k, bias_group):
+    """One (kv-block, head) program — TRANSPOSED grid: kv axis outermost,
+    head axis innermost, so the shared-bias gradient block is revisited by
+    consecutive programs (safe sequential accumulation on TPU)."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(0)       # kv-block index
+    h = pl.program_id(1)        # flat head index
+    k = k_ref[0].astype(jnp.float32)            # [BK, D]
+    v = v_ref[0].astype(jnp.float32)            # [BK, D]
+    key_bias_vec = key_bias_ref[0]              # [BK]
+    n_qb = q_len // block_q
+
+    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dkb = jnp.zeros((block_k,), jnp.float32)
+    dbias = (
+        None if dbias_ref is None
+        else jnp.zeros((q_len, block_k), jnp.float32)
     )
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((BN, Sq, D), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Sk, D), lambda h, i: (h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Sk, D), lambda h, i: (h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Sk), lambda h, i: (h, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
-                               memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(q, k, v, key_bias)
+
+    for ib in range(n_qb):
+        qs = slice(ib * block_q, (ib + 1) * block_q)
+        q = q_ref[0, qs, :].astype(jnp.float32) * scale
+        do = do_ref[0, qs, :].astype(jnp.float32)
+        lse = lse_ref[0, qs][:, None]
+        delta = delta_ref[0, qs][:, None]
+        s = _scores(
+            q, k, key_bias_vec,
+            None if bias_ref is None else bias_ref[0, qs, :],
+            ib * block_q, kb * block_k, causal, block_q, block_k,
+        )
+        p = jnp.exp(s - lse)                    # [BQ, BK]
+        dv = dv + jax.lax.dot_general(          # p^T @ dO
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(               # dO @ V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(          # ds^T @ (q·scale)
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dkb = dkb + ds.sum(axis=0)
+        if dbias is not None:
+            dbias = jax.lax.dynamic_update_slice(dbias, ds, (ib * block_q, 0))
+
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dkb_ref[0] = dkb
+    if dbias_ref is not None:
+        # heads h with equal h // bias_group share one gradient row;
+        # they are consecutive on the (innermost) head axis
+        @pl.when(h % bias_group == 0)
+        def _init():
+            dbias_ref[0] = dbias
+
+        @pl.when(h % bias_group != 0)
+        def _accumulate():
+            dbias_ref[0] += dbias
+
+
+# --------------------------------------------------------------------------
+# padding / plumbing
+# --------------------------------------------------------------------------
 
 
 def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, key_bias, causal, scale, interpret):
-    return _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret)
+def _pad_to(S, block):
+    Sp = _round_up(S, 8)
+    return _round_up(Sp, min(block, Sp))
 
 
-def _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret):
+def _prep(q, k, v, key_bias, bias, g=None):
+    """Flatten heads, pad seq lens to tile multiples. Padded KEYS get
+    key-bias −inf (never receive weight); padded QUERY rows are sliced
+    away by the caller. Returns the padded operands + geometry."""
     B, N, Sq, D = q.shape
     Sk = k.shape[2]
-
-    def pad_to(S, block):
-        Sp = _round_up(S, 8)
-        return _round_up(Sp, min(block, Sp))
-
-    # queries pad to the q-tile, keys to the K-TILE — n_kb = Skp // bk in
-    # the kernel truncates silently if this invariant ever breaks
-    Sqp, Skp = pad_to(Sq, BLOCK_Q), pad_to(Sk, BLOCK_K)
+    Sqp, Skp = _pad_to(Sq, BLOCK_Q), _pad_to(Sk, BLOCK_K)
+    bq, bk = min(BLOCK_Q, Sqp), min(BLOCK_K, Skp)
     qf = q.reshape(B * N, Sq, D)
     kf = k.reshape(B * N, Sk, D)
     vf = v.reshape(B * N, Sk, D)
-    bias = jnp.broadcast_to(key_bias, (B * N, Sk))
+    kb = jnp.broadcast_to(key_bias, (B * N, Sk))
     if Sqp != Sq:
-        # padded QUERY rows are sliced away below (their uniform/empty
-        # softmax is harmless)
         qf = jnp.pad(qf, ((0, 0), (0, Sqp - Sq), (0, 0)))
     if Skp != Sk:
-        # padded KEYS must never receive weight
         kf = jnp.pad(kf, ((0, 0), (0, Skp - Sk), (0, 0)))
         vf = jnp.pad(vf, ((0, 0), (0, Skp - Sk), (0, 0)))
-        bias = jnp.pad(bias, ((0, 0), (0, Skp - Sk)), constant_values=_NEG)
-    out = _pallas_forward(qf, kf, vf, bias, causal, scale, interpret)
-    return out[:, :Sq, :].reshape(B, N, Sq, D)
+        kb = jnp.pad(kb, ((0, 0), (0, Skp - Sk)), constant_values=_NEG)
+    bf = None
+    if bias is not None:
+        bf = bias
+        if Sqp != Sq or Skp != Sk:
+            # zero-padded: padded keys are already excluded via key-bias
+            bf = jnp.pad(bf, ((0, 0), (0, Sqp - Sq), (0, Skp - Sk)))
+    if g is not None and Sqp != Sq:
+        g = jnp.pad(g.reshape(B * N, Sq, D), ((0, 0), (0, Sqp - Sq), (0, 0)))
+    elif g is not None:
+        g = g.reshape(B * N, Sq, D)
+    return qf, kf, vf, kb, bf, g, (B, N, Sq, Sk, Sqp, Skp, bq, bk)
 
 
-def _flash_fwd(q, k, v, key_bias, causal, scale, interpret):
-    return _flash_fwd_impl(q, k, v, key_bias, causal, scale, interpret), (
-        q, k, v, key_bias,
+def _common_in_specs(pl, pltpu, geom, G, D):
+    """in_specs for (q, k, v, key_bias[, bias]) shared by the two
+    (head, q-block)-grid kernels (forward and dq)."""
+    B, N, Sq, Sk, Sqp, Skp, bq, bk = geom
+    specs = [
+        pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Skp, D), lambda h, i: (h, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Skp, D), lambda h, i: (h, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Skp), lambda h, i: (h, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    if G is not None:
+        group = (B * N) // G
+        specs.append(
+            pl.BlockSpec((1, bq, Skp), lambda h, i: (h // group, i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+    return specs
+
+
+# --------------------------------------------------------------------------
+# custom-vjp core
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, key_bias, bias, causal, scale, interpret):
+    out, _lse = _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
+                                interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qf, kf, vf, kb, bf, _, geom = _prep(q, k, v, key_bias, bias)
+    B, N, Sq, Sk, Sqp, Skp, bq, bk = geom
+    D = q.shape[-1]
+    G = None if bf is None else bf.shape[0]
+
+    kernel = functools.partial(
+        _fwd_kernel if bf is not None else _no_bias(_fwd_kernel),
+        scale=scale, causal=causal, kv_len=Skp, block_q=bq, block_k=bk,
     )
+    in_specs = _common_in_specs(pl, pltpu, geom, G, D)
+    operands = [qf, kf, vf, kb] + ([bf] if bf is not None else [])
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, Sqp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * N, Sqp), jnp.float32),
+        ],
+        grid=(B * N, Sqp // bq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda h, i: (h, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        interpret=interpret,
+    )(*operands)
+    return out[:, :Sq, :].reshape(B, N, Sq, D), lse[:, :Sq]
+
+
+def _no_bias(kernel):
+    """Adapter: drop the bias ref from a kernel's signature (Pallas passes
+    exactly one ref per operand, so the no-bias variant has one fewer)."""
+    @functools.wraps(kernel)
+    def wrapped(q_ref, k_ref, v_ref, key_bias_ref, *rest, **kw):
+        return kernel(q_ref, k_ref, v_ref, key_bias_ref, None, *rest, **kw)
+    return wrapped
+
+
+def _flash_fwd(q, k, v, key_bias, bias, causal, scale, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
+                               interpret)
+    return out, (q, k, v, key_bias, bias, out, lse)
 
 
 def _flash_bwd(causal, scale, interpret, res, g):
-    q, k, v, key_bias = res
-    B, N = q.shape[:2]
-    Sk = k.shape[2]
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    def ref(q, k, v, key_bias):
-        return reference_attention(
-            q, k, v, bias=key_bias.reshape(B, N, 1, Sk),
-            causal=causal, scale=scale,
+    q, k, v, key_bias, bias, out, lse = res
+    qf, kf, vf, kb, bf, gf, geom = _prep(q, k, v, key_bias, bias, g=g)
+    B, N, Sq, Sk, Sqp, Skp, bq, bk = geom
+    D = q.shape[-1]
+    G = None if bf is None else bf.shape[0]
+
+    # delta = rowsum(dO ∘ O): tiny elementwise pass XLA fuses on its own
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    delta = delta.reshape(B * N, Sq)
+    if Sqp != Sq:
+        delta = jnp.pad(delta, ((0, 0), (0, Sqp - Sq)))
+        lse_p = jnp.pad(lse, ((0, 0), (0, Sqp - Sq)))
+    else:
+        lse_p = lse
+
+    # ---- dq: same (head, q-block) grid as the forward ----
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel if bf is not None else _no_bias(_bwd_dq_kernel),
+        scale=scale, causal=causal, kv_len=Skp, block_q=bq, block_k=bk,
+    )
+    row_spec = pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, bq), lambda h, i: (h, i),
+                            memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((B * N, Sqp, D), q.dtype),
+        grid=(B * N, Sqp // bq),
+        in_specs=_common_in_specs(pl, pltpu, geom, G, D)
+        + [row_spec, vec_spec, vec_spec],
+        out_specs=row_spec,
+        interpret=interpret,
+    )(*([qf, kf, vf, kb] + ([bf] if bf is not None else []) + [gf, lse_p, delta]))
+
+    # ---- dk/dv/dkey_bias/dbias: transposed (kv-block, head) grid ----
+    group = None if G is None else (B * N) // G
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel if bf is not None else _no_bias(_bwd_dkv_kernel),
+        scale=scale, causal=causal, q_len=Sqp, block_q=bq, block_k=bk,
+        bias_group=group or 1,
+    )
+    if bf is None:
+        # adapter also has to drop the dbias OUT ref
+        base = dkv_kernel
+
+        def dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, dkb_ref):
+            return base(q_ref, k_ref, v_ref, key_bias_ref, do_ref, lse_ref,
+                        delta_ref, dk_ref, dv_ref, dkb_ref, None)
+
+    in_specs = [
+        pl.BlockSpec((1, Sqp, D), lambda j, h: (h, 0, 0),
+                     memory_space=pltpu.VMEM),       # q (full rows)
+        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+                     memory_space=pltpu.VMEM),       # k block
+        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+                     memory_space=pltpu.VMEM),       # v block
+        pl.BlockSpec((1, bk), lambda j, h: (h, j),
+                     memory_space=pltpu.VMEM),       # key bias block
+    ]
+    if bf is not None:
+        in_specs.append(
+            pl.BlockSpec((1, Sqp, bk), lambda j, h: (h // group, 0, j),
+                         memory_space=pltpu.VMEM)    # bias column block
         )
+    in_specs += [
+        pl.BlockSpec((1, Sqp, D), lambda j, h: (h, 0, 0),
+                     memory_space=pltpu.VMEM),       # dO (full rows)
+        pl.BlockSpec((1, Sqp), lambda j, h: (h, 0),
+                     memory_space=pltpu.VMEM),       # lse
+        pl.BlockSpec((1, Sqp), lambda j, h: (h, 0),
+                     memory_space=pltpu.VMEM),       # delta
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B * N, Skp, D), k.dtype),      # dk
+        jax.ShapeDtypeStruct((B * N, Skp, D), v.dtype),      # dv
+        jax.ShapeDtypeStruct((B * N, Skp), jnp.float32),     # dkey_bias
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk), lambda j, h: (h, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    if bf is not None:
+        out_shape.append(jax.ShapeDtypeStruct((G, Sqp, Skp), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, Sqp, bk), lambda j, h: (h // group, 0, j),
+                         memory_space=pltpu.VMEM)
+        )
+    outs = pl.pallas_call(
+        dkv_kernel,
+        out_shape=out_shape,
+        grid=(Skp // bk, B * N),   # kv OUTERMOST: consecutive head revisits
+        in_specs=in_specs,
+        out_specs=out_specs,
+        interpret=interpret,
+    )(*([qf, kf, vf, kb] + ([bf] if bf is not None else []) + [gf, lse_p, delta]))
+    if bf is not None:
+        dkf, dvf, dkb, dbias = outs
+        dbias = dbias[:, :Sq, :Sk]
+    else:
+        dkf, dvf, dkb = outs
+        dbias = None
 
-    _, vjp = jax.vjp(ref, q, k, v, key_bias)
-    dq, dk, dv, dbias = vjp(g)
-    return dq, dk, dv, dbias
+    dq = dq[:, :Sq, :].reshape(q.shape)
+    dk = dkf[:, :Sk, :].reshape(k.shape)
+    dv = dvf[:, :Sk, :].reshape(v.shape)
+    dkey_bias = dkb[:, :Sk].astype(key_bias.dtype)
+    return dq, dk, dv, dkey_bias, dbias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, key_bias=None, causal=False, scale=None,
-                    interpret=None):
+# --------------------------------------------------------------------------
+# public entry
+# --------------------------------------------------------------------------
+
+
+def _normalize_bias(bias, B, N, Sq, Sk):
+    """-> (bias [G, Sq, Sk] with G ∈ {1, B, B·N}, head_major_swap)."""
+    b = jnp.asarray(bias, jnp.float32)
+    if b.ndim == 2:
+        return b[None], False
+    if b.ndim == 3:
+        if b.shape[0] in (1, B * N) or (b.shape[0] == B and N == 1):
+            return b, False
+        raise ValueError(
+            "3-D flash-attention bias must have leading dim 1 or B*N, got %r"
+            % (b.shape,)
+        )
+    if b.ndim == 4:
+        b0, b1 = b.shape[:2]
+        if (b0, b1) == (1, 1):
+            return b.reshape(1, Sq, Sk), False
+        if b1 == 1 and b0 == B:
+            return b.reshape(B, Sq, Sk), False          # per-batch rows
+        if b0 == 1 and b1 == N:
+            # per-head shared across batch: run attention head-major so
+            # heads sharing a bias row stay consecutive (role swap B<->N)
+            return b.reshape(N, Sq, Sk), True
+        if (b0, b1) == (B, N):
+            return b.reshape(B * N, Sq, Sk), False
+        raise ValueError(
+            "4-D flash-attention bias must broadcast from (1|B, 1|N, Sq, Sk),"
+            " got %r" % (b.shape,)
+        )
+    raise ValueError("flash-attention bias must be 2-/3-/4-D, got %r"
+                     % (b.shape,))
+
+
+def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
+                    scale=None, interpret=None):
     """Fused attention, [B, N, S, D] -> [B, N, S, D].
 
     ``key_bias``: optional additive mask over KEYS, shape [B*N, S] or
     broadcastable — BERT-style padding masks ((mask-1)*1e4 per key).
+    ``bias``: optional general additive bias broadcastable to
+    [B, N, Sq, Sk] (relative-position / ALiBi). Both may be given.
     ``interpret``: force the Pallas interpreter (tests); default runs the
-    kernel on TPU and the jnp reference elsewhere.
+    kernels on TPU and the jnp reference elsewhere. Forward AND backward
+    are Pallas kernels — no [S, S] tensor ever reaches HBM.
     """
     B, N, Sq, d = q.shape
     Sk = k.shape[2]  # key length (cross attention: != query length)
@@ -220,11 +557,35 @@ def flash_attention(q, k, v, key_bias=None, causal=False, scale=None,
         kb = jnp.broadcast_to(kb, (B * N, Sk))
     on_tpu = jax.default_backend() == "tpu"
     if interpret is None and not on_tpu:
-        return reference_attention(
-            q, k, v,
-            bias=None if kb is None else kb.reshape(B, N, 1, Sk),
-            causal=causal, scale=scale,
-        )
+        full = None
+        if bias is not None:
+            nb, swap = _normalize_bias(bias, B, N, Sq, Sk)
+            G = nb.shape[0]
+            if swap:                      # [N, Sq, Sk]: per-head rows
+                full = nb.reshape(1, N, Sq, Sk)
+            elif G == 1:
+                full = nb.reshape(1, 1, Sq, Sk)
+            elif G == B * N:
+                full = nb.reshape(B, N, Sq, Sk)
+            else:                         # G == B: per-batch rows
+                full = nb.reshape(B, 1, Sq, Sk)
+        if kb is not None:
+            keyb = kb.reshape(B, N, 1, Sk)
+            full = keyb if full is None else full + keyb
+        return reference_attention(q, k, v, bias=full, causal=causal,
+                                   scale=scale)
     if kb is None:
         kb = jnp.zeros((B * N, Sk), jnp.float32)
-    return _flash(q, k, v, kb, causal, scale, bool(interpret))
+    bf, swap = (None, False) if bias is None else _normalize_bias(
+        bias, B, N, Sq, Sk
+    )
+    if swap:
+        # head-major role swap: [B,N,S,D] -> [N,B,S,D]; key bias rows
+        # b*N+n -> n*B+b; outer jax autodiff un-swaps the gradients
+        qT = q.transpose(1, 0, 2, 3)
+        kT = k.transpose(1, 0, 2, 3)
+        vT = v.transpose(1, 0, 2, 3)
+        kbT = kb.reshape(B, N, Sk).transpose(1, 0, 2).reshape(N * B, Sk)
+        out = _flash(qT, kT, vT, kbT, bf, causal, scale, bool(interpret))
+        return out.transpose(1, 0, 2, 3)
+    return _flash(q, k, v, kb, bf, causal, scale, bool(interpret))

@@ -110,11 +110,27 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
         and key_bias is not None
         and (cfg.attention_dropout <= 0.0 or cfg.is_test)
     )
+    if (getattr(cfg, "use_flash_attention", False) and not use_flash
+            and not getattr(cfg, "_warned_flash_fallback", False)):
+        import warnings
+
+        reason = (
+            "no key_bias/input_mask was built" if key_bias is None else
+            "training with attention_dropout=%g (the fused kernel has no "
+            "in-kernel dropout; set attention_dropout=0 to train through "
+            "it)" % cfg.attention_dropout
+        )
+        warnings.warn(
+            "use_flash_attention=True but %s: falling back to dense "
+            "attention" % reason, stacklevel=2)
+        cfg._warned_flash_fallback = True  # once per config, not per layer
     if use_flash:
         # ``causal`` rides the kernel flag instead of a dense [T, T] bias
         ctxt = fluid.layers.flash_attention(
             q, k, v, key_bias=key_bias, causal=causal,
             scale=1.0 / math.sqrt(d_head),
+            # tests force the Pallas kernels off-TPU via this cfg flag
+            interpret=getattr(cfg, "flash_interpret", False),
         )
     else:
         scores = fluid.layers.matmul(

@@ -245,7 +245,12 @@ class OpTest(unittest.TestCase):
         if isinstance(base, core.LoDTensor):
             lod = base.recursive_sequence_lengths()
             base = base.numpy()
-        x = np.array(base, dtype=np.float64)
+        # order="C" so flat = x.reshape(-1) below is guaranteed a VIEW —
+        # np.array(order='K') can return an F-ordered copy for F-ordered
+        # feeds, and perturbing a reshape COPY would silently leave the
+        # objective unperturbed (numeric grad degenerates to zeros)
+        x = np.array(base, dtype=np.float64, order="C")
+        assert x.flags["C_CONTIGUOUS"]
 
         def objective(arr):
             f = dict(feed)

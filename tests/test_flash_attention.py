@@ -249,3 +249,194 @@ def test_flash_causal_with_key_bias_and_odd_length():
     # causal cross-length must refuse loudly on every backend
     with pytest.raises(ValueError):
         flash_attention(q[:, :, :8], k, v, causal=True)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward (VERDICT r4 task 3): dq/dk/dv via the two-kernel
+# recompute backward, dbias via blockwise accumulation — gradient parity
+# against jax.grad through the dense reference for every bias mode.
+# ---------------------------------------------------------------------------
+
+
+def _grad_parity(flash_fn, ref_fn, args, rtol=2e-4, atol=2e-5):
+    gf = jax.grad(lambda *a: jnp.sum(flash_fn(*a) ** 2),
+                  argnums=tuple(range(len(args))))(*args)
+    gr = jax.grad(lambda *a: jnp.sum(ref_fn(*a) ** 2),
+                  argnums=tuple(range(len(args))))(*args)
+    for i, (a, b) in enumerate(zip(gf, gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol,
+                                   err_msg="grad argnum %d" % i)
+
+
+def test_flash_grad_key_bias():
+    """dkey_bias accumulates in the dkv kernel ([BK] colsum per block)."""
+    q, k, v = _inputs(S=48, seed=7)
+    B, N, S = q.shape[0], q.shape[1], q.shape[2]
+    rs = np.random.RandomState(8)
+    kb = jnp.asarray(rs.randn(B * N, S).astype("float32"))
+
+    _grad_parity(
+        lambda q, k, v, kb: flash_attention(q, k, v, key_bias=kb,
+                                            interpret=True),
+        lambda q, k, v, kb: reference_attention(
+            q, k, v, bias=kb.reshape(B, N, 1, S)),
+        (q, k, v, kb),
+    )
+
+
+@pytest.mark.parametrize("bias_shape", [
+    "2d",        # [S, S]            -> G=1 (accumulated across ALL heads)
+    "full",      # [B, N, S, S]      -> G=B*N (no cross-program accumulation)
+    "batch",     # [B, 1, S, S]      -> G=B (accumulated across heads of a batch)
+    "head",      # [1, N, S, S]      -> head-major role swap
+])
+def test_flash_grad_general_bias(bias_shape):
+    q, k, v = _inputs(B=2, N=3, S=32, D=8, seed=11)
+    B, N, S = q.shape[0], q.shape[1], q.shape[2]
+    rs = np.random.RandomState(12)
+    shape = {
+        "2d": (S, S),
+        "full": (B, N, S, S),
+        "batch": (B, 1, S, S),
+        "head": (1, N, S, S),
+    }[bias_shape]
+    bias = jnp.asarray(rs.randn(*shape).astype("float32") * 0.3)
+
+    _grad_parity(
+        lambda q, k, v, b: flash_attention(q, k, v, bias=b, interpret=True),
+        lambda q, k, v, b: reference_attention(
+            q, k, v, bias=jnp.broadcast_to(
+                b.reshape((1,) * (4 - b.ndim) + b.shape), (B, N, S, S))),
+        (q, k, v, bias),
+    )
+
+
+def test_flash_forward_general_bias_matches_reference():
+    q, k, v = _inputs(B=2, N=2, S=40, seed=13)
+    B, N, S = q.shape[0], q.shape[1], q.shape[2]
+    rs = np.random.RandomState(14)
+    bias = jnp.asarray(rs.randn(S, S).astype("float32"))
+    out = flash_attention(q, k, v, bias=bias, interpret=True)
+    ref = reference_attention(q, k, v, bias=bias[None, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_grad_causal_with_bias_and_key_bias():
+    """All masking paths at once + odd (padded) length: causal + general
+    bias + key padding mask, S not a block multiple."""
+    q, k, v = _inputs(B=1, N=2, S=37, D=8, seed=15)
+    B, N, S = q.shape[0], q.shape[1], q.shape[2]
+    rs = np.random.RandomState(16)
+    bias = jnp.asarray(rs.randn(S, S).astype("float32") * 0.2)
+    mask = (np.arange(S) < 30).astype("float32")   # last 7 keys padded
+    kb = jnp.asarray(np.tile((mask - 1.0) * 1e4, (B * N, 1)))
+
+    _grad_parity(
+        lambda q, k, v, b: flash_attention(q, k, v, key_bias=kb, bias=b,
+                                           causal=True, interpret=True),
+        lambda q, k, v, b: reference_attention(
+            q, k, v,
+            bias=kb.reshape(B, N, 1, S) + jnp.broadcast_to(
+                b[None, None], (B, N, S, S)),
+            causal=True),
+        (q, k, v, bias),
+    )
+
+
+def test_flash_grad_cross_attention():
+    """Sq != Sk, both padded to different block multiples."""
+    rs = np.random.RandomState(17)
+    B, N, Sq, Sk, D = 2, 2, 21, 50, 8
+    q = jnp.asarray(rs.randn(B, N, Sq, D).astype("float32") * 0.5)
+    k = jnp.asarray(rs.randn(B, N, Sk, D).astype("float32") * 0.5)
+    v = jnp.asarray(rs.randn(B, N, Sk, D).astype("float32") * 0.5)
+
+    _grad_parity(
+        lambda q, k, v: flash_attention(q, k, v, interpret=True),
+        lambda q, k, v: reference_attention(q, k, v),
+        (q, k, v),
+    )
+
+
+def test_flash_grad_bf16_runs():
+    """bf16 inputs: kernels accumulate fp32; loose parity vs the bf16
+    dense reference."""
+    q, k, v = _inputs(S=32, seed=18)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    _grad_parity(
+        lambda q, k, v: flash_attention(q, k, v, interpret=True),
+        lambda q, k, v: reference_attention(q, k, v),
+        (q, k, v), rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_flash_backward_never_materializes_scores():
+    """Structural: the jaxpr of the flash grad must contain no [S, S]
+    intermediate outside the Pallas calls (the whole point of task 3)."""
+    q, k, v = _inputs(B=1, N=1, S=256, D=16, seed=19)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    S = 256
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for var in eqn.outvars:
+            shape = getattr(var.aval, "shape", ())
+            assert not (len(shape) >= 2 and shape[-1] == S and
+                        shape[-2] == S), (
+                "non-Pallas [S,S] intermediate: %s -> %s" % (eqn.primitive,
+                                                             shape))
+
+
+def test_bert_trains_through_flash_kernel():
+    """End-to-end: a tiny BERT fine-tune step runs THROUGH the Pallas
+    kernels (interpret mode) — forward and the new two-kernel backward —
+    and the loss decreases (VERDICT r4 task 3 acceptance)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                               use_flash_attention=True)
+    cfg.flash_interpret = True
+    S, B = 24, 4
+    main, startup, feeds, loss, acc = bert.build_bert_classifier(
+        cfg, S, learning_rate=1e-3)
+    assert any(op.type == "flash_attention" for b in main.blocks
+               for op in b.ops), "kernel path not taken"
+    rs = np.random.RandomState(0)
+    feed = {
+        "src_ids": rs.randint(0, cfg.vocab_size, (B, S, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(S)[None, :, None], (B, 1, 1)).astype("int64"),
+        "sent_ids": np.zeros((B, S, 1), "int64"),
+        "input_mask": np.ones((B, S, 1), "float32"),
+        "label": rs.randint(0, 2, (B, 1)).astype("int64"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(4):
+        (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_flash_fallback_warning_on_dropout():
+    """ADVICE r4: use_flash_attention=True with training dropout warns
+    once instead of silently training dense."""
+    import warnings
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny(use_flash_attention=True)  # dropout 0.1
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        bert.build_bert_classifier(cfg, 16, learning_rate=1e-3)
+    msgs = [str(x.message) for x in w if "falling back to dense" in str(x.message)]
+    assert len(msgs) == 1, msgs  # once per config, not per layer

@@ -349,6 +349,15 @@ case("flash_attention",
              "V": U(182, (2, 2, 8, 4))},
      outputs={"Out": Z(2, 2, 8, 4)}, attrs={"causal": True, "scale": 0.5},
      tol=0.02)
+# same op THROUGH the Pallas kernels (interpret mode) incl. the general
+# [S, S] bias input — FD checks the two-kernel backward, not the fallback
+case("flash_attention_kernel", op_type="flash_attention",
+     inputs={"Q": U(183, (2, 2, 8, 4)), "K": U(184, (2, 2, 8, 4)),
+             "V": U(185, (2, 2, 8, 4)), "Bias": U(186, (8, 8)),
+             "KeyBias": U(187, (4, 8))},
+     outputs={"Out": Z(2, 2, 8, 4)},
+     attrs={"causal": True, "scale": 0.5, "interpret": True},
+     tol=0.02)
 
 # -- embeddings --------------------------------------------------------------
 case("lookup_table", inputs={"W": U(140, (10, 4)),
@@ -437,7 +446,9 @@ class _SweepCase(OpTest):
 
 def _run_case(op_type, spec):
     t = _SweepCase()
-    t.op_type = op_type
+    # a case key may alias a real op (same op under different attrs,
+    # e.g. flash_attention through the Pallas kernels vs the fallback)
+    t.op_type = spec.get("op_type", op_type)
     t.inputs = spec["inputs"]
     t.attrs = spec.get("attrs", {})
     t.outputs = spec["outputs"]

@@ -161,28 +161,41 @@ def commit_if_changed(msg):
         return
 
 
-def playbook():
-    """One live-window measurement pass; returns True if all goals met."""
+def playbook(deadline):
+    """One live-window measurement pass; returns True if all goals met.
+    Every step's timeout is capped at the lifetime deadline, and steps
+    whose goals are already banked are skipped (a short window must go
+    straight to whatever is still missing)."""
     g0 = goals_state()
     log("window open; goals before: %s" % g0)
 
-    # 1. the full bench ladder — banks everything it measures
-    rc, tail = run_killable(
-        [sys.executable, "bench.py"],
-        1550,
-        env={"BENCH_TIMEOUT": "1500"},
-        log_name="bench_ladder.log",
-    )
-    log("bench ladder rc=%s" % rc)
-    commit_if_changed("bank TPU measurements from live window (bench ladder)")
+    def slot(want):
+        return min(want, max(0.0, deadline - time.time()))
+
+    # 1. the full bench ladder — banks everything it measures; skipped
+    #    once every bench goal is in the bank so a later window can spend
+    #    itself on the still-missing steps
+    bench_goals = ("resnet", "resnet_big", "bert384", "bert384_flash")
+    if not all(g0[k] for k in bench_goals) and slot(1550) > 120:
+        budget = slot(1550)
+        rc, tail = run_killable(
+            [sys.executable, "bench.py"],
+            budget,
+            env={"BENCH_TIMEOUT": str(int(budget - 50))},
+            log_name="bench_ladder.log",
+        )
+        log("bench ladder rc=%s" % rc)
+        commit_if_changed("bank TPU measurements from live window (bench ladder)")
 
     # 2. flash probe at seq 384 if the ladder didn't get to it
-    if goals_state()["bert384"] and not goals_state()["bert384_flash"]:
+    if (goals_state()["bert384"] and not goals_state()["bert384_flash"]
+            and slot(600) > 120):
+        budget = slot(600)
         rc, _ = run_killable(
             [sys.executable, "bench_bert.py"],
-            600,
+            budget,
             env={"BENCH_BERT_SEQ": "384", "BENCH_FLASH": "1",
-                 "BENCH_BUDGET_S": "550"},
+                 "BENCH_BUDGET_S": str(int(budget - 50))},
             log_name="bench_bert_flash.log",
         )
         log("bert flash probe rc=%s" % rc)
@@ -198,11 +211,11 @@ def playbook():
     for name in HLO_GOALS:
         args = hlo_args[name]
         dst = os.path.join(OUT, name + ".json")
-        if os.path.exists(dst):
+        if os.path.exists(dst) or slot(700) < 120:
             continue
         rc, _ = run_killable(
             [sys.executable, "tools/hlo_scan.py"] + args + ["--out", dst],
-            700,
+            slot(700),
             log_name="hlo_scan.log",
         )
         log("hlo_scan %s rc=%s" % (name, rc))
@@ -225,7 +238,7 @@ def main():
         n += 1
         if probe():
             log("probe #%d: TPU ALIVE" % n)
-            if playbook():
+            if playbook(deadline):
                 log("all goals banked; watcher done")
                 return 0
             # partial window — re-probe soon in case it is still open
