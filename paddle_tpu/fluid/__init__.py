@@ -68,6 +68,14 @@ from . import flags
 from .flags import get_flags, set_flags
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from . import evaluator
+from . import average
+from . import lod_tensor
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
+from . import install_check
+from .install_check import run_check as _run_check  # fluid.install_check.run_check
+from . import graphviz
+from . import net_drawer
 from . import incubate
 from . import debugger
 from .debugger import set_check_nan_inf
@@ -102,6 +110,14 @@ __all__ = [
     "clip",
     "metrics",
     "backward",
+    "evaluator",
+    "average",
+    "lod_tensor",
+    "create_lod_tensor",
+    "create_random_int_lodtensor",
+    "install_check",
+    "graphviz",
+    "net_drawer",
     "append_backward",
     "gradients",
     "ParamAttr",

@@ -32,6 +32,8 @@ from .nn import (  # noqa: F401
     SpectralNorm,
 )
 from .tracer import Tracer, VarBase  # noqa: F401
+from .container import Sequential  # noqa: F401
+from .backward_strategy import BackwardStrategy  # noqa: F401
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401

@@ -1,0 +1,23 @@
+"""BackwardStrategy (reference: paddle/fluid/imperative/
+backward_strategy.h:24 — `sorted_sum_gradient_` controls deterministic
+gradient-accumulation order, exposed to Python as
+fluid.dygraph.BackwardStrategy).
+
+With ``sorted_sum_gradient = True`` the tape engine sums each variable's
+gradient contributions in FORWARD-op order (ascending tape index) instead
+of reverse-encounter order — the reproducibility knob v1.6 scripts set
+before calling loss.backward(strategy)."""
+
+from __future__ import annotations
+
+__all__ = ["BackwardStrategy"]
+
+
+class BackwardStrategy(object):
+    def __init__(self):
+        self.sorted_sum_gradient = False
+
+    def __repr__(self):
+        return "BackwardStrategy(sorted_sum_gradient=%r)" % (
+            self.sorted_sum_gradient,
+        )

@@ -65,7 +65,7 @@ class VarBase(object):
         tracer = _current_tracer()
         if tracer is None:
             raise RuntimeError("backward() outside dygraph guard")
-        tracer.run_backward(self)
+        tracer.run_backward(self, backward_strategy)
 
     def gradient(self):
         if self._grad is None:
@@ -211,17 +211,32 @@ class Tracer(object):
         return out_vars
 
     # -- backward (reference: BasicEngine::Execute, engine.cc) --
-    def run_backward(self, loss):
+    def run_backward(self, loss, backward_strategy=None):
         import jax
         import jax.numpy as jnp
 
         # eager grad ops run on the default jax device; set once per replay
         _registry.set_lowering_backend(jax.default_backend())
-        grads = {}  # VarBase id -> jax array
+        sorted_sum = bool(
+            backward_strategy is not None
+            and getattr(backward_strategy, "sorted_sum_gradient", False)
+        )
+        grads = {}  # VarBase id -> jax array (reverse-encounter accumulation)
         grads[id(loss)] = jnp.ones_like(loss.value)
         holders = {id(loss): loss}
+        # BackwardStrategy.sorted_sum_gradient: per-var contribution list
+        # tagged with the producing entry's tape index, so the final sum
+        # runs in FORWARD-op order (backward_strategy.h:24 semantics).
+        # Only tracked when requested — the lists would otherwise pin one
+        # extra buffer per gradient edge for the whole backward
+        contribs = (
+            {id(loss): [(len(self._tape), grads[id(loss)])]}
+            if sorted_sum else None
+        )
 
-        for entry in reversed(self._tape):
+        for tape_idx, entry in zip(
+            range(len(self._tape) - 1, -1, -1), reversed(self._tape)
+        ):
             out_has_grad = any(
                 id(v) in grads
                 for vs in entry.outputs.values()
@@ -275,7 +290,21 @@ class Tracer(object):
                             grads[id(target)] = grads[id(target)] + g
                         else:
                             grads[id(target)] = g
+                        if contribs is not None:
+                            contribs.setdefault(id(target), []).append(
+                                (tape_idx, g)
+                            )
                         holders[id(target)] = target
+
+        if sorted_sum:
+            # deterministic forward-order accumulation for the final grads
+            grads = {
+                vid: sum(
+                    (g for _i, g in sorted(cs, key=lambda c: c[0])[1:]),
+                    sorted(cs, key=lambda c: c[0])[0][1],
+                )
+                for vid, cs in contribs.items()
+            }
 
         # write accumulated grads onto VarBases (GradientAccumulator)
         for vid, g in grads.items():

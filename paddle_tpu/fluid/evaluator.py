@@ -1,0 +1,266 @@
+"""Legacy in-graph evaluators (reference:
+python/paddle/fluid/evaluator.py:45 Evaluator / :127 ChunkEvaluator /
+:218 EditDistance / :299 DetectionMAP).
+
+Deprecated in the reference in favor of fluid.metrics (the warning is
+preserved), but v1.6 scripts import them — state variables live in the
+main program as persistables, accumulated with ``sums`` ops every batch,
+reset by a fill_constant program, and read back by ``eval``.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from . import layers
+from . import unique_name
+from .framework import Program, Variable, program_guard
+from .layer_helper import LayerHelper
+from .initializer import Constant
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _clone_var_(block, var):
+    assert isinstance(var, Variable)
+    return block.create_var(
+        name=var.name,
+        shape=var.shape,
+        dtype=var.dtype,
+        persistable=True,
+    )
+
+
+class Evaluator(object):
+    """Base class: ``states`` accumulate across batches, ``metrics`` are
+    per-batch graph outputs; ``reset`` zeroes the states through a tiny
+    fill_constant program (reference evaluator.py:77)."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            "The %s is deprecated, because maintain a modified program "
+            "inside evaluator cause bug easily, please use "
+            "fluid.metrics.%s instead."
+            % (self.__class__.__name__, self.__class__.__name__), Warning)
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(main_program=reset_program):
+            for var in self.states:
+                assert isinstance(var, Variable)
+                g_var = _clone_var_(reset_program.current_block(), var)
+                layers.fill_constant(
+                    shape=g_var.shape, value=0.0, dtype=g_var.dtype,
+                    out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_variable(
+            name="_".join([unique_name.generate(self.helper.name), suffix]),
+            persistable=True,
+            dtype=dtype,
+            shape=shape,
+        )
+        self.helper.set_variable_initializer(
+            state, initializer=Constant(value=0.0))
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulates chunk_eval counters across batches; eval() computes
+    precision/recall/F1 from the accumulated counts
+    (reference evaluator.py:127)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super(ChunkEvaluator, self).__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.num_infer_chunks = self._create_state(
+            dtype="int64", shape=[1], suffix="num_infer_chunks")
+        self.num_label_chunks = self._create_state(
+            dtype="int64", shape=[1], suffix="num_label_chunks")
+        self.num_correct_chunks = self._create_state(
+            dtype="int64", shape=[1], suffix="num_correct_chunks")
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input=input,
+            label=label,
+            chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types,
+        )
+        layers.sums(
+            input=[self.num_infer_chunks, num_infer_chunks],
+            out=self.num_infer_chunks)
+        layers.sums(
+            input=[self.num_label_chunks, num_label_chunks],
+            out=self.num_label_chunks)
+        layers.sums(
+            input=[self.num_correct_chunks, num_correct_chunks],
+            out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None):
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.current_block()
+        with program_guard(main_program=eval_program):
+            num_infer_chunks, num_label_chunks, num_correct_chunks = (
+                executor.run(
+                    eval_program,
+                    fetch_list=[_clone_var_(block, s) for s in self.states],
+                )
+            )
+        num_infer_chunks = int(np.asarray(num_infer_chunks).ravel()[0])
+        num_label_chunks = int(np.asarray(num_label_chunks).ravel()[0])
+        num_correct_chunks = int(np.asarray(num_correct_chunks).ravel()[0])
+        precision = (
+            float(num_correct_chunks) / num_infer_chunks
+            if num_infer_chunks else 0.0
+        )
+        recall = (
+            float(num_correct_chunks) / num_label_chunks
+            if num_label_chunks else 0.0
+        )
+        f1_score = (
+            float(2 * precision * recall) / (precision + recall)
+            if num_correct_chunks else 0.0
+        )
+        return (
+            np.array([precision], dtype="float32"),
+            np.array([recall], dtype="float32"),
+            np.array([f1_score], dtype="float32"),
+        )
+
+
+class EditDistance(Evaluator):
+    """Accumulates edit-distance sum, sequence count and instance errors;
+    eval() returns (average distance, instance error rate)
+    (reference evaluator.py:218)."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super(EditDistance, self).__init__("edit_distance", **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.total_distance = self._create_state(
+            dtype="float32", shape=[1], suffix="total_distance")
+        self.seq_num = self._create_state(
+            dtype="int64", shape=[1], suffix="seq_num")
+        self.instance_error = self._create_state(
+            dtype="int64", shape=[1], suffix="instance_error")
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=False,
+            ignored_tokens=ignored_tokens)
+        zero = layers.fill_constant(shape=[1], value=0.0, dtype="float32")
+        compare_result = layers.equal(distances, zero)
+        compare_result_int = layers.cast(x=compare_result, dtype="int64")
+        seq_right_count = layers.reduce_sum(compare_result_int)
+        instance_error_count = layers.elementwise_sub(
+            x=seq_num, y=seq_right_count)
+        total_distance = layers.reduce_sum(distances)
+        layers.sums(
+            input=[self.total_distance, total_distance],
+            out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        layers.sums(
+            input=[self.instance_error, instance_error_count],
+            out=self.instance_error)
+        self.metrics.append(total_distance)
+        self.metrics.append(instance_error_count)
+
+    def eval(self, executor, eval_program=None):
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.current_block()
+        with program_guard(main_program=eval_program):
+            total_distance = _clone_var_(block, self.total_distance)
+            seq_num = _clone_var_(block, self.seq_num)
+            instance_error = _clone_var_(block, self.instance_error)
+            seq_num_f = layers.cast(x=seq_num, dtype="float32")
+            instance_error_f = layers.cast(x=instance_error, dtype="float32")
+            avg_distance = layers.elementwise_div(
+                x=total_distance, y=seq_num_f)
+            avg_instance_error = layers.elementwise_div(
+                x=instance_error_f, y=seq_num_f)
+            result = executor.run(
+                eval_program, fetch_list=[avg_distance, avg_instance_error])
+        return np.array(result[0]), np.array(result[1])
+
+
+class DetectionMAP(Evaluator):
+    """mAP over detection results (reference evaluator.py:299).
+
+    ``cur_map`` is the current batch's mAP from the detection_map op;
+    the accumulative mAP is maintained host-side by ``eval`` as the
+    batch-count-weighted running mean of batch mAPs (the reference
+    threads true/false-positive state tensors through the op; the
+    TPU-native detection_map lowering evaluates per batch, so the
+    cross-batch aggregation lives here instead)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super(DetectionMAP, self).__init__("map_eval")
+
+        gt_label = layers.cast(x=gt_label, dtype=gt_box.dtype)
+        if gt_difficult is not None:
+            gt_difficult = layers.cast(x=gt_difficult, dtype=gt_box.dtype)
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=1)
+
+        helper = self.helper
+        cur_map = helper.create_variable_for_type_inference(dtype="float32")
+        accum_pos = helper.create_variable_for_type_inference(dtype="int32")
+        accum_tp = helper.create_variable_for_type_inference(dtype="float32")
+        accum_fp = helper.create_variable_for_type_inference(dtype="float32")
+        helper.append_op(
+            type="detection_map",
+            inputs={"DetectRes": [input], "Label": [label]},
+            outputs={
+                "MAP": [cur_map],
+                "AccumPosCount": [accum_pos],
+                "AccumTruePos": [accum_tp],
+                "AccumFalsePos": [accum_fp],
+            },
+            attrs={
+                "class_num": class_num,
+                "background_label": background_label,
+                "overlap_threshold": overlap_threshold,
+                "evaluate_difficult": evaluate_difficult,
+                "ap_type": ap_version,
+            },
+        )
+        self.cur_map = cur_map
+        self.accum_map = cur_map  # per-batch value; see class docstring
+        self.metrics.append(cur_map)
+        self._batch_maps = []
+
+    def update(self, cur_map_value):
+        """Record one batch's fetched cur_map for the running mean."""
+        self._batch_maps.append(float(np.asarray(cur_map_value).ravel()[0]))
+
+    def reset(self, executor, reset_program=None):
+        self._batch_maps = []
+        if self.states:
+            super(DetectionMAP, self).reset(executor, reset_program)
+
+    def eval(self, executor, eval_program=None):
+        if not self._batch_maps:
+            return np.array([0.0], dtype="float32")
+        return np.array([float(np.mean(self._batch_maps))], dtype="float32")

@@ -1,0 +1,57 @@
+"""Program-to-graphviz drawing (reference:
+python/paddle/fluid/net_drawer.py:103 draw_graph — walks a Program's ops
+and vars and emits a DOT graph; the reference shells out through its
+graphviz module, this one builds on fluid.graphviz)."""
+
+from __future__ import annotations
+
+import logging
+
+from .graphviz import Graph
+
+__all__ = ["draw_graph"]
+
+logger = logging.getLogger(__name__)
+
+OP_STYLE = {"shape": "oval", "color": "#0F9D58", "style": "filled",
+            "fontcolor": "#FFFFFF"}
+VAR_STYLE = {"shape": "box", "color": "#F4B400", "style": "rounded,filled"}
+
+
+def parse_graph(program, graph, var_dict, **kwargs):
+    """Add one program's ops/vars to ``graph``; ``var_dict`` maps var
+    names to nodes so programs drawn together share variable nodes."""
+    for block in program.blocks:
+        for op in block.ops:
+            op_node = graph.node(op.type, prefix="op", **OP_STYLE)
+            for slot in op.input_names:
+                for name in op.input(slot) or []:
+                    if name not in var_dict:
+                        var_dict[name] = graph.node(
+                            name, prefix="var", **VAR_STYLE
+                        )
+                    graph.edge(var_dict[name], op_node, label=slot)
+            for slot in op.output_names:
+                for name in op.output(slot) or []:
+                    if name not in var_dict:
+                        var_dict[name] = graph.node(
+                            name, prefix="var", **VAR_STYLE
+                        )
+                    graph.edge(op_node, var_dict[name], label=slot)
+    return graph
+
+
+def draw_graph(startup_program, main_program, **kwargs):
+    """Draw startup+main programs into one DOT graph; ``graph_attr`` dict
+    and ``path`` (default netgraph.dot) mirror the reference kwargs.
+    Returns the Graph (call .compile(path) already done when path given)."""
+    graph_attr = kwargs.get("graph_attr") or {}
+    graph = Graph("network", **graph_attr)
+    var_dict = {}
+    parse_graph(startup_program, graph, var_dict)
+    parse_graph(main_program, graph, var_dict)
+    path = kwargs.get("path")
+    if path:
+        graph.compile(path)
+        logger.info("net graph written to %s", path)
+    return graph

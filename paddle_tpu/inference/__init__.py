@@ -243,6 +243,18 @@ class AnalysisPredictor(object):
     EXEC_SEG = "__executable_%d__"
     EXEC_STATE = "__state__.npz"
     EXEC_BRIDGE = "__bridge_program__"
+    # mesh-sharded bundle (VERDICT r4 task 6): a TP/dp-sharded program
+    # cannot ship as per-chip StableHLO (the artifact would be pinned to
+    # one mesh size and the collectives to one topology). The portable
+    # artifact is the PER-CHIP PROGRAM + a shard manifest (dist_attr per
+    # param + default mesh axes) + full-value params; at serve time the
+    # loader re-establishes the dist_attrs and compiles under
+    # CompiledProgram.with_spmd on whatever mesh the serving host has —
+    # the reference serves whatever program it is given
+    # (analysis_predictor.cc:636), and so does this path.
+    SHARD_MANIFEST = "__shard_manifest__.json"
+    SHARD_PROGRAM = "__sharded_program__"
+    SHARD_PARAMS = "__sharded_params__.npz"
 
     def _export_plans(self):
         if self._compiled is None:
@@ -250,24 +262,31 @@ class AnalysisPredictor(object):
                 self._program, 0, list(self._feed_names),
                 self._fetch_names, self._place,
             )
-        if self._compiled.mesh is not None:
-            raise NotImplementedError(
-                "AOT export targets a single-chip serving artifact; export "
-                "the per-chip program (no mesh) and shard at load time"
-            )
-        for kind, _seg, plan in self._compiled._plans:
-            if kind == "xla" and plan["sharded_const"]:
-                raise NotImplementedError(
-                    "AOT export does not support dist-attr sharded params"
-                )
+        # meshed / dist-attr-sharded programs never reach here: they take
+        # the sharded-program-bundle path in save_optimized_model
+        assert self._compiled.mesh is None, "sharded programs export via " \
+            "the shard-manifest bundle"
         return self._compiled._plans
 
+    def _sharded_dist_attrs(self):
+        """{var_name: dist_attr} for every dist-attr-annotated variable
+        (the repo's TP extension; empty for plain programs)."""
+        out = {}
+        for v in self._program.list_vars():
+            attr = getattr(v, "dist_attr", None)
+            if attr:
+                out[v.name] = [a if a else None for a in attr]
+        return out
+
     def save_optimized_model(self, dirname=None, input_shapes=None,
-                             input_dtypes=None):
+                             input_dtypes=None, mesh_axes=None):
         """Serialize the program as an executable bundle for the given input
         shapes. Works for state-mutating programs (BN running stats, ...)
         and multi-segment programs with host ops in the middle; see the
-        bundle-format note above. Returns the meta path."""
+        bundle-format note above. dist-attr-sharded programs (TP) export
+        as a shard-manifest bundle instead (reloaded under with_spmd;
+        ``mesh_axes`` records the default serving mesh). Returns the meta
+        path."""
         import json
 
         import jax
@@ -277,6 +296,10 @@ class AnalysisPredictor(object):
         from ..fluid.executor import _run_host_op
 
         dirname = dirname or self._config._model_dir
+        if self._sharded_dist_attrs() or mesh_axes is not None:
+            return self._save_sharded_bundle(
+                dirname, input_shapes, input_dtypes, mesh_axes
+            )
         if input_shapes is None:
             raise ValueError("input_shapes: {feed_name: shape} required")
         dtypes = input_dtypes or {}
@@ -461,14 +484,61 @@ class AnalysisPredictor(object):
             json.dump(meta, f)
         return meta_path
 
+    def _save_sharded_bundle(self, dirname, input_shapes, input_dtypes,
+                             mesh_axes):
+        """Shard-manifest bundle: per-chip program (wire format) +
+        dist_attr manifest + full-value params. See SHARD_MANIFEST note."""
+        import json
+
+        from ..fluid import proto as _proto
+
+        os.makedirs(dirname, exist_ok=True)
+        with open(os.path.join(dirname, self.SHARD_PROGRAM), "wb") as f:
+            f.write(_proto.program_to_bytes(self._program))
+        params = {}
+        for v in self._program.list_vars():
+            if not v.persistable:
+                continue
+            val = self._scope.get(v.name)
+            if val is not None:
+                params[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, self.SHARD_PARAMS), **params)
+        meta = {
+            "version": 1,
+            "kind": "sharded_program",
+            "feed_order": list(self._feed_names),
+            "fetch_names": list(self._fetch_names),
+            "dist_attrs": self._sharded_dist_attrs(),
+            "mesh_axes": dict(mesh_axes or {}),
+            "shapes": (
+                {n: list(input_shapes[n]) for n in input_shapes}
+                if input_shapes else {}
+            ),
+            "dtypes": {n: str(np.dtype(d))
+                       for n, d in (input_dtypes or {}).items()},
+        }
+        meta_path = os.path.join(dirname, self.SHARD_MANIFEST)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        return meta_path
+
     @classmethod
-    def from_executable(cls, dirname):
+    def from_executable(cls, dirname, mesh_axes=None):
         """Load the serialized executable bundle — no Program lowering, no
         retracing (reference analog: loading a saved engine plan). v1
-        single-executable bundles load too."""
+        single-executable bundles load too. A shard-manifest bundle (TP
+        export) reloads as a predictor that re-compiles the program under
+        with_spmd on this host's mesh; ``mesh_axes`` overrides the
+        recorded default axes."""
         import json
 
         from jax import export as jax_export
+
+        shard_meta = os.path.join(dirname, cls.SHARD_MANIFEST)
+        if os.path.exists(shard_meta):
+            with open(shard_meta) as f:
+                meta = json.load(f)
+            return _ShardedPredictor(dirname, meta, mesh_axes=mesh_axes)
 
         with open(os.path.join(dirname, cls.EXEC_META)) as f:
             meta = json.load(f)
@@ -608,6 +678,91 @@ class _ExecutablePredictor(object):
             self._inputs[n] = np.ascontiguousarray(a)
         self.zero_copy_run()
         return [np.asarray(self._outputs[n]) for n in self._fetch_names]
+
+
+class _ShardedPredictor(object):
+    """Predictor over a shard-manifest bundle: reconstructs the program
+    from the wire format, re-establishes each param's dist_attr from the
+    manifest, loads full-value params into a fresh scope, and compiles
+    under CompiledProgram.with_spmd on this host's device mesh — the TP
+    serving path for the repo's dist-attr tensor-parallel extension.
+    Mirrors the ZeroCopy API surface of AnalysisPredictor."""
+
+    def __init__(self, dirname, meta, mesh_axes=None):
+        from ..fluid import proto as _proto
+        from ..fluid.compiler import CompiledProgram
+        from ..fluid.executor import Executor
+
+        with open(os.path.join(dirname, AnalysisPredictor.SHARD_PROGRAM),
+                  "rb") as f:
+            self._program = _proto.program_from_bytes(f.read())
+        blk = self._program.global_block()
+        for name, attr in meta.get("dist_attrs", {}).items():
+            if name in blk.vars:
+                blk.vars[name].dist_attr = tuple(
+                    a if a else None for a in attr
+                )
+        self._scope = core.Scope()
+        params_path = os.path.join(dirname, AnalysisPredictor.SHARD_PARAMS)
+        with np.load(params_path) as z:
+            for k in z.files:
+                self._scope.set(k, z[k])
+        self._feed_names = list(meta["feed_order"])
+        self._fetch_names = list(meta["fetch_names"])
+        self._place = (
+            core.TPUPlace(0)
+            if core.get_tpu_device_count() > 0
+            else core.CPUPlace()
+        )
+        self._exe = Executor(self._place)
+        axes = dict(mesh_axes if mesh_axes is not None
+                    else meta.get("mesh_axes") or {})
+        if not axes:
+            # default: every model axis named by a dist_attr gets size 1
+            # hint (with_spmd fills "data" with the remaining devices);
+            # pass explicit mesh_axes to actually shard the model axes
+            axes = {"data": None}
+        self._compiled = CompiledProgram(self._program).with_spmd(
+            mesh_axes=axes
+        )
+        self._inputs = {}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name):
+        return ZeroCopyTensor(self, name, True)
+
+    def get_output_tensor(self, name):
+        return ZeroCopyTensor(self, name, False)
+
+    def zero_copy_run(self):
+        outs = self._exe.run(
+            self._compiled,
+            feed={n: np.asarray(self._inputs[n]) for n in self._feed_names},
+            fetch_list=list(self._fetch_names),
+            scope=self._scope,
+        )
+        self._outputs = dict(zip(self._fetch_names, outs))
+
+    def run(self, inputs):
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                "expected %d inputs (%s), got %d"
+                % (len(self._feed_names), self._feed_names, len(inputs))
+            )
+        for n, a in zip(self._feed_names, inputs):
+            self._inputs[n] = np.ascontiguousarray(a)
+        self.zero_copy_run()
+        return [np.asarray(self._outputs[n]) for n in self._fetch_names]
+
+    @property
+    def program(self):
+        return self._program
 
 
 class _BundleScope(object):

@@ -359,6 +359,87 @@ case("flash_attention_kernel", op_type="flash_attention",
      attrs={"causal": True, "scale": 0.5, "interpret": True},
      tol=0.02)
 
+# -- ROI / deformable sampling (VERDICT r4 task 7: direct FD, kink-aware) ----
+# grads are checked wrt the FEATURE map (and learned offsets where smooth):
+# ROI-coordinate grads are excluded exactly as the reference's own tests do
+# (test_roi_align_op.py checks ['X'] only) — bin quantization/rounding makes
+# coordinate FD ill-posed. Offsets are initialized ~0.25 from integers so no
+# bilinear sample sits within FD delta of a grid-line kink.
+case("roi_align",
+     inputs={"X": U(190, (1, 2, 6, 6)),
+             "ROIs": np.array([[0.3, 0.4, 4.6, 4.7]], np.float32)},
+     outputs={"Out": Z(1, 2, 2, 2)}, check=["X"],
+     attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0,
+            "sampling_ratio": 2}, tol=0.02)
+# max-pooled bins: feature values spaced 0.1 apart so the FD delta can
+# never flip an argmax tie
+case("roi_pool",
+     inputs={"X": (np.random.RandomState(191).permutation(72)
+                   .astype("float32").reshape(1, 2, 6, 6) * 0.1),
+             "ROIs": np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)},
+     outputs={"Out": Z(1, 2, 2, 2)}, check=["X"],
+     attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+     tol=0.02)
+case("psroi_pool",
+     inputs={"X": U(192, (1, 8, 6, 6)),
+             "ROIs": np.array([[0.0, 1.0, 4.0, 5.0]], np.float32)},
+     outputs={"Out": Z(1, 2, 2, 2)}, check=["X"],
+     attrs={"output_channels": 2, "pooled_height": 2, "pooled_width": 2,
+            "spatial_scale": 1.0}, tol=0.02)
+case("prroi_pool",
+     inputs={"X": U(193, (1, 2, 6, 6)),
+             "ROIs": np.array([[0.4, 0.6, 4.3, 4.7]], np.float32)},
+     outputs={"Out": Z(1, 2, 2, 2)}, check=["X"],
+     attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+     tol=0.02)
+case("deformable_conv",
+     inputs={"Input": U(194, (1, 2, 5, 5)),
+             "Offset": U(195, (1, 18, 3, 3), -0.1, 0.1) + 0.25,
+             "Mask": U(196, (1, 9, 3, 3), 0.2, 1.0),
+             "Filter": U(197, (2, 2, 3, 3))},
+     outputs={"Output": Z(1, 2, 3, 3)},
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1, "deformable_groups": 1}, tol=0.02)
+case("deformable_conv_v1",
+     inputs={"Input": U(198, (1, 2, 5, 5)),
+             "Offset": U(199, (1, 18, 3, 3), -0.1, 0.1) + 0.25,
+             "Filter": U(200, (2, 2, 3, 3))},
+     outputs={"Output": Z(1, 2, 3, 3)},
+     attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1, "deformable_groups": 1}, tol=0.02)
+case("deformable_psroi_pooling",
+     inputs={"Input": U(201, (1, 4, 6, 6)),
+             "ROIs": np.array([[0.7, 0.6, 4.3, 4.2]], np.float32),
+             "Trans": U(202, (1, 2, 2, 2), -0.05, 0.05) + 0.25},
+     outputs={"Output": Z(1, 4, 2, 2)}, check=["Input", "Trans"],
+     attrs={"no_trans": False, "spatial_scale": 1.0, "output_dim": 4,
+            "group_size": [1, 1], "pooled_height": 2, "pooled_width": 2,
+            "part_size": [2, 2], "sample_per_part": 2, "trans_std": 0.1},
+     tol=0.02)
+
+# -- fused inference ops with smooth math: direct FD instead of oracle-only -
+case("fused_fc_elementwise_layernorm",
+     inputs={"X": U(203, (2, 4)), "W": U(204, (4, 6)), "Y": U(205, (2, 6)),
+             "Bias0": U(206, (6,)), "Scale": U(207, (6,), 0.5, 1.5),
+             "Bias1": U(208, (6,))},
+     outputs={"Out": Z(2, 6), "Mean": Z(2, 1), "Variance": Z(2, 1)},
+     outs=["Out"],
+     attrs={"x_num_col_dims": 1, "activation_type": "", "epsilon": 1e-5},
+     tol=0.02)
+case("fusion_squared_mat_sub",
+     inputs={"X": U(209, (3, 4)), "Y": U(210, (4, 5))},
+     outputs={"Out": Z(3, 5), "SquaredX": Z(3, 4), "SquaredY": Z(4, 5),
+              "SquaredXY": Z(3, 5)},
+     outs=["Out"], attrs={"scalar": 0.5}, tol=0.02)
+case("fused_embedding_seq_pool",
+     inputs={"W": U(211, (8, 4)), "Ids": I(212, (2, 3), 0, 8)},
+     outputs={"Out": Z(2, 4)}, check=["W"],
+     attrs={"padding_idx": -1, "combiner": "sum"}, max_elements=32)
+case("fusion_seqpool_concat",
+     inputs={"X": [("fsp0", U(213, (2, 3, 4))), ("fsp1", U(214, (2, 3, 2)))]},
+     outputs={"Out": Z(2, 6)}, attrs={"pooltype": "SUM", "axis": 1},
+     max_elements=32)
+
 # -- embeddings --------------------------------------------------------------
 case("lookup_table", inputs={"W": U(140, (10, 4)),
                              "Ids": I(141, (3, 1), 0, 10)},
@@ -543,23 +624,11 @@ DISPOSITIONS = {
     "fusion_repeated_fc_relu": "fused inference op (test_op_fused oracle)",
     "fusion_seqconv_eltadd_relu": "fused inference op (test_op_fused oracle)",
     "fusion_seqexpand_concat_fc": "fused inference op (test_op_fused oracle)",
-    "fusion_seqpool_concat": "fused inference op (test_op_fused oracle)",
     "fusion_seqpool_cvm_concat": "fused inference op (test_op_fused oracle)",
-    "fusion_squared_mat_sub": "fused inference op (test_op_fused oracle)",
-    "fused_embedding_seq_pool": "fused embedding (test_op_fused oracle)",
-    "fused_fc_elementwise_layernorm":
-        "fused inference op (test_op_fused oracle)",
-    # ROI / deformable-sampling detection ops: forward is oracle-tested in
-    # test_op_detection; the grad is the generic vjp of that SAME jax
-    # lowering (registry grad='generic' differentiates the tested forward),
-    # and FD around the ROI max/bin boundaries is numerically ill-posed
-    "roi_pool": "ROI sampling (forward oracle in test_op_detection; generic vjp)",
-    "prroi_pool": "ROI sampling (forward oracle; generic vjp)",
-    "psroi_pool": "ROI sampling (forward oracle; generic vjp)",
+    # roi_align/roi_pool/psroi/prroi/deformable_* moved to direct FD CASES
+    # above (VERDICT r4 task 7); only the 8-point perspective solve stays
+    # dispositioned (its homography inverse makes FD ill-conditioned)
     "roi_perspective_transform": "ROI sampling (forward oracle; generic vjp)",
-    "deformable_conv": "deformable sampling (forward oracle; generic vjp)",
-    "deformable_conv_v1": "deformable sampling (forward oracle; generic vjp)",
-    "deformable_psroi_pooling": "deformable sampling (forward oracle; generic vjp)",
     "yolov3_loss": "detection loss with target assignment (forward oracle "
                    "in test_op_detection; generic vjp)",
     "match_matrix_tensor": "LoD text-matching op (forward oracle in "
@@ -605,12 +674,19 @@ def test_every_op_is_checked_or_dispositioned():
 
 
 def test_sweep_plus_dispositions_cover_target():
-    """VERDICT r3 #4 bar. Current accounting of the 397 registered ops:
-    190 FD-grad-checked (124 sweep cases + 66 dedicated tests), 52
-    grad-bearing ops dispositioned with recorded reasons, and 156 ops with
-    no grad maker by design (optimizer updates, integer/bool outputs,
-    IO/collective runtime, *_grad bodies) — the differentiable corpus is
-    241 ops, so 189/241 = 78% carries a direct finite-difference check."""
+    """VERDICT r3 #4 / r4 task 7 bar. Current accounting of the 398
+    registered ops: 200 FD-grad-checked (135 sweep cases incl. the
+    ROI/deformable sampling ops with kink-aware inputs + 66 dedicated
+    tests), 44 grad-bearing ops dispositioned with recorded reasons, and
+    156 ops with no grad maker by design (optimizer updates, integer/bool
+    outputs, IO/collective runtime, *_grad bodies) — the differentiable
+    corpus is 242 ops, so ~82% carries a direct finite-difference check.
+    Counted over DISTINCT REGISTERED ops — alias case keys (e.g.
+    flash_attention_kernel, a second config of flash_attention) do not
+    inflate the bar."""
     elsewhere = _ops_grad_checked_elsewhere()
-    checked = set(CASES) | elsewhere
-    assert len(checked) >= 185, len(checked)
+    real_ops = {
+        CASES[c].get("op_type", c) for c in CASES
+    } | elsewhere
+    checked = {op for op in real_ops if op in registry._REGISTRY}
+    assert len(checked) >= 200, len(checked)
