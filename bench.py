@@ -487,6 +487,8 @@ def _banked_resnet_line(errors):
     }
     if e.get("remat"):
         line["remat"] = True
+    if e.get("note"):
+        line["provenance"] = e["note"]
     if errors:
         line["note"] = "banked TPU measurement; live attempts this run failed: %s" % (
             "; ".join(errors)[:300]
@@ -518,6 +520,8 @@ def _banked_bert_line(errors):
     }
     if slot.endswith("_flash"):
         line["flash_attention"] = True
+    if e.get("note"):
+        line["provenance"] = e["note"]
     if errors:
         line["note"] = "banked TPU measurement; live attempts this run failed: %s" % (
             "; ".join(errors)[:300]

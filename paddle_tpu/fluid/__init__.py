@@ -73,7 +73,6 @@ from . import average
 from . import lod_tensor
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import install_check
-from .install_check import run_check as _run_check  # fluid.install_check.run_check
 from . import graphviz
 from . import net_drawer
 from . import incubate

@@ -298,12 +298,15 @@ class Tracer(object):
 
         if sorted_sum:
             # deterministic forward-order accumulation for the final grads
+            def _forward_order_sum(cs):
+                cs = sorted(cs, key=lambda c: c[0])
+                total = cs[0][1]
+                for _i, g in cs[1:]:
+                    total = total + g
+                return total
+
             grads = {
-                vid: sum(
-                    (g for _i, g in sorted(cs, key=lambda c: c[0])[1:]),
-                    sorted(cs, key=lambda c: c[0])[0][1],
-                )
-                for vid, cs in contribs.items()
+                vid: _forward_order_sum(cs) for vid, cs in contribs.items()
             }
 
         # write accumulated grads onto VarBases (GradientAccumulator)

@@ -206,11 +206,13 @@ class DetectionMAP(Evaluator):
     """mAP over detection results (reference evaluator.py:299).
 
     ``cur_map`` is the current batch's mAP from the detection_map op;
-    the accumulative mAP is maintained host-side by ``eval`` as the
-    batch-count-weighted running mean of batch mAPs (the reference
-    threads true/false-positive state tensors through the op; the
-    TPU-native detection_map lowering evaluates per batch, so the
-    cross-batch aggregation lives here instead)."""
+    ``accum_map`` is a GRAPH variable holding the batch-count-weighted
+    running mean of batch mAPs, accumulated through persistable state
+    vars every step (the reference threads true/false-positive state
+    tensors through the op; the TPU-native detection_map lowering
+    evaluates per batch, so the cross-batch aggregation rides two scalar
+    states instead). ``get_map_var()`` returns (cur_map, accum_map),
+    matching the v1.6 accessor."""
 
     def __init__(self, input, gt_label, gt_box, gt_difficult=None,
                  class_num=None, background_label=0, overlap_threshold=0.5,
@@ -247,20 +249,32 @@ class DetectionMAP(Evaluator):
             },
         )
         self.cur_map = cur_map
-        self.accum_map = cur_map  # per-batch value; see class docstring
+        # in-graph running mean: sum of batch mAPs / batch count
+        self._total_map = self._create_state(
+            dtype="float32", shape=[1], suffix="total_map")
+        self._batch_count = self._create_state(
+            dtype="float32", shape=[1], suffix="batch_count")
+        layers.sums(input=[self._total_map, cur_map], out=self._total_map)
+        one = layers.fill_constant(shape=[1], value=1.0, dtype="float32")
+        layers.sums(input=[self._batch_count, one], out=self._batch_count)
+        self.accum_map = layers.elementwise_div(
+            x=self._total_map, y=self._batch_count)
         self.metrics.append(cur_map)
-        self._batch_maps = []
+        self.metrics.append(self.accum_map)
 
-    def update(self, cur_map_value):
-        """Record one batch's fetched cur_map for the running mean."""
-        self._batch_maps.append(float(np.asarray(cur_map_value).ravel()[0]))
-
-    def reset(self, executor, reset_program=None):
-        self._batch_maps = []
-        if self.states:
-            super(DetectionMAP, self).reset(executor, reset_program)
+    def get_map_var(self):
+        """v1.6 accessor: (current-batch mAP var, accumulative mAP var)."""
+        return self.cur_map, self.accum_map
 
     def eval(self, executor, eval_program=None):
-        if not self._batch_maps:
-            return np.array([0.0], dtype="float32")
-        return np.array([float(np.mean(self._batch_maps))], dtype="float32")
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.current_block()
+        with program_guard(main_program=eval_program):
+            total = _clone_var_(block, self._total_map)
+            count = _clone_var_(block, self._batch_count)
+            result = executor.run(eval_program, fetch_list=[total, count])
+        total_v = float(np.asarray(result[0]).ravel()[0])
+        count_v = float(np.asarray(result[1]).ravel()[0])
+        return np.array(
+            [total_v / count_v if count_v else 0.0], dtype="float32")

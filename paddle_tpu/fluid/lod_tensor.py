@@ -57,5 +57,5 @@ def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
     assert isinstance(base_shape, list), "base_shape should be a list"
     converted_lod = core._lengths_to_offsets(recursive_seq_lens[-1])
     overall_shape = [converted_lod[-1]] + base_shape
-    data = np.random.random_integers(low, high, overall_shape).astype("int64")
+    data = np.random.randint(low, high + 1, overall_shape).astype("int64")
     return create_lod_tensor(data, recursive_seq_lens, place)

@@ -205,3 +205,48 @@ def test_graphviz_preview_generator():
     dot = gen.graph.code()
     assert "digraph G" in dot and "matmul" in dot
     assert dot.count("->") == 2
+
+
+def test_detection_map_evaluator_accumulates_in_graph():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        det = fluid.layers.data(name="det", shape=[6], dtype="float32")
+        gl = fluid.layers.data(name="gl", shape=[1], dtype="float32")
+        gb = fluid.layers.data(name="gb", shape=[4], dtype="float32")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.DetectionMAP(
+                input=det, gt_label=gl, gt_box=gb, class_num=2)
+    cur_var, accum_var = ev.get_map_var()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        ev.reset(exe)
+        # batch 1: one det exactly on the gt -> mAP 1.0
+        perfect = {
+            "det": np.array([[1.0, 0.9, 10, 10, 20, 20]], "float32"),
+            "gl": np.array([[1.0]], "float32"),
+            "gb": np.array([[10, 10, 20, 20]], "float32"),
+        }
+        cur1, acc1 = exe.run(main, feed=perfect,
+                             fetch_list=[cur_var, accum_var])
+        assert float(np.asarray(cur1).ravel()[0]) == 1.0
+        assert float(np.asarray(acc1).ravel()[0]) == 1.0
+        # batch 2: detection misses the gt box entirely -> mAP 0.0,
+        # accumulative mean drops to 0.5
+        miss = {
+            "det": np.array([[1.0, 0.9, 50, 50, 60, 60]], "float32"),
+            "gl": np.array([[1.0]], "float32"),
+            "gb": np.array([[10, 10, 20, 20]], "float32"),
+        }
+        cur2, acc2 = exe.run(main, feed=miss,
+                             fetch_list=[cur_var, accum_var])
+        assert float(np.asarray(cur2).ravel()[0]) == 0.0
+        np.testing.assert_allclose(
+            float(np.asarray(acc2).ravel()[0]), 0.5)
+        np.testing.assert_allclose(ev.eval(exe).ravel()[0], 0.5)
+        # reset zeroes the accumulation states
+        ev.reset(exe)
+        _c, acc3 = exe.run(main, feed=perfect,
+                           fetch_list=[cur_var, accum_var])
+        np.testing.assert_allclose(float(np.asarray(acc3).ravel()[0]), 1.0)
