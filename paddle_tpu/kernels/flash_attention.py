@@ -369,6 +369,15 @@ def _flash_fwd(q, k, v, key_bias, bias, causal, scale, interpret):
 
 
 def _flash_bwd(causal, scale, interpret, res, g):
+    return _flash_bwd_core(causal, scale, interpret, res, g, None)
+
+
+def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
+    """Shared backward. ``g_lse`` is the logsumexp cotangent from the
+    with-lse entry point (ring attention's combine differentiates through
+    each block's lse): d s_ij gains p_ij·g_lse_i, which folds into the
+    delta term — ds = p∘(dp − (delta − g_lse)) — so the kernels run
+    unchanged with an adjusted delta operand."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -381,6 +390,8 @@ def _flash_bwd(causal, scale, interpret, res, g):
     # delta = rowsum(dO ∘ O): tiny elementwise pass XLA fuses on its own
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
     delta = delta.reshape(B * N, Sq)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).reshape(B * N, Sq)
     if Sqp != Sq:
         delta = jnp.pad(delta, ((0, 0), (0, Sqp - Sq)))
         lse_p = jnp.pad(lse, ((0, 0), (0, Sqp - Sq)))
@@ -489,6 +500,29 @@ def _flash_bwd(causal, scale, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_lse(q, k, v, key_bias, bias, causal, scale, interpret):
+    """(out, lse) variant: lse [B*N, Sq] is the per-row logsumexp of the
+    masked scores — the residual blockwise/ring attention needs to
+    combine per-block outputs across hops without renormalizing."""
+    return _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
+                           interpret)
+
+
+def _flash_lse_fwd(q, k, v, key_bias, bias, causal, scale, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
+                               interpret)
+    return (out, lse), (q, k, v, key_bias, bias, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, interpret, res, cotangents):
+    g, g_lse = cotangents
+    return _flash_bwd_core(causal, scale, interpret, res, g, g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # --------------------------------------------------------------------------
 # public entry
 # --------------------------------------------------------------------------
@@ -526,6 +560,75 @@ def _normalize_bias(bias, B, N, Sq, Sk):
                      % (b.shape,))
 
 
+def flash_attention_lse(q, k, v, key_bias=None, bias=None, causal=False,
+                        scale=None, interpret=None):
+    """Like ``flash_attention`` but also returns the per-row logsumexp
+    [B, N, Sq] of the masked scores. This is the building block for
+    blockwise/ring attention: per-hop block outputs combine as
+    out = Σ_b o_b · exp(lse_b − logaddexp_b(lse)) with no [S, S] tensor
+    and no renormalization pass. Fully differentiable (the lse cotangent
+    folds into the backward's delta term)."""
+    B, N, Sq, d = q.shape
+    Sk = k.shape[2]
+    if causal and Sq != Sk:
+        raise ValueError("causal flash attention needs Sq == Sk")
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
+    kb = None
+    if key_bias is not None:
+        kb = key_bias.astype(jnp.float32)
+        if kb.ndim == 1:
+            kb = kb[None]
+        kb = kb.reshape(-1, Sk)
+        if kb.shape[0] == B and N > 1:
+            kb = jnp.broadcast_to(kb[:, None, :], (B, N, Sk)).reshape(-1, Sk)
+        kb = jnp.broadcast_to(kb, (B * N, Sk))
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None and not on_tpu:
+        # dense fallback with an explicit lse (same math as the kernels)
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, k).astype(jnp.float32) * scale
+        if kb is not None:
+            s = s + kb.reshape(B, N, 1, Sk)
+        if bias is not None:
+            nb, swap = _normalize_bias(bias, B, N, Sq, Sk)
+            G = nb.shape[0]
+            if swap:
+                s = s + nb.reshape(1, N, Sq, Sk)
+            elif G == 1:
+                s = s + nb.reshape(1, 1, Sq, Sk)
+            elif G == B * N:
+                s = s + nb.reshape(B, N, Sq, Sk)
+            else:
+                s = s + nb.reshape(B, 1, Sq, Sk)
+        if causal:
+            mask = jnp.tril(jnp.ones((Sq, Sk), bool))
+            s = jnp.where(mask[None, None], s, _NEG)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        # bit-identical to reference_attention (softmax then cast), so the
+        # no-lse entry point's fallback contract — "transparently the jnp
+        # reference" — holds exactly
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
+        return out, lse
+    if kb is None:
+        kb = jnp.zeros((B * N, Sk), jnp.float32)
+    bf, swap = (None, False) if bias is None else _normalize_bias(
+        bias, B, N, Sq, Sk
+    )
+    if swap:
+        qT = q.transpose(1, 0, 2, 3)
+        kT = k.transpose(1, 0, 2, 3)
+        vT = v.transpose(1, 0, 2, 3)
+        kbT = kb.reshape(B, N, Sk).transpose(1, 0, 2).reshape(N * B, Sk)
+        out, lse = _flash_lse(qT, kT, vT, kbT, bf, causal, scale,
+                              bool(interpret))
+        return (
+            out.transpose(1, 0, 2, 3),
+            lse.reshape(N, B, Sq).transpose(1, 0, 2),
+        )
+    out, lse = _flash_lse(q, k, v, kb, bf, causal, scale, bool(interpret))
+    return out, lse.reshape(B, N, Sq)
+
+
 def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
                     scale=None, interpret=None):
     """Fused attention, [B, N, S, D] -> [B, N, S, D].
@@ -537,55 +640,13 @@ def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
     ``interpret``: force the Pallas interpreter (tests); default runs the
     kernels on TPU and the jnp reference elsewhere. Forward AND backward
     are Pallas kernels — no [S, S] tensor ever reaches HBM.
+
+    Single implementation: this is ``flash_attention_lse`` with the
+    logsumexp dropped (its zero cotangent folds away in the backward), so
+    the two entry points can never diverge on normalization/dispatch.
     """
-    B, N, Sq, d = q.shape
-    Sk = k.shape[2]  # key length (cross attention: != query length)
-    if causal and Sq != Sk:
-        # guard here so the non-TPU reference fallback can't silently
-        # mis-mask (a 1-query causal call would broadcast tril((1,1)))
-        raise ValueError("causal flash attention needs Sq == Sk")
-    scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
-    kb = None
-    if key_bias is not None:
-        # normalize [Sk] / [B, Sk] / [B*N, Sk] / [B, N, Sk] -> [B*N, Sk]
-        kb = key_bias.astype(jnp.float32)
-        if kb.ndim == 1:
-            kb = kb[None]
-        kb = kb.reshape(-1, Sk)
-        if kb.shape[0] == B and N > 1:
-            kb = jnp.broadcast_to(kb[:, None, :], (B, N, Sk)).reshape(-1, Sk)
-        kb = jnp.broadcast_to(kb, (B * N, Sk))
-    on_tpu = jax.default_backend() == "tpu"
-    if interpret is None and not on_tpu:
-        full = None
-        if bias is not None:
-            nb, swap = _normalize_bias(bias, B, N, Sq, Sk)
-            G = nb.shape[0]
-            if swap:                      # [N, Sq, Sk]: per-head rows
-                full = nb.reshape(1, N, Sq, Sk)
-            elif G == 1:
-                full = nb.reshape(1, 1, Sq, Sk)
-            elif G == B * N:
-                full = nb.reshape(B, N, Sq, Sk)
-            else:                         # G == B: per-batch rows
-                full = nb.reshape(B, 1, Sq, Sk)
-        if kb is not None:
-            keyb = kb.reshape(B, N, 1, Sk)
-            full = keyb if full is None else full + keyb
-        return reference_attention(q, k, v, bias=full, causal=causal,
-                                   scale=scale)
-    if kb is None:
-        kb = jnp.zeros((B * N, Sk), jnp.float32)
-    bf, swap = (None, False) if bias is None else _normalize_bias(
-        bias, B, N, Sq, Sk
+    out, _lse = flash_attention_lse(
+        q, k, v, key_bias=key_bias, bias=bias, causal=causal, scale=scale,
+        interpret=interpret,
     )
-    if swap:
-        # head-major role swap: [B,N,S,D] -> [N,B,S,D]; key bias rows
-        # b*N+n -> n*B+b; outer jax autodiff un-swaps the gradients
-        qT = q.transpose(1, 0, 2, 3)
-        kT = k.transpose(1, 0, 2, 3)
-        vT = v.transpose(1, 0, 2, 3)
-        kbT = kb.reshape(B, N, Sk).transpose(1, 0, 2).reshape(N * B, Sk)
-        out = _flash(qT, kT, vT, kbT, bf, causal, scale, bool(interpret))
-        return out.transpose(1, 0, 2, 3)
-    return _flash(q, k, v, kb, bf, causal, scale, bool(interpret))
+    return out

@@ -54,17 +54,28 @@ def _block_attn(q, k, v, bias, scale):
     return m, s, out
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   use_flash=None, interpret=None):
     """Attention over a sequence sharded on ``axis_name``.
 
     q/k/v: local shards [B, H, S_local, D]. Returns the local output shard
     [B, H, S_local, D]. With ``causal=True``, block (i attends j) is masked
     by global block order (devices earlier on the axis hold earlier
     positions); intra-block causal masking applies on the diagonal block.
+
+    ``use_flash``: run each hop's block attention through the Pallas
+    flash kernels (forward AND backward) instead of the dense jnp block —
+    the per-hop [S_local, S_local] score tile then never leaves VMEM, and
+    the scan residuals shrink from O(S_local^2) to O(S_local·D) per hop.
+    Default (None): flash on the TPU backend, dense elsewhere;
+    ``interpret`` forces the Pallas interpreter for tests.
     """
     import jax
     import jax.lax as lax
     import jax.numpy as jnp
+
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" or bool(interpret)
 
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -74,6 +85,52 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
 
     neg = jnp.asarray(-1e9, q.dtype)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if use_flash:
+        from ..kernels.flash_attention import flash_attention_lse
+
+        def combine(acc, lse_b, o_b):
+            # merge a normalized block output by its logsumexp weight
+            lse_run, out_run = acc
+            lse_new = jnp.logaddexp(lse_run, lse_b)
+            out = (
+                out_run * jnp.exp(lse_run - lse_new)[..., None]
+                + o_b.astype(out_run.dtype)
+                * jnp.exp(lse_b - lse_new)[..., None]
+            )
+            return lse_new, out
+
+        # hop 0 is always the DIAGONAL block (K/V start local), so the
+        # kernel's own static causal flag handles intra-block masking —
+        # no [S_local, S_local] bias ever materializes, keeping the scan
+        # residuals at O(S_local·D) per hop
+        o0, lse0 = flash_attention_lse(
+            q, k, v, causal=causal, scale=scale, interpret=interpret,
+        )
+        acc0 = combine(
+            (jnp.full(q.shape[:3], -jnp.inf, jnp.float32),
+             jnp.zeros(q.shape, jnp.float32)),
+            lse0, o0,
+        )
+
+        def step(carry, _):
+            kv, src_idx, acc = carry
+            k_blk = lax.ppermute(kv[0], axis_name, perm)
+            v_blk = lax.ppermute(kv[1], axis_name, perm)
+            src_idx = lax.ppermute(src_idx, axis_name, perm)
+            o_b, lse_b = flash_attention_lse(
+                q, k_blk, v_blk, scale=scale, interpret=interpret,
+            )
+            if causal:
+                # off-diagonal hops are all-or-nothing: blocks from later
+                # positions are erased by zeroing their combine weight
+                lse_b = jnp.where(src_idx < my_idx, lse_b, -1e30)
+            acc = combine(acc, lse_b, o_b)
+            return ((k_blk, v_blk), src_idx, acc), None
+
+        carry0 = ((k, v), my_idx, acc0)
+        (_, _, (_lse, out)), _ = lax.scan(step, carry0, None, length=n - 1)
+        return out.astype(q.dtype)
 
     def step(carry, _):
         kv, src_idx, acc = carry
@@ -123,9 +180,11 @@ def _softmax(x):
     return e / jnp.sum(e, axis=-1, keepdims=True)
 
 
-def ring_attention_sharded(mesh, axis_name="sp"):
+def ring_attention_sharded(mesh, axis_name="sp", **kwargs):
     """Build a shard_map-wrapped ring attention over ``mesh``: takes GLOBAL
-    [B, H, S, D] arrays sharded on S and returns the global output."""
+    [B, H, S, D] arrays sharded on S and returns the global output.
+    ``kwargs`` (use_flash / interpret / scale) forward to
+    ``ring_attention``."""
     from jax.sharding import PartitionSpec as P
 
     from .mesh import shard_map as _shard_map
@@ -134,7 +193,7 @@ def ring_attention_sharded(mesh, axis_name="sp"):
 
     def fn(q, k, v, causal=False):
         inner = functools.partial(
-            ring_attention, axis_name=axis_name, causal=causal
+            ring_attention, axis_name=axis_name, causal=causal, **kwargs
         )
         return _shard_map(
             lambda a, b, c: inner(a, b, c),
