@@ -312,13 +312,6 @@ def _common_in_specs(pl, pltpu, geom, G, D):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash(q, k, v, key_bias, bias, causal, scale, interpret):
-    out, _lse = _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
-                                interpret)
-    return out
-
-
 def _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -360,16 +353,6 @@ def _no_bias(kernel):
     def wrapped(q_ref, k_ref, v_ref, key_bias_ref, *rest, **kw):
         return kernel(q_ref, k_ref, v_ref, key_bias_ref, None, *rest, **kw)
     return wrapped
-
-
-def _flash_fwd(q, k, v, key_bias, bias, causal, scale, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
-                               interpret)
-    return out, (q, k, v, key_bias, bias, out, lse)
-
-
-def _flash_bwd(causal, scale, interpret, res, g):
-    return _flash_bwd_core(causal, scale, interpret, res, g, None)
 
 
 def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
@@ -495,9 +478,6 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
     dv = dvf[:, :Sk, :].reshape(v.shape)
     dkey_bias = dkb[:, :Sk].astype(key_bias.dtype)
     return dq, dk, dv, dkey_bias, dbias
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))

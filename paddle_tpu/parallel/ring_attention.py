@@ -83,7 +83,6 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
 
-    neg = jnp.asarray(-1e9, q.dtype)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     if use_flash:
@@ -103,15 +102,13 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         # hop 0 is always the DIAGONAL block (K/V start local), so the
         # kernel's own static causal flag handles intra-block masking —
         # no [S_local, S_local] bias ever materializes, keeping the scan
-        # residuals at O(S_local·D) per hop
+        # residuals at O(S_local·D) per hop; it seeds the accumulator
+        # directly (combining into a (-inf, 0) identity would just burn
+        # an extra logaddexp/exp pass)
         o0, lse0 = flash_attention_lse(
             q, k, v, causal=causal, scale=scale, interpret=interpret,
         )
-        acc0 = combine(
-            (jnp.full(q.shape[:3], -jnp.inf, jnp.float32),
-             jnp.zeros(q.shape, jnp.float32)),
-            lse0, o0,
-        )
+        acc0 = (lse0, o0.astype(jnp.float32))
 
         def step(carry, _):
             kv, src_idx, acc = carry
@@ -131,6 +128,8 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         carry0 = ((k, v), my_idx, acc0)
         (_, _, (_lse, out)), _ = lax.scan(step, carry0, None, length=n - 1)
         return out.astype(q.dtype)
+
+    neg = jnp.asarray(-1e9, q.dtype)
 
     def step(carry, _):
         kv, src_idx, acc = carry

@@ -19,24 +19,41 @@ from __future__ import annotations
 from .mesh import shard_map
 
 
-def _attention(q, k, v, scale):
+def _attention(q, k, v, scale, causal=False):
     import jax
     import jax.numpy as jnp
 
     logits = jnp.einsum("bsnh,btnh->bnst", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bnst,btnh->bsnh", probs, v)
 
 
-def ulysses_attention(mesh, axis_name="sp"):
+def ulysses_attention(mesh, axis_name="sp", causal=False, use_flash=None,
+                      interpret=None):
     """Returns fn(q, k, v) for GLOBAL arrays [B, S, N, H] sharded on S over
-    ``axis_name``; computes exact full attention via two all_to_alls."""
+    ``axis_name``; computes exact full attention via two all_to_alls.
+
+    ``use_flash``: after the head-scatter each device holds the FULL
+    sequence for its head group, so the dense path materializes a
+    [B, N/sp, S, S] score tensor — the Pallas flash kernels (forward and
+    backward) keep it in VMEM instead. Default (None): flash on the TPU
+    backend, dense elsewhere; ``interpret`` forces the Pallas interpreter
+    for tests. ``causal`` masks by global position (exact, since the
+    sequence is whole on each device here)."""
+    import jax
     import jax.lax as lax
     from jax.sharding import PartitionSpec as P
 
     sp = mesh.shape[axis_name]
 
     def local_fn(q, k, v):
+        flash = use_flash
+        if flash is None:
+            flash = jax.default_backend() == "tpu" or bool(interpret)
         if q.shape[2] % sp != 0:
             raise ValueError(
                 "ulysses_attention: head count %d must divide by sp=%d"
@@ -56,13 +73,24 @@ def ulysses_attention(mesh, axis_name="sp"):
 
         qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
         scale = qh.shape[-1] ** -0.5
-        out = _attention(qh, kh, vh, scale)  # [B, S, N/sp, H]
+        if flash:
+            from ..kernels.flash_attention import flash_attention
+
+            # kernel layout is [B, N, S, D]
+            out = flash_attention(
+                qh.transpose(0, 2, 1, 3), kh.transpose(0, 2, 1, 3),
+                vh.transpose(0, 2, 1, 3), causal=causal, scale=scale,
+                interpret=interpret,
+            ).transpose(0, 2, 1, 3)
+        else:
+            out = _attention(qh, kh, vh, scale, causal)  # [B, S, N/sp, H]
         return gather_heads(out)  # [B, S/sp, N, H]
 
     spec = P(None, axis_name, None, None)
     return shard_map(
         local_fn, mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
+
 
 
 def reference_attention(q, k, v):
