@@ -1,14 +1,21 @@
 #!/bin/bash
-# Live-TPU-window playbook: the moment the axon tunnel answers, bank
-# everything a short window can give us:
-#   1. the full bench ladder (resnet 64->256->1024 + remat probe + BERT),
-#      which also leaves a warm persistent compile cache for the driver's
-#      end-of-round run;
-#   2. TPU cost/HLO census for both bench models (the PERF.md MFU inputs).
-# Everything runs with hard timeouts; partial results are kept.
+# Live-TPU-window playbook (round 5): the moment the axon tunnel answers,
+# bank everything a short window can give us. Normally the background
+# watcher (tools/tpu_watcher.py) runs this flow automatically; this script
+# is the manual/interactive equivalent.
+#   1. the full bench ladder (resnet 64->256->1024 + remat probe + BERT
+#      seq128 -> seq384 -> flash) — every TPU success is banked into
+#      BENCH_BANK.json with git sha + timestamp, and the run leaves a warm
+#      persistent compile cache for the driver's end-of-round run;
+#   2. a seq-384 flash-attention probe (runs AFTER the dense number is
+#      banked, so an untested kernel can never cost the headline);
+#   3. TPU cost/HLO census for both bench models (the PERF.md MFU inputs).
+# Everything runs with hard timeouts; partial results are kept and banked.
 set -u
 cd "$(dirname "$0")/.."
-OUT=MEASURED_r04
+# separate default dir from the watcher's MEASURED_r05 so a manual run
+# can never clobber (or get half-committed with) an automated window
+OUT=${OUT:-MEASURED_manual}
 mkdir -p "$OUT"
 stamp() { date -u +%H:%M:%S; }
 
@@ -19,24 +26,27 @@ rc=$?
 echo "$(stamp) bench rc=$rc ->" | tee -a "$OUT/log.txt"
 cat "$OUT/bench.json" | tee -a "$OUT/log.txt"
 
-# flash-attention probe: the fused Pallas kernel vs the banked dense
-# number (bank-best in bench.py does NOT see this; recorded separately)
-echo "$(stamp) bert flash-attention probe" | tee -a "$OUT/log.txt"
-BENCH_FLASH=1 BENCH_BUDGET_S=500 timeout 550 python bench_bert.py \
+# flash-attention probe at the defensible seq length (bank slot
+# bert_seq384_flash; bank-best means it can only improve the record)
+echo "$(stamp) bert seq-384 flash-attention probe" | tee -a "$OUT/log.txt"
+BENCH_BERT_SEQ=384 BENCH_FLASH=1 BENCH_BUDGET_S=500 timeout 550 \
+  python bench_bert.py \
   > "$OUT/bench_bert_flash.json" 2>> "$OUT/bench.log"
 rc=$?
 echo "$(stamp) flash probe rc=$rc ->" | tee -a "$OUT/log.txt"
 cat "$OUT/bench_bert_flash.json" | tee -a "$OUT/log.txt"
 
-for spec in "resnet 256" "bert 64" "bert 64 --flash 1"; do
+for spec in "hlo_resnet resnet 256" \
+            "hlo_bert bert 24 --seq 384" \
+            "hlo_bert_flash bert 24 --seq 384 --flash 1"; do
   set -- $spec
-  model=$1; batch=$2; shift 2
-  tag=$model${1:+_flash}
+  tag=$1; model=$2; batch=$3; shift 3
   echo "$(stamp) hlo_scan $tag b$batch" | tee -a "$OUT/log.txt"
-  timeout 700 python tools/hlo_scan.py --model "$model" --batch "$batch" "$@" \
-    > "$OUT/hlo_$tag.json" 2>> "$OUT/bench.log"
+  timeout 700 python tools/hlo_scan.py --model "$model" --batch "$batch" \
+    "$@" --out "$OUT/$tag.json" \
+    > /dev/null 2>> "$OUT/bench.log"
   rc=$?
   echo "$(stamp) hlo_scan $tag rc=$rc" | tee -a "$OUT/log.txt"
-  cat "$OUT/hlo_$tag.json" | tee -a "$OUT/log.txt"
+  cat "$OUT/$tag.json" 2>/dev/null | tee -a "$OUT/log.txt"
 done
-echo "$(stamp) live window playbook done" | tee -a "$OUT/log.txt"
+echo "$(stamp) live window playbook done — remember: git add BENCH_BANK.json $OUT && git commit" | tee -a "$OUT/log.txt"
