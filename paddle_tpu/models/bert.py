@@ -81,6 +81,18 @@ def mask_to_key_bias(mask):
     return b
 
 
+def flash_engages(cfg, key_bias):
+    """True when multi_head_attention will actually run the fused flash
+    path (vs the dense fallback). Model builders that skip constructing a
+    dense attention bias on the flash path MUST consult this — a silent
+    fallback without the dense bias would drop masking entirely."""
+    return bool(
+        getattr(cfg, "use_flash_attention", False)
+        and key_bias is not None
+        and (cfg.attention_dropout <= 0.0 or cfg.is_test)
+    )
+
+
 def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
                          causal=False):
     """Self/cross attention on [N, S, H] inputs.
@@ -105,11 +117,7 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     q = _split_heads(_proj(q_in, "q"))
     k = _split_heads(_proj(kv_in, "k"))
     v = _split_heads(_proj(kv_in, "v"))
-    use_flash = (
-        getattr(cfg, "use_flash_attention", False)
-        and key_bias is not None
-        and (cfg.attention_dropout <= 0.0 or cfg.is_test)
-    )
+    use_flash = flash_engages(cfg, key_bias)
     if (getattr(cfg, "use_flash_attention", False) and not use_flash
             and not getattr(cfg, "_warned_flash_fallback", False)):
         import warnings

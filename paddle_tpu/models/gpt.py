@@ -1,0 +1,205 @@
+"""Decoder-only causal language model (GPT-style).
+
+The reference era's language model is the PTB LSTM
+(reference: python/paddle/fluid/tests/book/test_rnn_encoder_decoder.py,
+and the word-language-model configs); a decoder-only transformer LM is
+the modern successor built from the SAME fluid pieces this repo already
+ships: embedding + the shared ``multi_head_attention`` (models/bert.py,
+with its fused flash-attention path) under the kernel's causal flag +
+post-LN residual FFN blocks + an (untied) LM softmax head.
+
+TPU-first notes: with ``cfg.use_flash_attention`` the causal mask rides
+the Pallas kernel's static flag (no [T, T] bias tensor is built), the
+whole step compiles to one XLA computation, and long-context training
+composes with the sequence-parallel machinery (parallel/ring_attention
+runs the same kernels per ring hop).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from . import bert as _bert
+
+
+class GPTConfig(object):
+    def __init__(self, vocab_size=50257, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072,
+                 max_position_embeddings=1024, hidden_dropout=0.1,
+                 attention_dropout=0.1, is_test=False,
+                 use_flash_attention=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.is_test = is_test
+        self.use_flash_attention = use_flash_attention
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 211)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(**kw)
+
+
+def _causal_bias(seq_len):
+    """[1, T, T] additive bias (0 attendable / -1e4 future) for the dense
+    path; the flash path masks inside the kernel instead."""
+    tri = np.tril(np.ones((1, seq_len, seq_len), np.float32))
+    bias = fluid.layers.assign((tri - 1.0) * 1e4)
+    bias = fluid.layers.unsqueeze(bias, axes=[1])  # [1, 1, T, T]
+    bias.stop_gradient = True
+    return bias
+
+
+def gpt_decoder(ids, pos_ids, input_mask, cfg):
+    """Decoder stack on [N, T, 1] int64 ids; returns hidden [N, T, H]."""
+    emb = fluid.layers.embedding(
+        input=ids, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(name="tok_embedding"),
+    )
+    pos = fluid.layers.embedding(
+        input=pos_ids, size=[cfg.max_position_embeddings, cfg.hidden_size],
+        param_attr=fluid.ParamAttr(name="pos_embedding"),
+    )
+    h = fluid.layers.elementwise_add(emb, pos)
+    h = _bert._dropout(h, cfg.hidden_dropout, cfg.is_test)
+
+    key_bias = None
+    attn_bias = None
+    if getattr(cfg, "use_flash_attention", False):
+        # padding as a key-only bias; causality rides the kernel flag
+        key_bias = _bert.mask_to_key_bias(input_mask)
+    if not _bert.flash_engages(cfg, key_bias):
+        # dense path: causal [1,1,T,T] + key padding [N,1,1,T] broadcast.
+        # Built whenever the shared attention helper would take its dense
+        # branch — INCLUDING the dropout-driven flash fallback, which
+        # would otherwise run with neither mask (acausal LM)
+        pad = fluid.layers.scale(
+            fluid.layers.reshape(input_mask, shape=[0, 1, 1, -1]),
+            scale=1e4, bias=-1e4,
+        )
+        pad.stop_gradient = True
+        attn_bias = fluid.layers.elementwise_add(
+            _causal_bias(ids.shape[1]), pad
+        )
+    for i in range(cfg.num_layers):
+        name = "gpt_%d" % i
+        attn = _bert.multi_head_attention(
+            h, h, attn_bias, cfg, name + "_att", key_bias=key_bias,
+            causal=True,
+        )
+        attn = _bert._dropout(attn, cfg.hidden_dropout, cfg.is_test)
+        h = fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(h, attn), begin_norm_axis=2,
+            name=name + "_ln1",
+        )
+        ff = _bert._dropout(
+            _bert._ffn(h, cfg, name + "_ffn"), cfg.hidden_dropout,
+            cfg.is_test,
+        )
+        h = fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(h, ff), begin_norm_axis=2,
+            name=name + "_ln2",
+        )
+    return h
+
+
+def gpt_lm_logits(ids, pos_ids, input_mask, cfg):
+    """[N, T, vocab] next-token logits."""
+    h = gpt_decoder(ids, pos_ids, input_mask, cfg)
+    return fluid.layers.fc(
+        input=h, size=cfg.vocab_size, num_flatten_dims=2, name="lm_head"
+    )
+
+
+def build_gpt_lm_train(cfg, seq_len, learning_rate=3e-4, use_amp=False):
+    """Next-token LM training graph: positions t predict tokens t+1,
+    padded positions masked out of the loss.
+
+    Returns (main, startup, feeds, avg_loss)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[seq_len, 1],
+                                dtype="int64")
+        pos_ids = fluid.layers.data(name="pos_ids", shape=[seq_len, 1],
+                                    dtype="int64")
+        input_mask = fluid.layers.data(
+            name="input_mask", shape=[seq_len, 1], dtype="float32"
+        )
+        logits = gpt_lm_logits(ids, pos_ids, input_mask, cfg)
+        # shift: logits[:, :-1] predict ids[:, 1:]
+        pred = fluid.layers.slice(logits, axes=[1], starts=[0],
+                                  ends=[seq_len - 1])
+        tgt = fluid.layers.slice(ids, axes=[1], starts=[1], ends=[seq_len])
+        loss = fluid.layers.softmax_with_cross_entropy(pred, tgt)
+        # mask the loss at padded TARGET positions
+        tgt_mask = fluid.layers.slice(input_mask, axes=[1], starts=[1],
+                                      ends=[seq_len])
+        loss = fluid.layers.elementwise_mul(loss, tgt_mask)
+        denom = fluid.layers.reduce_sum(tgt_mask)
+        avg_loss = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(loss), denom
+        )
+        opt = fluid.optimizer.Adam(learning_rate=learning_rate)
+        if use_amp:
+            from paddle_tpu.fluid.contrib import mixed_precision as _mp
+
+            opt = _mp.decorate(opt)
+        opt.minimize(avg_loss)
+    feeds = [ids, pos_ids, input_mask]
+    return main, startup, feeds, avg_loss
+
+
+def build_gpt_infer(cfg, seq_len):
+    """Inference graph (is_test semantics): returns (main, startup,
+    feed names, logits). The caller's config is not mutated."""
+    import copy
+
+    cfg = copy.copy(cfg)
+    cfg.is_test = True
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[seq_len, 1],
+                                dtype="int64")
+        pos_ids = fluid.layers.data(name="pos_ids", shape=[seq_len, 1],
+                                    dtype="int64")
+        input_mask = fluid.layers.data(
+            name="input_mask", shape=[seq_len, 1], dtype="float32"
+        )
+        logits = gpt_lm_logits(ids, pos_ids, input_mask, cfg)
+    return main, startup, ["ids", "pos_ids", "input_mask"], logits
+
+
+def greedy_generate(exe, infer_prog, logits_var, cfg, prompt_ids, max_len,
+                    scope=None):
+    """Host-driven greedy decode with a fixed-shape graph: the causal
+    mask makes positions >= the current length irrelevant, so one
+    compiled [1, max_len] program serves every step (the XLA-friendly
+    static-shape idiom; the NMT model's beam search is the batched
+    in-graph variant)."""
+    ids = list(prompt_ids)
+    for _ in range(max_len - len(prompt_ids)):
+        cur = len(ids)
+        padded = np.zeros((1, max_len, 1), "int64")
+        padded[0, :cur, 0] = ids
+        feed = {
+            "ids": padded,
+            "pos_ids": np.arange(max_len).reshape(1, max_len, 1)
+            .astype("int64"),
+            "input_mask": (np.arange(max_len) < cur)
+            .astype("float32").reshape(1, max_len, 1),
+        }
+        (lv,) = exe.run(infer_prog, feed=feed, fetch_list=[logits_var],
+                        scope=scope)
+        nxt = int(np.asarray(lv)[0, cur - 1].argmax())
+        ids.append(nxt)
+    return ids
