@@ -68,7 +68,8 @@ def _ln(x, g, b):
     return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
 
 
-def _block_fwd(params, ids, labels, heads_local, head_dim, causal=True):
+def _block_fwd(params, ids, labels, heads_local, head_dim, causal=True,
+               use_flash=None, interpret=None):
     """Per-shard forward; runs INSIDE shard_map.
 
     ids/labels: [B_local, S_local] int32. Params arrive as their LOCAL
@@ -91,8 +92,10 @@ def _block_fwd(params, ids, labels, heads_local, head_dim, causal=True):
     q = split_heads(h @ params["wq"])
     k = split_heads(h @ params["wk"])
     v = split_heads(h @ params["wv"])
-    # context parallelism: sequence is sharded over "sp"
-    attn = ring_attention(q, k, v, axis_name="sp", causal=causal)
+    # context parallelism: sequence is sharded over "sp"; with
+    # use_flash the per-hop blocks run through the Pallas kernels
+    attn = ring_attention(q, k, v, axis_name="sp", causal=causal,
+                          use_flash=use_flash, interpret=interpret)
     attn = jnp.moveaxis(attn, 1, 2).reshape(B, S, heads_local * head_dim)
     # row-parallel out-projection: partial products summed over "model"
     proj = lax.psum(attn @ params["wo"], "model")
@@ -116,7 +119,7 @@ def _block_fwd(params, ids, labels, heads_local, head_dim, causal=True):
 
 
 def build_train_step(mesh, vocab=64, embed=32, heads=4, head_dim=8, ffn=64,
-                     lr=0.1, causal=True):
+                     lr=0.1, causal=True, use_flash=None, interpret=None):
     """-> (jitted_step, sharded_params): ``step(params, ids, labels) ->
     (loss, new_params)`` with dp/tp/sp shardings baked in."""
     import jax
@@ -139,7 +142,7 @@ def build_train_step(mesh, vocab=64, embed=32, heads=4, head_dim=8, ffn=64,
     fwd = _shard_map(
         functools.partial(
             _block_fwd, heads_local=heads_local, head_dim=head_dim,
-            causal=causal,
+            causal=causal, use_flash=use_flash, interpret=interpret,
         ),
         mesh,
         (param_spec_tree, data_spec, data_spec),

@@ -47,6 +47,24 @@ def test_ring_attention_grads_match():
     assert float(jnp.max(jnp.abs(g_ring - g_full))) < 2e-5
 
 
+def _run_transformer_steps(d, m, sp, **kw):
+    """3 training steps on a (data=d, model=m, sp=sp) mesh with the
+    shared fixed batch; -> (loss, params as numpy)."""
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 64, (8, 16)).astype("int32")
+    labels = rs.randint(0, 64, (8, 16)).astype("int32")
+    mesh = build_mesh(
+        {"data": d, "model": m, "sp": sp},
+        devices=jax.devices("cpu")[: d * m * sp],
+    )
+    step, params = st.build_train_step(mesh, lr=0.5, **kw)
+    for _ in range(3):
+        loss, params = step(params, ids, labels)
+    return float(np.asarray(loss)), {
+        k: np.asarray(v) for k, v in params.items()
+    }
+
+
 @pytest.mark.parametrize(
     "shape", [(2, 2, 2), (2, 1, 4), (1, 2, 4), (8, 1, 1), (1, 1, 8)]
 )
@@ -54,26 +72,25 @@ def test_spmd_transformer_parity(shape):
     """dp x tp x sp training step produces the same params as single
     device — the loss-parity methodology of test_dist_base.py:891 applied
     to every mesh factorization."""
-    rs = np.random.RandomState(0)
-    ids = rs.randint(0, 64, (8, 16)).astype("int32")
-    labels = rs.randint(0, 64, (8, 16)).astype("int32")
-
-    def run(d, m, sp):
-        mesh = build_mesh(
-            {"data": d, "model": m, "sp": sp},
-            devices=jax.devices("cpu")[: d * m * sp],
-        )
-        step, params = st.build_train_step(mesh, lr=0.5)
-        for _ in range(3):
-            loss, params = step(params, ids, labels)
-        return float(np.asarray(loss)), {
-            k: np.asarray(v) for k, v in params.items()
-        }
-
-    base_loss, base = run(1, 1, 1)
-    loss, got = run(*shape)
+    base_loss, base = _run_transformer_steps(1, 1, 1)
+    loss, got = _run_transformer_steps(*shape)
     assert abs(loss - base_loss) < 1e-5, (loss, base_loss)
     for k in base:
         np.testing.assert_allclose(
             got[k], base[k], rtol=1e-3, atol=1e-6, err_msg=k
+        )
+
+
+def test_spmd_transformer_flash_ring_parity():
+    """Full dp x tp x sp TRAINING STEP with ring attention running
+    through the Pallas flash kernels (interpret): params after 3 steps
+    match the dense single-device run — kernels inside shard_map + scan
+    + psum, forward and backward."""
+    base_loss, base = _run_transformer_steps(1, 1, 1, use_flash=False)
+    loss, got = _run_transformer_steps(2, 1, 4, use_flash=True,
+                                       interpret=True)
+    assert abs(loss - base_loss) < 1e-4, (loss, base_loss)
+    for k in base:
+        np.testing.assert_allclose(
+            got[k], base[k], rtol=2e-3, atol=1e-5, err_msg=k
         )
