@@ -1,0 +1,114 @@
+"""TPU window watcher (tools/tpu_watcher.py) gating logic: goal
+tracking, playbook step skipping, and lifetime capping — with subprocess
+spawning stubbed out (the real thing needs a live tunnel)."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def watcher(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_BANK_PATH", str(tmp_path / "bank.json"))
+    monkeypatch.setenv("WATCH_OUT", str(tmp_path / "out"))
+    monkeypatch.syspath_prepend(os.path.join(ROOT, "tools"))
+    monkeypatch.syspath_prepend(ROOT)
+    import bench
+    importlib.reload(bench)
+    import tpu_watcher
+    tpu_watcher = importlib.reload(tpu_watcher)
+    os.makedirs(tpu_watcher.OUT, exist_ok=True)
+    yield tpu_watcher
+    monkeypatch.delenv("BENCH_BANK_PATH", raising=False)
+    importlib.reload(bench)
+
+
+def _bank(watcher, slots):
+    data = {}
+    for slot in slots:
+        data[slot] = {"value": 100.0, "device": "tpu",
+                      "batch": 256 if slot.startswith("resnet") else 24,
+                      "seq_len": 384}
+    with open(watcher.bench.BANK_PATH, "w") as f:
+        json.dump(data, f)
+
+
+def _touch_hlo(watcher, names):
+    for n in names:
+        with open(os.path.join(watcher.OUT, n + ".json"), "w") as f:
+            f.write("{}\n")
+
+
+def test_goals_state_tracks_bank_and_hlo(watcher):
+    g = watcher.goals_state()
+    assert not any(g.values())
+    _bank(watcher, ["resnet50", "bert_seq384", "bert_seq384_flash"])
+    _touch_hlo(watcher, watcher.HLO_GOALS)
+    g = watcher.goals_state()
+    assert g["resnet"] and g["resnet_big"] and g["bert384"]
+    assert g["bert384_flash"] and g["hlo"]
+
+
+def test_goals_resnet_big_requires_batch_256(watcher):
+    with open(watcher.bench.BANK_PATH, "w") as f:
+        json.dump({"resnet50": {"value": 1.0, "device": "tpu",
+                                "batch": 64}}, f)
+    g = watcher.goals_state()
+    assert g["resnet"] and not g["resnet_big"]
+
+
+def test_playbook_skips_banked_steps_and_caps_deadline(watcher, monkeypatch):
+    """With every bench goal banked, the playbook must not launch the
+    bench ladder; with hlo files present it must launch nothing at all;
+    a step whose remaining lifetime is too small is skipped."""
+    calls = []
+
+    def fake_run(cmd, timeout, env=None, log_name=None):
+        calls.append((list(cmd), timeout))
+        return 0, ""
+
+    monkeypatch.setattr(watcher, "run_killable", fake_run)
+    monkeypatch.setattr(watcher, "commit_if_changed", lambda msg: None)
+    with open(watcher.bench.BANK_PATH, "w") as f:
+        json.dump({
+            "resnet50": {"value": 1.0, "device": "tpu", "batch": 256},
+            "bert_seq384": {"value": 1.0, "device": "tpu"},
+            "bert_seq384_flash": {"value": 2.0, "device": "tpu"},
+        }, f)
+    _touch_hlo(watcher, watcher.HLO_GOALS)
+
+    import time
+    done = watcher.playbook(deadline=time.time() + 10_000)
+    assert done is True
+    assert calls == []  # nothing left to measure -> nothing launched
+
+    # remove one hlo artifact: exactly one scan should launch, with its
+    # timeout capped by the (short) remaining lifetime
+    os.remove(os.path.join(watcher.OUT, "hlo_bert.json"))
+    done = watcher.playbook(deadline=time.time() + 300)
+    assert done is False  # the stub never writes the artifact
+    assert len(calls) == 1
+    cmd, timeout = calls[0]
+    assert "tools/hlo_scan.py" in " ".join(cmd)
+    assert timeout <= 300  # capped at the lifetime remainder, not 700
+
+
+def test_playbook_runs_ladder_when_goal_missing(watcher, monkeypatch):
+    calls = []
+
+    def fake_run(cmd, timeout, env=None, log_name=None):
+        calls.append(" ".join(cmd))
+        return 0, ""
+
+    monkeypatch.setattr(watcher, "run_killable", fake_run)
+    monkeypatch.setattr(watcher, "commit_if_changed", lambda msg: None)
+    _touch_hlo(watcher, watcher.HLO_GOALS)
+
+    import time
+    watcher.playbook(deadline=time.time() + 10_000)
+    assert any("bench.py" in c for c in calls)
