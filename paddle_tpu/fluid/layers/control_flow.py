@@ -17,6 +17,7 @@ from ..layer_helper import LayerHelper
 from .tensor import fill_constant
 
 __all__ = [
+    "Print",
     "While",
     "Switch",
     "StaticRNN",
@@ -647,5 +648,38 @@ def shrink_memory(x, i, table):
         type="shrink_rnn_memory",
         inputs={"X": [x], "I": [i], "RankTable": [table]},
         outputs={"Out": [out]},
+    )
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor whenever it is computed (reference:
+    layers/control_flow.py:191 Print over print_op.cc). Returns a NEW
+    output variable — downstream code must consume the output so the
+    print op stays on the path (and its identity gradient keeps backward
+    intact, per the reference's note)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable(
+        name=unique_name.generate("print"),
+        dtype=input.dtype,
+        shape=list(input.shape),
+    )
+    helper.append_op(
+        type="print",
+        inputs={"In": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "first_n": first_n,
+            "message": message or "",
+            "summarize": summarize,
+            "print_tensor_name": print_tensor_name,
+            "print_tensor_type": print_tensor_type,
+            "print_tensor_shape": print_tensor_shape,
+            "print_tensor_lod": print_tensor_lod,
+            "print_phase": print_phase,
+        },
     )
     return out

@@ -224,17 +224,65 @@ register_op("save_combine", lower=_save_combine_lower, host=True)
 register_op("load_combine", lower=_load_combine_lower, host=True)
 
 
+# per-op forward-print counters for the first_n rate limit; keyed by op
+# object identity (op descs live as long as their Program)
+_PRINT_COUNTS = {}
+
+
 def _print_lower(ctx, op_):
     name = op_.input("In")[0] if op_.input("In") else op_.input("X")[0]
     value = ctx.scope.get(name)
-    message = op_.attr("message", "")
-    print("%s %s %s" % (message, name, np.asarray(value)))
+    phase = op_.attr("print_phase", "both") or "both"
+    is_grad = bool(op_.attr("is_grad_print", False))
+    # phase gate: the forward instance prints activations, the grad
+    # instance (emitted by the grad maker) prints gradients
+    should = phase == "both" or phase == ("backward" if is_grad else "forward")
+    first_n = int(op_.attr("first_n", -1))
+    if should and first_n >= 0:
+        seen = _PRINT_COUNTS.get(id(op_), 0)
+        _PRINT_COUNTS[id(op_)] = seen + 1
+        should = seen < first_n
+    if should:
+        message = op_.attr("message", "")
+        summarize = int(op_.attr("summarize", 20))
+        arr = np.asarray(value)
+        shown = arr.ravel()[:summarize] if summarize >= 0 else arr
+        parts = [message] if message else []
+        if is_grad:
+            parts.append("(grad)")
+        if op_.attr("print_tensor_name", True):
+            parts.append(name)
+        if op_.attr("print_tensor_type", True):
+            parts.append(str(arr.dtype))
+        if op_.attr("print_tensor_shape", True):
+            parts.append(str(list(arr.shape)))
+        parts.append(str(shown))
+        print(" ".join(parts))
     out_names = op_.output("Out")
     if out_names:
         ctx.scope.set(out_names[0], value)
 
 
-register_op("print", lower=_print_lower, host=True)
+def _print_grad_maker(op_):
+    """The grad of print is another print (reference: print_op.cc
+    PrintOpGradientMaker): it forwards the gradient unchanged (identity)
+    and prints it when print_phase is 'backward'/'both'."""
+    outs = op_.output("Out")
+    ins = op_.input("In") or op_.input("X")  # legacy 'X'-slot programs
+    if not outs or not ins:
+        return []
+    attrs = dict(op_.attrs)
+    attrs["is_grad_print"] = True
+    return [dict(
+        type="print",
+        inputs={"In": [outs[0] + "@GRAD"]},
+        outputs={"Out": [ins[0] + "@GRAD"]},
+        attrs=attrs,
+    )]
+
+
+register_op("print", lower=_print_lower, host=True,
+            grad=_print_grad_maker)
 
 
 def _feed_noop(ctx, op_):

@@ -250,3 +250,61 @@ def test_detection_map_evaluator_accumulates_in_graph():
         _c, acc3 = exe.run(main, feed=perfect,
                            fetch_list=[cur_var, accum_var])
         np.testing.assert_allclose(float(np.asarray(acc3).ravel()[0]), 1.0)
+
+
+def test_print_layer_passthrough_and_backward():
+    """fluid.layers.Print (reference control_flow.py:191): prints on
+    forward, passes the value through, and its identity gradient keeps
+    training intact."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=3)
+        h = fluid.layers.Print(h, message="dbg:", summarize=2)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    xb = np.random.RandomState(0).rand(2, 4).astype("float32")
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("fc_0.w_0")).copy()
+        (l,) = exe.run(main, feed={"x": xb}, fetch_list=[loss])
+        w1 = np.asarray(scope.get("fc_0.w_0"))
+    assert np.isfinite(float(np.asarray(l).ravel()[0]))
+    # gradient flowed THROUGH the print op into the fc weight
+    assert not np.allclose(w0, w1)
+
+
+def test_print_layer_first_n_and_phase(capsys):
+    """first_n rate-limits the forward prints; print_phase='backward'
+    prints only the gradient (the grad op IS another print)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        h = fluid.layers.fc(input=x, size=2)
+        h = fluid.layers.Print(h, message="fwd:", first_n=2)
+        g = fluid.layers.Print(h, message="bwd:", print_phase="backward")
+        loss = fluid.layers.mean(g)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    xb = np.random.RandomState(0).rand(2, 3).astype("float32")
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        capsys.readouterr()
+        for _ in range(4):
+            exe.run(main, feed={"x": xb}, fetch_list=[loss])
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    fwd_act = [l for l in lines if l.startswith("fwd:") and "(grad)" not in l]
+    fwd_grad = [l for l in lines if l.startswith("fwd: (grad)")]
+    bwd_grad = [l for l in lines if l.startswith("bwd: (grad)")]
+    bwd_act = [l for l in lines if l.startswith("bwd:") and "(grad)" not in l]
+    assert len(fwd_act) == 2   # forward prints rate-limited by first_n
+    assert len(fwd_grad) == 2  # phase 'both': grad instance prints too,
+                               # with its own first_n budget
+    assert len(bwd_grad) == 4  # 'backward' phase: gradient every step
+    assert len(bwd_act) == 0   # ...and never the activation

@@ -435,6 +435,8 @@ case("fused_embedding_seq_pool",
      inputs={"W": U(211, (8, 4)), "Ids": I(212, (2, 3), 0, 8)},
      outputs={"Out": Z(2, 4)}, check=["W"],
      attrs={"padding_idx": -1, "combiner": "sum"}, max_elements=32)
+case("print", inputs={"In": U(215, (3, 4))}, outputs={"Out": Z(3, 4)},
+     attrs={"message": "", "summarize": 2}, check=["In"])
 case("fusion_seqpool_concat",
      inputs={"X": [("fsp0", U(213, (2, 3, 4))), ("fsp1", U(214, (2, 3, 2)))]},
      outputs={"Out": Z(2, 6)}, attrs={"pooltype": "SUM", "axis": 1},
