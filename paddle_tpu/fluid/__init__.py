@@ -70,6 +70,20 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import evaluator
 from . import average
+from .data import data
+from . import input
+from .input import embedding, one_hot
+from .io import (
+    save,
+    load,
+    load_program_state,
+    set_program_state,
+)
+from .dygraph.checkpoint import save_dygraph, load_dygraph
+from .transpiler import memory_optimize, release_memory
+from .incubate import fleet
+from .incubate import data_generator
+from .layers.math_op_patch import monkey_patch_variable
 from . import lod_tensor
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import install_check
@@ -115,6 +129,21 @@ __all__ = [
     "create_lod_tensor",
     "create_random_int_lodtensor",
     "install_check",
+    "data",
+    "input",
+    "embedding",
+    "one_hot",
+    "save",
+    "load",
+    "load_program_state",
+    "set_program_state",
+    "save_dygraph",
+    "load_dygraph",
+    "memory_optimize",
+    "release_memory",
+    "fleet",
+    "data_generator",
+    "monkey_patch_variable",
     "graphviz",
     "net_drawer",
     "append_backward",

@@ -1,0 +1,19 @@
+"""The v1.6 "new data API" (reference: python/paddle/fluid/data.py:24
+fluid.data) — like layers.data but the given shape is the FULL tensor
+shape (no implicit batch dim is prepended; use -1 for unknown dims)."""
+
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return layers.data(
+        name=name,
+        shape=list(shape),
+        append_batch_size=False,
+        dtype=dtype,
+        lod_level=lod_level,
+    )

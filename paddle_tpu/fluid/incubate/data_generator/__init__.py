@@ -1,0 +1,164 @@
+"""User-defined dataset generators emitting the MultiSlot text format
+(reference: python/paddle/fluid/incubate/data_generator/__init__.py —
+DataGenerator/MultiSlotDataGenerator/MultiSlotStringDataGenerator).
+
+The emitted lines are exactly what the native MultiSlot parser
+(csrc/paddle_tpu_native.cpp) and fluid.DatasetFactory datasets consume:
+``<num> v1 v2 ... <num> v1 ...`` per line, slots in declaration order.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = [
+    "DataGenerator",
+    "MultiSlotDataGenerator",
+    "MultiSlotStringDataGenerator",
+]
+
+
+class DataGenerator(object):
+    """Subclass and override ``generate_sample(line)`` (returning an
+    iterator of per-sample slot lists) and optionally
+    ``generate_batch(samples)``; run_from_stdin/run_from_memory drive it
+    the way the fleet trainers did."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit):
+        assert isinstance(line_limit, int) and line_limit > 0
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def run_from_memory(self):
+        """Generate from memory (no input lines); writes the formatted
+        samples to stdout."""
+        batch_samples = []
+        line_iter = self.generate_sample(None)
+        for user_parsed_line in line_iter():
+            if user_parsed_line is None:
+                continue
+            batch_samples.append(user_parsed_line)
+            if len(batch_samples) == self.batch_size_:
+                batch_iter = self.generate_batch(batch_samples)
+                for sample in batch_iter():
+                    sys.stdout.write(self._gen_str(sample))
+                batch_samples = []
+        if len(batch_samples) > 0:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_stdin(self):
+        """Process stdin line by line through generate_sample/
+        generate_batch, writing formatted samples to stdout."""
+        batch_samples = []
+        processed = 0
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    batch_iter = self.generate_batch(batch_samples)
+                    for sample in batch_iter():
+                        sys.stdout.write(self._gen_str(sample))
+                    batch_samples = []
+            processed += 1
+            if self._line_limit and processed >= self._line_limit:
+                break
+        if len(batch_samples) > 0:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "generate_sample(line) must be implemented by the subclass"
+        )
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or MultiSlotStringDataGenerator"
+        )
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [str, ...]), ...] -> "<num> v1 v2 ... <num> ...\\n"."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type, "
+                "Examples: [('words', ['1926', '08', '17']), "
+                "('label', ['1'])]"
+            )
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [feasign, ...]), ...] with int/float feasigns; also
+        records per-slot types in _proto_info and enforces consistency
+        across lines (the reference's contract)."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type, "
+                "Examples: [('words', [1926, 8, 17]), ('label', [1])]"
+            )
+        if self._proto_info is None:
+            self._proto_info = []
+            first = True
+        else:
+            first = False
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    "the complete field set of two given line are "
+                    "inconsistent."
+                )
+        parts = []
+        for i, (name, elements) in enumerate(line):
+            if not elements:
+                raise ValueError(
+                    "the elements of each field can not be empty, please "
+                    "check if the slot %s is valid" % name
+                )
+            slot_type = "int64"
+            for e in elements:
+                if isinstance(e, float):
+                    slot_type = "float"
+                elif not isinstance(e, int):
+                    raise ValueError(
+                        "the type of element %r is not int or float" % (e,)
+                    )
+            if first:
+                self._proto_info.append((name, slot_type))
+            else:
+                exp_name, exp_type = self._proto_info[i]
+                if name != exp_name:
+                    raise ValueError(
+                        "the field name of two given line are not match: "
+                        "require<%s>, get<%s>." % (exp_name, name)
+                    )
+                if slot_type == "float" and exp_type == "int64":
+                    self._proto_info[i] = (name, "float")
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"

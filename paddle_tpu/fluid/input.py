@@ -1,0 +1,51 @@
+"""v1.6 "new input API" (reference: python/paddle/fluid/input.py) —
+``fluid.embedding`` / ``fluid.one_hot``: the relaxed-shape successors of
+the layers.* functions (no trailing [*, 1] dim required; the v2 op
+variants append the new dimension instead)."""
+
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+__all__ = ["embedding", "one_hot"]
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """[*] int ids -> [*, depth] one-hot (reference input.py:24 over
+    one_hot_v2_op.cc)."""
+    helper = LayerHelper("one_hot_v2")
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="one_hot_v2",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"depth": depth, "allow_out_of_range": allow_out_of_range},
+    )
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """[*] int ids -> [*, size[1]] embeddings (reference input.py:126 over
+    lookup_table_v2_op.cc; appends the emb dim to the input shape)."""
+    helper = LayerHelper("embedding", **locals())
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (
+        -1 if padding_idx is None
+        else padding_idx if padding_idx >= 0
+        else (size[0] + padding_idx)
+    )
+    helper.append_op(
+        type="lookup_table_v2",
+        inputs={"Ids": [input], "W": [w]},
+        outputs={"Out": [out]},
+        attrs={
+            "is_sparse": is_sparse,
+            "is_distributed": is_distributed,
+            "padding_idx": padding_idx,
+        },
+    )
+    return out

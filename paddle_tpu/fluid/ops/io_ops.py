@@ -224,11 +224,6 @@ register_op("save_combine", lower=_save_combine_lower, host=True)
 register_op("load_combine", lower=_load_combine_lower, host=True)
 
 
-# per-op forward-print counters for the first_n rate limit; keyed by op
-# object identity (op descs live as long as their Program)
-_PRINT_COUNTS = {}
-
-
 def _print_lower(ctx, op_):
     name = op_.input("In")[0] if op_.input("In") else op_.input("X")[0]
     value = ctx.scope.get(name)
@@ -239,8 +234,10 @@ def _print_lower(ctx, op_):
     should = phase == "both" or phase == ("backward" if is_grad else "forward")
     first_n = int(op_.attr("first_n", -1))
     if should and first_n >= 0:
-        seen = _PRINT_COUNTS.get(id(op_), 0)
-        _PRINT_COUNTS[id(op_)] = seen + 1
+        # counter lives ON the op object: no global dict to leak, and a
+        # recycled id() can never inherit another op's budget
+        seen = getattr(op_, "_print_seen", 0)
+        op_._print_seen = seen + 1
         should = seen < first_n
     if should:
         message = op_.attr("message", "")

@@ -308,3 +308,85 @@ def test_print_layer_first_n_and_phase(capsys):
                                # with its own first_n budget
     assert len(bwd_grad) == 4  # 'backward' phase: gradient every step
     assert len(bwd_act) == 0   # ...and never the activation
+
+
+# -- v1.6 top-level "new API" surface ----------------------------------------
+
+
+def test_fluid_data_full_shape():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data(name="nx", shape=[-1, 7], dtype="float32")
+    assert tuple(x.shape) == (-1, 7)  # no implicit batch dim prepended
+
+
+def test_fluid_embedding_and_one_hot_relaxed_shapes():
+    """fluid.embedding / fluid.one_hot (input.py v2 APIs): no trailing
+    [*, 1] dim; the new dimension is appended."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.data(name="vids", shape=[-1, 3], dtype="int64")
+        emb = fluid.embedding(ids, size=[10, 4])
+        oh = fluid.one_hot(ids, depth=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        ev, ov = exe.run(
+            main,
+            feed={"vids": np.array([[1, 2, 9], [0, 1, 2]], "int64")},
+            fetch_list=[emb, oh],
+        )
+    assert np.asarray(ev).shape == (2, 3, 4)
+    ov = np.asarray(ov)
+    assert ov.shape == (2, 3, 10)
+    assert ov[0, 2, 9] == 1.0 and ov[0, 2].sum() == 1.0
+
+
+def test_fluid_save_load_program_state_roundtrip(tmp_path):
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.data(name="sx", shape=[-1, 4], dtype="float32")
+            fluid.layers.fc(input=x, size=2)
+        return main, startup
+
+    main, startup = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        w = np.asarray(scope.get("fc_0.w_0")).copy()
+        fluid.save(main, str(tmp_path / "m"))
+
+    state = fluid.load_program_state(str(tmp_path / "m"))
+    assert "fc_0.w_0" in state
+    main2, startup2 = build()
+    scope2 = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(startup2)
+        fluid.set_program_state(main2, state)
+        np.testing.assert_array_equal(
+            np.asarray(scope2.get("fc_0.w_0")), w)
+
+
+def test_multislot_data_generators(capsys):
+    gen = fluid.data_generator.MultiSlotDataGenerator()
+
+    def sample_gen(line):
+        def it():
+            yield [("words", [1926, 8, 17]), ("label", [1])]
+            yield [("words", [3]), ("label", [0])]
+        return it
+
+    gen.generate_sample = sample_gen
+    gen.set_batch(2)
+    gen.run_from_memory()
+    out = capsys.readouterr().out.splitlines()
+    assert out == ["3 1926 8 17 1 1", "1 3 1 0"]
+
+    sgen = fluid.data_generator.MultiSlotStringDataGenerator()
+    assert sgen._gen_str([("w", ["a", "b"]), ("l", ["1"])]) == "2 a b 1 1\n"
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        gen._gen_str([("words", [1.5, 2]), ])  # slot count mismatch
