@@ -12,7 +12,12 @@ __all__ = ["embedding", "one_hot"]
 
 def one_hot(input, depth, allow_out_of_range=False):
     """[*] int ids -> [*, depth] one-hot (reference input.py:24 over
-    one_hot_v2_op.cc)."""
+    one_hot_v2_op.cc).
+
+    Divergence note: with allow_out_of_range=False the eager reference
+    RAISES on ids outside [0, depth); a jitted XLA computation cannot
+    raise data-dependent errors, so out-of-range ids produce all-zero
+    rows in both modes here (the allow_out_of_range=True behavior)."""
     helper = LayerHelper("one_hot_v2")
     out = helper.create_variable_for_type_inference(dtype="float32")
     helper.append_op(

@@ -1,0 +1,692 @@
+"""Layer-DSL tail: v1.6 layer callables whose OPS already exist in the
+registry but had no `fluid.layers.*` wrapper (reference:
+python/paddle/fluid/layers/nn.py, detection.py, tensor.py — signatures
+mirrored; each docstring cites the reference definition).
+
+Compositions (detection_output, dice_loss, mse_loss, ...) are built the
+same way the reference builds them — from the same public layers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..initializer import Xavier
+from ..framework import Variable
+
+__all__ = [
+    "add_position_encoding",
+    "bilinear_tensor_product",
+    "box_decoder_and_assign",
+    "collect_fpn_proposals",
+    "continuous_value_model",
+    "ctc_greedy_decoder",
+    "deformable_conv",
+    "deformable_roi_pooling",
+    "detection_output",
+    "dice_loss",
+    "distribute_fpn_proposals",
+    "eye",
+    "filter_by_instag",
+    "fsp_matrix",
+    "gather_tree",
+    "gaussian_random_batch_size_like",
+    "get_tensor_from_selected_rows",
+    "image_resize_short",
+    "lod_reset",
+    "mean_iou",
+    "merge_selected_rows",
+    "mse_loss",
+    "prroi_pool",
+    "psroi_pool",
+    "py_func",
+    "random_crop",
+    "rank",
+    "resize_trilinear",
+    "retinanet_detection_output",
+    "retinanet_target_assign",
+    "roi_perspective_transform",
+    "rpn_target_assign",
+    "similarity_focus",
+    "size",
+]
+
+
+def _single_out(op_type, inputs, attrs=None, dtype="float32",
+                out_slot="Out"):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={out_slot: [out]}, attrs=attrs or {})
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """reference: nn.py:15823 over add_position_encoding_op.cc."""
+    return _single_out(
+        "add_position_encoding", {"X": [input]},
+        {"alpha": float(alpha), "beta": float(beta)}, dtype=input.dtype,
+    )
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: nn.py:15890 — out_i = x W_i y^T + b."""
+    helper = LayerHelper("bilinear_tensor_product", **locals())
+    dtype = helper.input_dtype("x")
+    param_shape = [size, x.shape[-1], y.shape[-1]]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=param_shape, dtype=dtype,
+        default_initializer=Xavier(),
+    )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if helper.bias_attr is not False:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, size], dtype=dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        type="bilinear_tensor_product", inputs=inputs,
+        outputs={"Out": [out]},
+    )
+    return helper.append_activation(out)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """reference: detection.py box_decoder_and_assign over
+    box_decoder_and_assign_op.cc; -> (decoded_box, output_assign_box)."""
+    helper = LayerHelper("box_decoder_and_assign")
+    decoded = helper.create_variable_for_type_inference(prior_box.dtype)
+    assigned = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]},
+        attrs={"box_clip": box_clip},
+    )
+    return decoded, assigned
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """reference: detection.py collect_fpn_proposals over
+    collect_fpn_proposals_op.cc."""
+    helper = LayerHelper("collect_fpn_proposals")
+    num = max_level - min_level + 1
+    out = helper.create_variable_for_type_inference(multi_rois[0].dtype)
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": list(multi_rois[:num]),
+                "MultiLevelScores": list(multi_scores[:num])},
+        outputs={"FpnRois": [out]},
+        attrs={"post_nms_topN": post_nms_top_n},
+    )
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: nn.py:16746 over cvm_op.cc."""
+    return _single_out(
+        "cvm", {"X": [input], "CVM": [cvm]}, {"use_cvm": use_cvm},
+        dtype=input.dtype, out_slot="Y",
+    )
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """reference: nn.py:7231 — argmax over classes then ctc_align (merge
+    repeats, drop blanks); the padded [B, T] form of the LoD result."""
+    helper = LayerHelper("ctc_greedy_decoder")
+    topk = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="arg_max", inputs={"X": [input]},
+        outputs={"Out": [topk]}, attrs={"axis": -1, "keepdims": False},
+    )
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="ctc_align", inputs={"Input": [topk]},
+        outputs={"Output": [out]},
+        attrs={"blank": blank, "merge_repeated": True},
+    )
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """reference: nn.py:16984 over deformable_conv_op.cc (v2 modulated /
+    v1); creates the Filter parameter like conv2d."""
+    from .nn import _pair
+
+    helper = LayerHelper("deformable_conv", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    fsize = _pair(filter_size)
+    filter_shape = [num_filters, input.shape[1] // groups] + fsize
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    inputs = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if modulated:
+        inputs["Mask"] = [mask]
+    helper.append_op(
+        type="deformable_conv" if modulated else "deformable_conv_v1",
+        inputs=inputs,
+        outputs={"Output": [out]},
+        attrs={
+            "strides": _pair(stride),
+            "paddings": _pair(padding),
+            "dilations": _pair(dilation),
+            "groups": groups,
+            "deformable_groups": deformable_groups,
+            "im2col_step": im2col_step or 64,
+        },
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return pre_act
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """reference: nn.py:17325 over deformable_psroi_pooling_op.cc."""
+    helper = LayerHelper("deformable_roi_pooling")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    top_count = helper.create_variable_for_type_inference(dtype="int32")
+    part_size = part_size or [pooled_height, pooled_width]
+    # position_sensitive=False: the output dim equals the input channels
+    output_dim = (
+        input.shape[1] // (group_size[0] * group_size[1])
+        if position_sensitive else input.shape[1]
+    )
+    helper.append_op(
+        type="deformable_psroi_pooling",
+        inputs={"Input": [input], "ROIs": [rois], "Trans": [trans]},
+        outputs={"Output": [out], "TopCount": [top_count]},
+        attrs={
+            "no_trans": no_trans,
+            "spatial_scale": spatial_scale,
+            "output_dim": output_dim,
+            "group_size": list(group_size),
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "part_size": list(part_size),
+            "sample_per_part": sample_per_part,
+            "trans_std": trans_std,
+        },
+    )
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """reference: detection.py:515 — box_coder decode + softmax +
+    multiclass_nms, composed from the same layers the reference uses."""
+    from . import nn as _nn
+    from .detection import box_coder
+
+    helper = LayerHelper("detection_output")
+    decoded = box_coder(
+        prior_box=prior_box, prior_box_var=prior_box_var, target_box=loc,
+        code_type="decode_center_size",
+    )
+    sm = _nn.softmax(scores, axis=-1)
+    sm = _nn.transpose(sm, perm=[0, 2, 1])
+    sm.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype=decoded.dtype)
+    attrs = {
+        "background_label": background_label,
+        "nms_threshold": nms_threshold,
+        "nms_top_k": nms_top_k,
+        "keep_top_k": keep_top_k,
+        "score_threshold": score_threshold,
+        "nms_eta": nms_eta,
+        "normalized": True,
+    }
+    if return_index:
+        index = helper.create_variable_for_type_inference(dtype="int32")
+        helper.append_op(
+            type="multiclass_nms2",
+            inputs={"Scores": [sm], "BBoxes": [decoded]},
+            outputs={"Out": [out], "Index": [index]},
+            attrs=attrs,
+        )
+        out.stop_gradient = True
+        index.stop_gradient = True
+        return out, index
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"Scores": [sm], "BBoxes": [decoded]},
+        outputs={"Out": [out]},
+        attrs=attrs,
+    )
+    out.stop_gradient = True
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: nn.py:9745 — 1 - 2*|X∩Y| / (|X|+|Y|)."""
+    from . import nn as _nn
+    from .tensor import cast
+
+    label = cast(label, "float32") if label.dtype != input.dtype else label
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = _nn.reduce_sum(_nn.elementwise_mul(input, label),
+                          dim=reduce_dims)
+    dice_denominator = _nn.elementwise_add(
+        _nn.reduce_sum(input, dim=reduce_dims),
+        _nn.reduce_sum(label, dim=reduce_dims),
+    )
+    dice_score = _nn.scale(
+        _nn.elementwise_div(
+            _nn.scale(inse, scale=2.0),
+            _nn.scale(dice_denominator, scale=1.0, bias=epsilon),
+        ),
+        scale=-1.0, bias=1.0,
+    )
+    return _nn.reduce_mean(dice_score, dim=[0])
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """reference: detection.py distribute_fpn_proposals over
+    distribute_fpn_proposals_op.cc; -> (multi_rois, restore_ind)."""
+    helper = LayerHelper("distribute_fpn_proposals")
+    num = max_level - min_level + 1
+    outs = [
+        helper.create_variable_for_type_inference(fpn_rois.dtype)
+        for _ in range(num)
+    ]
+    restore = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": outs, "RestoreIndex": [restore]},
+        attrs={
+            "min_level": min_level,
+            "max_level": max_level,
+            "refer_level": refer_level,
+            "refer_scale": refer_scale,
+        },
+    )
+    return outs, restore
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    """reference: tensor.py:1336 over eye_op; batch_shape prepends
+    broadcast dims (expanded the way the reference does)."""
+    from . import nn as _nn
+
+    out = _single_out(
+        "eye", {}, {
+            "num_rows": num_rows,
+            "num_columns": num_columns if num_columns is not None else num_rows,
+            "dtype": dtype,
+        }, dtype=dtype,
+    )
+    if batch_shape is not None:
+        for _ in batch_shape:
+            out = _nn.unsqueeze(out, axes=[0])
+        out = _nn.expand(
+            out, expand_times=list(batch_shape) + [1, 1]
+        )
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    """reference: nn.py filter_by_instag over filter_by_instag_op.cc;
+    -> (out, loss_weight, index_map). When everything is filtered out a
+    single sentinel row filled with out_val_if_empty is emitted."""
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(dtype=ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference(dtype="float32")
+    index_map = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="filter_by_instag",
+        inputs={"Ins": [ins], "Ins_tag": [ins_tag]},
+        outputs={"Out": [out], "LossWeight": [loss_weight],
+                 "IndexMap": [index_map]},
+        attrs={"filter_tag": list(filter_tag), "is_lod": is_lod,
+               "out_val_if_empty": out_val_if_empty},
+    )
+    return out, loss_weight, index_map
+
+
+def fsp_matrix(x, y):
+    """reference: nn.py:16696 — flow-of-solution-procedure matrix:
+    x [B,C1,H,W], y [B,C2,H,W] -> [B,C1,C2] = (1/HW) Σ_hw x·y."""
+    from . import nn as _nn
+
+    b, c1 = x.shape[0], x.shape[1]
+    c2 = y.shape[1]
+    hw = int(np.prod(x.shape[2:]))
+    xf = _nn.reshape(x, shape=[0, c1, hw])
+    yf = _nn.reshape(y, shape=[0, c2, hw])
+    out = _nn.matmul(xf, _nn.transpose(yf, perm=[0, 2, 1]))
+    return _nn.scale(out, scale=1.0 / hw)
+
+
+def gather_tree(ids, parents):
+    """reference: nn.py:17617 over gather_tree_op.cc (beam-search path
+    backtrace)."""
+    return _single_out("gather_tree", {"Ids": [ids], "Parents": [parents]},
+                       dtype=ids.dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    dtype="float32"):
+    """reference: nn.py gaussian_random_batch_size_like."""
+    from .. import core
+
+    return _single_out(
+        "gaussian_random_batch_size_like", {"Input": [input]},
+        {
+            "shape": list(shape),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+            "mean": mean,
+            "std": std,
+            "dtype": core.np_to_dtype(np.dtype(dtype)),
+        }, dtype=dtype,
+    )
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference: nn.py get_tensor_from_selected_rows."""
+    return _single_out("get_tensor_from_selected_rows", {"X": [x]},
+                       dtype=x.dtype)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference: nn.py:10683 — resize so the SHORT side equals
+    out_short_len, keeping aspect ratio (static shapes)."""
+    from . import nn as _nn
+
+    in_shape = input.shape
+    h, w = int(in_shape[2]), int(in_shape[3])
+    short = min(h, w)
+    out_shape = [int(round(h * out_short_len / short)),
+                 int(round(w * out_short_len / short))]
+    return _nn.image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference: nn.py:9146 over lod_reset_op.cc."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = [int(v) for v in target_lod]
+    else:
+        raise ValueError("y and target_lod should not be both none")
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """reference: nn.py:11351 over mean_iou_op.cc; -> (mean_iou,
+    out_wrong, out_correct)."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference(dtype="float32")
+    wrong = helper.create_variable_for_type_inference(dtype="int32")
+    correct = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": num_classes},
+    )
+    return miou, wrong, correct
+
+
+def merge_selected_rows(x, name=None):
+    """reference: nn.py merge_selected_rows."""
+    return _single_out("merge_selected_rows", {"X": [x]}, dtype=x.dtype)
+
+
+def mse_loss(input, label):
+    """reference: nn.py:17692 — mean of squared error."""
+    from . import nn as _nn
+    from .loss import square_error_cost
+
+    return _nn.reduce_mean(square_error_cost(input, label))
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """reference: nn.py:16419 over prroi_pool_op.cc."""
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_roi_nums is not None:
+        inputs["RoisLod"] = [batch_roi_nums]
+    return _single_out(
+        "prroi_pool", inputs,
+        {"spatial_scale": spatial_scale, "pooled_height": pooled_height,
+         "pooled_width": pooled_width}, dtype=input.dtype,
+    )
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """reference: nn.py:16353 over psroi_pool_op.cc."""
+    return _single_out(
+        "psroi_pool", {"X": [input], "ROIs": [rois]},
+        {"output_channels": output_channels, "spatial_scale": spatial_scale,
+         "pooled_height": pooled_height, "pooled_width": pooled_width},
+        dtype=input.dtype,
+    )
+
+
+_PY_FUNC_COUNTER = [0]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: nn.py py_func over py_func_op.cc — run an arbitrary
+    Python callable as a (host) op. ``out`` must be pre-created
+    variable(s). ``backward_func(*fwd_inputs, *fwd_outputs, *out_grads)``
+    -> input grads; without one the op is non-differentiable (reference
+    parity). skip_vars_in_backward_input is accepted for signature
+    compatibility; the backward here always receives the full tuple."""
+    from ..ops.misc_ops import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    _PY_FUNC_COUNTER[0] += 1
+    fid = _PY_FUNC_COUNTER[0]
+    register_py_func(fid, func)
+    attrs = {"forward_callable_id": fid}
+    if backward_func is not None:
+        _PY_FUNC_COUNTER[0] += 1
+        bid = _PY_FUNC_COUNTER[0]
+        register_py_func(bid, backward_func)
+        attrs["backward_callable_id"] = bid
+    helper.append_op(
+        type="py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs=attrs,
+    )
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """reference: nn.py:11156 over random_crop_op.cc."""
+    return _single_out("random_crop", {"X": [x]},
+                       {"shape": list(shape)}, dtype=x.dtype)
+
+
+def rank(input):
+    """reference: nn.py:13877 — the (static) number of dimensions as a
+    1-element tensor."""
+    from .tensor import assign
+
+    return assign(np.array([len(input.shape)], dtype="int32"))
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    """reference: nn.py:10360 over trilinear_interp_op.cc. Only NCDHW
+    layout and align_mode=1 are lowered; anything else errors rather
+    than silently resizing the wrong axes."""
+    if data_format != "NCDHW":
+        raise ValueError(
+            "resize_trilinear: only data_format='NCDHW' is supported, "
+            "got %r" % data_format)
+    if align_mode != 1 and not align_corners:
+        raise ValueError(
+            "resize_trilinear: align_mode=0 is not lowered; use "
+            "align_mode=1 or align_corners=True")
+    attrs = {"align_corners": align_corners,
+             "interp_method": "trilinear"}
+    if out_shape is not None:
+        attrs.update({"out_d": int(out_shape[0]), "out_h": int(out_shape[1]),
+                      "out_w": int(out_shape[2])})
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return _single_out("trilinear_interp", {"X": [input]}, attrs,
+                       dtype=input.dtype)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """reference: detection.py retinanet_detection_output over
+    retinanet_detection_output_op.cc."""
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference(dtype=bboxes[0].dtype)
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors), "ImInfo": [im_info]},
+        outputs={"Out": [out]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "nms_eta": nms_eta,
+        },
+    )
+    out.stop_gradient = True
+    return out
+
+
+def _target_assign(op_type, anchor_box, gt_boxes, extra_attrs,
+                   with_fg_num):
+    helper = LayerHelper(op_type)
+    loc_index = helper.create_variable_for_type_inference(dtype="int32")
+    score_index = helper.create_variable_for_type_inference(dtype="int32")
+    target_bbox = helper.create_variable_for_type_inference(
+        dtype=anchor_box.dtype)
+    target_label = helper.create_variable_for_type_inference(dtype="int32")
+    bbox_inside_weight = helper.create_variable_for_type_inference(
+        dtype=anchor_box.dtype)
+    outputs = {
+        "LocationIndex": [loc_index],
+        "ScoreIndex": [score_index],
+        "TargetBBox": [target_bbox],
+        "TargetLabel": [target_label],
+        "BBoxInsideWeight": [bbox_inside_weight],
+    }
+    rets = [loc_index, score_index, target_bbox, target_label,
+            bbox_inside_weight]
+    if with_fg_num:
+        fg_num = helper.create_variable_for_type_inference(dtype="int32")
+        outputs["ForegroundNumber"] = [fg_num]
+        rets.append(fg_num)
+    helper.append_op(
+        type=op_type,
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes[0]],
+                "GtLabels": [gt_boxes[1]]} if isinstance(gt_boxes, tuple)
+        else {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+        outputs=outputs,
+        attrs=extra_attrs,
+    )
+    for v in rets:
+        v.stop_gradient = True
+    return tuple(rets)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """reference: detection.py rpn_target_assign over
+    rpn_target_assign_op.cc: label anchors fg/bg by IoU vs gt and emit
+    sampled indices + regression targets (the op-output surface; callers
+    gather predictions with the returned indices)."""
+    return _target_assign(
+        "rpn_target_assign", anchor_box, gt_boxes,
+        {
+            "rpn_batch_size_per_im": rpn_batch_size_per_im,
+            "rpn_straddle_thresh": rpn_straddle_thresh,
+            "rpn_fg_fraction": rpn_fg_fraction,
+            "rpn_positive_overlap": rpn_positive_overlap,
+            "rpn_negative_overlap": rpn_negative_overlap,
+            "use_random": use_random,
+        },
+        with_fg_num=False,
+    )
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """reference: detection.py retinanet_target_assign (keeps every fg
+    anchor, emits matched gt CLASS labels + foreground count for focal
+    loss)."""
+    return _target_assign(
+        "retinanet_target_assign", anchor_box, (gt_boxes, gt_labels),
+        {
+            "positive_overlap": positive_overlap,
+            "negative_overlap": negative_overlap,
+            "num_classes": num_classes,
+        },
+        with_fg_num=True,
+    )
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """reference: detection.py:2354 over roi_perspective_transform_op.cc."""
+    return _single_out(
+        "roi_perspective_transform", {"X": [input], "ROIs": [rois]},
+        {"transformed_height": transformed_height,
+         "transformed_width": transformed_width,
+         "spatial_scale": spatial_scale}, dtype=input.dtype,
+    )
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: nn.py:15448 over similarity_focus_op.cc."""
+    return _single_out(
+        "similarity_focus", {"X": [input]},
+        {"axis": axis, "indexes": list(indexes)}, dtype=input.dtype,
+    )
+
+
+def size(input):
+    """reference: nn.py:13902 over size_op.cc (total element count)."""
+    return _single_out("size", {"Input": [input]}, dtype="int64")

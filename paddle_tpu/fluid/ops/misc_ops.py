@@ -226,7 +226,27 @@ def _py_func_host(ctx, op_):
         ctx.scope.set(name, np.asarray(v))
 
 
-register_op("py_func", lower=_py_func_host, host=True)
+def _py_func_grad_maker(op_):
+    """Per-instance backward (reference: py_func_op.cc PyFuncOpGradMaker):
+    when the layer registered a backward callable, emit another py_func
+    op calling it with (forward inputs, forward outputs, output grads) ->
+    input grads; without one, the op has no gradient (reference parity:
+    backward_func=None means non-differentiable)."""
+    bid = op_.attr("backward_callable_id", 0)
+    if not bid:
+        return []
+    xs = op_.input("X")
+    outs = op_.output("Out")
+    return [dict(
+        type="py_func",
+        inputs={"X": list(xs) + list(outs) + [o + "@GRAD" for o in outs]},
+        outputs={"Out": [x + "@GRAD" for x in xs]},
+        attrs={"forward_callable_id": int(bid)},
+    )]
+
+
+register_op("py_func", lower=_py_func_host, host=True,
+            grad=_py_func_grad_maker)
 
 
 @op("affine_grid", grad="generic")
@@ -531,7 +551,10 @@ def _filter_by_instag_host(ctx, op_):
         if x3 & {int(t) for t in x2[tag_starts[i]:tag_starts[i + 1]]}
     ]
     if not keep_inst:
-        out = np.zeros((1,) + x1.shape[1:], x1.dtype)
+        # sentinel row filled with out_val_if_empty (reference
+        # filter_by_instag_op.cc empty-result contract)
+        fill = op_.attr("out_val_if_empty", 0)
+        out = np.full((1,) + x1.shape[1:], fill, x1.dtype)
         lw = np.zeros((1, 1), np.float32)
         imap = np.zeros((1, 2), np.int64)
         out_lens = np.asarray([1], np.int64)

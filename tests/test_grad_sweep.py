@@ -594,6 +594,9 @@ DISPOSITIONS = {
     # stochastic forward: finite differences of a resampled mask/path are
     # meaningless; grads verified with fixed masks at layer level
     "dropout": "stochastic mask (layer-level tests with fixed seed)",
+    "py_func": "per-instance Python callables (host op; the backward is "
+               "whatever callable the user registered — exercised "
+               "end-to-end by test_layers_compat.py::test_py_func_backward)",
     "nce": "stochastic negative sampling (layer-level oracle test)",
     "sampling_id": "sampler (non-differentiable draw)",
     # straight-through estimators: the quantized forward is a step

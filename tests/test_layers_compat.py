@@ -1,0 +1,269 @@
+"""Layer-DSL tail wrappers (layers/compat.py + detection star-export):
+every new v1.6 layer callable builds a program and runs through the
+executor with sane output shapes/values."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def test_eye_rank_size():
+    def build():
+        x = fluid.data(name="x", shape=[2, 3, 4], dtype="float32")
+        return [fluid.layers.eye(3), fluid.layers.rank(x),
+                fluid.layers.size(x)]
+
+    e, r, s = _run(build, {"x": np.zeros((2, 3, 4), "float32")})
+    np.testing.assert_array_equal(e, np.eye(3, dtype="float32"))
+    assert int(r.ravel()[0]) == 3
+    assert int(s.ravel()[0]) == 24
+
+
+def test_mse_and_dice_loss():
+    def build():
+        p = fluid.data(name="p", shape=[4, 3], dtype="float32")
+        l = fluid.data(name="l", shape=[4, 3], dtype="float32")
+        return [fluid.layers.mse_loss(input=p, label=l),
+                fluid.layers.dice_loss(input=p, label=l)]
+
+    rs = np.random.RandomState(0)
+    p = rs.rand(4, 3).astype("float32")
+    l = rs.rand(4, 3).astype("float32")
+    mse, dice = _run(build, {"p": p, "l": l})
+    np.testing.assert_allclose(mse.ravel()[0], ((p - l) ** 2).mean(),
+                               rtol=1e-5)
+    inse = (p * l).sum(1)
+    expect = (1 - (2 * inse) / (p.sum(1) + l.sum(1) + 1e-5)).mean()
+    np.testing.assert_allclose(dice.ravel()[0], expect, rtol=1e-4)
+
+
+def test_fsp_matrix_and_add_position_encoding():
+    def build():
+        x = fluid.data(name="x", shape=[2, 3, 4, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[2, 5, 4, 4], dtype="float32")
+        s = fluid.data(name="s", shape=[2, 6, 8], dtype="float32")
+        return [fluid.layers.fsp_matrix(x, y),
+                fluid.layers.add_position_encoding(s, alpha=1.0, beta=1.0)]
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(2, 3, 4, 4).astype("float32")
+    y = rs.rand(2, 5, 4, 4).astype("float32")
+    s = rs.rand(2, 6, 8).astype("float32")
+    fsp, ape = _run(build, {"x": x, "y": y, "s": s})
+    expect = np.einsum("bchw,bdhw->bcd", x, y) / 16.0
+    np.testing.assert_allclose(fsp, expect, rtol=1e-4)
+    assert ape.shape == (2, 6, 8)
+    assert not np.allclose(ape, s)  # the encoding actually moved values
+
+
+def test_bilinear_tensor_product_shapes():
+    def build():
+        x = fluid.data(name="x", shape=[3, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[3, 5], dtype="float32")
+        return [fluid.layers.bilinear_tensor_product(x, y, size=6)]
+
+    rs = np.random.RandomState(2)
+    (out,) = _run(build, {"x": rs.rand(3, 4).astype("float32"),
+                          "y": rs.rand(3, 5).astype("float32")})
+    assert out.shape == (3, 6)
+
+
+def test_mean_iou_perfect_prediction():
+    def build():
+        p = fluid.data(name="p", shape=[8], dtype="int32")
+        l = fluid.data(name="l", shape=[8], dtype="int32")
+        miou, wrong, correct = fluid.layers.mean_iou(p, l, num_classes=3)
+        return [miou, wrong, correct]
+
+    lab = np.array([0, 1, 2, 0, 1, 2, 0, 1], "int32")
+    miou, wrong, correct = _run(build, {"p": lab, "l": lab})
+    np.testing.assert_allclose(miou.ravel()[0], 1.0)
+
+
+def test_detection_output_pipeline():
+    """Composition parity: decode + softmax + NMS produces detections."""
+    def build():
+        loc = fluid.data(name="loc", shape=[1, 4, 4], dtype="float32")
+        sc = fluid.data(name="sc", shape=[1, 4, 3], dtype="float32")
+        pb = fluid.data(name="pb", shape=[4, 4], dtype="float32")
+        pbv = fluid.data(name="pbv", shape=[4, 4], dtype="float32")
+        return [fluid.layers.detection_output(
+            loc, sc, pb, pbv, score_threshold=0.01, nms_threshold=0.45)]
+
+    rs = np.random.RandomState(3)
+    pb = np.array([[1, 1, 5, 5], [6, 6, 10, 10], [2, 2, 8, 8],
+                   [11, 11, 15, 15]], "float32")
+    (out,) = _run(build, {
+        "loc": rs.rand(1, 4, 4).astype("float32") * 0.1,
+        "sc": rs.rand(1, 4, 3).astype("float32"),
+        "pb": pb,
+        "pbv": np.full((4, 4), 0.1, "float32"),
+    })
+    assert out.ndim == 2 and out.shape[-1] == 6  # [label, score, 4 box]
+
+
+def test_prroi_psroi_and_roi_perspective():
+    def build():
+        x = fluid.data(name="x", shape=[1, 8, 6, 6], dtype="float32")
+        rois = fluid.data(name="rois", shape=[1, 4], dtype="float32")
+        # roi_perspective_transform takes QUAD rois: 4 (x, y) corners
+        quad = fluid.data(name="quad", shape=[1, 8], dtype="float32")
+        pr = fluid.layers.prroi_pool(x, rois, 1.0, 2, 2)
+        ps = fluid.layers.psroi_pool(x, rois, output_channels=2,
+                                     spatial_scale=1.0, pooled_height=2,
+                                     pooled_width=2)
+        rp = fluid.layers.roi_perspective_transform(x, quad, 3, 3, 1.0)
+        return [pr, ps, rp]
+
+    rs = np.random.RandomState(4)
+    pr, ps, rp = _run(build, {
+        "x": rs.rand(1, 8, 6, 6).astype("float32"),
+        "rois": np.array([[0.5, 0.5, 4.5, 4.5]], "float32"),
+        "quad": np.array([[0.5, 0.5, 4.5, 0.5, 4.5, 4.5, 0.5, 4.5]],
+                         "float32"),
+    })
+    assert pr.shape == (1, 8, 2, 2)
+    assert ps.shape == (1, 2, 2, 2)
+    assert rp.shape[-2:] == (3, 3)
+
+
+def test_ctc_greedy_decoder():
+    def build():
+        x = fluid.data(name="x", shape=[1, 6, 4], dtype="float32")
+        return [fluid.layers.ctc_greedy_decoder(x, blank=0)]
+
+    probs = np.zeros((1, 6, 4), "float32")
+    # argmax path: 1 1 0 2 2 3 -> merge repeats, drop blank -> 1 2 3
+    for t, c in enumerate([1, 1, 0, 2, 2, 3]):
+        probs[0, t, c] = 1.0
+    (out,) = _run(build, {"x": probs})
+    np.testing.assert_array_equal(out.ravel()[:3], [1, 2, 3])
+
+
+def test_gather_tree_and_lod_reset_and_random_crop():
+    def build():
+        ids = fluid.data(name="ids", shape=[2, 2, 2], dtype="int64")
+        par = fluid.data(name="par", shape=[2, 2, 2], dtype="int64")
+        x = fluid.data(name="xx", shape=[4, 6], dtype="float32")
+        gt = fluid.layers.gather_tree(ids, par)
+        lr = fluid.layers.lod_reset(x, target_lod=[2, 2])
+        rc = fluid.layers.random_crop(x, shape=[4, 3])
+        return [gt, lr, rc]
+
+    rs = np.random.RandomState(5)
+    gt, lr, rc = _run(build, {
+        "ids": rs.randint(0, 9, (2, 2, 2)).astype("int64"),
+        "par": np.zeros((2, 2, 2), "int64"),
+        "xx": rs.rand(4, 6).astype("float32"),
+    })
+    assert gt.shape == (2, 2, 2)
+    assert lr.shape == (4, 6)
+    assert rc.shape == (4, 3)
+
+
+def test_rpn_and_retinanet_target_assign_build():
+    def build():
+        anchors = fluid.data(name="an", shape=[6, 4], dtype="float32")
+        gts = fluid.data(name="gt", shape=[2, 4], dtype="float32")
+        gtl = fluid.data(name="gl", shape=[2, 1], dtype="int32")
+        r = fluid.layers.rpn_target_assign(None, None, anchors, None, gts)
+        rn = fluid.layers.retinanet_target_assign(
+            None, None, anchors, None, gts, gtl, num_classes=3)
+        return [r[2], rn[2], rn[5]]  # target bboxes + fg num
+
+    rs = np.random.RandomState(6)
+    an = np.array([[0, 0, 4, 4], [5, 5, 9, 9], [0, 0, 5, 5],
+                   [10, 10, 14, 14], [1, 1, 4, 4], [6, 6, 9, 9]], "float32")
+    tb, tb2, fg = _run(build, {
+        "an": an,
+        "gt": np.array([[0, 0, 4, 4], [5, 5, 9, 9]], "float32"),
+        "gl": np.array([[1], [2]], "int32"),
+    })
+    assert tb.shape[-1] == 4 and tb2.shape[-1] == 4
+    assert int(np.asarray(fg).ravel()[0]) >= 1
+
+
+def test_eye_batch_shape_and_resize_trilinear():
+    def build():
+        v = fluid.data(name="v", shape=[1, 2, 2, 3, 3], dtype="float32")
+        return [fluid.layers.eye(2, batch_shape=[3]),
+                fluid.layers.resize_trilinear(v, out_shape=[4, 6, 6])]
+
+    rs = np.random.RandomState(7)
+    e, tri = _run(build, {"v": rs.rand(1, 2, 2, 3, 3).astype("float32")})
+    assert e.shape == (3, 2, 2)
+    np.testing.assert_array_equal(e[1], np.eye(2, dtype="float32"))
+    assert tri.shape == (1, 2, 4, 6, 6)
+
+
+def test_py_func_runs_host_callable():
+    def build():
+        x = fluid.data(name="x", shape=[3], dtype="float32")
+        helper = fluid.layer_helper.LayerHelper("pyf")
+        out = helper.create_variable_for_type_inference(dtype="float32")
+        fluid.layers.py_func(lambda a: a * 2.0 + 1.0, x, out)
+        return [out]
+
+    (out,) = _run(build, {"x": np.array([1.0, 2.0, 3.0], "float32")})
+    np.testing.assert_allclose(out, [3.0, 5.0, 7.0])
+
+
+def test_detection_output_return_index():
+    def build():
+        loc = fluid.data(name="loc", shape=[1, 4, 4], dtype="float32")
+        sc = fluid.data(name="sc", shape=[1, 4, 3], dtype="float32")
+        pb = fluid.data(name="pb", shape=[4, 4], dtype="float32")
+        pbv = fluid.data(name="pbv", shape=[4, 4], dtype="float32")
+        out, idx = fluid.layers.detection_output(
+            loc, sc, pb, pbv, return_index=True)
+        return [out, idx]
+
+    rs = np.random.RandomState(8)
+    out, idx = _run(build, {
+        "loc": rs.rand(1, 4, 4).astype("float32") * 0.1,
+        "sc": rs.rand(1, 4, 3).astype("float32"),
+        "pb": np.array([[1, 1, 5, 5], [6, 6, 10, 10], [2, 2, 8, 8],
+                        [11, 11, 15, 15]], "float32"),
+        "pbv": np.full((4, 4), 0.1, "float32"),
+    })
+    assert out.shape[0] == idx.reshape(-1).shape[0]
+
+
+def test_py_func_backward():
+    """backward_func drives gradients through the host op."""
+    def build():
+        x = fluid.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        helper = fluid.layer_helper.LayerHelper("pyfb")
+        out = helper.create_variable_for_type_inference(dtype="float32")
+        fluid.layers.py_func(
+            lambda a: a * 3.0, x, out,
+            backward_func=lambda a, o, og: og * 3.0)
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.backward.gradients(loss, x)
+        return [grads[0]]
+
+    (gx,) = _run(build, {"x": np.array([1.0, 2.0, 3.0], "float32")})
+    np.testing.assert_allclose(gx, [3.0, 3.0, 3.0])
+
+
+def test_resize_trilinear_rejects_bad_layout():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        v = fluid.data(name="v", shape=[1, 2, 2, 3, 3], dtype="float32")
+        with pytest.raises(ValueError, match="NCDHW"):
+            fluid.layers.resize_trilinear(v, out_shape=[4, 6, 6],
+                                          data_format="NDHWC")
