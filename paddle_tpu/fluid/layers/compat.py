@@ -169,8 +169,13 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
     deformable_groups = deformable_groups or 1
     fsize = _pair(filter_size)
     filter_shape = [num_filters, input.shape[1] // groups] + fsize
+    from ..initializer import Normal as _NormalInit
+
+    # reference _get_default_param_initializer: N(0, sqrt(2/(kh*kw*Cin)))
+    std = (2.0 / (fsize[0] * fsize[1] * input.shape[1])) ** 0.5
     w = helper.create_parameter(
         attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=_NormalInit(0.0, std),
     )
     out = helper.create_variable_for_type_inference(dtype=dtype)
     inputs = {"Input": [input], "Offset": [offset], "Filter": [w]}
@@ -203,9 +208,10 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     top_count = helper.create_variable_for_type_inference(dtype="int32")
     part_size = part_size or [pooled_height, pooled_width]
-    # position_sensitive=False: the output dim equals the input channels
+    # reference nn.py:17442: position-sensitive pooling folds the pooled
+    # grid out of the channel dim; otherwise channels pass through
     output_dim = (
-        input.shape[1] // (group_size[0] * group_size[1])
+        input.shape[1] // (pooled_height * pooled_width)
         if position_sensitive else input.shape[1]
     )
     helper.append_op(
@@ -527,7 +533,11 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
 
 def random_crop(x, shape, seed=None):
-    """reference: nn.py:11156 over random_crop_op.cc."""
+    """reference: nn.py:11156 over random_crop_op.cc. Per this repo's
+    RNG design every random op draws from the program-level key stream,
+    so determinism comes from ``program.random_seed`` — the per-op
+    ``seed`` arg is accepted for signature parity and ignored (same as
+    uniform_random/gaussian_random here)."""
     return _single_out("random_crop", {"X": [x]},
                        {"shape": list(shape)}, dtype=x.dtype)
 
@@ -590,8 +600,15 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
     return out
 
 
-def _target_assign(op_type, anchor_box, gt_boxes, extra_attrs,
-                   with_fg_num):
+def _target_assign(op_type, bbox_pred, cls_logits, anchor_box, gt_boxes,
+                   extra_attrs, with_fg_num, cls_width):
+    """Shared core mirroring the reference layers' full surface: run the
+    target-assign op, then GATHER the predictions at the sampled indices
+    (detection.py rpn_target_assign body) and return
+    (predicted_scores, predicted_location, target_label, target_bbox,
+     bbox_inside_weight[, fg_num])."""
+    from . import nn as _nn
+
     helper = LayerHelper(op_type)
     loc_index = helper.create_variable_for_type_inference(dtype="int32")
     score_index = helper.create_variable_for_type_inference(dtype="int32")
@@ -607,12 +624,9 @@ def _target_assign(op_type, anchor_box, gt_boxes, extra_attrs,
         "TargetLabel": [target_label],
         "BBoxInsideWeight": [bbox_inside_weight],
     }
-    rets = [loc_index, score_index, target_bbox, target_label,
-            bbox_inside_weight]
     if with_fg_num:
         fg_num = helper.create_variable_for_type_inference(dtype="int32")
         outputs["ForegroundNumber"] = [fg_num]
-        rets.append(fg_num)
     helper.append_op(
         type=op_type,
         inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes[0]],
@@ -621,8 +635,18 @@ def _target_assign(op_type, anchor_box, gt_boxes, extra_attrs,
         outputs=outputs,
         attrs=extra_attrs,
     )
-    for v in rets:
-        v.stop_gradient = True
+    for v in outputs:
+        for var in outputs[v]:
+            var.stop_gradient = True
+    # gather predictions at the sampled indices (reference body)
+    cls_flat = _nn.reshape(x=cls_logits, shape=(-1, cls_width))
+    bbox_flat = _nn.reshape(x=bbox_pred, shape=(-1, 4))
+    predicted_cls_logits = _nn.gather(cls_flat, score_index)
+    predicted_bbox_pred = _nn.gather(bbox_flat, loc_index)
+    rets = [predicted_cls_logits, predicted_bbox_pred, target_label,
+            target_bbox, bbox_inside_weight]
+    if with_fg_num:
+        rets.append(fg_num)
     return tuple(rets)
 
 
@@ -632,11 +656,12 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                       rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
                       rpn_negative_overlap=0.3, use_random=True):
     """reference: detection.py rpn_target_assign over
-    rpn_target_assign_op.cc: label anchors fg/bg by IoU vs gt and emit
-    sampled indices + regression targets (the op-output surface; callers
-    gather predictions with the returned indices)."""
+    rpn_target_assign_op.cc: label anchors fg/bg by IoU vs gt, sample,
+    and return (predicted_scores, predicted_location, target_label,
+    target_bbox, bbox_inside_weight) — predictions gathered at the
+    sampled indices, exactly the reference's return surface."""
     return _target_assign(
-        "rpn_target_assign", anchor_box, gt_boxes,
+        "rpn_target_assign", bbox_pred, cls_logits, anchor_box, gt_boxes,
         {
             "rpn_batch_size_per_im": rpn_batch_size_per_im,
             "rpn_straddle_thresh": rpn_straddle_thresh,
@@ -646,6 +671,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             "use_random": use_random,
         },
         with_fg_num=False,
+        cls_width=1,
     )
 
 
@@ -655,15 +681,18 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                             positive_overlap=0.5, negative_overlap=0.4):
     """reference: detection.py retinanet_target_assign (keeps every fg
     anchor, emits matched gt CLASS labels + foreground count for focal
-    loss)."""
+    loss); returns (predicted_scores, predicted_location, target_label,
+    target_bbox, bbox_inside_weight, fg_num)."""
     return _target_assign(
-        "retinanet_target_assign", anchor_box, (gt_boxes, gt_labels),
+        "retinanet_target_assign", bbox_pred, cls_logits, anchor_box,
+        (gt_boxes, gt_labels),
         {
             "positive_overlap": positive_overlap,
             "negative_overlap": negative_overlap,
             "num_classes": num_classes,
         },
         with_fg_num=True,
+        cls_width=num_classes,
     )
 
 

@@ -1,0 +1,200 @@
+"""Probability distributions built from layer ops (reference:
+python/paddle/fluid/layers/distributions.py — Distribution base at :28,
+Uniform :113, Normal :247, Categorical :400, MultivariateNormalDiag
+:503; same constructors, same method surfaces, same math)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from . import nn
+from . import ops as _ops
+from . import tensor
+from .control_flow import less_than as _less_than
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _uniform_random(shape, seed=0, dtype="float32", min=0.0, max=1.0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="uniform_random", inputs={},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "min": min, "max": max, "seed": seed},
+    )
+    return out
+
+
+class Distribution(object):
+    """Abstract base (reference distributions.py:28)."""
+
+    def sample(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def _to_variable(self, *args):
+        """floats / numpy arrays -> fp32 variables (reference :71)."""
+        variable_args = []
+        for arg in args:
+            if isinstance(arg, float):
+                arg = np.full([1], arg, "float32")
+            if isinstance(arg, np.ndarray):
+                arg = tensor.assign(arg.astype("float32"))
+            variable_args.append(arg)
+        return tuple(variable_args)
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference :113)."""
+
+    def __init__(self, low, high):
+        self.all_arg_is_float = isinstance(low, float) and isinstance(
+            high, float)
+        self.low, self.high = self._to_variable(low, high)
+
+    def sample(self, shape, seed=0):
+        batch_shape = list((self.low + self.high).shape)
+        output_shape = list(shape) + batch_shape
+        u = _uniform_random(output_shape, seed=seed)
+        output = u * (tensor.zeros(output_shape, dtype="float32")
+                      + (self.high - self.low)) + self.low
+        if self.all_arg_is_float:
+            return nn.reshape(output, shape)
+        return output
+
+    def log_prob(self, value):
+        lb = tensor.cast(_less_than(self.low, value), dtype=value.dtype)
+        ub = tensor.cast(_less_than(value, self.high), dtype=value.dtype)
+        return _ops.log(lb * ub) - _ops.log(self.high - self.low)
+
+    def entropy(self):
+        return _ops.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference :247)."""
+
+    def __init__(self, loc, scale):
+        self.all_arg_is_float = isinstance(loc, float) and isinstance(
+            scale, float)
+        self.loc, self.scale = self._to_variable(loc, scale)
+
+    def sample(self, shape, seed=0):
+        batch_shape = list((self.loc + self.scale).shape)
+        output_shape = list(shape) + batch_shape
+        g = nn.gaussian_random(output_shape, mean=0.0, std=1.0, seed=seed)
+        output = g * (tensor.zeros(output_shape, dtype="float32")
+                      + self.scale) + self.loc
+        if self.all_arg_is_float:
+            return nn.reshape(output, shape)
+        return output
+
+    def entropy(self):
+        return (
+            nn.scale(_ops.log(self.scale), scale=1.0,
+                     bias=0.5 + 0.5 * math.log(2 * math.pi))
+        )
+
+    def log_prob(self, value):
+        var = self.scale * self.scale
+        log_scale = _ops.log(self.scale)
+        return (
+            nn.scale((value - self.loc) * (value - self.loc),
+                     scale=-1.0) / (2.0 * var)
+            - log_scale - math.log(math.sqrt(2.0 * math.pi))
+        )
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Normal), \
+            "another distribution must be Normal"
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * (var_ratio + t1 - 1.0 - _ops.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference :400 — v1.6
+    exposes kl_divergence and entropy)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _prob_terms(self, logits):
+        shifted = logits - nn.reduce_max(logits, dim=[-1], keep_dim=True)
+        e = _ops.exp(shifted)
+        z = nn.reduce_sum(e, dim=[-1], keep_dim=True)
+        return shifted, e, z
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Categorical)
+        logits, e, z = self._prob_terms(self.logits)
+        o_logits, _oe, oz = self._prob_terms(other.logits)
+        prob = e / z
+        return nn.reduce_sum(
+            prob * (logits - _ops.log(z) - o_logits + _ops.log(oz)),
+            dim=[-1], keep_dim=True,
+        )
+
+    def entropy(self):
+        logits, e, z = self._prob_terms(self.logits)
+        prob = e / z
+        return nn.scale(
+            nn.reduce_sum(prob * (logits - _ops.log(z)), dim=[-1],
+                          keep_dim=True),
+            scale=-1.0,
+        )
+
+
+class MultivariateNormalDiag(Distribution):
+    """MVN with a diagonal scale matrix [k, k] (reference :503 — v1.6
+    exposes entropy and kl_divergence)."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc
+        self.scale = scale
+
+    def _det(self, value):
+        # product of the diagonal: mask off-diagonals to 1 then reduce
+        batch_shape = list(value.shape)
+        one_all = tensor.ones(shape=batch_shape, dtype="float32")
+        one_diag = tensor.diag(
+            tensor.ones(shape=[batch_shape[0]], dtype="float32"))
+        return nn.reduce_prod(value + one_all - one_diag)
+
+    def _inv(self, value):
+        batch_shape = list(value.shape)
+        one_all = tensor.ones(shape=batch_shape, dtype="float32")
+        one_diag = tensor.diag(
+            tensor.ones(shape=[batch_shape[0]], dtype="float32"))
+        return nn.elementwise_pow(value, one_all - 2.0 * one_diag)
+
+    def entropy(self):
+        return nn.scale(
+            _ops.log(self._det(self.scale)), scale=0.5,
+            bias=0.5 * self.scale.shape[0] * (1.0 + math.log(2 * math.pi)),
+        )
+
+    def kl_divergence(self, other):
+        assert isinstance(other, MultivariateNormalDiag)
+        tr = nn.reduce_sum(self._inv(other.scale) * self.scale)
+        diff = other.loc - self.loc
+        loc_cov = nn.matmul(diff, self._inv(other.scale))
+        tri = nn.matmul(loc_cov, nn.transpose(diff, perm=[1, 0]))
+        k = list(self.scale.shape)[0]
+        ln_cov = _ops.log(self._det(other.scale)) - _ops.log(
+            self._det(self.scale))
+        return 0.5 * (tr + tri - float(k) + ln_cov)

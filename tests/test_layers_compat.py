@@ -175,24 +175,36 @@ def test_gather_tree_and_lod_reset_and_random_crop():
 
 
 def test_rpn_and_retinanet_target_assign_build():
+    """Reference return surface: (predicted_scores, predicted_location,
+    target_label, target_bbox, bbox_inside_weight[, fg_num]) with the
+    predictions gathered at the sampled indices."""
     def build():
+        bp = fluid.data(name="bp", shape=[1, 6, 4], dtype="float32")
+        cl = fluid.data(name="cl", shape=[1, 6, 1], dtype="float32")
+        cl3 = fluid.data(name="cl3", shape=[1, 6, 3], dtype="float32")
         anchors = fluid.data(name="an", shape=[6, 4], dtype="float32")
         gts = fluid.data(name="gt", shape=[2, 4], dtype="float32")
         gtl = fluid.data(name="gl", shape=[2, 1], dtype="int32")
-        r = fluid.layers.rpn_target_assign(None, None, anchors, None, gts)
+        sp, lp, tl, tb, w = fluid.layers.rpn_target_assign(
+            bp, cl, anchors, None, gts)
         rn = fluid.layers.retinanet_target_assign(
-            None, None, anchors, None, gts, gtl, num_classes=3)
-        return [r[2], rn[2], rn[5]]  # target bboxes + fg num
+            bp, cl3, anchors, None, gts, gtl, num_classes=3)
+        return [sp, lp, tl, tb, rn[0], rn[3], rn[5]]
 
     rs = np.random.RandomState(6)
     an = np.array([[0, 0, 4, 4], [5, 5, 9, 9], [0, 0, 5, 5],
                    [10, 10, 14, 14], [1, 1, 4, 4], [6, 6, 9, 9]], "float32")
-    tb, tb2, fg = _run(build, {
+    sp, lp, tl, tb, rsp, rtb, fg = _run(build, {
+        "bp": rs.rand(1, 6, 4).astype("float32"),
+        "cl": rs.rand(1, 6, 1).astype("float32"),
+        "cl3": rs.rand(1, 6, 3).astype("float32"),
         "an": an,
         "gt": np.array([[0, 0, 4, 4], [5, 5, 9, 9]], "float32"),
         "gl": np.array([[1], [2]], "int32"),
     })
-    assert tb.shape[-1] == 4 and tb2.shape[-1] == 4
+    assert sp.shape[-1] == 1 and lp.shape[-1] == 4   # gathered predictions
+    assert tb.shape == lp.shape                       # targets align
+    assert rsp.shape[-1] == 3 and rtb.shape[-1] == 4
     assert int(np.asarray(fg).ravel()[0]) >= 1
 
 
@@ -267,3 +279,49 @@ def test_resize_trilinear_rejects_bad_layout():
         with pytest.raises(ValueError, match="NCDHW"):
             fluid.layers.resize_trilinear(v, out_shape=[4, 6, 6],
                                           data_format="NDHWC")
+
+
+def test_distributions():
+    """fluid.layers.distributions (reference distributions.py): Uniform /
+    Normal sampling + log_prob/entropy/kl against closed forms."""
+    import math
+
+    def build():
+        u = fluid.layers.Uniform(1.0, 3.0)
+        n = fluid.layers.Normal(0.0, 2.0)
+        n2 = fluid.layers.Normal(1.0, 2.0)
+        v = fluid.data(name="v", shape=[1], dtype="float32")
+        cat = fluid.layers.Categorical(
+            fluid.layers.assign(np.array([[1.0, 1.0, 1.0]], "float32")))
+        cat2 = fluid.layers.Categorical(
+            fluid.layers.assign(np.array([[2.0, 1.0, 0.0]], "float32")))
+        mvn = fluid.layers.MultivariateNormalDiag(
+            fluid.layers.assign(np.array([[0.0, 0.0]], "float32")),
+            fluid.layers.assign(np.diag([1.0, 4.0]).astype("float32")))
+        return [
+            u.sample([64]), u.entropy(), u.log_prob(v),
+            n.sample([64]), n.entropy(), n.log_prob(v),
+            n.kl_divergence(n2),
+            cat.entropy(), cat.kl_divergence(cat2),
+            mvn.entropy(),
+        ]
+
+    us, ue, ulp, ns, ne, nlp, nkl, ce, ckl, me = _run(
+        build, {"v": np.array([2.0], "float32")})
+    assert us.shape[0] == 64 and us.min() >= 1.0 and us.max() <= 3.0
+    np.testing.assert_allclose(ue.ravel()[0], math.log(2.0), rtol=1e-5)
+    np.testing.assert_allclose(ulp.ravel()[0], -math.log(2.0), rtol=1e-5)
+    assert ns.shape[0] == 64
+    np.testing.assert_allclose(
+        ne.ravel()[0], 0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        nlp.ravel()[0],
+        -0.5 * (2.0 / 2.0) ** 2 - math.log(2.0)
+        - math.log(math.sqrt(2 * math.pi)), rtol=1e-5)
+    np.testing.assert_allclose(nkl.ravel()[0], 0.5 * (1.0 / 4.0), rtol=1e-5)
+    np.testing.assert_allclose(ce.ravel()[0], math.log(3.0), rtol=1e-5)
+    assert ckl.ravel()[0] > 0.0
+    np.testing.assert_allclose(
+        me.ravel()[0], 0.5 * (2 * (1 + math.log(2 * math.pi))
+                              + math.log(4.0)), rtol=1e-5)
