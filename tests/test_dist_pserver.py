@@ -191,7 +191,11 @@ def test_heartbeat_monitor_flags_lost_worker():
         "DIST_DIE_AFTER_STEP": "0",  # both trainers die abruptly after step 0
         "FLAGS_pserver_heartbeat_timeout_s": "2",
         "FLAGS_pserver_heartbeat_interval_s": "0.5",
-        "FLAGS_pserver_timeout_ms": "8000",
+        # idle window before the pserver gives up: must outlast the
+        # trainers' FIRST jax compile even on a heavily loaded machine
+        # (two concurrent suites made 8000 flaky: the pserver exited
+        # before any worker registered, so 'lost' was never logged)
+        "FLAGS_pserver_timeout_ms": "25000",
     }
     procs = [
         spawn("PSERVER", dict(base, PADDLE_CURRENT_ENDPOINT=ep))
