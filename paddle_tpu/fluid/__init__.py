@@ -69,6 +69,13 @@ from .flags import get_flags, set_flags
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import evaluator
+from . import trainer_desc
+from . import trainer_factory
+from . import device_worker
+from . import inferencer
+from . import data_feed_desc
+from .data_feed_desc import DataFeedDesc
+from . import distribute_lookup_table
 from . import average
 from .data import data
 from . import input
@@ -144,6 +151,13 @@ __all__ = [
     "fleet",
     "data_generator",
     "monkey_patch_variable",
+    "trainer_desc",
+    "trainer_factory",
+    "device_worker",
+    "inferencer",
+    "data_feed_desc",
+    "DataFeedDesc",
+    "distribute_lookup_table",
     "graphviz",
     "net_drawer",
     "append_backward",

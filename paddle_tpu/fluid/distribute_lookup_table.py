@@ -1,0 +1,48 @@
+"""Distributed lookup-table discovery helpers (reference:
+python/paddle/fluid/distribute_lookup_table.py — scan a Program for the
+single is_distributed lookup_table and its inputs/outputs; used by the
+transpiler and fleet wrappers)."""
+
+from __future__ import annotations
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """Ids variables feeding the distributed table (reference :18)."""
+    local_vars = program.current_block().vars
+    inputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE:
+            if table_name == op.input("W")[0]:
+                inputs.extend([local_vars[name] for name in op.input("Ids")])
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """Out variables produced by the distributed table (reference :37)."""
+    local_vars = program.current_block().vars
+    outputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE:
+            if table_name == op.input("W")[0]:
+                outputs.extend(
+                    [local_vars[name] for name in op.output("Out")]
+                )
+    return outputs
+
+
+def find_distributed_lookup_table(program):
+    """The unique is_distributed table name, or None (reference :56)."""
+    table_name = None
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE:
+            if op.attr("is_distributed") is True:
+                if table_name is None:
+                    table_name = op.input("W")[0]
+                if table_name != op.input("W")[0]:
+                    raise RuntimeError(
+                        "all distributed lookup_table_ops should have "
+                        "only one table"
+                    )
+    return table_name

@@ -1,0 +1,5 @@
+"""reference: python/paddle/fluid/inferencer.py — in v1.6 this module is
+an empty stub ("inferencer is moved into fluid.contrib.inferencer");
+kept for import parity."""
+
+__all__ = []

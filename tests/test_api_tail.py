@@ -390,3 +390,78 @@ def test_multislot_data_generators(capsys):
     import pytest as _pytest
     with _pytest.raises(ValueError):
         gen._gen_str([("words", [1.5, 2]), ])  # slot count mismatch
+
+
+def test_trainer_and_device_worker_modules():
+    """trainer_desc/trainer_factory/device_worker module spellings map
+    onto the merged trainer stack (fluid/trainer.py)."""
+    assert fluid.trainer_desc.MultiTrainer is fluid.trainer.MultiTrainer
+    assert fluid.trainer_factory.TrainerFactory is fluid.trainer.TrainerFactory
+    w = fluid.device_worker.DeviceWorkerFactory()._create_device_worker(
+        "Hogwild")
+    assert isinstance(w, fluid.device_worker.Hogwild)
+    assert w.trainer_name == "MultiTrainer"
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        fluid.device_worker.DeviceWorkerFactory()._create_device_worker("Nope")
+
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    proto = tmp_path / "data.proto"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\n'
+        "batch_size: 2\n"
+        "multi_slot_desc {\n"
+        "    slots {\n"
+        '         name: "words"\n'
+        '         type: "uint64"\n'
+        "         is_dense: false\n"
+        "         is_used: true\n"
+        "     }\n"
+        "     slots {\n"
+        '         name: "label"\n'
+        '         type: "uint64"\n'
+        "         is_dense: false\n"
+        "         is_used: true\n"
+        "    }\n"
+        "}\n"
+    )
+    d = fluid.DataFeedDesc(str(proto))
+    assert d.name == "MultiSlotDataFeed" and d.batch_size == 2
+    assert [s.name for s in d.slots] == ["words", "label"]
+    d.set_batch_size(128)
+    d.set_dense_slots(["words"])
+    d.set_use_slots(["words"])
+    text = d.desc()
+    assert "batch_size: 128" in text
+    assert 'name: "words"' in text and "is_dense: true" in text
+    # only the opted-in slot is used (proto default is false)
+    assert text.count("is_used: true") == 2  # file set both explicitly
+    # field order doesn't matter for the top-level name
+    proto2 = proto.parent / "data2.proto"
+    proto2.write_text(
+        "multi_slot_desc {\n    slots {\n"
+        '         name: "w"\n    }\n}\n'
+        'name: "MultiSlotDataFeed"\nbatch_size: 4\n'
+    )
+    d2 = fluid.DataFeedDesc(str(proto2))
+    assert d2.name == "MultiSlotDataFeed" and d2.batch_size == 4
+    assert not d2.slots[0].is_used  # proto default false
+    d2.set_use_slots(["w"])
+    assert d2.slots[0].is_used
+
+
+def test_distribute_lookup_table_helpers():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="dids", shape=[1], dtype="int64")
+        fluid.layers.embedding(
+            input=ids, size=[100, 8], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="dist_table"))
+    name = fluid.distribute_lookup_table.find_distributed_lookup_table(main)
+    assert name == "dist_table"
+    ins = fluid.distribute_lookup_table.find_distributed_lookup_table_inputs(
+        main, name)
+    outs = fluid.distribute_lookup_table.find_distributed_lookup_table_outputs(
+        main, name)
+    assert len(ins) == 1 and len(outs) == 1
