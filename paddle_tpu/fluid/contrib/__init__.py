@@ -6,3 +6,29 @@ from . import memory_usage_calc  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 
 from . import slim  # noqa: F401
+
+from . import layers  # noqa: F401
+from . import reader  # noqa: F401
+from . import utils  # noqa: F401
+from . import decoder  # noqa: F401
+from . import extend_optimizer  # noqa: F401
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
+from . import op_frequence  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from . import model_stat  # noqa: F401
+from . import inferencer  # noqa: F401
+from .layers import (  # noqa: F401
+    fused_elemwise_activation,
+    fused_embedding_seq_pool,
+    match_matrix_tensor,
+    multiclass_nms2,
+    sequence_topk_avg_pooling,
+    tree_conv,
+    var_conv_2d,
+)
+from .layers.rnn_impl import (  # noqa: F401
+    basic_gru,
+    basic_lstm,
+    BasicGRUUnit,
+    BasicLSTMUnit,
+)

@@ -1,0 +1,9 @@
+"""reference: python/paddle/fluid/contrib/decoder/ — the old
+Trainer-API beam-search decoder. The maintained implementation is the
+BeamSearchDecoder in fluid.layers.rnn (one fused lax.while_loop,
+OPS_AUDIT 'beam_search: subsumed'); re-exported here so contrib imports
+resolve."""
+
+from ...layers.rnn import BeamSearchDecoder  # noqa: F401
+
+__all__ = ["BeamSearchDecoder"]

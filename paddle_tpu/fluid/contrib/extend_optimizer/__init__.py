@@ -1,0 +1,1 @@
+from .extend_optimizer_with_weight_decay import *  # noqa: F401,F403

@@ -1,0 +1,100 @@
+"""Decoupled weight decay as an optimizer mixin (reference:
+python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py — DecoupledWeightDecay:20,
+extend_with_decoupled_weight_decay:102; AdamW per arXiv:1711.05101:
+new_param = optimized_param - param_before * coeff, applied as explicit
+decay ops before the optimizer update, NOT through the L2 regularizer)."""
+
+from __future__ import annotations
+
+from ... import framework
+from ... import optimizer as _optimizer
+from ...framework import program_guard, default_main_program, \
+    default_startup_program
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay(object):
+    def __init__(self, weight_decay=0.0, apply_decay_param_fun=None,
+                 **kwargs):
+        coeff = weight_decay
+        if not isinstance(coeff, (float, framework.Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._params_name = set()
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = coeff
+        super(DecoupledWeightDecay, self).__init__(**kwargs)
+
+    def _scale_parameters(self, params_and_grads):
+        if isinstance(self._coeff, float) and self._coeff == 0.0:
+            return []
+        from ...layers import nn as _nn
+
+        scaled_params = []
+        for param, grad in params_and_grads:
+            if grad is None:
+                continue
+            if (self._apply_decay_param_fun is not None
+                    and not self._apply_decay_param_fun(param.name)):
+                continue
+            assert param.name not in self._params_name
+            scaled_params.append(
+                (param, grad, _nn.scale(param, scale=float(self._coeff)))
+            )
+            self._params_name.add(param.name)
+        return scaled_params
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        from ...layers import nn as _nn
+        from ...layers import tensor as _tensor
+
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(
+                loss=loss,
+                startup_program=startup_program,
+                parameter_list=parameter_list,
+                no_grad_set=no_grad_set,
+            )
+            if grad_clip is not None:
+                # same clip hook the base minimize applies
+                from ... import clip as _clip
+
+                params_grads = _clip.append_clip_with(params_grads,
+                                                      grad_clip)
+            scaled_params = self._scale_parameters(params_grads)
+            for param, grad, scaled in scaled_params:
+                updated = _nn.elementwise_sub(x=param, y=scaled)
+                _tensor.assign(input=updated, output=param)
+            optimize_ops = self.apply_optimize(
+                loss=loss,
+                params_grads=params_grads,
+                startup_program=startup_program,
+            )
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(self._params_name)])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """-> subclass of ``base_optimizer`` taking a ``weight_decay`` kwarg
+    (reference :102). Example: AdamW =
+    extend_with_decoupled_weight_decay(fluid.optimizer.Adam)."""
+    if not issubclass(base_optimizer, _optimizer.Optimizer):
+        raise TypeError(
+            "The input(base_optimizer) should be a derived class of "
+            "Optimizer.")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super(OptimizerWithDecoupledWeightDecay, self).__init__(
+                weight_decay=weight_decay,
+                apply_decay_param_fun=apply_decay_param_fun, **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

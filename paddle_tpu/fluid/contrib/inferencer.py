@@ -1,0 +1,38 @@
+"""reference: python/paddle/fluid/contrib/inferencer.py — the high-level
+Inferencer from the removed Trainer API; kept as a thin wrapper over
+load_inference_model + Executor.run."""
+
+from __future__ import annotations
+
+from .. import core
+from ..executor import Executor, scope_guard
+from .. import io as _io
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer(object):
+    def __init__(self, infer_func=None, param_path=None, place=None,
+                 parallel=False):
+        if param_path is None:
+            raise ValueError("param_path should not be None")
+        self.place = place or core.CPUPlace()
+        self.exe = Executor(self.place)
+        self.scope = core.Scope()
+        with scope_guard(self.scope):
+            (self.inference_program, self.feed_names,
+             self.fetch_vars) = _io.load_inference_model(
+                param_path, self.exe)
+
+    def infer(self, inputs, return_numpy=True):
+        """inputs: {feed_name: ndarray}."""
+        import numpy as np
+
+        with scope_guard(self.scope):
+            results = self.exe.run(
+                self.inference_program, feed=inputs,
+                fetch_list=list(self.fetch_vars),
+                return_numpy=return_numpy)
+        if return_numpy:
+            return [np.asarray(r) for r in results]
+        return list(results)

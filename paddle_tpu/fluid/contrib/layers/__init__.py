@@ -1,0 +1,4 @@
+"""contrib layers (reference: python/paddle/fluid/contrib/layers/)."""
+
+from .nn import *  # noqa: F401,F403
+from . import nn  # noqa: F401

@@ -1,0 +1,174 @@
+"""Contrib layer wrappers (reference:
+python/paddle/fluid/contrib/layers/nn.py — fused_elemwise_activation:39,
+var_conv_2d:103, match_matrix_tensor:219, sequence_topk_avg_pooling:302,
+tree_conv:370, fused_embedding_seq_pool:435, multiclass_nms2:501) over
+the ops already registered in paddle_tpu/fluid/ops/."""
+
+from __future__ import annotations
+
+from ...layer_helper import LayerHelper
+from ...param_attr import ParamAttr
+
+__all__ = [
+    "fused_elemwise_activation",
+    "var_conv_2d",
+    "match_matrix_tensor",
+    "sequence_topk_avg_pooling",
+    "tree_conv",
+    "fused_embedding_seq_pool",
+    "multiclass_nms2",
+]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """reference contrib nn.py:39 over fused_elemwise_activation_op.cc."""
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inter = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="fused_elemwise_activation",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out], "IntermediateOut": [inter]},
+        attrs={"functor_list": list(functor_list), "axis": axis,
+               "scale": scale,
+               "save_intermediate_out": save_intermediate_out},
+    )
+    return out
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """reference contrib nn.py:103 over var_conv_2d_op.cc (variable-size
+    1-channel conv over ragged rows/cols)."""
+    helper = LayerHelper("var_conv_2d", **locals())
+    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else filter_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    filter_shape = [int(output_channel),
+                    int(input_channel) * fh * fw]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    tmp = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="var_conv_2d",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
+        outputs={"Out": [out], "Col": [tmp]},
+        attrs={"InputChannel": int(input_channel),
+               "OutputChannel": int(output_channel),
+               "KernelH": fh, "KernelW": fw, "StrideH": sh, "StrideW": sw},
+    )
+    return helper.append_activation(out)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    """reference contrib nn.py:219 over match_matrix_tensor_op.cc;
+    -> (out, tmp)."""
+    helper = LayerHelper("match_matrix_tensor", **locals())
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[x.shape[-1], int(channel_num), y.shape[-1]],
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    tmp = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="match_matrix_tensor",
+        inputs={"X": [x], "Y": [y], "W": [w]},
+        outputs={"Out": [out], "Tmp": [tmp]},
+        attrs={"dim_t": int(channel_num)},
+    )
+    return helper.append_activation(out), tmp
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """reference contrib nn.py:302 over sequence_topk_avg_pooling_op.cc."""
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_topk_avg_pooling",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col]},
+        outputs={"Out": [out]},
+        attrs={"topks": list(topks), "channel_num": int(channel_num)},
+    )
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference contrib nn.py:370 over tree_conv_op.cc."""
+    helper = LayerHelper("tree_conv", **locals())
+    dtype = nodes_vector.dtype
+    feature_size = nodes_vector.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[feature_size, 3, int(output_size), int(num_filters)],
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)},
+    )
+    if helper.bias_attr is not False and helper.bias_attr is not None:
+        out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """reference contrib nn.py:435 over fused_embedding_seq_pool_op.cc."""
+    helper = LayerHelper("fused_embedding_seq_pool", **locals())
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (
+        -1 if padding_idx is None
+        else padding_idx if padding_idx >= 0
+        else (size[0] + padding_idx)
+    )
+    helper.append_op(
+        type="fused_embedding_seq_pool",
+        inputs={"Ids": [input], "W": [w]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "combiner": combiner,
+               "padding_idx": padding_idx},
+    )
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """reference contrib nn.py:501 over multiclass_nms2 (NMS + the flat
+    row Index output)."""
+    helper = LayerHelper("multiclass_nms2")
+    out = helper.create_variable_for_type_inference(dtype=bboxes.dtype)
+    index = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="multiclass_nms2",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "Index": [index]},
+        attrs={
+            "background_label": background_label,
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "nms_eta": nms_eta,
+            "normalized": normalized,
+        },
+    )
+    out.stop_gradient = True
+    index.stop_gradient = True
+    if return_index:
+        return out, index
+    return out

@@ -1,0 +1,58 @@
+"""reference: python/paddle/fluid/contrib/model_stat.py:40 summary —
+print a per-layer table of shapes, PARAMs and FLOPs for a Program's
+conv/fc/pool ops and return (total_params, total_flops)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def _op_stat(block_vars, op):
+    if op.type in ("conv2d", "depthwise_conv2d"):
+        x = block_vars[op.input("Input")[0]]
+        w = block_vars[op.input("Filter")[0]]
+        out = block_vars[op.output("Output")[0]]
+        params = int(np.prod(w.shape))
+        flops = int(np.prod(out.shape[1:])) * int(
+            np.prod(w.shape[1:])) * 2
+        return op.type, x.shape, out.shape, params, flops
+    if op.type == "mul":
+        x = block_vars[op.input("X")[0]]
+        w = block_vars[op.input("Y")[0]]
+        out = block_vars[op.output("Out")[0]]
+        params = int(np.prod(w.shape))
+        return op.type, x.shape, out.shape, params, 2 * params
+    if op.type in ("pool2d",):
+        x = block_vars[op.input("X")[0]]
+        out = block_vars[op.output("Out")[0]]
+        k = op.attr("ksize", [1, 1])
+        flops = int(np.prod(out.shape[1:])) * int(np.prod(k))
+        return op.type, x.shape, out.shape, 0, flops
+    return None
+
+
+def summary(main_prog):
+    """Print the stat table; returns (total_params, total_flops)."""
+    total_params = 0
+    total_flops = 0
+    rows = []
+    for block in main_prog.blocks:
+        for op in block.ops:
+            stat = _op_stat(block.vars, op)
+            if stat is None:
+                continue
+            typ, in_shape, out_shape, params, flops = stat
+            rows.append((typ, list(in_shape), list(out_shape), params,
+                         flops))
+            total_params += params
+            total_flops += flops
+    header = ("type", "in_shape", "out_shape", "PARAMs", "FLOPs")
+    print("%-18s %-20s %-20s %12s %14s" % header)
+    for r in rows:
+        print("%-18s %-20s %-20s %12d %14d" % (
+            r[0], str(r[1]), str(r[2]), r[3], r[4]))
+    print("Total PARAMs: %d (%.4fM)" % (total_params, total_params / 1e6))
+    print("Total FLOPs: %d (%.2fG)" % (total_flops, total_flops / 1e9))
+    return total_params, total_flops

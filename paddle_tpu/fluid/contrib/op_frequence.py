@@ -1,0 +1,33 @@
+"""reference: python/paddle/fluid/contrib/op_frequence.py:23
+op_freq_statistic — count op types over a program's blocks, returning
+(uni_op_freq, adj_2_op_freq) ordered dicts like the reference."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    if not isinstance(program, Program):
+        raise TypeError("'program' should be an instance of Program.")
+    uni_op_freq = OrderedDict()
+    adj_2_op_freq = OrderedDict()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+            if prev is not None:
+                key = prev + "->" + op.type
+                adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+            prev = op.type
+    uni = OrderedDict(
+        sorted(uni_op_freq.items(), key=lambda kv: -kv[1])
+    )
+    adj = OrderedDict(
+        sorted(adj_2_op_freq.items(), key=lambda kv: -kv[1])
+    )
+    return uni, adj

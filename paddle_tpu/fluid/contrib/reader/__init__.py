@@ -1,0 +1,1 @@
+from .distributed_reader import *  # noqa: F401,F403

@@ -1,0 +1,40 @@
+"""reference: python/paddle/fluid/contrib/reader/distributed_reader.py —
+shard a batch reader across PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM by
+round-robin (each trainer keeps every trainers_num-th batch)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    assert trainer_id < trainers_num, (
+        "trainer_id should be less than trainers_num."
+    )
+
+    def decorate_for_multi_process():
+        if trainers_num > 1:
+            print("start data reader (trainers_num: {}, trainer_id: {})"
+                  .format(trainers_num, trainer_id))
+        train_data, idx = None, 1
+        for batch_id, data in enumerate(batch_reader()):
+            if trainers_num > 1:
+                if idx < trainers_num:
+                    if idx == trainer_id + 1:
+                        train_data = data
+                    idx += 1
+                else:
+                    if idx == trainer_id + 1:
+                        train_data = data
+                    assert train_data is not None, \
+                        "train data should not be None."
+                    yield train_data
+                    train_data, idx = None, 1
+            else:
+                yield data
+
+    return decorate_for_multi_process

@@ -1,0 +1,7 @@
+"""reference: python/paddle/fluid/contrib/utils/ — HDFS + lookup-table
+utilities. The working implementations live with fleet
+(incubate/fleet/utils); re-exported here under the contrib spelling."""
+
+from ...incubate.fleet.utils.hdfs import *  # noqa: F401,F403
+from . import lookup_table_utils  # noqa: F401
+from .lookup_table_utils import *  # noqa: F401,F403

@@ -1,0 +1,48 @@
+"""Lookup-table utilities (reference:
+python/paddle/fluid/contrib/utils/lookup_table_utils.py).
+
+The reference's loaders unpack pserver-side table shards written by the
+C++ checkpoint machinery; here tables are saved/loaded through the
+shared persistable IO (fluid/io.py) and the pserver checkpoint_notify
+path, so these helpers reduce to program rewrites + the standard
+loaders."""
+
+from __future__ import annotations
+
+__all__ = [
+    "convert_dist_to_sparse_program",
+    "load_persistables_for_increment",
+    "load_persistables_for_inference",
+]
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+
+def convert_dist_to_sparse_program(program):
+    """reference :85 — turn distributed lookup tables back into local
+    sparse lookups (serving-side rewrite)."""
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and op.attr("is_distributed"):
+            op.attrs["is_distributed"] = False
+            op.attrs["is_sparse"] = True
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """reference :136 — load a checkpoint to continue training. Table
+    shards here ride the same persistable stream as everything else."""
+    from ... import io as _io
+
+    _io.load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """reference :260 — load params (incl. the table) for serving."""
+    from ... import io as _io
+
+    convert_dist_to_sparse_program(program)
+    _io.load_persistables(executor, dirname, main_program=program)
+    return program

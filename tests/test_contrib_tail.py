@@ -1,0 +1,151 @@
+"""contrib tail (reference python/paddle/fluid/contrib/): layer
+wrappers, AdamW-style decoupled weight decay, distributed reader,
+op-frequency/model-stat tools, basic_gru/basic_lstm builders."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(build, feeds, fetch_startup=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs], scope
+
+
+def test_fused_elemwise_activation_and_match_matrix():
+    def build():
+        x = fluid.data(name="x", shape=[3, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[3, 4], dtype="float32")
+        # functor_list = [f_outer, f_inner]: relu(add(x, y))
+        f = fluid.contrib.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_add"])
+        mx = fluid.data(name="mx", shape=[2, 5, 6], dtype="float32")
+        my = fluid.data(name="my", shape=[2, 7, 8], dtype="float32")
+        mm, _tmp = fluid.contrib.match_matrix_tensor(mx, my, channel_num=3)
+        return [f, mm]
+
+    rs = np.random.RandomState(0)
+    (f, mm), _ = _run(build, {
+        "x": rs.randn(3, 4).astype("float32"),
+        "y": rs.randn(3, 4).astype("float32"),
+        "mx": rs.rand(2, 5, 6).astype("float32"),
+        "my": rs.rand(2, 7, 8).astype("float32"),
+    })
+    assert (f >= 0).all()  # relu applied after the add
+    assert mm.shape[0] == 2  # [B, ...] match matrix
+
+
+def test_adamw_decoupled_weight_decay():
+    """extend_with_decoupled_weight_decay: the decay is applied to the
+    PARAMETER directly (param *= 1-coeff before the update), not through
+    the gradient — distinguishable from L2 by a zero-gradient step."""
+    AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.Adam)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[4, 6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=3)
+        loss = fluid.layers.mean(h)
+        opt = AdamW(weight_decay=0.1, learning_rate=0.0)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("fc_0.w_0")).copy()
+        exe.run(main, feed={"x": np.zeros((4, 6), "float32")},
+                fetch_list=[loss])
+        w1 = np.asarray(scope.get("fc_0.w_0"))
+    # zero input -> zero grad for w; lr=0 -> no Adam step; the decoupled
+    # decay still shrinks the weight by exactly (1 - coeff)
+    np.testing.assert_allclose(w1, w0 * 0.9, rtol=1e-5)
+
+
+def test_distributed_batch_reader(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    batches = [[i] for i in range(6)]
+    reader = fluid.contrib.reader.distributed_batch_reader(
+        lambda: iter(batches))
+    got = list(reader())
+    assert got == [[1], [3], [5]]  # trainer 1 takes every 2nd batch
+
+
+def test_op_freq_statistic_and_model_stat(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.data(name="img", shape=[2, 3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3)
+        fluid.layers.fc(input=c, size=5)
+    uni, adj = fluid.contrib.op_freq_statistic(main)
+    assert uni["conv2d"] == 1 and uni["mul"] == 1
+    assert any("->" in k for k in adj)
+    with pytest.raises(TypeError):
+        fluid.contrib.op_freq_statistic("not a program")
+    params, flops = fluid.contrib.model_stat.summary(main)
+    out = capsys.readouterr().out
+    assert params > 0 and flops > 0
+    assert "Total PARAMs" in out
+
+
+def test_basic_gru_and_lstm_builders():
+    """Reference return surface: (out, last_hidden[, last_cell])."""
+    def build():
+        x = fluid.data(name="x", shape=[2, 5, 6], dtype="float32")
+        g, gh = fluid.contrib.basic_gru(x, None, hidden_size=4,
+                                        num_layers=2)
+        l, lh, lc = fluid.contrib.basic_lstm(x, None, None, hidden_size=4,
+                                             bidirectional=True)
+        return [g, gh, l, lh, lc]
+
+    rs = np.random.RandomState(1)
+    (g, gh, l, lh, lc), _ = _run(
+        build, {"x": rs.rand(2, 5, 6).astype("float32")})
+    assert g.shape == (2, 5, 4)
+    assert gh.shape == (2, 4)          # top-layer final hidden
+    assert l.shape == (2, 5, 8)        # bidirectional concat
+    assert lh.shape == (2, 4) and lc.shape == (2, 4)
+    # the final hidden really is the last timestep of the fw outputs
+    np.testing.assert_allclose(gh, g[:, -1, :], rtol=1e-5)
+
+
+def test_contrib_multiclass_nms2_index():
+    def build():
+        bb = fluid.data(name="bb", shape=[1, 4, 4], dtype="float32")
+        sc = fluid.data(name="sc", shape=[1, 2, 4], dtype="float32")
+        out, idx = fluid.contrib.multiclass_nms2(
+            bb, sc, score_threshold=0.0, nms_top_k=4, keep_top_k=4,
+            return_index=True)
+        return [out, idx]
+
+    rs = np.random.RandomState(2)
+    (out, idx), _ = _run(build, {
+        "bb": np.array([[[0, 0, 4, 4], [5, 5, 9, 9], [2, 2, 6, 6],
+                         [7, 7, 11, 11]]], "float32"),
+        "sc": rs.rand(1, 2, 4).astype("float32"),
+    })
+    assert out.shape[-1] == 6
+    assert idx.reshape(-1).shape[0] == out.shape[0]
+
+
+def test_lookup_table_utils_convert():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="cids", shape=[1], dtype="int64")
+        fluid.layers.embedding(
+            input=ids, size=[50, 4], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="tbl"))
+    fluid.contrib.utils.lookup_table_utils.convert_dist_to_sparse_program(
+        main)
+    op = [o for o in main.global_block().ops
+          if o.type == "lookup_table"][0]
+    assert not op.attr("is_distributed")
+    assert op.attr("is_sparse")
