@@ -16,6 +16,8 @@ from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401
+from . import compat  # noqa: F401
+from .reader.decorator import batch  # noqa: F401  (paddle.batch)
 
 # Fluid-style top-level conveniences (reference: python/paddle/__init__.py)
 from .fluid import framework as _framework  # noqa: F401

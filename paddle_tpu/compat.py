@@ -1,0 +1,75 @@
+"""Py2/3 compatibility helpers (reference: python/paddle/compat.py —
+to_text:36, to_bytes:120, round:193, floor_division:219,
+get_exception_message:236). Python-3-only here, so these reduce to
+their py3 branches, kept because v1.6 user code imports them."""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def _map(obj, fn, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_map(v, fn, False) for v in obj]
+            return obj
+        return [_map(v, fn, False) for v in obj]
+    if isinstance(obj, set):
+        new = {_map(v, fn, False) for v in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    if isinstance(obj, dict):
+        new = {_map(k, fn, False): _map(v, fn, False)
+               for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return fn(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes (or containers of bytes) -> str."""
+    return _map(
+        obj,
+        lambda v: v.decode(encoding) if isinstance(v, bytes) else v,
+        inplace,
+    )
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str (or containers of str) -> bytes."""
+    return _map(
+        obj,
+        lambda v: v.encode(encoding) if isinstance(v, str) else v,
+        inplace,
+    )
+
+
+def round(x, d=0):
+    """Python-2-style round (half away from zero), reference :193."""
+    if x > 0.0:
+        p = 10 ** d
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0.0:
+        p = 10 ** d
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    assert exc is not None
+    return str(exc)

@@ -185,6 +185,11 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
 
 def batch(reader, batch_size, drop_last=False):
+    if not isinstance(batch_size, int) or batch_size <= 0:
+        raise ValueError(
+            "batch_size should be a positive integer value, "
+            "but got batch_size={}".format(batch_size))
+
     def batch_reader():
         r = reader()
         b = []

@@ -465,3 +465,24 @@ def test_distribute_lookup_table_helpers():
     outs = fluid.distribute_lookup_table.find_distributed_lookup_table_outputs(
         main, name)
     assert len(ins) == 1 and len(outs) == 1
+
+
+def test_paddle_level_batch_and_compat():
+    """paddle.batch + paddle.compat (reference python/paddle/batch.py,
+    compat.py) under the paddle_tpu spelling."""
+    import paddle_tpu as paddle
+
+    batches = list(paddle.batch(lambda: iter(range(5)), batch_size=2)())
+    assert batches == [[0, 1], [2, 3], [4]]
+    batches = list(paddle.batch(lambda: iter(range(5)), batch_size=2,
+                                drop_last=True)())
+    assert batches == [[0, 1], [2, 3]]
+    with pytest.raises(ValueError, match="positive integer"):
+        paddle.batch(lambda: iter(range(5)), batch_size=0)
+    c = paddle.compat
+    assert c.to_text(b"abc") == "abc"
+    assert c.to_bytes("abc") == b"abc"
+    assert c.to_text([b"a", {b"k": b"v"}]) == ["a", {"k": "v"}]
+    assert c.round(2.5) == 3.0 and c.round(-2.5) == -3.0  # py2 rounding
+    assert c.floor_division(7, 2) == 3
+    assert c.get_exception_message(ValueError("boom")) == "boom"
