@@ -19,6 +19,15 @@ from .base import (  # noqa: F401
 )
 from .layers import Layer  # noqa: F401
 from .nn import (  # noqa: F401
+    Conv3D,
+    Conv2DTranspose,
+    Conv3DTranspose,
+    GRUUnit,
+    NCE,
+    BilinearTensorProduct,
+    SequenceConv,
+    RowConv,
+    TreeConv,
     Conv2D,
     Pool2D,
     Linear,

@@ -10,87 +10,15 @@ from .. import core
 from ..framework import _dygraph_tracer
 from ..initializer import Constant, Normal
 from .layers import Layer
-from .tracer import VarBase
+from .tracer import VarBase, _as_jax
 
 
 def _trace(type, inputs, outputs, attrs):
     return _dygraph_tracer().trace_op(type, inputs, outputs, attrs)
 
 
-class Conv2D(Layer):
-    def __init__(
-        self,
-        name_scope,
-        num_filters,
-        filter_size,
-        stride=1,
-        padding=0,
-        dilation=1,
-        groups=None,
-        param_attr=None,
-        bias_attr=None,
-        use_cudnn=True,
-        act=None,
-        dtype="float32",
-    ):
-        super().__init__(name_scope, dtype)
-        self._groups = groups or 1
-        self._stride = _pair(stride)
-        self._padding = _pair(padding)
-        self._dilation = _pair(dilation)
-        self._act = act
-        self._num_filters = num_filters
-        self._filter_size = _pair(filter_size)
-        self._param_attr = param_attr
-        self._bias_attr = bias_attr
-        self._num_channels = None
-        self.weight = None
-        self.bias = None
-
-    def _build_once(self, input):
-        num_channels = input.shape[1]
-        self._num_channels = num_channels
-        filter_shape = [
-            self._num_filters,
-            num_channels // self._groups,
-        ] + self._filter_size
-        fan_in = (num_channels // self._groups) * np.prod(self._filter_size)
-        std = (2.0 / fan_in) ** 0.5
-        self.weight = self.create_parameter(
-            self._param_attr,
-            filter_shape,
-            self._dtype,
-            default_initializer=Normal(0.0, std),
-        )
-        if self._bias_attr is not False:
-            self.bias = self.create_parameter(
-                self._bias_attr, [self._num_filters], self._dtype, is_bias=True
-            )
-
-    def forward(self, input):
-        if self.weight is None:
-            self._build_once(input)
-        out = _trace(
-            "conv2d",
-            {"Input": [input], "Filter": [self.weight]},
-            {"Output": 1},
-            {
-                "strides": self._stride,
-                "paddings": self._padding,
-                "dilations": self._dilation,
-                "groups": self._groups,
-            },
-        )["Output"][0]
-        if self.bias is not None:
-            out = _trace(
-                "elementwise_add",
-                {"X": [out], "Y": [self.bias]},
-                {"Out": 1},
-                {"axis": 1},
-            )["Out"][0]
-        if self._act:
-            out = _trace(self._act, {"X": [out]}, {"Out": 1}, {})["Out"][0]
-        return out
+# Conv2D is defined after _ConvNd below (it is the 2-D instance of the
+# shared conv base); this placeholder keeps declaration order readable.
 
 
 class Pool2D(Layer):
@@ -444,3 +372,348 @@ def _pair(v):
     if isinstance(v, (list, tuple)):
         return [int(x) for x in v]
     return [int(v), int(v)]
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v)] * 3
+
+
+class _ConvNd(Layer):
+    """Shared body for the conv / conv-transpose dygraph layers
+    (reference dygraph/nn.py Conv3D:~ / Conv2DTranspose / Conv3DTranspose
+    — same param creation, different op type and filter orientation)."""
+
+    _op_type = None
+    _transposed = False
+    _nd = 2
+
+    def __init__(self, name_scope, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        tile = _pair if self._nd == 2 else _triple
+        self._groups = groups or 1
+        self._stride = tile(stride)
+        self._padding = tile(padding)
+        self._dilation = tile(dilation)
+        self._act = act
+        self._num_filters = num_filters
+        self._filter_size = tile(filter_size)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def _build_once(self, input):
+        num_channels = input.shape[1]
+        if self._transposed:
+            # transpose conv filters are [Cin, Cout/groups, *k]
+            filter_shape = [
+                num_channels, self._num_filters // self._groups,
+            ] + self._filter_size
+        else:
+            filter_shape = [
+                self._num_filters, num_channels // self._groups,
+            ] + self._filter_size
+        fan_in = (num_channels // self._groups) * int(
+            np.prod(self._filter_size))
+        std = (2.0 / max(fan_in, 1)) ** 0.5
+        self.weight = self.create_parameter(
+            self._param_attr, filter_shape, self._dtype,
+            default_initializer=Normal(0.0, std),
+        )
+        if self._bias_attr is not False:
+            self.bias = self.create_parameter(
+                self._bias_attr, [self._num_filters], self._dtype,
+                is_bias=True,
+            )
+
+    def forward(self, input):
+        if self.weight is None:
+            self._build_once(input)
+        out = _trace(
+            self._op_type,
+            {"Input": [input], "Filter": [self.weight]},
+            {"Output": 1},
+            {
+                "strides": self._stride,
+                "paddings": self._padding,
+                "dilations": self._dilation,
+                "groups": self._groups,
+            },
+        )["Output"][0]
+        if self.bias is not None:
+            out = _trace(
+                "elementwise_add", {"X": [out], "Y": [self.bias]},
+                {"Out": 1}, {"axis": 1},
+            )["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, {"Out": 1}, {})["Out"][0]
+        return out
+
+
+class Conv2D(_ConvNd):
+    """reference dygraph/nn.py Conv2D over conv2d_op."""
+
+    _op_type = "conv2d"
+    _nd = 2
+
+
+class Conv3D(_ConvNd):
+    """reference dygraph/nn.py Conv3D over conv3d_op."""
+
+    _op_type = "conv3d"
+    _nd = 3
+
+
+class Conv2DTranspose(_ConvNd):
+    """reference dygraph/nn.py Conv2DTranspose over conv2d_transpose."""
+
+    _op_type = "conv2d_transpose"
+    _transposed = True
+    _nd = 2
+
+
+class Conv3DTranspose(_ConvNd):
+    """reference dygraph/nn.py Conv3DTranspose over conv3d_transpose."""
+
+    _op_type = "conv3d_transpose"
+    _transposed = True
+    _nd = 3
+
+
+class GRUUnit(Layer):
+    """reference dygraph/nn.py GRUUnit over gru_unit_op: one step of a
+    GRU on (input [B, 3D], hidden_prev [B, D]) -> (hidden, reset_hidden,
+    gate)."""
+
+    def __init__(self, name_scope, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size  # 3 * hidden per the reference contract
+        self._hidden = size // 3
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+        self.weight = self.create_parameter(
+            param_attr, [self._hidden, 3 * self._hidden], dtype)
+        self.bias = self.create_parameter(
+            bias_attr, [1, 3 * self._hidden], dtype, is_bias=True)
+
+    def forward(self, input, hidden):
+        outs = _trace(
+            "gru_unit",
+            {"Input": [input], "HiddenPrev": [hidden],
+             "Weight": [self.weight], "Bias": [self.bias]},
+            {"Hidden": 1, "ResetHiddenPrev": 1, "Gate": 1},
+            {"activation": self._activation,
+             "gate_activation": self._gate_activation,
+             "origin_mode": self._origin_mode},
+        )
+        return (outs["Hidden"][0], outs["ResetHiddenPrev"][0],
+                outs["Gate"][0])
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py NCE over nce_op: noise-contrastive
+    estimation cost on (input [B, D], label [B, T])."""
+
+    def __init__(self, name_scope, num_total_classes, param_attr=None,
+                 bias_attr=None, num_neg_samples=10, sampler="uniform",
+                 custom_dist=None, seed=0, is_sparse=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_total_classes = num_total_classes
+        self._num_neg_samples = num_neg_samples
+        self._sampler = sampler
+        self._custom_dist = custom_dist
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def _build_once(self, input):
+        dim = input.shape[-1]
+        self.weight = self.create_parameter(
+            self._param_attr, [self._num_total_classes, dim], self._dtype)
+        if self._bias_attr is not False:
+            self.bias = self.create_parameter(
+                self._bias_attr, [self._num_total_classes, 1], self._dtype,
+                is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        if self.weight is None:
+            self._build_once(input)
+        inputs = {"Input": [input], "Label": [label],
+                  "Weight": [self.weight]}
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        if sample_weight is not None:
+            inputs["SampleWeight"] = [sample_weight]
+        if self._custom_dist is not None:
+            inputs["CustomDistProbs"] = [VarBase(
+                _as_jax(np.asarray(self._custom_dist, np.float32)),
+                stop_gradient=True,
+            )]
+        sampler_id = {"uniform": 0, "log_uniform": 1,
+                      "custom_dist": 2}[self._sampler]
+        outs = _trace(
+            "nce", inputs,
+            {"Cost": 1, "SampleLogits": 1, "SampleLabels": 1},
+            {"num_total_classes": self._num_total_classes,
+             "num_neg_samples": self._num_neg_samples,
+             "sampler": sampler_id},
+        )
+        return outs["Cost"][0]
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct over
+    bilinear_tensor_product_op."""
+
+    def __init__(self, name_scope, size, name=None, act=None,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x, y):
+        if self.weight is None:
+            self.weight = self.create_parameter(
+                self._param_attr,
+                [self._size, x.shape[-1], y.shape[-1]], self._dtype)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    self._bias_attr, [1, self._size], self._dtype,
+                    is_bias=True)
+        inputs = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        out = _trace("bilinear_tensor_product", inputs, {"Out": 1},
+                     {})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, {"Out": 1}, {})["Out"][0]
+        return out
+
+
+class SequenceConv(Layer):
+    """reference dygraph/nn.py SequenceConv over sequence_conv_op
+    (context-window conv over [B, T, D] padded sequences here)."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None:
+            d = input.shape[-1]
+            self.weight = self.create_parameter(
+                self._param_attr,
+                [self._filter_size * d, self._num_filters], self._dtype)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    self._bias_attr, [self._num_filters], self._dtype,
+                    is_bias=True)
+        out = _trace(
+            "sequence_conv",
+            {"X": [input], "Filter": [self.weight]},
+            {"Out": 1},
+            {"contextLength": self._filter_size,
+             "contextStart": -(self._filter_size // 2)},
+        )["Out"][0]
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         {"Out": 1}, {"axis": -1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, {"Out": 1}, {})["Out"][0]
+        return out
+
+
+class RowConv(Layer):
+    """reference dygraph/nn.py RowConv over row_conv_op (lookahead conv
+    for streaming models)."""
+
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._future = future_context_size
+        self._param_attr = param_attr
+        self._act = act
+        self.weight = None
+
+    def forward(self, input):
+        if self.weight is None:
+            self.weight = self.create_parameter(
+                self._param_attr,
+                [self._future + 1, input.shape[-1]], self._dtype)
+        out = _trace("row_conv", {"X": [input], "Filter": [self.weight]},
+                     {"Out": 1}, {})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, {"Out": 1}, {})["Out"][0]
+        return out
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py TreeConv over tree_conv_op."""
+
+    def __init__(self, name_scope, output_size, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None, name=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, nodes_vector, edge_set):
+        if self.weight is None:
+            feat = nodes_vector.shape[-1]
+            self.weight = self.create_parameter(
+                self._param_attr,
+                [feat, 3, self._output_size, self._num_filters],
+                self._dtype)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    self._bias_attr,
+                    [self._num_filters], self._dtype, is_bias=True)
+        out = _trace(
+            "tree_conv",
+            {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+             "Filter": [self.weight]},
+            {"Out": 1},
+            {"max_depth": self._max_depth},
+        )["Out"][0]
+        if self.bias is not None:
+            # the op emits [B, N, output_size*num_filters]; unflatten so
+            # the per-filter bias broadcasts, then restore the layout
+            n = out.shape[1]
+            out = _trace("reshape", {"X": [out]}, {"Out": 1},
+                         {"shape": [-1, n, self._output_size,
+                                    self._num_filters]})["Out"][0]
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         {"Out": 1}, {"axis": -1})["Out"][0]
+            out = _trace("reshape", {"X": [out]}, {"Out": 1},
+                         {"shape": [-1, n, self._output_size *
+                                    self._num_filters]})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, {"Out": 1}, {})["Out"][0]
+        return out

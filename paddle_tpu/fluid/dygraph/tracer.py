@@ -128,6 +128,22 @@ def _as_jax(v):
     return jnp.asarray(v)
 
 
+class _EnvScope(object):
+    """Scope view over the eager env dict so HOST op lowerings (which
+    use ctx.scope.get/set) run under the dygraph tracer too."""
+
+    __slots__ = ("_env",)
+
+    def __init__(self, env):
+        self._env = env
+
+    def get(self, name, default=None):
+        return self._env.get(name, default)
+
+    def set(self, name, value):
+        self._env[name] = value
+
+
 class _TapeEntry(object):
     __slots__ = ("type", "inputs", "outputs", "attrs")
 
@@ -190,7 +206,10 @@ class Tracer(object):
 
         # eager ops run on the default jax device; pick layouts for it
         _registry.set_lowering_backend(jax.default_backend())
-        ctx = LowerCtx(env=env, base_key=self._next_key())
+        # host ops (print, detection/NMS, tree walks, ...) read and write
+        # through ctx.scope; in eager mode the env IS the scope
+        ctx = LowerCtx(env=env, base_key=self._next_key(),
+                       scope=_EnvScope(env))
         opdef.lower(ctx, fake)
 
         for slot, vs in out_vars.items():

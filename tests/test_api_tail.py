@@ -486,3 +486,65 @@ def test_paddle_level_batch_and_compat():
     assert c.round(2.5) == 3.0 and c.round(-2.5) == -3.0  # py2 rounding
     assert c.floor_division(7, 2) == 3
     assert c.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_dygraph_layer_tail():
+    """The 9 remaining reference dygraph layers (Conv3D/transposes,
+    GRUUnit, NCE, BilinearTensorProduct, SequenceConv, RowConv,
+    TreeConv) run forward in eager mode with correct shapes."""
+    rs = np.random.RandomState(0)
+    with fluid.dygraph.guard():
+        tv = fluid.dygraph.to_variable
+        x3 = tv(rs.rand(1, 2, 4, 5, 5).astype("float32"))
+        assert fluid.dygraph.Conv3D("c3", 3, 3, padding=1)(x3).shape == \
+            (1, 3, 4, 5, 5)
+        x2 = tv(rs.rand(1, 2, 5, 5).astype("float32"))
+        assert fluid.dygraph.Conv2DTranspose("c2t", 3, 3)(x2).shape == \
+            (1, 3, 7, 7)
+        assert fluid.dygraph.Conv3DTranspose("c3t", 3, 3)(x3).shape == \
+            (1, 3, 6, 7, 7)
+
+        gin = tv(rs.rand(2, 12).astype("float32"))
+        gh = tv(rs.rand(2, 4).astype("float32"))
+        h, rh, g = fluid.dygraph.GRUUnit("gru", 12)(gin, gh)
+        assert h.shape == (2, 4) and g.shape == (2, 12)
+
+        nin = tv(rs.rand(3, 6).astype("float32"))
+        nlab = tv(rs.randint(0, 10, (3, 1)).astype("int64"))
+        cost = fluid.dygraph.NCE("nce", num_total_classes=10,
+                                 num_neg_samples=4)(nin, nlab)
+        assert np.isfinite(cost.numpy()).all()
+
+        bx = tv(rs.rand(3, 4).astype("float32"))
+        by = tv(rs.rand(3, 5).astype("float32"))
+        assert fluid.dygraph.BilinearTensorProduct("btp", 6)(bx, by).shape \
+            == (3, 6)
+
+        seq = tv(rs.rand(2, 7, 4).astype("float32"))
+        assert fluid.dygraph.SequenceConv("sc", 8)(seq).shape == (2, 7, 8)
+        assert fluid.dygraph.RowConv("rc", 2)(seq).shape == (2, 7, 4)
+
+        nodes = tv(rs.rand(1, 5, 4).astype("float32"))
+        edges = tv(np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]],
+                            "int32"))
+        out = fluid.dygraph.TreeConv("tc", output_size=6,
+                                     num_filters=2)(nodes, edges)
+        # the op's flattened layout: [B, N, output_size * num_filters]
+        assert out.shape == (1, 5, 12)
+
+
+def test_nets_sequence_conv_pool():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data(name="scp", shape=[2, 6, 4], dtype="float32")
+        out = fluid.nets.sequence_conv_pool(x, num_filters=5, filter_size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(
+            main,
+            feed={"scp": np.random.RandomState(1).rand(2, 6, 4)
+                  .astype("float32")},
+            fetch_list=[out])
+    assert np.asarray(o).shape == (2, 5)
