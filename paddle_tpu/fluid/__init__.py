@@ -6,6 +6,8 @@ from __future__ import annotations
 from . import core
 from . import framework
 from .framework import (
+    is_compiled_with_cuda,
+    require_version,
     Program,
     Variable,
     Operator,
@@ -151,6 +153,8 @@ __all__ = [
     "fleet",
     "data_generator",
     "monkey_patch_variable",
+    "is_compiled_with_cuda",
+    "require_version",
     "trainer_desc",
     "trainer_factory",
     "device_worker",

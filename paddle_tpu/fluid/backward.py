@@ -455,6 +455,13 @@ def append_backward(
     return params_grads
 
 
+def calc_gradient(targets, inputs, target_gradients=None,
+                  no_grad_set=None):
+    """reference: backward.py calc_gradient — the underlying API
+    ``gradients`` wraps (same contract here)."""
+    return gradients(targets, inputs, target_gradients, no_grad_set)
+
+
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """reference: backward.py gradients — grads of targets wrt inputs."""
     if not isinstance(targets, (list, tuple)):

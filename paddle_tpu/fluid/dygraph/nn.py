@@ -529,7 +529,14 @@ class NCE(Layer):
         self._num_total_classes = num_total_classes
         self._num_neg_samples = num_neg_samples
         self._sampler = sampler
-        self._custom_dist = custom_dist
+        # converted once: re-uploading the full class distribution every
+        # forward would be per-step host->device traffic
+        self._custom_dist = None
+        if custom_dist is not None:
+            self._custom_dist = VarBase(
+                _as_jax(np.asarray(custom_dist, np.float32)),
+                stop_gradient=True,
+            )
         self._param_attr = param_attr
         self._bias_attr = bias_attr
         self.weight = None
@@ -554,10 +561,7 @@ class NCE(Layer):
         if sample_weight is not None:
             inputs["SampleWeight"] = [sample_weight]
         if self._custom_dist is not None:
-            inputs["CustomDistProbs"] = [VarBase(
-                _as_jax(np.asarray(self._custom_dist, np.float32)),
-                stop_gradient=True,
-            )]
+            inputs["CustomDistProbs"] = [self._custom_dist]
         sampler_id = {"uniform": 0, "log_uniform": 1,
                       "custom_dist": 2}[self._sampler]
         outs = _trace(

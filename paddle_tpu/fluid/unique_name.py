@@ -43,3 +43,10 @@ def guard(new_generator=None):
         yield
     finally:
         switch(old)
+
+
+def generate_with_ignorable_key(key):
+    """reference: unique_name.py generate_with_ignorable_key — dygraph
+    name generation that may ignore the structural key; same stream as
+    generate() here."""
+    return generate(key)

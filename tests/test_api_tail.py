@@ -548,3 +548,54 @@ def test_nets_sequence_conv_pool():
                   .astype("float32")},
             fetch_list=[out])
     assert np.asarray(o).shape == (2, 5)
+
+
+def test_framework_io_backward_tail():
+    """Remaining module-level helpers under their v1.6 spellings."""
+    assert fluid.is_compiled_with_cuda() is False
+    fluid.require_version("1.6.0")
+    from paddle_tpu.fluid import framework as fw
+
+    assert fw.grad_var_name("w") == "w@GRAD"
+    assert fw.dtype_is_floating(fluid.core.VarDesc.VarType.FP32)
+    with pytest.raises(RuntimeError):
+        fw.cuda_pinned_places()
+    with pytest.raises(NotImplementedError):
+        fw.load_op_library("libfoo.so")
+    proto = fw.OpProtoHolder.instance().get_op_proto("mul")
+    assert proto is not None
+    assert len(fw.get_all_op_protos()) > 300
+
+    # io helpers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data(name="pv", shape=[-1, 3], dtype="float32")
+        fluid.layers.fc(input=x, size=2)
+        loss_var = None
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)  # global scope
+    w = main.global_block().vars["fc_0.w_0"]
+    val = fluid.io.get_parameter_value(w, exe)
+    assert val.shape == (3, 2)
+    np.testing.assert_array_equal(
+        fluid.io.get_parameter_value_by_name("fc_0.w_0", exe), val)
+    assert not fluid.io.is_belong_to_optimizer(w)
+
+    # backward.calc_gradient mirrors gradients()
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main2, startup2):
+        a = fluid.data(name="cga", shape=[2, 2], dtype="float32")
+        a.stop_gradient = False
+        l = fluid.layers.reduce_sum(a * a)
+        (g,) = fluid.backward.calc_gradient(l, a)
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup2)
+        (gv,) = exe.run(main2, feed={"cga": np.ones((2, 2), "float32")},
+                        fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(gv), 2 * np.ones((2, 2)))
+
+    # initializer.init_on_cpu context + metrics.DetectionMAP alias
+    with fluid.initializer.init_on_cpu():
+        pass
+    assert fluid.metrics.DetectionMAP is not None
