@@ -782,19 +782,19 @@ def generate_control_dev_var_name():
 
 
 def convert_np_dtype_to_dtype_(np_dtype):
-    """reference: framework.py convert_np_dtype_to_dtype_."""
-    import numpy as np
-
+    """reference: framework.py convert_np_dtype_to_dtype_ — one source of
+    truth: core's converter."""
     from . import core
 
-    return core.np_to_dtype(np.dtype(np_dtype))
+    return core.convert_np_dtype_to_dtype_(np_dtype)
 
 
 def dtype_is_floating(dtype):
+    """One source of truth: core.dtype_is_floating (which includes BF16 —
+    this is a bf16-first framework — and coerces non-enum dtypes)."""
     from . import core
 
-    return dtype in (core.VarDesc.VarType.FP16, core.VarDesc.VarType.FP32,
-                     core.VarDesc.VarType.FP64)
+    return core.dtype_is_floating(dtype)
 
 
 def cuda_pinned_places(device_count=None):

@@ -338,21 +338,24 @@ def is_belong_to_optimizer(var):
 
 def get_parameter_value(para, executor):
     """reference: io.py get_parameter_value — read a parameter's current
-    value from the executor's scope."""
-    from . import core
-    import numpy as np
-
-    scope = core.global_scope()
-    return np.asarray(scope.get(para.name))
+    value from the (possibly scope_guard-switched) global scope."""
+    return get_parameter_value_by_name(para.name, executor)
 
 
 def get_parameter_value_by_name(name, executor, program=None):
-    """reference: io.py get_parameter_value_by_name."""
+    """reference: io.py get_parameter_value_by_name. Raises on a missing
+    variable instead of silently wrapping None (the parameter may live
+    in a scope_guard scope that is no longer active)."""
     from . import core
     import numpy as np
 
-    scope = core.global_scope()
-    return np.asarray(scope.get(name))
+    val = core.global_scope().get(name)
+    if val is None:
+        raise ValueError(
+            "variable %r not found in the current global scope (was the "
+            "program run inside a scope_guard that has since exited?)"
+            % name)
+    return np.asarray(val)
 
 
 def prepend_feed_ops(inference_program, feed_target_names,

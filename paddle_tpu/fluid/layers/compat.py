@@ -15,6 +15,7 @@ from ..initializer import Xavier
 from ..framework import Variable
 
 __all__ = [
+    "adaptive_pool3d",
     "add_position_encoding",
     "bilinear_tensor_product",
     "box_decoder_and_assign",
@@ -49,6 +50,13 @@ __all__ = [
     "rpn_target_assign",
     "similarity_focus",
     "size",
+    "sum",
+    "tensor_array_to_tensor",
+    "teacher_student_sigmoid_loss",
+    "uniform_random",
+    "yolov3_loss",
+    "generate_proposal_labels",
+    "generate_mask_labels",
 ]
 
 
@@ -719,3 +727,165 @@ def similarity_focus(input, axis, indexes, name=None):
 def size(input):
     """reference: nn.py:13902 over size_op.cc (total element count)."""
     return _single_out("size", {"Input": [input]}, dtype="int64")
+
+
+def sum(x):
+    """reference: layers/tensor.py sum over sum_op (add a list of
+    tensors; single-tensor input passes through the op too)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return _single_out("sum", {"X": list(xs)}, dtype=xs[0].dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    """reference: layers/nn.py uniform_random. Determinism rides
+    ``program.random_seed`` (repo-wide RNG design); the per-op seed is
+    accepted for parity."""
+    from .. import core
+
+    return _single_out(
+        "uniform_random", {},
+        {"shape": [int(s) for s in shape], "min": float(min),
+         "max": float(max), "seed": seed,
+         "dtype": core.np_to_dtype(np.dtype(dtype))},
+        dtype=dtype,
+    )
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """reference: layers/tensor.py tensor_array_to_tensor over
+    tensor_array_to_tensor_op; -> (out, out_index)."""
+    helper = LayerHelper("tensor_array_to_tensor")
+    out = helper.create_variable_for_type_inference(
+        dtype=getattr(input, "dtype", "float32"))
+    out_index = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="tensor_array_to_tensor",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "OutIndex": [out_index]},
+        attrs={"axis": axis, "use_stack": use_stack},
+    )
+    return out, out_index
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """reference: detection.py yolov3_loss over yolov3_loss_op.cc."""
+    helper = LayerHelper("yolov3_loss")
+    loss = helper.create_variable_for_type_inference(dtype=x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(dtype=x.dtype)
+    match_mask = helper.create_variable_for_type_inference(dtype="int32")
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op(
+        type="yolov3_loss",
+        inputs=inputs,
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [match_mask]},
+        attrs={
+            "anchors": list(anchors),
+            "anchor_mask": list(anchor_mask),
+            "class_num": class_num,
+            "ignore_thresh": ignore_thresh,
+            "downsample_ratio": downsample_ratio,
+            "use_label_smooth": use_label_smooth,
+        },
+    )
+    return loss
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """reference: detection.py generate_proposal_labels over
+    generate_proposal_labels_op.cc; -> (rois, labels_int32, bbox_targets,
+    bbox_inside_weights, bbox_outside_weights)."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference(dtype=rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference(dtype="int32")
+    targets = helper.create_variable_for_type_inference(
+        dtype=rpn_rois.dtype)
+    inw = helper.create_variable_for_type_inference(dtype=rpn_rois.dtype)
+    outw = helper.create_variable_for_type_inference(dtype=rpn_rois.dtype)
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+              "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs=inputs,
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [targets], "BboxInsideWeights": [inw],
+                 "BboxOutsideWeights": [outw]},
+        attrs={
+            "batch_size_per_im": batch_size_per_im,
+            "fg_fraction": fg_fraction,
+            "fg_thresh": fg_thresh,
+            "bg_thresh_hi": bg_thresh_hi,
+            "bg_thresh_lo": bg_thresh_lo,
+            "class_nums": class_nums or 81,
+            "use_random": use_random,
+            "bbox_reg_weights": list(bbox_reg_weights),
+        },
+    )
+    return rois, labels, targets, inw, outw
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """reference: detection.py generate_mask_labels over
+    generate_mask_labels_op.cc; -> (mask_rois, roi_has_mask_int32,
+    mask_int32)."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference(dtype=rois.dtype)
+    has_mask = helper.create_variable_for_type_inference(dtype="int32")
+    mask_int32 = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"ImInfo": [im_info], "GtClasses": [gt_classes],
+                "GtSegms": [gt_segms], "Rois": [rois],
+                "LabelsInt32": [labels_int32]},
+        outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask_int32]},
+        attrs={"num_classes": num_classes, "resolution": resolution},
+    )
+    return mask_rois, has_mask, mask_int32
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: loss.py teacher_student_sigmoid_loss over
+    teacher_student_sigmoid_loss_op.cc."""
+    return _single_out(
+        "teacher_student_sigmoid_loss",
+        {"X": [input], "Label": [label]},
+        {"soft_max_up_bound": soft_max_up_bound,
+         "soft_max_lower_bound": soft_max_lower_bound},
+        dtype=input.dtype, out_slot="Y",
+    )
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    """reference: nn.py:3984 over pool3d_op with adaptive=True."""
+    if require_index:
+        raise ValueError(
+            "adaptive_pool3d: require_index is not supported here "
+            "(max_pool3d_with_index covers the indexed variant)")
+    sizes = (pool_size if isinstance(pool_size, (list, tuple))
+             else [pool_size] * 3)
+    return _single_out(
+        "pool3d", {"X": [input]},
+        {"ksize": [int(s) for s in sizes], "pooling_type": pool_type,
+         "adaptive": True, "strides": [1, 1, 1], "paddings": [0, 0, 0],
+         "global_pooling": False},
+        dtype=input.dtype,
+    )

@@ -1358,6 +1358,15 @@ def _generate_proposal_labels_host(ctx, op_):
     rois = _np_val(ctx, op_.input("RpnRois")[0]).reshape(-1, 4)
     gt_classes = _np_val(ctx, op_.input("GtClasses")[0]).reshape(-1)
     gt_boxes = _np_val(ctx, op_.input("GtBoxes")[0]).reshape(-1, 4)
+    if op_.input("IsCrowd"):
+        # crowd gt regions never become fg targets (reference crowd
+        # handling); drop them before the IoU assignment
+        crowd = _np_val(ctx, op_.input("IsCrowd")[0]).reshape(-1) > 0
+        gt_boxes = gt_boxes[~crowd]
+        gt_classes = gt_classes[~crowd]
+    reg_w = np.asarray(
+        op_.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]), np.float32
+    )
     batch_size = int(op_.attr("batch_size_per_im", 256))
     fg_frac = float(op_.attr("fg_fraction", 0.25))
     fg_thresh = float(op_.attr("fg_thresh", 0.5))
@@ -1391,12 +1400,12 @@ def _generate_proposal_labels_host(ctx, op_):
         ah = max(a[3] - a[1] + 1, 1.0)
         gw = max(g[2] - g[0] + 1, 1.0)
         gh = max(g[3] - g[1] + 1, 1.0)
-        d = [
+        d = np.asarray([
             ((g[0] + gw / 2) - (a[0] + aw / 2)) / aw,
             ((g[1] + gh / 2) - (a[1] + ah / 2)) / ah,
             np.log(gw / aw),
             np.log(gh / ah),
-        ]
+        ], np.float32) / reg_w  # reference: deltas normalized by weights
         c = int(labels[i])
         tgt[i, 4 * c:4 * c + 4] = d
         inw[i, 4 * c:4 * c + 4] = 1.0

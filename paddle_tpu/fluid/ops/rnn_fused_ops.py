@@ -353,6 +353,18 @@ def _pool3d(ctx, op_):
     ksize = _triple(op_.attr("ksize"))
     strides = _triple(op_.attr("strides", [1, 1, 1]))
     pads = _triple(op_.attr("paddings", [0, 0, 0]))
+    if op_.attr("adaptive", False):
+        # adaptive pooling: ksize holds the TARGET output sizes; static
+        # lowering needs divisible dims (same contract as pool2d here)
+        spatial = x.shape[2:]
+        for d, o in zip(spatial, ksize):
+            if d % o != 0:
+                raise ValueError(
+                    "adaptive pool3d requires divisible dims for the "
+                    "static lowering, got %s -> %s" % (spatial, ksize))
+        ksize = [d // o for d, o in zip(spatial, ksize)]
+        strides = list(ksize)
+        pads = [0, 0, 0]
     dims = (1, 1) + tuple(ksize)
     strd = (1, 1) + tuple(strides)
     padding = [(0, 0), (0, 0)] + [(p, p) for p in pads]

@@ -325,3 +325,43 @@ def test_distributions():
     np.testing.assert_allclose(
         me.ravel()[0], 0.5 * (2 * (1 + math.log(2 * math.pi))
                               + math.log(4.0)), rtol=1e-5)
+
+
+def test_compat_batch2_layers():
+    """sum/uniform_random/teacher_student/adaptive_pool3d/yolov3_loss
+    wrappers run end-to-end."""
+    def build():
+        a = fluid.data(name="a", shape=[2, 3], dtype="float32")
+        b = fluid.data(name="b", shape=[2, 3], dtype="float32")
+        s = fluid.layers.sum([a, b])
+        u = fluid.layers.uniform_random([4, 5], min=2.0, max=3.0)
+        t = fluid.layers.teacher_student_sigmoid_loss(
+            fluid.data(name="lg", shape=[4, 1], dtype="float32"),
+            fluid.data(name="lb", shape=[4, 1], dtype="float32"))
+        v = fluid.data(name="v3", shape=[1, 2, 4, 6, 6], dtype="float32")
+        ap = fluid.layers.adaptive_pool3d(v, [2, 3, 3], pool_type="avg")
+        yx = fluid.data(name="yx", shape=[1, 12, 4, 4], dtype="float32")
+        ygb = fluid.data(name="ygb", shape=[1, 2, 4], dtype="float32")
+        ygl = fluid.data(name="ygl", shape=[1, 2], dtype="int32")
+        yl = fluid.layers.yolov3_loss(
+            yx, ygb, ygl, anchors=[10, 13, 16, 30], anchor_mask=[0, 1],
+            class_num=1, ignore_thresh=0.7, downsample_ratio=32)
+        return [s, u, t, ap, yl]
+
+    rs = np.random.RandomState(0)
+    s, u, t, ap, yl = _run(build, {
+        "a": np.ones((2, 3), "float32"),
+        "b": 2 * np.ones((2, 3), "float32"),
+        "lg": rs.rand(4, 1).astype("float32"),
+        "lb": rs.rand(4, 1).astype("float32"),
+        "v3": rs.rand(1, 2, 4, 6, 6).astype("float32"),
+        "yx": rs.rand(1, 12, 4, 4).astype("float32"),
+        "ygb": np.array([[[0.5, 0.5, 0.2, 0.2], [0.3, 0.7, 0.1, 0.1]]],
+                        "float32"),
+        "ygl": np.zeros((1, 2), "int32"),
+    })
+    np.testing.assert_allclose(s, 3 * np.ones((2, 3)))
+    assert u.shape == (4, 5) and u.min() >= 2.0 and u.max() <= 3.0
+    assert np.isfinite(t).all()
+    assert ap.shape == (1, 2, 2, 3, 3)
+    assert np.isfinite(yl).all()
