@@ -848,11 +848,14 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
     mask_rois = helper.create_variable_for_type_inference(dtype=rois.dtype)
     has_mask = helper.create_variable_for_type_inference(dtype="int32")
     mask_int32 = helper.create_variable_for_type_inference(dtype="int32")
+    inputs = {"ImInfo": [im_info], "GtClasses": [gt_classes],
+              "GtSegms": [gt_segms], "Rois": [rois],
+              "LabelsInt32": [labels_int32]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
     helper.append_op(
         type="generate_mask_labels",
-        inputs={"ImInfo": [im_info], "GtClasses": [gt_classes],
-                "GtSegms": [gt_segms], "Rois": [rois],
-                "LabelsInt32": [labels_int32]},
+        inputs=inputs,
         outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
                  "MaskInt32": [mask_int32]},
         attrs={"num_classes": num_classes, "resolution": resolution},

@@ -1358,6 +1358,15 @@ def _generate_proposal_labels_host(ctx, op_):
     rois = _np_val(ctx, op_.input("RpnRois")[0]).reshape(-1, 4)
     gt_classes = _np_val(ctx, op_.input("GtClasses")[0]).reshape(-1)
     gt_boxes = _np_val(ctx, op_.input("GtBoxes")[0]).reshape(-1, 4)
+    if op_.input("ImInfo"):
+        # rpn rois arrive in RESIZED-image coordinates; gt boxes are in
+        # original coordinates — scale back before the IoU assignment
+        # (reference generate_proposal_labels_op.cc im_scale handling)
+        im_scale = float(
+            _np_val(ctx, op_.input("ImInfo")[0]).reshape(-1, 3)[0, 2]
+        )
+        if im_scale not in (0.0, 1.0):
+            rois = rois / im_scale
     if op_.input("IsCrowd"):
         # crowd gt regions never become fg targets (reference crowd
         # handling); drop them before the IoU assignment
@@ -1449,6 +1458,12 @@ def _generate_mask_labels_host(ctx, op_):
     gt_segms = _np_val(ctx, op_.input("GtSegms")[0])
     rois = _np_val(ctx, op_.input("Rois")[0]).reshape(-1, 4)
     label_int32 = _np_val(ctx, op_.input("LabelsInt32")[0]).reshape(-1)
+    crowd_mask = None
+    if op_.input("IsCrowd"):
+        # crowd segments never become mask targets (reference parity)
+        crowd_mask = (
+            _np_val(ctx, op_.input("IsCrowd")[0]).reshape(-1) > 0
+        )
     num_classes = int(op_.attr("num_classes", 81))
     resolution = int(op_.attr("resolution", 14))
     fg = np.where(label_int32 > 0)[0]
@@ -1488,6 +1503,8 @@ def _generate_mask_labels_host(ctx, op_):
         seg = None
         if len(poly_boxes):
             ious = _iou_matrix(rois[ri][None], poly_boxes, normalized=False)[0]
+            if crowd_mask is not None:
+                ious = np.where(crowd_mask[: len(ious)], -1.0, ious)
             g = int(np.argmax(ious))
             if ious[g] > 0 and len(polys[g]) >= 3:
                 seg = polys[g]

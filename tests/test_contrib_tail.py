@@ -149,3 +149,30 @@ def test_lookup_table_utils_convert():
           if o.type == "lookup_table"][0]
     assert not op.attr("is_distributed")
     assert op.attr("is_sparse")
+
+
+def test_adamw_honors_grad_clip():
+    """The decoupled-decay minimize must still apply grad_clip (it
+    overrides the base minimize that normally does)."""
+    AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.SGD)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[2, 3], dtype="float32")
+        h = fluid.layers.fc(input=x, size=2, bias_attr=False)
+        loss = fluid.layers.reduce_sum(h)
+        opt = AdamW(weight_decay=0.0, learning_rate=1.0)
+        opt.minimize(loss, startup_program=startup,
+                     grad_clip=fluid.clip.GradientClipByValue(
+                         max=0.01, min=-0.01))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("fc_0.w_0")).copy()
+        exe.run(main, feed={"x": np.ones((2, 3), "float32") * 10},
+                fetch_list=[loss])
+        w1 = np.asarray(scope.get("fc_0.w_0"))
+    # raw grad per weight = sum over batch of x = 20; clipped to 0.01 so
+    # the lr=1 step moves each weight by exactly 0.01
+    np.testing.assert_allclose(w0 - w1, np.full((3, 2), 0.01), rtol=1e-5)

@@ -365,3 +365,60 @@ def test_compat_batch2_layers():
     assert np.isfinite(t).all()
     assert ap.shape == (1, 2, 2, 3, 3)
     assert np.isfinite(yl).all()
+
+
+def test_proposal_and_mask_labels_pipeline():
+    """generate_proposal_labels (im_scale + crowd + reg-weight handling)
+    and generate_mask_labels + tensor_array_to_tensor coverage."""
+    def build():
+        rois = fluid.data(name="rr", shape=[3, 4], dtype="float32")
+        gtc = fluid.data(name="gc", shape=[2, 1], dtype="int32")
+        crowd = fluid.data(name="cw", shape=[2, 1], dtype="int32")
+        gtb = fluid.data(name="gb", shape=[2, 4], dtype="float32")
+        iminfo = fluid.data(name="ii", shape=[1, 3], dtype="float32")
+        outs = fluid.layers.generate_proposal_labels(
+            rois, gtc, crowd, gtb, iminfo, batch_size_per_im=4,
+            fg_thresh=0.5, class_nums=3, use_random=False)
+        segs = fluid.data(name="sg", shape=[8, 2], dtype="float32")
+        m_rois, has_mask, mask = fluid.layers.generate_mask_labels(
+            iminfo, gtc, crowd, segs, outs[0], outs[1], num_classes=3,
+            resolution=4)
+        return [outs[0], outs[1], outs[2], m_rois, mask]
+
+    # rois are in 2x-RESIZED coords; gts in original coords. gt0 is
+    # crowd (excluded); roi0 maps onto gt1 exactly after descaling.
+    rois_v = np.array([[0, 0, 20, 20], [40, 40, 60, 60],
+                       [2, 2, 10, 10]], "float32")
+    r, labels, tgt, m_rois, mask = _run(build, {
+        "rr": rois_v,
+        "gc": np.array([[1], [2]], "int32"),
+        "cw": np.array([[1], [0]], "int32"),
+        "gb": np.array([[0, 0, 5, 5], [0, 0, 10, 10]], "float32"),
+        "ii": np.array([[100, 100, 2.0]], "float32"),
+        "sg": np.array([[0, 0], [10, 0], [10, 10], [0, 10],
+                        [0, 0], [5, 0], [5, 5], [0, 5]], "float32"),
+    })
+    labels = np.asarray(labels).ravel()
+    # the descaled roi0 ([0,0,10,10]) hits gt1 (class 2) at IoU 1.0; the
+    # crowd gt0 never labels anything
+    assert 2 in labels.tolist()
+    assert 1 not in labels.tolist()
+    # fg targets normalized by the default bbox_reg_weights (0.1 -> 10x)
+    assert np.isfinite(np.asarray(tgt)).all()
+    assert np.asarray(mask).size > 0
+
+
+def test_tensor_array_to_tensor_roundtrip():
+    def build():
+        x = fluid.data(name="tat", shape=[2, 3], dtype="float32")
+        arr = fluid.layers.create_array(dtype="float32")
+        i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        fluid.layers.array_write(x, i0, array=arr)
+        fluid.layers.array_write(x, i1, array=arr)
+        out, idx = fluid.layers.tensor_array_to_tensor(arr, axis=0)
+        return [out, idx]
+
+    out, idx = _run(build, {"tat": np.arange(6).reshape(2, 3)
+                            .astype("float32")})
+    assert np.asarray(out).shape == (4, 3)  # two [2,3] entries on axis 0
