@@ -119,3 +119,16 @@ class CommContext(object):
 
     def has(self, ring_id=0):
         return int(ring_id) in self._meshes
+
+
+def pad_to_multiple(flat, n):
+    """Zero-pad a 1-D array to a multiple of n (collective tiling
+    helper shared by optimizer_sharding / quantized_allreduce).
+    -> (padded, original_size)."""
+    import jax.numpy as jnp
+
+    size = flat.shape[0]
+    pad = (-size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, size

@@ -1,0 +1,82 @@
+"""Quantized all-reduce (EQuARX-style; arXiv:2506.17615, PAPERS.md).
+
+Gradient all-reduce is bandwidth-bound on large models. EQuARX's core
+idea: run the reduce-scatter + all-gather decomposition of the
+all-reduce with the WIRE payload quantized to int8 against per-block
+scales, dequantizing around the arithmetic so accumulation stays fp32:
+
+  1. per-shard: split the flat tensor into dp blocks, compute each
+     block's absmax scale, quantize to int8,
+  2. all_to_all the quantized blocks + scales (every device receives
+     the k-th block of every peer — the reduce-scatter's traffic at
+     ~1/4 the bytes for fp32 inputs),
+  3. dequantize and SUM in fp32 (no int overflow, no bias),
+  4. re-quantize the reduced block and all_gather it (+ scales),
+  5. dequantize to the output dtype.
+
+The same ICI hop pattern as a plain psum, with payloads 8-bit on both
+halves. Exact arithmetic happens in fp32; the only loss is the two
+quantization roundings, bounded by absmax/127 per block — acceptable
+for gradients (DGC already ships far more aggressive compression; this
+is the milder, fleet-friendly option).
+
+Usage inside shard_map:  g_sum = quantized_psum(g, axis_name="data")
+"""
+
+from __future__ import annotations
+
+
+def _quantize(x, axis=-1):
+    """-> (int8 values, fp32 scales) with absmax scaling per row."""
+    import jax.numpy as jnp
+
+    absmax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantized_psum(x, axis_name="data", postscale=1.0):
+    """int8-wire all-reduce SUM of ``x`` over ``axis_name`` (shape and
+    dtype preserved; accumulation in fp32). ``postscale`` folds an
+    output factor (e.g. 1/n for a mean) into the fp32 stage — strictly
+    more accurate than scaling after the final dtype cast."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from .mesh import pad_to_multiple
+
+    n = lax.psum(1, axis_name)
+    flat, size = pad_to_multiple(x.astype(jnp.float32).reshape(-1), n)
+    blocks = flat.reshape(n, -1)                       # [n, B]
+
+    # 1. quantize each destination block
+    q, scale = _quantize(blocks, axis=-1)              # [n, B], [n, 1]
+
+    # 2. exchange: device d receives block d of every peer
+    q_recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True).reshape(n, -1)   # [n peers, B]
+    s_recv = lax.all_to_all(scale, axis_name, split_axis=0,
+                            concat_axis=0, tiled=True).reshape(n, 1)
+
+    # 3. dequantize + fp32 sum across peers (postscale folded in here)
+    reduced = jnp.sum(q_recv.astype(jnp.float32) * s_recv, axis=0)  # [B]
+    if postscale != 1.0:
+        reduced = reduced * postscale
+
+    # 4. second quantized hop: broadcast the reduced block to everyone
+    q2, s2 = _quantize(reduced[None, :], axis=-1)
+    q_all = lax.all_gather(q2[0], axis_name, tiled=True).reshape(n, -1)
+    s_all = lax.all_gather(s2[0], axis_name, tiled=True).reshape(n, 1)
+
+    # 5. dequantize, reassemble, restore shape/dtype
+    out = (q_all.astype(jnp.float32) * s_all).reshape(-1)[:size]
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def quantized_pmean(x, axis_name="data"):
+    """int8-wire all-reduce MEAN (the 1/n rides the fp32 stage)."""
+    import jax.lax as lax
+
+    n = lax.psum(1, axis_name)
+    return quantized_psum(x, axis_name, postscale=1.0 / n)

@@ -76,11 +76,10 @@ def test_sharded_state_is_actually_sharded():
     _step, opt_state = osh.build_data_parallel_step(
         mesh, _grad_fn, osh.sharded_momentum(0.1), params,
         n_states_per_param=1)
-    sizes = {k: int(np.prod(v.shape)) for k, v in params.items()}
-    expect = [(4, (s + (-s) % 4) // 4) for s in
-              [sizes["b1"], sizes["w1"], sizes["w2"]]]
-    got = sorted(tuple(s.shape) for s in opt_state)
-    assert got == sorted(expect), (got, expect)
+    total = sum(int(np.prod(v.shape)) for v in params.values())
+    shard = (total + (-total) % 4) // 4
+    # FUSED layout: one [n, ceil(total/n)] leaf per state tensor
+    assert [tuple(s.shape) for s in opt_state] == [(4, shard)]
 
 
 def test_sharded_sgd_and_adam_run():
