@@ -27,6 +27,10 @@ import os
 _DEFAULTS = {
     # numerics / debugging
     "check_nan_inf": False,
+    # int8-wire gradient allreduce (EQuARX-style,
+    # parallel/quantized_allreduce.py): c_allreduce_sum on the data axis
+    # quantizes its payload when enabled
+    "quantized_allreduce": False,
     "fast_check_nan_inf": False,
     "benchmark": False,
     "cpu_deterministic": False,

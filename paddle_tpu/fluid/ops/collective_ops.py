@@ -48,7 +48,36 @@ def _pprod(lax, x, a):
     return jnp.prod(lax.all_gather(x, a, axis=0), axis=0)
 
 
-_register_allreduce("c_allreduce_sum", lambda lax, x, a: lax.psum(x, a))
+def _c_allreduce_sum_lower(ctx, op_):
+    """c_allreduce_sum with the optional int8-wire path.
+
+    FLAGS_quantized_allreduce=1 routes sums over the DATA axis (ring 0
+    — the gradient allreduce) through the quantized collective
+    (parallel/quantized_allreduce.py); sums on other rings (model/
+    hierarchical partial sums, forward activations) always stay exact.
+    The flag is read at TRACE time: it bakes into the compiled
+    executable, so set it before building/running the program (the
+    standard gflags contract — flags configure lowering, not dispatch).
+    The quantized collective carries a straight-through custom vjp, so
+    differentiating through it behaves like the exact psum."""
+    import jax.lax as lax
+
+    from ..flags import get_flag
+
+    x = ctx.in1(op_, "X")
+    axis = _axis_for(ctx, op_)
+    if axis is not None:
+        if axis == ctx.data_axis and get_flag("quantized_allreduce"):
+            from ...parallel.quantized_allreduce import quantized_psum
+
+            x = quantized_psum(x, axis_name=axis)
+        else:
+            x = lax.psum(x, axis)
+    ctx.out(op_, "Out", x)
+
+
+op("c_allreduce_sum", infer_shape=same_shape_infer("X"),
+   grad="generic")(_c_allreduce_sum_lower)
 _register_allreduce("c_allreduce_max", lambda lax, x, a: lax.pmax(x, a))
 _register_allreduce("c_allreduce_min", lambda lax, x, a: lax.pmin(x, a))
 _register_allreduce("c_allreduce_prod", _pprod)

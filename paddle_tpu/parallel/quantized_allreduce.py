@@ -25,6 +25,8 @@ Usage inside shard_map:  g_sum = quantized_psum(g, axis_name="data")
 
 from __future__ import annotations
 
+import functools
+
 
 def _quantize(x, axis=-1):
     """-> (int8 values, fp32 scales) with absmax scaling per row."""
@@ -36,11 +38,34 @@ def _quantize(x, axis=-1):
     return q, scale.astype(jnp.float32)
 
 
+@functools.partial(__import__("jax").custom_vjp, nondiff_argnums=(1, 2))
 def quantized_psum(x, axis_name="data", postscale=1.0):
     """int8-wire all-reduce SUM of ``x`` over ``axis_name`` (shape and
     dtype preserved; accumulation in fp32). ``postscale`` folds an
     output factor (e.g. 1/n for a mean) into the fp32 stage — strictly
-    more accurate than scaling after the final dtype cast."""
+    more accurate than scaling after the final dtype cast.
+
+    Differentiable with a straight-through gradient: the backward is the
+    EXACT psum's vjp (itself a psum), so differentiating through a
+    quantized forward sum never zeroes gradients on the round/clip."""
+    return _quantized_psum_impl(x, axis_name, postscale)
+
+
+def _quantized_psum_fwd(x, axis_name, postscale):
+    return _quantized_psum_impl(x, axis_name, postscale), None
+
+
+def _quantized_psum_bwd(axis_name, postscale, _res, g):
+    import jax.lax as lax
+
+    # vjp of (psum . scale): psum of the cotangent, scaled
+    return (lax.psum(g, axis_name) * postscale,)
+
+
+quantized_psum.defvjp(_quantized_psum_fwd, _quantized_psum_bwd)
+
+
+def _quantized_psum_impl(x, axis_name, postscale):
     import jax.lax as lax
     import jax.numpy as jnp
 
