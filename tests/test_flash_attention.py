@@ -440,3 +440,34 @@ def test_flash_fallback_warning_on_dropout():
         bert.build_bert_classifier(cfg, 16, learning_rate=1e-3)
     msgs = [str(x.message) for x in w if "falling back to dense" in str(x.message)]
     assert len(msgs) == 1, msgs  # once per config, not per layer
+
+
+@pytest.mark.parametrize("shape", [
+    (1, 2, 1, 64, 33),     # Sq=1 decode step vs long KV (pad 1 -> 8)
+    (2, 2, 9, 9, 20),      # odd head dim, tiny odd seqs
+    (1, 1, 300, 260, 16),  # multi-block on BOTH axes with ragged tails
+])
+def test_flash_edge_shapes(shape):
+    """Kernel-path parity on awkward geometries: the single-query decode
+    shape GPT-style generation hits, non-multiple-of-8 head dims, and
+    multi-block padding on both seq axes."""
+    B, N, Sq, Sk, D = shape
+    rs = np.random.RandomState(hash(shape) % 2**31)
+    q = jnp.asarray(rs.rand(B, N, Sq, D).astype("float32") * 0.5)
+    k = jnp.asarray(rs.rand(B, N, Sk, D).astype("float32") * 0.5)
+    v = jnp.asarray(rs.rand(B, N, Sk, D).astype("float32") * 0.5)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # gradients too on the decode shape (the generation-time case)
+    if Sq == 1:
+        g = jax.grad(lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: jnp.sum(
+            reference_attention(a, b, c) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
