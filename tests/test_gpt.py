@@ -141,3 +141,25 @@ def test_gpt_flash_dropout_fallback_keeps_causal_mask():
         and any("att" in n for ns in op.inputs.values() for n in ns)
     ]
     assert att_adds, "attention scores were never biased (acausal!)"
+
+
+def test_gpt_greedy_generate_through_flash_kernel():
+    """Generation drives the CAUSAL kernel at full graph length with a
+    growing mask — the flash path must reproduce the dense path's greedy
+    tokens exactly."""
+    outs = {}
+    for flash in (False, True):
+        cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                                 use_flash_attention=flash)
+        cfg.flash_interpret = True
+        with fluid.unique_name.guard():
+            main, startup, names, logits = gpt.build_gpt_infer(cfg, 10)
+        main.random_seed = startup.random_seed = 9
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        with fluid.executor.scope_guard(scope):
+            exe.run(startup)
+            outs[flash] = gpt.greedy_generate(
+                exe, main, logits, cfg, [3, 7], 10, scope=scope)
+    assert outs[True] == outs[False]
+    assert len(outs[True]) == 10 and outs[True][:2] == [3, 7]
