@@ -1,6 +1,8 @@
 """Pure-Python weighted averaging (reference:
-python/paddle/fluid/average.py:40 WeightedAverage — no Program changes,
-just host-side accumulation)."""
+python/paddle/fluid/average.py:40 WeightedAverage — host-side metric
+accumulation, no Program involvement). The accumulator here is a single
+(weighted_sum, weight_sum) pair updated in one place; the reference's
+per-branch init/accumulate split collapses into it."""
 
 from __future__ import annotations
 
@@ -11,16 +13,14 @@ import numpy as np
 __all__ = ["WeightedAverage"]
 
 
-def _is_number_(var):
-    return (
-        isinstance(var, int)
-        or isinstance(var, float)
-        or (isinstance(var, np.ndarray) and var.shape == (1,))
+def _acceptable_value(v):
+    return isinstance(v, (int, float, np.ndarray))
+
+
+def _acceptable_weight(w):
+    return isinstance(w, (int, float)) or (
+        isinstance(w, np.ndarray) and w.shape == (1,)
     )
-
-
-def _is_number_or_matrix_(var):
-    return _is_number_(var) or isinstance(var, np.ndarray)
 
 
 class WeightedAverage(object):
@@ -33,25 +33,28 @@ class WeightedAverage(object):
         self.reset()
 
     def reset(self):
+        # exposed under the reference's attribute names
         self.numerator = None
         self.denominator = None
 
     def add(self, value, weight):
-        if not _is_number_or_matrix_(value):
+        if not _acceptable_value(value):
             raise ValueError(
                 "The 'value' must be a number(int, float) or a numpy "
                 "ndarray.")
-        if not _is_number_(weight):
+        if not _acceptable_weight(weight):
             raise ValueError("The 'weight' must be a number(int, float).")
-        if self.numerator is None or self.denominator is None:
-            self.numerator = value * weight
-            self.denominator = weight
+        contribution = value * weight
+        if self.numerator is None:
+            self.numerator, self.denominator = contribution, weight
         else:
-            self.numerator += value * weight
+            # in-place accumulate: a shape-growing value must ERROR (the
+            # reference's += contract), not silently broadcast
+            self.numerator += contribution
             self.denominator += weight
 
     def eval(self):
-        if self.numerator is None or self.denominator is None:
+        if self.denominator is None:
             raise ValueError(
                 "There is no data to be averaged in WeightedAverage.")
         return self.numerator / self.denominator

@@ -1,48 +1,52 @@
-"""Distributed lookup-table discovery helpers (reference:
-python/paddle/fluid/distribute_lookup_table.py — scan a Program for the
-single is_distributed lookup_table and its inputs/outputs; used by the
-transpiler and fleet wrappers)."""
+"""Distributed lookup-table discovery (reference:
+python/paddle/fluid/distribute_lookup_table.py — one distributed table
+per program; the transpiler/fleet wrappers locate it and its Ids/Out
+variables)."""
 
 from __future__ import annotations
 
 LOOKUP_TABLE_TYPE = "lookup_table"
 
 
-def find_distributed_lookup_table_inputs(program, table_name):
-    """Ids variables feeding the distributed table (reference :18)."""
-    local_vars = program.current_block().vars
-    inputs = []
+def _table_ops(program):
+    """The global block's lookup_table ops (shared filter)."""
     for op in program.global_block().ops:
         if op.type == LOOKUP_TABLE_TYPE:
-            if table_name == op.input("W")[0]:
-                inputs.extend([local_vars[name] for name in op.input("Ids")])
-    return inputs
-
-
-def find_distributed_lookup_table_outputs(program, table_name):
-    """Out variables produced by the distributed table (reference :37)."""
-    local_vars = program.current_block().vars
-    outputs = []
-    for op in program.global_block().ops:
-        if op.type == LOOKUP_TABLE_TYPE:
-            if table_name == op.input("W")[0]:
-                outputs.extend(
-                    [local_vars[name] for name in op.output("Out")]
-                )
-    return outputs
+            yield op
 
 
 def find_distributed_lookup_table(program):
     """The unique is_distributed table name, or None (reference :56)."""
-    table_name = None
-    for op in program.global_block().ops:
-        if op.type == LOOKUP_TABLE_TYPE:
-            if op.attr("is_distributed") is True:
-                if table_name is None:
-                    table_name = op.input("W")[0]
-                if table_name != op.input("W")[0]:
-                    raise RuntimeError(
-                        "all distributed lookup_table_ops should have "
-                        "only one table"
-                    )
-    return table_name
+    found = None
+    for op in _table_ops(program):
+        if op.attr("is_distributed") is True:
+            w = op.input("W")[0]
+            if found is None:
+                found = w
+            elif found != w:
+                raise RuntimeError(
+                    "all distributed lookup_table_ops should have "
+                    "only one table")
+    return found
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """Ids variables feeding the table (reference :18)."""
+    local_vars = program.current_block().vars
+    return [
+        local_vars[n]
+        for op in _table_ops(program)
+        if op.input("W")[0] == table_name
+        for n in op.input("Ids")
+    ]
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """Out variables the table produces (reference :37)."""
+    local_vars = program.current_block().vars
+    return [
+        local_vars[n]
+        for op in _table_ops(program)
+        if op.input("W")[0] == table_name
+        for n in op.output("Out")
+    ]
