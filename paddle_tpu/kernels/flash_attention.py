@@ -74,16 +74,17 @@ def reference_attention(q, k, v, bias=None, causal=False, scale=None):
     return jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
 
 
-def _scores(q_scaled, kblk, key_bias_vec, bias_blk, row_off, col_off,
+def _scores(q_scaled, kblk, key_bias_row, bias_blk, row_off, col_off,
             causal, block_q, block_k):
     """[BQ, BK] masked scores (q_scaled already carries the softmax
-    scale). Shared by all three kernels so forward and backward can never
+    scale; ``key_bias_row`` is a [1, BK] row that broadcasts over query
+    rows). Shared by all three kernels so forward and backward can never
     disagree on masking."""
     s = jax.lax.dot_general(
         q_scaled, kblk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    s = s + key_bias_vec[None, :]
+    s = s + key_bias_row
     if bias_blk is not None:
         s = s + bias_blk.astype(jnp.float32)
     if causal:
@@ -119,7 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, o_ref, lse_ref,
     for kb in range(n_kb):
         ks = slice(kb * block_k, (kb + 1) * block_k)
         s = _scores(
-            q, k_ref[0, ks, :].astype(jnp.float32), key_bias_ref[0, ks],
+            q, k_ref[0, ks, :].astype(jnp.float32), key_bias_ref[0, :, ks],
             None if bias_ref is None else bias_ref[0, :, ks],
             qi * block_q, kb * block_k, causal, block_q, block_k,
         )
@@ -134,7 +135,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, o_ref, lse_ref,
         m = m_new
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
@@ -145,8 +146,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
 
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)          # [BQ, D]
-    lse = lse_ref[0][:, None]                   # [BQ, 1]
-    delta = delta_ref[0][:, None]               # [BQ, 1]
+    lse = lse_ref[0]                            # [BQ, 1]
+    delta = delta_ref[0]                        # [BQ, 1]
     qi = pl.program_id(1)
     n_kb = kv_len // block_k
 
@@ -155,7 +156,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
         ks = slice(kb * block_k, (kb + 1) * block_k)
         kblk = k_ref[0, ks, :].astype(jnp.float32)
         s = _scores(
-            q, kblk, key_bias_ref[0, ks],
+            q, kblk, key_bias_ref[0, :, ks],
             None if bias_ref is None else bias_ref[0, :, ks],
             qi * block_q, kb * block_k, causal, block_q, block_k,
         )
@@ -184,12 +185,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
     h = pl.program_id(1)        # flat head index
     k = k_ref[0].astype(jnp.float32)            # [BK, D]
     v = v_ref[0].astype(jnp.float32)            # [BK, D]
-    key_bias_vec = key_bias_ref[0]              # [BK]
+    key_bias_row = key_bias_ref[0]              # [1, BK]
     n_qb = q_len // block_q
 
     dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    dkb = jnp.zeros((block_k,), jnp.float32)
+    dkb = jnp.zeros((1, block_k), jnp.float32)
     dbias = (
         None if dbias_ref is None
         else jnp.zeros((q_len, block_k), jnp.float32)
@@ -199,10 +200,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
         qs = slice(ib * block_q, (ib + 1) * block_q)
         q = q_ref[0, qs, :].astype(jnp.float32) * scale
         do = do_ref[0, qs, :].astype(jnp.float32)
-        lse = lse_ref[0, qs][:, None]
-        delta = delta_ref[0, qs][:, None]
+        lse = lse_ref[0, qs, :]                 # [BQ, 1]
+        delta = delta_ref[0, qs, :]             # [BQ, 1]
         s = _scores(
-            q, k, key_bias_vec,
+            q, k, key_bias_row,
             None if bias_ref is None else bias_ref[0, qs, :],
             ib * block_q, kb * block_k, causal, block_q, block_k,
         )
@@ -220,7 +221,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dkb = dkb + ds.sum(axis=0)
+        dkb = dkb + ds.sum(axis=0, keepdims=True)
         if dbias is not None:
             dbias = jax.lax.dynamic_update_slice(dbias, ds, (ib * block_q, 0))
 
@@ -286,7 +287,10 @@ def _prep(q, k, v, key_bias, bias, g=None):
 
 def _common_in_specs(pl, pltpu, geom, G, D):
     """in_specs for (q, k, v, key_bias[, bias]) shared by the two
-    (head, q-block)-grid kernels (forward and dq)."""
+    (head, q-block)-grid kernels (forward and dq). Vector operands ride
+    with an explicit singleton dim ([BN, 1, S] rows / [BN, S, 1] columns)
+    so every block's trailing two dims satisfy the Mosaic (8, 128) tiling
+    rule (a (1, S) block of a rank-2 array does not)."""
     B, N, Sq, Sk, Sqp, Skp, bq, bk = geom
     specs = [
         pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
@@ -295,7 +299,7 @@ def _common_in_specs(pl, pltpu, geom, G, D):
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, Skp, D), lambda h, i: (h, 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Skp), lambda h, i: (h, 0),
+        pl.BlockSpec((1, 1, Skp), lambda h, i: (h, 0, 0),
                      memory_space=pltpu.VMEM),
     ]
     if G is not None:
@@ -326,24 +330,24 @@ def _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale, interpret):
         scale=scale, causal=causal, kv_len=Skp, block_q=bq, block_k=bk,
     )
     in_specs = _common_in_specs(pl, pltpu, geom, G, D)
-    operands = [qf, kf, vf, kb] + ([bf] if bf is not None else [])
+    operands = [qf, kf, vf, kb[:, None, :]] + ([bf] if bf is not None else [])
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct((B * N, Sqp, D), q.dtype),
-            jax.ShapeDtypeStruct((B * N, Sqp), jnp.float32),
+            jax.ShapeDtypeStruct((B * N, Sqp, 1), jnp.float32),
         ],
         grid=(B * N, Sqp // bq),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda h, i: (h, i),
+            pl.BlockSpec((1, bq, 1), lambda h, i: (h, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         interpret=interpret,
     )(*operands)
-    return out[:, :Sq, :].reshape(B, N, Sq, D), lse[:, :Sq]
+    return out[:, :Sq, :].reshape(B, N, Sq, D), lse[:, :Sq, 0]
 
 
 def _no_bias(kernel):
@@ -388,17 +392,20 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
     )
     row_spec = pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
                             memory_space=pltpu.VMEM)
-    vec_spec = pl.BlockSpec((1, bq), lambda h, i: (h, i),
+    col_spec = pl.BlockSpec((1, bq, 1), lambda h, i: (h, i, 0),
                             memory_space=pltpu.VMEM)
+    kb3 = kb[:, None, :]                       # [BN, 1, Skp]
+    lse3 = lse_p[:, :, None]                   # [BN, Sqp, 1]
+    delta3 = delta[:, :, None]
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct((B * N, Sqp, D), q.dtype),
         grid=(B * N, Sqp // bq),
         in_specs=_common_in_specs(pl, pltpu, geom, G, D)
-        + [row_spec, vec_spec, vec_spec],
+        + [row_spec, col_spec, col_spec],
         out_specs=row_spec,
         interpret=interpret,
-    )(*([qf, kf, vf, kb] + ([bf] if bf is not None else []) + [gf, lse_p, delta]))
+    )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else []) + [gf, lse3, delta3]))
 
     # ---- dk/dv/dkey_bias/dbias: transposed (kv-block, head) grid ----
     group = None if G is None else (B * N) // G
@@ -423,7 +430,7 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
                      memory_space=pltpu.VMEM),       # k block
         pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
                      memory_space=pltpu.VMEM),       # v block
-        pl.BlockSpec((1, bk), lambda j, h: (h, j),
+        pl.BlockSpec((1, 1, bk), lambda j, h: (h, 0, j),
                      memory_space=pltpu.VMEM),       # key bias block
     ]
     if bf is not None:
@@ -434,22 +441,22 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
     in_specs += [
         pl.BlockSpec((1, Sqp, D), lambda j, h: (h, 0, 0),
                      memory_space=pltpu.VMEM),       # dO (full rows)
-        pl.BlockSpec((1, Sqp), lambda j, h: (h, 0),
+        pl.BlockSpec((1, Sqp, 1), lambda j, h: (h, 0, 0),
                      memory_space=pltpu.VMEM),       # lse
-        pl.BlockSpec((1, Sqp), lambda j, h: (h, 0),
+        pl.BlockSpec((1, Sqp, 1), lambda j, h: (h, 0, 0),
                      memory_space=pltpu.VMEM),       # delta
     ]
     out_shape = [
         jax.ShapeDtypeStruct((B * N, Skp, D), k.dtype),      # dk
         jax.ShapeDtypeStruct((B * N, Skp, D), v.dtype),      # dv
-        jax.ShapeDtypeStruct((B * N, Skp), jnp.float32),     # dkey_bias
+        jax.ShapeDtypeStruct((B * N, 1, Skp), jnp.float32),  # dkey_bias
     ]
     out_specs = [
         pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk), lambda j, h: (h, j),
+        pl.BlockSpec((1, 1, bk), lambda j, h: (h, 0, j),
                      memory_space=pltpu.VMEM),
     ]
     if bf is not None:
@@ -465,7 +472,7 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
         in_specs=in_specs,
         out_specs=out_specs,
         interpret=interpret,
-    )(*([qf, kf, vf, kb] + ([bf] if bf is not None else []) + [gf, lse_p, delta]))
+    )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else []) + [gf, lse3, delta3]))
     if bf is not None:
         dkf, dvf, dkb, dbias = outs
         dbias = dbias[:, :Sq, :Sk]
@@ -476,7 +483,7 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
     dq = dq[:, :Sq, :].reshape(q.shape)
     dk = dkf[:, :Sk, :].reshape(k.shape)
     dv = dvf[:, :Sk, :].reshape(v.shape)
-    dkey_bias = dkb[:, :Sk].astype(key_bias.dtype)
+    dkey_bias = dkb[:, 0, :Sk].astype(key_bias.dtype)
     return dq, dk, dv, dkey_bias, dbias
 
 

@@ -173,9 +173,11 @@ def playbook(deadline):
         return min(want, max(0.0, deadline - time.time()))
 
     # 1. the full bench ladder — banks everything it measures; skipped
-    #    once every bench goal is in the bank so a later window can spend
-    #    itself on the still-missing steps
-    bench_goals = ("resnet", "resnet_big", "bert384", "bert384_flash")
+    #    once every DENSE bench goal is in the bank so a later window can
+    #    spend itself on the still-missing steps (the flash rung has its
+    #    own dedicated step 2 — rerunning the 13-minute ladder just to
+    #    reach the final flash rung would waste a short window)
+    bench_goals = ("resnet", "resnet_big", "bert384")
     if not all(g0[k] for k in bench_goals) and slot(1550) > 120:
         budget = slot(1550)
         rc, tail = run_killable(
