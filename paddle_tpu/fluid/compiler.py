@@ -260,16 +260,17 @@ class CompiledProgram(object):
 
         mesh = self._get_mesh()
         self._apply_grad_allreduce(mesh)
-        key = (
-            id(self._program),
-            self._program._version,
-            tuple(sorted(feed.keys())),
-            tuple(fetch_names),
-            "spmd",
-            tuple(zip(mesh.axis_names, mesh.devices.shape)),
+        # executor-owned key helper: program-object key (no id-recycling
+        # aliasing) in the executor's bounded LRU (no unbounded pinning)
+        key = executor._cache_key(
+            self._program,
+            feed.keys(),
+            fetch_names,
+            extra=("spmd", tuple(zip(mesh.axis_names, mesh.devices.shape))),
         )
-        compiled = executor._cache.get(key)
-        if compiled is None or compiled.version != self._program._version:
+        compiled = executor._cache_get(key)
+        # _version is part of the key: a hit can never be stale
+        if compiled is None:
             mesh_axes = dict(
                 zip(mesh.axis_names, mesh.devices.shape)
             )
@@ -282,8 +283,8 @@ class CompiledProgram(object):
                 mesh_axes=mesh_axes,
                 mesh=mesh,
             )
-            executor._cache[key] = compiled
-        rng_key = executor._next_rng(self._program)
+            executor._cache_put(key, compiled)
+        rng_key = executor._next_rng(self._program, scope)
         outs = compiled.run(scope, feed, rng_key, executor.place)
         from .executor import _fetch_to_host
 

@@ -1024,8 +1024,9 @@ class Executor(object):
 
     def __init__(self, place=None):
         self.place = place if place is not None else core.CPUPlace()
-        self._cache = {}
-        self._step_counters = {}
+        from collections import OrderedDict
+
+        self._cache = OrderedDict()  # bounded LRU, see _cache_put
         self._closed = False
 
     def close(self):
@@ -1037,13 +1038,34 @@ class Executor(object):
         self._closed = True
         self._cache.clear()
 
-    def _cache_key(self, program, feed_names, fetch_names):
+    # compiled-program cache capacity. The cache key holds the Program
+    # OBJECT (identity hash), not id(program): a dead program's recycled
+    # id can then never alias a different program onto its compiled
+    # executable. The strong key pins the program — which is why the
+    # cache is a bounded LRU rather than an unbounded dict: a
+    # clone-per-eval loop (exe.run(main.clone(for_test=True)) each
+    # epoch) stays capped instead of growing for the executor's lifetime.
+    _CACHE_CAPACITY = 64
+
+    def _cache_key(self, program, feed_names, fetch_names, extra=()):
         return (
-            id(program),
+            program,
             program._version,
             tuple(sorted(feed_names)),
             tuple(fetch_names),
-        )
+        ) + tuple(extra)
+
+    def _cache_get(self, key):
+        compiled = self._cache.get(key)
+        if compiled is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        return compiled
+
+    def _cache_put(self, key, compiled):
+        self._cache[key] = compiled
+        self._cache.move_to_end(key)
+        while len(self._cache) > self._CACHE_CAPACITY:
+            self._cache.popitem(last=False)
 
     def run(
         self,
@@ -1095,8 +1117,9 @@ class Executor(object):
         feed.update(extra)
 
         key = self._cache_key(program, feed.keys(), fetch_names)
-        compiled = self._cache.get(key) if use_program_cache else None
-        if compiled is None or compiled.version != program._version:
+        compiled = self._cache_get(key) if use_program_cache else None
+        # _version is part of the key: a hit can never be stale
+        if compiled is None:
             if getattr(program, "_pipeline_config", None):
                 from . import pipeline as _pipeline
 
@@ -1108,9 +1131,9 @@ class Executor(object):
                     program, 0, list(feed.keys()), fetch_names, self.place
                 )
             if use_program_cache:
-                self._cache[key] = compiled
+                self._cache_put(key, compiled)
 
-        rng_key = self._next_rng(program)
+        rng_key = self._next_rng(program, scope)
         outs = compiled.run(scope, feed, rng_key, self.place)
         outs = [None if o is None else _fetch_to_host(o) for o in outs]
         if return_numpy:
@@ -1119,14 +1142,35 @@ class Executor(object):
             None if o is None else core.LoDTensor(np.asarray(o)) for o in outs
         ]
 
-    def _next_rng(self, program):
+    def _next_rng(self, program, scope):
+        """Per-run PRNG base key: fold_in(key(seed or 12345), run_index),
+        with the run index counted PER (scope, program).
+
+        Why per-scope: the reference fixes each random op's ``seed`` attr
+        at build time from Program.random_seed, so a seeded startup
+        re-initializes a fresh scope identically every time — and every
+        process in a pserver/trainer cluster agrees bit-for-bit (their
+        startup is always that scope's run 0). Counting runs per scope
+        preserves exactly that observable (fresh scope -> same init)
+        while a seeded MAIN program still gets a DIFFERENT key each
+        training step, so dropout masks / flash-attention dropout seeds /
+        sampled negatives vary per step yet replay identically across
+        process restarts."""
         import jax
 
+        import weakref
+
         seed = program._seed or 0
-        step = self._step_counters.get(id(program), 0)
-        self._step_counters[id(program)] = step + 1
-        base = jax.random.key(seed if seed else 12345)
-        return jax.random.fold_in(base, step)
+        # counters live ON the program, weakly keyed by scope: no id()
+        # aliasing when a dead Program's id is recycled (a fresh program's
+        # first run in any scope is ALWAYS run 0 — the cluster init-parity
+        # invariant), and both sides garbage-collect naturally
+        counters = program.__dict__.setdefault(
+            "_rng_run_counters", weakref.WeakKeyDictionary()
+        )
+        step = counters.get(scope, 0)
+        counters[scope] = step + 1
+        return jax.random.fold_in(jax.random.key(seed or 12345), step)
 
     # reference API compat
     def infer_from_dataset(self, *args, **kwargs):

@@ -581,7 +581,11 @@ class Program(object):
             {
                 k: v
                 for k, v in self.__dict__.items()
-                if k not in ("blocks",)
+                # _rng_run_counters must NOT be shared: a clone is a new
+                # program whose first run in any scope is run 0 (sharing
+                # would make training dropout streams depend on how often
+                # a for_test clone was evaluated in between)
+                if k not in ("blocks", "_rng_run_counters")
             }
         )
         p._params_grads = list(self._params_grads)

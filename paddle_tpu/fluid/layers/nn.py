@@ -1346,14 +1346,17 @@ def _seq_one_in(op_type, x, attrs=None, out_slot="Out", extra_inputs=None,
 
 
 def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
-                    scale=0.0, interpret=False, name=None):
+                    scale=0.0, dropout_rate=0.0, is_test=False,
+                    interpret=False, name=None):
     """Fused online-softmax attention over [N, heads, S, d_head] tensors
     (Pallas kernel on TPU — forward and backward, no [S, S] tensor ever
     reaches HBM; jnp reference elsewhere; reference analog: the
     fused_multihead_matmul CUDA op). ``key_bias``: optional [N, S]
     additive key mask; ``bias``: optional general additive bias
     broadcastable to [N, heads, S, S] (relative-position / ALiBi);
-    ``scale`` 0 means 1/sqrt(d_head)."""
+    ``scale`` 0 means 1/sqrt(d_head). ``dropout_rate``: in-kernel
+    attention-probability dropout (seeded per step from the executor's
+    key stream; disabled when ``is_test``)."""
     helper = LayerHelper("flash_attention", **locals())
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -1366,6 +1369,7 @@ def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
         inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": float(scale),
+               "dropout_rate": float(dropout_rate), "is_test": bool(is_test),
                "interpret": bool(interpret)},
     )
     return out

@@ -838,6 +838,9 @@ def _flash_attention(ctx, op_):
     generic grad maker Just Works."""
     from ...kernels import flash_attention as _fa
 
+    import jax
+    import jax.numpy as jnp
+
     q = ctx.in1(op_, "Q")
     k = ctx.in1(op_, "K")
     v = ctx.in1(op_, "V")
@@ -849,6 +852,16 @@ def _flash_attention(ctx, op_):
     # interpret=True forces the Pallas kernels off-TPU (tests/FD sweep);
     # default (None) runs kernels on TPU, dense reference elsewhere
     interpret = bool(op_.attr("interpret", False)) or None
+    # in-kernel attention dropout: the seed derives from the executor's
+    # per-(program-seed, step) key stream, which the generic-grad vjp
+    # replay re-threads (registry.py base_key note) — so the backward
+    # kernels regenerate the forward's exact mask
+    rate = float(op_.attr("dropout_rate", 0.0))
+    seed = None
+    if rate > 0.0 and not bool(op_.attr("is_test", False)):
+        seed = jax.random.randint(
+            ctx.next_key(), (1, 1), 0, 1 << 23
+        ).astype(jnp.float32)
     ctx.out(
         op_,
         "Out",
@@ -858,6 +871,8 @@ def _flash_attention(ctx, op_):
             bias=bias,
             causal=bool(op_.attr("causal", False)),
             scale=float(scale) if scale else None,
+            dropout_rate=rate if seed is not None else 0.0,
+            dropout_seed=seed,
             interpret=interpret,
         ),
     )

@@ -58,6 +58,33 @@ BLOCK_Q = 128
 BLOCK_K = 128
 _NEG = -1e30
 
+# splitmix32-style avalanche constants for the stateless dropout hash
+_H1, _H2, _H3 = 0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D
+_M1, _M2 = 0x2C1B3C6D, 0x297A2D39
+
+
+def _hash_keep(rows, cols, head, seed_u32, rate):
+    """Deterministic keep-mask from ABSOLUTE (row, col) coordinates, the
+    flat head index and a per-call seed — a counter-based splitmix32-style
+    scramble, so the forward kernel, both backward kernels and the dense
+    fallback all regenerate bit-identical masks with no stored [S, S]
+    tensor. ``rows``/``cols`` are broadcast-compatible int32 arrays;
+    ``head`` may be a traced scalar (pl.program_id) or an array."""
+    u = jnp.uint32
+    n = (
+        rows.astype(u) * u(_H1)
+        + cols.astype(u) * u(_H2)
+        + (seed_u32 + jnp.asarray(head, u) * u(_H3))
+    )
+    n = n ^ (n >> u(15))
+    n = n * u(_M1)
+    n = n ^ (n >> u(12))
+    n = n * u(_M2)
+    n = n ^ (n >> u(15))
+    # keep iff hash < keep_prob * 2^32 (threshold is static)
+    thresh = int((1.0 - float(rate)) * 4294967296.0)
+    return n < u(min(thresh, 4294967295))
+
 
 def reference_attention(q, k, v, bias=None, causal=False, scale=None):
     """Pure-jnp oracle, [B, N, S, D]; bias broadcastable to [B, N, S, S]."""
@@ -88,12 +115,7 @@ def _scores(q_scaled, kblk, key_bias_row, bias_blk, row_off, col_off,
     if bias_blk is not None:
         s = s + bias_blk.astype(jnp.float32)
     if causal:
-        row = row_off + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        col = col_off + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
+        row, col = _block_coords(row_off, col_off, block_q, block_k)
         s = jnp.where(col <= row, s, _NEG)
     return s
 
@@ -103,15 +125,39 @@ def _scores(q_scaled, kblk, key_bias_row, bias_blk, row_off, col_off,
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, o_ref, lse_ref,
-                *, scale, causal, kv_len, block_q, block_k):
+def _block_coords(row_off, col_off, block_q, block_k):
+    rows = row_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = col_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return rows, cols
+
+
+def _hash_head(h, head_swap):
+    """Flat head index in the CALLER's [B, N] layout for the dropout hash.
+    Under the head-major role swap (per-head shared bias) the kernels run
+    with heads flattened as n·B + b; remapping to b·N + n keeps the mask
+    bit-identical to the unswapped kernels and the dense fallback, so the
+    swap never changes which attention entries drop."""
+    if head_swap is None:
+        return h
+    B0, N0 = head_swap
+    return (h % B0) * N0 + h // B0
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, seed_ref,
+                o_ref, lse_ref, *, scale, causal, kv_len, block_q, block_k,
+                dropout_rate, head_swap=None):
     """One (head, q-block) program: online softmax over kv blocks; also
-    writes the per-row logsumexp residual for the backward."""
+    writes the per-row logsumexp residual for the backward. Dropout masks
+    the accumulated probabilities only — ``l``/``lse`` stay unmasked, so
+    out = (1/keep)·Σ_j mask_ij·P_ij·V_j (standard non-renormalizing
+    dropout) and the backward's rowsum(dO∘O) trick still yields delta."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    h = pl.program_id(0)
     qi = pl.program_id(1)
     n_kb = kv_len // block_k
+    seed_u = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
 
     m = jnp.full((block_q, 1), _NEG, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -128,28 +174,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, o_ref, lse_ref,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l = l * alpha + p.sum(axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            rows, cols = _block_coords(
+                qi * block_q, kb * block_k, block_q, block_k
+            )
+            p = jnp.where(
+                _hash_keep(rows, cols, _hash_head(h, head_swap), seed_u,
+                           dropout_rate),
+                p, 0.0,
+            )
         acc = acc * alpha + jax.lax.dot_general(
             p, v_ref[0, ks, :].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m = m_new
     l_safe = jnp.maximum(l, 1e-30)
+    if dropout_rate > 0.0:
+        l_safe = l_safe * (1.0 - dropout_rate)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
-                   lse_ref, delta_ref, dq_ref, *, scale, causal, kv_len,
-                   block_q, block_k):
-    """One (head, q-block) program: dq = Σ_kv (p∘(dO V^T − delta)) K·scale."""
+                   lse_ref, delta_ref, seed_ref, dq_ref, *, scale, causal,
+                   kv_len, block_q, block_k, dropout_rate, head_swap=None):
+    """One (head, q-block) program: dq = Σ_kv (p∘(dO V^T − delta)) K·scale.
+    With dropout the mask/keep lands on dp (= d out/d P path); p itself
+    stays unmasked — that IS the softmax jacobian of the dropped output."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)          # [BQ, D]
     lse = lse_ref[0]                            # [BQ, 1]
     delta = delta_ref[0]                        # [BQ, 1]
+    h = pl.program_id(0)
     qi = pl.program_id(1)
     n_kb = kv_len // block_k
+    seed_u = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    inv_keep = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     for kb in range(n_kb):
@@ -165,6 +227,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
             do, v_ref[0, ks, :].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            rows, cols = _block_coords(
+                qi * block_q, kb * block_k, block_q, block_k
+            )
+            dp = jnp.where(
+                _hash_keep(rows, cols, _hash_head(h, head_swap), seed_u,
+                           dropout_rate),
+                dp * inv_keep, 0.0,
+            )
         ds = p * (dp - delta)
         dq = dq + jax.lax.dot_general(          # ds @ K
             ds, kblk, (((1,), (0,)), ((), ())),
@@ -174,8 +245,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
-                    lse_ref, delta_ref, dk_ref, dv_ref, dkb_ref, dbias_ref,
-                    *, scale, causal, q_len, block_q, block_k, bias_group):
+                    lse_ref, delta_ref, seed_ref, dk_ref, dv_ref, dkb_ref,
+                    dbias_ref, *, scale, causal, q_len, block_q, block_k,
+                    bias_group, dropout_rate, head_swap=None):
     """One (kv-block, head) program — TRANSPOSED grid: kv axis outermost,
     head axis innermost, so the shared-bias gradient block is revisited by
     consecutive programs (safe sequential accumulation on TPU)."""
@@ -187,6 +259,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
     v = v_ref[0].astype(jnp.float32)            # [BK, D]
     key_bias_row = key_bias_ref[0]              # [1, BK]
     n_qb = q_len // block_q
+    seed_u = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    inv_keep = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
 
     dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
@@ -208,14 +282,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
             ib * block_q, kb * block_k, causal, block_q, block_k,
         )
         p = jnp.exp(s - lse)                    # [BQ, BK]
-        dv = dv + jax.lax.dot_general(          # p^T @ dO
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         dp = jax.lax.dot_general(               # dO @ V^T
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            rows, cols = _block_coords(
+                ib * block_q, kb * block_k, block_q, block_k
+            )
+            keep = _hash_keep(rows, cols, _hash_head(h, head_swap),
+                              seed_u, dropout_rate)
+            dv = dv + jax.lax.dot_general(      # (mask∘p/keep)^T @ dO
+                jnp.where(keep, p * inv_keep, 0.0), do,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        else:
+            dv = dv + jax.lax.dot_general(      # p^T @ dO
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(          # ds^T @ (q·scale)
             ds, q, (((0,), (0,)), ((), ())),
@@ -316,7 +403,14 @@ def _common_in_specs(pl, pltpu, geom, G, D):
 # --------------------------------------------------------------------------
 
 
-def _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale, interpret):
+def _seed_spec(pl, pltpu):
+    # scalar param rides SMEM — the canonical Pallas-TPU scalar pattern,
+    # exempt from the (8, 128) VMEM tiling rules
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _flash_fwd_impl(q, k, v, key_bias, bias, seed, causal, scale,
+                    dropout_rate, interpret, head_swap=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -328,9 +422,13 @@ def _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale, interpret):
     kernel = functools.partial(
         _fwd_kernel if bf is not None else _no_bias(_fwd_kernel),
         scale=scale, causal=causal, kv_len=Skp, block_q=bq, block_k=bk,
+        dropout_rate=dropout_rate, head_swap=head_swap,
     )
-    in_specs = _common_in_specs(pl, pltpu, geom, G, D)
-    operands = [qf, kf, vf, kb[:, None, :]] + ([bf] if bf is not None else [])
+    in_specs = _common_in_specs(pl, pltpu, geom, G, D) + [_seed_spec(pl, pltpu)]
+    operands = (
+        [qf, kf, vf, kb[:, None, :]]
+        + ([bf] if bf is not None else []) + [seed]
+    )
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -359,16 +457,19 @@ def _no_bias(kernel):
     return wrapped
 
 
-def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
+def _flash_bwd_core(causal, scale, dropout_rate, interpret, head_swap, res,
+                    g, g_lse):
     """Shared backward. ``g_lse`` is the logsumexp cotangent from the
     with-lse entry point (ring attention's combine differentiates through
     each block's lse): d s_ij gains p_ij·g_lse_i, which folds into the
     delta term — ds = p∘(dp − (delta − g_lse)) — so the kernels run
-    unchanged with an adjusted delta operand."""
+    unchanged with an adjusted delta operand. With dropout, delta =
+    rowsum(dO∘O) already equals Σ_j P·dP̂ (O carries the mask), so the
+    trick survives; the kernels regenerate the mask from the seed."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, key_bias, bias, out, lse = res
+    q, k, v, key_bias, bias, seed, out, lse = res
     qf, kf, vf, kb, bf, gf, geom = _prep(q, k, v, key_bias, bias, g=g)
     B, N, Sq, Sk, Sqp, Skp, bq, bk = geom
     D = q.shape[-1]
@@ -389,6 +490,7 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
     dq_kernel = functools.partial(
         _bwd_dq_kernel if bf is not None else _no_bias(_bwd_dq_kernel),
         scale=scale, causal=causal, kv_len=Skp, block_q=bq, block_k=bk,
+        dropout_rate=dropout_rate, head_swap=head_swap,
     )
     row_spec = pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0),
                             memory_space=pltpu.VMEM)
@@ -402,26 +504,28 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
         out_shape=jax.ShapeDtypeStruct((B * N, Sqp, D), q.dtype),
         grid=(B * N, Sqp // bq),
         in_specs=_common_in_specs(pl, pltpu, geom, G, D)
-        + [row_spec, col_spec, col_spec],
+        + [row_spec, col_spec, col_spec, _seed_spec(pl, pltpu)],
         out_specs=row_spec,
         interpret=interpret,
-    )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else []) + [gf, lse3, delta3]))
+    )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else [])
+        + [gf, lse3, delta3, seed]))
 
     # ---- dk/dv/dkey_bias/dbias: transposed (kv-block, head) grid ----
     group = None if G is None else (B * N) // G
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel if bf is not None else _no_bias(_bwd_dkv_kernel),
         scale=scale, causal=causal, q_len=Sqp, block_q=bq, block_k=bk,
-        bias_group=group or 1,
+        bias_group=group or 1, dropout_rate=dropout_rate,
+        head_swap=head_swap,
     )
     if bf is None:
         # adapter also has to drop the dbias OUT ref
         base = dkv_kernel
 
         def dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, do_ref, lse_ref,
-                       delta_ref, dk_ref, dv_ref, dkb_ref):
+                       delta_ref, seed_ref, dk_ref, dv_ref, dkb_ref):
             return base(q_ref, k_ref, v_ref, key_bias_ref, do_ref, lse_ref,
-                        delta_ref, dk_ref, dv_ref, dkb_ref, None)
+                        delta_ref, seed_ref, dk_ref, dv_ref, dkb_ref, None)
 
     in_specs = [
         pl.BlockSpec((1, Sqp, D), lambda j, h: (h, 0, 0),
@@ -445,6 +549,7 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
                      memory_space=pltpu.VMEM),       # lse
         pl.BlockSpec((1, Sqp, 1), lambda j, h: (h, 0, 0),
                      memory_space=pltpu.VMEM),       # delta
+        _seed_spec(pl, pltpu),                       # dropout seed
     ]
     out_shape = [
         jax.ShapeDtypeStruct((B * N, Skp, D), k.dtype),      # dk
@@ -472,7 +577,8 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
         in_specs=in_specs,
         out_specs=out_specs,
         interpret=interpret,
-    )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else []) + [gf, lse3, delta3]))
+    )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else [])
+        + [gf, lse3, delta3, seed]))
     if bf is not None:
         dkf, dvf, dkb, dbias = outs
         dbias = dbias[:, :Sq, :Sk]
@@ -484,27 +590,31 @@ def _flash_bwd_core(causal, scale, interpret, res, g, g_lse):
     dk = dkf[:, :Sk, :].reshape(k.shape)
     dv = dvf[:, :Sk, :].reshape(v.shape)
     dkey_bias = dkb[:, 0, :Sk].astype(key_bias.dtype)
-    return dq, dk, dv, dkey_bias, dbias
+    return dq, dk, dv, dkey_bias, dbias, jnp.zeros_like(seed)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_lse(q, k, v, key_bias, bias, causal, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, key_bias, bias, seed, causal, scale, dropout_rate,
+               interpret, head_swap):
     """(out, lse) variant: lse [B*N, Sq] is the per-row logsumexp of the
     masked scores — the residual blockwise/ring attention needs to
     combine per-block outputs across hops without renormalizing."""
-    return _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
-                           interpret)
+    return _flash_fwd_impl(q, k, v, key_bias, bias, seed, causal, scale,
+                           dropout_rate, interpret, head_swap)
 
 
-def _flash_lse_fwd(q, k, v, key_bias, bias, causal, scale, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, key_bias, bias, causal, scale,
-                               interpret)
-    return (out, lse), (q, k, v, key_bias, bias, out, lse)
+def _flash_lse_fwd(q, k, v, key_bias, bias, seed, causal, scale,
+                   dropout_rate, interpret, head_swap):
+    out, lse = _flash_fwd_impl(q, k, v, key_bias, bias, seed, causal, scale,
+                               dropout_rate, interpret, head_swap)
+    return (out, lse), (q, k, v, key_bias, bias, seed, out, lse)
 
 
-def _flash_lse_bwd(causal, scale, interpret, res, cotangents):
+def _flash_lse_bwd(causal, scale, dropout_rate, interpret, head_swap, res,
+                   cotangents):
     g, g_lse = cotangents
-    return _flash_bwd_core(causal, scale, interpret, res, g, g_lse)
+    return _flash_bwd_core(causal, scale, dropout_rate, interpret, head_swap,
+                           res, g, g_lse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -547,18 +657,74 @@ def _normalize_bias(bias, B, N, Sq, Sk):
                      % (b.shape,))
 
 
+def _fallback_keep(B, N, Sq, Sk, seed, rate):
+    """[B, N, Sq, Sk] keep-mask, bit-identical to what the kernels
+    regenerate from the same seed (flat head h = b·N + n, absolute
+    row/col — padding sits past the valid region so coords agree)."""
+    heads = jnp.arange(B * N, dtype=jnp.int32).reshape(B, N, 1, 1)
+    rows = jnp.arange(Sq, dtype=jnp.int32).reshape(1, 1, Sq, 1)
+    cols = jnp.arange(Sk, dtype=jnp.int32).reshape(1, 1, 1, Sk)
+    seed_u = seed.reshape(()).astype(jnp.uint32)
+    return _hash_keep(rows, cols, heads, seed_u, rate)
+
+
+def _norm_seed(dropout_seed):
+    """Normalize any user seed (python int of any size, or traced int/f32
+    scalar) to a (1, 1) f32 carrying a 23-bit value. A plain ``% 2^23``
+    would ALIAS seeds (s and s + 2^23 give identical masks, and f32
+    rounding collapses seeds ≥ 2^24 before the mod), so the full value is
+    avalanche-mixed down to 23 bits first — distinct seeds give
+    decorrelated masks."""
+    s = 0 if dropout_seed is None else dropout_seed
+    if isinstance(s, (int, np.integer)):
+        # fold arbitrary-width python ints into 32 bits before the mix
+        s = int(s)
+        s = (s ^ (s >> 32) ^ (s >> 64)) & 0xFFFFFFFF
+    u = jnp.asarray(s).reshape(()).astype(jnp.uint32)
+    u = u ^ (u >> jnp.uint32(16))
+    u = u * jnp.uint32(0x7FEB352D)
+    u = u ^ (u >> jnp.uint32(15))
+    u = u * jnp.uint32(0x846CA68B)
+    u = u ^ (u >> jnp.uint32(16))
+    return (u >> jnp.uint32(9)).astype(jnp.float32).reshape(1, 1)
+
+
 def flash_attention_lse(q, k, v, key_bias=None, bias=None, causal=False,
-                        scale=None, interpret=None):
+                        scale=None, dropout_rate=0.0, dropout_seed=None,
+                        interpret=None):
     """Like ``flash_attention`` but also returns the per-row logsumexp
     [B, N, Sq] of the masked scores. This is the building block for
     blockwise/ring attention: per-hop block outputs combine as
     out = Σ_b o_b · exp(lse_b − logaddexp_b(lse)) with no [S, S] tensor
     and no renormalization pass. Fully differentiable (the lse cotangent
-    folds into the backward's delta term)."""
+    folds into the backward's delta term).
+
+    ``dropout_rate``/``dropout_seed``: standard attention-probability
+    dropout (mask∘P/keep, no renormalization; lse reports the undropped
+    distribution). The mask is a stateless counter-based hash of
+    (head, row, col, seed) regenerated inside every kernel AND the dense
+    fallback — bit-identical across all paths, nothing stored. The rate
+    is static (recompile on change); the seed is traced (vary per step
+    for free)."""
     B, N, Sq, d = q.shape
     Sk = k.shape[2]
     if causal and Sq != Sk:
         raise ValueError("causal flash attention needs Sq == Sk")
+    rate = float(dropout_rate or 0.0)
+    if not 0.0 <= rate < 1.0:
+        raise ValueError("dropout_rate must be in [0, 1), got %r" % rate)
+    if rate > 0.0 and dropout_seed is None:
+        import warnings
+
+        # a None seed normalizes to one CONSTANT seed: every call drops
+        # the identical (head, row, col) entries — in a training loop
+        # that is a frozen mask (biased training), not dropout. The fluid
+        # op lowering threads a fresh per-step seed; direct users must too.
+        warnings.warn(
+            "flash_attention: dropout_rate > 0 with dropout_seed=None "
+            "reuses ONE fixed dropout mask on every call; pass a "
+            "per-step seed for real dropout", stacklevel=3)
+    seed = _norm_seed(dropout_seed)
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
     kb = None
     if key_bias is not None:
@@ -594,6 +760,9 @@ def flash_attention_lse(q, k, v, key_bias=None, bias=None, causal=False,
         # no-lse entry point's fallback contract — "transparently the jnp
         # reference" — holds exactly
         p = jax.nn.softmax(s, axis=-1)
+        if rate > 0.0:
+            p = jnp.where(_fallback_keep(B, N, Sq, Sk, seed, rate),
+                          p / (1.0 - rate), 0.0)
         out = jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
         return out, lse
     if kb is None:
@@ -606,24 +775,32 @@ def flash_attention_lse(q, k, v, key_bias=None, bias=None, causal=False,
         kT = k.transpose(1, 0, 2, 3)
         vT = v.transpose(1, 0, 2, 3)
         kbT = kb.reshape(B, N, Sk).transpose(1, 0, 2).reshape(N * B, Sk)
-        out, lse = _flash_lse(qT, kT, vT, kbT, bf, causal, scale,
-                              bool(interpret))
+        # head_swap remaps the dropout-hash head ids back to the caller's
+        # b*N+n layout so the swap never changes the mask (and the shared
+        # bias needs no B-fold expansion)
+        out, lse = _flash_lse(qT, kT, vT, kbT, bf, seed, causal, scale,
+                              rate, bool(interpret),
+                              (B, N) if rate > 0.0 else None)
         return (
             out.transpose(1, 0, 2, 3),
             lse.reshape(N, B, Sq).transpose(1, 0, 2),
         )
-    out, lse = _flash_lse(q, k, v, kb, bf, causal, scale, bool(interpret))
+    out, lse = _flash_lse(q, k, v, kb, bf, seed, causal, scale, rate,
+                          bool(interpret), None)
     return out, lse.reshape(B, N, Sq)
 
 
 def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
-                    scale=None, interpret=None):
+                    scale=None, dropout_rate=0.0, dropout_seed=None,
+                    interpret=None):
     """Fused attention, [B, N, S, D] -> [B, N, S, D].
 
     ``key_bias``: optional additive mask over KEYS, shape [B*N, S] or
     broadcastable — BERT-style padding masks ((mask-1)*1e4 per key).
     ``bias``: optional general additive bias broadcastable to
     [B, N, Sq, Sk] (relative-position / ALiBi). Both may be given.
+    ``dropout_rate``/``dropout_seed``: in-kernel attention dropout (see
+    ``flash_attention_lse``) — training with dropout rides the kernels.
     ``interpret``: force the Pallas interpreter (tests); default runs the
     kernels on TPU and the jnp reference elsewhere. Forward AND backward
     are Pallas kernels — no [S, S] tensor ever reaches HBM.
@@ -634,6 +811,7 @@ def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
     """
     out, _lse = flash_attention_lse(
         q, k, v, key_bias=key_bias, bias=bias, causal=causal, scale=scale,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         interpret=interpret,
     )
     return out

@@ -85,11 +85,13 @@ def flash_engages(cfg, key_bias):
     """True when multi_head_attention will actually run the fused flash
     path (vs the dense fallback). Model builders that skip constructing a
     dense attention bias on the flash path MUST consult this — a silent
-    fallback without the dense bias would drop masking entirely."""
+    fallback without the dense bias would drop masking entirely.
+    Attention dropout no longer forces the fallback: the kernel applies
+    it in-VMEM from a stateless per-step hash (kernels/flash_attention.py
+    dropout_rate)."""
     return bool(
         getattr(cfg, "use_flash_attention", False)
         and key_bias is not None
-        and (cfg.attention_dropout <= 0.0 or cfg.is_test)
     )
 
 
@@ -97,10 +99,11 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
                          causal=False):
     """Self/cross attention on [N, S, H] inputs.
 
-    With ``cfg.use_flash_attention`` (and no attention dropout to apply)
-    the score/softmax/context chain runs as ONE fused flash-attention op
-    — the Pallas kernel keeps the [S, S] scores in VMEM; ``key_bias``
-    [N, S] carries the padding mask in key-only form."""
+    With ``cfg.use_flash_attention`` the score/softmax/context chain runs
+    as ONE fused flash-attention op — the Pallas kernel keeps the [S, S]
+    scores in VMEM, applies attention dropout in-kernel (per-step seed
+    from the executor key stream), and ``key_bias`` [N, S] carries the
+    padding mask in key-only form."""
     d_head = cfg.hidden_size // cfg.num_heads
 
     def _proj(x, suffix):
@@ -122,21 +125,18 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
             and not getattr(cfg, "_warned_flash_fallback", False)):
         import warnings
 
-        reason = (
-            "no key_bias/input_mask was built" if key_bias is None else
-            "training with attention_dropout=%g (the fused kernel has no "
-            "in-kernel dropout; set attention_dropout=0 to train through "
-            "it)" % cfg.attention_dropout
-        )
         warnings.warn(
-            "use_flash_attention=True but %s: falling back to dense "
-            "attention" % reason, stacklevel=2)
+            "use_flash_attention=True but no key_bias/input_mask was "
+            "built: falling back to dense attention", stacklevel=2)
         cfg._warned_flash_fallback = True  # once per config, not per layer
     if use_flash:
-        # ``causal`` rides the kernel flag instead of a dense [T, T] bias
+        # ``causal`` rides the kernel flag instead of a dense [T, T] bias;
+        # attention dropout runs inside the kernel (per-step seed from the
+        # executor key stream)
         ctxt = fluid.layers.flash_attention(
             q, k, v, key_bias=key_bias, causal=causal,
             scale=1.0 / math.sqrt(d_head),
+            dropout_rate=cfg.attention_dropout, is_test=cfg.is_test,
             # tests force the Pallas kernels off-TPU via this cfg flag
             interpret=getattr(cfg, "flash_interpret", False),
         )

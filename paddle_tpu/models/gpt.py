@@ -81,8 +81,8 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg):
     if not _bert.flash_engages(cfg, key_bias):
         # dense path: causal [1,1,T,T] + key padding [N,1,1,T] broadcast.
         # Built whenever the shared attention helper would take its dense
-        # branch — INCLUDING the dropout-driven flash fallback, which
-        # would otherwise run with neither mask (acausal LM)
+        # branch (attention dropout no longer forces it — the kernel
+        # drops in-VMEM), which would otherwise run with neither mask
         pad = fluid.layers.scale(
             fluid.layers.reshape(input_mask, shape=[0, 1, 1, -1]),
             scale=1e4, bias=-1e4,

@@ -162,12 +162,14 @@ def test_book_word2vec():
         )
         fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
 
-    # synthetic corpus with strong 5-gram structure: w5 = sum(w1..w4) mod V
+    # synthetic corpus with learnable 5-gram structure: w5 = (w1+w2) mod V
+    # (the 4-word sum variant sat at chance for this tiny model, making
+    # the loss-decrease assert init-luck; two words learn decisively)
     def reader():
         rng = np.random.RandomState(7)
         for _ in range(80):
             ws = rng.randint(0, VOCAB, (32, 4)).astype(np.int64)
-            nx = (ws.sum(1) % VOCAB).astype(np.int64)
+            nx = ((ws[:, 0] + ws[:, 1]) % VOCAB).astype(np.int64)
             yield [ws[:, i:i + 1] for i in range(4)] + [nx.reshape(-1, 1)]
 
     exe = fluid.Executor(fluid.CPUPlace())
@@ -178,7 +180,9 @@ def test_book_word2vec():
         feed["nxt"] = batch[4]
         (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
         losses.append(float(np.asarray(lv).ravel()[0]))
-    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    # window means: single-batch endpoints are noise-dominated
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]), (
+        np.mean(losses[:10]), np.mean(losses[-10:]))
 
     with tempfile.TemporaryDirectory() as td:
         infer = main.clone(for_test=True)

@@ -117,26 +117,36 @@ def test_dist_pserver_sync_matches_local():
 
 
 def test_dist_pserver_async_converges():
-    """Async mode: no barrier sync, but loss must still go down."""
-    t0, t1 = run_cluster(sync=False)
-    assert t0[-1] < t0[0] * 1.05
-    assert t1[-1] < t1[0] * 1.05
+    """Async mode: no barrier sync, but loss must still go down. Async
+    step interleaving is racy and 5 steps carry no signal, so this runs
+    longer than the sync-parity test and compares WINDOW MEANS of each
+    trainer's trajectory rather than single-step endpoints."""
+    t0, t1 = run_cluster(sync=False, extra_env={"DIST_STEPS": "25"})
+    for t in (t0, t1):
+        assert len(t) == 25
+        assert np.mean(t[-5:]) < np.mean(t[:5]), t
 
 
 def test_dist_pserver_async_communicator():
     """Async mode routed through the background AsyncCommunicator
-    (reference communicator.cc:285 merge-and-push threads)."""
-    t0, t1 = run_cluster(sync=False, comm="async")
-    assert t0[-1] < t0[0] * 1.05
-    assert t1[-1] < t1[0] * 1.05
+    (reference communicator.cc:285 merge-and-push threads). Same
+    window-mean convergence check as the plain async test — endpoint
+    single-step compares are dominated by async race noise."""
+    t0, t1 = run_cluster(sync=False, comm="async",
+                         extra_env={"DIST_STEPS": "25"})
+    for t in (t0, t1):
+        assert len(t) == 25
+        assert np.mean(t[-5:]) < np.mean(t[:5]), t
 
 
 def test_dist_pserver_geo_sgd():
     """GEO-SGD: local SGD + periodic delta push/pull (reference
     GeoSgdCommunicator, communicator.h:332)."""
-    t0, t1 = run_cluster(sync=False, comm="geo")
-    assert t0[-1] < t0[0] * 1.05
-    assert t1[-1] < t1[0] * 1.05
+    t0, t1 = run_cluster(sync=False, comm="geo",
+                         extra_env={"DIST_STEPS": "25"})
+    for t in (t0, t1):
+        assert len(t) == 25
+        assert np.mean(t[-5:]) < np.mean(t[:5]), t
 
 
 def test_fleet_parameter_server_matches_local():
@@ -154,7 +164,7 @@ def test_dist_pserver_sparse_embedding_matches_local():
     pserver, id // n -> local row), lookups ride kPrefetch, grads ride
     SelectedRows sends — and the per-step mean loss matches the local
     full-batch baseline exactly (full-init-then-shard keeps init parity)."""
-    env = {"DIST_SPARSE": "1"}
+    env = {"DIST_SPARSE": "1", "DIST_STEPS": "25"}
     p = spawn("LOCAL", env)
     out, err = p.communicate(timeout=300)
     assert p.returncode == 0, "local sparse baseline failed:\n%s\n%s" % (out, err)
@@ -162,7 +172,8 @@ def test_dist_pserver_sparse_embedding_matches_local():
     t0, t1 = run_cluster(sync=True, extra_env=env)
     dist = [(a + b) / 2.0 for a, b in zip(t0, t1)]
     np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-4)
-    assert local[-1] < local[0]
+    # progress over window means (single-step endpoints are noisy)
+    assert np.mean(local[-5:]) < np.mean(local[:5]), local
 
 
 def test_checkpoint_notify_saves_pserver_shards(tmp_path):

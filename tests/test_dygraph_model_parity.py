@@ -193,8 +193,14 @@ def _static_resblock(x, ch):
 def test_dygraph_static_parity_resnet():
     rs = np.random.RandomState(1)
     steps = 5
-    imgs = [rs.rand(4, 3, 8, 8).astype("float32") for _ in range(steps)]
-    labels = [rs.randint(0, 5, (4, 1)).astype("int64") for _ in range(steps)]
+    # ONE batch repeated: with fresh random-label batches each step the
+    # expected loss does not decrease at all (the old endpoint assert
+    # passed on init luck); memorizing a single batch decreases reliably
+    # and the dygraph-vs-static parity comparison is unaffected
+    img0 = rs.rand(4, 3, 8, 8).astype("float32")
+    lab0 = rs.randint(0, 5, (4, 1)).astype("int64")
+    imgs = [img0 for _ in range(steps)]
+    labels = [lab0 for _ in range(steps)]
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 6

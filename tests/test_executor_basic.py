@@ -95,3 +95,64 @@ def test_missing_feed_raises():
     exe = fluid.Executor(fluid.CPUPlace())
     with pytest.raises(Exception):
         exe.run(main, feed={}, fetch_list=[y])
+
+
+def test_clone_rng_stream_independent_of_parent():
+    """A for_test clone must NOT share the parent's per-scope RNG run
+    counters (Program.clone excludes _rng_run_counters): interleaving
+    eval runs of the clone may not shift the parent's dropout stream, or
+    a restarted process that evals at a different cadence would replay a
+    different training trajectory."""
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.dropout(x, dropout_prob=0.5)
+        y = fluid.layers.reduce_sum(h)
+    main._seed = 7
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = np.ones((8, 4), np.float32)
+
+    def trajectory(eval_between):
+        scope = fluid.core.Scope()
+        exe.run(startup, scope=scope)
+        vals = []
+        for _ in range(3):
+            (v,) = exe.run(main, feed={"x": d}, fetch_list=[y], scope=scope)
+            vals.append(float(np.asarray(v).ravel()[0]))
+            if eval_between:
+                infer = main.clone(for_test=True)
+                exe.run(infer, feed={"x": d}, fetch_list=[], scope=scope)
+        return vals
+
+    assert trajectory(False) == trajectory(True)
+    # and the per-step masks do vary across steps (seeded stream advances)
+    t = trajectory(False)
+    assert len(set(t)) > 1
+
+
+def test_program_cache_bounded_lru():
+    """The compiled-program cache is a bounded LRU keyed by the Program
+    OBJECT: no id-recycling aliasing (the key pins the program while
+    cached), and a clone-per-eval loop cannot grow executor memory
+    without bound — old entries (and the programs they pin) fall out."""
+    import gc
+    import weakref
+
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = x * 3.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = np.ones((1, 2), np.float32)
+    cap = fluid.Executor._CACHE_CAPACITY
+    refs = []
+    for _ in range(cap + 16):
+        c = main.clone(for_test=True)
+        refs.append(weakref.ref(c))
+        exe.run(c, feed={"x": d}, fetch_list=[y.name])
+        del c
+    gc.collect()
+    assert len(exe._cache) <= cap
+    assert sum(1 for r in refs if r() is not None) <= cap

@@ -120,27 +120,45 @@ def test_gpt_greedy_generate():
     assert out == out2  # greedy decode is deterministic
 
 
-def test_gpt_flash_dropout_fallback_keeps_causal_mask():
-    """Review regression: use_flash_attention=True with training
-    attention dropout falls back to DENSE attention — that fallback must
-    carry the causal+padding bias (an acausal LM trains to zero loss by
-    copying its own targets)."""
-    import pytest as _pytest
+def test_gpt_flash_with_dropout_rides_kernel_and_stays_causal():
+    """Round 5: attention dropout runs INSIDE the flash kernel, so a
+    default training config (dropout 0.1) engages it — with the causal
+    flag on the op (an acausal LM trains to zero loss by copying its own
+    targets) and the dropout_rate attr carried for the lowering."""
+    import warnings
 
     cfg = gpt.GPTConfig.tiny(use_flash_attention=True)  # dropout 0.1
-    with _pytest.warns(Warning, match="falling back to dense"):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
         main, _startup, _feeds, _loss = gpt.build_gpt_lm_train(cfg, 12)
-    ops = [op.type for b in main.blocks for op in b.ops]
-    assert "flash_attention" not in ops          # fallback engaged
-    # the dense branch consumed a real attention bias: the tril constant
-    # (assign) feeds the bias chain, and scores get an elementwise_add
-    assert "assign_value" in ops  # the tril causal constant
-    att_adds = [
-        op for b in main.blocks for op in b.ops
-        if op.type == "elementwise_add"
-        and any("att" in n for ns in op.inputs.values() for n in ns)
-    ]
-    assert att_adds, "attention scores were never biased (acausal!)"
+    assert not [x for x in w if "falling back" in str(x.message)]
+    fa = [op for b in main.blocks for op in b.ops
+          if op.type == "flash_attention"]
+    assert fa, "flash kernel not engaged under training dropout"
+    assert all(op.attr("causal") for op in fa)
+    assert all(abs(op.attr("dropout_rate") - 0.1) < 1e-9 for op in fa)
+    # and the training loss through the kernel stays finite + decreases
+    cfg2 = gpt.GPTConfig.tiny(use_flash_attention=True)
+    cfg2.flash_interpret = True
+    with fluid.unique_name.guard():
+        main2, startup2, feeds2, loss2 = gpt.build_gpt_lm_train(cfg2, 12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rs = np.random.RandomState(0)
+    feed = {
+        "ids": rs.randint(0, cfg2.vocab_size, (4, 12, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(12)[None, :, None],
+                           (4, 1, 1)).astype("int64"),
+        "input_mask": np.ones((4, 12, 1), "float32"),
+    }
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup2)
+        losses = []
+        for _ in range(6):
+            out = exe.run(main2, feed=feed, fetch_list=[loss2])
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert all(np.isfinite(losses)), losses
+    assert min(losses[3:]) < losses[0], losses
 
 
 def test_gpt_greedy_generate_through_flash_kernel():

@@ -132,7 +132,7 @@ def test_fsp_distiller_on_conv_features():
                        [(rename[ta.name], rename[tb.name])])
     loss = fsp.distiller_loss(student)
     with fluid.program_guard(student, s_startup):
-        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
     scope = fluid.core.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(s_startup, scope=scope)
@@ -189,7 +189,7 @@ def test_light_nas_search_loop():
                 loss = fluid.layers.mean(
                     fluid.layers.square_error_cost(input=pred, label=y)
                 )
-                fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+                fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
             return main, None, startup, [loss], [loss]
 
     rng = np.random.RandomState(0)
@@ -200,7 +200,7 @@ def test_light_nas_search_loop():
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup, scope=scope)
         last = None
-        for s in range(15):
+        for s in range(40):
             xb = rng.rand(16, 6).astype(np.float32)
             yb = (xb @ w) ** 2
             (lv,) = exe.run(main, feed={"x": xb, "y": yb},

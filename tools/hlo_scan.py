@@ -80,6 +80,11 @@ def main():
     ap.add_argument("--out", default="", help="also write the JSON line here")
     args = ap.parse_args()
 
+    def hb(msg):
+        # watcher kills a hung scan at its hard timeout; heartbeats make
+        # the log say WHICH stage the tunnel wedged in
+        print("HB %s" % msg, file=sys.stderr, flush=True)
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -90,10 +95,12 @@ def main():
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import executor as _ex
 
+    hb("build start (program construction)")
     prog, startup, feed, loss = build(
         args.model, args.batch, bool(args.amp), bool(args.remat),
         flash=bool(args.flash), seq=args.seq,
     )
+    hb("build ok; device discovery next")
     # mirror bench.py's place choice: on a live TPU the lowering backend
     # (and with it the NHWC conv path) must match what bench.py compiles,
     # or the census describes a program the bench never runs
@@ -102,9 +109,11 @@ def main():
         if fluid.core.get_tpu_device_count() > 0
         else fluid.CPUPlace()
     )
+    hb("device ok (%s); startup run next" % type(place).__name__)
     scope = fluid.core.Scope()
     exe = fluid.Executor(place)
     exe.run(startup, scope=scope)
+    hb("startup ok; lowering main segment")
 
     cb = _ex._CompiledBlock(prog, 0, list(feed), [loss.name], place)
     xla = [p for k, _s, p in cb._plans if k == "xla"]
@@ -125,7 +134,9 @@ def main():
     lowered = jax.jit(plan["raw_fn"]).lower(
         feed_vals, mutable_vals, (), const_map, rng
     )
+    hb("lowered; compiling")
     compiled = lowered.compile()
+    hb("compiled; cost analysis")
 
     raw_cost = compiled.cost_analysis()
     if isinstance(raw_cost, (list, tuple)):
