@@ -1,0 +1,208 @@
+"""Tertiary benchmark: GPT-2-small causal-LM training throughput
+(tokens/sec) on one chip. Exercises the CAUSAL flash-attention path (the
+in-kernel `causal` flag, no dense [T, T] bias) that neither headline
+metric covers. Same hardened architecture as bench.py / bench_bert.py:
+the parent never imports jax; each attempt is a child process with a hard
+wall-clock timeout, demoting batch on OOM/timeout with a labeled CPU
+fallback. Prints ONE JSON line. No reference-era baseline constant exists
+for this config, so ``vs_baseline`` is always null — the line stands as
+an absolute measured number (BENCH_BANK provenance like the others).
+"""
+
+import json
+import os
+import signal  # noqa: F401  (parity with sibling harnesses' imports)
+import subprocess  # noqa: F401
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+METRIC = "gpt2_small_lm_throughput"
+UNIT = "tokens/sec/chip"
+DEFAULT_SEQ_LEN = int(os.environ.get("BENCH_GPT_SEQ", "1024"))
+
+
+def _hb(msg):
+    print("HB %s" % msg, file=sys.stderr, flush=True)
+
+
+def child_main(cfg):
+    if cfg["platform"]:
+        os.environ["JAX_PLATFORMS"] = cfg["platform"]
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import bench
+
+    bench.enable_compilation_cache(jax)
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+
+    if cfg["platform"] == "cpu":
+        place = fluid.CPUPlace()
+        device = "cpu"
+    elif fluid.core.get_tpu_device_count() == 0:
+        print("CHILDERR " + json.dumps({"kind": "no_tpu", "msg": "no tpu"}),
+              flush=True)
+        sys.exit(1)
+    else:
+        place = fluid.TPUPlace(0)
+        device = "tpu"
+    dev = fluid.core.get_jax_device(place)
+    import jax.numpy as jnp
+
+    _hb("probe start")
+    jax.jit(lambda a: (a @ a).sum())(
+        jax.device_put(jnp.ones((256, 256), jnp.bfloat16), dev)
+    ).block_until_ready()
+    _hb("probe ok")
+
+    batch = cfg["batch"]
+    seq_len = int(cfg.get("seq_len", DEFAULT_SEQ_LEN))
+    gcfg = (
+        gpt.GPTConfig() if cfg["full"] else gpt.GPTConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=4,
+            intermediate_size=1024, max_position_embeddings=seq_len,
+        )
+    )
+    # throughput config: dropout off (same convention as bench_bert)
+    gcfg.hidden_dropout = 0.0
+    gcfg.attention_dropout = 0.0
+    gcfg.use_flash_attention = bool(
+        cfg.get("flash", os.environ.get("BENCH_FLASH", "0") == "1")
+    )
+    _hb("build start")
+    main, startup, _feeds, loss = gpt.build_gpt_lm_train(
+        gcfg, seq_len, learning_rate=3e-4,
+        use_amp=os.environ.get("BENCH_AMP", "1") == "1",
+    )
+    exe = fluid.Executor(place)
+    _hb("startup start")
+    exe.run(startup)
+    _hb("startup ok")
+    rs = np.random.RandomState(0)
+    feed = {
+        "ids": jax.device_put(
+            rs.randint(0, gcfg.vocab_size, (batch, seq_len, 1)).astype("int64"),
+            dev,
+        ),
+        "pos_ids": jax.device_put(
+            np.tile(np.arange(seq_len)[None, :, None], (batch, 1, 1))
+            .astype("int64"), dev,
+        ),
+        "input_mask": jax.device_put(
+            np.ones((batch, seq_len, 1), "float32"), dev
+        ),
+    }
+    _hb("warmup start")
+    for i in range(cfg["warmup"]):
+        exe.run(main, feed=feed, fetch_list=[loss])
+        _hb("warmup %d done" % i)
+    exe.run(main, feed=feed, fetch_list=[])
+    exe.run(main, feed=feed, fetch_list=[loss])
+    _hb("timed start")
+    t0 = time.perf_counter()
+    steps = cfg["steps"]
+    out = None
+    for i in range(steps):
+        out = exe.run(
+            main, feed=feed, fetch_list=[loss] if i == steps - 1 else []
+        )
+    lval = float(np.asarray(out[0]).ravel()[0])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lval), lval
+    tps = batch * seq_len * steps / dt
+    _hb("timed ok %.2fs loss=%.4f tps=%.1f" % (dt, lval, tps))
+    print("RESULT " + json.dumps({"tps": tps, "device": device, "loss": lval}),
+          flush=True)
+
+
+def _child_entry(cfg):
+    try:
+        child_main(cfg)
+    except SystemExit:
+        raise
+    except Exception as e:  # classify for the parent (bench.py contract)
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            kind = "oom"
+        elif "UNAVAILABLE" in msg or "DEADLINE_EXCEEDED" in msg:
+            kind = "transient"
+        else:
+            kind = "other"
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print("CHILDERR " + json.dumps({"kind": kind, "msg": msg[:300]}),
+              flush=True)
+        sys.exit(1)
+
+
+def main():
+    import bench
+
+    deadline = time.time() + int(os.environ.get("BENCH_BUDGET_S", "1400"))
+    seq = DEFAULT_SEQ_LEN
+    flash = os.environ.get("BENCH_FLASH", "0") == "1"
+    attempts = [
+        (dict(platform="", batch=16, steps=10, warmup=2, full=True,
+              seq_len=seq, flash=flash), 420),
+        (dict(platform="", batch=4, steps=10, warmup=2, full=True,
+              seq_len=seq, flash=flash), 360),
+        # CPU fallback: tiny config, short seq, flash off (the kernel
+        # cannot run there — a flash:true CPU line would be false
+        # provenance, same rule as bench_bert)
+        (dict(platform="cpu", batch=4, steps=3, warmup=1, full=False,
+              seq_len=128, flash=False), 280),
+    ]
+    for cfg, slot in attempts:
+        label = "gpt-%s-b%d-s%d%s" % (
+            cfg["platform"] or "tpu", cfg["batch"], cfg["seq_len"],
+            "-flash" if cfg["flash"] else "",
+        )
+        res, _kind, err, _probe_ok = bench._run_attempt(
+            label, cfg, slot, deadline,
+            script=os.path.abspath(__file__),
+        )
+        if err:
+            print("bench_gpt[%s]: %s" % (label, err), file=sys.stderr,
+                  flush=True)
+        if res:
+            degraded = cfg["platform"] == "cpu" or not cfg["full"]
+            out = {
+                "metric": METRIC,
+                "value": round(res["tps"], 1),
+                "unit": UNIT,
+                "vs_baseline": None,  # no documented reference constant
+                "batch": cfg["batch"],
+                "seq_len": cfg["seq_len"],
+                "device": res["device"],
+            }
+            if cfg["flash"]:
+                out["flash_attention"] = True
+            if res["device"] == "tpu" and not degraded:
+                bench.bank_write(
+                    "gpt_seq%d%s" % (
+                        cfg["seq_len"], "_flash" if cfg["flash"] else ""
+                    ),
+                    bench._bank_entry(out),
+                )
+            if degraded:
+                out["degraded"] = "cpu-fallback tiny-config"
+            print(json.dumps(out), flush=True)
+            return
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": None,
+        "error": "all attempts failed",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child_entry(json.loads(sys.argv[2]))
+    else:
+        main()

@@ -157,7 +157,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, seed_ref,
     h = pl.program_id(0)
     qi = pl.program_id(1)
     n_kb = kv_len // block_k
-    seed_u = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    # read the SMEM seed only when dropout is live: the rate-0 kernel
+    # traces to exactly the pre-dropout op stream (the operand is
+    # still bound, just never loaded)
+    seed_u = (seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+              if dropout_rate > 0.0 else None)
 
     m = jnp.full((block_q, 1), _NEG, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -210,7 +214,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
     h = pl.program_id(0)
     qi = pl.program_id(1)
     n_kb = kv_len // block_k
-    seed_u = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    # read the SMEM seed only when dropout is live: the rate-0 kernel
+    # traces to exactly the pre-dropout op stream (the operand is
+    # still bound, just never loaded)
+    seed_u = (seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+              if dropout_rate > 0.0 else None)
     inv_keep = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
@@ -259,7 +267,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
     v = v_ref[0].astype(jnp.float32)            # [BK, D]
     key_bias_row = key_bias_ref[0]              # [1, BK]
     n_qb = q_len // block_q
-    seed_u = seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+    # read the SMEM seed only when dropout is live: the rate-0 kernel
+    # traces to exactly the pre-dropout op stream (the operand is
+    # still bound, just never loaded)
+    seed_u = (seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
+              if dropout_rate > 0.0 else None)
     inv_keep = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
 
     dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)

@@ -79,6 +79,8 @@ def test_playbook_skips_banked_steps_and_caps_deadline(watcher, monkeypatch):
             "resnet50": {"value": 1.0, "device": "tpu", "batch": 256},
             "bert_seq384": {"value": 1.0, "device": "tpu"},
             "bert_seq384_flash": {"value": 2.0, "device": "tpu"},
+            "gpt_seq1024": {"value": 1.0, "device": "tpu"},
+            "gpt_seq1024_flash": {"value": 2.0, "device": "tpu"},
         }, f)
     _touch_hlo(watcher, watcher.HLO_GOALS)
 
@@ -112,3 +114,43 @@ def test_playbook_runs_ladder_when_goal_missing(watcher, monkeypatch):
     import time
     watcher.playbook(deadline=time.time() + 10_000)
     assert any("bench.py" in c for c in calls)
+
+
+def test_playbook_gpt_dense_then_flash_gating(watcher, monkeypatch):
+    """With every other goal banked, the playbook launches bench_gpt.py
+    dense (BENCH_FLASH pinned to 0); once gpt_seq1024 is banked, a later
+    pass launches the flash probe (BENCH_FLASH=1) exactly once."""
+    calls = []
+
+    def fake_run(cmd, timeout, env=None, log_name=None):
+        calls.append((" ".join(cmd), dict(env or {})))
+        return 0, ""
+
+    monkeypatch.setattr(watcher, "run_killable", fake_run)
+    monkeypatch.setattr(watcher, "commit_if_changed", lambda msg: None)
+    _bank(watcher, ["resnet50", "bert_seq384", "bert_seq384_flash"])
+    _touch_hlo(watcher, watcher.HLO_GOALS)
+
+    import time
+    done = watcher.playbook(deadline=time.time() + 10_000)
+    assert done is False  # the stub banks nothing -> gpt goal still open
+    gpt_calls = [(c, e) for c, e in calls if "bench_gpt.py" in c]
+    assert len(gpt_calls) == 1
+    assert gpt_calls[0][1].get("BENCH_FLASH") == "0"
+
+    # dense banked -> next pass runs ONLY the flash probe
+    calls.clear()
+    _bank(watcher, ["resnet50", "bert_seq384", "bert_seq384_flash",
+                    "gpt_seq1024"])
+    done = watcher.playbook(deadline=time.time() + 10_000)
+    gpt_calls = [(c, e) for c, e in calls if "bench_gpt.py" in c]
+    assert len(gpt_calls) == 1
+    assert gpt_calls[0][1].get("BENCH_FLASH") == "1"
+
+    # flash banked too -> nothing gpt-related launches, playbook done
+    calls.clear()
+    _bank(watcher, ["resnet50", "bert_seq384", "bert_seq384_flash",
+                    "gpt_seq1024", "gpt_seq1024_flash"])
+    done = watcher.playbook(deadline=time.time() + 10_000)
+    assert done is True
+    assert not [c for c, _ in calls if "bench_gpt.py" in c]

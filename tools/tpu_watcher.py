@@ -122,6 +122,7 @@ def goals_state():
         ),
         "bert384": "bert_seq384" in bank,
         "bert384_flash": "bert_seq384_flash" in bank,
+        "gpt": "gpt_seq1024" in bank,
         "hlo": all(
             os.path.exists(os.path.join(OUT, n + ".json")) for n in HLO_GOALS
         ),
@@ -202,6 +203,37 @@ def playbook(deadline):
         )
         log("bert flash probe rc=%s" % rc)
         commit_if_changed("bank TPU flash-attention measurement from live window")
+
+    # 2b. GPT-2-small causal-LM rung (third model family; exercises the
+    #     causal flash path). Dense first — banks gpt_seq1024 — then a
+    #     best-effort flash variant if the window still has room.
+    if not goals_state()["gpt"] and slot(700) > 120:
+        budget = slot(700)
+        rc, _ = run_killable(
+            [sys.executable, "bench_gpt.py"],
+            budget,
+            # BENCH_FLASH pinned: an ambient =1 (say, from a manual flash
+            # probe's shell) would bank gpt_seq1024_flash instead and
+            # leave the dense goal permanently unmet
+            env={"BENCH_FLASH": "0",
+                 "BENCH_BUDGET_S": str(int(budget - 50))},
+            log_name="bench_gpt.log",
+        )
+        log("gpt bench rc=%s" % rc)
+        commit_if_changed("bank TPU GPT-2 LM measurement from live window")
+    if (goals_state()["gpt"]
+            and "gpt_seq1024_flash" not in bench.load_bank()
+            and slot(600) > 120):
+        budget = slot(600)
+        rc, _ = run_killable(
+            [sys.executable, "bench_gpt.py"],
+            budget,
+            env={"BENCH_FLASH": "1",
+                 "BENCH_BUDGET_S": str(int(budget - 50))},
+            log_name="bench_gpt_flash.log",
+        )
+        log("gpt flash probe rc=%s" % rc)
+        commit_if_changed("bank TPU GPT-2 causal-flash measurement from live window")
 
     # 3. HLO cost census for the PERF.md MFU numbers
     hlo_args = {
