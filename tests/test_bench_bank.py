@@ -120,6 +120,11 @@ def test_parent_emits_banked_line_when_tunnel_dead(tmp_path):
                         "batch": 24, "seq_len": 384, "device": "tpu",
                         "flash_attention": False, "git_sha": "abc1234",
                         "measured_at": "2026-07-30T00:00:00Z"},
+        "gpt_seq1024": {"metric": "gpt2_small_lm_throughput",
+                        "value": 50000.0, "unit": "tokens/sec/chip",
+                        "batch": 16, "seq_len": 1024, "device": "tpu",
+                        "git_sha": "abc1234",
+                        "measured_at": "2026-07-30T00:00:00Z"},
     }
     bank_path = tmp_path / "bank.json"
     bank_path.write_text(json.dumps(bank))
@@ -135,9 +140,13 @@ def test_parent_emits_banked_line_when_tunnel_dead(tmp_path):
         capture_output=True, text=True, env=env, timeout=300, cwd=ROOT,
     )
     lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
-    assert len(lines) == 2, out.stdout + out.stderr
-    resnet, bert = lines
+    assert len(lines) == 3, out.stdout + out.stderr
+    resnet, bert, gpt = lines
     assert resnet["banked"] is True and resnet["value"] == 1384.0
     assert resnet["device"] == "tpu" and resnet["git_sha"] == "abc1234"
     assert bert["banked"] is True and bert["seq_len"] == 384
+    # bonus GPT family line rides the bank too (vs_baseline stays null:
+    # no documented reference constant for this config)
+    assert gpt["banked"] is True and gpt["seq_len"] == 1024
+    assert gpt["vs_baseline"] is None
     assert out.returncode == 0

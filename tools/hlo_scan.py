@@ -92,6 +92,14 @@ def main():
         # the env var — honor the explicit choice (bench.py child convention)
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    import bench
+
+    # share the bench children's persistent XLA cache: when the ladder
+    # already compiled this exact program in the same window, the census
+    # compile is a cache hit instead of a fresh multi-minute tunnel
+    # compile (the r5 hlo_bert scans died at the 700s cap exactly here)
+    bench.enable_compilation_cache(jax)
+
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import executor as _ex
 
