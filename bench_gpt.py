@@ -64,7 +64,11 @@ def child_main(cfg):
     batch = cfg["batch"]
     seq_len = int(cfg.get("seq_len", DEFAULT_SEQ_LEN))
     gcfg = (
-        gpt.GPTConfig() if cfg["full"] else gpt.GPTConfig(
+        gpt.GPTConfig(
+            # long-context rungs (seq 4096) need a position table larger
+            # than GPT-2's stock 1024; growing it is the only change
+            max_position_embeddings=max(1024, seq_len),
+        ) if cfg["full"] else gpt.GPTConfig(
             vocab_size=2048, hidden_size=256, num_layers=4, num_heads=4,
             intermediate_size=1024, max_position_embeddings=seq_len,
         )
@@ -148,10 +152,12 @@ def main():
     deadline = time.time() + int(os.environ.get("BENCH_BUDGET_S", "1400"))
     seq = DEFAULT_SEQ_LEN
     flash = os.environ.get("BENCH_FLASH", "0") == "1"
+    # batch scales down with seq len so the attempt fits the same slot
+    big, small = (16, 4) if seq <= 1024 else (4, 1)
     attempts = [
-        (dict(platform="", batch=16, steps=10, warmup=2, full=True,
+        (dict(platform="", batch=big, steps=10, warmup=2, full=True,
               seq_len=seq, flash=flash), 420),
-        (dict(platform="", batch=4, steps=10, warmup=2, full=True,
+        (dict(platform="", batch=small, steps=10, warmup=2, full=True,
               seq_len=seq, flash=flash), 360),
         # CPU fallback: tiny config, short seq, flash off (the kernel
         # cannot run there — a flash:true CPU line would be false

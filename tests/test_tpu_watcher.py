@@ -81,6 +81,7 @@ def test_playbook_skips_banked_steps_and_caps_deadline(watcher, monkeypatch):
             "bert_seq384_flash": {"value": 2.0, "device": "tpu"},
             "gpt_seq1024": {"value": 1.0, "device": "tpu"},
             "gpt_seq1024_flash": {"value": 2.0, "device": "tpu"},
+            "gpt_seq4096_flash": {"value": 3.0, "device": "tpu"},
         }, f)
     _touch_hlo(watcher, watcher.HLO_GOALS)
 
@@ -137,6 +138,7 @@ def test_playbook_gpt_dense_then_flash_gating(watcher, monkeypatch):
     gpt_calls = [(c, e) for c, e in calls if "bench_gpt.py" in c]
     assert len(gpt_calls) == 1
     assert gpt_calls[0][1].get("BENCH_FLASH") == "0"
+    assert gpt_calls[0][1].get("BENCH_GPT_SEQ") == "1024"
 
     # dense banked -> next pass runs ONLY the flash probe
     calls.clear()
@@ -146,11 +148,23 @@ def test_playbook_gpt_dense_then_flash_gating(watcher, monkeypatch):
     gpt_calls = [(c, e) for c, e in calls if "bench_gpt.py" in c]
     assert len(gpt_calls) == 1
     assert gpt_calls[0][1].get("BENCH_FLASH") == "1"
+    assert gpt_calls[0][1].get("BENCH_GPT_SEQ") == "1024"
 
-    # flash banked too -> nothing gpt-related launches, playbook done
+    # seq-1024 flash banked -> the long-context seq-4096 bonus launches
     calls.clear()
     _bank(watcher, ["resnet50", "bert_seq384", "bert_seq384_flash",
                     "gpt_seq1024", "gpt_seq1024_flash"])
+    done = watcher.playbook(deadline=time.time() + 10_000)
+    assert done is True  # gpt GOAL is met; seq-4096 is bonus-only
+    gpt_calls = [(c, e) for c, e in calls if "bench_gpt.py" in c]
+    assert len(gpt_calls) == 1
+    assert gpt_calls[0][1].get("BENCH_GPT_SEQ") == "4096"
+    assert gpt_calls[0][1].get("BENCH_FLASH") == "1"
+
+    # long-context banked too -> nothing gpt-related launches
+    calls.clear()
+    _bank(watcher, ["resnet50", "bert_seq384", "bert_seq384_flash",
+                    "gpt_seq1024", "gpt_seq1024_flash", "gpt_seq4096_flash"])
     done = watcher.playbook(deadline=time.time() + 10_000)
     assert done is True
     assert not [c for c, _ in calls if "bench_gpt.py" in c]

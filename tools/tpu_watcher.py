@@ -212,10 +212,10 @@ def playbook(deadline):
         rc, _ = run_killable(
             [sys.executable, "bench_gpt.py"],
             budget,
-            # BENCH_FLASH pinned: an ambient =1 (say, from a manual flash
-            # probe's shell) would bank gpt_seq1024_flash instead and
-            # leave the dense goal permanently unmet
-            env={"BENCH_FLASH": "0",
+            # BENCH_FLASH/BENCH_GPT_SEQ pinned: ambient values (say, from
+            # a manual probe's shell) would bank a different slot and
+            # leave the dense gpt_seq1024 goal permanently unmet
+            env={"BENCH_FLASH": "0", "BENCH_GPT_SEQ": "1024",
                  "BENCH_BUDGET_S": str(int(budget - 50))},
             log_name="bench_gpt.log",
         )
@@ -228,7 +228,7 @@ def playbook(deadline):
         rc, _ = run_killable(
             [sys.executable, "bench_gpt.py"],
             budget,
-            env={"BENCH_FLASH": "1",
+            env={"BENCH_FLASH": "1", "BENCH_GPT_SEQ": "1024",
                  "BENCH_BUDGET_S": str(int(budget - 50))},
             log_name="bench_gpt_flash.log",
         )
@@ -254,6 +254,25 @@ def playbook(deadline):
         )
         log("hlo_scan %s rc=%s" % (name, rc))
     commit_if_changed("record TPU HLO cost census from live window")
+
+    # 4. long-context bonus (lowest priority — only leftover window time):
+    #    GPT seq-4096 through the causal flash kernel. Requires the seq-1024
+    #    flash rung banked first: it proves the kernel's TPU lowering before
+    #    spending a window on the 16x-larger attention problem.
+    if ("gpt_seq1024_flash" in bench.load_bank()
+            and "gpt_seq4096_flash" not in bench.load_bank()
+            and slot(700) > 120):
+        budget = slot(700)
+        rc, _ = run_killable(
+            [sys.executable, "bench_gpt.py"],
+            budget,
+            env={"BENCH_GPT_SEQ": "4096", "BENCH_FLASH": "1",
+                 "BENCH_BUDGET_S": str(int(budget - 50))},
+            log_name="bench_gpt_longctx.log",
+        )
+        log("gpt long-context probe rc=%s" % rc)
+        commit_if_changed(
+            "bank TPU long-context GPT measurement from live window")
 
     g1 = goals_state()
     log("goals after playbook: %s" % g1)
