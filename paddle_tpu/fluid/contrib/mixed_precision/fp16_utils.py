@@ -16,6 +16,16 @@ from ... import unique_name
 
 _FLOAT_SLOTS_SKIP = {"LearningRate", "Mean", "Variance", "Beta1Pow", "Beta2Pow"}
 
+# Per-op float input slots that stay fp32 even when the op itself runs in
+# low precision: normalization statistics/affine params (the bf16-safe BN
+# contract keeps them fp32 at runtime) and additive attention masks (the
+# flash kernel upcasts them to fp32 internally; -1e4 pad masks survive a
+# bf16 round-trip, but there is no bandwidth win casting a [S]-sized row).
+_OP_FLOAT_SLOTS_SKIP = {
+    "batch_norm": {"Scale", "Bias", "Mean", "Variance"},
+    "flash_attention": {"KeyBias", "Bias"},
+}
+
 
 def _low_dtype(use_bf16=True):
     return core.VarDesc.VarType.BF16 if use_bf16 else core.VarDesc.VarType.FP16
@@ -33,6 +43,52 @@ def _insert_cast_op(block, idx, in_name, out_name, in_dtype, out_dtype):
             OP_ROLE_KEY: OpRole.Forward,
         },
     )
+
+
+def _cast_inputs(block, op_, idx, target, cast_cache, black_varnames):
+    """Insert cast ops so every float input of ``op_`` arrives as
+    ``target`` (slot-skips and black_varnames excepted). Returns the
+    number of ops inserted before ``op_``."""
+    skip = set(_FLOAT_SLOTS_SKIP)
+    if target == _low_dtype(True) or target == core.VarDesc.VarType.FP16:
+        # the per-op table encodes "keep fp32": it suppresses DOWNcasts
+        # only — a black-list (fp32) target must still restore fp32 on
+        # these slots (e.g. after cast_parameters_to_bf16)
+        skip |= _OP_FLOAT_SLOTS_SKIP.get(op_.type, set())
+    n_insert = 0
+    for slot, names in list(op_.inputs.items()):
+        if slot in skip:
+            continue
+        new_names = []
+        for name in names:
+            var = block._find_var_recursive(name)
+            if (
+                var is None
+                or var.dtype
+                not in (core.VarDesc.VarType.FP32, core.VarDesc.VarType.BF16,
+                        core.VarDesc.VarType.FP16)
+                or var.dtype == target
+                or name in black_varnames
+            ):
+                new_names.append(name)
+                continue
+            key = (name, target)
+            if key not in cast_cache:
+                cast_name = unique_name.generate(name + ".cast")
+                block.create_var(
+                    name=cast_name,
+                    shape=var.shape,
+                    dtype=target,
+                    persistable=False,
+                )
+                _insert_cast_op(
+                    block, idx + n_insert, name, cast_name, var.dtype, target
+                )
+                n_insert += 1
+                cast_cache[key] = cast_name
+            new_names.append(cast_cache[key])
+        op_.inputs[slot] = new_names
+    return n_insert
 
 
 def rewrite_program(main_prog, amp_lists, use_bf16=True):
@@ -55,19 +111,52 @@ def rewrite_program(main_prog, amp_lists, use_bf16=True):
         elif op_.type in amp_lists.black_list:
             target = core.VarDesc.VarType.FP32
         if target is None:
-            # gray op: dtype FOLLOWS the inputs. Propagate low precision
-            # into the output var descs when any float input desc is low —
-            # otherwise a later black-list op sees a stale FP32 desc on a
-            # runtime-bf16 value and skips its protective fp32 cast
-            # (reference fp16_utils keeps descs in sync the same way).
+            # gray op: dtype FOLLOWS the inputs. When any float input desc
+            # is low, the op RUNS low: (a) propagate low precision into the
+            # output var descs — otherwise a later black-list op sees a
+            # stale FP32 desc on a runtime-bf16 value and skips its
+            # protective fp32 cast — and (b) cast the remaining fp32 float
+            # inputs down so the runtime value matches the desc. Without
+            # (b) a mixed add (bf16 activation + fp32 bias param) silently
+            # PROMOTES to fp32 at runtime while the desc says bf16, and
+            # every desc-trusting consumer downstream (including the gray
+            # flash_attention kernel) inherits fp32 — the desc lie in the
+            # opposite direction (reference fp16_utils casts all float
+            # inputs of an op to its chosen run dtype the same way).
             if op_.type in amp_lists.gray_list:
-                any_low = any(
-                    (v := block._find_var_recursive(n)) is not None
-                    and v.dtype == low
-                    for names in op_.inputs.values()
-                    for n in names
+                # exempt slots (fp32-pinned masks/statistics) neither
+                # trigger low precision nor receive casts: the op's run
+                # dtype is decided by its data inputs only
+                gray_skip = _FLOAT_SLOTS_SKIP | _OP_FLOAT_SLOTS_SKIP.get(
+                    op_.type, set()
                 )
-                if any_low:
+                data_vars = [
+                    block._find_var_recursive(n)
+                    for slot, names in op_.inputs.items()
+                    if slot not in gray_skip
+                    for n in names
+                    if n not in amp_lists.black_varnames
+                ]
+                any_low = any(
+                    v is not None and v.dtype == low for v in data_vars
+                )
+                # a black_varnames input stays fp32 uncast, so the op
+                # would still promote at runtime — treat it as fp32 (no
+                # desc flip) rather than recreate the desc-vs-runtime lie
+                pinned_fp32 = any(
+                    block._find_var_recursive(n) is not None
+                    and block._find_var_recursive(n).dtype
+                    == core.VarDesc.VarType.FP32
+                    for slot, names in op_.inputs.items()
+                    if slot not in gray_skip
+                    for n in names
+                    if n in amp_lists.black_varnames
+                )
+                if any_low and not pinned_fp32:
+                    n_insert = _cast_inputs(
+                        block, op_, idx, low, cast_cache,
+                        amp_lists.black_varnames,
+                    )
                     for slot, names in op_.outputs.items():
                         # normalization statistics stay fp32 at runtime
                         # (bf16-safe BN contract) — keep their descs fp32
@@ -80,41 +169,12 @@ def rewrite_program(main_prog, amp_lists, use_bf16=True):
                             v = block._find_var_recursive(n)
                             if v is not None and v.dtype in float_dtypes:
                                 v.dtype = low
+                    idx += n_insert
             idx += 1
             continue
-        n_insert = 0
-        for slot, names in list(op_.inputs.items()):
-            if slot in _FLOAT_SLOTS_SKIP:
-                continue
-            new_names = []
-            for name in names:
-                var = block._find_var_recursive(name)
-                if (
-                    var is None
-                    or var.dtype
-                    not in (core.VarDesc.VarType.FP32, core.VarDesc.VarType.BF16,
-                            core.VarDesc.VarType.FP16)
-                    or var.dtype == target
-                    or name in amp_lists.black_varnames
-                ):
-                    new_names.append(name)
-                    continue
-                key = (name, target)
-                if key not in cast_cache:
-                    cast_name = unique_name.generate(name + ".cast")
-                    block.create_var(
-                        name=cast_name,
-                        shape=var.shape,
-                        dtype=target,
-                        persistable=False,
-                    )
-                    _insert_cast_op(
-                        block, idx + n_insert, name, cast_name, var.dtype, target
-                    )
-                    n_insert += 1
-                    cast_cache[key] = cast_name
-                new_names.append(cast_cache[key])
-            op_.inputs[slot] = new_names
+        n_insert = _cast_inputs(
+            block, op_, idx, target, cast_cache, amp_lists.black_varnames
+        )
         # outputs of white ops are low precision
         if target == low:
             for slot, names in op_.outputs.items():

@@ -101,17 +101,20 @@ def reference_attention(q, k, v, bias=None, causal=False, scale=None):
     return jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
 
 
-def _scores(q_scaled, kblk, key_bias_row, bias_blk, row_off, col_off,
+def _scores(q, kblk, scale, key_bias_row, bias_blk, row_off, col_off,
             causal, block_q, block_k):
-    """[BQ, BK] masked scores (q_scaled already carries the softmax
-    scale; ``key_bias_row`` is a [1, BK] row that broadcasts over query
-    rows). Shared by all three kernels so forward and backward can never
-    disagree on masking."""
+    """[BQ, BK] masked scores. ``q``/``kblk`` stay in their INPUT dtype:
+    the MXU runs bf16×bf16→fp32 at full rate but fp32×fp32 at a fraction
+    of it, so the dot takes the raw operands and only the accumulator is
+    fp32 (``preferred_element_type``); the softmax scale lands on the
+    fp32 scores. ``key_bias_row`` is a [1, BK] row that broadcasts over
+    query rows. Shared by all three kernels so forward and backward can
+    never disagree on masking."""
     s = jax.lax.dot_general(
-        q_scaled, kblk, (((1,), (1,)), ((), ())),
+        q, kblk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    s = s + key_bias_row
+    s = s * scale + key_bias_row
     if bias_blk is not None:
         s = s + bias_blk.astype(jnp.float32)
     if causal:
@@ -153,7 +156,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, seed_ref,
     dropout) and the backward's rowsum(dO∘O) trick still yields delta."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    q = q_ref[0]                              # [BQ, D], input dtype
     h = pl.program_id(0)
     qi = pl.program_id(1)
     n_kb = kv_len // block_k
@@ -170,7 +173,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, seed_ref,
     for kb in range(n_kb):
         ks = slice(kb * block_k, (kb + 1) * block_k)
         s = _scores(
-            q, k_ref[0, ks, :].astype(jnp.float32), key_bias_ref[0, :, ks],
+            q, k_ref[0, ks, :], scale, key_bias_ref[0, :, ks],
             None if bias_ref is None else bias_ref[0, :, ks],
             qi * block_q, kb * block_k, causal, block_q, block_k,
         )
@@ -187,8 +190,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, seed_ref,
                            dropout_rate),
                 p, 0.0,
             )
+        # p rounds to the value dtype for the MXU (as the dense reference
+        # does with p.astype(q.dtype) @ v); accumulation stays fp32
         acc = acc * alpha + jax.lax.dot_general(
-            p, v_ref[0, ks, :].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0, ks, :], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m = m_new
@@ -207,8 +212,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
     stays unmasked — that IS the softmax jacobian of the dropped output."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)          # [BQ, D]
+    q = q_ref[0]                                # [BQ, D], input dtype
+    do = do_ref[0]                              # [BQ, D], input dtype
     lse = lse_ref[0]                            # [BQ, 1]
     delta = delta_ref[0]                        # [BQ, 1]
     h = pl.program_id(0)
@@ -224,15 +229,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     for kb in range(n_kb):
         ks = slice(kb * block_k, (kb + 1) * block_k)
-        kblk = k_ref[0, ks, :].astype(jnp.float32)
+        kblk = k_ref[0, ks, :]                  # [BK, D], input dtype
         s = _scores(
-            q, kblk, key_bias_ref[0, :, ks],
+            q, kblk, scale, key_bias_ref[0, :, ks],
             None if bias_ref is None else bias_ref[0, :, ks],
             qi * block_q, kb * block_k, causal, block_q, block_k,
         )
         p = jnp.exp(s - lse)                    # [BQ, BK]
         dp = jax.lax.dot_general(               # dO @ V^T
-            do, v_ref[0, ks, :].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0, ks, :].astype(do.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if dropout_rate > 0.0:
@@ -244,9 +249,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
                            dropout_rate),
                 dp * inv_keep, 0.0,
             )
+        # ds rounds to the key dtype for the MXU (standard flash backward);
+        # fp32 accumulation via preferred_element_type
         ds = p * (dp - delta)
         dq = dq + jax.lax.dot_general(          # ds @ K
-            ds, kblk, (((1,), (0,)), ((), ())),
+            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
@@ -263,8 +270,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
 
     kb = pl.program_id(0)       # kv-block index
     h = pl.program_id(1)        # flat head index
-    k = k_ref[0].astype(jnp.float32)            # [BK, D]
-    v = v_ref[0].astype(jnp.float32)            # [BK, D]
+    k = k_ref[0]                                # [BK, D], input dtype
+    v = v_ref[0]                                # [BK, D], input dtype
     key_bias_row = key_bias_ref[0]              # [1, BK]
     n_qb = q_len // block_q
     # read the SMEM seed only when dropout is live: the rate-0 kernel
@@ -284,20 +291,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
 
     for ib in range(n_qb):
         qs = slice(ib * block_q, (ib + 1) * block_q)
-        q = q_ref[0, qs, :].astype(jnp.float32) * scale
-        do = do_ref[0, qs, :].astype(jnp.float32)
+        q = q_ref[0, qs, :]                     # [BQ, D], input dtype
+        do = do_ref[0, qs, :]                   # [BQ, D], input dtype
         lse = lse_ref[0, qs, :]                 # [BQ, 1]
         delta = delta_ref[0, qs, :]             # [BQ, 1]
         s = _scores(
-            q, k, key_bias_row,
+            q, k, scale, key_bias_row,
             None if bias_ref is None else bias_ref[0, qs, :],
             ib * block_q, kb * block_k, causal, block_q, block_k,
         )
         p = jnp.exp(s - lse)                    # [BQ, BK]
         dp = jax.lax.dot_general(               # dO @ V^T
-            do, v, (((1,), (1,)), ((), ())),
+            do, v.astype(do.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        # fp32 intermediates round to the operand dtype for the MXU;
+        # accumulators (dk/dv/dkb/dbias) stay fp32
         if dropout_rate > 0.0:
             rows, cols = _block_coords(
                 ib * block_q, kb * block_k, block_q, block_k
@@ -305,26 +314,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
             keep = _hash_keep(rows, cols, _hash_head(h, head_swap),
                               seed_u, dropout_rate)
             dv = dv + jax.lax.dot_general(      # (mask∘p/keep)^T @ dO
-                jnp.where(keep, p * inv_keep, 0.0), do,
+                jnp.where(keep, p * inv_keep, 0.0).astype(do.dtype), do,
                 (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             dp = jnp.where(keep, dp * inv_keep, 0.0)
         else:
             dv = dv + jax.lax.dot_general(      # p^T @ dO
-                p, do, (((0,), (0,)), ((), ())),
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(          # ds^T @ (q·scale)
-            ds, q, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(          # ds^T @ q (·scale at write)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dkb = dkb + ds.sum(axis=0, keepdims=True)
         if dbias is not None:
             dbias = jax.lax.dynamic_update_slice(dbias, ds, (ib * block_q, 0))
 
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
     dkb_ref[0] = dkb
     if dbias_ref is not None:
