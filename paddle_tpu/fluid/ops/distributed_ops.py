@@ -24,6 +24,7 @@ through XLA via _CompiledBlock.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -146,18 +147,45 @@ def _compile_optimize_block(program, block_idx, place):
     return _executor_mod._CompiledBlock(program, block_idx, [], [], place)
 
 
-def _merge_trainer_grads(server, grad_name, n_trainers):
+def _merge_trainer_grads(server, grad_name, n_trainers, strict=False,
+                         wait_s=10.0):
     """Sum per-trainer copies and average (reference:
     _append_pserver_grad_merge_ops — sum op + scale 1/trainer_num). Sparse
     (SelectedRows) payloads merge by row concatenation with 1/n scaling
-    (reference MergeSelectedRows + scale)."""
+    (reference MergeSelectedRows + scale).
+
+    ``strict`` (sync mode, while no trainer has completed): every
+    trainer's copy MUST be present. The client's deadline-retry can
+    reorder a grad resend after its send_barrier under load, so
+    wait_sends may unblock with one payload still in flight — poll up to
+    ``wait_s`` for the stragglers, then raise rather than silently
+    average over fewer trainers (a plausible-looking but WRONG update;
+    the reference pserver scales by 1/trainer_num unconditionally for
+    the same reason). The caller drops strictness once any trainer sends
+    COMPLETE: a finished trainer legitimately stops producing grads and
+    averaging over the still-running ones is the correct semantics."""
     from .. import core as _core
 
     arrs = []
     sparse = []
     orig_dtype = None
     for t in range(n_trainers):
-        payload = server.get_recv("%s@trainer_%d" % (grad_name, t))
+        name = "%s@trainer_%d" % (grad_name, t)
+        payload = server.get_recv(name)
+        if payload is None and strict:
+            deadline = time.time() + wait_s
+            while payload is None and time.time() < deadline:
+                time.sleep(0.005)
+                if server.n_complete() > 0:
+                    # the straggler wasn't slow, it FINISHED mid-poll
+                    break
+                payload = server.get_recv(name)
+            if payload is None and server.n_complete() == 0:
+                raise RuntimeError(
+                    "sync pserver: grad %r from trainer %d never arrived "
+                    "(send reordered past its barrier and lost?)"
+                    % (grad_name, t)
+                )
         if payload is None:
             continue
         if native.is_selected_rows_payload(payload):
@@ -399,7 +427,15 @@ def _listen_and_serv_lower(ctx, op_):
                 if rc != 0:
                     break
                 for gname, (bidx, _pname) in grad_map.items():
-                    merged = _merge_trainer_grads(server, gname, n_trainers)
+                    merged = _merge_trainer_grads(
+                        server, gname, n_trainers,
+                        strict=server.n_complete() == 0,
+                        # an in-flight straggler lands in milliseconds;
+                        # cap the poll well under the RPC deadline so a
+                        # genuinely lost payload raises promptly instead
+                        # of stalling the server into its own timeout
+                        wait_s=min(timeout_ms / 1000.0, 30.0),
+                    )
                     if merged is None:
                         continue
                     apply_grad(gname, bidx, merged)
@@ -453,8 +489,6 @@ register_op("listen_and_serv", lower=_listen_and_serv_lower, host=True)
 def _prefetch_rows(table_name, eps, tid, ids, width, dtype):
     """Gather table rows for global ids sharded id%n -> pserver, id//n ->
     local row (reference: operators/distributed/parameter_prefetch.cc)."""
-    import time as _time
-
     ids = np.asarray(ids, np.int64).reshape(-1)
     out = np.zeros((len(ids), width), dtype)
     n_eps = len(eps)
@@ -471,7 +505,7 @@ def _prefetch_rows(table_name, eps, tid, ids, width, dtype):
                 break
             except ConnectionError as e:
                 last_err = e
-                _time.sleep(0.1)
+                time.sleep(0.1)
         else:
             raise last_err
         rows = np.frombuffer(raw, dtype).reshape(len(local), width)

@@ -83,11 +83,11 @@ def run_cluster(sync, comm="", extra_env=None):
     outs = []
     try:
         for p in trainers:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=600)
             assert p.returncode == 0, "trainer failed:\n%s\n%s" % (out, err)
             outs.append(parse_losses(out))
         for p in procs:
-            out, err = p.communicate(timeout=120)
+            out, err = p.communicate(timeout=300)
             assert p.returncode == 0, "pserver failed:\n%s\n%s" % (out, err)
     finally:
         for p in procs + trainers:
@@ -220,7 +220,7 @@ def test_heartbeat_monitor_flags_lost_worker():
         for p in trainers:
             p.communicate(timeout=120)
         for p in procs:
-            out, err = p.communicate(timeout=120)
+            out, err = p.communicate(timeout=300)
             assert p.returncode == 0, "pserver crashed:\n%s\n%s" % (out, err)
             assert "PSERVER DONE" in out
             assert "lost" in err  # HeartBeatMonitor warning hit the log
