@@ -81,18 +81,40 @@ def mask_to_key_bias(mask):
     return b
 
 
-def flash_engages(cfg, key_bias):
+# Measured dense/flash crossover on the v5e bench chip (BENCH_BANK.json,
+# round 5): XLA's fused dense attention wins at seq 384 (307 vs 242 seq/s,
+# it runs at the HBM roofline), the Pallas kernel wins from seq 1024 up
+# (GPT-2: 65.9k vs 59.9k tok/s at 1024, 36.6k vs 16.1k at 4096 where the
+# dense [S, S] scores blow the HBM budget).
+FLASH_AUTO_SEQ_THRESHOLD = 1024
+
+
+def flash_engages(cfg, key_bias, seq_len=None):
     """True when multi_head_attention will actually run the fused flash
     path (vs the dense fallback). Model builders that skip constructing a
     dense attention bias on the flash path MUST consult this — a silent
     fallback without the dense bias would drop masking entirely.
     Attention dropout no longer forces the fallback: the kernel applies
     it in-VMEM from a stateless per-step hash (kernels/flash_attention.py
-    dropout_rate)."""
-    return bool(
-        getattr(cfg, "use_flash_attention", False)
-        and key_bias is not None
-    )
+    dropout_rate).
+
+    ``cfg.use_flash_attention`` may be True (always fuse), False/None
+    (never), or ``"auto"``: fuse when the static query length is at or
+    beyond the measured crossover (``FLASH_AUTO_SEQ_THRESHOLD``,
+    overridable per-config via ``cfg.flash_auto_threshold``) — below it
+    XLA's dense attention is the faster program on TPU."""
+    return bool(flash_wanted(cfg, seq_len) and key_bias is not None)
+
+
+def flash_wanted(cfg, seq_len=None):
+    """Resolve ``cfg.use_flash_attention`` (True/False/"auto") to a bool
+    without needing the mask — model builders use this to decide WHICH
+    mask to construct (key-only for the kernel, dense bias otherwise)."""
+    want = getattr(cfg, "use_flash_attention", False)
+    if want == "auto":
+        thr = getattr(cfg, "flash_auto_threshold", FLASH_AUTO_SEQ_THRESHOLD)
+        want = seq_len is not None and seq_len >= thr
+    return bool(want)
 
 
 def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
@@ -120,8 +142,13 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     q = _split_heads(_proj(q_in, "q"))
     k = _split_heads(_proj(kv_in, "k"))
     v = _split_heads(_proj(kv_in, "v"))
-    use_flash = flash_engages(cfg, key_bias)
-    if (getattr(cfg, "use_flash_attention", False) and not use_flash
+    _sq = q_in.shape[1] if len(q_in.shape) >= 2 else -1
+    use_flash = flash_engages(
+        cfg, key_bias, seq_len=None if _sq in (-1, None) else int(_sq)
+    )
+    # warn only for the genuinely unsupported case — an EXPLICIT True with
+    # no mask to ride the kernel; "auto" choosing dense is working policy
+    if (getattr(cfg, "use_flash_attention", False) is True and not use_flash
             and not getattr(cfg, "_warned_flash_fallback", False)):
         import warnings
 

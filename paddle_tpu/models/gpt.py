@@ -75,10 +75,10 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg):
 
     key_bias = None
     attn_bias = None
-    if getattr(cfg, "use_flash_attention", False):
+    if _bert.flash_wanted(cfg, seq_len=int(ids.shape[1])):
         # padding as a key-only bias; causality rides the kernel flag
         key_bias = _bert.mask_to_key_bias(input_mask)
-    if not _bert.flash_engages(cfg, key_bias):
+    else:
         # dense path: causal [1,1,T,T] + key padding [N,1,1,T] broadcast.
         # Built whenever the shared attention helper would take its dense
         # branch (attention dropout no longer forces it — the kernel

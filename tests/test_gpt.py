@@ -181,3 +181,28 @@ def test_gpt_greedy_generate_through_flash_kernel():
                 exe, main, logits, cfg, [3, 7], 10, scope=scope)
     assert outs[True] == outs[False]
     assert len(outs[True]) == 10 and outs[True][:2] == [3, 7]
+
+
+def test_gpt_flash_auto_policy_follows_seq_length():
+    """use_flash_attention="auto" engages the kernel only at/beyond the
+    measured dense/flash crossover (bert.FLASH_AUTO_SEQ_THRESHOLD,
+    overridable via cfg.flash_auto_threshold): short sequences keep XLA's
+    dense attention (it measured faster at seq 384 on TPU), long ones
+    fuse. The dense program must still carry its causal bias."""
+    from paddle_tpu.models import bert as _bert
+
+    def ops_for(seq):
+        cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                                 use_flash_attention="auto")
+        cfg.flash_auto_threshold = 64
+        with fluid.unique_name.guard():
+            main, _startup, _feeds, _loss = gpt.build_gpt_lm_train(cfg, seq)
+        return [op.type for op in main.global_block().ops]
+
+    short = ops_for(32)
+    long_ = ops_for(64)
+    assert "flash_attention" not in short
+    assert "softmax" in short  # dense attention chain with its mask built
+    assert "flash_attention" in long_
+    # default threshold sits at the measured crossover
+    assert _bert.FLASH_AUTO_SEQ_THRESHOLD == 1024
