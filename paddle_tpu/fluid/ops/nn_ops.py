@@ -836,7 +836,7 @@ def _flash_attention(ctx, op_):
     (paddle_tpu/kernels/flash_attention.py): the [S, S] score matrix never
     touches HBM. Differentiable through the kernel's custom VJP, so the
     generic grad maker Just Works."""
-    from ...kernels import flash_attention as _fa
+    from ...kernels.flash_attention import flash_attention_lse
 
     import jax
     import jax.numpy as jnp
@@ -862,17 +862,100 @@ def _flash_attention(ctx, op_):
         seed = jax.random.randint(
             ctx.next_key(), (1, 1), 0, 1 << 23
         ).astype(jnp.float32)
-    ctx.out(
-        op_,
-        "Out",
-        _fa(
-            q, k, v,
-            key_bias=key_bias,
-            bias=bias,
-            causal=bool(op_.attr("causal", False)),
-            scale=float(scale) if scale else None,
-            dropout_rate=rate if seed is not None else 0.0,
-            dropout_seed=seed,
-            interpret=interpret,
-        ),
+    out, lse = flash_attention_lse(
+        q, k, v,
+        key_bias=key_bias,
+        bias=bias,
+        causal=bool(op_.attr("causal", False)),
+        scale=float(scale) if scale else None,
+        dropout_rate=rate if seed is not None else 0.0,
+        dropout_seed=seed,
+        interpret=interpret,
     )
+    ctx.out(op_, "Out", out)
+    # stash the softmax statistics + dropout seed as companions of the
+    # output var: the flash_attention_grad lowering drives the backward
+    # kernels from these residuals instead of replaying the forward
+    # (XLA cannot CSE a replayed Pallas custom call; the reference's
+    # fused attention saves its softmax stats the same way). Companions
+    # live in the segment's lowering env — a grad op in a DIFFERENT
+    # segment won't see them and falls back to the generic vjp replay.
+    oname = op_.output("Out")[0]
+    ctx.set(oname + "@FLASH_LSE", lse)
+    if seed is not None:
+        ctx.set(oname + "@FLASH_SEED", seed)
+
+
+@op("flash_attention_grad")
+def _flash_attention_grad(ctx, op_):
+    """Backward through the flash kernels from the forward's SAVED
+    residuals (Out + @FLASH_LSE/@FLASH_SEED companions) — the forward
+    kernel never re-runs. The generic vjp replay (still the fallback)
+    re-traces the forward, which XLA CSE's for pure ops but not for
+    Pallas custom calls: counting custom-calls in the lowered BERT/GPT
+    step showed the forward kernel executing twice per layer. The
+    reference's fused attention kernels save softmax statistics for
+    their backward for the same reason."""
+    import jax
+
+    from ...kernels.flash_attention import flash_attention_bwd_from_residuals
+    from .registry import _generic_grad_lower
+
+    interpret = bool(op_.attr("interpret", False))
+    on_kernel_path = interpret or jax.default_backend() == "tpu"
+    oname = (op_.inputs.get("Out") or [None])[0]
+    lse = ctx.get_opt(oname + "@FLASH_LSE") if oname else None
+    rate = float(op_.attr("dropout_rate", 0.0))
+    dropout_live = rate > 0.0 and not bool(op_.attr("is_test", False))
+    seed = ctx.get_opt(oname + "@FLASH_SEED") if oname else None
+    has_general_bias = bool(
+        [n for n in (op_.inputs.get("Bias") or []) if n]
+    )
+    if (
+        not on_kernel_path          # dense-math vjp is CSE-able, replay is free
+        or has_general_bias         # [S,S]-bias path keeps the replay
+        or lse is None              # grad landed in a different XLA segment
+        or (dropout_live and seed is None)
+    ):
+        return _generic_grad_lower(ctx, op_)
+
+    q = ctx.in1(op_, "Q")
+    k = ctx.in1(op_, "K")
+    v = ctx.in1(op_, "V")
+    key_bias = ctx.in1(op_, "KeyBias", optional=True)
+    out = ctx.in1(op_, "Out")
+    dout = ctx.in1(op_, "Out@GRAD")
+    scale = op_.attr("scale", 0.0)
+    dq, dk, dv, dkb = flash_attention_bwd_from_residuals(
+        q, k, v, key_bias,
+        seed if dropout_live else None, out, lse, dout,
+        causal=bool(op_.attr("causal", False)),
+        scale=float(scale) if scale else None,
+        dropout_rate=rate if dropout_live else 0.0,
+        interpret=interpret or None,
+    )
+    ctx.out(op_, "Q@GRAD", dq)
+    ctx.out(op_, "K@GRAD", dk)
+    ctx.out(op_, "V@GRAD", dv)
+    kb_grad_names = [
+        n for n in (op_.outputs.get("KeyBias@GRAD") or []) if n
+    ]
+    if key_bias is not None and kb_grad_names:
+        # unbroadcast [B*N, Sk] onto the raw key-bias shape. The forward
+        # normalization collapses ANY accepted raw shape to (r0, Sk) with
+        # r0 in {1, B, B*N} before broadcasting, so the gradient sums the
+        # broadcast axes back down to (r0, Sk) and reshapes to raw.
+        B, N = q.shape[0], q.shape[1]
+        Sk = k.shape[2]
+        full = dkb.reshape(B, N, Sk)
+        raw = tuple(key_bias.shape)
+        r0 = 1
+        for dim in raw[:-1]:
+            r0 *= int(dim)
+        if r0 == B * N:
+            d = dkb
+        elif r0 == B and N > 1:
+            d = full.sum(1)
+        else:  # r0 == 1 (the normalize contract admits no other value)
+            d = full.sum((0, 1))[None]
+        ctx.out(op_, "KeyBias@GRAD", d.reshape(raw).astype(key_bias.dtype))

@@ -710,6 +710,55 @@ def _norm_seed(dropout_seed):
     return (u >> jnp.uint32(9)).astype(jnp.float32).reshape(1, 1)
 
 
+def _normalize_key_bias(key_bias, B, N, Sk):
+    """Raw key bias ([Sk] / [1, Sk] / [B, Sk] / [B*N, Sk] / broadcastable)
+    -> the kernels' canonical [B*N, Sk] fp32 layout."""
+    if key_bias is None:
+        return None
+    kb = key_bias.astype(jnp.float32)
+    if kb.ndim == 1:
+        kb = kb[None]
+    kb = kb.reshape(-1, Sk)
+    if kb.shape[0] == B and N > 1:
+        kb = jnp.broadcast_to(kb[:, None, :], (B, N, Sk)).reshape(-1, Sk)
+    return jnp.broadcast_to(kb, (B * N, Sk))
+
+
+def flash_attention_bwd_from_residuals(q, k, v, key_bias, seed, out, lse, g,
+                                       causal=False, scale=None,
+                                       dropout_rate=0.0, interpret=None):
+    """Backward kernels driven by SAVED forward residuals (out, lse and
+    the dropout seed) instead of a forward replay.
+
+    The fluid ``flash_attention_grad`` lowering uses this: its generic
+    grad machinery re-traces the forward under jax.vjp, which XLA CSE's
+    for pure ops but NOT for Pallas custom calls — so the forward kernel
+    ran twice per training step (verified by custom-call count in the
+    lowered module). The reference saves softmax statistics on its fused
+    attention ops for exactly this reason (multihead_matmul_op.cu).
+
+    KeyBias-only entry (no general [S, S] bias — callers with one take
+    the replay path). ``seed`` is the RAW dropout seed exactly as the
+    caller passed it to the forward entry (None when dropout was off) —
+    it is re-normalized through the same ``_norm_seed`` pipeline here,
+    so the backward kernels hash the identical keep-mask. Returns
+    (dq, dk, dv, dkey_bias[B*N, Sk] fp32)."""
+    B, N, Sq, d = q.shape
+    Sk = k.shape[2]
+    rate = float(dropout_rate or 0.0)
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
+    kb = _normalize_key_bias(key_bias, B, N, Sk)
+    if kb is None:
+        kb = jnp.zeros((B * N, Sk), jnp.float32)
+    seed = _norm_seed(seed)
+    lse = lse.reshape(B * N, Sq)
+    res = (q, k, v, kb, None, seed, out, lse)
+    dq, dk, dv, dkb, _dbias, _dseed = _flash_bwd_core(
+        causal, scale, rate, bool(interpret), None, res, g, None
+    )
+    return dq, dk, dv, dkb
+
+
 def flash_attention_lse(q, k, v, key_bias=None, bias=None, causal=False,
                         scale=None, dropout_rate=0.0, dropout_seed=None,
                         interpret=None):
@@ -747,15 +796,7 @@ def flash_attention_lse(q, k, v, key_bias=None, bias=None, causal=False,
             "per-step seed for real dropout", stacklevel=3)
     seed = _norm_seed(dropout_seed)
     scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
-    kb = None
-    if key_bias is not None:
-        kb = key_bias.astype(jnp.float32)
-        if kb.ndim == 1:
-            kb = kb[None]
-        kb = kb.reshape(-1, Sk)
-        if kb.shape[0] == B and N > 1:
-            kb = jnp.broadcast_to(kb[:, None, :], (B, N, Sk)).reshape(-1, Sk)
-        kb = jnp.broadcast_to(kb, (B * N, Sk))
+    kb = _normalize_key_bias(key_bias, B, N, Sk)
     on_tpu = jax.default_backend() == "tpu"
     if interpret is None and not on_tpu:
         # dense fallback with an explicit lse (same math as the kernels)

@@ -655,3 +655,46 @@ def test_flash_edge_shapes(shape):
         for a, b in zip(g, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5)
+
+
+def test_residual_backward_matches_vjp_with_dropout():
+    """flash_attention_bwd_from_residuals (the fluid grad-op fast path:
+    backward from SAVED out/lse, no forward replay) must produce grads
+    IDENTICAL to differentiating through the kernel entry — including
+    with live dropout, where both sides must hash the same keep-mask
+    from the same RAW seed (the residual path re-normalizes it through
+    _norm_seed exactly as the forward did)."""
+    from paddle_tpu.kernels.flash_attention import (
+        flash_attention_bwd_from_residuals, flash_attention_lse)
+
+    rs = np.random.RandomState(5)
+    B, N, S, D = 2, 3, 16, 8
+    q = jnp.asarray(rs.randn(B, N, S, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, N, S, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, N, S, D), jnp.float32)
+    key_bias = jnp.asarray(
+        np.where(rs.rand(B, S) > 0.2, 0.0, -1e4), jnp.float32)
+    g = jnp.asarray(rs.randn(B, N, S, D), jnp.float32)
+    raw_seed = jnp.asarray([[12345.0]], jnp.float32)
+
+    def fwd(q, k, v, kb):
+        out, _lse = flash_attention_lse(
+            q, k, v, key_bias=kb, causal=True, dropout_rate=0.3,
+            dropout_seed=raw_seed, interpret=True)
+        return out
+
+    out, vjp = jax.vjp(fwd, q, k, v, key_bias)
+    dq0, dk0, dv0, dkb0 = vjp(g)
+    _out2, lse = flash_attention_lse(
+        q, k, v, key_bias=key_bias, causal=True, dropout_rate=0.3,
+        dropout_seed=raw_seed, interpret=True)
+    dq1, dk1, dv1, dkb1 = flash_attention_bwd_from_residuals(
+        q, k, v, key_bias, raw_seed, out, lse, g,
+        causal=True, dropout_rate=0.3, interpret=True)
+    np.testing.assert_allclose(dq1, dq0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dk1, dk0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dv1, dv0, rtol=1e-5, atol=1e-5)
+    # vjp reduces dkey_bias to the raw [B, S] shape; the residual entry
+    # returns the kernels' canonical [B*N, S] — same after head-summing
+    np.testing.assert_allclose(
+        np.asarray(dkb1).reshape(B, N, S).sum(1), dkb0, rtol=1e-5, atol=1e-5)
