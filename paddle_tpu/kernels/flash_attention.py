@@ -262,14 +262,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
                     lse_ref, delta_ref, seed_ref, dk_ref, dv_ref, dkb_ref,
                     dbias_ref, *, scale, causal, q_len, block_q, block_k,
-                    bias_group, dropout_rate, head_swap=None):
-    """One (kv-block, head) program — TRANSPOSED grid: kv axis outermost,
-    head axis innermost, so the shared-bias gradient block is revisited by
-    consecutive programs (safe sequential accumulation on TPU)."""
+                    bias_group, dropout_rate, head_swap=None,
+                    head_major=False):
+    """One (kv-block, head) program. Two grid orders:
+
+    - shared-bias path (``head_major=False``): TRANSPOSED grid, kv axis
+      outermost / head axis innermost, so the shared-bias gradient block
+      is revisited by consecutive programs (safe sequential accumulation
+      on TPU);
+    - KeyBias-only path (``head_major=True``): head axis outermost, so
+      the full q/dO row blocks (index maps keyed on the head only) are
+      REUSED across the inner kv sweep instead of refetched from HBM on
+      every program — at seq 4096 that's ~1 MB of q+dO per program saved."""
     from jax.experimental import pallas as pl
 
-    kb = pl.program_id(0)       # kv-block index
-    h = pl.program_id(1)        # flat head index
+    if head_major:
+        h = pl.program_id(0)    # flat head index
+        kb = pl.program_id(1)   # kv-block index
+    else:
+        kb = pl.program_id(0)   # kv-block index
+        h = pl.program_id(1)    # flat head index
     k = k_ref[0]                                # [BK, D], input dtype
     v = v_ref[0]                                # [BK, D], input dtype
     key_bias_row = key_bias_ref[0]              # [1, BK]
@@ -531,13 +543,18 @@ def _flash_bwd_core(causal, scale, dropout_rate, interpret, head_swap, res,
     )(*([qf, kf, vf, kb3] + ([bf] if bf is not None else [])
         + [gf, lse3, delta3, seed]))
 
-    # ---- dk/dv/dkey_bias/dbias: transposed (kv-block, head) grid ----
+    # ---- dk/dv/dkey_bias/dbias ----
+    # Grid order depends on the bias mode (see _bwd_dkv_kernel): shared
+    # bias needs the transposed (kv, head) grid for safe dbias
+    # accumulation; the KeyBias-only path runs (head, kv) so the full
+    # q/dO row blocks are reused across the inner kv sweep.
+    head_major = bf is None
     group = None if G is None else (B * N) // G
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel if bf is not None else _no_bias(_bwd_dkv_kernel),
         scale=scale, causal=causal, q_len=Sqp, block_q=bq, block_k=bk,
         bias_group=group or 1, dropout_rate=dropout_rate,
-        head_swap=head_swap,
+        head_swap=head_swap, head_major=head_major,
     )
     if bf is None:
         # adapter also has to drop the dbias OUT ref
@@ -548,27 +565,36 @@ def _flash_bwd_core(causal, scale, dropout_rate, interpret, head_swap, res,
             return base(q_ref, k_ref, v_ref, key_bias_ref, do_ref, lse_ref,
                         delta_ref, seed_ref, dk_ref, dv_ref, dkb_ref, None)
 
+    # index maps below are written head-first; the transposed grid swaps
+    # the program-id arguments, the head-major grid uses them verbatim
+    if head_major:
+        def hj(f):
+            return f
+    else:
+        def hj(f):
+            return lambda j, h: f(h, j)
+
     in_specs = [
-        pl.BlockSpec((1, Sqp, D), lambda j, h: (h, 0, 0),
+        pl.BlockSpec((1, Sqp, D), hj(lambda h, j: (h, 0, 0)),
                      memory_space=pltpu.VMEM),       # q (full rows)
-        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+        pl.BlockSpec((1, bk, D), hj(lambda h, j: (h, j, 0)),
                      memory_space=pltpu.VMEM),       # k block
-        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+        pl.BlockSpec((1, bk, D), hj(lambda h, j: (h, j, 0)),
                      memory_space=pltpu.VMEM),       # v block
-        pl.BlockSpec((1, 1, bk), lambda j, h: (h, 0, j),
+        pl.BlockSpec((1, 1, bk), hj(lambda h, j: (h, 0, j)),
                      memory_space=pltpu.VMEM),       # key bias block
     ]
     if bf is not None:
         in_specs.append(
-            pl.BlockSpec((1, Sqp, bk), lambda j, h: (h // group, 0, j),
+            pl.BlockSpec((1, Sqp, bk), hj(lambda h, j: (h // group, 0, j)),
                          memory_space=pltpu.VMEM)    # bias column block
         )
     in_specs += [
-        pl.BlockSpec((1, Sqp, D), lambda j, h: (h, 0, 0),
+        pl.BlockSpec((1, Sqp, D), hj(lambda h, j: (h, 0, 0)),
                      memory_space=pltpu.VMEM),       # dO (full rows)
-        pl.BlockSpec((1, Sqp, 1), lambda j, h: (h, 0, 0),
+        pl.BlockSpec((1, Sqp, 1), hj(lambda h, j: (h, 0, 0)),
                      memory_space=pltpu.VMEM),       # lse
-        pl.BlockSpec((1, Sqp, 1), lambda j, h: (h, 0, 0),
+        pl.BlockSpec((1, Sqp, 1), hj(lambda h, j: (h, 0, 0)),
                      memory_space=pltpu.VMEM),       # delta
         _seed_spec(pl, pltpu),                       # dropout seed
     ]
@@ -578,23 +604,25 @@ def _flash_bwd_core(causal, scale, dropout_rate, interpret, head_swap, res,
         jax.ShapeDtypeStruct((B * N, 1, Skp), jnp.float32),  # dkey_bias
     ]
     out_specs = [
-        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+        pl.BlockSpec((1, bk, D), hj(lambda h, j: (h, j, 0)),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, D), lambda j, h: (h, j, 0),
+        pl.BlockSpec((1, bk, D), hj(lambda h, j: (h, j, 0)),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bk), lambda j, h: (h, 0, j),
+        pl.BlockSpec((1, 1, bk), hj(lambda h, j: (h, 0, j)),
                      memory_space=pltpu.VMEM),
     ]
     if bf is not None:
         out_shape.append(jax.ShapeDtypeStruct((G, Sqp, Skp), jnp.float32))
         out_specs.append(
-            pl.BlockSpec((1, Sqp, bk), lambda j, h: (h // group, 0, j),
+            pl.BlockSpec((1, Sqp, bk), hj(lambda h, j: (h // group, 0, j)),
                          memory_space=pltpu.VMEM)
         )
     outs = pl.pallas_call(
         dkv_kernel,
         out_shape=out_shape,
-        grid=(Skp // bk, B * N),   # kv OUTERMOST: consecutive head revisits
+        grid=(
+            (B * N, Skp // bk) if head_major else (Skp // bk, B * N)
+        ),
         in_specs=in_specs,
         out_specs=out_specs,
         interpret=interpret,
