@@ -82,10 +82,12 @@ def mask_to_key_bias(mask):
 
 
 # Measured dense/flash crossover on the v5e bench chip (BENCH_BANK.json,
-# round 5): XLA's fused dense attention wins at seq 384 (307 vs 242 seq/s,
-# it runs at the HBM roofline), the Pallas kernel wins from seq 1024 up
-# (GPT-2: 65.9k vs 59.9k tok/s at 1024, 36.6k vs 16.1k at 4096 where the
-# dense [S, S] scores blow the HBM budget).
+# round 5, post-AMP-harmonization numbers): XLA's fused dense attention
+# wins at seq 384 (351 vs 272 seq/s — it runs near the HBM roofline) and
+# seq 512 (237 vs 201); GPT-2 at seq 1024 is parity-to-slight-flash-win
+# (79.5k vs 78.0k tok/s); at 4096 flash runs +35% over dense's best
+# FEASIBLE batch — dense b4 cannot even compile there (the [S, S]
+# softmax activations exceed HBM), which is the kernel's real value.
 FLASH_AUTO_SEQ_THRESHOLD = 1024
 
 

@@ -1,0 +1,140 @@
+"""AMP gray-op runtime/desc harmonization (fp16_utils.rewrite_program).
+
+The round-5 fp32-poisoning find: a gray op with mixed bf16+fp32 float
+inputs used to PROMOTE to fp32 at runtime while the rewrite flipped its
+output desc to bf16 — every desc-trusting consumer downstream (including
+the gray flash_attention op) silently inherited fp32. These tests pin
+the fix: gray ops with any low data input now cast their remaining fp32
+float inputs low, with per-op fp32-pinned slots and black_varnames
+suppression (reference: contrib/mixed_precision/fp16_utils.py:174
+rewrite_program casts all float inputs of an op to its run dtype).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.contrib.mixed_precision import fp16_lists, fp16_utils
+
+BF16 = core.VarDesc.VarType.BF16
+FP32 = core.VarDesc.VarType.FP32
+
+
+def _build_fc_bias_program():
+    """mul (white) -> elementwise_add with an fp32 bias param (gray)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4)
+    return main, y
+
+
+def _op_types(prog):
+    return [op.type for op in prog.global_block().ops]
+
+
+def test_gray_add_casts_fp32_bias_after_white_matmul():
+    main, y = _build_fc_bias_program()
+    fp16_utils.rewrite_program(main, fp16_lists.AutoMixedPrecisionLists())
+    blk = main.global_block()
+    add = [op for op in blk.ops if op.type == "elementwise_add"][-1]
+    for slot in ("X", "Y"):
+        for n in add.inputs[slot]:
+            v = blk._find_var_recursive(n)
+            assert v.dtype == BF16, (slot, n, v.dtype)
+    out = blk._find_var_recursive(add.outputs["Out"][0])
+    assert out.dtype == BF16
+    # the bias input is now a cast of the original fp32 param
+    assert any(".cast" in n for n in add.inputs["Y"])
+
+
+def test_black_varname_input_suppresses_gray_desc_flip():
+    main, y = _build_fc_bias_program()
+    blk = main.global_block()
+    add = [op for op in blk.ops if op.type == "elementwise_add"][-1]
+    bias_name = add.inputs["Y"][0]
+    fp16_utils.rewrite_program(
+        main,
+        fp16_lists.AutoMixedPrecisionLists(custom_black_varnames=[bias_name]),
+    )
+    # the pinned-fp32 bias stays uncast, so the add runs (and is DESCRIBED)
+    # fp32 — no desc-vs-runtime divergence in either direction
+    assert add.inputs["Y"] == [bias_name]
+    out = blk._find_var_recursive(add.outputs["Out"][0])
+    assert out.dtype == FP32
+
+
+def test_batch_norm_affine_slots_stay_fp32():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3)
+        fluid.layers.batch_norm(input=c)
+    fp16_utils.rewrite_program(main, fp16_lists.AutoMixedPrecisionLists())
+    blk = main.global_block()
+    bn = [op for op in blk.ops if op.type == "batch_norm"][0]
+    x = blk._find_var_recursive(bn.inputs["X"][0])
+    assert x.dtype == BF16  # conv (white) produced bf16
+    for slot in ("Scale", "Bias", "Mean", "Variance"):
+        for n in bn.inputs.get(slot, []):
+            v = blk._find_var_recursive(n)
+            assert v is not None and v.dtype == FP32, (slot, n)
+        # and no cast was inserted for them
+        assert not any(".cast" in n for n in bn.inputs.get(slot, []))
+    # statistics outputs keep fp32 descs (bf16-safe BN contract)
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        for n in bn.outputs.get(slot, []):
+            v = blk._find_var_recursive(n)
+            assert v is None or v.dtype == FP32, (slot, n)
+
+
+def test_flash_attention_mask_slots_stay_fp32():
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny(
+        hidden_dropout=0.0, attention_dropout=0.0, use_flash_attention=True
+    )
+    main, startup, feeds, loss, acc = bert.build_bert_classifier(
+        cfg, 16, learning_rate=1e-3, use_amp=True
+    )
+    blk = main.global_block()
+    flash = [op for op in blk.ops if op.type == "flash_attention"][0]
+    for slot in ("Q", "K", "V"):
+        v = blk._find_var_recursive(flash.inputs[slot][0])
+        assert v.dtype == BF16, (slot, v.dtype)
+    kb = blk._find_var_recursive(flash.inputs["KeyBias"][0])
+    assert kb.dtype == FP32
+    assert not any(".cast" in n for n in flash.inputs["KeyBias"])
+
+
+def test_rewritten_fc_program_still_trains():
+    """End-to-end: the harmonized program runs and the loss is finite."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+        mp.decorate(opt).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rs = np.random.RandomState(0)
+    feed = {
+        "x": rs.rand(16, 8).astype("float32"),
+        "y": rs.randint(0, 4, (16, 1)).astype("int64"),
+    }
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        losses = [
+            float(np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(4)
+        ]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
