@@ -1,9 +1,10 @@
 """Structural scan of the compiled training step's optimized HLO + cost
 analysis (the PERF.md methodology, reproducible).
 
-Builds the ResNet-50 or BERT-base training step exactly as bench.py does,
-compiles the executor's main XLA segment ahead-of-time on the current
-backend, and prints ONE JSON line:
+Builds the ResNet-50, BERT-base, or GPT-2-small training step exactly as
+bench.py / bench_bert.py / bench_gpt.py do, compiles the executor's main
+XLA segment ahead-of-time on the current backend, and prints ONE JSON
+line:
 
   {"model", "batch", "backend", "flops", "bytes_accessed",
    "hlo_ops": {"transpose": N, "convert": N, "copy": N, "fusion": N,
@@ -64,6 +65,26 @@ def build(model, batch, amp, remat, flash=False, seq=128):
             "input_mask": np.ones((batch, S, 1), "float32"),
             "label": rs.randint(0, 2, (batch, 1)).astype("int64"),
         }
+    elif model == "gpt":
+        from paddle_tpu.models import gpt
+
+        cfg = gpt.GPTConfig(
+            hidden_dropout=0.0, attention_dropout=0.0,
+            use_flash_attention=flash,
+            max_position_embeddings=max(1024, seq),
+        )
+        S = seq
+        main, startup, feeds, loss = gpt.build_gpt_lm_train(
+            cfg, S, use_amp=amp
+        )
+        rs = np.random.RandomState(0)
+        feed = {
+            "ids": rs.randint(0, cfg.vocab_size, (batch, S, 1)).astype("int64"),
+            "pos_ids": np.tile(
+                np.arange(S)[None, :, None], (batch, 1, 1)
+            ).astype("int64"),
+            "input_mask": np.ones((batch, S, 1), "float32"),
+        }
     else:
         raise SystemExit("unknown model %r" % model)
     return main, startup, feed, loss
@@ -71,7 +92,7 @@ def build(model, batch, amp, remat, flash=False, seq=128):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet", choices=["resnet", "bert"])
+    ap.add_argument("--model", default="resnet", choices=["resnet", "bert", "gpt"])
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--amp", type=int, default=1)
     ap.add_argument("--remat", type=int, default=0)
@@ -167,7 +188,7 @@ def main():
         "model": args.model,
         "flash": bool(args.flash),
         "batch": args.batch,
-        "seq": args.seq if args.model == "bert" else None,
+        "seq": args.seq if args.model in ("bert", "gpt") else None,
         "backend": jax.default_backend(),
         "flops": cost.get("flops"),
         "bytes_accessed": cost.get("bytes accessed"),
