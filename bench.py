@@ -138,6 +138,16 @@ def bank_best(prefix):
     return max(cands, key=lambda kv: kv[1].get("value", 0.0))
 
 
+def honor_jax_platforms(jax):
+    """Make an explicit JAX_PLATFORMS env choice actually take effect: the
+    axon sitecustomize pins jax_platforms="axon,cpu" via config, which
+    BEATS the env var — and a hung tunnel then blocks backend init forever
+    before the cpu fallback can engage. Call before any backend
+    initializes. No-op when the env var is unset (live-TPU intent)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def enable_compilation_cache(jax):
     """Persistent XLA compilation cache shared by every bench child, so
     retries (and the driver's end-of-round run) skip recompilation."""
@@ -175,10 +185,7 @@ def child_main(cfg):
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # honor the explicit platform choice even when the axon
-        # sitecustomize pinned jax_platforms via config (config beats env)
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    honor_jax_platforms(jax)
     enable_compilation_cache(jax)
 
     import numpy as np

@@ -32,10 +32,9 @@ def child_main(cfg):
         os.environ["JAX_PLATFORMS"] = cfg["platform"]
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import bench
 
+    bench.honor_jax_platforms(jax)
     bench.enable_compilation_cache(jax)
     import numpy as np
 

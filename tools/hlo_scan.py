@@ -45,6 +45,11 @@ def build(model, batch, amp, remat, flash=False, seq=128):
             "label": rs.randint(0, 1000, (batch, 1)).astype("int64"),
         }
     elif model == "bert":
+        if remat:
+            raise SystemExit(
+                "--remat is only wired for resnet; a bert line would be a "
+                "mislabeled non-remat census"
+            )
         from paddle_tpu.models import bert
 
         cfg = bert.BertConfig()
@@ -66,6 +71,11 @@ def build(model, batch, amp, remat, flash=False, seq=128):
             "label": rs.randint(0, 2, (batch, 1)).astype("int64"),
         }
     elif model == "gpt":
+        if remat:
+            raise SystemExit(
+                "--remat is only wired for resnet; a gpt line would be a "
+                "mislabeled non-remat census"
+            )
         from paddle_tpu.models import gpt
 
         cfg = gpt.GPTConfig(
@@ -108,12 +118,9 @@ def main():
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # the axon sitecustomize pins jax_platforms via config, which beats
-        # the env var — honor the explicit choice (bench.py child convention)
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
     import bench
+
+    bench.honor_jax_platforms(jax)
 
     # share the bench children's persistent XLA cache: when the ladder
     # already compiled this exact program in the same window, the census
