@@ -138,6 +138,44 @@ def bank_best(prefix):
     return max(cands, key=lambda kv: kv[1].get("value", 0.0))
 
 
+def probe_accelerator(timeout_s=100):
+    """True iff a non-cpu jax backend answers device discovery AND a tiny
+    jit within ``timeout_s``, probed in a KILLABLE child so a hung axon
+    tunnel costs a bounded wait instead of blocking this process's
+    backend init forever. Own process group + killpg + DEVNULL streams:
+    SIGKILLing a child that spawned tunnel-helper grandchildren must not
+    leave the caller blocked on an inherited pipe. The child enables the
+    shared persistent compilation cache, so on a healthy tunnel the tiny
+    compile is warm after the first ever probe and the timeout only
+    trips for genuinely dead/wedged tunnels."""
+    import signal
+
+    src = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax, bench\n"
+        "bench.enable_compilation_cache(jax)\n"
+        "assert any(d.platform != 'cpu' for d in jax.devices())\n"
+        "import jax.numpy as jnp\n"
+        "jax.jit(lambda a: (a @ a).sum())("
+        "jnp.ones((128, 128), jnp.bfloat16)).block_until_ready()\n"
+    ) % os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", src],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        rc = -9
+    return rc == 0
+
+
 def honor_jax_platforms(jax):
     """Make an explicit JAX_PLATFORMS env choice actually take effect: the
     axon sitecustomize pins jax_platforms="axon,cpu" via config, which
