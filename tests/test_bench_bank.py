@@ -150,3 +150,19 @@ def test_parent_emits_banked_line_when_tunnel_dead(tmp_path):
     assert gpt["banked"] is True and gpt["seq_len"] == 1024
     assert gpt["vs_baseline"] is None
     assert out.returncode == 0
+
+
+def test_probe_accelerator_bounded_false_when_no_accelerator(bench_mod,
+                                                             monkeypatch):
+    """probe_accelerator returns False within its bound when no accelerator
+    answers. The child intentionally touches the accelerator backend (that
+    IS the probe), so with a dead/absent tunnel it is killed at timeout_s —
+    the guarantee under test is the BOUND, not a fast fail: jax.devices()
+    initializes every registered plugin regardless of JAX_PLATFORMS, so a
+    hung tunnel hangs the child, never the caller."""
+    import time
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    assert bench_mod.probe_accelerator(timeout_s=8) is False
+    assert time.time() - t0 < 40  # killed at ~8s + process overhead
