@@ -85,7 +85,8 @@ def _bank_entry(line):
     """Bank entry from an emit line: keep the measurement facts, drop the
     run-relative fields (vs_baseline is recomputed at emit time)."""
     keep = ("metric", "value", "unit", "batch", "device", "seq_len",
-            "remat", "flash_attention")
+            "remat", "flash_attention", "hostfeed", "plan_hit_rate",
+            "h2d_overlapped")
     return {k: line[k] for k in keep if k in line}
 
 
@@ -127,11 +128,16 @@ def bank_write(slot, entry):
 
 
 def bank_best(prefix):
-    """Best banked TPU entry whose slot starts with ``prefix`` (or None)."""
+    """Best banked TPU entry whose slot starts with ``prefix`` (or None).
+    Host-fed rungs are a SEPARATE convention (the measured rate includes
+    host decode/H2D): a prefix match must never promote one to a
+    device-resident headline — ask for them explicitly via a prefix
+    containing 'hostfeed'."""
     cands = [
         (slot, e)
         for slot, e in load_bank().items()
         if slot.startswith(prefix) and e.get("device") == "tpu"
+        and ("hostfeed" in prefix or not e.get("hostfeed"))
     ]
     if not cands:
         return None, None
@@ -274,29 +280,69 @@ def child_main(cfg):
     _hb("startup ok %.1fs" % (time.time() - t0))
 
     rs = np.random.RandomState(0)
-    # pre-stage the batch on device: the benchmark measures training-step
-    # compute (the reference's synthetic-data convention), not host link
-    # bandwidth — on this rig H2D rides a network tunnel to the chip
-    feed = {
-        "img": jax.device_put(
-            rs.rand(batch, 3, image_size, image_size).astype("float32"), dev
-        ),
-        "label": jax.device_put(rs.randint(0, 1000, (batch, 1)).astype("int64"), dev),
-    }
+    hostfeed = bool(cfg.get("hostfeed"))
+    if hostfeed:
+        # host-fed mode (BENCH_HOSTFEED=1): every batch is GENERATED on
+        # the host and travels through the double-buffered io_pipeline, so
+        # the measured rate includes host decode + H2D — overlapped behind
+        # compute by the pipeline instead of serialized before each step.
+        # This is the rung that proves the overlap claim on hardware; the
+        # device-resident mode below stays the headline convention.
+        from paddle_tpu.fluid import profiler as _profiler
+
+        n_batches = warmup + 2 + steps
+
+        def _host_batches():
+            hrs = np.random.RandomState(1)
+            for _ in range(n_batches):
+                yield {
+                    "img": hrs.rand(batch, 3, image_size, image_size)
+                    .astype("float32"),
+                    "label": hrs.randint(0, 1000, (batch, 1))
+                    .astype("int64"),
+                }
+
+        loader = fluid.DataLoader.from_generator(
+            capacity=4, use_double_buffer=True
+        )
+        loader.set_batch_generator(_host_batches, places=[place])
+        feed_iter = iter(loader)
+
+        def next_feed():
+            return next(feed_iter)
+
+        _hb("hostfeed pipeline ready (double-buffered)")
+    else:
+        # pre-stage the batch on device: this mode measures training-step
+        # compute (the reference's synthetic-data convention), not host
+        # link bandwidth — on this rig H2D rides a network tunnel
+        feed = {
+            "img": jax.device_put(
+                rs.rand(batch, 3, image_size, image_size).astype("float32"),
+                dev,
+            ),
+            "label": jax.device_put(
+                rs.randint(0, 1000, (batch, 1)).astype("int64"), dev
+            ),
+        }
+
+        def next_feed():
+            return feed
 
     t0 = time.time()
     _hb("warmup start (%d steps, includes main-graph compile)" % warmup)
     for i in range(warmup):
-        exe.run(main_prog, feed=feed, fetch_list=[loss])
+        exe.run(main_prog, feed=next_feed(), fetch_list=[loss])
         _hb("warmup step %d/%d done %.1fs" % (i + 1, warmup, time.time() - t0))
     # the executor cache key includes the fetch list, so the fetch-free
     # variant used by the timed loop must be compiled here, not inside it;
     # the follow-up fetching run DRAINS the async queue so none of that
     # work leaks into the timed window
-    exe.run(main_prog, feed=feed, fetch_list=[])
-    exe.run(main_prog, feed=feed, fetch_list=[loss])
+    exe.run(main_prog, feed=next_feed(), fetch_list=[])
+    exe.run(main_prog, feed=next_feed(), fetch_list=[loss])
     _hb("warmup fetch-free variant done %.1fs" % (time.time() - t0))
 
+    c0 = _profiler.get_counters() if hostfeed else {}
     _hb("timed run start (%d steps)" % steps)
     t0 = time.perf_counter()
     l = None
@@ -305,7 +351,7 @@ def child_main(cfg):
         # host<->device every iteration, which on a tunneled chip serializes
         # the pipeline (VERDICT r2 weak #2)
         fetches = [loss] if i == steps - 1 else []
-        out = exe.run(main_prog, feed=feed, fetch_list=fetches)
+        out = exe.run(main_prog, feed=next_feed(), fetch_list=fetches)
         if fetches:
             (l,) = out
     lval = float(np.asarray(l).ravel()[0])
@@ -314,10 +360,22 @@ def child_main(cfg):
     ips = batch * steps / dt
     _hb("timed run ok %.2fs loss=%.4f ips=%.1f" % (dt, lval, ips))
 
-    print(
-        "RESULT " + json.dumps({"ips": ips, "device": device, "loss": lval}),
-        flush=True,
-    )
+    result = {"ips": ips, "device": device, "loss": lval}
+    if hostfeed:
+        # steady-state plan hit rate over the timed window (delta vs the
+        # pre-loop snapshot); the staging count covers the whole run —
+        # the pipeline legitimately runs ahead during warmup
+        c = _profiler.get_counters()
+        hits = c.get("executor_plan_cache_hits", 0) - c0.get(
+            "executor_plan_cache_hits", 0
+        )
+        misses = c.get("executor_plan_cache_misses", 0) - c0.get(
+            "executor_plan_cache_misses", 0
+        )
+        result["hostfeed"] = True
+        result["plan_hit_rate"] = round(hits / max(hits + misses, 1), 4)
+        result["h2d_overlapped"] = c.get("io_pipeline_h2d_batches", 0)
+    print("RESULT " + json.dumps(result), flush=True)
 
 
 def _child_entry(cfg):
@@ -355,6 +413,10 @@ def _base_cfg():
         # trades recompute FLOPs for the bandwidth-dominant activation
         # writes on the HBM-bound step
         "remat": os.environ.get("BENCH_REMAT", "0") == "1",
+        # host-fed rung: batches generated on the host per step and
+        # streamed through the double-buffered io_pipeline (the overlap
+        # lever); the default stays the device-resident convention
+        "hostfeed": os.environ.get("BENCH_HOSTFEED", "0") == "1",
         "platform": "",
     }
 
@@ -484,6 +546,10 @@ def _resnet_line(result, batch, errors, degraded):
         "batch": batch,
         "device": result["device"],
     }
+    if result.get("hostfeed"):
+        line["hostfeed"] = True
+        line["plan_hit_rate"] = result.get("plan_hit_rate")
+        line["h2d_overlapped"] = result.get("h2d_overlapped")
     if degraded:
         # a CPU number has no defensible relation to the V100 baseline
         line["vs_baseline"] = None
@@ -533,6 +599,8 @@ def _banked_resnet_line(errors):
     }
     if e.get("remat"):
         line["remat"] = True
+    if e.get("hostfeed"):
+        line["hostfeed"] = True
     if e.get("note"):
         line["provenance"] = e["note"]
     if errors:
@@ -577,16 +645,26 @@ def _banked_bert_line(errors):
 
 def _banked_gpt_line():
     """Emit-line from the best banked GPT-2 LM TPU measurement, or None
-    (bonus family — bench_gpt.py owns the metric constants; no documented
-    reference constant, so vs_baseline is always null)."""
+    (bonus family — bench_gpt.py owns the metric constants, including the
+    derived V100-era GPT-2-small tokens/sec baseline documented in
+    BASELINE.md; vs_baseline is non-null for the seq-1024 full config the
+    constant was derived for)."""
     slot, e = bank_best("gpt_seq1024")
     if e is None:
         return None
+    vs = None
+    if e.get("seq_len") == 1024:
+        try:
+            import bench_gpt
+
+            vs = round(e["value"] / bench_gpt.V100_GPT2_SMALL_TOK_PER_SEC, 3)
+        except Exception:
+            vs = None
     line = {
         "metric": e.get("metric", "gpt2_small_lm_throughput"),
         "value": e["value"],
         "unit": e.get("unit", "tokens/sec/chip"),
-        "vs_baseline": None,
+        "vs_baseline": vs,
         "batch": e.get("batch"),
         "seq_len": e.get("seq_len"),
         "device": "tpu",
@@ -635,7 +713,11 @@ def parent_main():
             cfg["steps"] = steps
         if remat is not None:
             cfg["remat"] = remat
-        label = "tpu-b%d%s" % (batch, "-remat" if cfg.get("remat") else "")
+        label = "tpu-b%d%s%s" % (
+            batch,
+            "-remat" if cfg.get("remat") else "",
+            "-hostfeed" if cfg.get("hostfeed") else "",
+        )
         result, kind, err, probe_ok = _run_attempt(
             label, cfg, slot * tpu_scale, tpu_deadline()
         )
@@ -645,7 +727,9 @@ def parent_main():
                 if cfg.get("remat"):
                     line["remat"] = True
                 bank_write(
-                    "resnet50" + ("_remat" if cfg.get("remat") else ""),
+                    "resnet50"
+                    + ("_remat" if cfg.get("remat") else "")
+                    + ("_hostfeed" if cfg.get("hostfeed") else ""),
                     _bank_entry(line),
                 )
             prev = banked["resnet"]

@@ -51,6 +51,11 @@ _DEFAULTS = {
     "inner_op_parallelism": 0,
     # reader
     "reader_queue_speed_test_mode": False,
+    # double-buffered device feed: how many decoded+device_put batches the
+    # background producer may run ahead of the consuming step (reference:
+    # buffered_reader.cc kDoubleBufferSize; 2 = classic double buffering —
+    # deeper queues pin more HBM for no extra overlap)
+    "reader_buffer_size": 2,
     # profiling / graphs
     "print_sub_graph_dir": "",
     "pe_profile_fname": "",

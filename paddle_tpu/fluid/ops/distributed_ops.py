@@ -176,10 +176,14 @@ def _merge_trainer_grads(server, grad_name, n_trainers, strict=False,
             deadline = time.time() + wait_s
             while payload is None and time.time() < deadline:
                 time.sleep(0.005)
-                if server.n_complete() > 0:
+                # re-check the recv map BEFORE honoring a completion:
+                # a payload that landed during the sleep must be merged
+                # into THIS step, not left behind to be consumed as a
+                # stale gradient by the next step's merge (ADVICE r5)
+                payload = server.get_recv(name)
+                if payload is None and server.n_complete() > 0:
                     # the straggler wasn't slow, it FINISHED mid-poll
                     break
-                payload = server.get_recv(name)
             if payload is None and server.n_complete() == 0:
                 raise RuntimeError(
                     "sync pserver: grad %r from trainer %d never arrived "
@@ -433,8 +437,15 @@ def _listen_and_serv_lower(ctx, op_):
                         # an in-flight straggler lands in milliseconds;
                         # cap the poll well under the RPC deadline so a
                         # genuinely lost payload raises promptly instead
-                        # of stalling the server into its own timeout
-                        wait_s=min(timeout_ms / 1000.0, 30.0),
+                        # of stalling the server into its own timeout.
+                        # timeout_ms <= 0 is the native "wait forever"
+                        # convention (-1): a negative wait_s would disable
+                        # the poll entirely (ADVICE r5), so clamp to the
+                        # 30 s cap instead
+                        wait_s=(
+                            min(timeout_ms / 1000.0, 30.0)
+                            if timeout_ms > 0 else 30.0
+                        ),
                     )
                     if merged is None:
                         continue

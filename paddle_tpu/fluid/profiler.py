@@ -22,6 +22,9 @@ __all__ = [
     "start_profiler",
     "stop_profiler",
     "RecordEvent",
+    "bump_counter",
+    "get_counters",
+    "reset_counters",
 ]
 
 _events = defaultdict(list)  # name -> [durations]
@@ -29,6 +32,28 @@ _records = []  # (name, start, end, tid) — timeline source
 _active = threading.local()
 _trace_dir = None
 _profiling = False
+
+# Always-on lightweight counters (unlike _events these do not need an
+# active profiling session): the executor's dispatch-plan cache and the
+# io_pipeline feed path bump these so benches/probes can report host-feed
+# overlap and cache hit rates without enabling tracing.
+_counters = defaultdict(int)
+_counters_lock = threading.Lock()
+
+
+def bump_counter(name, n=1):
+    with _counters_lock:
+        _counters[name] += n
+
+
+def get_counters():
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters():
+    with _counters_lock:
+        _counters.clear()
 
 
 class RecordEvent(object):
@@ -61,6 +86,7 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 def reset_profiler():
     _events.clear()
     del _records[:]
+    reset_counters()
 
 
 def get_records():
@@ -108,6 +134,12 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def _print_summary(sorted_key=None):
+    counters = get_counters()
+    if counters:
+        print(
+            "Counters: "
+            + ", ".join("%s=%d" % kv for kv in sorted(counters.items()))
+        )
     if not _events:
         return
     rows = []

@@ -5,22 +5,35 @@ GeneratorLoader:298, PyReader:583) over a C++ LoDTensorBlockingQueue +
 BufferedReader double-buffering H2D on its own CUDA stream
 (operators/reader/buffered_reader.cc:63-95).
 
-TPU-native: the double-buffer is a background thread filling a bounded queue
-of host batches plus jax.device_put prefetch of the next batch while the
-current step runs — the standard XLA input-pipeline overlap."""
+TPU-native: the double-buffer is io_pipeline.DeviceFeeder — a background
+thread that decodes batch N+1 and dispatches its jax.device_put while step
+N computes (the standard XLA input-pipeline overlap), bounded by
+FLAGS_reader_buffer_size. With no places set (host-only readers, unit
+tests) the feeder degrades to plain threaded buffering of host batches."""
 
 from __future__ import annotations
 
-import queue
 import struct
 import threading
 
 import numpy as np
 
 from . import core
+from . import io_pipeline as _io_pipeline
 from .framework import Variable
 
 __all__ = ["DataLoader", "PyReader"]
+
+
+def _close_queue(holder):
+    """Close an epoch's native queue exactly once (idempotent; holder may
+    be None before the first epoch)."""
+    q = holder.pop("q", None) if holder else None
+    if q is not None:
+        try:
+            q.close()
+        except Exception:
+            pass
 
 
 class _GeneratorLoader(object):
@@ -41,7 +54,13 @@ class _GeneratorLoader(object):
         self._places = None
         self._queue = None
         self._thread = None
-        self._exited = False
+        self._exit_event = None  # current epoch's shutdown signal
+        self._pipe = None  # current epoch's DeviceFeeder
+        # current epoch's {"q": BlockingQueue} holder — PER EPOCH, so a
+        # stale iterator's cleanup can only ever close its own queue,
+        # never a newer epoch's
+        self._native_holder = None
+        self._it = None
 
     # -- wiring --
     def set_sample_generator(
@@ -88,29 +107,49 @@ class _GeneratorLoader(object):
         return self._run()
 
     def _run(self):
+        """One epoch: a decode source (native blocking queue when the C++
+        library is present, plain Python otherwise) wrapped in a
+        DeviceFeeder. With ``use_double_buffer`` the feeder's thread
+        decodes batch N+1 and dispatches its jax.device_put (to the first
+        of ``places``) while step N computes; otherwise it is plain
+        threaded host buffering at ``capacity`` depth."""
         from . import native
 
+        exit_ev = threading.Event()
+        self._exit_event = exit_ev
+        holder = {"q": None}
+        self._native_holder = holder
         if native.available():
-            yield from self._run_native()
-            return
-        q = queue.Queue(maxsize=self._capacity)
-        sentinel = object()
+            src = self._run_native(exit_ev, holder)
+        else:
+            src = self._iter_decoded(exit_ev)
+        pipe = _io_pipeline.DeviceFeeder(
+            src,
+            place=self._places if self._use_double_buffer else None,
+            depth=None if self._use_double_buffer else self._capacity,
+            stage=self._use_double_buffer,
+        )
+        self._pipe = pipe
+        try:
+            yield from pipe
+        finally:
+            # normal exhaustion, consumer abandon (GeneratorExit), or a
+            # propagated producer error all land here: no leaked threads
+            exit_ev.set()
+            _close_queue(holder)
+            pipe.close()
+            # the queue registers from the feeder thread at generator
+            # start — re-check in case that happened mid-shutdown
+            _close_queue(holder)
+            if self._pipe is pipe:
+                self._pipe = None
 
-        def _producer():
-            try:
-                for batch in self._batch_reader():
-                    if self._exited:
-                        return
-                    q.put(batch)
-            finally:
-                q.put(sentinel)
-
-        t = threading.Thread(target=_producer, daemon=True)
-        t.start()
+    def _iter_decoded(self, exit_ev):
+        """Synchronous decode source (no native library): runs on the
+        DeviceFeeder's producer thread."""
         names = self._feed_names()
-        while True:
-            batch = q.get()
-            if batch is sentinel:
+        for batch in self._batch_reader():
+            if exit_ev.is_set():
                 return
             if isinstance(batch, dict):
                 yield batch
@@ -118,7 +157,7 @@ class _GeneratorLoader(object):
                 # no feed_list (from_dataset) -> yield the raw batch list
                 yield dict(zip(names, batch)) if names else batch
 
-    def _run_native(self):
+    def _run_native(self, exit_ev, holder):
         """Producer thread feeds the native C++ blocking queue with
         tensor-stream-encoded batches (reference: GeneratorLoader over
         LoDTensorBlockingQueue, reader.py:298 + reader_py.cc); blocking
@@ -129,6 +168,7 @@ class _GeneratorLoader(object):
         from .ops import io_ops as _io
 
         q = native.BlockingQueue(self._capacity)
+        holder["q"] = q  # reset()/epoch cleanup close it to unblock both ends
         names = self._feed_names()
         producer_error = []
 
@@ -164,7 +204,7 @@ class _GeneratorLoader(object):
         def _producer():
             try:
                 for batch in self._batch_reader():
-                    if self._exited:
+                    if exit_ev.is_set():
                         return
                     try:
                         q.push(_encode(batch))
@@ -216,9 +256,24 @@ class _GeneratorLoader(object):
         self._it = self._run()
 
     def reset(self):
-        self._exited = True
-        self._it = None
-        self._exited = False
+        """Stop the current epoch's pipeline mid-stream: signals the
+        decode source, closes the native queue (unblocking a mid-push
+        producer), and joins the feeder thread — no leaked threads, and a
+        fresh ``__iter__``/``start()`` begins a clean epoch."""
+        ev = self._exit_event
+        if ev is not None:
+            ev.set()
+        holder = self._native_holder
+        _close_queue(holder)
+        pipe = self._pipe
+        if pipe is not None:
+            self._pipe = None
+            pipe.close()
+        _close_queue(holder)  # registered mid-shutdown from the feeder
+        it = self._it
+        if it is not None:
+            self._it = None
+            it.close()
 
     def next(self):
         return next(self._it)

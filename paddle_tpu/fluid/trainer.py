@@ -18,9 +18,6 @@ PipelineTrainer feeds the stage-partitioned executor (fluid/pipeline.py).
 
 from __future__ import annotations
 
-import queue as _queue
-import threading
-
 import numpy as np
 
 
@@ -37,58 +34,32 @@ class TrainerBase(object):
 
 class MultiTrainer(TrainerBase):
     """reference: trainer.h:64 MultiTrainer + HogwildWorker loop
-    (hogwild_worker.cc:163). A reader thread streams the dataset's batches
-    through a bounded queue; the device consumes in order while the host
-    parses ahead."""
-
-    QUEUE_DEPTH = 8
-
-    def _producer(self, dataset, out_q, stop, error):
-        try:
-            for batch in dataset._iter_batches():
-                # bounded put that re-checks stop so an aborted consumer
-                # cannot strand this thread on a full queue
-                while not stop.is_set():
-                    try:
-                        out_q.put(batch, timeout=0.2)
-                        break
-                    except _queue.Full:
-                        continue
-                if stop.is_set():
-                    return
-        except BaseException as e:  # propagate to the consumer
-            error.append(e)
-        finally:
-            while not stop.is_set():
-                try:
-                    out_q.put(None, timeout=0.2)
-                    break
-                except _queue.Full:
-                    continue
+    (hogwild_worker.cc:163). The dataset's batches stream through the
+    double-buffered io_pipeline feeder: its thread parses the next batch
+    AND dispatches the jax.device_put for it while the device runs the
+    current step, so the executor's feed fast lane sees committed device
+    arrays (dense slots; LoD slots keep their host form and take the
+    normal path)."""
 
     def train(self, executor, program, dataset, scope=None, fetch_list=None,
               fetch_info=None, print_period=100, on_step=None):
+        from . import io_pipeline as _io_pipeline
+
         feed_names = [
             v.name if hasattr(v, "name") else str(v)
             for v in dataset.use_var
         ]
-        out_q = _queue.Queue(maxsize=self.QUEUE_DEPTH)
-        stop = threading.Event()
-        error = []
-        t = threading.Thread(
-            target=self._producer, args=(dataset, out_q, stop, error),
-            daemon=True,
+
+        def _feeds():
+            for batch in dataset._iter_batches():
+                yield dict(zip(feed_names, batch))
+
+        pipe = _io_pipeline.DeviceFeeder(
+            _feeds(), place=getattr(executor, "place", None)
         )
-        t.start()
         step = 0
         try:
-            while True:
-                batch = out_q.get()
-                if batch is None:
-                    if error:
-                        raise error[0]
-                    break
-                feed = dict(zip(feed_names, batch))
+            for feed in pipe:
                 outs = executor.run(
                     program, feed=feed, fetch_list=fetch_list or [],
                     scope=scope,
@@ -106,7 +77,7 @@ class MultiTrainer(TrainerBase):
                     on_step(step)
                 step += 1
         finally:
-            stop.set()
+            pipe.close()
         return step
 
 

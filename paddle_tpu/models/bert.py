@@ -120,14 +120,20 @@ def flash_wanted(cfg, seq_len=None):
 
 
 def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
-                         causal=False):
+                         causal=False, use_flash=None):
     """Self/cross attention on [N, S, H] inputs.
 
     With ``cfg.use_flash_attention`` the score/softmax/context chain runs
     as ONE fused flash-attention op — the Pallas kernel keeps the [S, S]
     scores in VMEM, applies attention dropout in-kernel (per-step seed
     from the executor key stream), and ``key_bias`` [N, S] carries the
-    padding mask in key-only form."""
+    padding mask in key-only form.
+
+    ``use_flash``: the builder's RESOLVED policy decision. Model builders
+    choose which mask to construct from ``flash_wanted`` and must pass
+    that same decision down, so a dynamic query dim here can never
+    silently diverge from the mask they built (ADVICE r5). ``None`` keeps
+    the legacy behavior of re-resolving from the static query length."""
     d_head = cfg.hidden_size // cfg.num_heads
 
     def _proj(x, suffix):
@@ -144,16 +150,31 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     q = _split_heads(_proj(q_in, "q"))
     k = _split_heads(_proj(kv_in, "k"))
     v = _split_heads(_proj(kv_in, "v"))
-    _sq = q_in.shape[1] if len(q_in.shape) >= 2 else -1
-    use_flash = flash_engages(
-        cfg, key_bias, seq_len=None if _sq in (-1, None) else int(_sq)
-    )
-    # warn only for the genuinely unsupported case — an EXPLICIT True with
-    # no mask to ride the kernel; "auto" choosing dense is working policy
+    if use_flash is None:
+        _sq = q_in.shape[1] if len(q_in.shape) >= 2 else -1
+        use_flash = flash_engages(
+            cfg, key_bias, seq_len=None if _sq in (-1, None) else int(_sq)
+        )
+    else:
+        # the kernel still needs the key-side mask to ride along
+        use_flash = bool(use_flash) and key_bias is not None
+    import warnings
+
+    if (key_bias is not None and not use_flash and attn_bias is None
+            and not getattr(cfg, "_warned_flash_mask_drop", False)):
+        # the builder prepared ONLY the key-only mask (flash path) but the
+        # dense branch is about to run without any attn_bias: causal +
+        # padding masking would be silently dropped (ADVICE r5)
+        warnings.warn(
+            "flash attention resolved off for %r but only a key-only mask "
+            "was built: the dense fallback runs UNMASKED. Pass the "
+            "builder's resolved use_flash down, or build a dense attn_bias "
+            "for the fallback." % name, stacklevel=2)
+        cfg._warned_flash_mask_drop = True  # once per config, not per layer
+    # warn also for the other mismatch — an EXPLICIT True with no mask to
+    # ride the kernel; "auto" choosing dense is working policy
     if (getattr(cfg, "use_flash_attention", False) is True and not use_flash
             and not getattr(cfg, "_warned_flash_fallback", False)):
-        import warnings
-
         warnings.warn(
             "use_flash_attention=True but no key_bias/input_mask was "
             "built: falling back to dense attention", stacklevel=2)
@@ -197,9 +218,9 @@ def _ffn(x, cfg, name):
     )
 
 
-def encoder_layer(x, attn_bias, cfg, name, key_bias=None):
+def encoder_layer(x, attn_bias, cfg, name, key_bias=None, use_flash=None):
     attn = multi_head_attention(x, x, attn_bias, cfg, "%s_att" % name,
-                                key_bias=key_bias)
+                                key_bias=key_bias, use_flash=use_flash)
     attn = _dropout(attn, cfg.hidden_dropout, cfg.is_test)
     x = fluid.layers.layer_norm(
         fluid.layers.elementwise_add(x, attn), begin_norm_axis=2,
@@ -238,16 +259,20 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
     mask_t = fluid.layers.transpose(input_mask, perm=[0, 2, 1])
     attn_mask = fluid.layers.matmul(input_mask, mask_t)  # [N, S, S]
     attn_bias = mask_to_bias(attn_mask)
-    key_bias = (
-        mask_to_key_bias(input_mask)
-        if getattr(cfg, "use_flash_attention", False)
-        else None
+    # resolve the flash policy ONCE here (the dense attn_bias above is
+    # always built, so a fallback stays masked either way) and pass the
+    # decision down — the attention helper must never re-derive it from a
+    # possibly-dynamic query dim (ADVICE r5)
+    _s = src_ids.shape[1] if len(src_ids.shape) >= 2 else -1
+    use_flash = flash_wanted(
+        cfg, seq_len=None if _s in (-1, None) else int(_s)
     )
+    key_bias = mask_to_key_bias(input_mask) if use_flash else None
 
     x = emb
     for i in range(cfg.num_layers):
         x = encoder_layer(x, attn_bias, cfg, "layer_%d" % i,
-                          key_bias=key_bias)
+                          key_bias=key_bias, use_flash=use_flash)
 
     first_tok = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1])
     first_tok = fluid.layers.reshape(first_tok, shape=[-1, cfg.hidden_size])

@@ -75,7 +75,15 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg):
 
     key_bias = None
     attn_bias = None
-    if _bert.flash_wanted(cfg, seq_len=int(ids.shape[1])):
+    # resolve the flash policy ONCE and pass the decision down: the
+    # attention helper re-deriving it from a possibly-dynamic q_in seq dim
+    # could silently take the dense branch with attn_bias=None, dropping
+    # causal+padding masking entirely (ADVICE r5)
+    _s = ids.shape[1] if len(ids.shape) >= 2 else -1
+    use_flash = _bert.flash_wanted(
+        cfg, seq_len=None if _s in (-1, None) else int(_s)
+    )
+    if use_flash:
         # padding as a key-only bias; causality rides the kernel flag
         key_bias = _bert.mask_to_key_bias(input_mask)
     else:
@@ -95,7 +103,7 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg):
         name = "gpt_%d" % i
         attn = _bert.multi_head_attention(
             h, h, attn_bias, cfg, name + "_att", key_bias=key_bias,
-            causal=True,
+            causal=True, use_flash=use_flash,
         )
         attn = _bert._dropout(attn, cfg.hidden_dropout, cfg.is_test)
         h = fluid.layers.layer_norm(

@@ -145,10 +145,17 @@ def test_parent_emits_banked_line_when_tunnel_dead(tmp_path):
     assert resnet["banked"] is True and resnet["value"] == 1384.0
     assert resnet["device"] == "tpu" and resnet["git_sha"] == "abc1234"
     assert bert["banked"] is True and bert["seq_len"] == 384
-    # bonus GPT family line rides the bank too (vs_baseline stays null:
-    # no documented reference constant for this config)
+    # bonus GPT family line rides the bank too; the seq-1024 config now
+    # reports against the DERIVED V100-era constant (BASELINE.md,
+    # VERDICT item 6) instead of null
     assert gpt["banked"] is True and gpt["seq_len"] == 1024
-    assert gpt["vs_baseline"] is None
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import bench_gpt
+
+    assert gpt["vs_baseline"] == round(
+        50000.0 / bench_gpt.V100_GPT2_SMALL_TOK_PER_SEC, 3
+    )
     assert out.returncode == 0
 
 

@@ -306,3 +306,96 @@ def test_rpc_retry_dedup_barrier_and_async_send():
     assert srv.pop_send(timeout_ms=300) == "timeout"  # no duplicate queued
     cli.close()
     srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# strict sync-merge unit tests against a fake server (ADVICE r5: the
+# subprocess tests above never exercise the straggler poll's edge cases)
+# ---------------------------------------------------------------------------
+import time as _time
+
+from paddle_tpu.fluid.ops import distributed_ops as _dist
+
+
+class FakeServer(object):
+    """Stand-in for native.RpcServer's merge-facing surface: get_recv
+    CONSUMES (like the C++ map), payloads can be scheduled to arrive
+    mid-poll, and completion can flip mid-poll."""
+
+    def __init__(self, recv=None, n_complete=0):
+        self.recv = dict(recv or {})
+        self.scheduled = {}  # name -> (monotonic arrival time, payload)
+        self._n_complete = n_complete
+        self.complete_at = None
+
+    def get_recv(self, name):
+        sched = self.scheduled.get(name)
+        if sched is not None and _time.monotonic() >= sched[0]:
+            self.recv[name] = sched[1]
+            del self.scheduled[name]
+        return self.recv.pop(name, None)
+
+    def n_complete(self):
+        if self.complete_at is not None and _time.monotonic() >= self.complete_at:
+            return max(self._n_complete, 1)
+        return self._n_complete
+
+
+def _payload(arr):
+    return native.serialize_tensor(np.asarray(arr), [])
+
+
+def test_strict_merge_payload_arrives_mid_poll():
+    """A straggler landing during the poll is merged over n_trainers."""
+    a = np.full((2, 2), 2.0, "float32")
+    b = np.full((2, 2), 4.0, "float32")
+    srv = FakeServer(recv={"g@trainer_0": _payload(a)})
+    srv.scheduled["g@trainer_1"] = (_time.monotonic() + 0.05, _payload(b))
+    merged = _dist._merge_trainer_grads(srv, "g", 2, strict=True, wait_s=2.0)
+    np.testing.assert_allclose(merged, (a + b) / 2.0)
+    # nothing left behind for the next step to consume as stale
+    assert not srv.recv and not srv.scheduled
+
+
+def test_strict_merge_recheck_beats_completion_race():
+    """ADVICE r5: when a trainer COMPLETES while another's payload is in
+    flight, the poll must re-check get_recv before honoring the
+    completion break — otherwise the landed payload stays in the recv map
+    and the next step merges it as a stale gradient."""
+    a = np.full((3,), 1.0, "float32")
+    b = np.full((3,), 3.0, "float32")
+    srv = FakeServer(recv={"g@trainer_0": _payload(a)})
+    now = _time.monotonic()
+    # the payload lands DURING the first 5 ms poll sleep, a completion is
+    # visible by the time the loop wakes: the pre-fix code broke on the
+    # completion first and stranded the landed payload
+    srv.scheduled["g@trainer_1"] = (now + 0.001, _payload(b))
+    srv.complete_at = now + 0.002
+    merged = _dist._merge_trainer_grads(srv, "g", 2, strict=True, wait_s=2.0)
+    np.testing.assert_allclose(merged, (a + b) / 2.0)
+    assert not srv.recv, "straggler payload left behind as a stale grad"
+
+
+def test_strict_merge_missing_payload_raises():
+    """No completion + a payload that never arrives must raise (averaging
+    over fewer trainers is a plausible-looking but WRONG update)."""
+    srv = FakeServer(
+        recv={"g@trainer_0": _payload(np.ones((2,), "float32"))}
+    )
+    t0 = _time.monotonic()
+    with pytest.raises(RuntimeError, match="never arrived"):
+        _dist._merge_trainer_grads(srv, "g", 2, strict=True, wait_s=0.3)
+    # the poll is BOUNDED by wait_s, not the rpc deadline
+    assert _time.monotonic() - t0 < 5.0
+
+
+def test_strict_merge_skips_after_completion():
+    """Once any trainer reports COMPLETE, a missing payload is legitimate
+    (the finished trainer stopped producing): merge over the present
+    copies without raising."""
+    a = np.full((2,), 6.0, "float32")
+    srv = FakeServer(
+        recv={"g@trainer_0": _payload(a)}, n_complete=1
+    )
+    merged = _dist._merge_trainer_grads(srv, "g", 2, strict=True, wait_s=0.5)
+    np.testing.assert_allclose(merged, a)  # average over the 1 present copy

@@ -1,0 +1,259 @@
+"""Double-buffered input pipeline (fluid/io_pipeline.py): overlap
+guarantee, executor feed fast lane, and loader thread hygiene.
+
+The overlap test drives tools/feed_overlap_probe.py — a deterministic
+CPU microbench that injects a synthetic per-batch host latency and checks
+the pipelined wall-clock lands at max(compute, feed), not their sum
+(ISSUE 1 acceptance: >= 80% of the hideable side hidden, 100%
+steady-state dispatch-plan cache hit rate)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io_pipeline, profiler
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+
+
+def _feeder_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name == "io_pipeline_feeder" and t.is_alive()
+    ]
+
+
+def _wait_no_feeders(timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not _feeder_threads():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeeder unit behavior
+# ---------------------------------------------------------------------------
+def test_feeder_order_preserved_and_staged():
+    place = fluid.CPUPlace()
+    batches = [{"a": np.full((2, 2), i, "float32")} for i in range(7)]
+    pipe = io_pipeline.DeviceFeeder(iter(batches), place=place)
+    out = list(pipe)
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert isinstance(b, io_pipeline.DeviceFeedBatch)
+        assert b.device is not None
+        np.testing.assert_array_equal(np.asarray(b["a"]), batches[i]["a"])
+    assert _wait_no_feeders()
+
+
+def test_feeder_exception_propagates():
+    def bad():
+        yield {"a": np.zeros((1,), "float32")}
+        raise ValueError("decode exploded")
+
+    pipe = io_pipeline.DeviceFeeder(bad(), place=fluid.CPUPlace())
+    it = iter(pipe)
+    next(it)
+    with pytest.raises(ValueError, match="decode exploded"):
+        next(it)
+    assert _wait_no_feeders()
+
+
+def test_feeder_close_unsticks_blocked_producer():
+    produced = []
+
+    def slow_infinite():
+        i = 0
+        while True:
+            produced.append(i)
+            yield {"a": np.full((1,), i, "float32")}
+            i += 1
+
+    pipe = io_pipeline.DeviceFeeder(slow_infinite(), place=fluid.CPUPlace())
+    it = iter(pipe)
+    next(it)
+    next(it)
+    # producer is now parked on the bounded queue; close() must not hang
+    pipe.close()
+    assert _wait_no_feeders()
+    # bounded lookahead: depth + in-flight, nowhere near the infinite tail
+    assert len(produced) <= io_pipeline.buffer_size() + 4
+
+
+def test_feeder_passthrough_without_place():
+    batches = [[np.ones((2,), "float32")] for _ in range(3)]
+    pipe = io_pipeline.DeviceFeeder(iter(batches), place=None)
+    out = list(pipe)
+    assert len(out) == 3
+    assert isinstance(out[0][0], np.ndarray)
+
+
+def test_feeder_lod_batches_keep_host_form():
+    lod = fluid.core.LoDTensor(np.arange(3, dtype="int64").reshape(3, 1))
+    lod.set_recursive_sequence_lengths([[2, 1]])
+    pipe = io_pipeline.DeviceFeeder(
+        iter([{"ids": lod, "x": np.ones((2, 2), "float32")}]),
+        place=fluid.CPUPlace(),
+    )
+    (batch,) = list(pipe)
+    # device is None -> the executor takes the normal (LoD-aware) path
+    assert batch.device is None
+    assert isinstance(batch["ids"], fluid.core.LoDTensor)
+
+
+# ---------------------------------------------------------------------------
+# loader-level behavior (reset / shutdown / double buffer wiring)
+# ---------------------------------------------------------------------------
+def _make_loader(data, places=None, use_double_buffer=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="iop_x", shape=[4], dtype="float32")
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], capacity=8, use_double_buffer=use_double_buffer
+    )
+    loader.set_batch_generator(lambda: iter(data), places=places)
+    return loader
+
+
+def test_loader_reset_mid_epoch_stops_threads_and_restarts():
+    rs = np.random.RandomState(0)
+    data = [(rs.rand(4, 4).astype("float32"),) for _ in range(6)]
+    loader = _make_loader(data * 50, places=[fluid.CPUPlace()])
+    it = iter(loader)
+    next(it)
+    next(it)
+    loader.reset()
+    assert _wait_no_feeders()
+    # a fresh epoch starts clean after reset and sees every batch in order
+    seen = list(loader)
+    assert len(seen) == len(data) * 50
+    np.testing.assert_array_equal(np.asarray(seen[0]["iop_x"]), data[0][0])
+    assert _wait_no_feeders()
+
+
+def test_stale_iterator_cleanup_cannot_truncate_live_epoch():
+    """A prior epoch's abandoned iterator closing mid-epoch-2 must only
+    ever tear down its OWN native queue (per-epoch holder), not silently
+    truncate the live epoch's stream."""
+    data = [(np.full((2, 4), i, "float32"),) for i in range(30)]
+    loader = _make_loader(data, places=[fluid.CPUPlace()])
+    it1 = iter(loader)
+    next(it1)
+    loader.reset()
+    it2 = iter(loader)
+    first = next(it2)
+    it1.close()  # stale epoch-1 iterator cleans up while epoch 2 runs
+    rest = list(it2)
+    assert 1 + len(rest) == len(data), "live epoch was truncated"
+    np.testing.assert_array_equal(np.asarray(first["iop_x"]), data[0][0])
+    np.testing.assert_array_equal(np.asarray(rest[-1]["iop_x"]), data[-1][0])
+    assert _wait_no_feeders()
+
+
+def test_loader_epoch_exhaustion_leaves_no_threads():
+    data = [(np.ones((2, 4), "float32"),) for _ in range(4)]
+    loader = _make_loader(data, places=[fluid.CPUPlace()])
+    for _ in range(3):  # several epochs back to back
+        assert len(list(loader)) == 4
+    assert _wait_no_feeders()
+
+
+def test_loader_producer_error_propagates_through_pipeline():
+    def bad():
+        yield (np.ones((2, 4), "float32"),)
+        raise RuntimeError("reader died mid-epoch")
+
+    loader = _make_loader([], places=[fluid.CPUPlace()])
+    loader.set_batch_generator(bad, places=[fluid.CPUPlace()])
+    with pytest.raises(RuntimeError, match="reader died mid-epoch"):
+        list(loader)
+    assert _wait_no_feeders()
+
+
+# ---------------------------------------------------------------------------
+# executor integration: fast lane + dispatch-plan cache
+# ---------------------------------------------------------------------------
+def test_executor_fast_lane_and_plan_cache():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="fl_x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rs = np.random.RandomState(0)
+    data = [(rs.rand(8, 4).astype("float32"),) for _ in range(5)]
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], capacity=8, use_double_buffer=True
+    )
+    loader.set_batch_generator(lambda: iter(data), places=[place])
+
+    profiler.reset_counters()
+    losses = [
+        float(np.asarray(exe.run(main, feed=f, fetch_list=[loss])[0]).ravel()[0])
+        for f in loader
+    ]
+    assert len(losses) == 5 and all(np.isfinite(losses))
+    c = profiler.get_counters()
+    assert c.get("executor_feed_fast_lane_steps") == 5
+    assert c.get("executor_h2d_skipped_steps") == 5
+    assert c.get("io_pipeline_h2d_batches") == 5
+    # steady state: every step after the first resolves via the plan cache
+    assert c.get("executor_plan_cache_misses") == 1
+    assert c.get("executor_plan_cache_hits") == 4
+
+    # parity: the fast lane computes the same losses as plain dict feeds
+    exe2 = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe2.run(startup)
+        ref = [
+            float(
+                np.asarray(
+                    exe2.run(main, feed={"fl_x": d[0]}, fetch_list=[loss])[0]
+                ).ravel()[0]
+            )
+            for d in data
+        ]
+    np.testing.assert_allclose(losses, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_flag_bounds_pipeline_depth():
+    old = fluid.get_flags("FLAGS_reader_buffer_size")
+    try:
+        fluid.set_flags({"FLAGS_reader_buffer_size": 1})
+        assert io_pipeline.buffer_size() == 1
+        fluid.set_flags({"FLAGS_reader_buffer_size": 0})
+        assert io_pipeline.buffer_size() == 1  # clamped
+        fluid.set_flags({"FLAGS_reader_buffer_size": 4})
+        assert io_pipeline.buffer_size() == 4
+    finally:
+        fluid.set_flags(old)
+
+
+# ---------------------------------------------------------------------------
+# the overlap guarantee (acceptance criterion)
+# ---------------------------------------------------------------------------
+def test_feed_overlap_probe_hides_host_latency():
+    import feed_overlap_probe
+
+    # quick pass first; on a shared-host load spike retry ONCE at the
+    # probe's full noise-suppression defaults (steps=8, rounds=3). A real
+    # regression (serialized feed) measures ~0 efficiency and fails both.
+    result = feed_overlap_probe.run_probe(steps=6, rounds=2)
+    if result["overlap_efficiency"] < 0.8:
+        result = feed_overlap_probe.run_probe()
+    assert result["overlap_efficiency"] >= 0.8, result
+    assert result["plan_cache_hit_rate"] >= 0.999, result
+    assert result["fast_lane_steps"] == result["steps"], result

@@ -1,0 +1,196 @@
+"""CPU-runnable microbench proving the double-buffered input pipeline
+hides host feed latency.
+
+Deterministic design (no TPU window needed): a synthetic per-batch host
+latency (``time.sleep`` — it releases the GIL exactly like real decode
+I/O) is injected into the batch generator, and the compute step is a
+compiled fc stack sized so compute dominates. If the pipeline works,
+wall-clock per step ~= max(compute, feed); if the feed serializes, it is
+their SUM. The probe reports the overlap efficiency
+
+    (t_compute + t_feed - t_pipelined) / min(t_compute, t_feed)
+
+(1.0 = the whole smaller side disappeared into the larger; 0.0 = fully
+serial) plus the executor dispatch-plan cache hit rate over the timed
+loop (steady state must be 100%: every step after the first resolves its
+compiled block with one dict lookup).
+
+Run directly (prints one JSON line)::
+
+    JAX_PLATFORMS=cpu python tools/feed_overlap_probe.py
+
+or via tests/test_io_pipeline.py, which asserts the >=80% bar (ISSUE 1
+acceptance criterion) as a fast tier-1 regression guard.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(batch, dim, layers):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="probe_x", shape=[dim], dtype="float32")
+        h = x
+        for i in range(layers):
+            h = fluid.layers.fc(
+                input=h, size=dim, act="relu", name="probe_fc%d" % i
+            )
+        loss = fluid.layers.mean(h)
+        # a TRAINING step, not a forward pass: persistable param updates
+        # keep the fetch-free timed steps from being dead-code-eliminated
+        # by XLA (a fetchless forward-only program computes nothing)
+        fluid.optimizer.SGD(learning_rate=1e-4).minimize(loss)
+    return main, startup, x, loss
+
+
+def _timed_steps(exe, main, loss, feed_iter, steps):
+    """Run ``steps`` batches, fetch-synchronizing only on the last one
+    (the bench convention: per-step fetches serialize the pipeline)."""
+    t0 = time.perf_counter()
+    out = None
+    for i in range(steps):
+        feed = next(feed_iter)
+        out = exe.run(
+            main, feed=feed, fetch_list=[loss] if i == steps - 1 else []
+        )
+    _ = float(__import__("numpy").asarray(out[0]).ravel()[0])
+    return (time.perf_counter() - t0) / steps
+
+
+def run_probe(steps=8, rounds=3, feed_fraction=2.0, min_feed_s=0.05,
+              verbose=False):
+    """Returns a dict of measurements; raises AssertionError only for
+    setup problems (callers assert on the returned numbers).
+
+    Shared/loaded hosts drift by 2x between back-to-back runs, so the
+    compute-only and pipelined loops are measured in INTERLEAVED rounds
+    and compared by per-mode minimum (load only ever adds time; the
+    minimum is the undisturbed figure). The injected feed is sized to
+    DOMINATE compute: the sleep is the one load-insensitive quantity in
+    the probe, so the pipelined wall-clock pins to it deterministically
+    (wall ~= max(compute, feed) = feed) and the efficiency ratio measures
+    how much of the hideable side — compute, the min — the overlap
+    actually hid, rather than measuring this box's load spikes."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+
+    import jax
+
+    dev = fluid.core.get_jax_device(place)
+    rs = np.random.RandomState(0)
+
+    # size the compute so it comfortably DOMINATES the injected feed
+    # latency plus scheduling noise (the pipeline then has to hide the
+    # whole feed inside it); escalate until a fast many-core host's XLA
+    # CPU backend actually takes >= ~35 ms/step
+    t_compute = 0.0
+    batches = staged = main = loss = None
+    for batch, dim, layers in (
+        (256, 512, 4), (256, 1024, 8), (512, 2048, 8), (1024, 4096, 8),
+    ):
+        main, startup, x, loss = _build(batch, dim, layers)
+        exe.run(startup)
+        batches = [rs.rand(batch, dim).astype("float32") for _ in range(4)]
+        staged = [{"probe_x": jax.device_put(b, dev)} for b in batches]
+
+        def compute_only():
+            i = 0
+            while True:
+                yield staged[i % len(staged)]
+                i += 1
+
+        # warm up (compiles both the fetching and fetch-free variants)
+        it = compute_only()
+        exe.run(main, feed=next(it), fetch_list=[loss])
+        exe.run(main, feed=next(it), fetch_list=[])
+        t_compute = _timed_steps(exe, main, loss, compute_only(), steps)
+        if t_compute >= 0.035:
+            break
+
+    # injected host latency: a fixed fraction of compute, floored so it
+    # cannot vanish into timer noise — compute stays the max() side, so
+    # a perfect pipeline hides the ENTIRE feed
+    t_feed = max(t_compute * feed_fraction, min_feed_s)
+
+    def slow_batches():
+        # total batches: warmup step consumed below + timed steps
+        for i in range(steps + 2):
+            time.sleep(t_feed)  # synthetic decode/read latency
+            yield (batches[i % len(batches)],)
+
+    def pipelined_round(count_hits):
+        loader = fluid.DataLoader.from_generator(
+            feed_list=[x], capacity=64, use_double_buffer=True
+        )
+        loader.set_batch_generator(slow_batches, places=[place])
+        it = iter(loader)
+        # warmup pull: pays the feeder thread spin-up, not the steady state
+        exe.run(main, feed=next(it), fetch_list=[loss])
+        if count_hits:
+            profiler.reset_counters()
+        t = _timed_steps(exe, main, loss, it, steps)
+        counters = profiler.get_counters() if count_hits else None
+        loader.reset()
+        return t, counters
+
+    compute_times, pipe_times, counters = [], [], None
+    for r in range(rounds):
+        compute_times.append(
+            _timed_steps(exe, main, loss, compute_only(), steps)
+        )
+        t, c = pipelined_round(count_hits=(r == rounds - 1))
+        pipe_times.append(t)
+        if c is not None:
+            counters = c
+    t_compute = min(compute_times)
+    t_pipe = min(pipe_times)
+
+    hits = counters.get("executor_plan_cache_hits", 0)
+    misses = counters.get("executor_plan_cache_misses", 0)
+    plan_hit_rate = hits / max(hits + misses, 1)
+    overlap_efficiency = (t_compute + t_feed - t_pipe) / min(
+        t_compute, t_feed
+    )
+    result = {
+        "steps": steps,
+        "rounds": rounds,
+        "compute_s_per_step": round(t_compute, 5),
+        "injected_feed_s_per_step": round(t_feed, 5),
+        "serial_estimate_s_per_step": round(t_compute + t_feed, 5),
+        "pipelined_s_per_step": round(t_pipe, 5),
+        "overlap_efficiency": round(overlap_efficiency, 4),
+        "plan_cache_hit_rate": round(plan_hit_rate, 4),
+        "fast_lane_steps": counters.get("executor_feed_fast_lane_steps", 0),
+        "h2d_overlapped_batches": counters.get("io_pipeline_h2d_batches", 0),
+    }
+    if verbose:
+        print(json.dumps(result, indent=1), file=sys.stderr)
+    return result
+
+
+def main():
+    result = run_probe(verbose=False)
+    ok = (
+        result["overlap_efficiency"] >= 0.8
+        and result["plan_cache_hit_rate"] >= 0.999
+    )
+    result["pass"] = bool(ok)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
