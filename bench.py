@@ -86,7 +86,8 @@ def _bank_entry(line):
     run-relative fields (vs_baseline is recomputed at emit time)."""
     keep = ("metric", "value", "unit", "batch", "device", "seq_len",
             "remat", "flash_attention", "hostfeed", "plan_hit_rate",
-            "h2d_overlapped")
+            "h2d_overlapped", "serving", "offline_rps", "p99_ms",
+            "batch_fill", "bucket_hit_rate", "clients")
     return {k: line[k] for k in keep if k in line}
 
 
@@ -132,12 +133,16 @@ def bank_best(prefix):
     Host-fed rungs are a SEPARATE convention (the measured rate includes
     host decode/H2D): a prefix match must never promote one to a
     device-resident headline — ask for them explicitly via a prefix
-    containing 'hostfeed'."""
+    containing 'hostfeed'. Serving rungs (BENCH_SERVING=1: requests/sec
+    through the dynamic-batching runtime, a different metric entirely)
+    are guarded the same way — only a prefix containing 'serving' sees
+    them."""
     cands = [
         (slot, e)
         for slot, e in load_bank().items()
         if slot.startswith(prefix) and e.get("device") == "tpu"
         and ("hostfeed" in prefix or not e.get("hostfeed"))
+        and ("serving" in prefix or not e.get("serving"))
     ]
     if not cands:
         return None, None
@@ -222,7 +227,156 @@ def _child_fail(kind, msg):
     sys.exit(1)
 
 
+def serving_child_main(cfg):
+    """BENCH_SERVING=1 rung: offline-batch vs dynamic-batch serving
+    throughput + p99 on the GPT-2 export. One request = one seq_len
+    sequence; 'offline' runs pre-stacked full batches through
+    predictor.run (the upper bound dynamic batching chases), 'dynamic'
+    drives the InferenceServer with closed-loop concurrent clients.
+    Banked under the 'gpt_serving' slot, never promoted to a headline
+    (bank_best guards on the serving flag, same as the hostfeed rung)."""
+    import tempfile
+    import threading
+
+    t_start = time.time()
+    if cfg["platform"]:
+        os.environ["JAX_PLATFORMS"] = cfg["platform"]
+
+    import jax
+
+    honor_jax_platforms(jax)
+    enable_compilation_cache(jax)
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import inference, serving
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_infer
+
+    _hb("probe start (device discovery)")
+    if cfg["platform"] == "cpu":
+        device = "cpu"
+    elif fluid.core.get_tpu_device_count() == 0:
+        _child_fail("no_tpu", "no TPU device visible to this child")
+    else:
+        device = "tpu"
+    _hb("probe ok %.1fs device=%s" % (time.time() - t_start, device))
+
+    seq_len = cfg.get("seq_len", 128)
+    max_batch = cfg.get("batch", 8)
+    clients = cfg.get("clients", 2 * max_batch)
+    gcfg = GPTConfig(
+        vocab_size=cfg.get("vocab", 50257),
+        hidden_size=cfg.get("hidden", 768),
+        num_layers=cfg.get("layers", 12),
+        num_heads=cfg.get("heads", 12),
+        intermediate_size=cfg.get("hidden", 768) * 4,
+        is_test=True,
+    )
+    t0 = time.time()
+    _hb("build start (GPT infer graph + export)")
+    main_prog, startup, feed_names, logits = build_gpt_infer(gcfg, seq_len)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+    # a GPT-2-small export is ~0.5 GB: clean it up even on failure, or
+    # repeated runs fill /tmp on a long-lived TPU host
+    import shutil
+
+    export_dir = tempfile.mkdtemp(prefix="bench_serving_")
+    try:
+        with fluid.scope_guard(scope):
+            fluid.io.save_inference_model(
+                export_dir, feed_names,
+                [main_prog.global_block().var(logits.name)], exe,
+                main_program=main_prog,
+            )
+        _hb("build ok %.1fs" % (time.time() - t0))
+        _serving_measure(cfg, inference, serving, np, export_dir, device,
+                         gcfg, seq_len, max_batch, clients)
+    finally:
+        shutil.rmtree(export_dir, ignore_errors=True)
+
+
+def _serving_measure(cfg, inference, serving, np, export_dir, device, gcfg,
+                     seq_len, max_batch, clients):
+    """Measurement body of the serving rung (export_dir cleanup owned by
+    serving_child_main)."""
+    import threading
+
+    rs = np.random.RandomState(0)
+    one = [
+        rs.randint(0, gcfg.vocab_size, (1, seq_len, 1)).astype("int64"),
+        np.arange(seq_len, dtype="int64").reshape(1, seq_len, 1),
+        np.ones((1, seq_len, 1), dtype="float32"),
+    ]
+    stacked = [np.repeat(a, max_batch, axis=0) for a in one]
+
+    t0 = time.time()
+    _hb("offline warmup start (batch-%d compile)" % max_batch)
+    offline_pred = inference.create_paddle_predictor(
+        inference.AnalysisConfig(export_dir)
+    )
+    offline_pred.run(stacked)
+    _hb("offline warmup ok %.1fs" % (time.time() - t0))
+    steps = cfg.get("steps", 10)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        offline_pred.run(stacked)
+    offline_rps = steps * max_batch / (time.perf_counter() - t0)
+    _hb("offline ok %.1f req/s" % offline_rps)
+
+    t0 = time.time()
+    _hb("server warmup start (bucket ladder compiles)")
+    server_pred = inference.create_paddle_predictor(
+        inference.AnalysisConfig(export_dir)
+    )
+    server = serving.InferenceServer(
+        server_pred, max_batch_size=max_batch,
+        batch_timeout_ms=cfg.get("batch_timeout_ms", 8.0),
+        queue_depth=4 * clients, num_workers=cfg.get("workers", 1),
+    ).start(warmup_inputs=one)
+    _hb("server warmup ok %.1fs" % (time.time() - t0))
+
+    per_client = cfg.get("requests_per_client", 2 * steps)
+    errors = []
+
+    def client_loop():
+        try:
+            for _ in range(per_client):
+                server.infer(one, deadline_ms=120000)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=client_loop) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    stats = server.stats()
+    server.stop()
+    if errors:
+        _child_fail("other", "serving clients failed: %r" % errors[:2])
+    rps = clients * per_client / dt
+    _hb("dynamic ok %.1f req/s fill=%.2f" % (rps, stats.batch_fill_ratio))
+    print("RESULT " + json.dumps({
+        "rps": rps,
+        "offline_rps": offline_rps,
+        "p99_ms": stats.latency_ms["p99"],
+        "batch_fill": stats.batch_fill_ratio,
+        "bucket_hit_rate": stats.bucket_hit_rate,
+        "plan_misses_after_warm": stats.plan_cache_misses,
+        "clients": clients,
+        "device": device,
+    }), flush=True)
+
+
 def child_main(cfg):
+    if cfg.get("serving"):
+        return serving_child_main(cfg)
     t_start = time.time()
     if cfg["platform"]:
         os.environ["JAX_PLATFORMS"] = cfg["platform"]
@@ -686,7 +840,9 @@ def parent_main():
 
     banked = {"resnet": None, "bert": None}  # best emitted-line per metric
     tpu_ok = {"resnet": False, "bert": False}
-    errors = {"resnet": [], "bert": []}
+    # serving failures surface via note_fail's stderr trace only: the
+    # rung is bank-only (no emit line exists to carry an error field)
+    errors = {"resnet": [], "bert": [], "serving": []}
     tunnel_suspect = False
     # test hook: shrink TPU slots (hang-path tests shouldn't take 20 min)
     tpu_scale = float(os.environ.get("BENCH_TPU_SLOT_SCALE", "1"))
@@ -792,6 +948,51 @@ def parent_main():
             tunnel_suspect = True
         return False
 
+    def try_serving_tpu(slot):
+        """BENCH_SERVING=1 rung: bank the dynamic-batching serving
+        throughput on the GPT-2 export under 'gpt_serving'. Bank-only
+        (never an emit line): requests/sec through the serving runtime is
+        a different convention from the headline tokens/sec metrics."""
+        nonlocal tunnel_suspect
+        cfg = {
+            "platform": "",
+            "serving": True,
+            "batch": int(os.environ.get("BENCH_SERVING_BATCH", "8")),
+            "seq_len": int(os.environ.get("BENCH_SERVING_SEQ", "128")),
+            "layers": int(os.environ.get("BENCH_SERVING_LAYERS", "12")),
+            "hidden": int(os.environ.get("BENCH_SERVING_HIDDEN", "768")),
+            "heads": int(os.environ.get("BENCH_SERVING_HEADS", "12")),
+            "vocab": int(os.environ.get("BENCH_SERVING_VOCAB", "50257")),
+            "steps": int(os.environ.get("BENCH_SERVING_STEPS", "10")),
+        }
+        label = "serving-gpt-b%d-s%d" % (cfg["batch"], cfg["seq_len"])
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, tpu_deadline()
+        )
+        if result is not None:
+            if result["device"] == "tpu":
+                # routed through _bank_entry so the banked fields can
+                # never drift from its serving keep-list
+                bank_write("gpt_serving", _bank_entry({
+                    "metric": "gpt2_serving_throughput",
+                    "value": round(result["rps"], 2),
+                    "unit": "requests/sec/chip",
+                    "batch": cfg["batch"],
+                    "seq_len": cfg["seq_len"],
+                    "device": "tpu",
+                    "serving": True,
+                    "offline_rps": round(result["offline_rps"], 2),
+                    "p99_ms": result.get("p99_ms"),
+                    "batch_fill": result.get("batch_fill"),
+                    "bucket_hit_rate": result.get("bucket_hit_rate"),
+                    "clients": result.get("clients"),
+                }))
+            return True
+        note_fail("serving", label, kind, err)
+        if kind == "no_tpu" or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
     def bank_cpu_fallbacks():
         # a banked TPU number makes the CPU fallback pointless — skip it
         # and leave the window to phase-D TPU retries
@@ -839,6 +1040,10 @@ def parent_main():
     if not tunnel_suspect:
         if try_bert_tpu(260.0, batch=64, seq_len=128):
             try_bert_tpu(280.0, batch=24, seq_len=384)
+
+    # ---- phase B2: opt-in serving rung (BENCH_SERVING=1; bank-only) ----
+    if os.environ.get("BENCH_SERVING", "0") == "1" and not tunnel_suspect:
+        try_serving_tpu(300.0)
 
     # ---- phase C: degraded CPU fallbacks for anything still missing ----
     bank_cpu_fallbacks()

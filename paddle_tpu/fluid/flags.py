@@ -56,6 +56,20 @@ _DEFAULTS = {
     # buffered_reader.cc kDoubleBufferSize; 2 = classic double buffering —
     # deeper queues pin more HBM for no extra overlap)
     "reader_buffer_size": 2,
+    # serving runtime (paddle_tpu/serving): micro-batch coalescer policy.
+    # max_batch_size caps how many request rows one device batch carries
+    # (also the top of the default padding-bucket ladder); batch_timeout_ms
+    # bounds how long the coalescer holds the first request of a batch
+    # waiting for more; queue_depth bounds admission (beyond it requests
+    # are SHED with retry-after instead of queuing unboundedly); workers
+    # sizes the predictor pool / dispatch threads.
+    "serving_max_batch_size": 8,
+    "serving_batch_timeout_ms": 5.0,
+    "serving_queue_depth": 64,
+    "serving_workers": 2,
+    # default per-request deadline; 0 = no deadline. Requests whose
+    # deadline passes while queued are shed at dispatch time.
+    "serving_default_deadline_ms": 0.0,
     # profiling / graphs
     "print_sub_graph_dir": "",
     "pe_profile_fname": "",

@@ -13,7 +13,7 @@ import contextlib
 import os
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 
 __all__ = [
     "cuda_profiler",
@@ -25,6 +25,10 @@ __all__ = [
     "bump_counter",
     "get_counters",
     "reset_counters",
+    "bump_histogram",
+    "get_histograms",
+    "get_histogram",
+    "reset_histograms",
 ]
 
 _events = defaultdict(list)  # name -> [durations]
@@ -40,6 +44,13 @@ _profiling = False
 _counters = defaultdict(int)
 _counters_lock = threading.Lock()
 
+# Always-on value histograms (serving latency percentiles ride these).
+# Bounded per-name: a long-lived server must not grow host memory without
+# bound, so each histogram is a sliding window of the most recent samples
+# (percentiles over the window, which is what a serving dashboard wants).
+_HISTOGRAM_WINDOW = 65536
+_histograms = {}  # name -> deque(maxlen=_HISTOGRAM_WINDOW)
+
 
 def bump_counter(name, n=1):
     with _counters_lock:
@@ -47,13 +58,52 @@ def bump_counter(name, n=1):
 
 
 def get_counters():
+    """Snapshot COPY of the always-on counters. Never hands out the live
+    module-level dict: serving worker threads bump_counter concurrently,
+    and a caller iterating/mutating the snapshot must not race or corrupt
+    them."""
     with _counters_lock:
         return dict(_counters)
 
 
 def reset_counters():
+    """Clear the counters ONLY (the pre-histogram contract callers like
+    tools/feed_overlap_probe.py rely on); histograms have their own
+    reset so a counter-windowing probe can't wipe a live server's
+    latency samples."""
     with _counters_lock:
         _counters.clear()
+
+
+def reset_histograms():
+    with _counters_lock:
+        _histograms.clear()
+
+
+def bump_histogram(name, value):
+    """Record one sample (e.g. a request latency in ms) into the named
+    sliding-window histogram."""
+    with _counters_lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = deque(maxlen=_HISTOGRAM_WINDOW)
+        h.append(float(value))
+
+
+def get_histograms():
+    """Snapshot {name: [samples...]} — list COPIES, same isolation contract
+    as get_counters()."""
+    with _counters_lock:
+        return {k: list(v) for k, v in _histograms.items()}
+
+
+def get_histogram(name):
+    """Snapshot copy of ONE histogram's samples (empty list when absent).
+    Stats pollers that only need one series use this so the lock is held
+    for a single-window copy, not every histogram in the process."""
+    with _counters_lock:
+        h = _histograms.get(name)
+        return list(h) if h is not None else []
 
 
 class RecordEvent(object):
@@ -87,6 +137,7 @@ def reset_profiler():
     _events.clear()
     del _records[:]
     reset_counters()
+    reset_histograms()
 
 
 def get_records():

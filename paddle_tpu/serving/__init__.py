@@ -1,0 +1,45 @@
+"""paddle_tpu.serving — dynamic-batching inference serving runtime.
+
+The request-path counterpart of the training input pipeline: concurrent
+requests coalesce into padded-bucket device batches over a pool of
+AnalysisPredictor clones sharing compiled plans, with bounded admission
+(load shedding + retry-after), per-request deadlines, eager bucket
+warmup (zero steady-state XLA compiles), and a ServingStats snapshot
+riding the always-on fluid.profiler counters.
+
+Quickstart::
+
+    from paddle_tpu import inference, serving
+
+    pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+    server = serving.InferenceServer(
+        pred, max_batch_size=8, batch_timeout_ms=5, num_workers=2
+    ).start(warmup_inputs=[example_x])
+    out, = server.infer([x_row], deadline_ms=100)
+    print(server.stats().as_dict())
+    server.stop()
+"""
+
+from .batcher import (  # noqa: F401
+    DeadlineExceededError,
+    MicroBatcher,
+    ServerOverloadedError,
+    ServingError,
+)
+from .buckets import BatchPlan, BucketLadder  # noqa: F401
+from .metrics import ServingStats, snapshot_stats  # noqa: F401
+from .pool import PredictorPool  # noqa: F401
+from .server import InferenceServer  # noqa: F401
+
+__all__ = [
+    "InferenceServer",
+    "MicroBatcher",
+    "PredictorPool",
+    "BucketLadder",
+    "BatchPlan",
+    "ServingStats",
+    "snapshot_stats",
+    "ServingError",
+    "ServerOverloadedError",
+    "DeadlineExceededError",
+]

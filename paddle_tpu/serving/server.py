@@ -1,0 +1,234 @@
+"""InferenceServer — the dynamic-batching serving runtime.
+
+Ties the subsystem together on top of AnalysisPredictor:
+
+  client threads --submit--> [admission queue | MicroBatcher]
+        --coalesced batch--> BucketLadder.pad_feeds (round to bucket)
+        --padded batch-----> PredictorPool predictor.run (compiled plan)
+        --outputs----------> unpad_outputs --split--> per-request results
+
+Defaults come from the serving_* flags (fluid/flags.py) so deployments
+tune the policy via FLAGS_ env vars without code changes. ``start()``
+eagerly warms every bucket-ladder shape through the pool's shared
+compiled plans, so steady-state traffic never sees an XLA compile on the
+request path; ``stats()`` returns the ServingStats snapshot.
+
+This is the in-process runtime (the piece worth building on TPU); a
+transport (HTTP/gRPC) would sit in front of ``infer()`` unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..fluid import flags as _flags
+from ..fluid import profiler as _profiler
+from .batcher import (
+    DeadlineExceededError,
+    MicroBatcher,
+    ServerOverloadedError,
+    ServingError,
+)
+from .buckets import BucketLadder
+from .metrics import snapshot_stats
+from .pool import PredictorPool
+
+__all__ = ["InferenceServer"]
+
+
+def _flag(name, override):
+    return override if override is not None else _flags.get_flag(name)
+
+
+class InferenceServer(object):
+    """Dynamic-batching server over an AnalysisPredictor (or anything
+    with ``run(list_of_arrays) -> list_of_arrays`` and ``clone()``).
+
+    Parameters default to the serving_* flags; ``ladder`` overrides the
+    default power-of-two batch-bucket ladder (e.g. to add seq buckets).
+    """
+
+    def __init__(self, predictor, max_batch_size=None, batch_timeout_ms=None,
+                 queue_depth=None, num_workers=None, default_deadline_ms=None,
+                 ladder=None):
+        self.max_batch_size = int(_flag("serving_max_batch_size",
+                                        max_batch_size))
+        self.batch_timeout_ms = float(_flag("serving_batch_timeout_ms",
+                                            batch_timeout_ms))
+        self.queue_depth = int(_flag("serving_queue_depth", queue_depth))
+        self.num_workers = int(_flag("serving_workers", num_workers))
+        self.default_deadline_ms = float(_flag("serving_default_deadline_ms",
+                                               default_deadline_ms))
+        self.ladder = ladder or BucketLadder(max_batch=self.max_batch_size)
+        if self.ladder.max_batch < self.max_batch_size:
+            raise ValueError(
+                "bucket ladder tops out at %d rows but max_batch_size is %d"
+                % (self.ladder.max_batch, self.max_batch_size)
+            )
+        self._predictor = predictor
+        self._pool = None
+        self._batcher = None
+        self._warm_sigs = set()
+        self._warm_lock = threading.Lock()
+        self._baseline = {}
+        self._lat_base = 0
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, warmup_inputs=None):
+        """Build the pool and dispatch workers. ``warmup_inputs`` (one
+        example request: list of arrays, axis 0 = rows) eagerly compiles
+        every bucket-ladder shape BEFORE traffic arrives, so no
+        steady-state request ever waits on XLA."""
+        if self._started:
+            raise RuntimeError("server already started")
+        self._pool = PredictorPool(self._predictor, size=self.num_workers)
+        if warmup_inputs is not None:
+            self.warmup(warmup_inputs)
+        # baseline AFTER warmup: stats() reports steady-state traffic only
+        self._baseline = _profiler.get_counters()
+        self._lat_base = len(_profiler.get_histogram("serving_latency_ms"))
+        self._batcher = MicroBatcher(
+            self._run_batch,
+            max_batch_size=self.max_batch_size,
+            batch_timeout_ms=self.batch_timeout_ms,
+            queue_depth=self.queue_depth,
+            num_workers=self.num_workers,
+            default_deadline_ms=self.default_deadline_ms,
+        )
+        self._started = True
+        return self
+
+    def warmup(self, example_inputs):
+        """Run every bucket shape once through the pool's shared plans.
+        Callable before start() traffic or any time the ladder grows; on
+        a live server a predictor is checked OUT of the pool PER SHAPE
+        (never raced with a dispatch worker's staging, and released
+        between compiles so live traffic interleaves instead of starving
+        through the whole ladder — a full-ladder hold on a size-1 pool
+        would stall every batch for minutes of TPU compile time)."""
+        example = [np.asarray(a) for a in example_inputs]
+        c_before = _profiler.get_counters()
+        for rows, seq in self.ladder.shapes():
+            feeds = []
+            for a in example:
+                one = a[:1] if a.ndim else a.reshape(1)
+                if (seq is not None and one.ndim > self.ladder.seq_axis
+                        and one.shape[self.ladder.seq_axis] > seq):
+                    idx = [slice(None)] * one.ndim
+                    idx[self.ladder.seq_axis] = slice(0, seq)
+                    one = one[tuple(idx)]
+                feeds.append(one)
+            plan = self.ladder.plan(feeds)
+            plan.padded_rows, plan.padded_seq = rows, seq
+            padded, _ = self.ladder.pad_feeds(feeds, plan)
+            self._record_bucket(padded, warm=True)
+            if self._pool is not None:
+                with self._pool.acquire() as pred:
+                    pred.run(padded)
+            else:
+                self._predictor.run(padded)
+        if self._started:
+            # post-start warmup (ladder growth on a live server): fold the
+            # warmup-attributable plan-cache activity into the baseline so
+            # stats() keeps reporting request-path compiles only ('zero
+            # miss delta == zero steady-state compiles')
+            c_after = _profiler.get_counters()
+            for k in ("predictor_plan_cache_misses",
+                      "predictor_plan_cache_hits"):
+                self._baseline[k] = self._baseline.get(k, 0) + (
+                    c_after.get(k, 0) - c_before.get(k, 0)
+                )
+
+    def stop(self):
+        if self._batcher is not None:
+            self._batcher.stop()
+        self._started = False
+
+    def __enter__(self):
+        return self if self._started else self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request path --------------------------------------------------------
+    def infer(self, inputs, deadline_ms=None, timeout=None):
+        """Blocking request: list of arrays (axis 0 = rows, usually 1).
+        Returns the per-request output list. Raises
+        ServerOverloadedError (shed at admission, carries retry_after_ms)
+        or DeadlineExceededError (shed at dispatch) — both retriable —
+        or ServingError for execution failures."""
+        return self.result(self.submit(inputs, deadline_ms=deadline_ms),
+                           timeout=timeout)
+
+    def submit(self, inputs, deadline_ms=None):
+        """Non-blocking admission; pair with ``result()``."""
+        if not self._started:
+            raise ServingError("server not started")
+        aligned, seq_plan = self._seq_align(inputs)
+        req = self._batcher.submit(aligned, deadline_ms=deadline_ms)
+        req.seq_plan = seq_plan
+        return req
+
+    def result(self, req, timeout=None):
+        outs = self._batcher.result(req, timeout=timeout)
+        if req.seq_plan is not None:
+            # strip the admission-time seq padding (row axis untouched:
+            # seq_plan carries padded_rows == rows)
+            outs = self.ladder.unpad_outputs(outs, req.seq_plan)
+        return outs
+
+    def _seq_align(self, inputs):
+        """(aligned_inputs, request_plan|None). With seq buckets enabled
+        each request's seq axis pads to its bucket AT ADMISSION, so
+        bucket-equivalent requests share one coalescing signature — on
+        raw lengths, mixed-seq traffic would never coalesce (every
+        request a distinct sig) and fill would collapse to 1/max_batch
+        for exactly the traffic seq buckets exist for. Rows stay
+        untouched; the batch-level row pad happens per coalesced batch."""
+        if self.ladder.seq_buckets is None:
+            return inputs, None
+        feeds = [np.asarray(a) for a in inputs]
+        plan = self.ladder.plan(feeds)
+        if plan.padded_seq is None:
+            return feeds, None
+        plan.padded_rows = plan.rows  # seq-only pad at admission
+        padded, plan = self.ladder.pad_feeds(feeds, plan)
+        return padded, plan
+
+    # -- internals -----------------------------------------------------------
+    def _record_bucket(self, padded_feeds, warm=False):
+        sig = tuple((a.shape, a.dtype.str) for a in padded_feeds)
+        with self._warm_lock:  # dispatch workers record concurrently
+            hit = sig in self._warm_sigs
+            if not hit:
+                self._warm_sigs.add(sig)
+        if not warm:
+            _profiler.bump_counter(
+                "serving_bucket_hits" if hit else "serving_bucket_misses"
+            )
+
+    def _run_batch(self, stacked, rows):
+        padded, plan = self.ladder.pad_feeds(stacked)
+        _profiler.bump_counter("serving_pad_rows",
+                               plan.padded_rows - plan.rows)
+        self._record_bucket(padded)
+        # blocking acquire: when warmup (or a slow batch) holds the pool,
+        # batches WAIT rather than failing their clients; per-request
+        # deadlines bound the caller-visible latency
+        with self._pool.acquire() as pred:
+            outs = pred.run(padded)
+        return self.ladder.unpad_outputs(outs, plan)
+
+    def stats(self):
+        """ServingStats snapshot (deltas since start; latency percentiles
+        over the histogram window)."""
+        return snapshot_stats(
+            baseline=self._baseline,
+            queue_depth=self._batcher.queue_len if self._batcher else 0,
+            max_batch_size=self.max_batch_size,
+            latency_baseline_count=self._lat_base,
+        )

@@ -97,6 +97,34 @@ def test_banked_bert_line_prefers_seq384(bench_mod):
     assert line["vs_baseline"] == round(30.0 / 12.7, 3)
 
 
+def test_bank_best_never_promotes_serving_entry(bench_mod):
+    """The BENCH_SERVING=1 rung banks requests/sec through the
+    dynamic-batching runtime — a different convention from the headline
+    tokens/sec metric. A generic prefix match must never promote it
+    (same guard as the hostfeed rung); an explicit 'serving' prefix
+    retrieves it."""
+    b = bench_mod
+    b.bank_write(
+        "gpt_serving",
+        {"metric": "gpt2_serving_throughput", "value": 99999.0,
+         "unit": "requests/sec/chip", "batch": 8, "seq_len": 128,
+         "device": "tpu", "serving": True, "offline_rps": 120000.0,
+         "p99_ms": 12.0, "batch_fill": 0.97, "bucket_hit_rate": 1.0},
+    )
+    b.bank_write(
+        "gpt_seq1024",
+        {"metric": "gpt2_small_lm_throughput", "value": 100.0,
+         "unit": "tokens/sec/chip", "batch": 16, "seq_len": 1024,
+         "device": "tpu"},
+    )
+    slot, e = b.bank_best("gpt")
+    assert slot == "gpt_seq1024" and not e.get("serving")
+    slot, e = b.bank_best("gpt_serving")
+    assert e["serving"] is True and e["value"] == 99999.0
+    # serving facts survive the bank round-trip for provenance
+    assert e["p99_ms"] == 12.0 and e["bucket_hit_rate"] == 1.0
+
+
 def test_degraded_cpu_line_has_null_vs_baseline(bench_mod):
     b = bench_mod
     line = b._resnet_line({"ips": 0.7, "device": "cpu"}, 8, ["tpu: killed"], True)

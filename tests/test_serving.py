@@ -1,0 +1,521 @@
+"""Serving runtime (paddle_tpu/serving): coalescer timeout/deadline/shed
+paths, bucket rounding + unpadding round-trip, predictor-pool plan
+sharing, the profiler histogram/counter snapshot contract, and the
+closed-loop load probe (ISSUE 2 acceptance: dynamic batching >= 2x serial
+predictor.run at 8 clients, bucket hit rate 100% with zero recompiles
+after warmup, deadline-exceeded requests shed with a distinct error).
+
+No sockets anywhere: the runtime is in-process; a transport would sit in
+front of InferenceServer.infer unchanged.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import inference, serving
+from paddle_tpu.fluid import profiler
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_rounding():
+    lad = serving.BucketLadder(max_batch=8)
+    assert lad.batch_buckets == [1, 2, 4, 8]
+    assert [lad.batch_bucket(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        lad.batch_bucket(9)
+    # non-power-of-two max still tops the ladder
+    lad12 = serving.BucketLadder(max_batch=12)
+    assert lad12.batch_buckets[-1] == 12 and lad12.batch_bucket(9) == 12
+
+
+def test_bucket_pad_unpad_roundtrip():
+    lad = serving.BucketLadder(
+        max_batch=8, seq_buckets=[4, 8], seq_pad_value=99
+    )
+    ids = np.arange(3 * 3, dtype=np.int64).reshape(3, 3)
+    mask = np.ones((3, 3), dtype=np.float32)
+    padded, plan = lad.pad_feeds([ids, mask])
+    assert plan.rows == 3 and plan.padded_rows == 4
+    assert plan.seq == 3 and plan.padded_seq == 4
+    assert padded[0].shape == (4, 4) and padded[1].shape == (4, 4)
+    # seq padding: pad token id for ints, zeros for the float mask
+    assert (padded[0][:, 3] == 99).all()
+    assert (padded[1][:3, 3] == 0.0).all()
+    # row padding replicates the last valid row (numerically inert)
+    np.testing.assert_array_equal(padded[0][3, :3], ids[2])
+    # outputs at the padded shape strip back to (rows, seq)
+    out = np.arange(4 * 4 * 2, dtype=np.float32).reshape(4, 4, 2)
+    (stripped,) = lad.unpad_outputs([out], plan)
+    assert stripped.shape == (3, 3, 2)
+    np.testing.assert_array_equal(stripped, out[:3, :3])
+    # non-batch-major outputs (scalars) pass through
+    (scalar,) = lad.unpad_outputs([np.float32(7.0)], plan)
+    assert scalar == np.float32(7.0)
+
+
+def test_bucket_warmup_shape_set():
+    lad = serving.BucketLadder(max_batch=4, seq_buckets=[16, 32])
+    assert lad.shapes() == [
+        (1, 16), (1, 32), (2, 16), (2, 32), (4, 16), (4, 32)
+    ]
+    assert serving.BucketLadder(max_batch=4).shapes() == [
+        (1, None), (2, None), (4, None)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# micro-batch coalescer
+# ---------------------------------------------------------------------------
+
+
+class _RecordingRunner(object):
+    def __init__(self, delay_s=0.0):
+        self.calls = []
+        self.delay_s = delay_s
+        self.release = None  # optional Event to block on
+
+    def __call__(self, feeds, rows):
+        self.calls.append((rows, [tuple(a.shape) for a in feeds]))
+        if self.release is not None:
+            assert self.release.wait(5.0), "runner never released"
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [feeds[0] * 2.0]
+
+
+def test_coalescer_timeout_path_dispatches_partial_batch():
+    r = _RecordingRunner()
+    mb = serving.MicroBatcher(r, max_batch_size=8, batch_timeout_ms=30,
+                              queue_depth=8, num_workers=1)
+    try:
+        x = np.ones((1, 4), np.float32)
+        t0 = time.monotonic()
+        out = mb.result(mb.submit([x]), timeout=5.0)
+        waited = time.monotonic() - t0
+        np.testing.assert_array_equal(out[0], x * 2.0)
+        # held for ~batch_timeout waiting for peers, then dispatched alone
+        assert waited >= 0.02, waited
+        assert r.calls == [(1, [(1, 4)])]
+    finally:
+        mb.stop()
+
+
+def test_coalescer_full_batch_cuts_before_timeout():
+    r = _RecordingRunner()
+    mb = serving.MicroBatcher(r, max_batch_size=4, batch_timeout_ms=500,
+                              queue_depth=16, num_workers=1)
+    try:
+        x = np.ones((1, 4), np.float32)
+        reqs, outs = [], []
+        t0 = time.monotonic()
+        barrier = threading.Barrier(4)
+
+        def client():
+            barrier.wait()
+            req = mb.submit([x])
+            outs.append(mb.result(req, timeout=5.0))
+
+        ts = [threading.Thread(target=client) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        elapsed = time.monotonic() - t0
+        assert len(outs) == 4
+        # a full batch cuts the gather EARLY — nowhere near the 500ms
+        # timeout — and the 4 requests ran as few coalesced batches
+        assert elapsed < 0.45, elapsed
+        assert sum(rows for rows, _ in r.calls) == 4
+        assert len(r.calls) <= 2, r.calls
+    finally:
+        mb.stop()
+
+
+def test_admission_queue_full_sheds_with_retry_after():
+    r = _RecordingRunner()
+    r.release = threading.Event()
+    mb = serving.MicroBatcher(r, max_batch_size=1, batch_timeout_ms=1,
+                              queue_depth=2, num_workers=1)
+    try:
+        x = np.ones((1, 2), np.float32)
+        c0 = profiler.get_counters()
+        r1 = mb.submit([x])  # claimed by the worker, blocked in the runner
+        deadline = time.monotonic() + 2.0
+        while not r.calls and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert r.calls, "worker never picked up the first request"
+        r2 = mb.submit([x])  # queued
+        r3 = mb.submit([x])  # queued (depth limit)
+        with pytest.raises(serving.ServerOverloadedError) as ei:
+            mb.submit([x])
+        assert ei.value.retry_after_ms >= 1
+        shed = profiler.get_counters().get("serving_shed_overload", 0) - \
+            c0.get("serving_shed_overload", 0)
+        assert shed == 1
+        r.release.set()
+        for req in (r1, r2, r3):
+            np.testing.assert_array_equal(
+                mb.result(req, timeout=5.0)[0], x * 2.0
+            )
+    finally:
+        r.release.set()
+        mb.stop()
+
+
+def test_deadline_exceeded_sheds_distinct_error_without_stalling():
+    r = _RecordingRunner()
+    r.release = threading.Event()
+    mb = serving.MicroBatcher(r, max_batch_size=1, batch_timeout_ms=1,
+                              queue_depth=8, num_workers=1)
+    try:
+        x = np.ones((1, 2), np.float32)
+        c0 = profiler.get_counters()
+        slow = mb.submit([x])  # occupies the single worker
+        deadline = time.monotonic() + 2.0
+        while not r.calls and time.monotonic() < deadline:
+            time.sleep(0.002)
+        doomed = mb.submit([x], deadline_ms=10)   # expires while queued
+        healthy = mb.submit([x])                  # behind it, no deadline
+        time.sleep(0.05)  # let the deadline lapse while the runner blocks
+        r.release.set()
+        np.testing.assert_array_equal(
+            mb.result(slow, timeout=5.0)[0], x * 2.0
+        )
+        # the doomed request is shed with the DISTINCT retriable error...
+        with pytest.raises(serving.DeadlineExceededError):
+            mb.result(doomed, timeout=5.0)
+        # ...and the queue was not stalled: the request behind it completes
+        np.testing.assert_array_equal(
+            mb.result(healthy, timeout=5.0)[0], x * 2.0
+        )
+        shed = profiler.get_counters().get("serving_shed_deadline", 0) - \
+            c0.get("serving_shed_deadline", 0)
+        assert shed == 1
+    finally:
+        r.release.set()
+        mb.stop()
+
+
+def test_idle_server_serves_deadline_shorter_than_gather_window():
+    """A tight-deadline request on an IDLE server must be served — the
+    gather window cuts at the request's deadline (minus dispatch margin)
+    instead of holding it through the full batch timeout and shedding."""
+    r = _RecordingRunner()
+    mb = serving.MicroBatcher(r, max_batch_size=8, batch_timeout_ms=200,
+                              queue_depth=8, num_workers=1)
+    try:
+        x = np.ones((1, 4), np.float32)
+        t0 = time.monotonic()
+        out = mb.result(mb.submit([x], deadline_ms=60), timeout=5.0)
+        elapsed = time.monotonic() - t0
+        np.testing.assert_array_equal(out[0], x * 2.0)
+        assert elapsed < 0.19, elapsed  # cut well before the 200ms window
+    finally:
+        mb.stop()
+
+
+def test_incompatible_shapes_never_coalesce():
+    r = _RecordingRunner()
+    mb = serving.MicroBatcher(r, max_batch_size=8, batch_timeout_ms=100,
+                              queue_depth=16, num_workers=1)
+    try:
+        a = mb.submit([np.ones((1, 4), np.float32)])
+        b = mb.submit([np.ones((1, 6), np.float32)])
+        mb.result(a, timeout=5.0)
+        mb.result(b, timeout=5.0)
+        shapes = [feeds for _, feeds in r.calls]
+        assert [(1, 4)] in shapes and [(1, 6)] in shapes
+        assert len(r.calls) == 2, r.calls
+    finally:
+        mb.stop()
+
+
+def test_multi_row_requests_and_row_split():
+    r = _RecordingRunner()
+    mb = serving.MicroBatcher(r, max_batch_size=8, batch_timeout_ms=50,
+                              queue_depth=16, num_workers=1)
+    try:
+        a = np.arange(2 * 3, dtype=np.float32).reshape(2, 3)
+        b = np.arange(100, 100 + 3 * 3, dtype=np.float32).reshape(3, 3)
+        ra, rb = mb.submit([a]), mb.submit([b])
+        np.testing.assert_array_equal(mb.result(ra, 5.0)[0], a * 2.0)
+        np.testing.assert_array_equal(mb.result(rb, 5.0)[0], b * 2.0)
+        with pytest.raises(ValueError):
+            mb.submit([np.ones((9, 3), np.float32)])  # rows > max_batch
+        with pytest.raises(ValueError):
+            mb.submit([np.ones((0, 3), np.float32)])  # empty request
+        with pytest.raises(ValueError):
+            mb.submit([np.float32(1.0)])  # no row axis
+    finally:
+        mb.stop()
+
+
+def test_stop_completes_pending_requests():
+    r = _RecordingRunner()
+    r.release = threading.Event()
+    mb = serving.MicroBatcher(r, max_batch_size=1, batch_timeout_ms=1,
+                              queue_depth=8, num_workers=1)
+    x = np.ones((1, 2), np.float32)
+    inflight = mb.submit([x])
+    deadline = time.monotonic() + 2.0
+    while not r.calls and time.monotonic() < deadline:
+        time.sleep(0.002)
+    queued = mb.submit([x])
+    r.release.set()
+    mb.stop()
+    mb.result(inflight, timeout=5.0)  # ran before/during stop
+    with pytest.raises(serving.ServingError):
+        mb.result(queued, timeout=5.0)
+    with pytest.raises(serving.ServingError):
+        mb.submit([x])  # stopped batcher admits nothing
+
+
+# ---------------------------------------------------------------------------
+# predictor pool / plan sharing / plan cache
+# ---------------------------------------------------------------------------
+
+
+def _save_tiny_model(dirname, dim=8, classes=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+        out = fluid.layers.softmax(fluid.layers.fc(x, size=classes))
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.io.save_inference_model(
+            dirname, ["x"], [out], exe, main_program=main
+        )
+
+
+def test_predictor_pool_shares_compiled_plans():
+    with tempfile.TemporaryDirectory() as d:
+        _save_tiny_model(d)
+        pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+        pool = serving.PredictorPool(pred, size=3)
+        assert pool.size == 3
+        members = pool._all
+        assert all(
+            m._plan_holder is pred._plan_holder for m in members
+        )
+        x = np.random.RandomState(0).rand(2, 8).astype("float32")
+        ref = pred.run([x])[0]
+        # the primary's compile published the block to the holder; every
+        # member resolves the SAME compiled object (one compile per pool)
+        compiled = pred._plan_holder.compiled
+        assert compiled is not None
+        for m in members[1:]:
+            np.testing.assert_allclose(m.run([x])[0], ref, rtol=1e-6)
+            assert m._compiled is compiled
+        # isolation opt-out still exists
+        iso = pred.clone(share_plans=False)
+        assert iso._plan_holder is not pred._plan_holder
+
+
+def test_predictor_plan_cache_counters():
+    with tempfile.TemporaryDirectory() as d:
+        _save_tiny_model(d)
+        pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+        x = np.random.RandomState(1).rand(4, 8).astype("float32")
+        c0 = profiler.get_counters()
+        pred.run([x])
+        pred.run([x])
+        pred.run([x[:2]])  # new shape -> miss
+        clone = pred.clone()
+        clone.run([x])     # clone shares the holder -> HIT, not miss
+        c1 = profiler.get_counters()
+        assert c1.get("predictor_plan_cache_misses", 0) - \
+            c0.get("predictor_plan_cache_misses", 0) == 2
+        assert c1.get("predictor_plan_cache_hits", 0) - \
+            c0.get("predictor_plan_cache_hits", 0) == 2
+        # a FAILED run must not record its signature: retries at the bad
+        # shape stay misses (miss count tracks compile attempts)
+        bad = np.random.RandomState(2).rand(4, 7).astype("float32")
+        for _ in range(2):
+            with pytest.raises(Exception):
+                pred.run([bad])
+        c2 = profiler.get_counters()
+        assert c2.get("predictor_plan_cache_misses", 0) - \
+            c1.get("predictor_plan_cache_misses", 0) == 2
+        assert c2.get("predictor_plan_cache_hits", 0) - \
+            c1.get("predictor_plan_cache_hits", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# profiler snapshot contract + histograms
+# ---------------------------------------------------------------------------
+
+
+def test_counters_and_histograms_snapshots_are_copies():
+    profiler.bump_counter("snap_test", 2)
+    snap = profiler.get_counters()
+    snap["snap_test"] = 999999  # caller mutation must not reach the source
+    assert profiler.get_counters()["snap_test"] == 2
+    profiler.bump_histogram("snap_hist", 1.5)
+    h = profiler.get_histograms()
+    assert h["snap_hist"] == [1.5]
+    h["snap_hist"].append(42.0)
+    assert profiler.get_histograms()["snap_hist"] == [1.5]
+
+
+def test_histogram_window_bounded():
+    from paddle_tpu.fluid import profiler as p
+
+    for i in range(p._HISTOGRAM_WINDOW + 10):
+        p.bump_histogram("bounded_hist", float(i))
+    samples = p.get_histograms()["bounded_hist"]
+    assert len(samples) == p._HISTOGRAM_WINDOW
+    assert samples[0] == 10.0  # oldest dropped, newest kept
+
+
+# ---------------------------------------------------------------------------
+# AnalysisConfig no-op migration warnings
+# ---------------------------------------------------------------------------
+
+
+def test_config_engine_noops_warn_once_with_tpu_equivalent():
+    inference._warned_tpu_noop.clear()
+    cfg = inference.AnalysisConfig("/nonexistent")
+    with pytest.warns(UserWarning, match="bucketed AOT plans"):
+        cfg.enable_tensorrt_engine(workspace_size=1 << 20)
+    with pytest.warns(UserWarning, match="enable_mkldnn"):
+        cfg.enable_mkldnn()
+    # one-time: a second config in the same process stays silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cfg2 = inference.AnalysisConfig("/nonexistent")
+        cfg2.enable_tensorrt_engine()
+        cfg2.enable_mkldnn()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: closed-loop load probe (ISSUE 2 acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_load_probe_acceptance():
+    """Dynamic batching >= 2x serial predictor.run at 8 concurrent
+    clients, batch-fill >= 0.5, bucket-plan hit rate 100%, and ZERO
+    recompiles after warmup — the fast subset of
+    tools/serving_load_probe.py run in-process."""
+    import serving_load_probe as probe
+
+    result = probe.run_probe(
+        clients=8, requests_per_client=15, serial_requests=30, rounds=2
+    )
+    assert result["speedup"] >= 2.0, result
+    assert result["batch_fill_ratio"] >= 0.5, result
+    assert result["bucket_hit_rate"] == 1.0, result
+    assert result["recompiles_after_warmup"] == 0, result
+
+
+class _EchoPredictor(object):
+    """run() echoes feed 0 doubled; shape-stable for ladder tests."""
+
+    def run(self, feeds):
+        return [np.asarray(feeds[0]) * 2.0]
+
+    def clone(self, share_plans=True):
+        return self
+
+
+def test_mixed_seq_lengths_coalesce_via_admission_alignment():
+    """With seq buckets, requests of DIFFERENT raw lengths that round to
+    the same bucket must coalesce into one batch (seq pads at admission,
+    so their signatures match), and each caller gets its own length
+    back."""
+    lad = serving.BucketLadder(max_batch=8, seq_buckets=[8],
+                               seq_pad_value=0)
+    server = serving.InferenceServer(
+        _EchoPredictor(), max_batch_size=8, batch_timeout_ms=100,
+        queue_depth=16, num_workers=1, ladder=lad,
+    ).start(warmup_inputs=[np.ones((1, 5), np.float32)])
+    try:
+        inputs = [np.full((1, s), float(s), np.float32)
+                  for s in (5, 6, 7, 8)]
+        reqs = [server.submit([a], deadline_ms=10000) for a in inputs]
+        outs = [server.result(r, timeout=5.0) for r in reqs]
+        for a, (o,) in zip(inputs, outs):
+            assert o.shape == a.shape, (o.shape, a.shape)
+            np.testing.assert_array_equal(o, a * 2.0)
+        st = server.stats()
+        # one coalesced batch (two at most if the worker won the race to
+        # the first request), NOT four single-row dispatches
+        assert st.batches <= 2, st.as_dict()
+        assert st.batched_rows == 4
+    finally:
+        server.stop()
+
+
+def test_second_server_latency_stats_isolated():
+    """A later server's percentiles must not inherit an earlier server's
+    histogram samples (stats are deltas since start)."""
+    with tempfile.TemporaryDirectory() as d:
+        _save_tiny_model(d)
+        x = np.random.RandomState(3).rand(1, 8).astype("float32")
+
+        def serve_n(n):
+            pred = inference.create_paddle_predictor(
+                inference.AnalysisConfig(d)
+            )
+            server = serving.InferenceServer(
+                pred, max_batch_size=2, batch_timeout_ms=1, queue_depth=8,
+                num_workers=1,
+            ).start(warmup_inputs=[x])
+            try:
+                for _ in range(n):
+                    server.infer([x], deadline_ms=5000)
+                return server.stats()
+            finally:
+                server.stop()
+
+        assert serve_n(5).latency_ms["count"] == 5
+        st2 = serve_n(2)  # second server in the same process
+        assert st2.latency_ms["count"] == 2, st2.as_dict()
+
+
+def test_server_deadline_shed_and_stats_surface():
+    """Through the full InferenceServer: an already-expired request is
+    shed with DeadlineExceededError (not executed, not stalling), and the
+    ServingStats snapshot reports it alongside the latency percentiles."""
+    with tempfile.TemporaryDirectory() as d:
+        _save_tiny_model(d)
+        pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+        x = np.random.RandomState(2).rand(1, 8).astype("float32")
+        server = serving.InferenceServer(
+            pred, max_batch_size=4, batch_timeout_ms=20, queue_depth=8,
+            num_workers=1,
+        ).start(warmup_inputs=[x])
+        try:
+            with pytest.raises(serving.DeadlineExceededError):
+                # sub-ms deadline expires during the coalescer's gather
+                # window — shed at dispatch, never executed
+                server.infer([x], deadline_ms=0.01)
+            (out,) = server.infer([x], deadline_ms=5000)  # queue healthy
+            assert out.shape == (1, 3)
+            st = server.stats()
+            assert st.shed_deadline == 1
+            assert st.completed >= 1
+            # latency percentiles cover SERVED requests only — the shed
+            # request contributes no sample
+            assert st.latency_ms["count"] == st.completed
+            assert st.latency_ms["p99"] is not None
+            assert st.bucket_hit_rate == 1.0
+        finally:
+            server.stop()

@@ -1,0 +1,180 @@
+"""CPU-runnable closed-loop load probe for the serving runtime.
+
+Drives an InferenceServer at N concurrent closed-loop clients (each
+submits, waits for its result, immediately resubmits) against the serial
+baseline — the same requests one predictor.run() call at a time, which
+is exactly what every caller did before paddle_tpu.serving existed. The
+probe asserts the serving acceptance bars:
+
+- dynamic batching >= 2x the serial requests/sec at 8 clients (the
+  coalescer amortizes per-call dispatch overhead across the batch and
+  the device sees batch-parallel work);
+- bucket-plan hit rate == 100% after warmup AND zero predictor
+  plan-cache misses (zero steady-state XLA compiles: every padded shape
+  was eagerly compiled at server start);
+- batch-fill ratio >= 0.5 (the coalescer actually coalesces).
+
+Run directly (prints one JSON line)::
+
+    JAX_PLATFORMS=cpu python tools/serving_load_probe.py
+
+or via tests/test_serving.py, which runs a fast subset as a tier-1
+regression guard.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(dirname, dim=64, hidden=128, classes=8, seed=0):
+    """Init (no training needed) and save a small classifier inference
+    model; returns an example single-row input."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+        h = fluid.layers.fc(x, size=hidden, act="relu", name="probe_fc1")
+        h = fluid.layers.fc(h, size=hidden, act="relu", name="probe_fc2")
+        out = fluid.layers.softmax(
+            fluid.layers.fc(h, size=classes, name="probe_cls")
+        )
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.io.save_inference_model(
+            dirname, ["x"], [out], exe, main_program=main
+        )
+    return np.random.RandomState(seed).rand(1, dim).astype("float32")
+
+
+def run_probe(clients=8, requests_per_client=25, serial_requests=40,
+              max_batch=8, batch_timeout_ms=8.0, workers=1, rounds=3,
+              verbose=False):
+    """Returns a dict of measurements; callers assert on the numbers.
+
+    Shared/loaded hosts drift between back-to-back runs (same finding as
+    tools/feed_overlap_probe.py), so the serial and dynamic loops are
+    measured in INTERLEAVED rounds and compared by per-mode BEST rps —
+    load only ever subtracts throughput, so the max is the undisturbed
+    figure. Correctness is verified once per client outside the timed
+    windows: numpy assert machinery inside the loop would serialize the
+    closed-loop clients on the GIL and measure the assert, not the
+    server. One dispatch worker (the default here) lets all N clients
+    coalesce into ONE full device batch per cadence — the configuration
+    the >= 2x bar is about; more workers trade fill for lower latency."""
+    import numpy as np
+
+    from paddle_tpu import inference, serving
+    from paddle_tpu.fluid import profiler
+
+    with tempfile.TemporaryDirectory() as d:
+        xd = build_model(d)
+
+        serial_pred = inference.create_paddle_predictor(
+            inference.AnalysisConfig(d)
+        )
+        expect = serial_pred.run([xd])[0]  # warm (compiles batch-1 plan)
+
+        server_pred = inference.create_paddle_predictor(
+            inference.AnalysisConfig(d)
+        )
+        server = serving.InferenceServer(
+            server_pred, max_batch_size=max_batch,
+            batch_timeout_ms=batch_timeout_ms, queue_depth=4 * clients,
+            num_workers=workers,
+        ).start(warmup_inputs=[xd])
+        # correctness once, outside any timed window
+        np.testing.assert_allclose(
+            server.infer([xd], deadline_ms=30000)[0], expect,
+            rtol=1e-4, atol=1e-5,
+        )
+        c_after_warm = profiler.get_counters()
+
+        errors = []
+
+        def client_loop():
+            out = None
+            try:
+                for _ in range(requests_per_client):
+                    (out,) = server.infer([xd], deadline_ms=30000)
+            except Exception as e:  # noqa: BLE001 - surfaced via errors
+                errors.append(e)
+                return
+            if not np.allclose(out, expect, rtol=1e-4, atol=1e-5):
+                errors.append(AssertionError("served output diverged"))
+
+        def dynamic_round():
+            threads = [
+                threading.Thread(target=client_loop) for _ in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return clients * requests_per_client / (time.perf_counter() - t0)
+
+        def serial_round():
+            t0 = time.perf_counter()
+            for _ in range(serial_requests):
+                serial_pred.run([xd])
+            return serial_requests / (time.perf_counter() - t0)
+
+        serial_rps = dynamic_rps = 0.0
+        for _ in range(rounds):
+            serial_rps = max(serial_rps, serial_round())
+            dynamic_rps = max(dynamic_rps, dynamic_round())
+        stats = server.stats()
+        server.stop()
+        if errors:
+            raise AssertionError("client errors: %r" % errors[:3])
+
+        c_end = profiler.get_counters()
+        recompiles = c_end.get("predictor_plan_cache_misses", 0) - \
+            c_after_warm.get("predictor_plan_cache_misses", 0)
+        result = {
+            "clients": clients,
+            "requests": rounds * clients * requests_per_client,
+            "rounds": rounds,
+            "serial_rps": round(serial_rps, 1),
+            "dynamic_rps": round(dynamic_rps, 1),
+            "speedup": round(dynamic_rps / serial_rps, 3),
+            "batch_fill_ratio": stats.batch_fill_ratio,
+            "bucket_hit_rate": stats.bucket_hit_rate,
+            "recompiles_after_warmup": int(recompiles),
+            "shed_deadline": stats.shed_deadline,
+            "shed_overload": stats.shed_overload,
+            "p50_ms": stats.latency_ms["p50"],
+            "p99_ms": stats.latency_ms["p99"],
+        }
+        if verbose:
+            print(json.dumps(result, indent=1), file=sys.stderr)
+        return result
+
+
+def main():
+    result = run_probe(verbose=False)
+    ok = (
+        result["speedup"] >= 2.0
+        and result["batch_fill_ratio"] >= 0.5
+        and result["bucket_hit_rate"] == 1.0
+        and result["recompiles_after_warmup"] == 0
+    )
+    result["pass"] = bool(ok)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
