@@ -16,6 +16,7 @@ from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import compat  # noqa: F401
 from .reader.decorator import batch  # noqa: F401  (paddle.batch)
 

@@ -1,0 +1,45 @@
+"""paddle_tpu.checkpoint — fault-tolerant async checkpointing + resume.
+
+The L7.5 persistence subsystem: Orbax-style step-tagged checkpoint
+directories with a two-phase atomic commit (stage under ``tmp.step_<N>/``,
+fsync, ``os.replace`` to ``step_<N>/`` — a torn directory is never
+discoverable), a background writer thread that keeps serialization and
+disk I/O off the step critical path, per-rank sharded save/restore for
+multi-process DP/TP, retention GC, and SIGTERM preemption handling with
+one final synchronous save.
+
+Quickstart::
+
+    from paddle_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager("ckpts", keep_max=3)
+    start = mgr.restore_or_initialize(main, exe, startup_program=startup)
+    for step in range(start + 1, num_steps):
+        exe.run(main, feed=batch(step), fetch_list=[loss])
+        if step % 50 == 0:
+            mgr.save(step, main)          # async: returns after snapshot
+    mgr.save(num_steps - 1, main, async_=False)
+    mgr.close()
+"""
+
+from .manager import (  # noqa: F401
+    CheckpointError,
+    CheckpointManager,
+    ChecksumError,
+    latest_step,
+    list_steps,
+)
+from .preempt import (  # noqa: F401
+    PreemptionHandler,
+    preemption_requested,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "CheckpointError",
+    "ChecksumError",
+    "PreemptionHandler",
+    "preemption_requested",
+    "latest_step",
+    "list_steps",
+]

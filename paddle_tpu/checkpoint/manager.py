@@ -1,0 +1,669 @@
+"""CheckpointManager — fault-tolerant async checkpointing (L7.5).
+
+The reference's persistence layer (fluid/io.py save/load) is synchronous
+and crash-oblivious: a SIGKILL mid-write leaves a torn pickle that still
+loads. On a preemptible TPU fleet a checkpoint must instead be (a)
+atomic — either fully committed or invisible, (b) off the step critical
+path — serialization and disk I/O on a background thread while the chip
+keeps stepping, and (c) resumable bit-exactly — params, optimizer
+accumulators, the step counter, AND the executor's per-scope RNG run
+index all round-trip.
+
+Commit protocol (Orbax-style two-phase):
+
+    1. snapshot  — device->host fetch of every persistable at save()
+                   time on the caller thread (cheap: one blocking copy),
+                   so the writer thread serializes an immutable snapshot
+                   while training mutates the live scope.
+    2. stage     — the writer serializes tensors in the reference's
+                   LoDTensor stream format into ``tmp.step_<N>/`` next
+                   to the final location, with a ``manifest.json``
+                   recording per-tensor shape/dtype/offset/crc32.
+    3. fsync     — data file, manifest, and the staging dir itself.
+    4. publish   — ``os.replace(tmp.step_<N>, step_<N>)`` + fsync of the
+                   parent dir. A rename is atomic on POSIX, so
+                   ``latest_step()`` (which requires ``step_*/
+                   manifest.json``) can never observe a torn state.
+
+Sharded saves (multi-process DP/TP) keep the same protocol with one
+twist: every rank stages ``tmp.step_<N>/shard_<rank>/`` independently
+(its own data + ``shard_manifest.json``, renamed into place inside the
+staging dir as the per-shard commit marker), and rank 0 alone performs
+the publish once all shard manifests exist — mirroring the sharded
+inference export's manifest conventions (inference SHARD_MANIFEST).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import shutil
+import threading
+import time
+import zlib
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+SHARD_MANIFEST = "shard_manifest.json"
+DATA_FILE = "state.pdckpt"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_PREFIX = "tmp.step_"
+_FORMAT = 1
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+class ChecksumError(CheckpointError):
+    """A committed tensor's bytes no longer match the manifest crc32."""
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_atomic(path, data):
+    """Write bytes to ``path`` via same-dir tmp + fsync + os.replace
+    (shared helper: ops/io_ops.py owns the one implementation)."""
+    from ..fluid.ops.io_ops import _atomic_write
+
+    _atomic_write(path, data)
+
+
+def _step_dirname(step):
+    return "step_%08d" % int(step)
+
+
+def _shard_dirname(rank):
+    return "shard_%05d" % int(rank)
+
+
+def list_steps(dirname):
+    """Committed steps (ascending). A step is committed iff its dir
+    matched ``step_<N>`` AND contains a manifest — a crashed writer's
+    ``tmp.step_*`` staging dir or a half-deleted GC victim is invisible."""
+    steps = []
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return steps
+    for name in entries:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        if os.path.isfile(os.path.join(dirname, name, MANIFEST)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(dirname):
+    steps = list_steps(dirname)
+    return steps[-1] if steps else None
+
+
+def _snapshot_value(value):
+    """Device->host fetch of one scope value at snapshot time. LoDTensors
+    keep their wrapper (the stream format carries the LoD); device arrays
+    become host ndarrays NOW so the writer thread never touches a buffer
+    the next step might donate."""
+    from ..fluid import core
+
+    if isinstance(value, core.LoDTensor):
+        return value
+    return np.asarray(value)
+
+
+class CheckpointManager(object):
+    """Step-tagged atomic checkpoints with an async background writer.
+
+    Args:
+        dirname: root directory; step dirs are created under it.
+        keep_max: newest K committed steps survive GC (None -> FLAGS_
+            ckpt_keep_max; 0 = unbounded).
+        keep_every_n_steps: steps divisible by N are additionally kept
+            forever (None -> FLAGS_ckpt_keep_every_n_steps; 0 = off).
+        async_depth: bounded writer-queue depth — at most this many
+            snapshots in flight; a full queue back-pressures save()
+            (None -> FLAGS_ckpt_async_depth).
+        rank / nranks: sharded mode when nranks > 1 — this process
+            writes ``shard_<rank>/`` and only rank 0 publishes.
+        dist_attrs: {var_name: axis} for vars whose LOCAL shard each
+            rank holds (TP); restore concatenates shards along ``axis``.
+            Vars not listed are treated as replicated and partitioned
+            round-robin across ranks for writing.
+    """
+
+    def __init__(self, dirname, keep_max=None, keep_every_n_steps=None,
+                 async_depth=None, rank=0, nranks=1, dist_attrs=None,
+                 commit_timeout_s=None):
+        from ..fluid import flags as _flags
+
+        self.dirname = str(dirname)
+        self.keep_max = int(
+            _flags.get_flag("ckpt_keep_max", 5) if keep_max is None
+            else keep_max
+        )
+        self.keep_every_n_steps = int(
+            _flags.get_flag("ckpt_keep_every_n_steps", 0)
+            if keep_every_n_steps is None else keep_every_n_steps
+        )
+        depth = int(
+            _flags.get_flag("ckpt_async_depth", 2)
+            if async_depth is None else async_depth
+        )
+        self.commit_timeout_s = float(
+            _flags.get_flag("ckpt_commit_timeout_s", 120.0)
+            if commit_timeout_s is None else commit_timeout_s
+        )
+        self.rank = int(rank)
+        self.nranks = int(nranks)
+        self.dist_attrs = dict(dist_attrs or {})
+        os.makedirs(self.dirname, exist_ok=True)
+        # resume-time hygiene: a crashed run's staging dirs are garbage.
+        # Only rank 0 sweeps (peers may be slower to start, but no save
+        # can be in flight before training begins, so this cannot race a
+        # live writer).
+        if self.rank == 0:
+            self._sweep_stale_tmp()
+        self._queue = queue.Queue(maxsize=max(depth, 1))
+        self._error = None
+        self._error_lock = threading.Lock()
+        self._closed = False
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="ckpt-writer", daemon=True
+        )
+        self._writer.start()
+
+    # -- public API ---------------------------------------------------------
+
+    def latest_step(self):
+        return latest_step(self.dirname)
+
+    def all_steps(self):
+        return list_steps(self.dirname)
+
+    def save(self, step, program=None, scope=None, async_=True):
+        """Snapshot persistables from ``scope`` and commit them as
+        ``step_<step>``. With ``async_`` the serialization + write + GC
+        happen on the writer thread (bounded queue; a full queue blocks
+        — back-pressure, never an unbounded host-memory pileup) and this
+        returns after the device->host snapshot; ``wait()`` barriers."""
+        from ..fluid import profiler as _profiler
+        from ..fluid.framework import default_main_program
+
+        self._raise_pending()
+        if self._closed:
+            raise CheckpointError("save() on a closed CheckpointManager")
+        program = program or default_main_program()
+        t0 = time.perf_counter()
+        snap = self._snapshot(program, scope)
+        _profiler.bump_histogram(
+            "ckpt_snapshot_ms", (time.perf_counter() - t0) * 1000.0
+        )
+        if async_:
+            self._queue.put((int(step), snap))
+        else:
+            # serialize with in-flight async saves FIRST: the staging dir
+            # name is deterministic per step (sharded peers must agree on
+            # it), so a sync save racing the writer on the same step
+            # would tear each other's tmp files; draining also keeps
+            # commits arriving in step order for retention
+            self._queue.join()
+            self._raise_pending()
+            self._write_checkpoint(int(step), snap)
+            self._raise_pending()
+        return self
+
+    def wait(self):
+        """Barrier: returns when every queued save has committed (or
+        re-raises the writer's failure)."""
+        self._queue.join()
+        self._raise_pending()
+        return self
+
+    def close(self):
+        """wait() then stop the writer thread. Idempotent."""
+        if self._closed:
+            return
+        try:
+            self._queue.join()
+        finally:
+            self._closed = True
+            self._queue.put(None)  # sentinel
+            self._writer.join(timeout=30)
+        self._raise_pending()
+
+    def restore(self, program=None, scope=None, step=None, executor=None):
+        """Load ``step`` (default: latest committed) into the scope,
+        verifying every tensor's crc32 against the manifest. Returns the
+        restored step. Raises CheckpointError when nothing is committed
+        and ChecksumError on corruption."""
+        from ..fluid import core
+        from ..fluid.framework import default_main_program
+
+        program = program or default_main_program()
+        scope = scope or core.global_scope()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise CheckpointError(
+                    "no committed checkpoint under %r" % self.dirname
+                )
+        step_dir = os.path.join(self.dirname, _step_dirname(step))
+        manifest_path = os.path.join(step_dir, MANIFEST)
+        if not os.path.isfile(manifest_path):
+            raise CheckpointError(
+                "step %d is not committed under %r" % (step, self.dirname)
+            )
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        state = {}
+        if manifest.get("nranks", 1) > 1:
+            for shard in manifest["shards"]:
+                self._read_shard(
+                    os.path.join(step_dir, shard["dir"]), state
+                )
+            state = self._reassemble(state)
+        else:
+            self._read_shard(step_dir, state)
+            state = {name: val for name, (val, _dist) in state.items()}
+        for name, val in state.items():
+            scope.set(name, val)
+        self._restore_rng(manifest, program, scope)
+        return int(manifest["step"])
+
+    def restore_or_initialize(self, program=None, executor=None,
+                              startup_program=None, scope=None):
+        """Resume path for trainers: restore the latest committed step
+        and return it, or run ``startup_program`` (when given) for a
+        fresh start and return -1."""
+        if self.latest_step() is not None:
+            return self.restore(program, scope=scope, executor=executor)
+        if startup_program is not None:
+            if executor is None:
+                raise CheckpointError(
+                    "restore_or_initialize needs an executor to run the "
+                    "startup program on a fresh start"
+                )
+            executor.run(startup_program, scope=scope)
+        return -1
+
+    def verify(self, step=None):
+        """Re-checksum a committed step without touching any scope (the
+        crash probe's torn-checkpoint detector). Returns the tensor
+        count; raises ChecksumError/CheckpointError on any damage."""
+        count = 0
+        for _name, _val in self._iter_step_tensors(step):
+            count += 1
+        return count
+
+    # -- snapshot -----------------------------------------------------------
+
+    def _snapshot(self, program, scope):
+        from ..fluid import core
+        from ..fluid.io import is_persistable
+
+        scope = scope or core.global_scope()
+        names = sorted(
+            v.name for v in program.list_vars() if is_persistable(v)
+        )
+        owned = self._owned_names(names)
+        tensors = []
+        for name in names:
+            if name not in owned:
+                continue
+            val = scope.get(name)
+            if val is None:
+                continue  # e.g. pruned/unused accumulator never ran
+            tensors.append((name, _snapshot_value(val)))
+        # executor RNG run index for this (program, scope): restoring it
+        # makes dropout masks replay identically across a resume, the
+        # last piece of bit-exact resume besides params + accumulators
+        rng_index = None
+        counters = program.__dict__.get("_rng_run_counters")
+        if counters is not None:
+            rng_index = counters.get(scope)
+        return {"tensors": tensors, "rng_run_index": rng_index}
+
+    def _owned_names(self, names):
+        """Which vars THIS rank writes. TP-sharded vars (dist_attrs) are
+        written by every rank (each holds a distinct shard); replicated
+        vars are partitioned round-robin so a big DP save spreads its
+        write bandwidth across hosts."""
+        if self.nranks <= 1:
+            return set(names)
+        owned = set()
+        i = 0
+        for name in names:  # names arrive sorted -> same partition on all ranks
+            if name in self.dist_attrs:
+                owned.add(name)
+            else:
+                if i % self.nranks == self.rank:
+                    owned.add(name)
+                i += 1
+        return owned
+
+    # -- writer -------------------------------------------------------------
+
+    def _writer_loop(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            step, snap = item
+            try:
+                self._write_checkpoint(step, snap)
+            except BaseException as e:  # surfaced via wait()/next save()
+                with self._error_lock:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _write_checkpoint(self, step, snap):
+        from ..fluid import profiler as _profiler
+
+        t0 = time.perf_counter()
+        final_dir = os.path.join(self.dirname, _step_dirname(step))
+        if os.path.isfile(os.path.join(final_dir, MANIFEST)):
+            return  # already committed (e.g. preempt save after interval save)
+        if os.path.isdir(final_dir):
+            # manifest-less husk (GC crashed between unlink and rmtree):
+            # invisible to list_steps, and it must not block a re-save
+            shutil.rmtree(final_dir, ignore_errors=True)
+        # the staging name is deterministic (no pid/uuid) because sharded
+        # peers must agree on it; a stale one from a crashed run was swept
+        # at init
+        tmp_dir = os.path.join(self.dirname, _TMP_PREFIX + "%d" % step)
+        shard_dir = (
+            os.path.join(tmp_dir, _shard_dirname(self.rank))
+            if self.nranks > 1 else tmp_dir
+        )
+        os.makedirs(shard_dir, exist_ok=True)
+        nbytes = self._write_shard(shard_dir, step, snap)
+        if self.nranks > 1:
+            _fsync_dir(tmp_dir)
+            if self.rank == 0:
+                shards = self._await_peer_shards(tmp_dir, step)
+                self._publish(tmp_dir, final_dir, step, snap, shards)
+            else:
+                self._await_publish(final_dir, step)
+        else:
+            self._publish(tmp_dir, final_dir, step, snap, shards=None)
+        _profiler.bump_histogram(
+            "ckpt_save_ms", (time.perf_counter() - t0) * 1000.0
+        )
+        _profiler.bump_histogram("ckpt_save_bytes", float(nbytes))
+        _profiler.bump_counter("ckpt_saves_committed")
+
+    def _write_shard(self, shard_dir, step, snap):
+        """Serialize the snapshot into ``shard_dir`` (reference LoDTensor
+        stream format, one concatenated file) + a shard manifest with
+        per-tensor shape/dtype/offset/crc32. The manifest lands via
+        same-dir rename so its presence IS the per-shard commit marker."""
+        from ..fluid.ops.io_ops import serialize_lod_tensor
+
+        data_path = os.path.join(shard_dir, DATA_FILE)
+        catalog = {}
+        offset = 0
+        with open(data_path, "wb") as f:
+            for name, val in snap["tensors"]:
+                blob = serialize_lod_tensor(val)
+                f.write(blob)
+                entry = {
+                    "shape": [int(d) for d in np.shape(
+                        val.numpy() if hasattr(val, "numpy") else val
+                    )],
+                    "dtype": str(
+                        (val.numpy() if hasattr(val, "numpy") else val).dtype
+                    ),
+                    "offset": offset,
+                    "nbytes": len(blob),
+                    "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                }
+                if name in self.dist_attrs:
+                    entry["dist"] = {
+                        "axis": int(self.dist_attrs[name]),
+                        "rank": self.rank,
+                        "nranks": self.nranks,
+                    }
+                catalog[name] = entry
+                offset += len(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        shard_manifest = {
+            "format": _FORMAT,
+            "step": int(step),
+            "rank": self.rank,
+            "nranks": self.nranks,
+            "data_file": DATA_FILE,
+            "tensors": catalog,
+        }
+        _write_atomic(
+            os.path.join(shard_dir, SHARD_MANIFEST),
+            json.dumps(shard_manifest, indent=1, sort_keys=True).encode(),
+        )
+        _fsync_dir(shard_dir)
+        return offset
+
+    def _publish(self, tmp_dir, final_dir, step, snap, shards):
+        manifest = {
+            "format": _FORMAT,
+            "step": int(step),
+            "nranks": self.nranks,
+            "rng_run_index": snap.get("rng_run_index"),
+        }
+        if shards is not None:
+            manifest["shards"] = [
+                {"rank": r, "dir": _shard_dirname(r)} for r in shards
+            ]
+        _write_atomic(
+            os.path.join(tmp_dir, MANIFEST),
+            json.dumps(manifest, indent=1, sort_keys=True).encode(),
+        )
+        _fsync_dir(tmp_dir)
+        try:
+            os.replace(tmp_dir, final_dir)  # THE commit point
+        except OSError:
+            if os.path.isfile(os.path.join(final_dir, MANIFEST)):
+                # lost a benign same-step race to another committer
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+            else:
+                raise
+        _fsync_dir(self.dirname)
+        self._gc()
+
+    def _await_peer_shards(self, tmp_dir, step):
+        deadline = time.monotonic() + self.commit_timeout_s
+        want = set(range(self.nranks))
+        while True:
+            have = {
+                r for r in want
+                if os.path.isfile(os.path.join(
+                    tmp_dir, _shard_dirname(r), SHARD_MANIFEST
+                ))
+            }
+            if have == want:
+                return sorted(want)
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    "step %d: shards %s missing after %.0fs"
+                    % (step, sorted(want - have), self.commit_timeout_s)
+                )
+            time.sleep(0.02)
+
+    def _await_publish(self, final_dir, step):
+        deadline = time.monotonic() + self.commit_timeout_s
+        while not os.path.isfile(os.path.join(final_dir, MANIFEST)):
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    "step %d: rank 0 did not publish within %.0fs"
+                    % (step, self.commit_timeout_s)
+                )
+            time.sleep(0.02)
+
+    # -- restore ------------------------------------------------------------
+
+    def _read_shard(self, shard_dir, state):
+        """state[name] = (value, dist_or_None) for every tensor in the
+        shard, crc-verified. For sharded manifests dist-sharded entries
+        accumulate as {rank: piece} dicts for reassembly."""
+        from ..fluid import core
+        from ..fluid.ops.io_ops import deserialize_lod_tensor
+
+        manifest_path = os.path.join(shard_dir, SHARD_MANIFEST)
+        if not os.path.isfile(manifest_path):
+            manifest_path = os.path.join(shard_dir, MANIFEST)
+        with open(manifest_path) as f:
+            shard = json.load(f)
+        data_path = os.path.join(shard_dir, shard.get("data_file", DATA_FILE))
+        with open(data_path, "rb") as f:
+            buf = f.read()
+        for name, entry in shard["tensors"].items():
+            blob = buf[entry["offset"]: entry["offset"] + entry["nbytes"]]
+            if len(blob) != entry["nbytes"] or (
+                zlib.crc32(blob) & 0xFFFFFFFF
+            ) != entry["crc32"]:
+                raise ChecksumError(
+                    "checkpoint tensor %r in %s fails its manifest crc32 "
+                    "(torn or corrupted data file)" % (name, data_path)
+                )
+            t, _ = deserialize_lod_tensor(blob)
+            val = t if t.lod() else t.numpy()
+            dist = entry.get("dist")
+            if dist is None:
+                state[name] = (val, None)
+            else:
+                pieces = state.setdefault(name, ({}, dist))[0]
+                pieces[int(dist["rank"])] = (
+                    val.numpy() if isinstance(val, core.LoDTensor) else val
+                )
+
+    def _reassemble(self, state):
+        """Replicated vars pass through. Dist-sharded vars: a single-rank
+        restore (gather/export) concatenates all shards to the full
+        value; a sharded restore (this manager has nranks > 1 and the var
+        in its dist_attrs) yields THIS rank's local shard — picked up
+        directly when the topology matches, re-sliced from the full value
+        when restoring into a different nranks (resharded restore)."""
+        out = {}
+        for name, (val, dist) in state.items():
+            if dist is None:
+                out[name] = val
+                continue
+            pieces = [val[r] for r in sorted(val)]
+            if len(pieces) != int(dist["nranks"]):
+                raise CheckpointError(
+                    "sharded tensor %r: have %d of %d shards"
+                    % (name, len(pieces), dist["nranks"])
+                )
+            saved_axis = int(dist["axis"])
+            if self.nranks > 1 and name in self.dist_attrs:
+                axis = int(self.dist_attrs[name])
+                if int(dist["nranks"]) == self.nranks and axis == saved_axis:
+                    out[name] = pieces[self.rank]
+                else:
+                    full = np.concatenate(pieces, axis=saved_axis)
+                    out[name] = np.array_split(
+                        full, self.nranks, axis=axis
+                    )[self.rank]
+            else:
+                out[name] = np.concatenate(pieces, axis=saved_axis)
+        return out
+
+    def _iter_step_tensors(self, step=None):
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise CheckpointError(
+                    "no committed checkpoint under %r" % self.dirname
+                )
+        step_dir = os.path.join(self.dirname, _step_dirname(step))
+        with open(os.path.join(step_dir, MANIFEST)) as f:
+            manifest = json.load(f)
+        state = {}
+        if manifest.get("nranks", 1) > 1:
+            for shard in manifest["shards"]:
+                self._read_shard(os.path.join(step_dir, shard["dir"]), state)
+        else:
+            self._read_shard(step_dir, state)
+        for name, (val, _dist) in state.items():
+            yield name, val
+
+    def _restore_rng(self, manifest, program, scope):
+        idx = manifest.get("rng_run_index")
+        if idx is None:
+            return
+        import weakref
+
+        counters = program.__dict__.setdefault(
+            "_rng_run_counters", weakref.WeakKeyDictionary()
+        )
+        counters[scope] = int(idx)
+
+    # -- retention / hygiene ------------------------------------------------
+
+    def _gc(self):
+        """Retention after each commit (rank 0 / single-rank only — it
+        runs on the publishing side): newest ``keep_max`` steps survive;
+        steps divisible by ``keep_every_n_steps`` are pinned forever."""
+        if self.keep_max <= 0:
+            return
+        steps = list_steps(self.dirname)
+        doomed = steps[:-self.keep_max] if len(steps) > self.keep_max else []
+        for s in doomed:
+            if self.keep_every_n_steps > 0 and s % self.keep_every_n_steps == 0:
+                continue
+            victim = os.path.join(self.dirname, _step_dirname(s))
+            # delete the manifest FIRST so a reader that races the rmtree
+            # can never see a half-deleted dir as committed
+            try:
+                os.unlink(os.path.join(victim, MANIFEST))
+            except OSError:
+                pass
+            shutil.rmtree(victim, ignore_errors=True)
+
+    def _sweep_stale_tmp(self):
+        """Remove a crashed run's staging dirs. Sharded mode sweeps only
+        dirs older than the commit timeout: a faster-starting peer may
+        already be staging its shard of a live save while this rank is
+        still constructing its manager, and its fresh mtime spares it."""
+        for name in os.listdir(self.dirname):
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            path = os.path.join(self.dirname, name)
+            if self.nranks > 1:
+                try:
+                    age = time.time() - os.stat(path).st_mtime
+                except OSError:
+                    continue
+                if age < self.commit_timeout_s:
+                    continue
+            shutil.rmtree(path, ignore_errors=True)
+
+    def _raise_pending(self):
+        with self._error_lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

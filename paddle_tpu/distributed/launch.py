@@ -33,6 +33,12 @@ def _parse_args(argv=None):
     parser.add_argument("--log_level", type=int, default=20)
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument(
+        "--sigterm_grace_s", type=float, default=30.0,
+        help="on SIGTERM: forward it to workers (their preemption "
+        "handlers run one final checkpoint save), then SIGKILL "
+        "survivors after this many seconds",
+    )
+    parser.add_argument(
         "training_script", type=str,
         help="the training script followed by its arguments",
     )
@@ -86,20 +92,46 @@ def start_procs(args):
             proc = subprocess.Popen(cmd, env=proc_env)
         procs.append(proc)
 
+    # preemption contract (paddle_tpu/checkpoint): when the fleet
+    # scheduler SIGTERMs the launcher, forward the signal to every worker
+    # so their PreemptionHandlers commit one final synchronous save, give
+    # them a grace window, then SIGKILL any survivor and exit 143.
+    preempted = {"flag": False}
+
+    def _on_sigterm(signum, frame):
+        preempted["flag"] = True
+        terminate_procs(procs)
+
+    prev_handler = None
+    try:
+        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread; no forwarding possible
+
+    import time
+
     try:
         alive = True
         error = False
-        while alive and not error:
+        while alive and not error and not preempted["flag"]:
             alive = False
             for p in procs:
                 ret = p.poll()
                 if ret is None:
                     alive = True
-                elif ret != 0:
+                elif ret != 0 and not preempted["flag"]:
                     error = True
-            import time
-
             time.sleep(0.25)
+        if preempted["flag"]:
+            deadline = time.monotonic() + args.sigterm_grace_s
+            while any(p.poll() is None for p in procs):
+                if time.monotonic() > deadline:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    break
+                time.sleep(0.25)
+            sys.exit(128 + signal.SIGTERM)
         if error:
             terminate_procs(procs)
             sys.exit(1)
@@ -107,6 +139,11 @@ def start_procs(args):
         terminate_procs(procs)
         raise
     finally:
+        if prev_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_handler)
+            except ValueError:
+                pass
         for fn in log_fns:
             fn.close()
 

@@ -1238,11 +1238,14 @@ class Executor(object):
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           ckpt_manager=None, startup_program=None):
         from . import trainer as _trainer
 
         return _trainer.train_from_dataset(
-            self, program, dataset, scope, fetch_list, fetch_info, print_period
+            self, program, dataset, scope, fetch_list, fetch_info,
+            print_period, ckpt_manager=ckpt_manager,
+            startup_program=startup_program,
         )
 
 

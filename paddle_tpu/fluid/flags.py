@@ -70,6 +70,17 @@ _DEFAULTS = {
     # default per-request deadline; 0 = no deadline. Requests whose
     # deadline passes while queued are shed at dispatch time.
     "serving_default_deadline_ms": 0.0,
+    # checkpoint manager (paddle_tpu/checkpoint): trainer-integrated save
+    # cadence (0 = off), retention (newest keep_max steps survive GC,
+    # every keep_every_n_steps-th step is pinned forever), writer-queue
+    # depth (snapshots in flight before save() back-pressures), and how
+    # long rank 0 waits for peer shard manifests before failing a
+    # sharded commit.
+    "ckpt_save_interval_steps": 0,
+    "ckpt_keep_max": 5,
+    "ckpt_keep_every_n_steps": 0,
+    "ckpt_async_depth": 2,
+    "ckpt_commit_timeout_s": 120.0,
     # profiling / graphs
     "print_sub_graph_dir": "",
     "pe_profile_fname": "",

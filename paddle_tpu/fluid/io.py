@@ -39,6 +39,16 @@ __all__ = [
 ]
 
 
+def _atomic_write_bytes(path, data):
+    """Same-dir temp + fsync + os.replace: a SIGKILL mid-save can leave a
+    stale ``*.tmp.<pid>`` turd but never a torn file at the real path
+    (the crash-oblivious in-place write was the old behavior). One shared
+    implementation, owned by ops/io_ops.py (the save ops use it too)."""
+    from .ops.io_ops import _atomic_write
+
+    _atomic_write(path, data)
+
+
 def is_persistable(var):
     return var.persistable and var.name not in (
         "feed",
@@ -284,37 +294,54 @@ def save(program, model_path):
             param_dict[v.name] = arr
         else:
             opt_dict[v.name] = arr
-    with open(base + ".pdparams", "wb") as f:
-        pickle.dump(param_dict, f, protocol=2)
-    with open(base + ".pdopt", "wb") as f:
-        pickle.dump(opt_dict, f, protocol=2)
+    _atomic_write_bytes(
+        base + ".pdparams", pickle.dumps(param_dict, protocol=2)
+    )
+    _atomic_write_bytes(base + ".pdopt", pickle.dumps(opt_dict, protocol=2))
     from . import proto
 
-    with open(base + ".pdmodel", "wb") as f:
-        f.write(proto.program_to_bytes(program))
+    _atomic_write_bytes(base + ".pdmodel", proto.program_to_bytes(program))
 
 
 def load(program, model_path, executor=None, var_list=None):
-    """reference: io.py load — restore consolidated state."""
+    """reference: io.py load — restore consolidated state. Raises
+    ValueError when no checkpoint exists at ``model_path`` (the old
+    silent no-op left the scope untouched and let a typo'd path
+    masquerade as a successful restore)."""
     scope = core.global_scope()
     base = model_path
+    found = False
     for suffix in (".pdparams", ".pdopt"):
         path = base + suffix
         if not os.path.exists(path):
             continue
+        found = True
         with open(path, "rb") as f:
             state = pickle.load(f)
         for name, arr in state.items():
             scope.set(name, np.asarray(arr))
+    if not found:
+        raise ValueError(
+            "fluid.load: no checkpoint at %r (neither %r nor %r exists)"
+            % (base, base + ".pdparams", base + ".pdopt")
+        )
 
 
 def load_program_state(model_path, var_list=None):
     state = {}
+    found = False
     for suffix in (".pdparams", ".pdopt"):
         path = model_path + suffix
         if os.path.exists(path):
+            found = True
             with open(path, "rb") as f:
                 state.update(pickle.load(f))
+    if not found:
+        raise ValueError(
+            "load_program_state: no checkpoint at %r (neither %r nor %r "
+            "exists)" % (model_path, model_path + ".pdparams",
+                         model_path + ".pdopt")
+        )
     return state
 
 

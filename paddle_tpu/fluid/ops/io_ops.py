@@ -170,6 +170,34 @@ def _ensure_dir(path):
         os.makedirs(d, exist_ok=True)
 
 
+def _atomic_write(path, data):
+    """Same-dir temp + fsync + os.replace so a SIGKILL mid-save never
+    leaves a torn tensor file at the real path (save_op.cc wrote in
+    place; paddle_tpu/checkpoint's atomic-commit contract extends down
+    to these raw save ops too)."""
+    _atomic_write_stream(path, (data,))
+
+
+def _atomic_write_stream(path, chunks):
+    """Atomic write fed chunk-by-chunk (a generator is fine): a combined
+    multi-GB params file streams tensor-by-tensor instead of holding the
+    whole payload in host RAM. A failure mid-stream removes the temp."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            for chunk in chunks:
+                f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
 def _save_lower(ctx, op_):
     name = op_.input("X")[0]
     value = ctx.scope.get(name)
@@ -177,8 +205,7 @@ def _save_lower(ctx, op_):
         raise ValueError("save: variable %r not found in scope" % name)
     path = op_.attr("file_path")
     _ensure_dir(path)
-    with open(path, "wb") as f:
-        f.write(serialize_lod_tensor(_to_host(value)))
+    _atomic_write(path, serialize_lod_tensor(_to_host(value)))
 
 
 def _load_lower(ctx, op_):
@@ -193,12 +220,15 @@ def _save_combine_lower(ctx, op_):
     names = op_.input("X")
     path = op_.attr("file_path")
     _ensure_dir(path)
-    with open(path, "wb") as f:
-        for n in names:
-            value = ctx.scope.get(n)
-            if value is None:
-                raise ValueError("save_combine: %r not in scope" % n)
-            f.write(serialize_lod_tensor(_to_host(value)))
+    values = []
+    for n in names:  # validate everything BEFORE the temp file opens
+        value = ctx.scope.get(n)
+        if value is None:
+            raise ValueError("save_combine: %r not in scope" % n)
+        values.append(value)
+    _atomic_write_stream(
+        path, (serialize_lod_tensor(_to_host(v)) for v in values)
+    )
 
 
 def _load_combine_lower(ctx, op_):

@@ -28,6 +28,7 @@ __all__ = [
     "bump_histogram",
     "get_histograms",
     "get_histogram",
+    "summarize_histogram",
     "reset_histograms",
 ]
 
@@ -88,6 +89,30 @@ def bump_histogram(name, value):
         if h is None:
             h = _histograms[name] = deque(maxlen=_HISTOGRAM_WINDOW)
         h.append(float(value))
+
+
+def summarize_histogram(name):
+    """{count, sum, mean, p50, p99, max} over one histogram's window —
+    what a save-latency dashboard line or a probe report wants, computed
+    from a single-window snapshot (same lock discipline as
+    get_histogram). Percentiles are nearest-rank: index ceil(p*n)-1."""
+    samples = sorted(get_histogram(name))
+    if not samples:
+        return {"count": 0, "sum": 0.0, "mean": 0.0, "p50": 0.0,
+                "p99": 0.0, "max": 0.0}
+    n = len(samples)
+
+    def rank(p):
+        return samples[max(0, -(-p * n // 100) - 1)]
+
+    return {
+        "count": n,
+        "sum": float(sum(samples)),
+        "mean": float(sum(samples) / n),
+        "p50": float(rank(50)),
+        "p99": float(rank(99)),
+        "max": float(samples[-1]),
+    }
 
 
 def get_histograms():

@@ -42,7 +42,9 @@ class MultiTrainer(TrainerBase):
     normal path)."""
 
     def train(self, executor, program, dataset, scope=None, fetch_list=None,
-              fetch_info=None, print_period=100, on_step=None):
+              fetch_info=None, print_period=100, on_step=None,
+              ckpt_manager=None, startup_program=None):
+        from . import flags as _flags
         from . import io_pipeline as _io_pipeline
 
         feed_names = [
@@ -50,14 +52,45 @@ class MultiTrainer(TrainerBase):
             for v in dataset.use_var
         ]
 
+        # preemption-safe checkpointing (paddle_tpu/checkpoint): resume at
+        # the last committed step (replaying the dataset stream past the
+        # already-trained batches — file datasets must iterate
+        # deterministically for bit-exact resume), save every
+        # FLAGS_ckpt_save_interval_steps on the background writer, and on
+        # SIGTERM stop at the next step boundary with one final sync save.
+        start_step = 0
+        ckpt_interval = 0
+        preempt_mod = None
+        handler = None
+        if ckpt_manager is not None:
+            from ..checkpoint import preempt as preempt_mod
+
+            start_step = ckpt_manager.restore_or_initialize(
+                program, executor, startup_program=startup_program,
+                scope=scope,
+            ) + 1
+            ckpt_interval = int(
+                _flags.get_flag("ckpt_save_interval_steps", 0) or 0
+            )
+            # flag-only handler: the loop below commits the final save at
+            # the next STEP BOUNDARY, so it can never snapshot a scope
+            # that executor.run is halfway through writing back (the
+            # in-handler save path can — see preempt.py)
+            handler = preempt_mod.PreemptionHandler(
+                ckpt_manager, lambda: None, save_in_handler=False,
+                exit_after=False,
+            ).install()
+
         def _feeds():
-            for batch in dataset._iter_batches():
+            for i, batch in enumerate(dataset._iter_batches()):
+                if i < start_step:
+                    continue  # replayed prefix: drop BEFORE the H2D copy
                 yield dict(zip(feed_names, batch))
 
         pipe = _io_pipeline.DeviceFeeder(
             _feeds(), place=getattr(executor, "place", None)
         )
-        step = 0
+        step = start_step
         try:
             for feed in pipe:
                 outs = executor.run(
@@ -75,9 +108,38 @@ class MultiTrainer(TrainerBase):
                     print("step %d: %s" % (step, msg))
                 if on_step is not None:
                     on_step(step)
+                if ckpt_manager is not None:
+                    # per-install latch, not the sticky module flag: a
+                    # driver that deliberately re-enters train() after a
+                    # survived SIGTERM gets a full run, not 1-step stops
+                    requested = (
+                        handler.requested.is_set()
+                        if handler is not None and handler._installed
+                        else preempt_mod.preemption_requested()
+                    )
+                    if requested:
+                        # the final save must not be skipped because an
+                        # EARLIER interval save failed on the writer —
+                        # drain + swallow the stale error first (same
+                        # contract as PreemptionHandler._final_save)
+                        try:
+                            ckpt_manager.wait()
+                        except Exception:
+                            pass
+                        ckpt_manager.save(
+                            step, program, scope=scope, async_=False
+                        )
+                        step += 1
+                        break
+                    if ckpt_interval and (step + 1) % ckpt_interval == 0:
+                        ckpt_manager.save(step, program, scope=scope)
                 step += 1
         finally:
             pipe.close()
+            if handler is not None:
+                handler.uninstall()
+            if ckpt_manager is not None:
+                ckpt_manager.wait()
         return step
 
 
@@ -174,10 +236,13 @@ class TrainerFactory(object):
 
 def train_from_dataset(
     executor, program, dataset, scope=None, fetch_list=None, fetch_info=None,
-    print_period=100,
+    print_period=100, ckpt_manager=None, startup_program=None,
 ):
     """Entry point behind Executor.train_from_dataset (reference:
-    Executor::RunFromDataset executor.cc:157)."""
+    Executor::RunFromDataset executor.cc:157). ``ckpt_manager`` (a
+    paddle_tpu.checkpoint.CheckpointManager) turns on preemption-safe
+    periodic checkpointing + resume, paced by
+    FLAGS_ckpt_save_interval_steps."""
     if dataset is None:
         raise ValueError("dataset must be provided")
     trainer_name = "MultiTrainer"
@@ -188,7 +253,12 @@ def train_from_dataset(
             dataset, "thread_num", 1
         )}
     )
+    kwargs = {}
+    if ckpt_manager is not None and trainer_name == "MultiTrainer":
+        kwargs = dict(
+            ckpt_manager=ckpt_manager, startup_program=startup_program
+        )
     return trainer.train(
         executor, program, dataset, scope, fetch_list, fetch_info,
-        print_period,
+        print_period, **kwargs,
     )
