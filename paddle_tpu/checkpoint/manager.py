@@ -287,17 +287,73 @@ class CheckpointManager(object):
                               startup_program=None, scope=None):
         """Resume path for trainers: restore the latest committed step
         and return it, or run ``startup_program`` (when given) for a
-        fresh start and return -1."""
-        if self.latest_step() is not None:
-            return self.restore(program, scope=scope, executor=executor)
-        if startup_program is not None:
-            if executor is None:
-                raise CheckpointError(
-                    "restore_or_initialize needs an executor to run the "
-                    "startup program on a fresh start"
+        fresh start and return -1.
+
+        Resilience (FLAGS_ckpt_restore_fallback, default on): when the
+        newest step fails its crc32 manifest check (bit rot, a torn
+        write that slipped past the atomic-commit protocol's
+        assumptions, a half-synced remote mount), log the ChecksumError
+        and fall back to the next-newest valid step — losing a few
+        steps of progress beats losing the job. Only when EVERY
+        committed step is damaged does the resume hard-fail (silently
+        fresh-starting would discard the whole run's progress)."""
+        import logging
+
+        from ..fluid import flags as _flags
+        from ..fluid import profiler as _profiler
+
+        steps = self.all_steps()
+        if not steps:
+            if startup_program is not None:
+                if executor is None:
+                    raise CheckpointError(
+                        "restore_or_initialize needs an executor to run "
+                        "the startup program on a fresh start"
+                    )
+                executor.run(startup_program, scope=scope)
+            return -1
+        fallback = bool(_flags.get_flag("ckpt_restore_fallback", True))
+        # Gang safety: ranks restore independently, so one rank falling
+        # back to an older step while its peers load the newest would
+        # silently train divergent replicas / misaligned collectives.
+        # Inside a multi-worker gang the fallback therefore requires the
+        # operator's EXPLICIT opt-in (identical-replica workloads, or
+        # checkpoint storage shared by all ranks) — the default-on
+        # behavior is for single-process training only.
+        in_gang = self.nranks > 1 or int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1")
+        ) > 1
+        if fallback and in_gang and not _flags.is_explicit(
+            "ckpt_restore_fallback"
+        ):
+            fallback = False
+        log = logging.getLogger("paddle_tpu.checkpoint")
+        newest_err = None
+        # fallback is scoped to ON-DISK damage (failed crc, torn/missing
+        # manifest or data file): a ValueError from e.g. restoring into a
+        # mismatched program is a caller bug and must surface on the
+        # first (newest) step, not walk the history mislabeled as bit rot
+        for s in reversed(steps):
+            try:
+                return self.restore(
+                    program, scope=scope, step=s, executor=executor
                 )
-            executor.run(startup_program, scope=scope)
-        return -1
+            except (ChecksumError, CheckpointError, OSError,
+                    json.JSONDecodeError) as e:
+                if not fallback:
+                    raise
+                if newest_err is None:
+                    newest_err = e
+                _profiler.bump_counter("ckpt_restore_fallbacks")
+                log.warning(
+                    "restore_or_initialize: step %d under %r is damaged "
+                    "(%s: %s); falling back to the next-newest "
+                    "checkpoint", s, self.dirname, type(e).__name__, e,
+                )
+        raise CheckpointError(
+            "every committed checkpoint under %r failed to restore "
+            "(newest step's error: %s)" % (self.dirname, newest_err)
+        )
 
     def verify(self, step=None):
         """Re-checksum a committed step without touching any scope (the
@@ -415,13 +471,19 @@ class CheckpointManager(object):
         same-dir rename so its presence IS the per-shard commit marker."""
         from ..fluid.ops.io_ops import serialize_lod_tensor
 
+        from ..testing import chaos as _chaos
+
         data_path = os.path.join(shard_dir, DATA_FILE)
         catalog = {}
         offset = 0
         with open(data_path, "wb") as f:
             for name, val in snap["tensors"]:
                 blob = serialize_lod_tensor(val)
-                f.write(blob)
+                # fault-injection point: chaos corrupt_ckpt flips a data
+                # byte AFTER the manifest crc32 below is computed from
+                # the clean bytes — the exact torn-file signature the
+                # restore fallback must survive (no-op when disarmed)
+                f.write(_chaos.corrupt_ckpt_bytes(blob))
                 entry = {
                     "shape": [int(d) for d in np.shape(
                         val.numpy() if hasattr(val, "numpy") else val

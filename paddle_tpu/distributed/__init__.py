@@ -1,3 +1,7 @@
-"""Distributed launch + host services (reference: python/paddle/distributed/)."""
+"""Distributed launch + host services (reference: python/paddle/distributed/).
 
+``launch`` keeps the reference CLI; ``supervisor`` is the elastic layer
+under it (heartbeat liveness, gang teardown, restart-with-resume)."""
+
+from . import supervisor  # noqa: F401
 from . import launch  # noqa: F401

@@ -5,15 +5,24 @@ PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINER_ENDPOINTS env).
 TPU-native: one process per HOST (each process owns its local chips through
 jax; per-chip parallelism is SPMD inside the process, not process-per-chip as
 with CUDA). The same env contract is kept, plus JAX_* coordinator vars so
-jax.distributed can bootstrap over DCN."""
+jax.distributed can bootstrap over DCN.
+
+The launcher is a supervising agent (distributed/supervisor.py), not a
+spawn-and-wait loop: worker crashes and heartbeat stalls tear down the
+whole gang (one dead rank deadlocks every peer of the collective) and —
+with ``--max_restarts > 0`` — restart it with exponential backoff,
+resuming from the newest committed checkpoint (paddle_tpu/checkpoint).
+SIGTERM preemption keeps its PR 3 contract: forwarded to workers (their
+handlers commit one final save), grace window, SIGKILL survivors,
+exit 143."""
 
 from __future__ import annotations
 
 import argparse
 import os
-import signal
-import subprocess
 import sys
+
+from . import supervisor as _supervisor
 
 
 def _parse_args(argv=None):
@@ -39,6 +48,31 @@ def _parse_args(argv=None):
         "survivors after this many seconds",
     )
     parser.add_argument(
+        "--max_restarts", type=int, default=0,
+        help="elastic restart budget: after a worker crash or heartbeat "
+        "stall the supervisor tears the gang down and relaunches it up "
+        "to this many times (workers resume from their newest committed "
+        "checkpoint); 0 keeps the legacy fail-fast behavior",
+    )
+    parser.add_argument(
+        "--heartbeat_timeout_s", type=float, default=None,
+        help="hang watchdog: a running worker whose heartbeat file "
+        "(written each step by the trainer) goes stale beyond this is "
+        "killed with the gang (default FLAGS_dist_heartbeat_timeout_s)",
+    )
+    parser.add_argument(
+        "--startup_grace_s", type=float, default=None,
+        help="staleness bound before a worker's FIRST heartbeat; unset "
+        "= never hang-kill a worker that has not proven it beats "
+        "(workers that did beat 'start' fall back to "
+        "FLAGS_dist_startup_grace_s for the restore/compile window)",
+    )
+    parser.add_argument(
+        "--supervisor_dir", type=str, default=None,
+        help="where supervisor.log + heartbeat files live "
+        "(default: --log_dir, else a temp dir)",
+    )
+    parser.add_argument(
         "training_script", type=str,
         help="the training script followed by its arguments",
     )
@@ -46,10 +80,9 @@ def _parse_args(argv=None):
     return parser.parse_args(argv)
 
 
-def start_procs(args):
-    """reference: launch.py:147 start_procs."""
-    procs = []
-    log_fns = []
+def build_specs(args):
+    """Per-rank WorkerSpecs carrying the reference env contract
+    (reference: launch.py:147 start_procs env wiring)."""
     node_ips = [ip.strip() for ip in args.cluster_node_ips.split(",")]
     node_id = node_ips.index(args.node_ip)
     num_nodes = len(node_ips)
@@ -62,96 +95,65 @@ def start_procs(args):
     nranks = num_nodes * nproc
     coordinator = "%s:%d" % (node_ips[0], args.started_port + 1000)
 
-    current_env = copy_env = dict(os.environ)
-    _ = copy_env
+    specs = []
     for i in range(nproc):
         rank = node_id * nproc + i
         current_endpoint = "%s:%d" % (args.node_ip, args.started_port + i)
-        proc_env = dict(current_env)
-        proc_env.update(
-            {
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_CURRENT_ENDPOINT": current_endpoint,
-                "PADDLE_TRAINERS_NUM": str(nranks),
-                "PADDLE_TRAINER_ENDPOINTS": ",".join(all_endpoints),
-                # jax.distributed bootstrap over DCN
-                "JAX_COORDINATOR_ADDRESS": coordinator,
-                "JAX_NUM_PROCESSES": str(nranks),
-                "JAX_PROCESS_ID": str(rank),
-            }
-        )
+        proc_env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": current_endpoint,
+            "PADDLE_TRAINERS_NUM": str(nranks),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(all_endpoints),
+            # jax.distributed bootstrap over DCN
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "JAX_NUM_PROCESSES": str(nranks),
+            "JAX_PROCESS_ID": str(rank),
+        }
         cmd = [sys.executable, "-u", args.training_script] + list(
             args.training_script_args
         )
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            fn = open("%s/workerlog.%d" % (args.log_dir, i), "w")
-            log_fns.append(fn)
-            proc = subprocess.Popen(cmd, env=proc_env, stdout=fn, stderr=fn)
-        else:
-            proc = subprocess.Popen(cmd, env=proc_env)
-        procs.append(proc)
-
-    # preemption contract (paddle_tpu/checkpoint): when the fleet
-    # scheduler SIGTERMs the launcher, forward the signal to every worker
-    # so their PreemptionHandlers commit one final synchronous save, give
-    # them a grace window, then SIGKILL any survivor and exit 143.
-    preempted = {"flag": False}
-
-    def _on_sigterm(signum, frame):
-        preempted["flag"] = True
-        terminate_procs(procs)
-
-    prev_handler = None
-    try:
-        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
-    except ValueError:
-        pass  # not the main thread; no forwarding possible
-
-    import time
-
-    try:
-        alive = True
-        error = False
-        while alive and not error and not preempted["flag"]:
-            alive = False
-            for p in procs:
-                ret = p.poll()
-                if ret is None:
-                    alive = True
-                elif ret != 0 and not preempted["flag"]:
-                    error = True
-            time.sleep(0.25)
-        if preempted["flag"]:
-            deadline = time.monotonic() + args.sigterm_grace_s
-            while any(p.poll() is None for p in procs):
-                if time.monotonic() > deadline:
-                    for p in procs:
-                        if p.poll() is None:
-                            p.kill()
-                    break
-                time.sleep(0.25)
-            sys.exit(128 + signal.SIGTERM)
-        if error:
-            terminate_procs(procs)
-            sys.exit(1)
-    except KeyboardInterrupt:
-        terminate_procs(procs)
-        raise
-    finally:
-        if prev_handler is not None:
-            try:
-                signal.signal(signal.SIGTERM, prev_handler)
-            except ValueError:
-                pass
-        for fn in log_fns:
-            fn.close()
+        log_path = (
+            os.path.join(args.log_dir, "workerlog.%d" % i)
+            if args.log_dir else None
+        )
+        specs.append(_supervisor.WorkerSpec(
+            cmd, env=proc_env, log_path=log_path, rank=rank,
+        ))
+    return specs
 
 
-def terminate_procs(procs):
-    for p in procs:
-        if p.poll() is None:
-            p.send_signal(signal.SIGTERM)
+def start_procs(args):
+    """reference: launch.py:147 start_procs — now supervised: crashes
+    and hangs tear down the whole gang; with --max_restarts the gang is
+    relaunched (exponential backoff) and workers resume from their
+    newest committed checkpoint; SIGTERM preemption exits 143."""
+    import tempfile
+
+    workdir = args.supervisor_dir or args.log_dir
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="paddle_tpu_supervisor_")
+    sup = _supervisor.Supervisor(
+        build_specs(args),
+        workdir=workdir,
+        max_restarts=args.max_restarts,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        startup_grace_s=args.startup_grace_s,
+        sigterm_grace_s=args.sigterm_grace_s,
+    )
+    rc = sup.run()
+    if rc != 0:
+        if sup.failure_report is not None:
+            # with no restart budget the accurate diagnosis is the
+            # worker failure itself, not "budget exhausted"
+            what = (
+                "restart budget exhausted" if args.max_restarts > 0
+                else "worker failed"
+            )
+            print(
+                "launch: %s: %s" % (what, sup.failure_report),
+                file=sys.stderr,
+            )
+        sys.exit(rc)
 
 
 def launch():

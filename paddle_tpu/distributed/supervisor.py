@@ -1,0 +1,549 @@
+"""Elastic gang supervisor: crash/hang detection + restart with resume.
+
+The Fluid-era reference kept jobs alive with ad-hoc pieces (the pserver
+``HeartBeatMonitor``, ``checkpoint_notify``); the launcher itself just
+spawned workers and waited. On a preemptible TPU pool that is fatal: one
+SIGKILLed or silently hung worker deadlocks every peer of the collective
+and the job dies without retry. The supervisor closes the loop:
+
+- **Liveness**: every worker writes a heartbeat file (step, timestamp,
+  status, pid — atomic tmp+rename) via a runtime hook in
+  ``fluid/trainer.py``; the env var ``PADDLE_TPU_HEARTBEAT_FILE`` names
+  it and is injected per rank by the supervisor.
+- **Detection**: a poll loop watches process exits (crash = any nonzero
+  exit) and heartbeat staleness (hang = a live worker whose newest beat
+  is older than ``FLAGS_dist_heartbeat_timeout_s``; before the first
+  beat a separate ``startup_grace_s`` covers imports + XLA compile).
+- **Teardown**: ANY failure kills the WHOLE gang — a torn collective
+  cannot make progress — via the PR 3 preemption path: SIGTERM (workers'
+  PreemptionHandlers commit a final save when they still can), grace
+  window, then SIGKILL survivors.
+- **Restart**: exponential backoff with jitter
+  (``FLAGS_dist_restart_backoff_s`` base, capped) under a restart budget
+  (``max_restarts``); workers resume bit-exactly through
+  ``CheckpointManager.restore_or_initialize`` (PR 3) — the supervisor
+  itself is stateless about training progress.
+- **Observability**: structured JSONL events in ``supervisor.log``
+  (gang_start / worker_exit / crash_detected / hang_detected /
+  gang_teardown / restart / gang_done / giveup / preempted) plus
+  always-on profiler counters ``dist_restarts`` / ``dist_hang_kills``
+  and the ``dist_downtime_ms`` histogram (failure detection -> next gang
+  start; MTTR for ``tools/dist_crash_probe.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+__all__ = [
+    "HEARTBEAT_ENV",
+    "RESTART_ENV",
+    "WorkerHeartbeat",
+    "worker_heartbeat",
+    "read_heartbeat",
+    "WorkerSpec",
+    "Supervisor",
+    "load_events",
+]
+
+HEARTBEAT_ENV = "PADDLE_TPU_HEARTBEAT_FILE"
+RESTART_ENV = "PADDLE_TPU_RESTART_NUM"
+SUPERVISOR_LOG = "supervisor.log"
+
+
+# ---------------------------------------------------------------------------
+# worker-side heartbeat (the fluid/trainer.py runtime hook lands here)
+# ---------------------------------------------------------------------------
+def _flag(name, default):
+    try:
+        from ..fluid import flags as _flags
+
+        return _flags.get_flag(name, default)
+    except Exception:
+        return default
+
+
+class WorkerHeartbeat(object):
+    """Throttled atomic progress file: ``{pid, step, status, time}``.
+
+    ``beat()`` is called once per training step; writes are throttled to
+    ``interval_s`` (FLAGS_dist_heartbeat_interval_s) so a fast step loop
+    never turns into fs churn, and status transitions always force a
+    write. Staleness detection on the supervisor side uses the file's
+    mtime, so the write itself IS the beat."""
+
+    def __init__(self, path, interval_s=None):
+        self.path = str(path)
+        self.interval_s = float(
+            _flag("dist_heartbeat_interval_s", 0.5)
+            if interval_s is None else interval_s
+        )
+        self._last_write = 0.0
+        self._last_status = None
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def beat(self, step, status="step", force=False):
+        now = time.monotonic()
+        if (not force and status == self._last_status
+                and now - self._last_write < self.interval_s):
+            return False
+        payload = json.dumps({
+            "pid": os.getpid(),
+            "step": int(step),
+            "status": str(status),
+            "time": time.time(),
+        })
+        tmp = "%s.tmp.%d" % (self.path, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+        except OSError:
+            return False  # liveness reporting must never kill the worker
+        self._last_write = now
+        self._last_status = status
+        return True
+
+
+def worker_heartbeat(interval_s=None):
+    """The heartbeat this process should write to, or None when not
+    running under a supervisor (PADDLE_TPU_HEARTBEAT_FILE unset)."""
+    path = os.environ.get(HEARTBEAT_ENV)
+    if not path:
+        return None
+    return WorkerHeartbeat(path, interval_s=interval_s)
+
+
+def read_heartbeat(path):
+    """Parse one heartbeat file -> dict with an added ``mtime``, or None
+    when absent/torn (a torn read loses one poll tick, nothing else)."""
+    try:
+        mtime = os.stat(path).st_mtime
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    data["mtime"] = mtime
+    return data
+
+
+# ---------------------------------------------------------------------------
+# supervisor side
+# ---------------------------------------------------------------------------
+class WorkerSpec(object):
+    """One gang member: argv, env overlay, and an optional log path the
+    supervisor appends stdout+stderr to (one file across restarts, with
+    an attempt banner between runs)."""
+
+    def __init__(self, cmd, env=None, log_path=None, rank=None):
+        self.cmd = list(cmd)
+        self.env = dict(env or {})
+        self.log_path = log_path
+        self.rank = rank
+
+
+class _Log(object):
+    """Append-only JSONL event log (workdir/supervisor.log)."""
+
+    def __init__(self, path, echo=False):
+        self.path = path
+        self.echo = echo
+        self._lock = threading.Lock()
+
+    def event(self, event, **fields):
+        rec = dict(fields)
+        rec["event"] = event
+        rec["ts"] = time.time()
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        if self.echo:
+            print("[supervisor] %s" % line, flush=True)
+        return rec
+
+
+def load_events(workdir):
+    """Parse workdir/supervisor.log back into a list of event dicts
+    (the probe's MTTR source)."""
+    path = os.path.join(workdir, SUPERVISOR_LOG)
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return events
+
+
+class GangOutcome(object):
+    DONE = "done"
+    CRASH = "crash"
+    HANG = "hang"
+    PREEMPTED = "preempted"
+
+
+class Supervisor(object):
+    """Supervising agent over one gang of worker processes.
+
+    ``run()`` drives start -> monitor -> (teardown -> backoff ->
+    restart)* until the gang completes, the restart budget is exhausted,
+    or the supervisor itself is preempted. Exit codes follow the
+    launcher's conventions: 0 done, 1 budget exhausted (a structured
+    ``giveup`` report is logged and returned via ``failure_report``),
+    143 preempted."""
+
+    def __init__(self, specs, workdir, max_restarts=0,
+                 heartbeat_timeout_s=None, startup_grace_s=None,
+                 backoff_base_s=None, backoff_max_s=None,
+                 sigterm_grace_s=5.0, poll_s=0.1, seed=None,
+                 echo_events=False):
+        self.specs = list(specs)
+        self.workdir = str(workdir)
+        self.max_restarts = int(max_restarts)
+        self.heartbeat_timeout_s = float(
+            _flag("dist_heartbeat_timeout_s", 60.0)
+            if heartbeat_timeout_s is None else heartbeat_timeout_s
+        )
+        # the watchdog threshold must clear the worker-side beat
+        # throttle (the same flag env reaches both sides): a throttle at
+        # or above the timeout would hang-kill every HEALTHY worker
+        # between two legitimate beats
+        beat_interval = float(_flag("dist_heartbeat_interval_s", 0.5))
+        self.heartbeat_timeout_s = max(
+            self.heartbeat_timeout_s, 2.0 * beat_interval
+        )
+        # Pre-first-STEP staleness bounds. A worker that never beats at
+        # all (not routed through the fluid.trainer hook) is
+        # unobservable and must not be killed for its silence unless an
+        # explicit grace was configured — crash detection still covers
+        # it. A worker whose beat says status "start" HAS proven it is
+        # instrumented, so a hang in jax re-init / restore / the first
+        # XLA compile is detectable: it gets the configured grace, or a
+        # generous finite default (big models compile for minutes, but
+        # not forever).
+        self.startup_grace_s = (
+            None if startup_grace_s is None else float(startup_grace_s)
+        )
+        self._instrumented_grace_s = (
+            self.startup_grace_s if self.startup_grace_s is not None
+            else float(_flag("dist_startup_grace_s", 600.0))
+        )
+        self.backoff_base_s = float(
+            _flag("dist_restart_backoff_s", 1.0)
+            if backoff_base_s is None else backoff_base_s
+        )
+        self.backoff_max_s = float(
+            _flag("dist_restart_backoff_max_s", 30.0)
+            if backoff_max_s is None else backoff_max_s
+        )
+        self.sigterm_grace_s = float(sigterm_grace_s)
+        self.poll_s = float(poll_s)
+        self.restarts_used = 0
+        self.failure_report = None
+        os.makedirs(self.workdir, exist_ok=True)
+        self._hb_dir = os.path.join(self.workdir, "heartbeats")
+        os.makedirs(self._hb_dir, exist_ok=True)
+        self.log = _Log(
+            os.path.join(self.workdir, SUPERVISOR_LOG), echo=echo_events
+        )
+        # default (seed=None) draws from OS entropy: many hosts' gangs
+        # crashed by one shared outage must NOT respawn in lockstep —
+        # decorrelation is the whole point of the jitter. A fixed seed
+        # is for tests wanting reproducible backoff.
+        self._rng = random.Random(seed)
+        self._procs = []  # list[(spec, Popen)]
+        self._procs_lock = threading.Lock()
+        self._log_files = []
+        self._preempted = threading.Event()
+
+    # -- public ------------------------------------------------------------
+
+    def alive_pids(self):
+        """{rank: pid} of currently-running workers (probe killer API)."""
+        with self._procs_lock:
+            return {
+                (s.rank if s.rank is not None else i): p.pid
+                for i, (s, p) in enumerate(self._procs)
+                if p.poll() is None
+            }
+
+    def run(self):
+        prev = self._install_sigterm()
+        try:
+            attempt = 0
+            t_detect = None
+            while True:
+                self._start_gang(attempt)
+                if t_detect is not None:
+                    # MTTR as documented: failure detection -> the
+                    # replacement gang is SPAWNED (spawn cost included)
+                    from ..fluid import profiler as _profiler
+
+                    _profiler.bump_histogram(
+                        "dist_downtime_ms",
+                        (time.monotonic() - t_detect) * 1000.0,
+                    )
+                outcome, detail = self._monitor()
+                t_detect = time.monotonic()
+                if outcome == GangOutcome.DONE:
+                    self.log.event("gang_done", restart=attempt)
+                    return 0
+                if outcome == GangOutcome.PREEMPTED:
+                    self._teardown("preempted", self.sigterm_grace_s)
+                    self.log.event("preempted", restart=attempt)
+                    return 128 + signal.SIGTERM
+                # crash or hang: the gang is torn — kill it whole
+                from ..fluid import profiler as _profiler
+
+                if outcome == GangOutcome.HANG:
+                    _profiler.bump_counter("dist_hang_kills")
+                self._teardown(outcome, self.sigterm_grace_s)
+                if self.restarts_used >= self.max_restarts:
+                    self.failure_report = {
+                        "restarts_used": self.restarts_used,
+                        "max_restarts": self.max_restarts,
+                        "last_failure": dict(detail, kind=outcome),
+                        "workdir": self.workdir,
+                    }
+                    self.log.event("giveup", **self.failure_report)
+                    return 1
+                self.restarts_used += 1
+                _profiler.bump_counter("dist_restarts")
+                delay = min(
+                    self.backoff_base_s * (2.0 ** (self.restarts_used - 1)),
+                    self.backoff_max_s,
+                ) * (0.5 + 0.5 * self._rng.random())  # decorrelating jitter
+                self.log.event(
+                    "restart", restart=self.restarts_used, backoff_s=delay,
+                    cause=dict(detail, kind=outcome),
+                )
+                # interruptible backoff: a SIGTERM preemption landing
+                # here must not wait out the sleep and then spawn (and
+                # immediately kill) a whole fresh gang
+                if self._preempted.wait(delay):
+                    self.log.event("preempted", restart=attempt)
+                    return 128 + signal.SIGTERM
+                attempt = self.restarts_used
+        finally:
+            # exception/Ctrl-C unwind: the full SIGTERM grace applies —
+            # workers' preemption handlers may be mid final-save, and
+            # killing that save loses up to ckpt_save_interval_steps of
+            # progress. Normal returns reach here with the gang already
+            # dead, making this a no-op.
+            self._teardown(
+                "supervisor_exit", self.sigterm_grace_s, quiet=True
+            )
+            self._restore_sigterm(prev)
+            for f in self._log_files:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            self._log_files = []
+
+    # -- internals ---------------------------------------------------------
+
+    def _install_sigterm(self):
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        try:
+            return signal.signal(
+                signal.SIGTERM, lambda *_: self._preempted.set()
+            )
+        except ValueError:
+            return None
+
+    def _restore_sigterm(self, prev):
+        if prev is None:
+            return
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except (ValueError, TypeError):
+            pass
+
+    def _hb_path(self, rank):
+        return os.path.join(self._hb_dir, "heartbeat_%d.json" % rank)
+
+    def _start_gang(self, attempt):
+        # previous attempt's log handles are dead with their processes
+        for f in self._log_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._log_files = []
+        # stale beats from the previous attempt must not mask a worker
+        # that hangs before its first beat
+        for i in range(len(self.specs)):
+            try:
+                os.remove(self._hb_path(i))
+            except OSError:
+                pass
+        # register the (still empty) gang list BEFORE spawning and
+        # append per worker: if a mid-loop Popen/open fails, the
+        # exception unwinds into run()'s finally, whose teardown must
+        # see — and reap — the workers already spawned, not the previous
+        # attempt's dead list
+        procs = []
+        with self._procs_lock:
+            self._procs = procs
+        # staleness bookkeeping: {local idx: (last seen mtime, monotonic
+        # time that mtime was first observed)} — ages are measured on
+        # the supervisor's monotonic clock between observed CHANGES, so
+        # an NTP step of the wall clock can neither forge a hang nor
+        # mask one
+        self._hb_seen = {}
+        for i, spec in enumerate(self.specs):
+            env = dict(os.environ)
+            env.update(spec.env)
+            env[HEARTBEAT_ENV] = self._hb_path(i)
+            env[RESTART_ENV] = str(attempt)
+            stdout = stderr = None
+            if spec.log_path:
+                d = os.path.dirname(spec.log_path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                fn = open(spec.log_path, "a")
+                fn.write("--- supervisor attempt %d ---\n" % attempt)
+                fn.flush()
+                self._log_files.append(fn)
+                stdout = stderr = fn
+            p = subprocess.Popen(
+                spec.cmd, env=env, stdout=stdout, stderr=stderr
+            )
+            with self._procs_lock:
+                procs.append((spec, p))
+        self._gang_t0 = time.monotonic()
+        self.log.event(
+            "gang_start", restart=attempt,
+            pids=[p.pid for _s, p in procs],
+        )
+
+    def _monitor(self):
+        """Poll until the gang completes or a failure is detected.
+        Returns (outcome, detail). Events carry the spec's GLOBAL rank
+        (multi-node: node 1's workers are ranks 4..7, not 0..3) so
+        operators and MTTR tooling inspect the right worker."""
+        finished = set()
+        while True:
+            if self._preempted.is_set():
+                return GangOutcome.PREEMPTED, {}
+            now = time.monotonic()
+            for i, (spec, p) in enumerate(self._procs):
+                rank = spec.rank if spec.rank is not None else i
+                rc = p.poll()
+                if rc is None or i in finished:
+                    continue
+                if rc == 0:
+                    finished.add(i)
+                    self.log.event("worker_exit", rank=rank, returncode=0)
+                    continue
+                self.log.event(
+                    "crash_detected", rank=rank, returncode=rc, pid=p.pid,
+                )
+                return GangOutcome.CRASH, {"rank": rank, "returncode": rc}
+            if len(finished) == len(self._procs):
+                return GangOutcome.DONE, {}
+            # hang watchdog over the still-running workers
+            for i, (spec, p) in enumerate(self._procs):
+                if i in finished or p.poll() is not None:
+                    continue
+                rank = spec.rank if spec.rank is not None else i
+                hb = read_heartbeat(self._hb_path(i))
+                status = (hb or {}).get("status")
+                if hb is None:
+                    # never beat: unobservable unless an explicit grace
+                    # was configured
+                    if self.startup_grace_s is None:
+                        continue
+                    age = now - self._gang_t0
+                    limit = self.startup_grace_s
+                elif status == "start":
+                    # instrumented but pre-first-step (restore + first
+                    # XLA compile): laxer, but FINITE, bound
+                    age = now - self._gang_t0
+                    limit = self._instrumented_grace_s
+                elif status == "done":
+                    # Training progress is complete; what follows (final
+                    # save teardown, then whatever post-train work the
+                    # user script runs — eval, export) is unbeatable and
+                    # of unknowable duration, so NO staleness bound
+                    # applies: killing a healthy 20-minute export to
+                    # guard against the rarer wedged-final-save would
+                    # turn succeeding jobs into restart loops. The
+                    # accepted tradeoff: a truly wedged post-'done'
+                    # worker stalls the gang until the operator (or the
+                    # fleet scheduler's own job timeout) intervenes —
+                    # process exit is the remaining signal.
+                    continue
+                else:
+                    seen = self._hb_seen.get(i)
+                    if seen is None or seen[0] != hb["mtime"]:
+                        self._hb_seen[i] = (hb["mtime"], now)
+                        continue  # fresh beat observed this poll
+                    age = now - seen[1]
+                    limit = self.heartbeat_timeout_s
+                if age > limit:
+                    self.log.event(
+                        "hang_detected", rank=rank, pid=p.pid,
+                        stale_s=round(age, 3),
+                        last_step=(hb or {}).get("step"),
+                    )
+                    return GangOutcome.HANG, {
+                        "rank": rank, "stale_s": round(age, 3),
+                    }
+            time.sleep(self.poll_s)
+
+    def _teardown(self, reason, grace_s, quiet=False):
+        """SIGTERM the gang (the PR 3 preemption path: workers' handlers
+        get a chance to commit a final save), then SIGKILL survivors
+        after ``grace_s``."""
+        with self._procs_lock:
+            procs = list(self._procs)
+        alive = [p for _s, p in procs if p.poll() is None]
+        if not alive:
+            return
+        for p in alive:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + grace_s
+        killed = []
+        while any(p.poll() is None for p in alive):
+            if time.monotonic() > deadline:
+                for p in alive:
+                    if p.poll() is None:
+                        try:
+                            p.kill()
+                        except OSError:
+                            pass
+                        killed.append(p.pid)
+                break
+            time.sleep(0.05)
+        for p in alive:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        if not quiet:
+            self.log.event(
+                "gang_teardown", reason=reason, sigkilled=killed,
+            )

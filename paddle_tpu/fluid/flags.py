@@ -81,6 +81,32 @@ _DEFAULTS = {
     "ckpt_keep_every_n_steps": 0,
     "ckpt_async_depth": 2,
     "ckpt_commit_timeout_s": 120.0,
+    # resume resilience: when the newest committed checkpoint fails its
+    # crc32 manifest check, restore_or_initialize logs the ChecksumError
+    # and falls back to the next-newest valid step instead of hard-failing
+    "ckpt_restore_fallback": True,
+    # elastic supervisor (paddle_tpu/distributed/supervisor.py): hang
+    # watchdog threshold over worker heartbeat files, worker-side beat
+    # write throttle, and the restart backoff (base doubles per restart,
+    # capped, with decorrelating jitter)
+    "dist_heartbeat_timeout_s": 60.0,
+    "dist_heartbeat_interval_s": 0.5,
+    # staleness bound for an INSTRUMENTED worker still pre-first-step
+    # (status "start": restore + first XLA compile) — generous but
+    # finite so a post-restart deadlock cannot stall the gang forever
+    "dist_startup_grace_s": 600.0,
+    "dist_restart_backoff_s": 1.0,
+    "dist_restart_backoff_max_s": 30.0,
+    # deterministic fault injection (paddle_tpu/testing/chaos.py):
+    # -1/0/"" = disarmed; target_rank scopes step faults to one gang
+    # member; marker_dir makes each fault one-shot across gang restarts
+    "chaos_crash_at_step": -1,
+    "chaos_hang_at_step": -1,
+    "chaos_corrupt_ckpt": False,
+    "chaos_slow_feed_ms": 0.0,
+    "chaos_rpc_fail_n": 0,
+    "chaos_target_rank": -1,
+    "chaos_marker_dir": "",
     # profiling / graphs
     "print_sub_graph_dir": "",
     "pe_profile_fname": "",
@@ -107,6 +133,11 @@ _DEFAULTS = {
     "pserver_heartbeat_timeout_s": 120.0,
     "pserver_heartbeat_interval_s": 10.0,
     "pserver_timeout_ms": 600000,
+    # trainer-side RPC resilience: transient connection errors during a
+    # pserver (re)start retry with capped exponential backoff + jitter up
+    # to this many times (overall time still bounded by the
+    # FLAGS_rpc_deadline budget)
+    "pserver_rpc_retries": 5,
     # communicator
     "communicator_independent_recv_thread": True,
     "communicator_send_queue_size": 20,
@@ -130,6 +161,14 @@ _DEFAULTS = {
 
 _flags = {}
 _explicit = set()  # flags set via env or set_flags (side effects key off it)
+_version = 0  # bumped on every mutation; cheap cache-invalidation token
+
+
+def version():
+    """Monotonic counter bumped by set_flags/_read_env — lets hot paths
+    cache flag-derived state (e.g. testing.chaos's disarmed fast path)
+    and revalidate with one integer compare."""
+    return _version
 
 
 def _coerce(default, text):
@@ -143,6 +182,7 @@ def _coerce(default, text):
 
 
 def _read_env():
+    global _version
     _flags.clear()
     _flags.update(_DEFAULTS)
     _explicit.clear()
@@ -154,6 +194,10 @@ def _read_env():
                 _explicit.add(name)
             except ValueError:
                 pass
+    # bump AFTER the mutation: a concurrent reader that snapshots the
+    # old values under the new version would otherwise cache stale state
+    # forever (the bump-after order makes such a race self-healing)
+    _version += 1
     _apply_side_effects()
 
 
@@ -193,21 +237,36 @@ def get_flags(names):
 
 
 def set_flags(flags):
-    """paddle-compatible flag write: {FLAGS_name: value}."""
+    """paddle-compatible flag write: {FLAGS_name: value}. Validates (and
+    coerces) EVERY key before mutating ANY: a bad key mid-dict must not
+    leave earlier keys half-applied with no version bump / side effects
+    (version-keyed caches would then serve stale state indefinitely)."""
+    global _version
+    staged = {}
     for n, v in flags.items():
         key = n[6:] if n.startswith("FLAGS_") else n
         if key not in _DEFAULTS:
             raise ValueError("flag %r is not registered" % n)
-        _flags[key] = _coerce(_DEFAULTS[key], str(v)) if isinstance(
+        staged[key] = _coerce(_DEFAULTS[key], str(v)) if isinstance(
             v, str
         ) else v
-        _explicit.add(key)
+    _flags.update(staged)
+    _explicit.update(staged)
+    _version += 1  # after the mutation — see _read_env
     _apply_side_effects()
 
 
 def is_registered(name):
     key = name[6:] if name.startswith("FLAGS_") else name
     return key in _DEFAULTS
+
+
+def is_explicit(name):
+    """True when the flag was set via env or set_flags (vs. sitting at
+    its default) — lets risky behaviors distinguish an operator's
+    deliberate opt-in from a default."""
+    key = name[6:] if name.startswith("FLAGS_") else name
+    return key in _explicit
 
 
 def get_flag(name, default=None):

@@ -153,10 +153,16 @@ class DeviceFeeder(object):
         return False
 
     def _produce(self):
+        from ..testing import chaos as _chaos
+
         try:
             for batch in self._source:
                 if self._stop.is_set():
                     break
+                # fault-injection point: chaos slow_feed_ms models a
+                # degraded input host on the producer thread (no-op when
+                # disarmed), so feed-stall behavior is testable
+                _chaos.maybe_slow_feed()
                 if not self._put(self._stage(batch)):
                     break
         except BaseException as e:  # surfaced at the consumer's next pull

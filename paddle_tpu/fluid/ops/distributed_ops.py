@@ -35,14 +35,77 @@ _clients_lock = threading.Lock()
 _clients = {}  # (endpoint, trainer_id) -> native.RpcClient
 
 
+def _with_conn_retry(what, fn):
+    """Pserver (re)start resilience: retry ``fn`` over transient
+    ``ConnectionError``s with capped exponential backoff + jitter —
+    FLAGS_pserver_rpc_retries attempts, gated by a FLAGS_rpc_deadline
+    wall-clock budget: no NEW attempt starts once the budget is spent
+    (an in-flight attempt still runs to its own RPC deadline, so this
+    layer adds at most one deadline-bounded cycle to the worst case —
+    the fast path it exists for is refused connects, which fail in
+    microseconds and need the backoff the transport-level retry inside
+    ``native.RpcClient._with_retry`` does not provide). A refused
+    connection while a preempted pserver is being relaunched is expected
+    fleet weather, not a crash; anything that survives the budget still
+    raises.
+
+    IDEMPOTENT operations only (connect, get_var): re-invoking a
+    mutating send draws a fresh seq that the server's retry-dedup window
+    cannot match, so an ambiguous failure would apply the payload twice
+    — sends rely on get_client's connect retry + RpcClient._with_retry's
+    same-seq reconnects instead.
+
+    The chaos hook (paddle_tpu/testing/chaos.py rpc_fail_n) injects
+    deterministic failures BEFORE the real call so tests can prove the
+    retry path without real sockets."""
+    import random as _random
+
+    from .. import flags as _flags
+    from .. import profiler as _profiler
+    from ...testing import chaos as _chaos
+
+    retries = max(int(_flags.get_flag("pserver_rpc_retries", 5)), 0)
+    budget_s = max(float(_flags.get_flag("rpc_deadline", 180000)), 0.0) / 1000.0
+    deadline = time.monotonic() + budget_s
+    delay_s = 0.05
+    attempt = 0
+    while True:
+        try:
+            _chaos.maybe_rpc_error(what)
+            return fn()
+        except ConnectionError:
+            attempt += 1
+            remaining = deadline - time.monotonic()
+            if attempt > retries or remaining <= 0:
+                raise
+            _profiler.bump_counter("pserver_rpc_conn_retries")
+            sleep_s = min(delay_s, 2.0, max(remaining, 0.0))
+            time.sleep(sleep_s * (0.5 + 0.5 * _random.random()))
+            delay_s = min(delay_s * 2.0, 2.0)
+
+
 def get_client(endpoint, trainer_id):
     key = (endpoint, int(trainer_id))
     with _clients_lock:
         c = _clients.get(key)
-        if c is None:
-            c = native.RpcClient(endpoint, trainer_id)
-            _clients[key] = c
+    if c is not None:
         return c
+    # connect retries OUTSIDE the cache lock (backoff sleeps must not
+    # serialize every other endpoint's lookups): during a pserver restart
+    # the listening socket is down for a window and the constructor
+    # raises ConnectionError on the first refused connect
+    c = _with_conn_retry(
+        "connect(%s)" % endpoint,
+        lambda: native.RpcClient(endpoint, trainer_id),
+    )
+    with _clients_lock:
+        winner = _clients.setdefault(key, c)
+    if winner is not c:  # lost a benign connect race
+        try:
+            c.close()
+        except Exception:
+            pass
+    return winner
 
 
 def close_all_clients(send_complete=True):
@@ -108,13 +171,20 @@ def _send_lower(ctx, op_):
                     height=(v.height + n_eps - 1 - k) // n_eps,
                     value=vals[sel],
                 )
+                # MUTATING sends are deliberately NOT wrapped in
+                # _with_conn_retry: re-invoking send_var draws a fresh
+                # seq, which the server cannot dedup — an ambiguous
+                # failure (grad applied, response lost) would be applied
+                # TWICE. Refused-connection resilience for sends lives in
+                # get_client's connect retry plus RpcClient._with_retry's
+                # same-seq reconnect loop, both dedup-safe.
                 get_client(ep, tid).send_var(
                     n, native.serialize_selected_rows(shard)
                 )
             continue
         payload = native.serialize_tensor(np.asarray(v))
         for ep in eps:
-            get_client(ep, tid).send_var(n, payload)
+            get_client(ep, tid).send_var(n, payload)  # see dedup note above
 
 
 def _recv_lower(ctx, op_):
@@ -122,9 +192,12 @@ def _recv_lower(ctx, op_):
     tid = int(op_.attr("trainer_id", 0))
     names = [n for n in op_.output_arg_names]
     for ep in eps:
-        client = get_client(ep, tid)
         for n in names:
-            arr, _lod, _used = native.deserialize_tensor(client.get_var(n))
+            payload = _with_conn_retry(
+                "get_var(%s<-%s)" % (n, ep),
+                lambda ep=ep, n=n: get_client(ep, tid).get_var(n),
+            )
+            arr, _lod, _used = native.deserialize_tensor(payload)
             ctx.scope.set(n, arr)
 
 

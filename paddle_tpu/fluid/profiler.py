@@ -23,6 +23,7 @@ __all__ = [
     "stop_profiler",
     "RecordEvent",
     "bump_counter",
+    "get_counter",
     "get_counters",
     "reset_counters",
     "bump_histogram",
@@ -65,6 +66,14 @@ def get_counters():
     them."""
     with _counters_lock:
         return dict(_counters)
+
+
+def get_counter(name, default=0):
+    """One counter's current value (same isolation contract as
+    get_counters, holding the lock for a single lookup — what the
+    supervisor's restart accounting and probes poll per event)."""
+    with _counters_lock:
+        return _counters.get(name, default)
 
 
 def reset_counters():

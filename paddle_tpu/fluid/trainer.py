@@ -46,11 +46,19 @@ class MultiTrainer(TrainerBase):
               ckpt_manager=None, startup_program=None):
         from . import flags as _flags
         from . import io_pipeline as _io_pipeline
+        from ..distributed import supervisor as _sup
+        from ..testing import chaos as _chaos
 
         feed_names = [
             v.name if hasattr(v, "name") else str(v)
             for v in dataset.use_var
         ]
+
+        # elastic-training liveness hook: when launched under the
+        # supervising agent (PADDLE_TPU_HEARTBEAT_FILE in the env), write
+        # a progress beat per step so the hang watchdog can tell a slow
+        # step from a stalled worker. No-op (hb is None) otherwise.
+        hb = _sup.worker_heartbeat()
 
         # preemption-safe checkpointing (paddle_tpu/checkpoint): resume at
         # the last committed step (replaying the dataset stream past the
@@ -62,6 +70,8 @@ class MultiTrainer(TrainerBase):
         ckpt_interval = 0
         preempt_mod = None
         handler = None
+        if hb is not None:
+            hb.beat(-1, status="start", force=True)
         if ckpt_manager is not None:
             from ..checkpoint import preempt as preempt_mod
 
@@ -91,6 +101,7 @@ class MultiTrainer(TrainerBase):
             _feeds(), place=getattr(executor, "place", None)
         )
         step = start_step
+        preempted_break = False
         try:
             for feed in pipe:
                 outs = executor.run(
@@ -108,6 +119,8 @@ class MultiTrainer(TrainerBase):
                     print("step %d: %s" % (step, msg))
                 if on_step is not None:
                     on_step(step)
+                if hb is not None:
+                    hb.beat(step)
                 if ckpt_manager is not None:
                     # per-install latch, not the sticky module flag: a
                     # driver that deliberately re-enters train() after a
@@ -118,6 +131,7 @@ class MultiTrainer(TrainerBase):
                         else preempt_mod.preemption_requested()
                     )
                     if requested:
+                        preempted_break = True
                         # the final save must not be skipped because an
                         # EARLIER interval save failed on the writer —
                         # drain + swallow the stale error first (same
@@ -133,7 +147,22 @@ class MultiTrainer(TrainerBase):
                         break
                     if ckpt_interval and (step + 1) % ckpt_interval == 0:
                         ckpt_manager.save(step, program, scope=scope)
+                # fault-injection point AFTER the interval save was
+                # enqueued: a crash here lands while the async writer may
+                # be mid-commit — the worst case the chaos harness exists
+                # to make reproducible
+                _chaos.on_step(step)
                 step += 1
+            if hb is not None:
+                # a preempted stop is NOT completion: "done" would exempt
+                # this worker from the supervisor's hang watchdog while
+                # it may still wedge in teardown; "preempted" keeps the
+                # per-step staleness bound active for the wrap-up
+                hb.beat(
+                    step - 1,
+                    status="preempted" if preempted_break else "done",
+                    force=True,
+                )
         finally:
             pipe.close()
             if handler is not None:

@@ -1,0 +1,17 @@
+"""paddle_tpu.testing — deterministic fault-injection (chaos) harness.
+
+Robustness features are only trustworthy when their failure modes are
+reproducible: ``chaos`` provides flag/env-driven injection points (crash,
+hang, checkpoint corruption, slow feed, flaky RPC) plus an in-process
+``FaultPlan`` API, wired into the trainer loop, the input pipeline, the
+checkpoint writer, and the pserver RPC client.
+"""
+
+from .chaos import (  # noqa: F401
+    FaultPlan,
+    active_plan,
+    clear,
+    install,
+)
+
+__all__ = ["FaultPlan", "install", "clear", "active_plan"]

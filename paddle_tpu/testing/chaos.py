@@ -1,0 +1,231 @@
+"""Deterministic fault injection for elastic-training tests.
+
+Every injection point is a pure function of configuration + observable
+state (step index, call count) — no randomness lives here, so a failing
+chaos trial replays bit-exactly. Faults come from two sources, resolved
+per call site:
+
+1. **In-process**: ``install(FaultPlan(...))`` — unit tests inject and
+   ``clear()`` in teardown.
+2. **Flags/env**: ``FLAGS_chaos_*`` (env-bridged like every other flag:
+   ``FLAGS_chaos_crash_at_step=7`` in a worker's environment arms the
+   fault in that subprocess). ``FLAGS_chaos_target_rank`` scopes a fault
+   to one worker of a gang (matched against ``PADDLE_TRAINER_ID``);
+   -1 targets every rank.
+
+One-shot semantics across restarts: a supervised gang re-spawns workers
+with the SAME environment, so an armed crash/hang would re-fire on every
+attempt and no trial could ever converge. ``FLAGS_chaos_marker_dir``
+fixes that deterministically: firing a fault first touches
+``fired_<point>`` in that directory, and any later process that sees the
+marker skips the injection. An empty marker dir (the default) means
+faults fire unconditionally — what a restart-budget-exhaustion test
+wants.
+
+Injection points and their hosts:
+
+- ``crash_at_step`` / ``hang_at_step`` — ``fluid/trainer.py`` calls
+  ``on_step(step)`` at each step boundary (right after the interval
+  checkpoint save is enqueued, the worst moment to die).
+- ``slow_feed_ms`` — ``fluid/io_pipeline.py``'s producer thread calls
+  ``maybe_slow_feed()`` per batch (models a degraded input host).
+- ``corrupt_ckpt`` — the checkpoint writer routes serialized tensor
+  bytes through ``corrupt_ckpt_bytes()`` AFTER the manifest crc32 is
+  computed, producing exactly the torn-file signature the restore
+  fallback must survive.
+- ``rpc_fail_n`` — the pserver client's retry wrapper raises
+  ``ConnectionError`` for the first N calls via ``maybe_rpc_error()``
+  (models a pserver that is still restarting).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+__all__ = [
+    "FaultPlan",
+    "install",
+    "clear",
+    "active_plan",
+    "on_step",
+    "maybe_slow_feed",
+    "corrupt_ckpt_bytes",
+    "maybe_rpc_error",
+]
+
+_lock = threading.Lock()
+_plan = None  # in-process FaultPlan (overrides flags when installed)
+_rpc_faults_raised = 0  # process-local count for rpc_fail_n
+# flags-derived plan cache keyed on the flags version: the injection
+# points sit on per-step / per-batch / per-tensor hot paths and the
+# common (disarmed) case must cost one lock + one integer compare, not
+# seven flag lookups and an allocation per call
+_flag_plan_cache = (None, None)  # (flags.version(), plan_or_None)
+
+
+class FaultPlan(object):
+    """One process's fault configuration. ``None``/0/False fields are
+    disarmed. ``target_rank`` scopes step faults to one gang member
+    (None = every rank); ``marker_dir`` makes each fault one-shot across
+    process restarts (see module docstring)."""
+
+    def __init__(self, crash_at_step=None, hang_at_step=None,
+                 corrupt_ckpt=False, slow_feed_ms=0.0, rpc_fail_n=0,
+                 target_rank=None, marker_dir=None):
+        self.crash_at_step = crash_at_step
+        self.hang_at_step = hang_at_step
+        self.corrupt_ckpt = bool(corrupt_ckpt)
+        self.slow_feed_ms = float(slow_feed_ms)
+        self.rpc_fail_n = int(rpc_fail_n)
+        self.target_rank = target_rank
+        self.marker_dir = marker_dir
+
+    @classmethod
+    def from_flags(cls):
+        """The env/flag-driven plan (armed in subprocess workers by
+        exporting ``FLAGS_chaos_*``). Returns None when every chaos flag
+        sits at its disarmed default."""
+        from ..fluid import flags as _flags
+
+        crash = int(_flags.get_flag("chaos_crash_at_step", -1))
+        hang = int(_flags.get_flag("chaos_hang_at_step", -1))
+        corrupt = bool(_flags.get_flag("chaos_corrupt_ckpt", False))
+        slow = float(_flags.get_flag("chaos_slow_feed_ms", 0.0))
+        rpc_n = int(_flags.get_flag("chaos_rpc_fail_n", 0))
+        rank = int(_flags.get_flag("chaos_target_rank", -1))
+        marker = str(_flags.get_flag("chaos_marker_dir", "") or "")
+        if (crash < 0 and hang < 0 and not corrupt and slow <= 0
+                and rpc_n <= 0):
+            return None
+        return cls(
+            crash_at_step=crash if crash >= 0 else None,
+            hang_at_step=hang if hang >= 0 else None,
+            corrupt_ckpt=corrupt,
+            slow_feed_ms=slow,
+            rpc_fail_n=rpc_n,
+            target_rank=rank if rank >= 0 else None,
+            marker_dir=marker or None,
+        )
+
+    def targets_me(self):
+        if self.target_rank is None:
+            return True
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0")) == int(
+            self.target_rank
+        )
+
+
+def install(plan):
+    """Arm an in-process plan (unit tests); overrides the flag plan."""
+    global _plan
+    with _lock:
+        _plan = plan
+    return plan
+
+
+def clear():
+    global _plan, _rpc_faults_raised
+    with _lock:
+        _plan = None
+        _rpc_faults_raised = 0
+
+
+def active_plan():
+    """The plan governing this process: the installed one, else the
+    flag/env one (cached per flags-version), else None."""
+    global _flag_plan_cache
+    from ..fluid import flags as _flags
+
+    with _lock:
+        if _plan is not None:
+            return _plan
+        ver = _flags.version()
+        cached_ver, cached = _flag_plan_cache
+        if cached_ver == ver:
+            return cached
+    plan = FaultPlan.from_flags()
+    with _lock:
+        _flag_plan_cache = (ver, plan)
+    return plan
+
+
+def _fire_once(plan, point):
+    """True when `point` should fire now; with a marker_dir, atomically
+    claims the ``fired_<point>`` marker so exactly one process in the
+    trial's lineage ever fires it."""
+    if plan.marker_dir is None:
+        return True
+    os.makedirs(plan.marker_dir, exist_ok=True)
+    marker = os.path.join(plan.marker_dir, "fired_%s" % point)
+    try:
+        fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    os.close(fd)
+    return True
+
+
+def on_step(step):
+    """Trainer step-boundary hook: SIGKILL this process or hang it
+    forever when the armed step is reached. The hang deliberately keeps
+    the process alive and silent — heartbeats stop, the collective
+    stalls — which is exactly what the supervisor's watchdog exists to
+    catch (a SIGTERM-able sleep, so teardown escalation is exercised
+    too)."""
+    plan = active_plan()
+    if plan is None or not plan.targets_me():
+        return
+    if plan.crash_at_step is not None and step == int(plan.crash_at_step):
+        if _fire_once(plan, "crash_at_step"):
+            print("CHAOS crash_at_step=%d pid=%d" % (step, os.getpid()),
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+    if plan.hang_at_step is not None and step == int(plan.hang_at_step):
+        if _fire_once(plan, "hang_at_step"):
+            print("CHAOS hang_at_step=%d pid=%d" % (step, os.getpid()),
+                  flush=True)
+            while True:
+                time.sleep(0.25)
+
+
+def maybe_slow_feed():
+    """Input-pipeline producer hook: per-batch host-side delay."""
+    plan = active_plan()
+    if plan is None or plan.slow_feed_ms <= 0 or not plan.targets_me():
+        return
+    time.sleep(plan.slow_feed_ms / 1000.0)
+
+
+def corrupt_ckpt_bytes(blob):
+    """Checkpoint-writer hook: return `blob` with its last byte flipped
+    (called after the manifest crc32 was computed from the clean bytes,
+    so the committed checkpoint fails its integrity check on restore).
+    Length is preserved — offsets in the concatenated data file stay
+    valid, making the corruption visible ONLY to the crc."""
+    plan = active_plan()
+    if plan is None or not plan.corrupt_ckpt or not plan.targets_me():
+        return blob
+    if not blob or not _fire_once(plan, "corrupt_ckpt"):
+        return blob
+    return blob[:-1] + bytes([blob[-1] ^ 0xFF])
+
+
+def maybe_rpc_error(what):
+    """Pserver-client hook: raise ConnectionError for the first
+    ``rpc_fail_n`` guarded calls in this process (then heal), modeling a
+    pserver that is mid-restart."""
+    global _rpc_faults_raised
+    plan = active_plan()
+    if plan is None or plan.rpc_fail_n <= 0 or not plan.targets_me():
+        return
+    with _lock:
+        if _rpc_faults_raised >= plan.rpc_fail_n:
+            return
+        _rpc_faults_raised += 1
+        n = _rpc_faults_raised
+    raise ConnectionError(
+        "chaos: injected rpc failure %d/%d (%s)" % (n, plan.rpc_fail_n, what)
+    )

@@ -399,3 +399,88 @@ def test_strict_merge_skips_after_completion():
     )
     merged = _dist._merge_trainer_grads(srv, "g", 2, strict=True, wait_s=0.5)
     np.testing.assert_allclose(merged, a)  # average over the 1 present copy
+
+
+# ---------------------------------------------------------------------------
+# HeartBeatMonitor unit tests (PR 4 satellite: direct coverage of the
+# watchdog against a fake liveness surface — the subprocess e2e above
+# only observes its log side effect)
+# ---------------------------------------------------------------------------
+class FakeLivenessServer(object):
+    """Stand-in for native.RpcServer's liveness surface: worker_idle_ms
+    returns per-trainer idle milliseconds (-1 = never seen), settable by
+    the test; can be armed to raise (the poll-failure path)."""
+
+    def __init__(self, idle):
+        self.idle = list(idle)
+        self.fail = False
+
+    def worker_idle_ms(self):
+        if self.fail:
+            raise RuntimeError("liveness poll exploded")
+        return list(self.idle)
+
+
+def _wait_until(cond, timeout=5.0):
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if cond():
+            return True
+        _time.sleep(0.01)
+    return False
+
+
+def test_heartbeat_monitor_flags_stale_and_recovers():
+    srv = FakeLivenessServer([0.0, 0.0])
+    mon = _dist.HeartBeatMonitor(
+        srv, n_trainers=2, threshold_s=0.05, interval_s=0.01
+    )
+    mon.start()
+    try:
+        # healthy: nothing flagged
+        assert not _wait_until(lambda: mon.lost, timeout=0.2)
+        # worker 1 goes stale past threshold_s -> flagged lost
+        srv.idle[1] = 200.0  # ms, > 50 ms threshold
+        assert _wait_until(lambda: 1 in mon.lost)
+        assert 0 not in mon.lost
+        # the trainer reappears (requests flow again) -> recovered
+        srv.idle[1] = 0.0
+        assert _wait_until(lambda: 1 not in mon.lost)
+    finally:
+        mon.stop()
+    assert not mon._thread.is_alive()  # stop() joins cleanly
+
+
+def test_heartbeat_monitor_ignores_never_seen_workers():
+    # -1 = worker never connected: must not be flagged as lost (it is
+    # still starting up, the serve-loop timeout owns that case)
+    srv = FakeLivenessServer([-1.0, 100000.0])
+    mon = _dist.HeartBeatMonitor(
+        srv, n_trainers=2, threshold_s=0.05, interval_s=0.01
+    )
+    mon.start()
+    try:
+        assert _wait_until(lambda: 1 in mon.lost)
+        assert 0 not in mon.lost
+    finally:
+        mon.stop()
+
+
+def test_heartbeat_monitor_stop_joins_after_poll_failure():
+    srv = FakeLivenessServer([0.0])
+    mon = _dist.HeartBeatMonitor(
+        srv, n_trainers=1, threshold_s=0.05, interval_s=0.01
+    )
+    mon.start()
+    srv.fail = True  # watchdog thread logs + exits on its own
+    assert _wait_until(lambda: not mon._thread.is_alive())
+    mon.stop()  # still clean after the thread self-terminated
+    assert not mon._thread.is_alive()
+
+
+def test_heartbeat_monitor_stop_before_start_is_safe():
+    mon = _dist.HeartBeatMonitor(
+        FakeLivenessServer([0.0]), n_trainers=1,
+        threshold_s=0.05, interval_s=0.01,
+    )
+    mon.stop()  # never started: no thread to join, no crash

@@ -1,0 +1,452 @@
+"""Elastic supervisor + chaos harness (distributed/supervisor.py,
+testing/chaos.py): heartbeat protocol, crash/hang detection, gang
+restart with backoff under a budget, RPC connect-retry, and the fast
+deterministic subset of tools/dist_crash_probe.py (ISSUE 4 acceptance:
+kill/hang trials converge to the uninterrupted digest, budget
+exhaustion exits non-zero with a structured report).
+
+The unit-level gangs here are tiny ``python -c`` scripts (no jax
+import), so detection/restart mechanics get exercised in milliseconds;
+the probe subprocess at the bottom is the full closed loop over real
+trainers."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import traceback
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import supervisor as sup_mod
+from paddle_tpu.distributed.supervisor import Supervisor, WorkerSpec
+from paddle_tpu.fluid import profiler
+from paddle_tpu.testing import FaultPlan, chaos
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PROBE = os.path.join(REPO, "tools", "dist_crash_probe.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    yield
+    chaos.clear()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat protocol
+# ---------------------------------------------------------------------------
+def test_heartbeat_roundtrip_and_throttle(tmp_path):
+    path = str(tmp_path / "hb.json")
+    hb = sup_mod.WorkerHeartbeat(path, interval_s=30.0)
+    assert hb.beat(0, status="start", force=True)
+    rec = sup_mod.read_heartbeat(path)
+    assert rec["step"] == 0 and rec["status"] == "start"
+    assert rec["pid"] == os.getpid() and "mtime" in rec
+    # a status transition always punches through the throttle
+    assert hb.beat(1)
+    rec = sup_mod.read_heartbeat(path)
+    assert rec["step"] == 1 and rec["status"] == "step"
+    assert not hb.beat(2)  # now throttled (same status, within interval)
+    assert sup_mod.read_heartbeat(path)["step"] == 1
+    assert hb.beat(3, force=True)  # force punches through
+    assert sup_mod.read_heartbeat(path)["step"] == 3
+
+
+def test_heartbeat_env_wiring(tmp_path, monkeypatch):
+    monkeypatch.delenv(sup_mod.HEARTBEAT_ENV, raising=False)
+    assert sup_mod.worker_heartbeat() is None
+    path = str(tmp_path / "hb.json")
+    monkeypatch.setenv(sup_mod.HEARTBEAT_ENV, path)
+    hb = sup_mod.worker_heartbeat()
+    assert hb is not None and hb.path == path
+
+
+def test_read_heartbeat_tolerates_torn_or_missing(tmp_path):
+    assert sup_mod.read_heartbeat(str(tmp_path / "nope.json")) is None
+    p = tmp_path / "torn.json"
+    p.write_text("{not json")
+    assert sup_mod.read_heartbeat(str(p)) is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor over trivial python -c gangs (no jax: milliseconds per test)
+# ---------------------------------------------------------------------------
+def _spec(code, workdir, rank):
+    return WorkerSpec(
+        [sys.executable, "-c", code],
+        log_path=os.path.join(str(workdir), "workerlog.%d" % rank),
+        rank=rank,
+    )
+
+
+def _events(workdir, kind=None):
+    evs = sup_mod.load_events(str(workdir))
+    return [e for e in evs if kind is None or e["event"] == kind]
+
+
+def test_supervisor_clean_gang_completes(tmp_path):
+    sup = Supervisor(
+        [_spec("print('w%d ok')" % r, tmp_path, r) for r in range(2)],
+        workdir=str(tmp_path), max_restarts=0, poll_s=0.02,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_used == 0
+    assert sup.alive_pids() == {}
+    assert _events(tmp_path, "gang_done")
+    exits = _events(tmp_path, "worker_exit")
+    assert sorted(e["rank"] for e in exits) == [0, 1]
+    # worker stdout landed in the per-rank log with the attempt banner
+    log0 = open(os.path.join(str(tmp_path), "workerlog.0")).read()
+    assert "attempt 0" in log0 and "w0 ok" in log0
+
+
+def test_supervisor_restarts_crashed_gang_and_recovers(tmp_path):
+    # worker 0 exits 3 on its first life and 0 once the marker exists —
+    # a crash the first attempt heals
+    code = (
+        "import os, sys\n"
+        "m = os.path.join(r'%s', 'attempt_marker')\n"
+        "if os.path.exists(m):\n"
+        "    sys.exit(0)\n"
+        "open(m, 'w').close()\n"
+        "sys.exit(3)\n" % str(tmp_path)
+    )
+    before = profiler.get_counter("dist_restarts")
+    sup = Supervisor(
+        [_spec(code, tmp_path, 0),
+         _spec("import time; time.sleep(0.1)", tmp_path, 1)],
+        workdir=str(tmp_path), max_restarts=2,
+        backoff_base_s=0.05, backoff_max_s=0.1, poll_s=0.02,
+        sigterm_grace_s=0.5,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_used == 1
+    assert profiler.get_counter("dist_restarts") == before + 1
+    crash = _events(tmp_path, "crash_detected")
+    assert crash and crash[0]["rank"] == 0 and crash[0]["returncode"] == 3
+    restart = _events(tmp_path, "restart")
+    assert restart and restart[0]["cause"]["kind"] == "crash"
+    assert _events(tmp_path, "gang_done")
+    assert sup.alive_pids() == {}
+
+
+def test_supervisor_budget_exhaustion_structured_report(tmp_path):
+    # always crashes: the budget must bound retries and the giveup
+    # report must carry the last failure
+    sup = Supervisor(
+        [_spec("import sys; sys.exit(7)", tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=1,
+        backoff_base_s=0.02, backoff_max_s=0.05, poll_s=0.02,
+    )
+    assert sup.run() == 1
+    assert sup.restarts_used == 1
+    rep = sup.failure_report
+    assert rep["max_restarts"] == 1 and rep["restarts_used"] == 1
+    assert rep["last_failure"]["kind"] == "crash"
+    assert rep["last_failure"]["returncode"] == 7
+    giveup = _events(tmp_path, "giveup")
+    assert giveup and giveup[-1]["last_failure"]["kind"] == "crash"
+
+
+def test_supervisor_hang_watchdog_kills_stale_worker(tmp_path):
+    # worker writes ONE step beat then goes silent forever: the
+    # watchdog must flag it and the teardown must reap it
+    code = (
+        "import json, os, time\n"
+        "p = os.environ['PADDLE_TPU_HEARTBEAT_FILE']\n"
+        "open(p, 'w').write(json.dumps({'pid': os.getpid(), 'step': 1,"
+        " 'status': 'step', 'time': time.time()}))\n"
+        "time.sleep(120)\n"
+    )
+    before = profiler.get_counter("dist_hang_kills")
+    sup = Supervisor(
+        [_spec(code, tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=0,
+        heartbeat_timeout_s=0.4, poll_s=0.05, sigterm_grace_s=0.3,
+    )
+    t0 = time.monotonic()
+    assert sup.run() == 1  # budget 0 -> giveup after the hang kill
+    assert time.monotonic() - t0 < 30.0
+    assert profiler.get_counter("dist_hang_kills") == before + 1
+    hang = _events(tmp_path, "hang_detected")
+    assert hang and hang[0]["rank"] == 0 and hang[0]["last_step"] == 1
+    assert sup.failure_report["last_failure"]["kind"] == "hang"
+    assert sup.alive_pids() == {}
+
+
+def test_supervisor_beatless_worker_is_not_killed(tmp_path):
+    # no heartbeat contract (script never beats) and no startup grace
+    # configured: silence must NOT be treated as a hang
+    sup = Supervisor(
+        [_spec("import time; time.sleep(0.6)", tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=0,
+        heartbeat_timeout_s=0.1, poll_s=0.02,
+    )
+    assert sup.run() == 0
+    assert not _events(tmp_path, "hang_detected")
+
+
+def test_supervisor_startup_grace_catches_pre_beat_hang(tmp_path):
+    # WITH an explicit startup grace, a worker that hangs before its
+    # first beat is caught too
+    sup = Supervisor(
+        [_spec("import time; time.sleep(120)", tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=0,
+        heartbeat_timeout_s=0.2, startup_grace_s=0.4,
+        poll_s=0.05, sigterm_grace_s=0.3,
+    )
+    t0 = time.monotonic()
+    assert sup.run() == 1
+    assert time.monotonic() - t0 < 30.0
+    assert _events(tmp_path, "hang_detected")
+
+
+def test_supervisor_start_status_hang_bounded_by_instrumented_grace(
+        tmp_path):
+    # a worker that proved it beats (status "start") and then hangs in
+    # restore/compile is caught by the FINITE instrumented grace even
+    # with no explicit startup_grace_s configured
+    code = (
+        "import json, os, time\n"
+        "p = os.environ['PADDLE_TPU_HEARTBEAT_FILE']\n"
+        "open(p, 'w').write(json.dumps({'pid': os.getpid(), 'step': -1,"
+        " 'status': 'start', 'time': time.time()}))\n"
+        "time.sleep(120)\n"
+    )
+    old = fluid.get_flags("FLAGS_dist_startup_grace_s")
+    try:
+        fluid.set_flags({"FLAGS_dist_startup_grace_s": 0.4})
+        sup = Supervisor(
+            [_spec(code, tmp_path, 0)], workdir=str(tmp_path),
+            max_restarts=0, heartbeat_timeout_s=0.1,
+            poll_s=0.05, sigterm_grace_s=0.3,
+        )
+        t0 = time.monotonic()
+        assert sup.run() == 1
+        assert time.monotonic() - t0 < 30.0
+        assert _events(tmp_path, "hang_detected")
+    finally:
+        fluid.set_flags(old)
+
+
+def test_supervisor_preemption_during_backoff_skips_respawn(tmp_path):
+    # SIGTERM landing in the restart-backoff sleep must exit 143 without
+    # spawning (and immediately killing) a fresh gang
+    sup = Supervisor(
+        [_spec("import sys; sys.exit(9)", tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=5,
+        backoff_base_s=5.0, backoff_max_s=5.0, poll_s=0.02,
+    )
+    killer = threading.Timer(
+        0.5, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    killer.start()
+    t0 = time.monotonic()
+    try:
+        rc = sup.run()
+    finally:
+        killer.cancel()
+    assert rc == 143
+    assert time.monotonic() - t0 < 3.0  # did not wait out the 5s backoff
+    assert len(_events(tmp_path, "gang_start")) == 1  # no respawn
+    assert _events(tmp_path, "preempted")
+
+
+def test_supervisor_sigterm_preemption_exits_143(tmp_path):
+    sup = Supervisor(
+        [_spec("import time; time.sleep(30)", tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=5, poll_s=0.05,
+        sigterm_grace_s=0.5,
+    )
+    killer = threading.Timer(
+        0.4, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    killer.start()
+    try:
+        rc = sup.run()
+    finally:
+        killer.cancel()
+    assert rc == 143
+    assert _events(tmp_path, "preempted")
+    assert not _events(tmp_path, "restart")  # preemption never retries
+    assert sup.alive_pids() == {}
+
+
+def test_supervisor_downtime_histogram_records_restart(tmp_path):
+    profiler.reset_histograms()
+    code = (
+        "import os, sys\n"
+        "m = os.path.join(r'%s', 'm2')\n"
+        "sys.exit(0) if os.path.exists(m) else"
+        " (open(m, 'w').close(), sys.exit(5))\n" % str(tmp_path)
+    )
+    sup = Supervisor(
+        [_spec(code, tmp_path, 0)], workdir=str(tmp_path),
+        max_restarts=1, backoff_base_s=0.05, backoff_max_s=0.05,
+        poll_s=0.02,
+    )
+    assert sup.run() == 0
+    samples = profiler.get_histogram("dist_downtime_ms")
+    assert len(samples) == 1
+    # downtime covers teardown + backoff; the jittered backoff floor is
+    # 0.5 * base
+    assert samples[0] >= 0.5 * 0.05 * 1000.0 * 0.9
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+def test_chaos_flag_plan_resolution():
+    assert chaos.active_plan() is None  # disarmed by default
+    old = fluid.get_flags([
+        "FLAGS_chaos_hang_at_step", "FLAGS_chaos_target_rank",
+    ])
+    try:
+        fluid.set_flags({
+            "FLAGS_chaos_hang_at_step": 9, "FLAGS_chaos_target_rank": 3,
+        })
+        plan = chaos.active_plan()
+        assert plan.hang_at_step == 9
+        assert plan.target_rank == 3 and not plan.targets_me()
+    finally:
+        fluid.set_flags(old)
+    assert chaos.active_plan() is None
+
+
+def test_chaos_installed_plan_overrides_and_clears():
+    p = chaos.install(FaultPlan(slow_feed_ms=1.0))
+    assert chaos.active_plan() is p
+    chaos.clear()
+    assert chaos.active_plan() is None
+
+
+def test_chaos_corrupt_ckpt_bytes_flips_one_byte_once(tmp_path):
+    chaos.install(FaultPlan(corrupt_ckpt=True,
+                            marker_dir=str(tmp_path / "markers")))
+    blob = b"\x00\x01\x02\x03"
+    out1 = chaos.corrupt_ckpt_bytes(blob)
+    assert len(out1) == len(blob) and out1 != blob
+    assert out1[:-1] == blob[:-1] and out1[-1] == blob[-1] ^ 0xFF
+    # one-shot via the marker: the second call passes bytes through
+    assert chaos.corrupt_ckpt_bytes(blob) == blob
+
+
+def test_chaos_slow_feed_delays_producer():
+    chaos.install(FaultPlan(slow_feed_ms=25.0))
+    from paddle_tpu.fluid import io_pipeline
+
+    batches = [{"a": np.zeros((2,), "float32")} for _ in range(3)]
+    t0 = time.monotonic()
+    out = list(io_pipeline.DeviceFeeder(iter(batches), place=None))
+    assert len(out) == 3
+    assert time.monotonic() - t0 >= 0.06  # ~3 x 25ms of injected stall
+
+
+# NOTE: env-armed crash/hang (FLAGS_chaos_* -> SIGKILL / stall in a real
+# worker) is covered end-to-end by test_dist_crash_probe_fast below — a
+# dedicated subprocess test would re-pay a full framework import for a
+# path the probe already proves.
+
+
+# ---------------------------------------------------------------------------
+# pserver RPC connect-retry (satellite: ops/distributed_ops.py)
+# ---------------------------------------------------------------------------
+def test_rpc_conn_retry_heals_transient_failures():
+    from paddle_tpu.fluid.ops import distributed_ops as dist_ops
+
+    chaos.install(FaultPlan(rpc_fail_n=2))
+    before = profiler.get_counter("pserver_rpc_conn_retries")
+    calls = []
+    out = dist_ops._with_conn_retry("unit", lambda: calls.append(1) or 42)
+    assert out == 42 and len(calls) == 1
+    assert profiler.get_counter("pserver_rpc_conn_retries") == before + 2
+
+
+def test_rpc_conn_retry_budget_exhausts_and_raises():
+    from paddle_tpu.fluid.ops import distributed_ops as dist_ops
+
+    old = fluid.get_flags("FLAGS_pserver_rpc_retries")
+    try:
+        fluid.set_flags({"FLAGS_pserver_rpc_retries": 2})
+        chaos.install(FaultPlan(rpc_fail_n=100))
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="injected rpc failure"):
+            dist_ops._with_conn_retry("unit", lambda: 1)
+        # 2 retries at <= ~0.1s backoff each: promptly, not the full
+        # 180s rpc_deadline budget
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        fluid.set_flags(old)
+
+
+def test_rpc_conn_retry_real_failures_without_chaos():
+    from paddle_tpu.fluid.ops import distributed_ops as dist_ops
+
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionError("refused (pserver restarting)")
+        return "connected"
+
+    assert dist_ops._with_conn_retry("unit", flaky) == "connected"
+    assert state["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeeder worker-death propagation (satellite; see also
+# tests/test_io_pipeline.py for the loader-level variant)
+# ---------------------------------------------------------------------------
+def test_feeder_death_surfaces_original_traceback_not_hang():
+    from paddle_tpu.fluid import io_pipeline
+
+    def dying_reader():
+        yield {"a": np.zeros((2,), "float32")}
+        yield {"a": np.ones((2,), "float32")}
+        raise RuntimeError("reader thread died mid-stream")
+
+    pipe = io_pipeline.DeviceFeeder(dying_reader(), place=fluid.CPUPlace())
+    it = iter(pipe)
+    next(it)
+    next(it)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="died mid-stream") as ei:
+        next(it)
+    assert time.monotonic() - t0 < 10.0, "consumer hung on worker death"
+    tb = "".join(traceback.format_exception(ei.type, ei.value, ei.tb))
+    assert "dying_reader" in tb, (
+        "original producer traceback was lost:\n%s" % tb
+    )
+
+
+# ---------------------------------------------------------------------------
+# the closed loop (ISSUE 4 acceptance): fast deterministic probe subset
+# ---------------------------------------------------------------------------
+def test_dist_crash_probe_fast(tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, PROBE, "--fast", "--workdir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, out
+    assert "PROBE PASS" in p.stdout, out
+    assert "budget exhaustion OK" in p.stdout, out
+    # the REPORT line carries MTTR for PERF.md
+    report = next(
+        json.loads(ln[len("REPORT "):])
+        for ln in p.stdout.splitlines() if ln.startswith("REPORT ")
+    )
+    assert report["trials_kill"] == 2 and report["trials_hang"] == 2
+    assert report["restarts"] >= 4  # every trial restarted at least once
+    assert report["mttr_ms"]["mean"] > 0

@@ -178,6 +178,49 @@ def test_loader_producer_error_propagates_through_pipeline():
     assert _wait_no_feeders()
 
 
+def test_worker_death_surfaces_original_exception_not_hang():
+    """PR 4 satellite: a reader thread that raises mid-stream must
+    surface the ORIGINAL exception (with its producer-side traceback) at
+    the consumer's next(), promptly — never strand the consumer on the
+    double-buffer queue."""
+    import traceback
+
+    def dying_reader():
+        yield {"a": np.zeros((2, 2), "float32")}
+        yield {"a": np.ones((2, 2), "float32")}
+        raise ValueError("decode worker died mid-stream")
+
+    pipe = io_pipeline.DeviceFeeder(dying_reader(), place=fluid.CPUPlace())
+    it = iter(pipe)
+    next(it)
+    next(it)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="died mid-stream") as ei:
+        next(it)
+    assert time.monotonic() - t0 < 10.0, "consumer hung on worker death"
+    tb = "".join(traceback.format_exception(ei.type, ei.value, ei.tb))
+    assert "dying_reader" in tb, (
+        "producer traceback lost in propagation:\n%s" % tb
+    )
+    assert _wait_no_feeders()
+
+    # and through the DataLoader double-buffer stack: same contract,
+    # bounded time, original exception type
+    def bad_gen():
+        yield (np.ones((2, 4), "float32"),)
+        raise ValueError("loader reader died")
+
+    loader = _make_loader([], places=[fluid.CPUPlace()])
+    loader.set_batch_generator(bad_gen, places=[fluid.CPUPlace()])
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="loader reader died") as ei2:
+        list(loader)
+    assert time.monotonic() - t0 < 10.0
+    tb2 = "".join(traceback.format_exception(ei2.type, ei2.value, ei2.tb))
+    assert "bad_gen" in tb2, tb2
+    assert _wait_no_feeders()
+
+
 # ---------------------------------------------------------------------------
 # executor integration: fast lane + dispatch-plan cache
 # ---------------------------------------------------------------------------

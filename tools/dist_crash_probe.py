@@ -1,0 +1,396 @@
+"""Closed-loop crash/hang/restart probe for the elastic supervisor.
+
+Proves the acceptance properties of distributed/supervisor.py on a real
+OS-process boundary, with a 2-process gang of deterministic trainers
+(the ckpt_crash_probe workload, one checkpoint dir per rank):
+
+  1. **Recovery** — a worker SIGKILLed at a random moment, or hung
+     mid-step (deterministic chaos injection), is detected (exit poll /
+     heartbeat watchdog), the WHOLE gang is torn down (SIGTERM grace ->
+     SIGKILL) and restarted, and every rank resumes through
+     ``CheckpointManager.restore_or_initialize`` to finish with params
+     byte-identical to an uninterrupted run. No trial may strand a gang
+     (every spawned pid is dead when the supervisor returns).
+  2. **Bounded retry** — a fault that re-fires every attempt exhausts
+     ``max_restarts`` and exits non-zero with a structured ``giveup``
+     failure report instead of looping forever.
+  3. **Observability** — MTTR (failure detection -> next gang start)
+     is measured from the structured supervisor.log events and the
+     ``dist_downtime_ms`` histogram, and reported for PERF.md.
+
+Modes::
+
+    # full probe: N trials of random-moment SIGKILL + N injected hangs
+    python tools/dist_crash_probe.py --trials 5
+
+    # fast deterministic subset (tier-1 via tests/test_dist_supervisor.py):
+    # 2 fixed-step kill trials + 2 fixed-step hang trials + the
+    # restart-budget-exhaustion check
+    python tools/dist_crash_probe.py --fast
+
+The worker is this same file with ``--worker`` (rank from
+PADDLE_TRAINER_ID): the ckpt_crash_probe MLP trained through
+``fluid.trainer.MultiTrainer`` — which also exercises the real
+heartbeat hook and the SIGTERM step-boundary final save."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+for _p in (REPO, TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+STEPS = 9
+INTERVAL = 3
+
+
+# -- worker ------------------------------------------------------------------
+
+def run_worker(args):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import checkpoint
+    from paddle_tpu.fluid.trainer import MultiTrainer
+
+    from ckpt_crash_probe import _build, _StepDataset, _params_digest
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    fluid.set_flags({"FLAGS_ckpt_save_interval_steps": args.interval})
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    mgr = checkpoint.CheckpointManager(
+        os.path.join(args.dir, "rank_%d" % rank), keep_max=3
+    )
+    resumed = mgr.latest_step()
+    print("RESUMED %s" % ("FRESH" if resumed is None else resumed), flush=True)
+    dataset = _StepDataset(
+        [main.global_block().var("x"), main.global_block().var("y")],
+        args.steps,
+    )
+    # MultiTrainer wires everything under test: restore_or_initialize,
+    # heartbeat beats per step, interval saves, chaos step faults, and
+    # the SIGTERM step-boundary final save
+    trained = MultiTrainer().train(
+        exe, main, dataset, fetch_list=[loss], print_period=0,
+        ckpt_manager=mgr, startup_program=startup,
+    )
+    if trained < args.steps or checkpoint.preemption_requested():
+        mgr.close()
+        print("PREEMPTED %d" % trained, flush=True)
+        return 143
+    mgr.save(args.steps - 1, main, async_=False)
+    mgr.close()
+    digest = _params_digest(main, fluid.global_scope())
+    path = os.path.join(args.dir, "digest_%d.txt" % rank)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        f.write(digest)
+    os.replace(tmp, path)
+    print("FINAL %s" % digest, flush=True)
+    return 0
+
+
+# -- driver ------------------------------------------------------------------
+
+def _worker_cmd(dirname, steps, interval):
+    return [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--dir", dirname, "--steps", str(steps),
+        "--interval", str(interval),
+    ]
+
+
+def _gang(trial_dir, args, chaos_env=None, max_restarts=2,
+          hb_timeout_s=30.0, interval=None, grace_s=1.0, nranks=None):
+    """Build a supervised gang (default 2 ranks) rooted at trial_dir.
+    Returns the Supervisor (not yet run)."""
+    from paddle_tpu.distributed.supervisor import Supervisor, WorkerSpec
+
+    os.makedirs(trial_dir, exist_ok=True)
+    nranks = args.nranks if nranks is None else nranks
+    specs = []
+    for r in range(nranks):
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",  # single-device CPU per worker
+            "PADDLE_TRAINER_ID": str(r),
+            "PADDLE_TRAINERS_NUM": str(nranks),
+        }
+        env.update(chaos_env or {})
+        specs.append(WorkerSpec(
+            _worker_cmd(
+                trial_dir, args.steps,
+                args.interval if interval is None else interval,
+            ),
+            env=env,
+            log_path=os.path.join(trial_dir, "workerlog.%d" % r),
+            rank=r,
+        ))
+    return Supervisor(
+        specs, workdir=trial_dir, max_restarts=max_restarts,
+        heartbeat_timeout_s=hb_timeout_s,
+        startup_grace_s=args.startup_grace_s,
+        backoff_base_s=0.1, backoff_max_s=0.5,
+        sigterm_grace_s=grace_s, poll_s=0.05,
+    )
+
+
+def _chaos_env(kind, victim, step, trial_dir, one_shot=True):
+    env = {
+        "FLAGS_chaos_%s" % kind: str(step),
+        "FLAGS_chaos_target_rank": str(victim),
+    }
+    if one_shot:
+        env["FLAGS_chaos_marker_dir"] = os.path.join(trial_dir, "markers")
+    return env
+
+
+def _check_trial(trial_dir, args, sup, ref, expect_restart=True):
+    """Post-trial invariants: no stranded workers, every committed
+    checkpoint verifies, both ranks' digests match the reference."""
+    from paddle_tpu.distributed import supervisor as _sup
+
+    from ckpt_crash_probe import _validate_dir
+
+    assert sup.alive_pids() == {}, "stranded gang: %s" % sup.alive_pids()
+    if expect_restart:
+        assert sup.restarts_used >= 1, (
+            "fault never triggered a restart (events: %s)"
+            % _sup.load_events(trial_dir)
+        )
+    for r in range(args.nranks):
+        _validate_dir(os.path.join(trial_dir, "rank_%d" % r))
+        dpath = os.path.join(trial_dir, "digest_%d.txt" % r)
+        assert os.path.isfile(dpath), "rank %d wrote no digest" % r
+        with open(dpath) as f:
+            digest = f.read().strip()
+        assert digest == ref, (
+            "rank %d diverged from the uninterrupted run\n  ref   %s\n"
+            "  trial %s" % (r, ref, digest)
+        )
+
+
+def _mttr(trial_dirs):
+    """[(detect_ts, next gang_start_ts)] deltas in ms across trials."""
+    from paddle_tpu.distributed.supervisor import load_events
+
+    downtimes = []
+    for d in trial_dirs:
+        detect_ts = None
+        for e in load_events(d):
+            if e["event"] in ("crash_detected", "hang_detected"):
+                detect_ts = e["ts"]
+            elif e["event"] == "gang_start" and detect_ts is not None:
+                downtimes.append((e["ts"] - detect_ts) * 1000.0)
+                detect_ts = None
+    return downtimes
+
+
+def _reference_digest(tmp, args):
+    """Uninterrupted single-worker run -> param digest (both ranks train
+    identical replicas of the same deterministic stream, so one
+    reference covers the gang)."""
+    import subprocess
+
+    d = os.path.join(tmp, "ref")
+    os.makedirs(d, exist_ok=True)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "", "PADDLE_TRAINER_ID": "0",
+    })
+    env.pop("PADDLE_TPU_HEARTBEAT_FILE", None)
+    p = subprocess.run(
+        _worker_cmd(d, args.steps, args.interval), env=env,
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert p.returncode == 0, "reference run failed:\n%s%s" % (
+        p.stdout, p.stderr
+    )
+    with open(os.path.join(d, "digest_0.txt")) as f:
+        return f.read().strip()
+
+
+def _kill_randomly(sup, rng, delay_range, kills):
+    """Probe killer thread: SIGKILL one random alive worker after a
+    random delay (the supervisor must see it and heal the gang)."""
+
+    def _run():
+        deadline = time.monotonic() + 60.0
+        while not sup.alive_pids():
+            if time.monotonic() > deadline:
+                return
+            time.sleep(0.05)
+        time.sleep(rng.uniform(*delay_range))
+        pids = sup.alive_pids()
+        if not pids:
+            return  # gang already finished: the kill missed
+        rank = rng.choice(sorted(pids))
+        try:
+            os.kill(pids[rank], signal.SIGKILL)
+            kills.append(rank)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return t
+
+
+def _budget_exhaustion_check(tmp, args):
+    """A fault that re-fires every attempt (no one-shot marker, no
+    checkpoints to make progress behind) must exhaust max_restarts and
+    exit non-zero with a structured failure report."""
+    d = os.path.join(tmp, "budget")
+    chaos = _chaos_env("crash_at_step", victim=0, step=2, trial_dir=d,
+                       one_shot=False)
+    # 1-rank gang: budget accounting is rank-count independent, and the
+    # check stays cheap enough for the tier-1 wiring
+    sup = _gang(d, args, chaos_env=chaos, max_restarts=1, interval=0,
+                nranks=1)
+    rc = sup.run()
+    assert rc != 0, "budget exhaustion must exit non-zero"
+    assert sup.alive_pids() == {}, "giveup stranded the gang"
+    report = sup.failure_report
+    assert report is not None, "no structured failure report"
+    assert report["restarts_used"] == 1
+    assert report["last_failure"]["kind"] == "crash"
+    from paddle_tpu.distributed.supervisor import load_events
+
+    giveups = [e for e in load_events(d) if e["event"] == "giveup"]
+    assert giveups and giveups[-1]["max_restarts"] == 1
+    print("budget exhaustion OK: rc=%d report=%s" % (rc, report),
+          flush=True)
+
+
+def run_probe(args):
+    import tempfile
+
+    tmp = args.workdir or tempfile.mkdtemp(prefix="dist_crash_probe_")
+    rng = random.Random(args.seed)
+    t0 = time.time()
+    ref = _reference_digest(tmp, args)
+    ref_s = time.time() - t0
+    print("reference digest %s (%.1fs)" % (ref[:16], ref_s), flush=True)
+    kill_window = (0.5, max(2.0, ref_s * 0.9))
+
+    trial_dirs = []
+    kills = hangs = 0
+    for trial in range(args.trials):
+        # -- SIGKILL trial --
+        d = os.path.join(tmp, "kill_%02d" % trial)
+        trial_dirs.append(d)
+        if args.fast:
+            # deterministic "random moment": fixed victim + step via chaos
+            step = [args.steps // 3, (2 * args.steps) // 3][trial % 2]
+            sup = _gang(d, args, chaos_env=_chaos_env(
+                "crash_at_step", victim=trial % args.nranks, step=step,
+                trial_dir=d,
+            ))
+            rc = sup.run()
+        else:
+            while True:
+                sup = _gang(d, args)
+                got = []
+                _kill_randomly(sup, rng, kill_window, got)
+                rc = sup.run()
+                if got or sup.restarts_used:
+                    break  # a kill landed (or something else killed one)
+                # gang beat the timer: clean dir and retry with a kill
+                # window biased early so it MUST land
+                import shutil
+
+                shutil.rmtree(d, ignore_errors=True)
+                kill_window = (0.5, max(2.0, kill_window[1] * 0.6))
+        assert rc == 0, "kill trial %d: supervisor rc %d" % (trial, rc)
+        _check_trial(d, args, sup, ref)
+        kills += 1
+        print("kill trial %d OK (restarts=%d)" % (trial, sup.restarts_used),
+              flush=True)
+
+        # -- hang trial --
+        d = os.path.join(tmp, "hang_%02d" % trial)
+        trial_dirs.append(d)
+        if args.fast:
+            victim = (trial + 1) % args.nranks
+            step = [args.steps // 3, (2 * args.steps) // 3][trial % 2]
+        else:
+            victim = rng.randrange(args.nranks)
+            step = rng.randrange(args.interval, args.steps - 1)
+        sup = _gang(
+            d, args,
+            chaos_env=_chaos_env("hang_at_step", victim, step, d),
+            hb_timeout_s=args.hang_timeout_s,
+        )
+        rc = sup.run()
+        assert rc == 0, "hang trial %d: supervisor rc %d" % (trial, rc)
+        _check_trial(d, args, sup, ref)
+        hangs += 1
+        print("hang trial %d OK (restarts=%d)" % (trial, sup.restarts_used),
+              flush=True)
+
+    _budget_exhaustion_check(tmp, args)
+
+    from paddle_tpu.fluid import profiler
+
+    downtimes = _mttr(trial_dirs)
+    report = {
+        "trials_kill": kills,
+        "trials_hang": hangs,
+        "restarts": len(downtimes),
+        "mttr_ms": {
+            "mean": sum(downtimes) / len(downtimes) if downtimes else 0.0,
+            "max": max(downtimes) if downtimes else 0.0,
+            "min": min(downtimes) if downtimes else 0.0,
+        },
+        "dist_downtime_ms": profiler.summarize_histogram("dist_downtime_ms"),
+        "dist_restarts": profiler.get_counter("dist_restarts"),
+        "dist_hang_kills": profiler.get_counter("dist_hang_kills"),
+        "wall_s": time.time() - t0,
+    }
+    print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
+    print(
+        "PROBE PASS: %d kill + %d hang trials, %d gang restarts, 0 "
+        "stranded gangs, all resumed digests == reference; MTTR mean "
+        "%.0f ms / max %.0f ms (%.1fs)"
+        % (kills, hangs, report["restarts"], report["mttr_ms"]["mean"],
+           report["mttr_ms"]["max"], report["wall_s"])
+    )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--dir", type=str, default=None)
+    ap.add_argument("--steps", type=int, default=STEPS)
+    ap.add_argument("--interval", type=int, default=INTERVAL)
+    ap.add_argument("--nranks", type=int, default=2)
+    ap.add_argument("--trials", type=int, default=5,
+                    help="kill trials + hang trials per unit")
+    ap.add_argument("--fast", action="store_true",
+                    help="deterministic 2+2-trial subset for tier-1")
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--hang_timeout_s", type=float, default=2.0,
+                    help="heartbeat watchdog threshold for hang trials")
+    ap.add_argument("--startup_grace_s", type=float, default=120.0)
+    ap.add_argument("--workdir", type=str, default=None)
+    args = ap.parse_args(argv)
+    if args.worker:
+        assert args.dir, "--worker needs --dir"
+        return run_worker(args)
+    if args.fast:
+        args.trials = 2
+    return run_probe(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
