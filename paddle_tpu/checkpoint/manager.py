@@ -201,13 +201,17 @@ class CheckpointManager(object):
         returns after the device->host snapshot; ``wait()`` barriers."""
         from ..fluid import profiler as _profiler
         from ..fluid.framework import default_main_program
+        from ..observability import trace as _trace
 
         self._raise_pending()
         if self._closed:
             raise CheckpointError("save() on a closed CheckpointManager")
         program = program or default_main_program()
         t0 = time.perf_counter()
-        snap = self._snapshot(program, scope)
+        # the D2H snapshot is the only critical-path work of an async
+        # save — its span sits on the caller's (step loop's) thread row
+        with _trace.span("ckpt_snapshot", cat="ckpt", step=int(step)):
+            snap = self._snapshot(program, scope)
         _profiler.bump_histogram(
             "ckpt_snapshot_ms", (time.perf_counter() - t0) * 1000.0
         )
@@ -429,6 +433,14 @@ class CheckpointManager(object):
                 self._queue.task_done()
 
     def _write_checkpoint(self, step, snap):
+        from ..observability import trace as _trace
+
+        # serialize + fsync + commit, on the writer thread's trace row
+        # (or the caller's for a sync save)
+        with _trace.span("ckpt_write", cat="ckpt", step=int(step)):
+            self._write_checkpoint_traced(step, snap)
+
+    def _write_checkpoint_traced(self, step, snap):
         from ..fluid import profiler as _profiler
 
         t0 = time.perf_counter()

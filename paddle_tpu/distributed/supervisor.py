@@ -25,10 +25,15 @@ and the job dies without retry. The supervisor closes the loop:
   itself is stateless about training progress.
 - **Observability**: structured JSONL events in ``supervisor.log``
   (gang_start / worker_exit / crash_detected / hang_detected /
-  gang_teardown / restart / gang_done / giveup / preempted) plus
-  always-on profiler counters ``dist_restarts`` / ``dist_hang_kills``
-  and the ``dist_downtime_ms`` histogram (failure detection -> next gang
-  start; MTTR for ``tools/dist_crash_probe.py``).
+  gang_teardown / restart / gang_done / giveup / preempted; each
+  carries ``schema_version``, wall-clock ``ts`` and monotonic
+  ``ts_mono``) plus always-on profiler counters ``dist_restarts`` /
+  ``dist_hang_kills`` and the ``dist_downtime_ms`` histogram (failure
+  detection -> next gang start; MTTR for ``tools/dist_crash_probe.py``).
+  The supervisor also injects ``FLAGS_obs_dir`` into every worker so
+  each rank leaves JSONL telemetry snapshots, and merges them with this
+  log into ``workdir/gang_report.json`` on every restart and on exit
+  (``observability/aggregate.py``).
 """
 
 from __future__ import annotations
@@ -56,6 +61,10 @@ __all__ = [
 HEARTBEAT_ENV = "PADDLE_TPU_HEARTBEAT_FILE"
 RESTART_ENV = "PADDLE_TPU_RESTART_NUM"
 SUPERVISOR_LOG = "supervisor.log"
+# JSONL event schema: 1 added schema_version itself plus ts_mono (the
+# monotonic-clock twin of the wall-clock ts — downtime/MTTR math must
+# survive an NTP step; ts stays for humans and cross-host correlation)
+LOG_SCHEMA_VERSION = 1
 
 
 # ---------------------------------------------------------------------------
@@ -162,7 +171,9 @@ class _Log(object):
     def event(self, event, **fields):
         rec = dict(fields)
         rec["event"] = event
-        rec["ts"] = time.time()
+        rec["schema_version"] = LOG_SCHEMA_VERSION
+        rec["ts"] = time.time()  # wall clock, for humans
+        rec["ts_mono"] = time.monotonic()  # for interval math
         line = json.dumps(rec, sort_keys=True)
         with self._lock:
             with open(self.path, "a") as f:
@@ -260,6 +271,14 @@ class Supervisor(object):
         os.makedirs(self.workdir, exist_ok=True)
         self._hb_dir = os.path.join(self.workdir, "heartbeats")
         os.makedirs(self._hb_dir, exist_ok=True)
+        # per-rank telemetry snapshots land here (FLAGS_obs_dir injected
+        # into every worker env below); aggregate.py merges them + this
+        # log into workdir/gang_report.json. _obs_dir is the injected
+        # DEFAULT; the merge reads the EFFECTIVE dir (_spawn records it,
+        # because an operator's explicit FLAGS_obs_dir wins the
+        # setdefault and the snapshots land there instead)
+        self._obs_dir = os.path.join(self.workdir, "obs")
+        self._obs_dir_effective = self._obs_dir
         self.log = _Log(
             os.path.join(self.workdir, SUPERVISOR_LOG), echo=echo_events
         )
@@ -334,6 +353,10 @@ class Supervisor(object):
                     "restart", restart=self.restarts_used, backoff_s=delay,
                     cause=dict(detail, kind=outcome),
                 )
+                # merged telemetry checkpoint at every restart: an
+                # operator watching a flapping gang reads the report
+                # without waiting for the run to end
+                self._write_gang_report()
                 # interruptible backoff: a SIGTERM preemption landing
                 # here must not wait out the sleep and then spawn (and
                 # immediately kill) a whole fresh gang
@@ -350,6 +373,9 @@ class Supervisor(object):
             self._teardown(
                 "supervisor_exit", self.sigterm_grace_s, quiet=True
             )
+            # final merged gang report — after teardown, so every
+            # worker's exit-time snapshot file is already on disk
+            self._write_gang_report()
             self._restore_sigterm(prev)
             for f in self._log_files:
                 try:
@@ -359,6 +385,19 @@ class Supervisor(object):
             self._log_files = []
 
     # -- internals ---------------------------------------------------------
+
+    def _write_gang_report(self):
+        """Best-effort workdir/gang_report.json (observability
+        aggregate): telemetry merge failures must never take down the
+        supervision loop itself."""
+        try:
+            from ..observability import aggregate as _aggregate
+
+            _aggregate.write_gang_report(
+                self.workdir, obs_dir=self._obs_dir_effective
+            )
+        except Exception:
+            pass
 
     def _install_sigterm(self):
         if threading.current_thread() is not threading.main_thread():
@@ -415,6 +454,13 @@ class Supervisor(object):
             env.update(spec.env)
             env[HEARTBEAT_ENV] = self._hb_path(i)
             env[RESTART_ENV] = str(attempt)
+            # flags are env-bridged, so this arms per-rank snapshot files
+            # in every worker; an operator's explicit FLAGS_obs_dir
+            # (spec.env or the supervisor's own environment) wins
+            env.setdefault("FLAGS_obs_dir", self._obs_dir)
+            if i == 0:
+                # merge wherever the snapshots actually land
+                self._obs_dir_effective = env["FLAGS_obs_dir"]
             stdout = stderr = None
             if spec.log_path:
                 d = os.path.dirname(spec.log_path)

@@ -26,6 +26,7 @@ import numpy as np
 
 from . import core
 from . import profiler as _profiler
+from ..observability import trace as _obs_trace
 from .framework import Program, Variable, default_main_program
 from .io_pipeline import DeviceFeedBatch
 from .ops import registry as _registry
@@ -1192,7 +1193,10 @@ class Executor(object):
                     self._plans.popitem(last=False)
 
         rng_key = self._next_rng(program, scope)
-        outs = compiled.run(scope, feed, rng_key, self.place)
+        # the step-loop span: one per run(), nesting under the trainer's
+        # train_step span and over any RecordEvents ops open inside
+        with _obs_trace.span("executor_run", cat="exec"):
+            outs = compiled.run(scope, feed, rng_key, self.place)
         outs = [None if o is None else _fetch_to_host(o) for o in outs]
         if return_numpy:
             return [None if o is None else np.asarray(o) for o in outs]

@@ -107,6 +107,22 @@ _DEFAULTS = {
     "chaos_rpc_fail_n": 0,
     "chaos_target_rank": -1,
     "chaos_marker_dir": "",
+    # observability (paddle_tpu/observability): one telemetry spine over
+    # tracing + metrics. obs_trace gates the span tracer (on by default —
+    # bounded ring buffer, ~µs per span, measured <2% of the step path by
+    # tools/obs_probe.py); obs_trace_buffer bounds retained spans.
+    # obs_http_port exposes /metrics /healthz /trace over stdlib HTTP:
+    # -1 disabled, 0 ephemeral, >0 binds that port or walks up to
+    # obs_http_port_retries successors when taken. obs_dir turns on
+    # per-rank JSONL metric snapshots (the gang supervisor injects it so
+    # it can merge a cross-rank report); obs_snapshot_interval_s paces
+    # periodic snapshots (0 = one final snapshot only).
+    "obs_trace": True,
+    "obs_trace_buffer": 65536,
+    "obs_http_port": -1,
+    "obs_http_port_retries": 8,
+    "obs_dir": "",
+    "obs_snapshot_interval_s": 0.0,
     # profiling / graphs
     "print_sub_graph_dir": "",
     "pe_profile_fname": "",

@@ -30,6 +30,7 @@ import numpy as np
 from . import core
 from . import flags as _flags
 from . import profiler as _profiler
+from ..observability import trace as _obs_trace
 
 __all__ = ["DeviceFeedBatch", "DeviceFeeder", "buffer_size"]
 
@@ -159,11 +160,17 @@ class DeviceFeeder(object):
             for batch in self._source:
                 if self._stop.is_set():
                     break
-                # fault-injection point: chaos slow_feed_ms models a
-                # degraded input host on the producer thread (no-op when
-                # disarmed), so feed-stall behavior is testable
-                _chaos.maybe_slow_feed()
-                if not self._put(self._stage(batch)):
+                # the feed-path span covers chaos delay + staging so a
+                # degraded input host is visible on the producer thread's
+                # trace row (overlap vs the consumer's executor_run row
+                # is exactly what the timeline exists to show)
+                with _obs_trace.span("feed_stage", cat="feed"):
+                    # fault-injection point: chaos slow_feed_ms models a
+                    # degraded input host on the producer thread (no-op
+                    # when disarmed), so feed-stall behavior is testable
+                    _chaos.maybe_slow_feed()
+                    staged = self._stage(batch)
+                if not self._put(staged):
                     break
         except BaseException as e:  # surfaced at the consumer's next pull
             self._error.append(e)

@@ -62,6 +62,7 @@ def _with_conn_retry(what, fn):
 
     from .. import flags as _flags
     from .. import profiler as _profiler
+    from ...observability import trace as _trace
     from ...testing import chaos as _chaos
 
     retries = max(int(_flags.get_flag("pserver_rpc_retries", 5)), 0)
@@ -69,19 +70,24 @@ def _with_conn_retry(what, fn):
     deadline = time.monotonic() + budget_s
     delay_s = 0.05
     attempt = 0
-    while True:
-        try:
-            _chaos.maybe_rpc_error(what)
-            return fn()
-        except ConnectionError:
-            attempt += 1
-            remaining = deadline - time.monotonic()
-            if attempt > retries or remaining <= 0:
-                raise
-            _profiler.bump_counter("pserver_rpc_conn_retries")
-            sleep_s = min(delay_s, 2.0, max(remaining, 0.0))
-            time.sleep(sleep_s * (0.5 + 0.5 * _random.random()))
-            delay_s = min(delay_s * 2.0, 2.0)
+    # one span over the WHOLE retry loop (name is the op kind only —
+    # bounded cardinality; the full what string rides args): backoff
+    # sleeps show up as rpc time, which is what the step timeline should
+    # attribute them to
+    with _trace.span("rpc_" + what.split("(", 1)[0], cat="rpc", what=what):
+        while True:
+            try:
+                _chaos.maybe_rpc_error(what)
+                return fn()
+            except ConnectionError:
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if attempt > retries or remaining <= 0:
+                    raise
+                _profiler.bump_counter("pserver_rpc_conn_retries")
+                sleep_s = min(delay_s, 2.0, max(remaining, 0.0))
+                time.sleep(sleep_s * (0.5 + 0.5 * _random.random()))
+                delay_s = min(delay_s * 2.0, 2.0)
 
 
 def get_client(endpoint, trainer_id):
@@ -130,6 +136,7 @@ def _scope_value(ctx, name):
 
 def _send_lower(ctx, op_):
     from .. import core as _core
+    from ...observability import trace as _trace
 
     eps = op_.attr("endpoints") or op_.attr("epmap") or []
     tid = int(op_.attr("trainer_id", 0))
@@ -161,30 +168,34 @@ def _send_lower(ctx, op_):
             # row-sharded sparse send (reference parameter_send.cc sliced
             # SelectedRows path): pserver k gets rows with id % n == k,
             # re-indexed to the shard-local id // n
-            rows = np.asarray(v.rows, np.int64)
-            vals = np.asarray(v.value)
-            n_eps = len(eps)
-            for k, ep in enumerate(eps):
-                sel = np.nonzero(rows % n_eps == k)[0]
-                shard = _core.SelectedRows(
-                    rows=list(rows[sel] // n_eps),
-                    height=(v.height + n_eps - 1 - k) // n_eps,
-                    value=vals[sel],
-                )
-                # MUTATING sends are deliberately NOT wrapped in
-                # _with_conn_retry: re-invoking send_var draws a fresh
-                # seq, which the server cannot dedup — an ambiguous
-                # failure (grad applied, response lost) would be applied
-                # TWICE. Refused-connection resilience for sends lives in
-                # get_client's connect retry plus RpcClient._with_retry's
-                # same-seq reconnect loop, both dedup-safe.
-                get_client(ep, tid).send_var(
-                    n, native.serialize_selected_rows(shard)
-                )
+            with _trace.span("rpc_send_var", cat="rpc", var=n, sparse=True):
+                rows = np.asarray(v.rows, np.int64)
+                vals = np.asarray(v.value)
+                n_eps = len(eps)
+                for k, ep in enumerate(eps):
+                    sel = np.nonzero(rows % n_eps == k)[0]
+                    shard = _core.SelectedRows(
+                        rows=list(rows[sel] // n_eps),
+                        height=(v.height + n_eps - 1 - k) // n_eps,
+                        value=vals[sel],
+                    )
+                    # MUTATING sends are deliberately NOT wrapped in
+                    # _with_conn_retry: re-invoking send_var draws a fresh
+                    # seq, which the server cannot dedup — an ambiguous
+                    # failure (grad applied, response lost) would be
+                    # applied TWICE. Refused-connection resilience for
+                    # sends lives in get_client's connect retry plus
+                    # RpcClient._with_retry's same-seq reconnect loop,
+                    # both dedup-safe.
+                    get_client(ep, tid).send_var(
+                        n, native.serialize_selected_rows(shard)
+                    )
             continue
-        payload = native.serialize_tensor(np.asarray(v))
-        for ep in eps:
-            get_client(ep, tid).send_var(n, payload)  # see dedup note above
+        with _trace.span("rpc_send_var", cat="rpc", var=n):
+            payload = native.serialize_tensor(np.asarray(v))
+            for ep in eps:
+                # see dedup note above
+                get_client(ep, tid).send_var(n, payload)
 
 
 def _recv_lower(ctx, op_):

@@ -5,7 +5,14 @@ tools/timeline.py).
 TPU-native: host events are recorded by a RecordEvent-compatible shim and
 device tracing delegates to jax.profiler (xprof) which captures XLA/TPU
 timelines natively; start_profiler/stop_profiler map onto a jax trace
-session and the summary prints host-event aggregates."""
+session and the summary prints host-event aggregates.
+
+Timeline source: RecordEvent rides the unified span tracer
+(paddle_tpu/observability/trace.py) — legacy ``fluid.profiler`` API
+calls land in the SAME exported Chrome trace as the executor / feeder /
+checkpoint / serving / RPC spans instead of a parallel record list, and
+``get_records()`` derives its tuples from the tracer's ring buffer (so
+retention is bounded by FLAGS_obs_trace_buffer)."""
 
 from __future__ import annotations
 
@@ -33,11 +40,15 @@ __all__ = [
     "reset_histograms",
 ]
 
-_events = defaultdict(list)  # name -> [durations]
-_records = []  # (name, start, end, tid) — timeline source
+_events = defaultdict(list)  # name -> [durations]; guarded by _counters_lock
 _active = threading.local()
 _trace_dir = None
 _profiling = False
+# perf_counter bounds of the most recent start/stop_profiler session:
+# get_records() clips to this window so a long-lived process's pre-session
+# host spans don't dominate the exported timeline
+_session_t0 = None
+_session_t1 = None
 
 # Always-on lightweight counters (unlike _events these do not need an
 # active profiling session): the executor's dispatch-plan cache and the
@@ -141,23 +152,37 @@ def get_histogram(name):
 
 
 class RecordEvent(object):
-    """RAII host event (reference: platform/profiler.h:81)."""
+    """RAII host event (reference: platform/profiler.h:81).
+
+    Rebased onto the unified tracer: entering opens a ``cat="host"``
+    span (so legacy events nest correctly among executor/serving/ckpt
+    spans in the exported timeline, even recorded concurrently from
+    worker threads); the per-name duration aggregate for the profiling
+    summary is kept only while a profiling session is active, under the
+    shared counters lock (RecordEvents fire from the checkpoint writer
+    and serving batcher threads too)."""
 
     def __init__(self, name):
         self.name = name
         self._t0 = None
+        self._span = None
 
     def __enter__(self):
+        from ..observability import trace as _trace
+
+        self._span = _trace.span(self.name, cat="host")
+        self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._span is not None:
+            self._span.__exit__()
+            self._span = None
         if _profiling:
-            t1 = time.perf_counter()
-            _events[self.name].append(t1 - self._t0)
-            _records.append(
-                (self.name, self._t0, t1, threading.get_ident())
-            )
+            with _counters_lock:
+                _events[self.name].append(t1 - self._t0)
         return False
 
 
@@ -168,20 +193,48 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    _events.clear()
-    del _records[:]
+    global _session_t0, _session_t1
+    from ..observability import trace as _trace
+
+    with _counters_lock:
+        _events.clear()
+    _session_t0 = _session_t1 = None
+    _trace.reset()  # the tracer ring buffer IS the record store now
     reset_counters()
     reset_histograms()
 
 
 def get_records():
     """Timeline source records [(name, start, end, tid)] — consumed by
-    tools/timeline.py."""
-    return list(_records)
+    tools/timeline.py. Derived from the tracer's ``cat="host"`` spans
+    (the RecordEvent category), so retention is the tracer's bounded
+    ring buffer rather than an unbounded list. Once a profiling session
+    has run, records are clipped to the newest session's window by their
+    COMPLETION time (the pre-reform contract: _records appended at
+    RecordEvent exit while profiling, so an event straddling
+    start_profiler counts and one straddling stop_profiler doesn't)."""
+    from ..observability import trace as _trace
+
+    t0, t1 = _session_t0, _session_t1
+    return [
+        (s["name"], s["start"], s["end"], s["tid"])
+        for s in _trace.get_spans()
+        if s["cat"] == "host"
+        and (t0 is None or s["end"] >= t0)
+        and (t1 is None or s["end"] <= t1)
+    ]
 
 
 def start_profiler(state="All", tracer_option=None):
-    global _profiling, _trace_dir
+    global _profiling, _trace_dir, _session_t0, _session_t1
+    from ..observability import trace as _trace
+
+    if not _profiling:
+        # the session must yield a timeline even when the always-on
+        # tracer was flagged off for overhead (FLAGS_obs_trace=0)
+        _trace.force_enable(True)
+    _session_t0 = time.perf_counter()
+    _session_t1 = None
     _profiling = True
     if state in ("GPU", "All"):
         _trace_dir = os.environ.get(
@@ -196,7 +249,12 @@ def start_profiler(state="All", tracer_option=None):
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    global _profiling, _trace_dir
+    global _profiling, _trace_dir, _session_t1
+    from ..observability import trace as _trace
+
+    if _profiling:
+        _trace.force_enable(False)
+        _session_t1 = time.perf_counter()
     _profiling = False
     if _trace_dir is not None:
         try:
@@ -213,7 +271,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         try:
             from ..tools.timeline import save_chrome_trace
 
-            save_chrome_trace(_records, profile_path + ".json")
+            save_chrome_trace(get_records(), profile_path + ".json")
         except Exception:
             pass
 
@@ -225,10 +283,12 @@ def _print_summary(sorted_key=None):
             "Counters: "
             + ", ".join("%s=%d" % kv for kv in sorted(counters.items()))
         )
-    if not _events:
+    with _counters_lock:
+        events = {k: list(v) for k, v in _events.items()}
+    if not events:
         return
     rows = []
-    for name, durs in _events.items():
+    for name, durs in events.items():
         total = sum(durs)
         rows.append((name, len(durs), total, total / len(durs), max(durs), min(durs)))
     key_idx = {"total": 2, "calls": 1, "ave": 3, "max": 4, "min": 5}.get(

@@ -44,10 +44,21 @@ class MultiTrainer(TrainerBase):
     def train(self, executor, program, dataset, scope=None, fetch_list=None,
               fetch_info=None, print_period=100, on_step=None,
               ckpt_manager=None, startup_program=None):
+        import time as _time
+
         from . import flags as _flags
         from . import io_pipeline as _io_pipeline
+        from . import profiler as _profiler
         from ..distributed import supervisor as _sup
+        from ..observability import exporter as _obs_exporter
+        from ..observability import trace as _trace
         from ..testing import chaos as _chaos
+
+        # FLAGS_obs_* light up the telemetry endpoint / snapshot files
+        # for this worker with env flags alone (no-op when disarmed); the
+        # supervisor injects FLAGS_obs_dir so every gang member leaves a
+        # per-rank snapshot the gang report merges
+        _obs_exporter.maybe_start_from_flags()
 
         feed_names = [
             v.name if hasattr(v, "name") else str(v)
@@ -102,56 +113,81 @@ class MultiTrainer(TrainerBase):
         )
         step = start_step
         preempted_break = False
+
+        def _account_step():
+            # one definition for BOTH exits of a completed step (normal
+            # fall-through and preempted break) so the metric name/unit
+            # can never diverge between them; reads the current
+            # iteration's t_step from the enclosing scope
+            _profiler.bump_histogram(
+                "train_step_ms", (_time.perf_counter() - t_step) * 1e3
+            )
+            _profiler.bump_counter("train_steps")
+
         try:
             for feed in pipe:
-                outs = executor.run(
-                    program, feed=feed, fetch_list=fetch_list or [],
-                    scope=scope,
-                )
-                if fetch_list and print_period and step % print_period == 0:
-                    info = fetch_info or [
-                        getattr(f, "name", str(f)) for f in fetch_list
-                    ]
-                    msg = ", ".join(
-                        "%s=%s" % (n, np.asarray(o).ravel()[:4])
-                        for n, o in zip(info, outs)
+                t_step = _time.perf_counter()
+                # the per-step umbrella span: executor_run, ckpt_snapshot
+                # and any RecordEvents nest under it, so the exported
+                # timeline answers "where did this step's ms go"
+                with _trace.span("train_step", cat="train", step=step):
+                    outs = executor.run(
+                        program, feed=feed, fetch_list=fetch_list or [],
+                        scope=scope,
                     )
-                    print("step %d: %s" % (step, msg))
-                if on_step is not None:
-                    on_step(step)
-                if hb is not None:
-                    hb.beat(step)
-                if ckpt_manager is not None:
-                    # per-install latch, not the sticky module flag: a
-                    # driver that deliberately re-enters train() after a
-                    # survived SIGTERM gets a full run, not 1-step stops
-                    requested = (
-                        handler.requested.is_set()
-                        if handler is not None and handler._installed
-                        else preempt_mod.preemption_requested()
-                    )
-                    if requested:
-                        preempted_break = True
-                        # the final save must not be skipped because an
-                        # EARLIER interval save failed on the writer —
-                        # drain + swallow the stale error first (same
-                        # contract as PreemptionHandler._final_save)
-                        try:
-                            ckpt_manager.wait()
-                        except Exception:
-                            pass
-                        ckpt_manager.save(
-                            step, program, scope=scope, async_=False
+                    if (fetch_list and print_period
+                            and step % print_period == 0):
+                        info = fetch_info or [
+                            getattr(f, "name", str(f)) for f in fetch_list
+                        ]
+                        msg = ", ".join(
+                            "%s=%s" % (n, np.asarray(o).ravel()[:4])
+                            for n, o in zip(info, outs)
                         )
-                        step += 1
-                        break
-                    if ckpt_interval and (step + 1) % ckpt_interval == 0:
-                        ckpt_manager.save(step, program, scope=scope)
-                # fault-injection point AFTER the interval save was
-                # enqueued: a crash here lands while the async writer may
-                # be mid-commit — the worst case the chaos harness exists
-                # to make reproducible
-                _chaos.on_step(step)
+                        print("step %d: %s" % (step, msg))
+                    if on_step is not None:
+                        on_step(step)
+                    if hb is not None:
+                        hb.beat(step)
+                    if ckpt_manager is not None:
+                        # per-install latch, not the sticky module flag:
+                        # a driver that deliberately re-enters train()
+                        # after a survived SIGTERM gets a full run, not
+                        # 1-step stops
+                        requested = (
+                            handler.requested.is_set()
+                            if handler is not None and handler._installed
+                            else preempt_mod.preemption_requested()
+                        )
+                        if requested:
+                            preempted_break = True
+                            # the final save must not be skipped because
+                            # an EARLIER interval save failed on the
+                            # writer — drain + swallow the stale error
+                            # first (same contract as
+                            # PreemptionHandler._final_save)
+                            try:
+                                ckpt_manager.wait()
+                            except Exception:
+                                pass
+                            ckpt_manager.save(
+                                step, program, scope=scope, async_=False
+                            )
+                            # the final preempted step ran in full (plus
+                            # its terminal save) — it must count in the
+                            # progress/step-time telemetry the gang
+                            # report compares across ranks
+                            _account_step()
+                            step += 1
+                            break
+                        if ckpt_interval and (step + 1) % ckpt_interval == 0:
+                            ckpt_manager.save(step, program, scope=scope)
+                    # fault-injection point AFTER the interval save was
+                    # enqueued: a crash here lands while the async writer
+                    # may be mid-commit — the worst case the chaos
+                    # harness exists to make reproducible
+                    _chaos.on_step(step)
+                _account_step()
                 step += 1
             if hb is not None:
                 # a preempted stop is NOT completion: "done" would exempt
@@ -169,6 +205,10 @@ class MultiTrainer(TrainerBase):
                 handler.uninstall()
             if ckpt_manager is not None:
                 ckpt_manager.wait()
+            # leave the per-rank telemetry record (FLAGS_obs_dir armed):
+            # this is what the supervisor's gang report merges, and it
+            # must land even on a preempted/raising exit
+            _obs_exporter.final_snapshot()
         return step
 
 

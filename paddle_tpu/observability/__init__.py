@@ -1,0 +1,37 @@
+"""paddle_tpu.observability — unified tracing, metrics, and gang telemetry.
+
+The reference Fluid stack shipped a dedicated observability layer
+(``platform/profiler.h``, ``platform/device_tracer.h`` + the
+chrome-trace ``tools/timeline.py``); this package is that layer rebuilt
+as one spine over the whole reproduction:
+
+- ``trace``     — thread-safe span tracer (ring buffer, Perfetto export)
+- ``registry``  — metrics API over the always-on profiler counters /
+  histograms: Prometheus text + JSONL snapshot renderers
+- ``exporter``  — stdlib HTTP ``/metrics`` ``/healthz`` ``/trace`` +
+  per-rank JSONL snapshot files, armed by ``FLAGS_obs_*``
+- ``aggregate`` — supervisor-side merge of per-rank snapshots +
+  supervisor.log into ``gang_report.json``
+
+Submodules load lazily (PEP 562): ``trace`` sits on hot paths inside
+``fluid`` itself, so this package must import without dragging the rest
+of the stack in (and without import cycles through ``fluid.profiler``).
+"""
+
+import importlib
+
+_SUBMODULES = ("trace", "registry", "exporter", "aggregate")
+
+__all__ = list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

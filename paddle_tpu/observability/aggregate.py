@@ -1,0 +1,188 @@
+"""Supervisor-side telemetry merge: per-rank snapshots -> gang report.
+
+A supervised gang's telemetry is scattered by design — each rank's
+process owns its counters/histograms and leaves ``rank_<r>.jsonl``
+snapshot files (exporter.py), and the supervisor's ``supervisor.log``
+carries the restart narrative. This module joins them into ONE
+``gang_report.json`` an operator (or the crash probe) reads after the
+fact: how many restarts and why, downtime per restart, and per-rank
+step-time percentiles + progress counters from each rank's NEWEST
+snapshot. The supervisor writes it on every restart event and again on
+exit, so even a gang that dies mid-flight leaves a merged record.
+
+Snapshots are merged last-line-wins per rank: a restarted worker appends
+to the same file, and its newest snapshot reflects the life that
+mattered (counters are process-local, so they restart from zero with the
+process — the report keeps each life's final word, not a fake sum across
+lives).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+from . import registry as _registry
+
+__all__ = [
+    "GANG_REPORT",
+    "read_rank_snapshots",
+    "gang_report",
+    "write_gang_report",
+]
+
+GANG_REPORT = "gang_report.json"
+_RANK_FILE = re.compile(r"^rank_(\d+)\.jsonl$")
+
+# the counters/histograms worth surfacing per rank without dumping the
+# whole registry into the report (the full detail stays in the JSONL)
+_RANK_COUNTERS = (
+    "train_steps",
+    "ckpt_saves_committed",
+    "ckpt_restore_fallbacks",
+    "executor_plan_cache_hits",
+    "executor_plan_cache_misses",
+    "pserver_rpc_conn_retries",
+)
+_RANK_HISTOGRAMS = ("train_step_ms", "ckpt_save_ms", "ckpt_snapshot_ms")
+
+
+def read_rank_snapshots(obs_dir):
+    """{rank: newest snapshot dict} from ``rank_*.jsonl`` under
+    ``obs_dir``. Torn/garbage lines are skipped (the writer appends
+    whole lines, but a crash can still truncate the last one)."""
+    out = {}
+    try:
+        names = os.listdir(obs_dir)
+    except OSError:
+        return out
+    for fn in names:
+        m = _RANK_FILE.match(fn)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        newest = None
+        try:
+            with open(os.path.join(obs_dir, fn)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        newest = json.loads(line)
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        if newest is not None:
+            out[rank] = newest
+    return out
+
+
+def _downtimes_ms(events):
+    """[(failure detection -> next restarted gang_start) ms] from
+    supervisor events, on the monotonic timestamps when present
+    (schema_version >= 1), falling back to wall-clock for older logs.
+
+    supervisor.log is append-only across supervisor RUNS (a reused
+    workdir accumulates them), and each run's monotonic clock has its
+    own epoch — so a detection may only pair with a gang_start from the
+    SAME run. A fresh run's first gang_start carries ``restart == 0``
+    and clears any detection a dead previous run left dangling; terminal
+    events end a run's pairing too, and negative deltas (mixed clock
+    epochs in malformed logs) are dropped rather than poisoning the
+    percentiles."""
+    key = "ts_mono" if any("ts_mono" in e for e in events) else "ts"
+    downtimes = []
+    detect = None
+    for e in events:
+        ev = e.get("event")
+        if ev in ("crash_detected", "hang_detected"):
+            detect = e.get(key)
+        elif ev in ("gang_done", "giveup", "preempted"):
+            detect = None
+        elif ev == "gang_start":
+            if e.get("restart", 0) and detect is not None \
+                    and e.get(key) is not None:
+                delta_ms = (e[key] - detect) * 1000.0
+                if delta_ms >= 0:
+                    downtimes.append(delta_ms)
+            detect = None
+    return downtimes
+
+
+def _rank_summary(snap):
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    return {
+        "snapshot_ts": snap.get("ts"),
+        "pid": snap.get("pid"),
+        "counters": {
+            k: counters[k] for k in _RANK_COUNTERS if k in counters
+        },
+        "step_time_ms": hists.get("train_step_ms"),
+        "histograms": {
+            k: hists[k] for k in _RANK_HISTOGRAMS if k in hists
+        },
+    }
+
+
+def _last_run(events):
+    """The event slice belonging to the NEWEST supervisor run: the log
+    appends across runs in a reused workdir, and the report must
+    describe the current gang, not a sum over dead ones. A run begins at
+    a ``gang_start`` with ``restart == 0`` (the only kind a fresh
+    supervisor emits first)."""
+    start = 0
+    for i, e in enumerate(events):
+        if e.get("event") == "gang_start" and not e.get("restart", 0):
+            start = i
+    return events[start:]
+
+
+def gang_report(workdir, obs_dir=None):
+    """Merge ``workdir``'s supervisor.log + per-rank snapshots (default
+    ``workdir/obs``) into one report dict. Counters, outcome, and
+    downtime all describe the newest supervisor run in the log."""
+    from ..distributed import supervisor as _sup
+
+    events = _last_run(_sup.load_events(str(workdir)))
+    obs_dir = obs_dir or os.path.join(str(workdir), "obs")
+    snaps = read_rank_snapshots(obs_dir)
+    downtimes = _downtimes_ms(events)
+    terminal = None
+    for e in events:  # last terminal event wins
+        if e.get("event") in ("gang_done", "giveup", "preempted"):
+            terminal = e["event"]
+    return {
+        "schema_version": _registry.SCHEMA_VERSION,
+        "ts": time.time(),
+        "ts_mono": time.monotonic(),
+        "workdir": str(workdir),
+        "outcome": terminal,  # None while the gang is still running
+        "restarts": sum(1 for e in events if e.get("event") == "restart"),
+        "crashes": sum(
+            1 for e in events if e.get("event") == "crash_detected"
+        ),
+        "hang_kills": sum(
+            1 for e in events if e.get("event") == "hang_detected"
+        ),
+        "downtime_ms": _registry.percentiles(downtimes, points=(50, 99)),
+        "ranks_reporting": sorted(snaps),
+        "per_rank": {str(r): _rank_summary(s) for r, s in snaps.items()},
+    }
+
+
+def write_gang_report(workdir, obs_dir=None, path=None):
+    """Emit ``gang_report.json`` under ``workdir`` (atomic tmp+rename:
+    an operator tailing the file never reads a torn report). Returns the
+    path."""
+    report = gang_report(workdir, obs_dir=obs_dir)
+    path = path or os.path.join(str(workdir), GANG_REPORT)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(report, f, sort_keys=True, indent=1)
+    os.replace(tmp, path)
+    return path

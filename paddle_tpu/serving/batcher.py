@@ -29,6 +29,7 @@ from collections import deque
 import numpy as np
 
 from ..fluid import profiler as _profiler
+from ..observability import trace as _trace
 
 __all__ = [
     "ServingError",
@@ -265,20 +266,25 @@ class MicroBatcher(object):
             if not live:
                 continue
             rows = sum(r.rows for r in live)
-            stacked = [
-                np.concatenate([r.inputs[i] for r in live], axis=0)
-                if len(live) > 1 else live[0].inputs[i]
-                for i in range(len(live[0].inputs))
-            ]
-            t0 = time.monotonic()
-            try:
-                outs = self._runner(stacked, rows)
-            except BaseException as e:  # surface to every waiting caller
-                for r in live:
-                    r.complete(error=ServingError(
-                        "batch execution failed: %r" % (e,)
-                    ))
-                continue
+            # dispatch span on this batcher worker's trace row: covers
+            # stacking + the runner (whose predictor_run span nests
+            # inside), so queue time vs device time separate cleanly
+            with _trace.span("serving_dispatch", cat="serving",
+                             rows=rows, requests=len(live)):
+                stacked = [
+                    np.concatenate([r.inputs[i] for r in live], axis=0)
+                    if len(live) > 1 else live[0].inputs[i]
+                    for i in range(len(live[0].inputs))
+                ]
+                t0 = time.monotonic()
+                try:
+                    outs = self._runner(stacked, rows)
+                except BaseException as e:  # surface to every waiting caller
+                    for r in live:
+                        r.complete(error=ServingError(
+                            "batch execution failed: %r" % (e,)
+                        ))
+                    continue
             self._service_s = 0.8 * self._service_s + 0.2 * (
                 time.monotonic() - t0
             )

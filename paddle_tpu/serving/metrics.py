@@ -1,9 +1,12 @@
 """ServingStats — the observability snapshot of a running server.
 
 All raw signals ride the always-on ``fluid.profiler`` counters and
-sliding-window histograms (the same surface the bench/probe tooling
-already reads), so one snapshot call assembles: queue depth, batch-fill
+sliding-window histograms (the same surface the bench/probe tooling —
+and now the ``observability`` registry's Prometheus/JSONL renderers —
+reads), so one snapshot call assembles: queue depth, batch-fill
 ratio, bucket-plan hit rate, latency percentiles, and shed counts.
+Percentile math is delegated to ``observability.registry.percentiles``
+so serving keeps no private windowing/summary code.
 Counter fields are deltas since the server's ``start()`` (the baseline
 snapshot), and the latency percentiles exclude samples recorded before
 it (via the histogram sample count at start) — so a fresh server's
@@ -21,9 +24,8 @@ serving_* bumps and latency samples mixed into their snapshots.
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..fluid import profiler as _profiler
+from ..observability import registry as _registry
 
 __all__ = ["ServingStats", "snapshot_stats"]
 
@@ -64,17 +66,6 @@ class ServingStats(object):
         return "ServingStats(%s)" % ", ".join(
             "%s=%r" % (k, getattr(self, k)) for k in self.__slots__
         )
-
-
-def _percentiles(samples, points=(50, 95, 99)):
-    if not samples:
-        return {"count": 0, "mean": None,
-                **{"p%d" % p: None for p in points}}
-    arr = np.asarray(samples, dtype=np.float64)
-    out = {"count": int(arr.size), "mean": round(float(arr.mean()), 3)}
-    for p in points:
-        out["p%d" % p] = round(float(np.percentile(arr, p)), 3)
-    return out
 
 
 def snapshot_stats(baseline=None, queue_depth=0, max_batch_size=1,
@@ -119,5 +110,8 @@ def snapshot_stats(baseline=None, queue_depth=0, max_batch_size=1,
         bucket_hit_rate=hit_rate,
         plan_cache_hits=d["predictor_plan_cache_hits"],
         plan_cache_misses=d["predictor_plan_cache_misses"],
-        latency_ms=_percentiles(lat),
+        # percentile math lives in the observability registry now — one
+        # formula shared with snapshots and the gang aggregator (same
+        # numpy linear-interpolation semantics this module always had)
+        latency_ms=_registry.percentiles(lat),
     )

@@ -25,6 +25,9 @@ import numpy as np
 
 from ..fluid import flags as _flags
 from ..fluid import profiler as _profiler
+from ..observability import exporter as _obs_exporter
+from ..observability import registry as _obs_registry
+from ..observability import trace as _trace
 from .batcher import (
     DeadlineExceededError,
     MicroBatcher,
@@ -74,6 +77,7 @@ class InferenceServer(object):
         self._warm_lock = threading.Lock()
         self._baseline = {}
         self._lat_base = 0
+        self._queue_gauge = None
         self._started = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -99,6 +103,14 @@ class InferenceServer(object):
             default_deadline_ms=self.default_deadline_ms,
         )
         self._started = True
+        # telemetry: FLAGS_obs_* light up /metrics /healthz /trace and
+        # JSONL snapshots with no code changes (no-op when disarmed), and
+        # the admission-queue depth publishes as a scrape-time gauge
+        _obs_exporter.maybe_start_from_flags()
+        self._queue_gauge = lambda b=self._batcher: b.queue_len
+        _obs_registry.register_gauge(
+            "serving_queue_depth", self._queue_gauge
+        )
         return self
 
     def warmup(self, example_inputs):
@@ -143,6 +155,17 @@ class InferenceServer(object):
                 )
 
     def stop(self):
+        # mirror the trainer's finally: a serving process with
+        # FLAGS_obs_dir armed must leave its per-rank snapshot even with
+        # snapshot_interval 0 ("one final snapshot" contract)
+        _obs_exporter.final_snapshot()
+        if self._queue_gauge is not None:
+            # ownership-scoped: a second server that re-registered the
+            # gauge keeps it when this (older) one stops
+            _obs_registry.unregister_gauge(
+                "serving_queue_depth", self._queue_gauge
+            )
+            self._queue_gauge = None
         if self._batcher is not None:
             self._batcher.stop()
         self._started = False
@@ -216,11 +239,15 @@ class InferenceServer(object):
         _profiler.bump_counter("serving_pad_rows",
                                plan.padded_rows - plan.rows)
         self._record_bucket(padded)
-        # blocking acquire: when warmup (or a slow batch) holds the pool,
-        # batches WAIT rather than failing their clients; per-request
-        # deadlines bound the caller-visible latency
-        with self._pool.acquire() as pred:
-            outs = pred.run(padded)
+        # nests inside the batcher's serving_dispatch span (same worker
+        # thread): pool wait + device time vs stacking/padding overhead
+        with _trace.span("predictor_run", cat="serving",
+                         rows=rows, padded_rows=plan.padded_rows):
+            # blocking acquire: when warmup (or a slow batch) holds the
+            # pool, batches WAIT rather than failing their clients;
+            # per-request deadlines bound the caller-visible latency
+            with self._pool.acquire() as pred:
+                outs = pred.run(padded)
         return self.ladder.unpad_outputs(outs, plan)
 
     def stats(self):

@@ -448,6 +448,45 @@ def test_trainer_ignores_stale_process_preemption_flag(tmp_path):
         ckpt_preempt_mod._reset_for_tests()
 
 
+def test_preempted_final_step_counts_in_step_metrics(tmp_path):
+    """The step that observes preemption ran in full (plus the terminal
+    save) — it must land in the train_steps counter and train_step_ms
+    histogram the gang report compares across ranks."""
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.fluid.trainer import MultiTrainer
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, loss = _build()
+    sc = fluid.Scope()
+    steps_before = profiler.get_counter("train_steps")
+    hist_before = len(profiler.get_histogram("train_step_ms"))
+
+    def _on_step(step):
+        if step == 1:
+            signal.raise_signal(signal.SIGTERM)
+
+    try:
+        with fluid.scope_guard(sc):
+            mgr = checkpoint.CheckpointManager(str(tmp_path / "ck"))
+            ds = _FakeDataset(
+                [main.global_block().var("x"), main.global_block().var("y")],
+                6,
+            )
+            steps = MultiTrainer().train(
+                exe, main, ds, scope=sc, fetch_list=[loss], print_period=0,
+                ckpt_manager=mgr, startup_program=startup, on_step=_on_step,
+            )
+            mgr.close()
+        assert steps == 2  # steps 0 and 1 ran, then the preempted break
+        assert checkpoint.latest_step(str(tmp_path / "ck")) == 1
+        assert profiler.get_counter("train_steps") - steps_before == 2
+        assert (
+            len(profiler.get_histogram("train_step_ms")) - hist_before == 2
+        )
+    finally:
+        ckpt_preempt_mod._reset_for_tests()
+
+
 def test_summarize_histogram_nearest_rank():
     from paddle_tpu.fluid import profiler
 

@@ -1,0 +1,779 @@
+"""Observability subsystem (paddle_tpu/observability): span tracer,
+metrics registry, HTTP/JSONL exporter, gang-report aggregation, and the
+ISSUE 5 satellites (profiler thread safety, RecordEvent-on-tracer,
+supervisor/probe schema fields, ServingStats migration, FLAGS_obs_*
+lint) — plus the fast subset of tools/obs_probe.py as the closed loop."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.checkpoint import preempt as preempt_mod
+from paddle_tpu.distributed import supervisor as sup_mod
+from paddle_tpu.fluid import profiler
+from paddle_tpu.observability import aggregate, exporter, registry, trace
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TOOLS = os.path.join(REPO, "tools")
+for _p in (REPO, TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+
+@pytest.fixture(autouse=True)
+def _tracer_state():
+    """Every test starts from an armed tracer with a fresh buffer and
+    leaves the flags at defaults (counters/histograms are deliberately
+    NOT reset — they are process-global and other suites own deltas)."""
+    fluid.set_flags({"FLAGS_obs_trace": True})
+    trace.reset()
+    yield
+    fluid.set_flags({
+        "FLAGS_obs_trace": True,
+        "FLAGS_obs_trace_buffer": 65536,
+    })
+    trace.reset()
+
+
+def _http(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+def test_span_nesting_parent_and_args():
+    with trace.span("outer", cat="t"):
+        with trace.span("inner", cat="t", step=3):
+            pass
+        with trace.span("inner2", cat="t"):
+            pass
+    spans = {s["name"]: s for s in trace.get_spans()}
+    assert spans["inner"]["parent"] == "outer"
+    assert spans["inner"]["depth"] == 1
+    assert spans["inner"]["args"] == {"step": 3}
+    assert spans["inner2"]["parent"] == "outer"
+    assert spans["outer"]["parent"] is None and spans["outer"]["depth"] == 0
+    # time containment (what Perfetto nests by)
+    assert spans["outer"]["start"] <= spans["inner"]["start"]
+    assert spans["inner"]["end"] <= spans["outer"]["end"]
+
+
+def test_span_ring_buffer_bounded():
+    fluid.set_flags({"FLAGS_obs_trace_buffer": 8})
+    trace.reset()
+    for i in range(20):
+        with trace.span("s%d" % i):
+            pass
+    spans = trace.get_spans()
+    assert len(spans) == 8
+    assert spans[-1]["name"] == "s19"  # newest survive
+
+
+def test_traced_decorator_both_forms():
+    @trace.traced
+    def bare():
+        return 1
+
+    @trace.traced("named_span", cat="t")
+    def named():
+        return 2
+
+    assert bare() == 1 and named() == 2
+    names = [s["name"] for s in trace.get_spans()]
+    assert "named_span" in names
+    assert any("bare" in n for n in names)
+
+
+def test_trace_buffer_flag_applies_without_reset():
+    """FLAGS_obs_trace_buffer must bound the live ring buffer on paths
+    that never call reset() (a long-lived trainer/server): the bound is
+    applied on the flags-version-change branch of enabled()."""
+    fluid.set_flags({"FLAGS_obs_trace_buffer": 8})
+    for i in range(20):
+        with trace.span("nb%d" % i):
+            pass
+    spans = trace.get_spans()
+    assert len(spans) == 8
+    assert spans[-1]["name"] == "nb19"
+
+
+def test_trace_disabled_records_nothing():
+    fluid.set_flags({"FLAGS_obs_trace": False})
+    with trace.span("ghost"):
+        pass
+    assert all(s["name"] != "ghost" for s in trace.get_spans())
+
+
+def test_trace_thread_safety_and_per_thread_nesting():
+    n_threads, per = 4, 100
+
+    def work(k):
+        for i in range(per):
+            with trace.span("outer_%d" % k, cat="t"):
+                with trace.span("inner_%d" % k, cat="t"):
+                    pass
+
+    threads = [
+        threading.Thread(target=work, args=(k,)) for k in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = trace.get_spans()
+    assert len(spans) == n_threads * per * 2
+    for s in spans:
+        if s["name"].startswith("inner_"):
+            k = s["name"].split("_")[1]
+            # concurrency never cross-wires parents between threads
+            assert s["parent"] == "outer_%s" % k, s
+
+
+def test_chrome_trace_export(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    with trace.span("a", cat="t"):
+        with trace.span("b", cat="t"):
+            pass
+    doc = trace.chrome_trace()
+    x = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in x} >= {"a", "b"}
+    assert all(e["pid"] == 3 and e["dur"] >= 0 and e["ts"] >= 0 for e in x)
+    assert any(e["name"] == "process_name" for e in meta)
+    assert any(e["name"] == "thread_name" for e in meta)
+    b = next(e for e in x if e["name"] == "b")
+    assert b["args"]["parent"] == "a" and b["args"]["depth"] == 1
+    path = trace.save_chrome_trace(str(tmp_path / "t.json"))
+    assert json.load(open(path))["traceEvents"]
+
+
+def test_chrome_trace_thread_rows_are_collision_free():
+    """Exported tids are small per-export aliases of the OS thread
+    idents: distinct threads must never share a Perfetto row (a modulus
+    over pthread addresses can collide)."""
+    barrier = threading.Barrier(4)
+
+    def work():
+        barrier.wait()  # all threads alive at once -> distinct idents
+        with trace.span("alias_span", cat="t"):
+            pass
+
+    threads = [threading.Thread(target=work) for _ in range(3)]
+    for t in threads:
+        t.start()
+    work()
+    for t in threads:
+        t.join()
+    raw_tids = {s["tid"] for s in trace.get_spans()}
+    assert len(raw_tids) == 4
+    doc = trace.chrome_trace()
+    export_tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(export_tids) == len(raw_tids)
+    # every aliased row has its thread-name metadata row
+    meta_tids = {
+        e["tid"] for e in doc["traceEvents"] if e["name"] == "thread_name"
+    }
+    assert export_tids <= meta_tids
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def test_registry_counter_histogram_handles():
+    c = registry.counter("obs_reg_test_counter")
+    base = c.value()
+    c.inc()
+    c.inc(4)
+    assert c.value() == base + 5
+    h = registry.histogram("obs_reg_test_hist")
+    h.observe(1.0)
+    h.observe(3.0)
+    assert h.summary()["count"] >= 2
+
+
+def test_prometheus_render_roundtrip_and_gauges():
+    profiler.bump_counter("obs_prom_test_total", 7)
+    profiler.bump_histogram("obs_prom_test_ms", 2.5)
+    registry.register_gauge("obs_prom_gauge", lambda: 1.25)
+    registry.register_gauge("obs_prom_dead_gauge", lambda: 1 / 0)
+    try:
+        text = registry.render_prometheus()
+        parsed = registry.parse_prometheus(text)
+        live = profiler.get_counters()
+        for name, val in live.items():
+            assert parsed[(registry.prom_name(name), "")] == float(val), name
+        assert parsed[("obs_prom_gauge", "")] == 1.25
+        assert ("obs_prom_dead_gauge", "") not in parsed  # skipped, not 500
+        assert ("obs_prom_test_ms", 'quantile="0.5"') in parsed
+        assert parsed[("obs_prom_test_ms_count", "")] >= 1.0
+    finally:
+        registry.unregister_gauge("obs_prom_gauge")
+        registry.unregister_gauge("obs_prom_dead_gauge")
+
+
+def test_gauge_unregister_respects_ownership():
+    """A stopping owner passing its callable must not tear down a
+    successor's re-registration of the same gauge name (the two-servers
+    -in-one-process case InferenceServer.stop relies on)."""
+    first, second = (lambda: 1.0), (lambda: 2.0)
+    registry.register_gauge("obs_owned_gauge", first)
+    registry.register_gauge("obs_owned_gauge", second)  # successor re-owns
+    try:
+        registry.unregister_gauge("obs_owned_gauge", first)  # stale: no-op
+        assert registry.gauge_values()["obs_owned_gauge"] == 2.0
+        registry.unregister_gauge("obs_owned_gauge", second)
+        assert "obs_owned_gauge" not in registry.gauge_values()
+    finally:
+        registry.unregister_gauge("obs_owned_gauge")
+
+
+def test_prom_name_sanitization():
+    assert registry.prom_name("a.b-c d") == "a_b_c_d"
+    assert registry.prom_name("0bad") == "_0bad"
+    assert registry.prom_name("fine_name:x") == "fine_name:x"
+
+
+def test_snapshot_fields_and_jsonl_write(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    snap = registry.snapshot()
+    assert snap["schema_version"] == registry.SCHEMA_VERSION
+    assert snap["rank"] == 2 and snap["pid"] == os.getpid()
+    assert isinstance(snap["ts"], float) and isinstance(
+        snap["ts_mono"], float
+    )
+    assert snap["counters"] == profiler.get_counters()
+    d = str(tmp_path / "obs")
+    p1 = registry.write_snapshot(d)
+    p2 = registry.write_snapshot(d)
+    assert p1 == p2 == registry.snapshot_path(d, 2)
+    lines = open(p1).read().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[1])["rank"] == 2
+
+
+def test_percentiles_matches_numpy_formula():
+    rng = np.random.RandomState(5)
+    samples = list(rng.rand(257) * 100.0)
+    got = registry.percentiles(samples)
+    arr = np.asarray(samples)
+    assert got["count"] == 257
+    assert got["mean"] == round(float(arr.mean()), 3)
+    for p in (50, 95, 99):
+        assert got["p%d" % p] == round(float(np.percentile(arr, p)), 3)
+    empty = registry.percentiles([], points=(50, 99))
+    assert empty == {"count": 0, "mean": None, "p50": None, "p99": None}
+
+
+def test_serving_stats_equivalence_on_registry_percentiles():
+    """Satellite: ServingStats keeps its exact public contract after
+    delegating the percentile math to the registry."""
+    from paddle_tpu.serving.metrics import snapshot_stats
+
+    profiler.bump_histogram("serving_latency_ms", 1.5)
+    profiler.bump_histogram("serving_latency_ms", 9.5)
+    stats = snapshot_stats(baseline=profiler.get_counters())
+    lat = profiler.get_histogram("serving_latency_ms")
+    arr = np.asarray(lat, dtype=np.float64)
+    expect = {"count": int(arr.size),
+              "mean": round(float(arr.mean()), 3)}
+    for p in (50, 95, 99):
+        expect["p%d" % p] = round(float(np.percentile(arr, p)), 3)
+    assert stats.latency_ms == expect
+    assert set(stats.as_dict()) == set(stats.__slots__)  # API unchanged
+
+
+# ---------------------------------------------------------------------------
+# exporter lifecycle
+# ---------------------------------------------------------------------------
+def test_exporter_endpoints(tmp_path):
+    profiler.bump_counter("obs_exp_test", 3)
+    with trace.span("exp_span"):
+        pass
+    exp = exporter.Exporter(
+        port=0, snapshot_dir=str(tmp_path / "obs"), rank=1
+    ).start()
+    try:
+        code, body = _http(exp.url("/healthz"))
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, body = _http(exp.url("/metrics"))
+        assert code == 200
+        assert registry.parse_prometheus(body)[("obs_exp_test", "")] >= 3.0
+        code, body = _http(exp.url("/trace"))
+        assert code == 200
+        assert any(
+            e["name"] == "exp_span"
+            for e in json.loads(body)["traceEvents"] if e["ph"] == "X"
+        )
+        code, _body = _http(exp.url("/nope"))
+        assert code == 404
+    finally:
+        exp.stop()
+    # stop() wrote the final snapshot and released the port
+    assert os.path.isfile(registry.snapshot_path(str(tmp_path / "obs"), 1))
+
+
+def test_exporter_port_in_use_fallback():
+    before = profiler.get_counter("obs_port_fallbacks")
+    first = exporter.Exporter(port=0).start()
+    try:
+        taken = first.port
+        second = exporter.Exporter(port=taken, port_retries=10).start()
+        try:
+            assert second.port != taken
+            assert taken < second.port <= taken + 10
+            assert _http(second.url("/healthz"))[0] == 200
+        finally:
+            second.stop()
+        assert profiler.get_counter("obs_port_fallbacks") == before + 1
+    finally:
+        first.stop()
+
+
+def test_exporter_periodic_snapshots(tmp_path):
+    d = str(tmp_path / "obs")
+    exp = exporter.Exporter(
+        port=-1, snapshot_dir=d, snapshot_interval_s=0.05, rank=0
+    ).start()
+    time.sleep(0.35)
+    exp.stop()
+    lines = open(registry.snapshot_path(d, 0)).read().splitlines()
+    assert len(lines) >= 3  # several periodic + the final one
+    for line in lines:
+        assert json.loads(line)["schema_version"] == registry.SCHEMA_VERSION
+
+
+def test_exporter_restart_after_stop():
+    """stop() must not wedge a later start(): the stop event is cleared
+    so /healthz reports ok again and the snapshot loop runs."""
+    exp = exporter.Exporter(port=0)
+    exp.start()
+    exp.stop()
+    exp.start()
+    try:
+        code, body = _http(exp.url("/healthz"))
+        assert code == 200 and json.loads(body)["status"] == "ok"
+    finally:
+        exp.stop()
+
+
+def test_exporter_healthz_flips_and_shuts_down_on_sigterm():
+    """Satellite: SIGTERM through the PR 3 preemption path (what a
+    supervisor-driven restart delivers to every worker) flips /healthz
+    to draining, and stop() afterwards is clean."""
+    exp = exporter.Exporter(port=0).start()
+    handler = preempt_mod.PreemptionHandler(
+        None, lambda: None, save_in_handler=False, exit_after=False,
+    ).install()
+    try:
+        assert _http(exp.url("/healthz"))[0] == 200
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not handler.requested.is_set():
+            assert time.monotonic() < deadline, "SIGTERM never delivered"
+            time.sleep(0.01)
+        code, body = _http(exp.url("/healthz"))
+        assert code == 503 and json.loads(body)["status"] == "draining"
+    finally:
+        handler.uninstall()
+        exp.stop()
+        preempt_mod._reset_for_tests()
+    # manual drain flag works without a signal too
+    exp2 = exporter.Exporter(port=0).start()
+    try:
+        exp2.set_health(False)
+        assert _http(exp2.url("/healthz"))[0] == 503
+        exp2.set_health(True)
+        assert _http(exp2.url("/healthz"))[0] == 200
+    finally:
+        exp2.stop()
+
+
+def test_maybe_start_from_flags_snapshots_survive_bind_failure(tmp_path):
+    """An exhausted HTTP port walk must not cost the per-rank JSONL
+    snapshots (the gang report's input needs no port): the global
+    exporter degrades to a port-less one."""
+    blocker = exporter.Exporter(port=0).start()
+    try:
+        fluid.set_flags({
+            "FLAGS_obs_http_port": blocker.port,
+            "FLAGS_obs_http_port_retries": 0,
+            "FLAGS_obs_dir": str(tmp_path / "obs"),
+        })
+        exp = exporter.maybe_start_from_flags()
+        assert exp is not None
+        assert exp.port is None  # HTTP degraded away, snapshots live
+        assert os.path.isfile(exp.write_snapshot())
+    finally:
+        exporter.stop_global()
+        blocker.stop()
+        fluid.set_flags({
+            "FLAGS_obs_http_port": -1,
+            "FLAGS_obs_http_port_retries": 8,
+            "FLAGS_obs_dir": "",
+        })
+
+
+def test_maybe_start_from_flags_disarmed_and_armed(tmp_path):
+    assert exporter.maybe_start_from_flags() is None  # defaults: off
+    fluid.set_flags({"FLAGS_obs_dir": str(tmp_path / "obs")})
+    try:
+        exp = exporter.maybe_start_from_flags()
+        assert exp is not None
+        assert exporter.maybe_start_from_flags() is exp  # idempotent
+        assert exporter.final_snapshot() is not None
+    finally:
+        exporter.stop_global()
+        fluid.set_flags({"FLAGS_obs_dir": ""})
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites: one-lock thread safety + RecordEvent on the tracer
+# ---------------------------------------------------------------------------
+def test_profiler_concurrent_bumps_lose_nothing():
+    n_threads, per = 8, 500
+    name = "obs_concurrency_counter"
+    hname = "obs_concurrency_hist"
+    base = profiler.get_counter(name)
+    hbase = len(profiler.get_histogram(hname))
+
+    def work():
+        for i in range(per):
+            profiler.bump_counter(name)
+            profiler.bump_histogram(hname, float(i))
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert profiler.get_counter(name) == base + n_threads * per
+    assert len(profiler.get_histogram(hname)) == hbase + n_threads * per
+
+
+def test_record_event_concurrent_aggregation_under_profiling():
+    profiler.start_profiler("CPU")
+    try:
+        def work():
+            for _ in range(200):
+                with profiler.RecordEvent("obs_conc_event"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(profiler._events["obs_conc_event"]) == 800
+    finally:
+        profiler.stop_profiler(profile_path="")
+        with profiler._counters_lock:
+            profiler._events.clear()
+
+
+def test_record_event_rides_unified_tracer():
+    """Satellite: legacy fluid.profiler.RecordEvent lands in the SAME
+    exported timeline as native spans (correct nesting both ways), and
+    get_records() derives from it."""
+    profiler.start_profiler("CPU")
+    try:
+        with trace.span("native_outer", cat="t"):
+            with profiler.RecordEvent("legacy_inner"):
+                with trace.span("native_leaf", cat="t"):
+                    pass
+        recs = profiler.get_records()
+    finally:
+        profiler.stop_profiler(profile_path="")
+        with profiler._counters_lock:
+            profiler._events.clear()
+    spans = {s["name"]: s for s in trace.get_spans()}
+    assert spans["legacy_inner"]["parent"] == "native_outer"
+    assert spans["legacy_inner"]["cat"] == "host"
+    assert spans["native_leaf"]["parent"] == "legacy_inner"
+    assert any(r[0] == "legacy_inner" for r in recs)
+    # records keep the legacy tuple shape tools/timeline.py consumes
+    name, start, end, tid = next(r for r in recs if r[0] == "legacy_inner")
+    assert end >= start and tid == threading.get_ident()
+
+
+def test_get_records_clips_to_profiling_session():
+    """The exported timeline covers the start/stop_profiler window, not
+    every host span a long-lived process ever retained (pre-session and
+    post-session RecordEvents stay out of profile.json)."""
+    with profiler.RecordEvent("before_session"):
+        pass
+    profiler.start_profiler("CPU")
+    try:
+        with profiler.RecordEvent("in_session"):
+            pass
+    finally:
+        profiler.stop_profiler(profile_path="")
+        with profiler._counters_lock:
+            profiler._events.clear()
+    with profiler.RecordEvent("after_session"):
+        pass
+    names = [r[0] for r in profiler.get_records()]
+    assert "in_session" in names
+    assert "before_session" not in names
+    assert "after_session" not in names
+    # the ring buffer itself still holds all three (get_spans is the
+    # always-on view; only the legacy profile export is windowed)
+    retained = {s["name"] for s in trace.get_spans()}
+    assert {"before_session", "in_session", "after_session"} <= retained
+
+
+def test_profiling_session_forces_tracing_when_flagged_off():
+    """FLAGS_obs_trace=0 (the documented no-overhead setting) must not
+    silence an EXPLICIT start_profiler session: the session force-arms
+    the tracer, and releases it on stop."""
+    fluid.set_flags({"FLAGS_obs_trace": False})
+    profiler.start_profiler("CPU")
+    try:
+        with profiler.RecordEvent("forced_ev"):
+            pass
+    finally:
+        profiler.stop_profiler(profile_path="")
+        with profiler._counters_lock:
+            profiler._events.clear()
+    assert any(r[0] == "forced_ev" for r in profiler.get_records())
+    with trace.span("after_ghost"):  # force released at stop
+        pass
+    assert all(s["name"] != "after_ghost" for s in trace.get_spans())
+
+
+# ---------------------------------------------------------------------------
+# schema fields: supervisor JSONL + crash-probe report
+# ---------------------------------------------------------------------------
+def test_supervisor_events_carry_schema_and_monotonic_ts(tmp_path):
+    log = sup_mod._Log(str(tmp_path / "supervisor.log"))
+    before_wall, before_mono = time.time(), time.monotonic()
+    log.event("gang_start", restart=0, pids=[1])
+    after_wall, after_mono = time.time(), time.monotonic()
+    (ev,) = sup_mod.load_events(str(tmp_path))
+    assert ev["schema_version"] == sup_mod.LOG_SCHEMA_VERSION
+    assert before_wall <= ev["ts"] <= after_wall  # wall clock, for humans
+    assert before_mono <= ev["ts_mono"] <= after_mono  # for interval math
+
+
+def test_crash_probe_report_schema_fields():
+    import dist_crash_probe
+
+    report = dist_crash_probe._finalize_report({"trials_kill": 1})
+    assert report["schema_version"] == dist_crash_probe.REPORT_SCHEMA_VERSION
+    assert report["trials_kill"] == 1
+    assert abs(report["ts"] - time.time()) < 60.0
+    assert abs(report["ts_mono"] - time.monotonic()) < 60.0
+
+
+# ---------------------------------------------------------------------------
+# aggregation: unit merge + the chaos-restart closed loop
+# ---------------------------------------------------------------------------
+def test_gang_report_merges_snapshots_and_events(tmp_path):
+    workdir = str(tmp_path)
+    log = sup_mod._Log(os.path.join(workdir, sup_mod.SUPERVISOR_LOG))
+    log.event("gang_start", restart=0, pids=[11, 12])
+    log.event("crash_detected", rank=1, returncode=9, pid=12)
+    log.event("restart", restart=1, backoff_s=0.1)
+    log.event("gang_start", restart=1, pids=[13, 14])
+    log.event("gang_done", restart=1)
+    obs = os.path.join(workdir, "obs")
+    os.makedirs(obs)
+    for rank, steps in ((0, 5), (1, 3)):
+        snap = {
+            "schema_version": registry.SCHEMA_VERSION,
+            "ts": time.time(), "ts_mono": time.monotonic(),
+            "rank": rank, "pid": 100 + rank,
+            "counters": {"train_steps": steps, "irrelevant": 1},
+            "gauges": {},
+            "histograms": {
+                "train_step_ms": registry.percentiles(
+                    [1.0] * steps, points=(50, 95, 99)
+                ),
+            },
+        }
+        with open(os.path.join(obs, "rank_%d.jsonl" % rank), "a") as f:
+            f.write(json.dumps({"stale": True, "counters": {}}) + "\n")
+            f.write(json.dumps(snap) + "\n")  # last line wins
+            f.write("{torn line")  # skipped, not fatal
+    path = aggregate.write_gang_report(workdir)
+    report = json.load(open(path))
+    assert report["schema_version"] == registry.SCHEMA_VERSION
+    assert report["outcome"] == "gang_done"
+    assert report["restarts"] == 1 and report["crashes"] == 1
+    assert report["hang_kills"] == 0
+    assert report["downtime_ms"]["count"] == 1
+    assert report["downtime_ms"]["p50"] >= 0.0
+    assert report["ranks_reporting"] == [0, 1]
+    assert report["per_rank"]["0"]["counters"]["train_steps"] == 5
+    assert "irrelevant" not in report["per_rank"]["0"]["counters"]
+    assert report["per_rank"]["1"]["step_time_ms"]["count"] == 3
+
+
+def test_gang_report_scopes_to_newest_supervisor_run(tmp_path):
+    """A reused workdir appends runs to one supervisor.log — the report's
+    restart/crash counters and outcome must describe the NEWEST run, not
+    a sum over dead ones (downtime pairing is already per-run)."""
+    workdir = str(tmp_path)
+    log = sup_mod._Log(os.path.join(workdir, sup_mod.SUPERVISOR_LOG))
+    # dead run 1: crash, restart, crash, giveup
+    log.event("gang_start", restart=0, pids=[1])
+    log.event("crash_detected", rank=0, returncode=9)
+    log.event("restart", restart=1, backoff_s=0.1)
+    log.event("gang_start", restart=1, pids=[2])
+    log.event("crash_detected", rank=0, returncode=9)
+    log.event("giveup")
+    # current run 2: clean completion
+    log.event("gang_start", restart=0, pids=[3])
+    log.event("gang_done", restart=0)
+    report = aggregate.gang_report(workdir)
+    assert report["outcome"] == "gang_done"
+    assert report["restarts"] == 0 and report["crashes"] == 0
+    assert report["downtime_ms"]["count"] == 0
+
+
+def test_downtime_pairing_is_scoped_to_one_supervisor_run():
+    """supervisor.log appends across supervisor RUNS (reused workdir),
+    and each run's monotonic clock has its own epoch — a detection left
+    dangling by a dead run must not pair with the next run's gang_start,
+    and terminal events end pairing for their run."""
+    runs = [
+        # run 1: crash detected, supervisor dies before any restart
+        {"event": "gang_start", "restart": 0, "ts_mono": 1000.0},
+        {"event": "crash_detected", "ts_mono": 1007.0},
+        # run 2 (fresh epoch, earlier mono values): one real restart
+        {"event": "gang_start", "restart": 0, "ts_mono": 5.0},
+        {"event": "crash_detected", "ts_mono": 6.0},
+        {"event": "gang_start", "restart": 1, "ts_mono": 6.5},
+        {"event": "gang_done", "restart": 1, "ts_mono": 9.0},
+        # run 3: detection followed by giveup — no restart to pair with
+        {"event": "gang_start", "restart": 0, "ts_mono": 2.0},
+        {"event": "hang_detected", "ts_mono": 3.0},
+        {"event": "giveup", "ts_mono": 3.1},
+    ]
+    downtimes = aggregate._downtimes_ms(runs)
+    assert downtimes == [pytest.approx(500.0)]
+
+
+def test_gang_report_merges_operator_chosen_obs_dir(tmp_path):
+    """An operator's explicit FLAGS_obs_dir wins the supervisor's
+    setdefault injection — the gang report must merge the snapshots from
+    THERE, not from the default workdir/obs."""
+    from paddle_tpu.distributed.supervisor import Supervisor, WorkerSpec
+
+    custom = os.path.join(str(tmp_path), "custom_telemetry")
+    code = (
+        "from paddle_tpu.fluid import profiler\n"
+        "from paddle_tpu.observability import exporter\n"
+        "profiler.bump_counter('train_steps', 3)\n"
+        "assert exporter.final_snapshot() is not None\n"
+    )
+    spec = WorkerSpec(
+        [sys.executable, "-c", code],
+        env={"PADDLE_TRAINER_ID": "0", "FLAGS_obs_dir": custom},
+        rank=0,
+    )
+    sup = Supervisor(
+        [spec], workdir=str(tmp_path), max_restarts=0, poll_s=0.02
+    )
+    assert sup.run() == 0
+    report = json.load(
+        open(os.path.join(str(tmp_path), aggregate.GANG_REPORT))
+    )
+    assert os.path.isfile(registry.snapshot_path(custom, 0))
+    assert report["ranks_reporting"] == [0]
+    assert report["per_rank"]["0"]["counters"]["train_steps"] == 3
+
+
+def test_supervisor_emits_gang_report_after_chaos_restart(tmp_path):
+    """Acceptance: a chaos-crashed gang member triggers a restart, every
+    rank leaves a telemetry snapshot (FLAGS_obs_dir injected by the
+    supervisor), and the supervisor merges them into gang_report.json."""
+    from paddle_tpu.distributed.supervisor import Supervisor, WorkerSpec
+
+    code = (
+        "from paddle_tpu.fluid import profiler\n"
+        "from paddle_tpu.testing import chaos\n"
+        "from paddle_tpu.observability import exporter\n"
+        "for i in range(5):\n"
+        "    profiler.bump_counter('train_steps')\n"
+        "    profiler.bump_histogram('train_step_ms', 1.0 + i)\n"
+        "    chaos.on_step(i)\n"  # rank 0 SIGKILLs itself once at step 2
+        "assert exporter.final_snapshot() is not None\n"
+    )
+    specs = []
+    for r in range(2):
+        env = {
+            "PADDLE_TRAINER_ID": str(r),
+            "FLAGS_chaos_crash_at_step": "2",
+            "FLAGS_chaos_target_rank": "0",
+            "FLAGS_chaos_marker_dir": os.path.join(str(tmp_path), "markers"),
+        }
+        specs.append(WorkerSpec(
+            [sys.executable, "-c", code], env=env,
+            log_path=os.path.join(str(tmp_path), "workerlog.%d" % r),
+            rank=r,
+        ))
+    sup = Supervisor(
+        specs, workdir=str(tmp_path), max_restarts=2,
+        backoff_base_s=0.05, backoff_max_s=0.1, poll_s=0.02,
+        sigterm_grace_s=1.0,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_used == 1
+    path = os.path.join(str(tmp_path), aggregate.GANG_REPORT)
+    report = json.load(open(path))
+    assert report["outcome"] == "gang_done"
+    assert report["restarts"] == 1 and report["crashes"] == 1
+    assert report["ranks_reporting"] == [0, 1]
+    for r in ("0", "1"):
+        rank_rec = report["per_rank"][r]
+        assert rank_rec["counters"]["train_steps"] == 5
+        assert rank_rec["step_time_ms"]["count"] == 5
+    assert report["downtime_ms"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CI lint + the closed-loop probe
+# ---------------------------------------------------------------------------
+def test_obs_flags_lint_clean():
+    """Satellite: every FLAGS_obs_* knob is registered in fluid/flags.py
+    and documented in README.md, and none is dead."""
+    import flags_lint
+
+    assert flags_lint.lint() == []
+
+
+def test_obs_probe_fast_acceptance():
+    """ISSUE 5 closed loop: trace validates with nested spans from every
+    wired layer, /metrics round-trips every counter, tracer overhead on
+    the step path <2%."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "obs_probe.py"), "--fast"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=""),
+    )
+    assert p.returncode == 0, "probe failed:\n%s\n%s" % (
+        p.stdout[-3000:], p.stderr[-2000:]
+    )
+    assert "PROBE PASS" in p.stdout
+    report_line = next(
+        ln for ln in p.stdout.splitlines() if ln.startswith("REPORT ")
+    )
+    report = json.loads(report_line[len("REPORT "):])
+    assert report["overhead"]["overhead_pct"] < 2.0
+    assert report["trace"]["spans"] > 0

@@ -519,3 +519,34 @@ def test_server_deadline_shed_and_stats_surface():
             assert st.bucket_hit_rate == 1.0
         finally:
             server.stop()
+
+
+def test_server_stop_leaves_final_snapshot(tmp_path):
+    """FLAGS_obs_dir with the default snapshot interval 0 means ONE
+    final snapshot — a serving-only process (which never runs the
+    trainer's finally) must leave it at stop()."""
+    from paddle_tpu.observability import exporter as obs_exporter
+    from paddle_tpu.observability import registry as obs_registry
+
+    obs_dir = str(tmp_path / "obs")
+    fluid.set_flags({"FLAGS_obs_dir": obs_dir})
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            _save_tiny_model(d)
+            pred = inference.create_paddle_predictor(
+                inference.AnalysisConfig(d)
+            )
+            x = np.random.RandomState(4).rand(1, 8).astype("float32")
+            server = serving.InferenceServer(
+                pred, max_batch_size=2, batch_timeout_ms=5, queue_depth=4,
+                num_workers=1,
+            ).start(warmup_inputs=[x])
+            try:
+                (out,) = server.infer([x], deadline_ms=5000)
+                assert out.shape == (1, 3)
+            finally:
+                server.stop()
+        assert os.path.isfile(obs_registry.snapshot_path(obs_dir))
+    finally:
+        obs_exporter.stop_global()
+        fluid.set_flags({"FLAGS_obs_dir": ""})

@@ -52,6 +52,18 @@ for _p in (REPO, TOOLS):
 
 STEPS = 9
 INTERVAL = 3
+REPORT_SCHEMA_VERSION = 1
+
+
+def _finalize_report(report):
+    """Stamp the machine-readable REPORT line: schema_version, wall-clock
+    ``ts`` (for humans / cross-host correlation) and monotonic
+    ``ts_mono`` (interval math that survives NTP steps) — the same
+    contract as supervisor.log events and observability snapshots."""
+    report["schema_version"] = REPORT_SCHEMA_VERSION
+    report["ts"] = time.time()
+    report["ts_mono"] = time.monotonic()
+    return report
 
 
 # -- worker ------------------------------------------------------------------
@@ -356,6 +368,7 @@ def run_probe(args):
         "dist_hang_kills": profiler.get_counter("dist_hang_kills"),
         "wall_s": time.time() - t0,
     }
+    _finalize_report(report)
     print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
     print(
         "PROBE PASS: %d kill + %d hang trials, %d gang restarts, 0 "

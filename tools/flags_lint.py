@@ -1,0 +1,100 @@
+"""Knob lint (op_audit.py-style consistency check, run inside tier-1).
+
+Every ``FLAGS_obs_*`` knob must be (1) registered in
+``paddle_tpu/fluid/flags.py`` — an unregistered reference silently reads
+its fallback and ``FLAGS_`` env vars for it are dropped by the bridge —
+and (2) mentioned in README.md, so the Observability quickstart can't
+drift behind the code. The reverse direction is linted too: a registered
+``obs_*`` flag nobody reads is a dead knob.
+
+Run standalone (``python tools/flags_lint.py``, exit 1 on findings) or
+via ``tests/test_observability.py::test_obs_flags_lint_clean``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# both spellings a knob is consumed under: the env-bridge name and the
+# get_flag/set_flags key
+_REF_PATTERNS = (
+    re.compile(r"FLAGS_(obs_[a-z0-9_]+)"),
+    re.compile(r"""get_flag\(\s*['"](obs_[a-z0-9_]+)['"]"""),
+)
+_SCAN_DIRS = ("paddle_tpu", "tools", "tests")
+_FLAGS_PY = os.path.join("paddle_tpu", "fluid", "flags.py")
+
+
+def find_obs_flag_refs():
+    """{flag_name: [relpath, ...]} for every obs_* knob referenced in
+    Python sources (the flags registry file itself excluded — defining a
+    flag is not consuming it)."""
+    refs = {}
+    for top in _SCAN_DIRS:
+        for root, _dirs, files in os.walk(os.path.join(REPO, top)):
+            if "__pycache__" in root:
+                continue
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                rel = os.path.relpath(path, REPO)
+                if rel == _FLAGS_PY:
+                    continue
+                with open(path, errors="replace") as f:
+                    text = f.read()
+                for pat in _REF_PATTERNS:
+                    for m in pat.finditer(text):
+                        refs.setdefault(m.group(1), []).append(rel)
+    return refs
+
+
+def lint():
+    """Returns a list of human-readable problem strings (empty = clean)."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.fluid import flags
+
+    refs = find_obs_flag_refs()
+    with open(os.path.join(REPO, "README.md"), errors="replace") as f:
+        readme = f.read()
+    problems = []
+    for name in sorted(refs):
+        where = ", ".join(sorted(set(refs[name]))[:3])
+        if not flags.is_registered(name):
+            problems.append(
+                "FLAGS_%s referenced (%s) but not registered in %s"
+                % (name, where, _FLAGS_PY)
+            )
+        if "FLAGS_" + name not in readme:
+            problems.append(
+                "FLAGS_%s referenced (%s) but not documented in README.md"
+                % (name, where)
+            )
+    registered = {
+        n for n in flags._DEFAULTS if n.startswith("obs_")
+    }
+    for name in sorted(registered - set(refs)):
+        problems.append(
+            "FLAGS_%s registered in %s but never read anywhere (dead knob)"
+            % (name, _FLAGS_PY)
+        )
+    return problems
+
+
+def main():
+    problems = lint()
+    for p in problems:
+        print("LINT: %s" % p)
+    if problems:
+        return 1
+    print("flags lint clean: %d obs_* knobs registered + documented"
+          % len(find_obs_flag_refs()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
