@@ -31,6 +31,23 @@ twist: every rank stages ``tmp.step_<N>/shard_<rank>/`` independently
 staging dir as the per-shard commit marker), and rank 0 alone performs
 the publish once all shard manifests exist — mirroring the sharded
 inference export's manifest conventions (inference SHARD_MANIFEST).
+
+Elastic N->M restore (the resize contract, per arXiv:2112.01075's
+redistribution framing — ours is filesystem-mediated, not collective):
+``restore`` accepts a checkpoint written by N ranks into a manager with
+M ranks. TP vars (``dist_attrs``) are concatenated along their saved
+axis and re-sliced into M contiguous ``np.array_split`` pieces (exact
+concat: the M pieces joined along the axis reproduce the N pieces
+joined, bit for bit); replicated params and optimizer accumulators
+(arXiv:2004.13336's per-replica weight-update state) pass through
+bit-exactly — every rank reads all shards, so the round-robin write
+partition at N is invisible at M. The N=1 edge replicates-and-partitions:
+a var saved unsharded but listed in the restoring manager's
+``dist_attrs`` is sliced to this rank's piece. Each manifest stamps the
+gang ``world_size`` at save time and every restore records
+``last_restore_info`` (step, world_size_saved, resharded, reshard_ms) —
+``distributed/elastic.maybe_rescale_lr`` keys off it so LR corrections
+never compound across repeated degraded resumes.
 """
 
 from __future__ import annotations
@@ -169,6 +186,11 @@ class CheckpointManager(object):
         self.rank = int(rank)
         self.nranks = int(nranks)
         self.dist_attrs = dict(dist_attrs or {})
+        # stamped by every successful restore(): {step, world_size_saved,
+        # nranks_saved, resharded, reshard_ms}. Elasticity-aware callers
+        # (trainer LR rescale) read the world size the checkpoint was
+        # SAVED at from here rather than assuming the submitted topology.
+        self.last_restore_info = None
         os.makedirs(self.dirname, exist_ok=True)
         # resume-time hygiene: a crashed run's staging dirs are garbage.
         # Only rank 0 sweeps (peers may be slower to start, but no save
@@ -250,10 +272,13 @@ class CheckpointManager(object):
 
     def restore(self, program=None, scope=None, step=None, executor=None):
         """Load ``step`` (default: latest committed) into the scope,
-        verifying every tensor's crc32 against the manifest. Returns the
-        restored step. Raises CheckpointError when nothing is committed
-        and ChecksumError on corruption."""
+        verifying every tensor's crc32 against the manifest. Accepts a
+        checkpoint written at any shard count (see module docstring:
+        N->M resharding). Returns the restored step. Raises
+        CheckpointError when nothing is committed and ChecksumError on
+        corruption."""
         from ..fluid import core
+        from ..fluid import profiler as _profiler
         from ..fluid.framework import default_main_program
 
         program = program or default_main_program()
@@ -272,19 +297,43 @@ class CheckpointManager(object):
             )
         with open(manifest_path) as f:
             manifest = json.load(f)
+        nranks_saved = int(manifest.get("nranks", 1))
         state = {}
-        if manifest.get("nranks", 1) > 1:
+        if nranks_saved > 1:
             for shard in manifest["shards"]:
                 self._read_shard(
                     os.path.join(step_dir, shard["dir"]), state
                 )
-            state = self._reassemble(state)
         else:
             self._read_shard(step_dir, state)
-            state = {name: val for name, (val, _dist) in state.items()}
+        t0 = time.perf_counter()
+        state, resliced = self._reassemble(state)
+        reshard_ms = (time.perf_counter() - t0) * 1000.0
+        resharded = nranks_saved != self.nranks and resliced > 0
+        if resharded:
+            _profiler.bump_counter("ckpt_resharded_restores")
+            _profiler.bump_histogram("ckpt_reshard_ms", reshard_ms)
         for name, val in state.items():
             scope.set(name, val)
         self._restore_rng(manifest, program, scope)
+        self.last_restore_info = {
+            "step": int(manifest["step"]),
+            "nranks_saved": nranks_saved,
+            # the gang size the writing job ran at. Manifests predating
+            # the stamp report None — NOT the shard count, which is 1
+            # for per-rank managers regardless of gang size, and a wrong
+            # "saved at world 1" claim would make maybe_rescale_lr
+            # multiply the LR by the full world. Unknown provenance must
+            # read as "assume the submitted topology" (the rescaler's
+            # None fallback), i.e. no correction.
+            "world_size_saved": (
+                int(manifest["world_size"])
+                if manifest.get("world_size") else None
+            ),
+            "resharded": resharded,
+            "resliced_vars": resliced,
+            "reshard_ms": reshard_ms,
+        }
         return int(manifest["step"])
 
     def restore_or_initialize(self, program=None, executor=None,
@@ -533,10 +582,17 @@ class CheckpointManager(object):
         return offset
 
     def _publish(self, tmp_dir, final_dir, step, snap, shards):
+        from ..distributed import elastic as _elastic
+
         manifest = {
             "format": _FORMAT,
             "step": int(step),
             "nranks": self.nranks,
+            # the gang size the writing JOB ran at (>= nranks when each
+            # rank keeps its own checkpoint dir): a later restore reads
+            # it back as world_size_saved so elasticity-aware LR math is
+            # relative to the topology that produced these tensors
+            "world_size": _elastic.world_info().world_size,
             "rng_run_index": snap.get("rng_run_index"),
         }
         if shards is not None:
@@ -626,16 +682,34 @@ class CheckpointManager(object):
                 )
 
     def _reassemble(self, state):
-        """Replicated vars pass through. Dist-sharded vars: a single-rank
-        restore (gather/export) concatenates all shards to the full
-        value; a sharded restore (this manager has nranks > 1 and the var
-        in its dist_attrs) yields THIS rank's local shard — picked up
-        directly when the topology matches, re-sliced from the full value
-        when restoring into a different nranks (resharded restore)."""
+        """-> (out, resliced_count). Replicated vars pass through
+        bit-exactly. Dist-sharded vars: a single-rank restore
+        (gather/export) concatenates all shards to the full value; a
+        sharded restore (this manager has nranks > 1 and the var in its
+        dist_attrs) yields THIS rank's local shard — picked up directly
+        when the topology matches, re-sliced from the concatenated full
+        value when restoring into a different nranks (N->M resharding).
+        The N=1 edge (saved unsharded, restored sharded) replicates the
+        full value and partitions it. ``resliced_count`` counts vars
+        whose bytes had to be regrouped (concat and/or re-split) —
+        topology-matched pickups and pass-throughs are free."""
         out = {}
+        resliced = 0
         for name, (val, dist) in state.items():
             if dist is None:
-                out[name] = val
+                if self.nranks > 1 and name in self.dist_attrs:
+                    # replicate-and-partition: the checkpoint holds the
+                    # full (unsharded) value but THIS manager wants a
+                    # TP shard of it
+                    out[name] = np.array_split(
+                        np.asarray(
+                            val.numpy() if hasattr(val, "numpy") else val
+                        ),
+                        self.nranks, axis=int(self.dist_attrs[name]),
+                    )[self.rank]
+                    resliced += 1
+                else:
+                    out[name] = val
                 continue
             pieces = [val[r] for r in sorted(val)]
             if len(pieces) != int(dist["nranks"]):
@@ -653,9 +727,11 @@ class CheckpointManager(object):
                     out[name] = np.array_split(
                         full, self.nranks, axis=axis
                     )[self.rank]
+                    resliced += 1
             else:
                 out[name] = np.concatenate(pieces, axis=saved_axis)
-        return out
+                resliced += 1
+        return out, resliced
 
     def _iter_step_tensors(self, step=None):
         if step is None:

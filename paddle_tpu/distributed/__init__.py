@@ -3,5 +3,6 @@
 ``launch`` keeps the reference CLI; ``supervisor`` is the elastic layer
 under it (heartbeat liveness, gang teardown, restart-with-resume)."""
 
+from . import elastic  # noqa: F401
 from . import supervisor  # noqa: F401
 from . import launch  # noqa: F401

@@ -55,6 +55,22 @@ def _parse_args(argv=None):
         "checkpoint); 0 keeps the legacy fail-fast behavior",
     )
     parser.add_argument(
+        "--max_preempt_restarts", type=int, default=None,
+        help="separate restart budget for PREEMPTED workers (exit 143 / "
+        "SIGTERM death / unspawnable slot): on a preemptible pool these "
+        "are the normal lifecycle and must not consume --max_restarts "
+        "(default FLAGS_dist_max_preempt_restarts)",
+    )
+    parser.add_argument(
+        "--min_world_size", type=int, default=None,
+        help="elastic resize floor: a restart may shrink the gang to "
+        "the launchable survivors (rank ids remapped contiguously, new "
+        "topology injected via PADDLE_TPU_WORLD_SIZE/PADDLE_TPU_RANK) "
+        "as long as at least this many remain, growing back when downed "
+        "slots return; unset/0 = fixed-size restarts only "
+        "(default FLAGS_elastic_min_world_size)",
+    )
+    parser.add_argument(
         "--heartbeat_timeout_s", type=float, default=None,
         help="hang watchdog: a running worker whose heartbeat file "
         "(written each step by the trainer) goes stale beyond this is "
@@ -136,6 +152,8 @@ def start_procs(args):
         build_specs(args),
         workdir=workdir,
         max_restarts=args.max_restarts,
+        max_preempt_restarts=args.max_preempt_restarts,
+        min_world_size=args.min_world_size,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
         startup_grace_s=args.startup_grace_s,
         sigterm_grace_s=args.sigterm_grace_s,

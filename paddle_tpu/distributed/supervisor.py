@@ -22,7 +22,27 @@ and the job dies without retry. The supervisor closes the loop:
   (``FLAGS_dist_restart_backoff_s`` base, capped) under a restart budget
   (``max_restarts``); workers resume bit-exactly through
   ``CheckpointManager.restore_or_initialize`` (PR 3) — the supervisor
-  itself is stateless about training progress.
+  itself is stateless about training progress. Workers that exit 143 /
+  die to SIGTERM are *preempted*, not crashed: they draw from a separate
+  (generous) ``max_preempt_restarts`` budget, so a preemption-churny
+  pool can't eat the crash-loop budget.
+- **Elastic resize** (any explicit ``min_world_size``): every restart
+  re-plans the gang instead of assuming the full spec list. A
+  launchability probe (``elastic.read_down_marker`` over
+  ``workdir/avail/down_slot_<r>.json`` — written by the chaos
+  ``lose_rank`` fault, by the supervisor itself on a spawn failure, or
+  by an external scheduler) picks the available slots; the gang shrinks
+  to the survivors (never below ``min_world_size``), rank ids are
+  remapped contiguously, and the new topology is injected via
+  ``PADDLE_TPU_WORLD_SIZE`` / ``PADDLE_TPU_RANK`` (plus remapped legacy
+  ``PADDLE_TRAINER_*`` / ``JAX_*`` vars when the spec carried them).
+  When a marker expires — ``down_for`` plans have observed it, or the
+  file is deleted — the slot rejoins at the next restart boundary and
+  the gang grows back. Resize decisions land as ``gang_resize`` events
+  and the ``dist_resizes`` counter; each ``gang_start`` records the
+  attempt's world size and rank->pid map so a resized run is auditable
+  post-hoc. (Single-node scope: remapping cannot re-home a lost
+  multi-node DCN coordinator — that needs a rendezvous service.)
 - **Observability**: structured JSONL events in ``supervisor.log``
   (gang_start / worker_exit / crash_detected / hang_detected /
   gang_teardown / restart / gang_done / giveup / preempted; each
@@ -46,6 +66,8 @@ import subprocess
 import sys
 import threading
 import time
+
+from . import elastic
 
 __all__ = [
     "HEARTBEAT_ENV",
@@ -207,7 +229,19 @@ class GangOutcome(object):
     DONE = "done"
     CRASH = "crash"
     HANG = "hang"
-    PREEMPTED = "preempted"
+    PREEMPTED = "preempted"  # the SUPERVISOR caught SIGTERM: exit 143
+    # one WORKER exited 143 / died to SIGTERM (slice preemption): restart
+    # under the separate preempt budget, re-planning the world size
+    WORKER_PREEMPT = "worker_preempt"
+
+
+class _SpawnFailed(Exception):
+    """A worker could not be spawned (its slot is unlaunchable)."""
+
+    def __init__(self, slot, error):
+        super().__init__("slot %s: %s" % (slot, error))
+        self.slot = slot
+        self.error = error
 
 
 class Supervisor(object):
@@ -224,10 +258,34 @@ class Supervisor(object):
                  heartbeat_timeout_s=None, startup_grace_s=None,
                  backoff_base_s=None, backoff_max_s=None,
                  sigterm_grace_s=5.0, poll_s=0.1, seed=None,
-                 echo_events=False):
+                 echo_events=False, min_world_size=None,
+                 max_preempt_restarts=None):
         self.specs = list(specs)
         self.workdir = str(workdir)
         self.max_restarts = int(max_restarts)
+        # preemptions (worker exit 143 / SIGTERM death, spawn failure on
+        # a downed slot) draw from their own, deliberately generous,
+        # budget: on a preemptible pool they are the NORMAL lifecycle,
+        # and must not eat the crash-loop budget
+        self.max_preempt_restarts = int(
+            _flag("dist_max_preempt_restarts", 100)
+            if max_preempt_restarts is None else max_preempt_restarts
+        )
+        # elastic floor: a restart may shrink the gang to the launchable
+        # survivors as long as at least this many remain. Unset/0 means
+        # "full size only" — the PR 4 fixed-gang behavior (availability
+        # markers are then ignored entirely).
+        mws = int(
+            _flag("elastic_min_world_size", 0)
+            if min_world_size is None else min_world_size
+        )
+        self.min_world_size = (
+            min(mws, len(self.specs)) if mws > 0 else len(self.specs)
+        )
+        # any explicit floor arms the availability probe — even a floor
+        # equal to the world size (then a downed slot means giveup, not
+        # a blind full-size launch that crash-loops on the dead host)
+        self._elastic = mws > 0
         self.heartbeat_timeout_s = float(
             _flag("dist_heartbeat_timeout_s", 60.0)
             if heartbeat_timeout_s is None else heartbeat_timeout_s
@@ -267,10 +325,24 @@ class Supervisor(object):
         self.sigterm_grace_s = float(sigterm_grace_s)
         self.poll_s = float(poll_s)
         self.restarts_used = 0
+        self.preempt_restarts_used = 0
+        self.resizes = 0
         self.failure_report = None
         os.makedirs(self.workdir, exist_ok=True)
         self._hb_dir = os.path.join(self.workdir, "heartbeats")
         os.makedirs(self._hb_dir, exist_ok=True)
+        # availability markers (elastic.read_down_marker) live here; one
+        # file per SLOT (the spec's stable global rank) — written by the
+        # chaos lose_rank fault, by _start_gang on a spawn failure, or
+        # by an external scheduler marking a host down
+        self._avail_dir = os.path.join(self.workdir, "avail")
+        os.makedirs(self._avail_dir, exist_ok=True)
+        # the previous attempt's plan (resize detection by MEMBERSHIP,
+        # not just size: one slot returning while another goes down is a
+        # resize even at constant world size); a fresh supervisor
+        # measures its first plan against the full spec list, so
+        # starting degraded IS a resize event
+        self._plan_prev = list(range(len(self.specs)))
         # per-rank telemetry snapshots land here (FLAGS_obs_dir injected
         # into every worker env below); aggregate.py merges them + this
         # log into workdir/gang_report.json. _obs_dir is the injected
@@ -304,22 +376,86 @@ class Supervisor(object):
             }
 
     def run(self):
+        from ..fluid import profiler as _profiler
+
         prev = self._install_sigterm()
+        # run boundary for log consumers (aggregate._last_run): in a
+        # reused workdir the report must scope to THIS run, and the
+        # first in-run event is not always a gang_start — a supervisor
+        # that starts degraded emits gang_resize first, one that starts
+        # below the floor emits only giveup
+        self.log.event(
+            "supervisor_boot", world_size=len(self.specs),
+            min_world_size=self.min_world_size,
+            max_restarts=self.max_restarts,
+            max_preempt_restarts=self.max_preempt_restarts,
+        )
         try:
             attempt = 0
             t_detect = None
             while True:
-                self._start_gang(attempt)
-                if t_detect is not None:
-                    # MTTR as documented: failure detection -> the
-                    # replacement gang is SPAWNED (spawn cost included)
-                    from ..fluid import profiler as _profiler
-
-                    _profiler.bump_histogram(
-                        "dist_downtime_ms",
-                        (time.monotonic() - t_detect) * 1000.0,
+                t_plan = time.monotonic()
+                plan = self._plan_gang()
+                if len(plan) < self.min_world_size:
+                    # fewer launchable slots than the floor: a resize
+                    # cannot save this gang — structured giveup, the
+                    # scheduler resubmits when capacity returns
+                    self.failure_report = {
+                        "reason": "insufficient_ranks",
+                        "available": len(plan),
+                        "min_world_size": self.min_world_size,
+                        "world_size": len(self.specs),
+                        "workdir": self.workdir,
+                    }
+                    self.log.event("giveup", **self.failure_report)
+                    return 1
+                if plan != self._plan_prev:
+                    self.resizes += 1
+                    _profiler.bump_counter("dist_resizes")
+                    down = sorted(
+                        set(self._slot(i) for i in range(len(self.specs)))
+                        - set(self._slot(i) for i in plan)
                     )
-                outcome, detail = self._monitor()
+                    self.log.event(
+                        "gang_resize", restart=attempt,
+                        from_world=len(self._plan_prev),
+                        to_world=len(plan),
+                        down_slots=down,
+                        plan_ms=round(
+                            (time.monotonic() - t_plan) * 1000.0, 3
+                        ),
+                    )
+                self._plan_prev = plan
+                try:
+                    self._start_gang(attempt, plan)
+                except _SpawnFailed as e:
+                    # the slot is unlaunchable right now: mark it down
+                    # for one planning round and treat the attempt as a
+                    # preemption (bounded by the preempt budget). With
+                    # elasticity off there is no replanning that could
+                    # ever succeed differently — keep PR 4's fail-fast.
+                    if not self._elastic:
+                        raise e.error
+                    elastic.write_down_marker(
+                        self._down_path(e.slot), down_for=1, slot=e.slot,
+                        from_attempt=attempt, reason="spawn_failed",
+                    )
+                    self.log.event(
+                        "spawn_failed", restart=attempt, slot=e.slot,
+                        error=str(e.error),
+                    )
+                    outcome = GangOutcome.WORKER_PREEMPT
+                    detail = {"slot": e.slot, "spawn_error": str(e.error)}
+                else:
+                    if t_detect is not None:
+                        # MTTR as documented: failure detection -> the
+                        # replacement gang is SPAWNED (spawn cost
+                        # included)
+                        _profiler.bump_histogram(
+                            "dist_downtime_ms",
+                            (time.monotonic() - t_detect) * 1000.0,
+                        )
+                    outcome, detail = self._monitor()
                 t_detect = time.monotonic()
                 if outcome == GangOutcome.DONE:
                     self.log.event("gang_done", restart=attempt)
@@ -328,29 +464,52 @@ class Supervisor(object):
                     self._teardown("preempted", self.sigterm_grace_s)
                     self.log.event("preempted", restart=attempt)
                     return 128 + signal.SIGTERM
-                # crash or hang: the gang is torn — kill it whole
-                from ..fluid import profiler as _profiler
-
+                # crash / hang / worker preemption: the gang is torn —
+                # kill it whole
                 if outcome == GangOutcome.HANG:
                     _profiler.bump_counter("dist_hang_kills")
                 self._teardown(outcome, self.sigterm_grace_s)
-                if self.restarts_used >= self.max_restarts:
+                preempt = outcome == GangOutcome.WORKER_PREEMPT
+                used = (
+                    self.preempt_restarts_used if preempt
+                    else self.restarts_used
+                )
+                budget = (
+                    self.max_preempt_restarts if preempt
+                    else self.max_restarts
+                )
+                if used >= budget:
                     self.failure_report = {
                         "restarts_used": self.restarts_used,
                         "max_restarts": self.max_restarts,
+                        "preempt_restarts_used": self.preempt_restarts_used,
+                        "max_preempt_restarts": self.max_preempt_restarts,
                         "last_failure": dict(detail, kind=outcome),
                         "workdir": self.workdir,
                     }
                     self.log.event("giveup", **self.failure_report)
                     return 1
-                self.restarts_used += 1
+                if preempt:
+                    self.preempt_restarts_used += 1
+                else:
+                    self.restarts_used += 1
+                attempt += 1
                 _profiler.bump_counter("dist_restarts")
+                # backoff escalates with the CRASH count only: crashes
+                # look like a loop worth damping, while preemptions are
+                # the pool's normal lifecycle (that's why they have
+                # their own generous budget) — penalizing the 7th
+                # preemption with backoff_max would inflate MTTR
+                # exactly where elasticity is supposed to help
+                exponent = 1 if preempt else self.restarts_used
                 delay = min(
-                    self.backoff_base_s * (2.0 ** (self.restarts_used - 1)),
+                    self.backoff_base_s * (2.0 ** (exponent - 1)),
                     self.backoff_max_s,
                 ) * (0.5 + 0.5 * self._rng.random())  # decorrelating jitter
                 self.log.event(
-                    "restart", restart=self.restarts_used, backoff_s=delay,
+                    "restart", restart=attempt, backoff_s=delay,
+                    restarts_used=self.restarts_used,
+                    preempt_restarts_used=self.preempt_restarts_used,
                     cause=dict(detail, kind=outcome),
                 )
                 # merged telemetry checkpoint at every restart: an
@@ -363,7 +522,6 @@ class Supervisor(object):
                 if self._preempted.wait(delay):
                     self.log.event("preempted", restart=attempt)
                     return 128 + signal.SIGTERM
-                attempt = self.restarts_used
         finally:
             # exception/Ctrl-C unwind: the full SIGTERM grace applies —
             # workers' preemption handlers may be mid final-save, and
@@ -420,7 +578,63 @@ class Supervisor(object):
     def _hb_path(self, rank):
         return os.path.join(self._hb_dir, "heartbeat_%d.json" % rank)
 
-    def _start_gang(self, attempt):
+    def _slot(self, i):
+        """A spec's stable identity: its global rank (or list index)."""
+        spec = self.specs[i]
+        return spec.rank if spec.rank is not None else i
+
+    def _down_path(self, slot):
+        return os.path.join(self._avail_dir, "down_slot_%d.json" % slot)
+
+    def _plan_gang(self):
+        """Launchability probe -> spec indices for the next attempt.
+
+        A slot with a live down marker is excluded; attempt-counted
+        markers (``down_for >= 0``) expire after that many planning
+        rounds have observed them — counted in the marker itself, so
+        expiry is deterministic across supervisor restarts — and
+        open-ended markers (``down_for < 0``) hold until the file is
+        deleted. With elasticity off the probe is skipped entirely: the
+        plan is always the full spec list (PR 4 behavior)."""
+        if not self._elastic:
+            return list(range(len(self.specs)))
+        plan = []
+        for i in range(len(self.specs)):
+            slot = self._slot(i)
+            path = self._down_path(slot)
+            marker = elastic.read_down_marker(path)
+            if marker is None:
+                plan.append(i)
+                continue
+            down_for = int(marker.get("down_for", -1))
+            seen = int(marker.get("attempts_down", 0))
+            if 0 <= down_for <= seen:
+                # the spare returned: clear the marker so the slot
+                # rejoins this plan (and stays launchable)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                plan.append(i)
+                continue
+            if down_for >= 0:
+                elastic.write_down_marker(
+                    path, down_for=down_for, slot=slot,
+                    from_attempt=marker.get("from_attempt"),
+                    attempts_down=seen + 1,
+                    reason=marker.get("reason"),
+                )
+        return plan
+
+    def _start_gang(self, attempt, plan=None):
+        """Spawn the gang for this attempt: one worker per planned spec,
+        ranks remapped contiguously (gang position == rank), topology
+        injected via the elastic env contract. ``plan`` is the list of
+        spec indices (default: all)."""
+        if plan is None:
+            plan = list(range(len(self.specs)))
+        world = len(plan)
+        resized = plan != list(range(len(self.specs)))
         # previous attempt's log handles are dead with their processes
         for f in self._log_files:
             try:
@@ -449,16 +663,44 @@ class Supervisor(object):
         # an NTP step of the wall clock can neither forge a hang nor
         # mask one
         self._hb_seen = {}
-        for i, spec in enumerate(self.specs):
+        for j, idx in enumerate(plan):
+            spec = self.specs[idx]
+            slot = self._slot(idx)
             env = dict(os.environ)
             env.update(spec.env)
-            env[HEARTBEAT_ENV] = self._hb_path(i)
+            env[HEARTBEAT_ENV] = self._hb_path(j)
             env[RESTART_ENV] = str(attempt)
+            # the elastic topology contract: new rank = gang position,
+            # slot = the spec's stable identity (chaos faults and down
+            # markers address slots, not remapped ranks)
+            env[elastic.WORLD_ENV] = str(world)
+            env[elastic.RANK_ENV] = str(j)
+            env[elastic.BASE_WORLD_ENV] = str(len(self.specs))
+            env[elastic.SLOT_ENV] = str(slot)
+            env[elastic.DOWN_FILE_ENV] = self._down_path(slot)
+            if resized:
+                # remap the legacy contract vars the launcher baked into
+                # the spec — a shrunk gang must not see the old topology
+                for key, val in (
+                    ("PADDLE_TRAINER_ID", str(j)),
+                    ("PADDLE_TRAINERS_NUM", str(world)),
+                    ("JAX_PROCESS_ID", str(j)),
+                    ("JAX_NUM_PROCESSES", str(world)),
+                ):
+                    if key in spec.env:
+                        env[key] = val
+                if "PADDLE_TRAINER_ENDPOINTS" in spec.env:
+                    eps = [
+                        self.specs[k].env.get("PADDLE_CURRENT_ENDPOINT")
+                        for k in plan
+                    ]
+                    if all(eps):
+                        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(eps)
             # flags are env-bridged, so this arms per-rank snapshot files
             # in every worker; an operator's explicit FLAGS_obs_dir
             # (spec.env or the supervisor's own environment) wins
             env.setdefault("FLAGS_obs_dir", self._obs_dir)
-            if i == 0:
+            if j == 0:
                 # merge wherever the snapshots actually land
                 self._obs_dir_effective = env["FLAGS_obs_dir"]
             stdout = stderr = None
@@ -471,15 +713,23 @@ class Supervisor(object):
                 fn.flush()
                 self._log_files.append(fn)
                 stdout = stderr = fn
-            p = subprocess.Popen(
-                spec.cmd, env=env, stdout=stdout, stderr=stderr
-            )
+            try:
+                p = subprocess.Popen(
+                    spec.cmd, env=env, stdout=stdout, stderr=stderr
+                )
+            except OSError as e:
+                # this slot cannot spawn a process at all — the elastic
+                # caller marks it down and re-plans around it
+                raise _SpawnFailed(slot, e)
             with self._procs_lock:
                 procs.append((spec, p))
         self._gang_t0 = time.monotonic()
         self.log.event(
             "gang_start", restart=attempt,
             pids=[p.pid for _s, p in procs],
+            world_size=world,
+            slots=[self._slot(idx) for idx in plan],
+            rank_pids={str(j): p.pid for j, (_s, p) in enumerate(procs)},
         )
 
     def _monitor(self):
@@ -501,6 +751,18 @@ class Supervisor(object):
                     finished.add(i)
                     self.log.event("worker_exit", rank=rank, returncode=0)
                     continue
+                if rc in (128 + signal.SIGTERM, -signal.SIGTERM):
+                    # exit 143 / killed by SIGTERM: the worker was
+                    # preempted, not buggy — restart under the separate
+                    # preempt budget (and, when elastic, re-plan the
+                    # world around any slot that marked itself down)
+                    self.log.event(
+                        "worker_preempted", rank=rank, returncode=rc,
+                        pid=p.pid,
+                    )
+                    return GangOutcome.WORKER_PREEMPT, {
+                        "rank": rank, "returncode": rc,
+                    }
                 self.log.event(
                     "crash_detected", rank=rank, returncode=rc, pid=p.pid,
                 )

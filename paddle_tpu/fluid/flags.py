@@ -97,11 +97,37 @@ _DEFAULTS = {
     "dist_startup_grace_s": 600.0,
     "dist_restart_backoff_s": 1.0,
     "dist_restart_backoff_max_s": 30.0,
+    # separate restart budget for PREEMPTED workers (exit 143 / SIGTERM
+    # death / unspawnable slot): on a preemptible pool preemptions are
+    # the normal lifecycle, so the default is generous — a crash-looping
+    # worker still burns --max_restarts
+    "dist_max_preempt_restarts": 100,
+    # elastic resize (distributed/elastic.py + supervisor): a restart
+    # may shrink the gang to the launchable survivors down to this
+    # floor, remapping rank ids contiguously and growing back when
+    # downed slots return; 0 = fixed-size restarts only.
+    "elastic_min_world_size": 0,
+    # opt-in linear LR rescaling for degraded attempts: per-rank batch
+    # stays fixed, so the global batch shrinks by world/base — scale the
+    # program's global learning-rate var(s) by the same factor (applied
+    # relative to the world size the checkpoint was saved at, so resumes
+    # never compound it). Off by default: identical-replica workloads
+    # must NOT rescale.
+    "elastic_lr_rescale": False,
     # deterministic fault injection (paddle_tpu/testing/chaos.py):
     # -1/0/"" = disarmed; target_rank scopes step faults to one gang
     # member; marker_dir makes each fault one-shot across gang restarts
     "chaos_crash_at_step": -1,
     "chaos_hang_at_step": -1,
+    # slice-preemption fault: the worker occupying gang slot
+    # chaos_lose_rank writes its down marker (PADDLE_TPU_DOWN_FILE) at
+    # step chaos_lose_rank_at_step and exits 143; the slot stays
+    # unlaunchable for chaos_lose_rank_for supervisor planning rounds
+    # (-1 = until the marker is deleted), making shrink->regrow
+    # deterministically reproducible
+    "chaos_lose_rank": -1,
+    "chaos_lose_rank_at_step": -1,
+    "chaos_lose_rank_for": -1,
     "chaos_corrupt_ckpt": False,
     "chaos_slow_feed_ms": 0.0,
     "chaos_rpc_fail_n": 0,

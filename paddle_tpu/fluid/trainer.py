@@ -49,6 +49,7 @@ class MultiTrainer(TrainerBase):
         from . import flags as _flags
         from . import io_pipeline as _io_pipeline
         from . import profiler as _profiler
+        from ..distributed import elastic as _elastic
         from ..distributed import supervisor as _sup
         from ..observability import exporter as _obs_exporter
         from ..observability import trace as _trace
@@ -71,6 +72,35 @@ class MultiTrainer(TrainerBase):
         # step from a stalled worker. No-op (hb is None) otherwise.
         hb = _sup.worker_heartbeat()
 
+        # elastic topology: the supervisor re-plans the gang per restart
+        # and injects PADDLE_TPU_WORLD_SIZE/_RANK; running with fewer
+        # ranks than the job was submitted with is a DEGRADED attempt.
+        # This trainer's feed is identical-replica (every rank consumes
+        # the full stream, the dist_crash_probe shape), so each
+        # replica's math is world-size independent and needs NO batch
+        # correction — which is what makes the shrink/regrow digest
+        # check exact. Sharded-stream callers own their micro-batching:
+        # batch_plan() tells them the accumulation factor that would
+        # preserve the global batch (logged here as advisory), and
+        # FLAGS_elastic_lr_rescale is the alternative correction
+        # (applied after restore, relative to the saved world size).
+        winfo = _elastic.world_info()
+        degraded = winfo.world_size < winfo.base_world_size
+        if degraded:
+            plan = _elastic.batch_plan(
+                winfo.base_world_size, winfo.world_size
+            )
+            print(
+                "elastic: DEGRADED attempt — world %d/%d (slot %d -> "
+                "rank %d); identical-replica stream, no batch "
+                "correction applied (a sharded stream would need x%d "
+                "accumulation or FLAGS_elastic_lr_rescale to preserve "
+                "the global batch)"
+                % (winfo.world_size, winfo.base_world_size, winfo.slot,
+                   winfo.rank, plan.accum_steps),
+                flush=True,
+            )
+
         # preemption-safe checkpointing (paddle_tpu/checkpoint): resume at
         # the last committed step (replaying the dataset stream past the
         # already-trained batches — file datasets must iterate
@@ -90,6 +120,16 @@ class MultiTrainer(TrainerBase):
                 program, executor, startup_program=startup_program,
                 scope=scope,
             ) + 1
+            # opt-in LR correction for degraded/regrown attempts, keyed
+            # to the world size the restored checkpoint was SAVED at so
+            # repeated resumes never compound the factor (no-op unless
+            # FLAGS_elastic_lr_rescale)
+            _elastic.maybe_rescale_lr(
+                program, scope=scope,
+                restore_info=getattr(
+                    ckpt_manager, "last_restore_info", None
+                ),
+            )
             ckpt_interval = int(
                 _flags.get_flag("ckpt_save_interval_steps", 0) or 0
             )
@@ -123,6 +163,11 @@ class MultiTrainer(TrainerBase):
                 "train_step_ms", (_time.perf_counter() - t_step) * 1e3
             )
             _profiler.bump_counter("train_steps")
+            if degraded:
+                # steps trained below the submitted world size: the gang
+                # report surfaces this per rank so an operator can see
+                # how much of a run happened degraded
+                _profiler.bump_counter("dist_degraded_steps")
 
         try:
             for feed in pipe:

@@ -40,8 +40,10 @@ _RANK_FILE = re.compile(r"^rank_(\d+)\.jsonl$")
 # whole registry into the report (the full detail stays in the JSONL)
 _RANK_COUNTERS = (
     "train_steps",
+    "dist_degraded_steps",
     "ckpt_saves_committed",
     "ckpt_restore_fallbacks",
+    "ckpt_resharded_restores",
     "executor_plan_cache_hits",
     "executor_plan_cache_misses",
     "pserver_rpc_conn_retries",
@@ -99,7 +101,7 @@ def _downtimes_ms(events):
     detect = None
     for e in events:
         ev = e.get("event")
-        if ev in ("crash_detected", "hang_detected"):
+        if ev in ("crash_detected", "hang_detected", "worker_preempted"):
             detect = e.get(key)
         elif ev in ("gang_done", "giveup", "preempted"):
             detect = None
@@ -132,12 +134,18 @@ def _rank_summary(snap):
 def _last_run(events):
     """The event slice belonging to the NEWEST supervisor run: the log
     appends across runs in a reused workdir, and the report must
-    describe the current gang, not a sum over dead ones. A run begins at
-    a ``gang_start`` with ``restart == 0`` (the only kind a fresh
-    supervisor emits first)."""
+    describe the current gang, not a sum over dead ones. A run begins
+    at a ``supervisor_boot`` event; logs predating it fall back to the
+    newest ``gang_start`` with ``restart == 0`` (which misses a
+    pre-first-start ``gang_resize``/``giveup`` — exactly why the boot
+    event exists)."""
     start = 0
+    booted = any(e.get("event") == "supervisor_boot" for e in events)
     for i, e in enumerate(events):
-        if e.get("event") == "gang_start" and not e.get("restart", 0):
+        if booted:
+            if e.get("event") == "supervisor_boot":
+                start = i
+        elif e.get("event") == "gang_start" and not e.get("restart", 0):
             start = i
     return events[start:]
 
@@ -156,6 +164,18 @@ def gang_report(workdir, obs_dir=None):
     for e in events:  # last terminal event wins
         if e.get("event") in ("gang_done", "giveup", "preempted"):
             terminal = e["event"]
+    # elastic-resize audit trail: one record per gang attempt (the
+    # gang_start events carry the attempt's world size and rank->pid
+    # map since ISSUE 6), so a resized run is reconstructible post-hoc
+    attempts = [
+        {
+            "restart": e.get("restart", 0),
+            "world_size": e.get("world_size"),
+            "slots": e.get("slots"),
+            "rank_pids": e.get("rank_pids"),
+        }
+        for e in events if e.get("event") == "gang_start"
+    ]
     return {
         "schema_version": _registry.SCHEMA_VERSION,
         "ts": time.time(),
@@ -168,6 +188,16 @@ def gang_report(workdir, obs_dir=None):
         ),
         "hang_kills": sum(
             1 for e in events if e.get("event") == "hang_detected"
+        ),
+        "preemptions": sum(
+            1 for e in events if e.get("event") == "worker_preempted"
+        ),
+        "resizes": sum(
+            1 for e in events if e.get("event") == "gang_resize"
+        ),
+        "attempts": attempts,
+        "world_size_final": (
+            attempts[-1]["world_size"] if attempts else None
         ),
         "downtime_ms": _registry.percentiles(downtimes, points=(50, 99)),
         "ranks_reporting": sorted(snaps),

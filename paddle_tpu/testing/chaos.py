@@ -27,6 +27,14 @@ Injection points and their hosts:
 - ``crash_at_step`` / ``hang_at_step`` — ``fluid/trainer.py`` calls
   ``on_step(step)`` at each step boundary (right after the interval
   checkpoint save is enqueued, the worst moment to die).
+- ``lose_rank`` (+ ``lose_rank_at_step`` / ``lose_rank_for``) — slice
+  preemption, the elastic-resize fault: the worker occupying gang SLOT
+  ``lose_rank`` (its stable ``PADDLE_TPU_GANG_SLOT`` identity, not the
+  per-attempt remapped rank) writes its availability down-marker
+  (``PADDLE_TPU_DOWN_FILE``, unlaunchable for ``lose_rank_for``
+  supervisor planning rounds; -1 = until deleted) at the armed step and
+  exits 143 — so the supervisor's next plan must shrink the gang around
+  the slot and grow back when the marker expires, deterministically.
 - ``slow_feed_ms`` — ``fluid/io_pipeline.py``'s producer thread calls
   ``maybe_slow_feed()`` per batch (models a degraded input host).
 - ``corrupt_ckpt`` — the checkpoint writer routes serialized tensor
@@ -74,7 +82,8 @@ class FaultPlan(object):
 
     def __init__(self, crash_at_step=None, hang_at_step=None,
                  corrupt_ckpt=False, slow_feed_ms=0.0, rpc_fail_n=0,
-                 target_rank=None, marker_dir=None):
+                 target_rank=None, marker_dir=None, lose_rank=None,
+                 lose_rank_at_step=None, lose_rank_for=-1):
         self.crash_at_step = crash_at_step
         self.hang_at_step = hang_at_step
         self.corrupt_ckpt = bool(corrupt_ckpt)
@@ -82,6 +91,12 @@ class FaultPlan(object):
         self.rpc_fail_n = int(rpc_fail_n)
         self.target_rank = target_rank
         self.marker_dir = marker_dir
+        # slice-preemption fault: addressed by stable gang SLOT (so it
+        # stays aimed at the same worker across rank remaps), own knob —
+        # target_rank scopes the OTHER step faults, not this one
+        self.lose_rank = lose_rank
+        self.lose_rank_at_step = lose_rank_at_step
+        self.lose_rank_for = int(lose_rank_for)
 
     @classmethod
     def from_flags(cls):
@@ -97,8 +112,11 @@ class FaultPlan(object):
         rpc_n = int(_flags.get_flag("chaos_rpc_fail_n", 0))
         rank = int(_flags.get_flag("chaos_target_rank", -1))
         marker = str(_flags.get_flag("chaos_marker_dir", "") or "")
+        lose = int(_flags.get_flag("chaos_lose_rank", -1))
+        lose_at = int(_flags.get_flag("chaos_lose_rank_at_step", -1))
+        lose_for = int(_flags.get_flag("chaos_lose_rank_for", -1))
         if (crash < 0 and hang < 0 and not corrupt and slow <= 0
-                and rpc_n <= 0):
+                and rpc_n <= 0 and (lose < 0 or lose_at < 0)):
             return None
         return cls(
             crash_at_step=crash if crash >= 0 else None,
@@ -108,6 +126,9 @@ class FaultPlan(object):
             rpc_fail_n=rpc_n,
             target_rank=rank if rank >= 0 else None,
             marker_dir=marker or None,
+            lose_rank=lose if lose >= 0 and lose_at >= 0 else None,
+            lose_rank_at_step=lose_at if lose_at >= 0 else None,
+            lose_rank_for=lose_for,
         )
 
     def targets_me(self):
@@ -116,6 +137,27 @@ class FaultPlan(object):
         return int(os.environ.get("PADDLE_TRAINER_ID", "0")) == int(
             self.target_rank
         )
+
+    def loses_me(self):
+        """lose_rank is armed and aimed at THIS worker's stable slot."""
+        if self.lose_rank is None or self.lose_rank_at_step is None:
+            return False
+        return _my_slot() == int(self.lose_rank)
+
+
+def _my_slot():
+    """This worker's stable gang slot: the elastic contract's
+    PADDLE_TPU_GANG_SLOT when the supervisor injected it, else the
+    legacy trainer id (fixed-size gangs: slot == rank)."""
+    from ..distributed import elastic as _elastic
+
+    raw = os.environ.get(_elastic.SLOT_ENV)
+    if raw is None:
+        raw = os.environ.get("PADDLE_TRAINER_ID", "0")
+    try:
+        return int(raw)
+    except ValueError:
+        return 0
 
 
 def install(plan):
@@ -176,7 +218,32 @@ def on_step(step):
     catch (a SIGTERM-able sleep, so teardown escalation is exercised
     too)."""
     plan = active_plan()
-    if plan is None or not plan.targets_me():
+    if plan is None:
+        return
+    # slice preemption first (slot-addressed, independent of
+    # target_rank): write the down marker, THEN exit 143 — the
+    # supervisor must find the marker when it re-plans the gang
+    if (plan.loses_me()
+            and step == int(plan.lose_rank_at_step)
+            and _fire_once(plan, "lose_rank")):
+        from ..distributed import elastic as _elastic
+
+        down_file = os.environ.get(_elastic.DOWN_FILE_ENV)
+        if down_file:
+            _elastic.write_down_marker(
+                down_file, down_for=plan.lose_rank_for,
+                slot=plan.lose_rank, reason="chaos_lose_rank",
+            )
+        print(
+            "CHAOS lose_rank slot=%d step=%d down_for=%d pid=%d"
+            % (int(plan.lose_rank), step, plan.lose_rank_for,
+               os.getpid()),
+            flush=True,
+        )
+        # exit 143 like a SIGTERMed (preempted) worker, abruptly —
+        # no atexit / finally cleanup, as a real slice loss gives none
+        os._exit(143)
+    if not plan.targets_me():
         return
     if plan.crash_at_step is not None and step == int(plan.crash_at_step):
         if _fire_once(plan, "crash_at_step"):

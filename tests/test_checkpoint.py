@@ -318,6 +318,193 @@ def test_sharded_save_restore_reassembles(tmp_path):
         m.close()
 
 
+# ---------------------------------------------------------------------------
+# elastic N->M resharded restore (ISSUE 6): a checkpoint written at N
+# shards loads into M ranks, independent of the supervisor path
+# ---------------------------------------------------------------------------
+def _tp_prog():
+    """One TP-sharded var (7 columns: odd against every split) + two
+    replicated vars (stand-ins for params and optimizer accumulators)."""
+    with fluid.unique_name.guard():
+        prog = fluid.Program()
+        with fluid.program_guard(prog):
+            for name, shape in (
+                ("tp.w_0", (4, 7)), ("repl_w", (5,)), ("adam_moment", (5,)),
+            ):
+                prog.global_block().create_var(
+                    name=name, shape=shape, dtype="float32",
+                    persistable=True,
+                )
+    return prog
+
+
+_TP_FULL = np.arange(28, dtype=np.float32).reshape(4, 7)
+_REPL = np.linspace(-1.0, 1.0, 5).astype(np.float32)
+
+
+def _save_sharded_at(d, prog, nranks, step=3):
+    """Write one sharded checkpoint with an ``nranks``-rank gang (each
+    rank holds its np.array_split TP piece; replicated vars identical)."""
+    pieces = np.array_split(_TP_FULL, nranks, axis=1)
+    mgrs = [
+        checkpoint.CheckpointManager(
+            d, rank=r, nranks=nranks, dist_attrs={"tp.w_0": 1},
+            commit_timeout_s=30,
+        )
+        for r in range(nranks)
+    ]
+    # peers stage on their async writers first (their publish barrier
+    # waits for rank 0), then rank 0 commits synchronously
+    for r in list(range(1, nranks)) + [0]:
+        sc = fluid.Scope()
+        sc.set("tp.w_0", pieces[r])
+        sc.set("repl_w", _REPL)
+        sc.set("adam_moment", _REPL * 2.0)
+        mgrs[r].save(step, prog, scope=sc, async_=(r != 0))
+    for m in mgrs:
+        m.wait()
+        m.close()
+    assert checkpoint.latest_step(d) == step
+
+
+def _restore_sharded_at(d, prog, nranks):
+    """-> (managers, scopes) after an ``nranks``-rank restore."""
+    out = []
+    for r in range(nranks):
+        m = checkpoint.CheckpointManager(
+            d, rank=r, nranks=nranks, dist_attrs={"tp.w_0": 1},
+        )
+        sc = fluid.Scope()
+        assert m.restore(prog, scope=sc) == 3
+        out.append((m, sc))
+    return out
+
+
+@pytest.mark.parametrize("n,m", [(3, 2), (2, 3), (3, 1), (4, 3)])
+def test_resharded_restore_n_to_m(tmp_path, n, m):
+    """Shrink (N>M), grow (N<M), gather (M=1), odd-split off-by-one
+    boundaries: TP shards re-slice to exact-concat, replicated vars and
+    accumulators pass through bit-exactly on every restoring rank."""
+    d = str(tmp_path / "ck")
+    prog = _tp_prog()
+    _save_sharded_at(d, prog, n)
+    restored = _restore_sharded_at(d, prog, m)
+    want = np.array_split(_TP_FULL, m, axis=1)
+    got = []
+    for r, (mgr, sc) in enumerate(restored):
+        # exact re-slice: rank r holds exactly the M-way split piece
+        assert np.array_equal(np.asarray(sc.get("tp.w_0")), want[r]), r
+        got.append(np.asarray(sc.get("tp.w_0")))
+        # replicated + accumulator state: bit-exact on every rank
+        assert np.asarray(sc.get("repl_w")).tobytes() == _REPL.tobytes()
+        assert np.asarray(
+            sc.get("adam_moment")
+        ).tobytes() == (_REPL * 2.0).tobytes()
+        info = mgr.last_restore_info
+        assert info["nranks_saved"] == n and info["step"] == 3
+        assert info["resharded"] and info["resliced_vars"] >= 1
+        assert info["reshard_ms"] >= 0.0
+        mgr.close()
+    # exact-concat acceptance: the M pieces joined reproduce the N
+    # pieces joined, bit for bit
+    assert np.concatenate(got, axis=1).tobytes() == _TP_FULL.tobytes()
+
+
+def test_resharded_restore_n1_edge_replicates_and_partitions(tmp_path):
+    """N=1 edge: a var saved UNSHARDED by a single-rank manager restores
+    into a sharded manager that lists it in dist_attrs — the full value
+    is replicated and this rank's piece sliced out."""
+    d = str(tmp_path / "ck")
+    prog = _tp_prog()
+    mgr = checkpoint.CheckpointManager(d)
+    sc = fluid.Scope()
+    sc.set("tp.w_0", _TP_FULL)
+    sc.set("repl_w", _REPL)
+    sc.set("adam_moment", _REPL * 2.0)
+    mgr.save(3, prog, scope=sc, async_=False)
+    mgr.close()
+    restored = _restore_sharded_at(d, prog, 2)
+    want = np.array_split(_TP_FULL, 2, axis=1)
+    for r, (m, rsc) in enumerate(restored):
+        assert np.array_equal(np.asarray(rsc.get("tp.w_0")), want[r]), r
+        assert np.asarray(rsc.get("repl_w")).tobytes() == _REPL.tobytes()
+        assert m.last_restore_info["nranks_saved"] == 1
+        assert m.last_restore_info["resharded"]
+        m.close()
+
+
+def test_matched_topology_restore_is_not_counted_as_reshard(tmp_path):
+    """Same-shape restore keeps resharded=False (and the counter still):
+    the topology-matched pickup path stays the bit-copy it always was."""
+    from paddle_tpu.fluid import profiler
+
+    d = str(tmp_path / "ck")
+    prog = _tp_prog()
+    _save_sharded_at(d, prog, 2)
+    before = profiler.get_counter("ckpt_resharded_restores")
+    restored = _restore_sharded_at(d, prog, 2)
+    for r, (m, sc) in enumerate(restored):
+        assert np.array_equal(
+            np.asarray(sc.get("tp.w_0")),
+            np.array_split(_TP_FULL, 2, axis=1)[r],
+        )
+        assert m.last_restore_info["resharded"] is False
+        m.close()
+    assert profiler.get_counter("ckpt_resharded_restores") == before
+
+
+def test_resharded_restore_bumps_counter(tmp_path):
+    from paddle_tpu.fluid import profiler
+
+    d = str(tmp_path / "ck")
+    prog = _tp_prog()
+    _save_sharded_at(d, prog, 3)
+    before = profiler.get_counter("ckpt_resharded_restores")
+    for m, _sc in _restore_sharded_at(d, prog, 2):
+        m.close()
+    assert profiler.get_counter("ckpt_resharded_restores") == before + 2
+
+
+def test_manifest_stamps_saving_world_size(tmp_path, monkeypatch):
+    """The manifest records the gang size the writing JOB ran at (the
+    elastic env contract), read back as last_restore_info
+    world_size_saved — what maybe_rescale_lr keys off."""
+    from paddle_tpu.distributed import elastic
+
+    d = str(tmp_path / "ck")
+    prog = _tp_prog()
+    monkeypatch.setenv(elastic.WORLD_ENV, "4")
+    mgr = checkpoint.CheckpointManager(d)
+    sc = fluid.Scope()
+    sc.set("repl_w", _REPL)
+    mgr.save(3, prog, scope=sc, async_=False)
+    mgr.close()
+    manifest = json.load(
+        open(os.path.join(d, "step_00000003", "manifest.json"))
+    )
+    assert manifest["world_size"] == 4
+    monkeypatch.delenv(elastic.WORLD_ENV)
+    mgr2 = checkpoint.CheckpointManager(d)
+    rsc = fluid.Scope()
+    mgr2.restore(prog, scope=rsc)
+    assert mgr2.last_restore_info["world_size_saved"] == 4
+    assert mgr2.last_restore_info["resharded"] is False
+    mgr2.close()
+    # a manifest predating the stamp reads back UNKNOWN (None), never
+    # the shard count: a per-rank manager's nranks is 1 regardless of
+    # gang size, and a false "saved at world 1" would make
+    # maybe_rescale_lr multiply the LR by the full world — unknown
+    # provenance means "assume the submitted topology", i.e. no rescale
+    mpath = os.path.join(d, "step_00000003", "manifest.json")
+    del manifest["world_size"]
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    mgr3 = checkpoint.CheckpointManager(d)
+    mgr3.restore(prog, scope=fluid.Scope())
+    assert mgr3.last_restore_info["world_size_saved"] is None
+    mgr3.close()
+
+
 def test_preemption_handler_final_save(tmp_path):
     exe = fluid.Executor(fluid.CPUPlace())
     main, startup, loss = _build()

@@ -301,6 +301,364 @@ def test_supervisor_downtime_histogram_records_restart(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# preemption budget (ISSUE 6 satellite: exit 143 != crash)
+# ---------------------------------------------------------------------------
+def test_worker_preemption_draws_from_preempt_budget_not_crash(tmp_path):
+    # worker 0 exits 143 (slice preempted) on its first life and 0 once
+    # the marker exists; max_restarts=0 would kill a CRASH loop dead,
+    # yet the preempt budget must carry the gang to recovery
+    code = (
+        "import os, sys\n"
+        "m = os.path.join(r'%s', 'preempt_marker')\n"
+        "if os.path.exists(m):\n"
+        "    sys.exit(0)\n"
+        "open(m, 'w').close()\n"
+        "sys.exit(143)\n" % str(tmp_path)
+    )
+    sup = Supervisor(
+        [_spec(code, tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=0, max_preempt_restarts=2,
+        backoff_base_s=0.05, backoff_max_s=0.1, poll_s=0.02,
+        sigterm_grace_s=0.5,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_used == 0  # the crash budget is untouched
+    assert sup.preempt_restarts_used == 1
+    pre = _events(tmp_path, "worker_preempted")
+    assert pre and pre[0]["rank"] == 0 and pre[0]["returncode"] == 143
+    assert not _events(tmp_path, "crash_detected")
+    restart = _events(tmp_path, "restart")
+    assert restart and restart[0]["cause"]["kind"] == "worker_preempt"
+    assert restart[0]["preempt_restarts_used"] == 1
+    assert _events(tmp_path, "gang_done")
+
+
+def test_preempt_budget_exhaustion_structured_report(tmp_path):
+    # a slot preempted on EVERY life exhausts max_preempt_restarts (not
+    # max_restarts) and the giveup report says which budget died
+    sup = Supervisor(
+        [_spec("import sys; sys.exit(143)", tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=5, max_preempt_restarts=1,
+        backoff_base_s=0.02, backoff_max_s=0.05, poll_s=0.02,
+    )
+    assert sup.run() == 1
+    assert sup.restarts_used == 0
+    assert sup.preempt_restarts_used == 1
+    rep = sup.failure_report
+    assert rep["preempt_restarts_used"] == 1
+    assert rep["max_preempt_restarts"] == 1
+    assert rep["last_failure"]["kind"] == "worker_preempt"
+
+
+# ---------------------------------------------------------------------------
+# elastic resize (ISSUE 6 tentpole): shrink to survivors, grow back
+# ---------------------------------------------------------------------------
+def _env_dump_spec(workdir, rank):
+    # worker writes the elastic env contract it sees to env_<slot>.json
+    code = (
+        "import json, os\n"
+        "keys = ['PADDLE_TPU_WORLD_SIZE', 'PADDLE_TPU_RANK',"
+        " 'PADDLE_TPU_BASE_WORLD_SIZE', 'PADDLE_TPU_GANG_SLOT',"
+        " 'PADDLE_TPU_RESTART_NUM']\n"
+        "env = {k: os.environ.get(k) for k in keys}\n"
+        "p = os.path.join(r'%s', 'env_%%s_attempt_%%s.json'\n"
+        "                 %% (env['PADDLE_TPU_GANG_SLOT'],\n"
+        "                    os.environ.get('PADDLE_TPU_RESTART_NUM')))\n"
+        "open(p, 'w').write(json.dumps(env))\n" % str(workdir)
+    )
+    return _spec(code, workdir, rank)
+
+
+def _down_path(workdir, slot):
+    return os.path.join(str(workdir), "avail", "down_slot_%d.json" % slot)
+
+
+def test_elastic_shrink_remaps_ranks_and_injects_topology(tmp_path):
+    from paddle_tpu.distributed import elastic
+
+    # slot 1 of 3 is down (open-ended marker): the first plan must
+    # already shrink around it — starting degraded IS a resize
+    elastic.write_down_marker(_down_path(tmp_path, 1), down_for=-1, slot=1)
+    sup = Supervisor(
+        [_env_dump_spec(tmp_path, r) for r in range(3)],
+        workdir=str(tmp_path), max_restarts=0, min_world_size=2,
+        poll_s=0.02,
+    )
+    before = profiler.get_counter("dist_resizes")
+    assert sup.run() == 0
+    assert sup.resizes == 1
+    assert profiler.get_counter("dist_resizes") == before + 1
+    resize = _events(tmp_path, "gang_resize")
+    assert len(resize) == 1
+    assert resize[0]["from_world"] == 3 and resize[0]["to_world"] == 2
+    assert resize[0]["down_slots"] == [1]
+    # survivors got CONTIGUOUS new ranks: slot 0 -> rank 0, slot 2 -> 1
+    env0 = json.load(open(str(tmp_path / "env_0_attempt_0.json")))
+    env2 = json.load(open(str(tmp_path / "env_2_attempt_0.json")))
+    for env in (env0, env2):
+        assert env["PADDLE_TPU_WORLD_SIZE"] == "2"
+        assert env["PADDLE_TPU_BASE_WORLD_SIZE"] == "3"
+    assert env0["PADDLE_TPU_RANK"] == "0"
+    assert env2["PADDLE_TPU_RANK"] == "1"
+    assert not os.path.exists(str(tmp_path / "env_1_attempt_0.json"))
+    # the attempt is auditable post-hoc: world size + rank->pid map
+    starts = _events(tmp_path, "gang_start")
+    assert len(starts) == 1
+    assert starts[0]["world_size"] == 2
+    assert starts[0]["slots"] == [0, 2]
+    assert sorted(starts[0]["rank_pids"]) == ["0", "1"]
+    # the merged report sees the start-degraded resize too: it precedes
+    # the first gang_start, which the pre-supervisor_boot scoping used
+    # to slice off (_last_run anchored on gang_start restart==0)
+    from paddle_tpu.observability import aggregate
+
+    rep = aggregate.gang_report(str(tmp_path))
+    assert rep["resizes"] == 1 and rep["outcome"] == "gang_done"
+    assert rep["world_size_final"] == 2
+
+
+def test_elastic_regrow_at_restart_after_marker_expiry(tmp_path):
+    from paddle_tpu.distributed import elastic
+
+    # slot 2 down for ONE planning round; rank 0 crashes its first life
+    # to force the restart boundary the regrow happens at
+    crash_once = (
+        "import os, sys\n"
+        "m = os.path.join(r'%s', 'crash_marker')\n"
+        "if os.environ['PADDLE_TPU_GANG_SLOT'] == '0'"
+        " and not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(5)\n" % str(tmp_path)
+    )
+    elastic.write_down_marker(_down_path(tmp_path, 2), down_for=1, slot=2)
+    sup = Supervisor(
+        [_spec(crash_once, tmp_path, r) for r in range(3)],
+        workdir=str(tmp_path), max_restarts=1, min_world_size=2,
+        backoff_base_s=0.05, backoff_max_s=0.1, poll_s=0.02,
+        sigterm_grace_s=0.5,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_used == 1
+    assert sup.resizes == 2  # 3 -> 2 (start degraded), 2 -> 3 (regrow)
+    worlds = [e["world_size"] for e in _events(tmp_path, "gang_start")]
+    assert worlds == [2, 3]
+    resizes = [
+        (e["from_world"], e["to_world"])
+        for e in _events(tmp_path, "gang_resize")
+    ]
+    assert resizes == [(3, 2), (2, 3)]
+    assert not os.path.exists(_down_path(tmp_path, 2))  # marker cleared
+
+
+def test_elastic_floor_gives_up_with_insufficient_ranks(tmp_path):
+    from paddle_tpu.distributed import elastic
+
+    for slot in (0, 1):
+        elastic.write_down_marker(
+            _down_path(tmp_path, slot), down_for=-1, slot=slot
+        )
+    sup = Supervisor(
+        [_spec("pass", tmp_path, r) for r in range(2)],
+        workdir=str(tmp_path), max_restarts=3, min_world_size=2,
+        poll_s=0.02,
+    )
+    assert sup.run() == 1
+    rep = sup.failure_report
+    assert rep["reason"] == "insufficient_ranks"
+    assert rep["available"] == 0 and rep["min_world_size"] == 2
+    assert not _events(tmp_path, "gang_start")  # nothing ever spawned
+    assert _events(tmp_path, "giveup")
+
+
+def test_elastic_same_size_membership_change_is_a_resize(tmp_path):
+    from paddle_tpu.distributed import elastic
+
+    # attempt 0: slot 0 down for ONE round -> plan {1, 2}. The slot-1
+    # worker then preempts itself (down marker + exit 143) while slot
+    # 0's marker expires -> attempt 1 plan {0, 2}: the world STAYS 2
+    # but the membership flipped, which must still be a gang_resize
+    # (rank->host mapping changed; an audit that only watched world
+    # size would miss it)
+    self_preempt = (
+        "import os, sys\n"
+        "sys.path.insert(0, r'%s')\n"
+        "from paddle_tpu.distributed import elastic\n"
+        "if os.environ['PADDLE_TPU_GANG_SLOT'] == '1':\n"
+        "    elastic.write_down_marker(\n"
+        "        os.environ[elastic.DOWN_FILE_ENV], down_for=-1, slot=1)\n"
+        "    sys.exit(143)\n" % REPO
+    )
+    elastic.write_down_marker(_down_path(tmp_path, 0), down_for=1, slot=0)
+    sup = Supervisor(
+        [_spec(self_preempt, tmp_path, r) for r in range(3)],
+        workdir=str(tmp_path), max_restarts=0, max_preempt_restarts=2,
+        min_world_size=2, backoff_base_s=0.02, backoff_max_s=0.05,
+        poll_s=0.02, sigterm_grace_s=0.5,
+    )
+    assert sup.run() == 0
+    assert sup.resizes == 2  # 3 -> 2 (degraded start), 2 -> 2 (flip)
+    resizes = _events(tmp_path, "gang_resize")
+    assert [(e["from_world"], e["to_world"]) for e in resizes] == [
+        (3, 2), (2, 2)
+    ]
+    assert resizes[1]["down_slots"] == [1]
+    slots = [e["slots"] for e in _events(tmp_path, "gang_start")]
+    assert slots == [[1, 2], [0, 2]]
+
+
+def test_preempt_restart_backoff_stays_flat(tmp_path):
+    # preemptions are the pool's normal lifecycle: their restart delay
+    # must NOT escalate with the attempt count (only crashes look like
+    # a loop worth damping) — the 3rd preempt restart still waits at
+    # most backoff_base_s
+    code = (
+        "import os, sys\n"
+        "d = r'%s'\n"
+        "n = len([f for f in os.listdir(d) if f.startswith('life_')])\n"
+        "open(os.path.join(d, 'life_%%d' %% n), 'w').close()\n"
+        "sys.exit(143 if n < 3 else 0)\n" % str(tmp_path)
+    )
+    sup = Supervisor(
+        [_spec(code, tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=0, max_preempt_restarts=5,
+        backoff_base_s=0.04, backoff_max_s=10.0, poll_s=0.02,
+        sigterm_grace_s=0.5,
+    )
+    assert sup.run() == 0
+    assert sup.preempt_restarts_used == 3
+    backoffs = [e["backoff_s"] for e in _events(tmp_path, "restart")]
+    assert len(backoffs) == 3
+    for b in backoffs:  # exponent pinned at 1: jittered base, never 2^n
+        assert b <= 0.04 + 1e-9, backoffs
+
+
+def test_elastic_off_ignores_down_markers(tmp_path):
+    from paddle_tpu.distributed import elastic
+
+    # no min_world_size: PR 4 fixed-size behavior — markers are not
+    # even probed, the gang always launches full size
+    elastic.write_down_marker(_down_path(tmp_path, 0), down_for=-1, slot=0)
+    sup = Supervisor(
+        [_spec("pass", tmp_path, r) for r in range(2)],
+        workdir=str(tmp_path), max_restarts=0, poll_s=0.02,
+    )
+    assert sup.run() == 0
+    starts = _events(tmp_path, "gang_start")
+    assert starts[0]["world_size"] == 2 and starts[0]["slots"] == [0, 1]
+    assert not _events(tmp_path, "gang_resize")
+    assert os.path.exists(_down_path(tmp_path, 0))  # left untouched
+
+
+# ---------------------------------------------------------------------------
+# elastic contract unit tests (distributed/elastic.py)
+# ---------------------------------------------------------------------------
+def test_world_info_prefers_elastic_contract_over_legacy():
+    from paddle_tpu.distributed import elastic
+
+    env = {
+        "PADDLE_TPU_WORLD_SIZE": "2", "PADDLE_TPU_RANK": "1",
+        "PADDLE_TPU_BASE_WORLD_SIZE": "3", "PADDLE_TPU_GANG_SLOT": "2",
+        "PADDLE_TRAINERS_NUM": "3", "PADDLE_TRAINER_ID": "2",
+    }
+    info = elastic.world_info(env)
+    assert info == (1, 2, 3, 2)  # rank, world, base, slot
+    # legacy fallback (no elastic vars): base == world, slot == rank
+    info = elastic.world_info(
+        {"PADDLE_TRAINERS_NUM": "4", "PADDLE_TRAINER_ID": "3"}
+    )
+    assert info == (3, 4, 4, 3)
+    assert elastic.world_info({}) == (0, 1, 1, 0)
+
+
+def test_batch_plan_preserves_global_batch():
+    from paddle_tpu.distributed import elastic
+
+    # even shrink 4 -> 2: accumulate 2x, exact global batch, no LR skew
+    p = elastic.batch_plan(4, 2, per_rank_batch=8)
+    assert p.accum_steps == 2
+    assert p.effective_global_batch == p.global_batch == 32
+    assert p.lr_scale == 1.0
+    # uneven shrink 3 -> 2: rounds UP (never a smaller batch than
+    # submitted), lr_scale carries the linear-scaling correction
+    p = elastic.batch_plan(3, 2, per_rank_batch=1)
+    assert p.accum_steps == 2
+    assert p.effective_global_batch == 4 and p.global_batch == 3
+    assert p.lr_scale == pytest.approx(4.0 / 3.0)
+    # parity and grow-beyond-base never accumulate
+    assert elastic.batch_plan(2, 2).accum_steps == 1
+    assert elastic.batch_plan(2, 4).accum_steps == 1
+
+
+def test_down_marker_roundtrip_and_torn_marker_fails_safe(tmp_path):
+    from paddle_tpu.distributed import elastic
+
+    p = str(tmp_path / "avail" / "down_slot_0.json")
+    assert elastic.read_down_marker(p) is None
+    elastic.write_down_marker(p, down_for=2, slot=0, reason="test")
+    m = elastic.read_down_marker(p)
+    assert m["down_for"] == 2 and m["slot"] == 0
+    assert m["attempts_down"] == 0 and m["reason"] == "test"
+    # a torn/garbage marker must read as down-until-deleted: never
+    # launch onto a slot whose availability claim is unreadable
+    with open(p, "w") as f:
+        f.write("{torn")
+    m = elastic.read_down_marker(p)
+    assert m["down_for"] == -1 and m["torn"]
+    # an EXISTING but unreadable marker (here: the path is a directory,
+    # EISDIR; EACCES/EIO behave the same) is an availability claim we
+    # cannot read — fail safe as down-until-deleted, only a genuinely
+    # ABSENT path (ENOENT/ENOTDIR) reads as launchable
+    d = str(tmp_path / "avail" / "down_slot_1.json")
+    os.makedirs(d)
+    m = elastic.read_down_marker(d)
+    assert m["down_for"] == -1 and m["torn"]
+    assert elastic.read_down_marker(
+        str(tmp_path / "avail" / "missing.json")
+    ) is None
+
+
+def test_maybe_rescale_lr_keys_off_saved_world(tmp_path, monkeypatch):
+    from paddle_tpu.distributed import elastic
+
+    with fluid.unique_name.guard():
+        prog = fluid.Program()
+        with fluid.program_guard(prog):
+            prog.global_block().create_var(
+                name="learning_rate_0", shape=(1,), dtype="float32",
+                persistable=True,
+            )
+    sc = fluid.Scope()
+    sc.set("learning_rate_0", np.array([0.1], np.float32))
+    monkeypatch.setenv(elastic.WORLD_ENV, "1")
+    monkeypatch.setenv(elastic.RANK_ENV, "0")
+    monkeypatch.setenv(elastic.BASE_WORLD_ENV, "2")
+    # disarmed by default: identical-replica workloads must not rescale
+    assert elastic.maybe_rescale_lr(prog, scope=sc) is None
+    assert np.asarray(sc.get("learning_rate_0"))[0] == np.float32(0.1)
+    old = fluid.get_flags("FLAGS_elastic_lr_rescale")
+    try:
+        fluid.set_flags({"FLAGS_elastic_lr_rescale": True})
+        # checkpoint saved at world 2, now world 1: halve the LR
+        f = elastic.maybe_rescale_lr(
+            prog, scope=sc, restore_info={"world_size_saved": 2}
+        )
+        assert f == 0.5
+        assert np.asarray(
+            sc.get("learning_rate_0")
+        )[0] == np.float32(0.05)
+        # resumed AGAIN at the same degraded size from a checkpoint the
+        # degraded run itself wrote: factor 1.0 -> no compounding
+        assert elastic.maybe_rescale_lr(
+            prog, scope=sc, restore_info={"world_size_saved": 1}
+        ) is None
+        assert np.asarray(
+            sc.get("learning_rate_0")
+        )[0] == np.float32(0.05)
+    finally:
+        fluid.set_flags(old)
+
+
+# ---------------------------------------------------------------------------
 # chaos harness
 # ---------------------------------------------------------------------------
 def test_chaos_flag_plan_resolution():
@@ -450,3 +808,12 @@ def test_dist_crash_probe_fast(tmp_path):
     assert report["trials_kill"] == 2 and report["trials_hang"] == 2
     assert report["restarts"] >= 4  # every trial restarted at least once
     assert report["mttr_ms"]["mean"] > 0
+    # ISSUE 6 acceptance: the shrink trial resumed at world 2 without
+    # exhausting the restart budget, the regrow trial returned to 3, and
+    # both converged to the fixed-gang reference digest (tolerance: 0)
+    sr = report["shrink_regrow"]
+    assert [tuple(r) for r in sr["resizes"]] == [(3, 2), (2, 3)]
+    assert sr["world_sizes"] == [3, 2, 3]
+    assert sr["restarts_used"] <= 1 and sr["preempt_restarts_used"] <= 3
+    assert sr["digest_match"] is True
+    assert report["trials_shrink"] == 1 and report["dist_resizes"] >= 2

@@ -646,6 +646,29 @@ def test_gang_report_scopes_to_newest_supervisor_run(tmp_path):
     assert report["downtime_ms"]["count"] == 0
 
 
+def test_gang_report_boot_scoping_keeps_pre_start_resize(tmp_path):
+    """A supervisor that starts DEGRADED emits gang_resize before its
+    first gang_start — the run boundary is the supervisor_boot event,
+    so the report keeps that resize instead of slicing it into the
+    previous run (the old gang_start-anchored scoping's blind spot)."""
+    workdir = str(tmp_path)
+    log = sup_mod._Log(os.path.join(workdir, sup_mod.SUPERVISOR_LOG))
+    # dead run 1: full size, clean
+    log.event("supervisor_boot", world_size=3)
+    log.event("gang_start", restart=0, pids=[1], world_size=3)
+    log.event("gang_done", restart=0)
+    # current run 2: starts degraded
+    log.event("supervisor_boot", world_size=3)
+    log.event("gang_resize", restart=0, from_world=3, to_world=2,
+              down_slots=[1])
+    log.event("gang_start", restart=0, pids=[2, 3], world_size=2)
+    log.event("gang_done", restart=0)
+    report = aggregate.gang_report(workdir)
+    assert report["resizes"] == 1
+    assert report["world_size_final"] == 2
+    assert report["outcome"] == "gang_done"
+
+
 def test_downtime_pairing_is_scoped_to_one_supervisor_run():
     """supervisor.log appends across supervisor RUNS (reused workdir),
     and each run's monotonic clock has its own epoch — a detection left
@@ -750,9 +773,9 @@ def test_supervisor_emits_gang_report_after_chaos_restart(tmp_path):
 # ---------------------------------------------------------------------------
 # CI lint + the closed-loop probe
 # ---------------------------------------------------------------------------
-def test_obs_flags_lint_clean():
-    """Satellite: every FLAGS_obs_* knob is registered in fluid/flags.py
-    and documented in README.md, and none is dead."""
+def test_flags_lint_clean():
+    """Satellite: every FLAGS_obs_*/dist_*/elastic_* knob is registered
+    in fluid/flags.py and documented in README.md, and none is dead."""
     import flags_lint
 
     assert flags_lint.lint() == []

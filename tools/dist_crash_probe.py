@@ -14,18 +14,30 @@ OS-process boundary, with a 2-process gang of deterministic trainers
   2. **Bounded retry** — a fault that re-fires every attempt exhausts
      ``max_restarts`` and exits non-zero with a structured ``giveup``
      failure report instead of looping forever.
-  3. **Observability** — MTTR (failure detection -> next gang start)
+  3. **Elastic resize (shrink -> regrow)** — a 3-proc gang whose slot 2
+     is lost to a chaos ``lose_rank`` slice preemption (exit 143 + down
+     marker) must resume at world size 2 WITHOUT consuming the crash
+     restart budget, survive a crash while degraded, grow back to world
+     size 3 once the availability marker expires, and converge every
+     rank to the fixed-gang reference digest exactly (identical-replica
+     DP: per-replica math is world-size independent, so the digest
+     tolerance is zero).
+  4. **Observability** — MTTR (failure detection -> next gang start)
      is measured from the structured supervisor.log events and the
-     ``dist_downtime_ms`` histogram, and reported for PERF.md.
+     ``dist_downtime_ms`` histogram, and reported for PERF.md, split by
+     cause (crash/hang vs preemption); resize decisions are read back
+     from ``gang_resize`` events and the merged ``gang_report.json``.
 
 Modes::
 
     # full probe: N trials of random-moment SIGKILL + N injected hangs
+    # (+ the deterministic shrink/regrow + budget checks)
     python tools/dist_crash_probe.py --trials 5
 
     # fast deterministic subset (tier-1 via tests/test_dist_supervisor.py):
     # 2 fixed-step kill trials + 2 fixed-step hang trials + the
-    # restart-budget-exhaustion check
+    # shrink->regrow elasticity trial + the restart-budget-exhaustion
+    # check
     python tools/dist_crash_probe.py --fast
 
 The worker is this same file with ``--worker`` (rank from
@@ -122,9 +134,11 @@ def _worker_cmd(dirname, steps, interval):
 
 
 def _gang(trial_dir, args, chaos_env=None, max_restarts=2,
-          hb_timeout_s=30.0, interval=None, grace_s=1.0, nranks=None):
+          hb_timeout_s=30.0, interval=None, grace_s=1.0, nranks=None,
+          min_world_size=None, max_preempt_restarts=None):
     """Build a supervised gang (default 2 ranks) rooted at trial_dir.
-    Returns the Supervisor (not yet run)."""
+    Returns the Supervisor (not yet run). ``min_world_size`` arms
+    elastic resize (shrink to survivors / regrow at restart)."""
     from paddle_tpu.distributed.supervisor import Supervisor, WorkerSpec
 
     os.makedirs(trial_dir, exist_ok=True)
@@ -153,6 +167,8 @@ def _gang(trial_dir, args, chaos_env=None, max_restarts=2,
         startup_grace_s=args.startup_grace_s,
         backoff_base_s=0.1, backoff_max_s=0.5,
         sigterm_grace_s=grace_s, poll_s=0.05,
+        min_world_size=min_world_size,
+        max_preempt_restarts=max_preempt_restarts,
     )
 
 
@@ -283,6 +299,130 @@ def _budget_exhaustion_check(tmp, args):
           flush=True)
 
 
+def _shrink_regrow_trial(tmp, args, ref):
+    """Deterministic elasticity closed loop (ISSUE 6 acceptance) on one
+    supervised 3-proc gang:
+
+      attempt 0 (world 3): chaos ``lose_rank`` preempts slot 2 early —
+        it writes its down marker (one planning round) and exits 143.
+        The preemption must NOT consume the crash restart budget.
+      attempt 1 (world 2): the plan shrinks around the downed slot
+        (resize 3->2, ranks remapped contiguously); the probe SIGKILLs
+        the degraded gang's rank 0 (one crash budget consumed).
+      attempt 2 (world 3): the marker has expired, the gang grows back
+        (resize 2->3); every rank resumes and converges to the
+        fixed-gang reference digest exactly.
+
+    Returns the shrink metrics for the REPORT."""
+    from paddle_tpu.distributed.supervisor import load_events
+
+    d = os.path.join(tmp, "shrink_regrow")
+    chaos = {
+        # slice preemption: slot 2 drops at step 1 (marker + exit 143),
+        # down for exactly one planning round, one-shot across restarts
+        "FLAGS_chaos_lose_rank": "2",
+        "FLAGS_chaos_lose_rank_at_step": "1",
+        "FLAGS_chaos_lose_rank_for": "1",
+        "FLAGS_chaos_marker_dir": os.path.join(d, "markers"),
+    }
+    sup = _gang(
+        d, args, chaos_env=chaos, max_restarts=1, nranks=3,
+        min_world_size=2, max_preempt_restarts=3,
+    )
+    # the degraded-attempt crash is driven from HERE, gated on the
+    # OBSERVED world size (a chaos step-count crash would race worker
+    # compile skew: a fast rank could reach the armed step in attempt 0
+    # before the slot-2 preemption is even detected). The kill forces
+    # the restart boundary the regrow happens at.
+    killed = []
+
+    def _kill_degraded_rank0():
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            starts = [
+                e for e in load_events(d) if e["event"] == "gang_start"
+            ]
+            if starts and starts[-1]["world_size"] == 2:
+                pid = starts[-1]["rank_pids"].get("0")
+                if pid and pid in sup.alive_pids().values():
+                    # let the degraded gang get past spawn (the kill is
+                    # valid at any point of attempt 1; the sleep just
+                    # makes "crash while degraded" the common shape)
+                    time.sleep(0.5)
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                        killed.append(pid)
+                    except OSError:
+                        pass
+                    return
+            time.sleep(0.05)
+
+    killer = threading.Thread(target=_kill_degraded_rank0, daemon=True)
+    killer.start()
+    rc = sup.run()
+    killer.join(timeout=5)
+    assert killed, "the degraded-attempt kill never fired"
+    assert rc == 0, "shrink/regrow trial: supervisor rc %d" % rc
+    assert sup.restarts_used == 1, (
+        "preemption leaked into the crash budget: restarts_used=%d"
+        % sup.restarts_used
+    )
+    assert sup.preempt_restarts_used == 1, (
+        "expected exactly 1 preempt restart, got %d"
+        % sup.preempt_restarts_used
+    )
+    events = load_events(d)
+    resizes = [
+        (e["from_world"], e["to_world"])
+        for e in events if e["event"] == "gang_resize"
+    ]
+    assert resizes == [(3, 2), (2, 3)], (
+        "resize sequence %s != [(3, 2), (2, 3)]" % (resizes,)
+    )
+    worlds = [
+        e["world_size"] for e in events if e["event"] == "gang_start"
+    ]
+    assert worlds == [3, 2, 3], "gang_start world sizes %s" % (worlds,)
+    # every gang_start is auditable: world size + rank->pid map
+    for e in events:
+        if e["event"] == "gang_start":
+            assert len(e["rank_pids"]) == e["world_size"], e
+    # digest convergence at full size, via the standard invariants
+    shrink_args = argparse.Namespace(**vars(args))
+    shrink_args.nranks = 3
+    _check_trial(d, shrink_args, sup, ref)
+    # preemption-detection -> respawn MTTR from the structured events
+    mttr_preempt = []
+    detect = None
+    for e in events:
+        if e["event"] in ("worker_preempted", "crash_detected"):
+            detect = e["ts_mono"]
+        elif e["event"] == "gang_start" and detect is not None:
+            mttr_preempt.append((e["ts_mono"] - detect) * 1000.0)
+            detect = None
+    # the merged gang report must tell the same story post-hoc
+    report_path = os.path.join(d, "gang_report.json")
+    assert os.path.isfile(report_path), "no gang_report.json"
+    with open(report_path) as f:
+        gang_report = json.load(f)
+    assert gang_report["resizes"] == 2, gang_report["resizes"]
+    assert gang_report["preemptions"] == 1
+    assert [a["world_size"] for a in gang_report["attempts"]] == [3, 2, 3]
+    assert gang_report["world_size_final"] == 3
+    print(
+        "shrink/regrow trial OK: world 3 -> 2 -> 3, crash budget 1/1, "
+        "preempt budget 1/3, all digests == reference", flush=True,
+    )
+    return {
+        "resizes": resizes,
+        "world_sizes": worlds,
+        "restarts_used": sup.restarts_used,
+        "preempt_restarts_used": sup.preempt_restarts_used,
+        "mttr_resize_ms": mttr_preempt,
+        "digest_match": True,  # asserted exact above (tolerance: 0)
+    }
+
+
 def run_probe(args):
     import tempfile
 
@@ -349,6 +489,7 @@ def run_probe(args):
         print("hang trial %d OK (restarts=%d)" % (trial, sup.restarts_used),
               flush=True)
 
+    shrink = _shrink_regrow_trial(tmp, args, ref)
     _budget_exhaustion_check(tmp, args)
 
     from paddle_tpu.fluid import profiler
@@ -357,23 +498,27 @@ def run_probe(args):
     report = {
         "trials_kill": kills,
         "trials_hang": hangs,
+        "trials_shrink": 1,
         "restarts": len(downtimes),
         "mttr_ms": {
             "mean": sum(downtimes) / len(downtimes) if downtimes else 0.0,
             "max": max(downtimes) if downtimes else 0.0,
             "min": min(downtimes) if downtimes else 0.0,
         },
+        "shrink_regrow": shrink,
         "dist_downtime_ms": profiler.summarize_histogram("dist_downtime_ms"),
         "dist_restarts": profiler.get_counter("dist_restarts"),
         "dist_hang_kills": profiler.get_counter("dist_hang_kills"),
+        "dist_resizes": profiler.get_counter("dist_resizes"),
         "wall_s": time.time() - t0,
     }
     _finalize_report(report)
     print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
     print(
-        "PROBE PASS: %d kill + %d hang trials, %d gang restarts, 0 "
-        "stranded gangs, all resumed digests == reference; MTTR mean "
-        "%.0f ms / max %.0f ms (%.1fs)"
+        "PROBE PASS: %d kill + %d hang trials + shrink/regrow "
+        "(world 3 -> 2 -> 3), %d gang restarts, 0 stranded gangs, all "
+        "resumed digests == reference; MTTR mean %.0f ms / max %.0f ms "
+        "(%.1fs)"
         % (kills, hangs, report["restarts"], report["mttr_ms"]["mean"],
            report["mttr_ms"]["max"], report["wall_s"])
     )

@@ -1,14 +1,17 @@
 """Knob lint (op_audit.py-style consistency check, run inside tier-1).
 
-Every ``FLAGS_obs_*`` knob must be (1) registered in
-``paddle_tpu/fluid/flags.py`` — an unregistered reference silently reads
-its fallback and ``FLAGS_`` env vars for it are dropped by the bridge —
-and (2) mentioned in README.md, so the Observability quickstart can't
-drift behind the code. The reverse direction is linted too: a registered
-``obs_*`` flag nobody reads is a dead knob.
+Every ``FLAGS_obs_*``, ``FLAGS_dist_*`` and ``FLAGS_elastic_*`` knob
+must be (1) registered in ``paddle_tpu/fluid/flags.py`` — an
+unregistered reference silently reads its fallback and ``FLAGS_`` env
+vars for it are dropped by the bridge — and (2) mentioned in README.md,
+so the Observability / Fault-tolerance quickstarts can't drift behind
+the code. The reverse direction is linted too: a registered knob nobody
+reads is a dead knob. (Scope grew obs_* -> +dist_*/elastic_* with the
+elastic-resize PR: the resize knobs are exactly the kind an operator
+reaches for mid-incident, when stale docs hurt most.)
 
 Run standalone (``python tools/flags_lint.py``, exit 1 on findings) or
-via ``tests/test_observability.py::test_obs_flags_lint_clean``.
+via ``tests/test_observability.py::test_flags_lint_clean``.
 """
 
 from __future__ import annotations
@@ -19,20 +22,34 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# both spellings a knob is consumed under: the env-bridge name and the
-# get_flag/set_flags key
+# the linted knob families (prefix with trailing underscore)
+PREFIXES = ("obs_", "dist_", "elastic_")
+_NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
+
+# the spellings a knob is consumed under: the env-bridge name and the
+# get_flag/_flag/set_flags key (supervisor.py wraps get_flag in a local
+# ``_flag(name, default)`` helper; the substring match covers both)
 _REF_PATTERNS = (
-    re.compile(r"FLAGS_(obs_[a-z0-9_]+)"),
-    re.compile(r"""get_flag\(\s*['"](obs_[a-z0-9_]+)['"]"""),
+    re.compile(r"FLAGS_" + _NAME),
+    re.compile(r"""_flag\(\s*['"]""" + _NAME + r"""['"]"""),
 )
 _SCAN_DIRS = ("paddle_tpu", "tools", "tests")
 _FLAGS_PY = os.path.join("paddle_tpu", "fluid", "flags.py")
 
+# registered-but-unread knobs that are NOT dead: the reference's env
+# whitelist includes them, so scripts that set them must keep working
+# (flags.py's accepted-and-recorded contract). Anything added here needs
+# that justification — a knob of OURS nobody reads is still a finding.
+_LEGACY_COMPAT = {
+    "dist_threadpool_size",  # reference flags.cc threading knob; XLA
+                             # owns threading on TPU, value is recorded
+}
 
-def find_obs_flag_refs():
-    """{flag_name: [relpath, ...]} for every obs_* knob referenced in
-    Python sources (the flags registry file itself excluded — defining a
-    flag is not consuming it)."""
+
+def find_flag_refs():
+    """{flag_name: [relpath, ...]} for every linted-family knob
+    referenced in Python sources (the flags registry file itself
+    excluded — defining a flag is not consuming it)."""
     refs = {}
     for top in _SCAN_DIRS:
         for root, _dirs, files in os.walk(os.path.join(REPO, top)):
@@ -53,12 +70,16 @@ def find_obs_flag_refs():
     return refs
 
 
+# backwards-compatible alias (pre-elastic name)
+find_obs_flag_refs = find_flag_refs
+
+
 def lint():
     """Returns a list of human-readable problem strings (empty = clean)."""
     sys.path.insert(0, REPO)
     from paddle_tpu.fluid import flags
 
-    refs = find_obs_flag_refs()
+    refs = find_flag_refs()
     with open(os.path.join(REPO, "README.md"), errors="replace") as f:
         readme = f.read()
     problems = []
@@ -75,9 +96,10 @@ def lint():
                 % (name, where)
             )
     registered = {
-        n for n in flags._DEFAULTS if n.startswith("obs_")
+        n for n in flags._DEFAULTS
+        if any(n.startswith(p) for p in PREFIXES)
     }
-    for name in sorted(registered - set(refs)):
+    for name in sorted(registered - set(refs) - _LEGACY_COMPAT):
         problems.append(
             "FLAGS_%s registered in %s but never read anywhere (dead knob)"
             % (name, _FLAGS_PY)
@@ -91,8 +113,10 @@ def main():
         print("LINT: %s" % p)
     if problems:
         return 1
-    print("flags lint clean: %d obs_* knobs registered + documented"
-          % len(find_obs_flag_refs()))
+    print(
+        "flags lint clean: %d %s knobs registered + documented"
+        % (len(find_flag_refs()), "/".join(p + "*" for p in PREFIXES))
+    )
     return 0
 
 
