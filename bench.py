@@ -87,7 +87,14 @@ def _bank_entry(line):
     keep = ("metric", "value", "unit", "batch", "device", "seq_len",
             "remat", "flash_attention", "hostfeed", "plan_hit_rate",
             "h2d_overlapped", "serving", "offline_rps", "p99_ms",
-            "batch_fill", "bucket_hit_rate", "clients")
+            "batch_fill", "bucket_hit_rate", "clients",
+            # per-rung cost census (observability/xla_stats): the
+            # compiled step's FLOP/HBM-byte budget banks alongside the
+            # throughput so PERF.md's bytes-budget table has provenance
+            # and future perf PRs have a regression baseline;
+            # census_source says where the numbers came from
+            # ("live_census" vs a hand-recorded hlo_scan artifact)
+            "flops", "bytes_accessed", "out_bytes", "census_source")
     return {k: line[k] for k in keep if k in line}
 
 
@@ -115,10 +122,25 @@ def bank_write(slot, entry):
             ).stdout.strip() or "unknown"
         except (OSError, subprocess.SubprocessError):
             sha = "unknown"
+        # a faster run whose census was unavailable (census flag off, or
+        # headline_census failed) must not erase the slot's banked
+        # flops/bytes baseline — PERF.md's bytes-budget table depends on
+        # it surviving every re-bank. Carry is ALL-or-nothing: splicing
+        # one prior field into a fresh partial census would bank a
+        # mixed-run baseline under a single census_source label
+        census_fields = ("flops", "bytes_accessed", "out_bytes")
+        carried = {}
+        if prev is not None and not any(k in entry for k in census_fields):
+            carried = {
+                k: prev[k]
+                for k in census_fields + ("census_source",)
+                if k in prev
+            }
         bank[slot] = dict(
             entry,
             git_sha=sha,
             measured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            **carried,
         )
         tmp = BANK_PATH + ".tmp"
         with open(tmp, "w") as f:
@@ -515,6 +537,15 @@ def child_main(cfg):
     _hb("timed run ok %.2fs loss=%.4f ips=%.1f" % (dt, lval, ips))
 
     result = {"ips": ips, "device": device, "loss": lval}
+    # bank the rung's cost census: the executor recorded cost analysis +
+    # HLO op counts for every executable it compiled this run (free at
+    # compile time); the heaviest program key IS the training step
+    try:
+        from paddle_tpu.observability import xla_stats as _xla_stats
+
+        _xla_stats.attach_headline_census(result)
+    except Exception as e:  # census must never sink a measurement
+        _hb("census unavailable: %s" % e)
     if hostfeed:
         # steady-state plan hit rate over the timed window (delta vs the
         # pre-loop snapshot); the staging count covers the whole run —
@@ -704,6 +735,10 @@ def _resnet_line(result, batch, errors, degraded):
         line["hostfeed"] = True
         line["plan_hit_rate"] = result.get("plan_hit_rate")
         line["h2d_overlapped"] = result.get("h2d_overlapped")
+    for k in ("flops", "bytes_accessed", "out_bytes"):
+        if result.get(k) is not None:
+            line[k] = result[k]
+            line["census_source"] = "live_census"
     if degraded:
         # a CPU number has no defensible relation to the V100 baseline
         line["vs_baseline"] = None
@@ -727,6 +762,15 @@ def _bert_line(result, batch, seq_len, errors, degraded, flash=False):
     }
     if flash:
         line["flash_attention"] = True
+    elif any(result.get(k) is not None
+             for k in ("flops", "bytes_accessed", "out_bytes")):
+        # dense path only: XLA cost analysis cannot see inside the flash
+        # Pallas custom call, so a flash census would undercount — a
+        # poisoned bytes baseline is worse than none (PERF.md round-5)
+        for k in ("flops", "bytes_accessed", "out_bytes"):
+            if result.get(k) is not None:
+                line[k] = result[k]
+        line["census_source"] = "live_census"
     if degraded:
         line["vs_baseline"] = None
         line["degraded"] = "cpu-fallback tiny-config (TPU attempts failed: %s)" % (

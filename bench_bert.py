@@ -118,8 +118,17 @@ def child_main(cfg):
     assert np.isfinite(lval), lval
     sps = batch * steps / dt
     _hb("timed ok %.2fs loss=%.4f sps=%.1f" % (dt, lval, sps))
-    print("RESULT " + json.dumps({"sps": sps, "device": device, "loss": lval}),
-          flush=True)
+    result = {"sps": sps, "device": device, "loss": lval}
+    # dense path only: cost analysis cannot see inside the flash Pallas
+    # custom call, so a flash census would undercount (PERF.md round-5)
+    if not bcfg.use_flash_attention:
+        try:
+            from paddle_tpu.observability import xla_stats as _xla_stats
+
+            _xla_stats.attach_headline_census(result)
+        except Exception as e:  # census must never sink a measurement
+            _hb("census unavailable: %s" % e)
+    print("RESULT " + json.dumps(result), flush=True)
 
 
 def _child_entry(cfg):
@@ -194,6 +203,13 @@ def main():
             }
             if cfg["flash"]:
                 out["flash_attention"] = True
+            # propagate the child's fresh census (dense rungs only — the
+            # child skips it for flash) so a standalone run re-banks
+            # flops/bytes like the bench.py driver path does
+            for k in ("flops", "bytes_accessed", "out_bytes"):
+                if res.get(k) is not None:
+                    out[k] = res[k]
+                    out["census_source"] = "live_census"
             if res["device"] == "tpu" and not degraded:
                 bench.bank_write(
                     "bert_seq%d%s" % (cfg["seq_len"], "_flash" if cfg["flash"] else ""),

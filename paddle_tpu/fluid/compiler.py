@@ -207,26 +207,35 @@ class CompiledProgram(object):
             return
         # routed through the Pass registry (ir.py
         # collective_grad_allreduce_pass) — PassBuilder users see the same
-        # pipeline surface as the reference's build_strategy.cc:299
+        # pipeline surface as the reference's build_strategy.cc:299.
+        # The one-time program rewrite is part of the compile story a
+        # timeline should attribute: span it like the executor's
+        # xla_build (the early return above keeps repeat runs span-free)
         from .ir import get_pass
+        from ..observability import trace as _obs_trace
 
-        get_pass(
-            "collective_grad_allreduce_pass",
-            nranks=nranks,
-            loss_name=self._loss_name,
-            nrings=1,
-        ).apply_program(self._program)
-        self._program._grad_allreduce_applied = nranks
+        with _obs_trace.span("spmd_program_prepare", cat="compile",
+                             stage="grad_allreduce"):
+            get_pass(
+                "collective_grad_allreduce_pass",
+                nranks=nranks,
+                loss_name=self._loss_name,
+                nrings=1,
+            ).apply_program(self._program)
+            self._program._grad_allreduce_applied = nranks
 
     def _run(self, executor, feed=None, fetch_list=None, scope=None,
              return_numpy=True):
         from . import executor as _executor_mod
+        from ..observability import trace as _obs_trace
 
         # user-injected pass pipeline (BuildStrategy pass builder,
         # pybind.cc:1547 parity) rewrites the program once, pre-compile
         pb = getattr(self._build_strategy, "_pass_builder", None)
         if pb is not None and not getattr(self, "_passes_applied", False):
-            pb.apply(self._program)
+            with _obs_trace.span("spmd_program_prepare", cat="compile",
+                                 stage="pass_builder"):
+                pb.apply(self._program)
             self._passes_applied = True
         scope = scope or core.global_scope()
         feed = dict(feed or {})
@@ -269,7 +278,9 @@ class CompiledProgram(object):
             extra=("spmd", tuple(zip(mesh.axis_names, mesh.devices.shape))),
         )
         compiled = executor._cache_get(key)
-        # _version is part of the key: a hit can never be stale
+        # _version is part of the key: a hit can never be stale — and a
+        # miss builds a _CompiledBlock whose own instrumentation records
+        # the build/compiles under a key carrying the spmd mesh extra
         if compiled is None:
             mesh_axes = dict(
                 zip(mesh.axis_names, mesh.devices.shape)

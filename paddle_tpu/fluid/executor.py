@@ -22,11 +22,15 @@ engine here is different by design:
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 
 from . import core
 from . import profiler as _profiler
 from ..observability import trace as _obs_trace
+from ..observability import xla_stats as _xla_stats
 from .framework import Program, Variable, default_main_program
 from .io_pipeline import DeviceFeedBatch
 from .ops import registry as _registry
@@ -605,6 +609,29 @@ class _ScopeEnv(dict):
 class _CompiledBlock(object):
     def __init__(self, program, block_idx, feed_names, fetch_names, place,
                  mesh_axes=None, mesh=None):
+        # device-plane telemetry: the serializable image of this block's
+        # cache key, the build span, and the build record (the recompile
+        # sentinel classifies cold / program_mutation / feed_order_change
+        # / lru_eviction from the key history)
+        self._obs_key = _xla_stats.make_key(
+            program, feed_names, fetch_names, mesh=mesh, block_idx=block_idx
+        )
+        t0 = time.perf_counter()
+        with _obs_trace.span(
+            "xla_build", cat="compile",
+            key=_xla_stats.fingerprint(self._obs_key),
+        ):
+            self._construct(
+                program, block_idx, feed_names, fetch_names, place,
+                mesh_axes, mesh,
+            )
+        _xla_stats.on_build(
+            self._obs_key, (time.perf_counter() - t0) * 1e3,
+            n_xla_segments=sum(1 for k, _s, _p in self._plans if k == "xla"),
+        )
+
+    def _construct(self, program, block_idx, feed_names, fetch_names, place,
+                   mesh_axes, mesh):
         import jax
 
         self.program = program
@@ -752,6 +779,18 @@ class _CompiledBlock(object):
                         fn=jfn,
                         raw_fn=raw_fn,
                         needs_rng=needs_rng,
+                        # AOT dispatch state: each distinct feed-shape
+                        # signature is lowered+compiled EXPLICITLY (one
+                        # timed, censused compile event) and the Compiled
+                        # executable dispatched directly — jax.jit's
+                        # implicit in-call compile would be invisible to
+                        # the sentinel and its executable unreachable for
+                        # cost analysis
+                        execs={},
+                        exec_lock=threading.Lock(),
+                        seg_index=sum(
+                            1 for k, _s, _p in self._plans if k == "xla"
+                        ),
                     ),
                 )
             )
@@ -885,6 +924,82 @@ class _CompiledBlock(object):
 
         return fn
 
+    def _dispatch(self, plan, feed_vals, mutable_vals, sharded_vals,
+                  const_map, rng_key):
+        """Execute one XLA segment through its AOT-compiled executable.
+
+        The signature (feed shapes/dtypes + const-map size) resolves the
+        executable with one tuple build + dict lookup per step — state
+        var shapes are program constants, so only feeds key the cache.
+        A miss is THE compile event: lower+compile under a span, record
+        through the sentinel, census the in-hand executable. The rare
+        drift the signature can't see surfaces as the Compiled call's
+        mismatch error — TypeError for aval drift (a scope var re-set
+        with a new shape, a changed const key set), ValueError for
+        input-sharding drift on the SPMD path — evict and recompile
+        once, as the implicit jit path would have."""
+        sig = (
+            tuple(
+                (a.shape, getattr(a.dtype, "name", str(a.dtype)))
+                for a in feed_vals
+            ),
+            len(const_map),
+        )
+        ex = plan["execs"].get(sig)
+        if ex is None:
+            ex = self._compile_plan(
+                plan, sig, feed_vals, mutable_vals, sharded_vals,
+                const_map, rng_key,
+            )
+        try:
+            return ex(feed_vals, mutable_vals, sharded_vals, const_map,
+                      rng_key)
+        except (TypeError, ValueError):
+            with plan["exec_lock"]:
+                plan["execs"].pop(sig, None)
+            ex = self._compile_plan(
+                plan, sig, feed_vals, mutable_vals, sharded_vals,
+                const_map, rng_key,
+            )
+            return ex(feed_vals, mutable_vals, sharded_vals, const_map,
+                      rng_key)
+
+    def _compile_plan(self, plan, sig, feed_vals, mutable_vals,
+                      sharded_vals, const_map, rng_key):
+        """Lower + compile one segment for one feed-shape signature and
+        record the compile event (wall ms, trigger, key diff, census).
+        Serialized per plan: a serving pool's workers racing the same
+        new shape compile it once."""
+        with plan["exec_lock"]:
+            ex = plan["execs"].get(sig)
+            if ex is not None:
+                return ex
+            fp = _xla_stats.fingerprint(self._obs_key)
+            t0 = time.perf_counter()
+            with _obs_trace.span(
+                "xla_compile", cat="compile", key=fp,
+                segment=plan["seg_index"],
+            ):
+                lowered = plan["fn"].lower(
+                    feed_vals, mutable_vals, sharded_vals, const_map,
+                    rng_key,
+                )
+                ex = lowered.compile()
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            plan["execs"][sig] = ex
+            feed_shapes = {
+                n: list(a.shape)
+                for n, a in zip(plan["feeds"], feed_vals)
+            }
+            # may raise SteadyStateRecompileError (strict serving gate)
+            # AFTER the executable is cached: the violation surfaces to
+            # the caller once, retries at this shape run compiled
+            _xla_stats.on_xla_compile(
+                self._obs_key, plan["seg_index"], feed_shapes, wall_ms,
+                compiled=ex,
+            )
+            return ex
+
     def run(self, scope, feed, rng_key, place):
         import jax
 
@@ -974,9 +1089,9 @@ class _CompiledBlock(object):
                         "program first)" % n
                     )
                 const_map[n] = _to_device(v, state_dev_for(n))
-            outs = plan["fn"](
-                tuple(feed_vals), tuple(mutable_vals), tuple(sharded_vals),
-                const_map, rng_key,
+            outs = self._dispatch(
+                plan, tuple(feed_vals), tuple(mutable_vals),
+                tuple(sharded_vals), const_map, rng_key,
             )
             for n, v in zip(plan["outs"], outs):
                 local_env[n] = v
@@ -1090,7 +1205,17 @@ class Executor(object):
         self._cache[key] = compiled
         self._cache.move_to_end(key)
         while len(self._cache) > self._CACHE_CAPACITY:
-            self._cache.popitem(last=False)
+            _k, evicted = self._cache.popitem(last=False)
+            # keep the two compile caches ALIGNED: the dispatch-plan
+            # fast lane must not keep an evicted block live (which would
+            # skew hit/miss accounting and hide the recompile when the
+            # canonical cache rebuilds it), and the sentinel remembers
+            # the fingerprint so that rebuild classifies lru_eviction
+            for pk in [
+                pk for pk, c in self._plans.items() if c is evicted
+            ]:
+                del self._plans[pk]
+            _xla_stats.note_eviction(getattr(evicted, "_obs_key", None))
 
     def run(
         self,
@@ -1172,6 +1297,17 @@ class Executor(object):
             _profiler.bump_counter("executor_plan_cache_misses")
             key = self._cache_key(program, feed.keys(), fetch_names)
             compiled = self._cache_get(key) if use_program_cache else None
+            if (
+                compiled is not None
+                and getattr(compiled, "_obs_key", None) is not None
+                and tuple(feed.keys()) != tuple(compiled.feed_names)
+            ):
+                # canonical hit under a new feed ORDER: no XLA work, but
+                # the sentinel records it so /compiles can prove the
+                # sorted-key cache absorbed the reorder
+                _xla_stats.on_dispatch_rebind(
+                    compiled._obs_key, tuple(feed.keys())
+                )
             # _version is part of the key: a hit can never be stale
             if compiled is None:
                 if getattr(program, "_pipeline_config", None):

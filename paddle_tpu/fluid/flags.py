@@ -149,6 +149,21 @@ _DEFAULTS = {
     "obs_http_port_retries": 8,
     "obs_dir": "",
     "obs_snapshot_interval_s": 0.0,
+    # device-plane telemetry (observability/xla_stats.py): compile
+    # records + recompile sentinel ride the executor's AOT
+    # lower-and-compile path. obs_compile_census runs XLA cost analysis
+    # + the optimized-HLO op census on every freshly compiled executable
+    # (compile time only — the executable is already in hand, no second
+    # compile) and publishes per-program-key flops/bytes gauges;
+    # obs_compile_records bounds the retained record ring.
+    "obs_compile_census": True,
+    "obs_compile_records": 1024,
+    # strict serving gate: once InferenceServer warmup completes, any
+    # steady-state XLA compile raises SteadyStateRecompileError with the
+    # sentinel's attribution (instead of only bumping
+    # serving_steady_recompiles) — the "0 recompiles after warmup"
+    # serving claim as an enforced invariant
+    "serving_strict_compiles": False,
     # profiling / graphs
     "print_sub_graph_dir": "",
     "pe_profile_fname": "",

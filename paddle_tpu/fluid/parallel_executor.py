@@ -53,6 +53,17 @@ class ParallelExecutor(object):
     def device_count(self):
         return self._compiled._device_count()
 
+    def compile_report(self):
+        """Device-plane compile telemetry for this process (builds,
+        compiles by trigger, steady-state violations, compile wall
+        time) — the legacy PE API surface of
+        ``observability.xla_stats.summary()``, so reference-style
+        scripts can assert "no recompiles in my loop" without importing
+        the observability package."""
+        from ..observability import xla_stats as _xla_stats
+
+        return _xla_stats.summary()
+
     def drop_local_exe_scopes(self):
         pass
 

@@ -12,6 +12,9 @@ as one spine over the whole reproduction:
   per-rank JSONL snapshot files, armed by ``FLAGS_obs_*``
 - ``aggregate`` — supervisor-side merge of per-rank snapshots +
   supervisor.log into ``gang_report.json``
+- ``xla_stats`` — device-plane telemetry: compile spans + recompile
+  sentinel with cache-key attribution, per-program-key FLOP/HBM-byte
+  census, device-memory gauges, strict serving compile gate
 
 Submodules load lazily (PEP 562): ``trace`` sits on hot paths inside
 ``fluid`` itself, so this package must import without dragging the rest
@@ -20,7 +23,7 @@ of the stack in (and without import cycles through ``fluid.profiler``).
 
 import importlib
 
-_SUBMODULES = ("trace", "registry", "exporter", "aggregate")
+_SUBMODULES = ("trace", "registry", "exporter", "aggregate", "xla_stats")
 
 __all__ = list(_SUBMODULES)
 

@@ -128,6 +128,26 @@ def _rank_summary(snap):
         "histograms": {
             k: hists[k] for k in _RANK_HISTOGRAMS if k in hists
         },
+        "compiles": snap.get("compiles"),
+    }
+
+
+def _gang_compiles(snaps):
+    """Cross-rank roll-up of the per-rank compile summaries: totals by
+    trigger plus steady-state violations — one place to see a gang
+    restart's recompile storm."""
+    total, steady = 0, 0
+    by_trigger = {}
+    for snap in snaps.values():
+        c = snap.get("compiles") or {}
+        total += int(c.get("compiles", 0))
+        steady += int(c.get("steady_recompiles", 0))
+        for trig, n in (c.get("by_trigger") or {}).items():
+            by_trigger[trig] = by_trigger.get(trig, 0) + int(n)
+    return {
+        "compiles_total": total,
+        "by_trigger": by_trigger,
+        "steady_recompiles": steady,
     }
 
 
@@ -200,6 +220,7 @@ def gang_report(workdir, obs_dir=None):
             attempts[-1]["world_size"] if attempts else None
         ),
         "downtime_ms": _registry.percentiles(downtimes, points=(50, 99)),
+        "compiles": _gang_compiles(snaps),
         "ranks_reporting": sorted(snaps),
         "per_rank": {str(r): _rank_summary(s) for r, s in snaps.items()},
     }

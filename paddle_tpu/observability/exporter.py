@@ -10,7 +10,10 @@ One ``Exporter`` serves three read-only endpoints from a daemon thread
   calls ``set_health(False)`` — so a load balancer or the gang
   supervisor stops routing to a worker that is wrapping up;
 - ``/trace`` — the tracer ring buffer as Chrome trace-event JSON
-  (open the URL, save, load in Perfetto).
+  (open the URL, save, load in Perfetto);
+- ``/compiles`` — the device-plane compile telemetry (``xla_stats``):
+  every build/compile record with trigger + cache-key diff, plus the
+  per-program-key FLOP/HBM-byte census.
 
 Port policy (``FLAGS_obs_http_port``): -1 disables HTTP entirely, 0
 binds an ephemeral port (tests, single-host probes), >0 binds that port
@@ -89,6 +92,15 @@ def _make_handler(exporter):
                 elif path == "/trace":
                     self._send(
                         200, json.dumps(_trace.chrome_trace()),
+                        "application/json",
+                    )
+                elif path == "/compiles":
+                    from . import xla_stats as _xla_stats
+
+                    self._send(
+                        200,
+                        json.dumps(_xla_stats.compiles_endpoint(),
+                                   sort_keys=True),
                         "application/json",
                     )
                 else:

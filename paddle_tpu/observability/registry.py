@@ -205,6 +205,8 @@ def snapshot(rank=None):
     events across NTP steps on one host), rank/pid, counters, gauges,
     and per-histogram summaries. This is the per-rank record
     ``aggregate.py`` merges into the gang report."""
+    from . import xla_stats as _xla_stats
+
     rank = _trace.gang_rank(rank)
     hists = {
         name: percentiles(samples, points=(50, 95, 99))
@@ -219,6 +221,10 @@ def snapshot(rank=None):
         "counters": _profiler.get_counters(),
         "gauges": gauge_values(),
         "histograms": hists,
+        # device-plane roll-up: per-rank compile counts by trigger +
+        # the newest records' fingerprints, so the gang aggregator can
+        # surface a restart's recompile storm without the full ring
+        "compiles": _xla_stats.summary(),
     }
 
 

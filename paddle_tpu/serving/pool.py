@@ -32,6 +32,14 @@ class PredictorPool(object):
     def size(self):
         return len(self._all)
 
+    @property
+    def free_count(self):
+        """Currently checked-in predictors (approximate under races —
+        queue length is a snapshot). Published as the
+        ``serving_pool_free`` gauge so a scrape shows pool saturation
+        next to queue depth."""
+        return self._free.qsize()
+
     @contextlib.contextmanager
     def acquire(self, timeout=None):
         """Check a predictor out for one batch; always returned."""

@@ -28,6 +28,7 @@ from ..fluid import profiler as _profiler
 from ..observability import exporter as _obs_exporter
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
+from ..observability import xla_stats as _xla_stats
 from .batcher import (
     DeadlineExceededError,
     MicroBatcher,
@@ -78,6 +79,8 @@ class InferenceServer(object):
         self._baseline = {}
         self._lat_base = 0
         self._queue_gauge = None
+        self._pool_gauge = None
+        self._steady_armed = False
         self._started = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -105,12 +108,24 @@ class InferenceServer(object):
         self._started = True
         # telemetry: FLAGS_obs_* light up /metrics /healthz /trace and
         # JSONL snapshots with no code changes (no-op when disarmed), and
-        # the admission-queue depth publishes as a scrape-time gauge
+        # the admission-queue depth + pool occupancy publish as
+        # scrape-time gauges
         _obs_exporter.maybe_start_from_flags()
         self._queue_gauge = lambda b=self._batcher: b.queue_len
         _obs_registry.register_gauge(
             "serving_queue_depth", self._queue_gauge
         )
+        self._pool_gauge = lambda p=self._pool: p.free_count
+        _obs_registry.register_gauge("serving_pool_free", self._pool_gauge)
+        # warmup is over: from here every XLA compile is a steady-state
+        # recompile — counted, and (FLAGS_serving_strict_compiles) fatal
+        # to the offending request. NOTE: strict mode presumes
+        # warmup_inputs warmed the ladder; an unwarmed strict server
+        # fails its first request by design. Arm is COUNTED (ownership-
+        # scoped like the gauges): stopping an older server must not
+        # disarm the gate under a live successor in the same process.
+        _xla_stats.arm_serving_steady()
+        self._steady_armed = True
         return self
 
     def warmup(self, example_inputs):
@@ -123,6 +138,23 @@ class InferenceServer(object):
         would stall every batch for minutes of TPU compile time)."""
         example = [np.asarray(a) for a in example_inputs]
         c_before = _profiler.get_counters()
+        with _xla_stats.warmup_window(), _trace.span(
+            "serving_warmup", cat="serving"
+        ):
+            self._warm_ladder(example)
+        if self._started:
+            # post-start warmup (ladder growth on a live server): fold the
+            # warmup-attributable plan-cache activity into the baseline so
+            # stats() keeps reporting request-path compiles only ('zero
+            # miss delta == zero steady-state compiles')
+            c_after = _profiler.get_counters()
+            for k in ("predictor_plan_cache_misses",
+                      "predictor_plan_cache_hits"):
+                self._baseline[k] = self._baseline.get(k, 0) + (
+                    c_after.get(k, 0) - c_before.get(k, 0)
+                )
+
+    def _warm_ladder(self, example):
         for rows, seq in self.ladder.shapes():
             feeds = []
             for a in example:
@@ -142,23 +174,19 @@ class InferenceServer(object):
                     pred.run(padded)
             else:
                 self._predictor.run(padded)
-        if self._started:
-            # post-start warmup (ladder growth on a live server): fold the
-            # warmup-attributable plan-cache activity into the baseline so
-            # stats() keeps reporting request-path compiles only ('zero
-            # miss delta == zero steady-state compiles')
-            c_after = _profiler.get_counters()
-            for k in ("predictor_plan_cache_misses",
-                      "predictor_plan_cache_hits"):
-                self._baseline[k] = self._baseline.get(k, 0) + (
-                    c_after.get(k, 0) - c_before.get(k, 0)
-                )
 
     def stop(self):
         # mirror the trainer's finally: a serving process with
         # FLAGS_obs_dir armed must leave its per-rank snapshot even with
         # snapshot_interval 0 ("one final snapshot" contract)
         _obs_exporter.final_snapshot()
+        # disarm THIS server's steady-state compile gate (a stopped or
+        # restarting server's compiles are lifecycle, not violations);
+        # counted, so another live server's gate stays armed, and
+        # idempotent across repeated stop() calls
+        if getattr(self, "_steady_armed", False):
+            _xla_stats.disarm_serving_steady()
+            self._steady_armed = False
         if self._queue_gauge is not None:
             # ownership-scoped: a second server that re-registered the
             # gauge keeps it when this (older) one stops
@@ -166,6 +194,11 @@ class InferenceServer(object):
                 "serving_queue_depth", self._queue_gauge
             )
             self._queue_gauge = None
+        if getattr(self, "_pool_gauge", None) is not None:
+            _obs_registry.unregister_gauge(
+                "serving_pool_free", self._pool_gauge
+            )
+            self._pool_gauge = None
         if self._batcher is not None:
             self._batcher.stop()
         self._started = False
@@ -240,9 +273,12 @@ class InferenceServer(object):
                                plan.padded_rows - plan.rows)
         self._record_bucket(padded)
         # nests inside the batcher's serving_dispatch span (same worker
-        # thread): pool wait + device time vs stacking/padding overhead
-        with _trace.span("predictor_run", cat="serving",
-                         rows=rows, padded_rows=plan.padded_rows):
+        # thread): pool wait + device time vs stacking/padding overhead.
+        # The request window scopes the steady-state compile gate to
+        # THIS thread's compiles — a colocated trainer never trips it.
+        with _xla_stats.serving_request_window(), _trace.span(
+                "predictor_run", cat="serving",
+                rows=rows, padded_rows=plan.padded_rows):
             # blocking acquire: when warmup (or a slow batch) holds the
             # pool, batches WAIT rather than failing their clients;
             # per-request deadlines bound the caller-visible latency

@@ -201,3 +201,46 @@ def test_probe_accelerator_bounded_false_when_no_accelerator(bench_mod,
     t0 = time.time()
     assert bench_mod.probe_accelerator(timeout_s=8) is False
     assert time.time() - t0 < 40  # killed at ~8s + process overhead
+
+
+def test_bank_write_preserves_census_when_new_entry_lacks_it(bench_mod):
+    """A faster re-measurement whose live census was unavailable must
+    not erase the slot's banked flops/bytes baseline (PERF.md's
+    bytes-budget table sources it from the bank)."""
+    b = bench_mod
+    assert b.bank_write(
+        "resnet50",
+        {"metric": b.METRIC, "value": 1000.0, "unit": b.UNIT, "batch": 256,
+         "device": "tpu", "flops": 6.1e12, "bytes_accessed": 7.9e10,
+         "out_bytes": 1.0e8, "census_source": "live_census"},
+    )
+    # faster, census-less run: throughput updates, census fields carry
+    assert b.bank_write(
+        "resnet50",
+        {"metric": b.METRIC, "value": 1200.0, "unit": b.UNIT, "batch": 256,
+         "device": "tpu"},
+    )
+    e = b.load_bank()["resnet50"]
+    assert e["value"] == 1200.0
+    assert e["flops"] == 6.1e12
+    assert e["bytes_accessed"] == 7.9e10
+    assert e["census_source"] == "live_census"
+    # a run WITH a fresh census replaces them
+    assert b.bank_write(
+        "resnet50",
+        {"metric": b.METRIC, "value": 1300.0, "unit": b.UNIT, "batch": 256,
+         "device": "tpu", "flops": 6.2e12, "bytes_accessed": 7.8e10,
+         "out_bytes": 1.1e8, "census_source": "live_census"},
+    )
+    assert b.load_bank()["resnet50"]["flops"] == 6.2e12
+    # carry is all-or-nothing: a PARTIAL fresh census (backend without
+    # the out-bytes key) must not get the old run's out_bytes spliced in
+    assert b.bank_write(
+        "resnet50",
+        {"metric": b.METRIC, "value": 1400.0, "unit": b.UNIT, "batch": 256,
+         "device": "tpu", "flops": 6.3e12, "bytes_accessed": 7.7e10,
+         "census_source": "live_census"},
+    )
+    e = b.load_bank()["resnet50"]
+    assert e["flops"] == 6.3e12
+    assert "out_bytes" not in e

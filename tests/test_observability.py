@@ -774,11 +774,14 @@ def test_supervisor_emits_gang_report_after_chaos_restart(tmp_path):
 # CI lint + the closed-loop probe
 # ---------------------------------------------------------------------------
 def test_flags_lint_clean():
-    """Satellite: every FLAGS_obs_*/dist_*/elastic_* knob is registered
-    in fluid/flags.py and documented in README.md, and none is dead."""
+    """Satellite: every FLAGS_obs_*/dist_*/elastic_*/serving_* knob is
+    registered in fluid/flags.py and documented in README.md, none is
+    dead — and every metric name the registry can render appears in the
+    README metrics table."""
     import flags_lint
 
     assert flags_lint.lint() == []
+    assert flags_lint.lint_metrics() == []
 
 
 def test_obs_probe_fast_acceptance():

@@ -1,0 +1,449 @@
+"""Closed-loop probe for the device-plane compile telemetry (ISSUE 7).
+
+Drives REAL train + serving workloads with the telemetry armed and then
+verifies the four properties the subsystem promises:
+
+  1. **Recompile attribution** — every synthetic recompile trigger
+     (cold start, feed-order change, program version bump, LRU
+     eviction, feed-shape change) produces a record with the right
+     trigger label AND a cache-key diff naming the changed component;
+     an evicted block is also dropped from the dispatch-plan cache
+     (the two executor caches stay aligned).
+  2. **Strict serving gate** — a warmed `InferenceServer` under
+     `FLAGS_serving_strict_compiles` serves steady-state traffic with 0
+     recompiles; an UNWARMED strict server fails its first request with
+     the sentinel's attribution attached (warmup is the contract).
+  3. **Exporter round-trip** — `/compiles` serves the records + census
+     as JSON matching the in-process state, and `/metrics` carries the
+     `xla_*` counters and per-key census gauges at their exact values.
+  4. **Census ground truth** — the flops/bytes the executor recorded at
+     compile time equal a direct census of the same segment through the
+     `hlo_scan.py` code path (`jax.jit(raw_fn).lower().compile()` + the
+     shared `xla_stats` census library). Full mode additionally runs
+     `tools/hlo_scan.py --model resnet` as a subprocess and checks the
+     executor-recorded ResNet census against the scan's JSON line.
+
+Modes::
+
+    python tools/compile_probe.py          # full: adds the ResNet
+                                           # hlo_scan cross-check
+    python tools/compile_probe.py --fast   # tier-1 subset (1-4 on the
+                                           # probe MLP / tiny serving
+                                           # model)
+
+The fast subset runs inside tier-1 via tests/test_xla_stats.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+for _p in (REPO, TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+REPORT_SCHEMA_VERSION = 1
+
+
+def _http_get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def _records_since(n0):
+    from paddle_tpu.observability import xla_stats
+
+    return xla_stats.get_records()[n0:]
+
+
+# -- property 1: trigger classification + key-diff attribution ---------------
+
+def _check_triggers():
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.observability import xla_stats
+
+    from ckpt_crash_probe import _build
+
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    feed = {
+        "x": rs.rand(16, 8).astype("float32"),
+        "y": rs.randint(0, 4, (16, 1)).astype("int64"),
+    }
+
+    # cold: first run of the main program compiles its segment
+    n0 = len(xla_stats.get_records())
+    exe.run(main, feed=feed, fetch_list=[loss])
+    recs = _records_since(n0)
+    cold = [r for r in recs if r["kind"] == "compile"]
+    assert cold and all(r["trigger"] == "cold" for r in cold), recs
+    main_fp = cold[-1]["fingerprint"]
+
+    # steady state: repeat runs add NO records
+    n0 = len(xla_stats.get_records())
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    assert _records_since(n0) == [], "steady-state runs left records"
+
+    # feed-order change: same feed SET, different dict order -> the
+    # canonical (sorted-key) cache absorbs it; the sentinel records a
+    # dispatch rebind, no recompile
+    n0 = len(xla_stats.get_records())
+    exe.run(main, feed={"y": feed["y"], "x": feed["x"]},
+            fetch_list=[loss])
+    recs = _records_since(n0)
+    assert [r["kind"] for r in recs] == ["dispatch"], recs
+    assert recs[0]["trigger"] == "feed_order_change"
+    assert recs[0]["recompiled"] is False
+    assert recs[0]["diff"]["detail"]["feed_order"] == ["y", "x"]
+
+    # feed-shape change: a new batch size at the same key
+    n0 = len(xla_stats.get_records())
+    exe.run(main, feed={
+        "x": rs.rand(8, 8).astype("float32"),
+        "y": rs.randint(0, 4, (8, 1)).astype("int64"),
+    }, fetch_list=[loss])
+    recs = [r for r in _records_since(n0) if r["kind"] == "compile"]
+    assert recs and recs[0]["trigger"] == "shape_change", recs
+    shapes = recs[0]["diff"]["detail"]["feed_shapes"]
+    assert shapes.get("x") == [[16, 8], [8, 8]], shapes
+
+    # program version bump: mutation recompiles under the same program
+    # with the diff naming the version component
+    main._bump_version()
+    n0 = len(xla_stats.get_records())
+    exe.run(main, feed=feed, fetch_list=[loss])
+    recs = _records_since(n0)
+    builds = [r for r in recs if r["kind"] == "build"]
+    assert builds and builds[0]["trigger"] == "program_mutation", recs
+    assert builds[0]["diff"]["changed"] == ["version"], builds[0]["diff"]
+    compiles = [r for r in recs if r["kind"] == "compile"]
+    assert compiles and compiles[0]["trigger"] == "program_mutation"
+
+    # LRU eviction: cap the cache at 1, compile another program (evicts
+    # main), re-run main -> lru_eviction, and the dispatch-plan cache
+    # must have dropped the evicted block (cache-alignment satellite)
+    exe._CACHE_CAPACITY = 1
+    other, other_startup, other_loss = _build(hidden=8)
+    exe.run(other_startup)
+    exe.run(other, feed=feed, fetch_list=[other_loss])
+    assert all(
+        getattr(c, "program", None) is not main
+        for c in exe._plans.values()
+    ), "evicted block still live in the dispatch-plan cache"
+    c0 = profiler.get_counter("executor_plan_cache_misses")
+    n0 = len(xla_stats.get_records())
+    exe.run(main, feed=feed, fetch_list=[loss])
+    recs = _records_since(n0)
+    builds = [r for r in recs if r["kind"] == "build"]
+    assert builds and builds[0]["trigger"] == "lru_eviction", recs
+    assert profiler.get_counter("executor_plan_cache_misses") > c0, (
+        "eviction-survivor plan entry masked the recompile"
+    )
+    assert profiler.get_counter("executor_compiled_block_evictions") >= 2
+
+    by_trigger = xla_stats.summary()["by_trigger"]
+    for trig in ("cold", "shape_change", "program_mutation",
+                 "lru_eviction"):
+        assert by_trigger.get(trig), (trig, by_trigger)
+    return {
+        "by_trigger": by_trigger,
+        "main_fingerprint": main_fp,
+        "artifacts": (main, exe, feed, loss),
+    }
+
+
+# -- property 4: census ground truth -----------------------------------------
+
+def _check_census(main, exe, feed, loss):
+    """The executor-recorded census equals a direct census through the
+    hlo_scan code path (jax.jit(raw_fn).lower().compile() + the shared
+    library) for the same segment at the same shapes."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import executor as _ex
+    from paddle_tpu.observability import xla_stats
+
+    # compile a FRESH block exactly as hlo_scan.main() does: same
+    # _CompiledBlock lowering, same largest-segment choice, same
+    # scope-value feed/mutable/const binding, same jit(raw_fn) AOT path,
+    # same shared census library
+    scope = fluid.global_scope()
+    cb = _ex._CompiledBlock(
+        main, 0, list(feed), [loss.name], fluid.CPUPlace()
+    )
+    xla = [p for _k, _s, p in cb._plans if _k == "xla"]
+    plan = max(xla, key=lambda p: len(p["feeds"]) + len(p["mutable"])
+               + len(p["const"]))
+    feed_vals = tuple(feed[n] for n in plan["feeds"])
+    mutable_vals = tuple(np.asarray(scope.get(n)) for n in plan["mutable"])
+    const_map = {
+        n: np.asarray(scope.get(n))
+        for n in plan["const"]
+        if scope.get(n) is not None
+    }
+    rng = jax.random.key(0)
+    compiled = jax.jit(plan["raw_fn"]).lower(
+        feed_vals, mutable_vals, (), const_map, rng
+    ).compile()
+    direct = xla_stats.executable_census(compiled)
+
+    # the executor's record for the SAME key/segment at these shapes
+    fp = xla_stats.fingerprint(cb._obs_key)
+    recorded = [
+        r for r in xla_stats.get_records()
+        if r["kind"] == "compile" and r["fingerprint"] == fp
+        and r["segment"] == plan["seg_index"]
+        and r["feed_shapes"].get(plan["feeds"][0])
+        == list(np.shape(feed_vals[0]))
+        and r.get("census")
+    ]
+    assert recorded, "no censused record for the probe segment"
+    cen = recorded[-1]["census"]
+    assert cen["flops"] == direct["flops"], (cen["flops"], direct["flops"])
+    assert cen["bytes_accessed"] == direct["bytes_accessed"], (
+        cen["bytes_accessed"], direct["bytes_accessed"]
+    )
+    assert cen["hlo_ops"] == direct["hlo_ops"], "op census diverged"
+    return {"flops": cen["flops"], "bytes_accessed": cen["bytes_accessed"],
+            "total_hlo_ops": cen["total_hlo_ops"]}
+
+
+def _check_census_vs_hlo_scan_resnet():
+    """Full mode: the executor-recorded ResNet census equals a real
+    ``tools/hlo_scan.py --model resnet`` subprocess run (same model,
+    same batch, same backend)."""
+    import subprocess
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+    from paddle_tpu.observability import xla_stats
+
+    batch = 4
+    p = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "hlo_scan.py"),
+         "--model", "resnet", "--batch", str(batch), "--amp", "1"],
+        cwd=REPO, capture_output=True, text=True, timeout=1800,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, "hlo_scan failed:\n%s" % p.stderr[-2000:]
+    scan = json.loads(p.stdout.strip().splitlines()[-1])
+
+    main, startup, feeds, loss, acc = resnet.build_resnet_train(
+        depth=50, class_num=1000, image_size=224, use_amp=True,
+        recompute=False,
+    )
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        n0 = len(xla_stats.get_records())
+        exe.run(main, feed={
+            "img": rs.rand(batch, 3, 224, 224).astype("float32"),
+            "label": rs.randint(0, 1000, (batch, 1)).astype("int64"),
+        }, fetch_list=[loss], scope=scope)
+    recs = [r for r in xla_stats.get_records()[n0:]
+            if r["kind"] == "compile" and r.get("census")]
+    assert recs, "executor left no censused resnet records"
+    best = max(recs, key=lambda r: r["census"]["flops"] or 0)
+    assert best["census"]["flops"] == scan["flops"], (
+        best["census"]["flops"], scan["flops"]
+    )
+    assert best["census"]["bytes_accessed"] == scan["bytes_accessed"]
+    return {"resnet_flops": scan["flops"],
+            "resnet_bytes_accessed": scan["bytes_accessed"]}
+
+
+# -- property 2: strict serving gate -----------------------------------------
+
+def _serving_model(tmp):
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import inference
+
+    d = os.path.join(tmp, "model")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            out = fluid.layers.softmax(fluid.layers.fc(x, size=3))
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    _ = np
+    return d
+
+
+def _check_strict_serving(tmp):
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import inference, serving
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.serving.batcher import ServingError
+    from paddle_tpu.observability import xla_stats
+
+    d = _serving_model(tmp)
+    fluid.set_flags({"FLAGS_serving_strict_compiles": True})
+    rng = np.random.RandomState(0)
+    one = [rng.rand(1, 8).astype("float32")]
+    try:
+        # warmed strict server: steady-state traffic must see ZERO
+        # compiles with the gate armed
+        pred = inference.create_paddle_predictor(inference.AnalysisConfig(d))
+        server = serving.InferenceServer(
+            pred, max_batch_size=4, batch_timeout_ms=1.0, num_workers=2
+        )
+        server.start(warmup_inputs=one)
+        v0 = profiler.get_counter("serving_steady_recompiles")
+        try:
+            for _ in range(8):
+                server.infer([rng.rand(1, 8).astype("float32")])
+            steady = profiler.get_counter("serving_steady_recompiles") - v0
+            assert steady == 0, (
+                "%d steady-state recompiles on warmed traffic" % steady
+            )
+        finally:
+            server.stop()
+
+        # UNWARMED strict server: the first request compiles in steady
+        # state -> the gate fires with the sentinel's attribution
+        pred2 = inference.create_paddle_predictor(
+            inference.AnalysisConfig(d)
+        )
+        server2 = serving.InferenceServer(
+            pred2, max_batch_size=4, batch_timeout_ms=1.0, num_workers=1
+        )
+        server2.start()  # no warmup_inputs: ladder not compiled
+        v0 = profiler.get_counter("serving_steady_recompiles")
+        try:
+            try:
+                server2.infer(one)
+            except ServingError as e:
+                msg = str(e)
+                assert "SteadyStateRecompileError" in msg or "steady" in msg, msg
+            else:
+                raise AssertionError(
+                    "strict gate let an unwarmed compile through"
+                )
+            tripped = profiler.get_counter("serving_steady_recompiles") - v0
+            assert tripped >= 1, "gate raised but counter did not move"
+        finally:
+            server2.stop()
+    finally:
+        fluid.set_flags({"FLAGS_serving_strict_compiles": False})
+    assert not xla_stats.compiles_endpoint()["serving_steady"], (
+        "stop() left the steady gate armed"
+    )
+    return {"steady_recompiles_warmed": 0, "strict_gate_fired": True}
+
+
+# -- property 3: exporter round-trip -----------------------------------------
+
+def _check_exporter_roundtrip():
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.observability import exporter, registry, xla_stats
+
+    exp = exporter.Exporter(port=0, rank=0).start()
+    try:
+        doc = json.loads(_http_get(exp.url("/compiles")))
+        live = xla_stats.compiles_endpoint()
+        assert doc["schema_version"] == live["schema_version"]
+        assert len(doc["records"]) == len(live["records"])
+        assert [r["fingerprint"] for r in doc["records"]] == [
+            r["fingerprint"] for r in live["records"]
+        ]
+        assert doc["summary"]["by_trigger"] == live["summary"]["by_trigger"]
+        assert doc["census"].keys() == live["census"].keys()
+
+        parsed = registry.parse_prometheus(_http_get(exp.url("/metrics")))
+        for name in ("xla_builds", "xla_compiles", "xla_recompiles"):
+            key = (registry.prom_name(name), "")
+            assert key in parsed, "%s missing from /metrics" % name
+            assert parsed[key] == float(profiler.get_counter(name)), name
+        gauges = registry.gauge_values()
+        census_gauges = {
+            n: v for n, v in gauges.items() if n.startswith("xla_flops_")
+        }
+        assert census_gauges, "no census gauges registered"
+        for n, v in census_gauges.items():
+            assert parsed[(registry.prom_name(n), "")] == float(v), n
+    finally:
+        exp.stop()
+    return {
+        "records": len(doc["records"]),
+        "census_gauges": len(census_gauges),
+    }
+
+
+def run_probe(args):
+    import tempfile
+
+    from paddle_tpu.observability import xla_stats
+
+    tmp = args.workdir or tempfile.mkdtemp(prefix="compile_probe_")
+    t0 = time.time()
+    xla_stats.reset()
+    report = {"workdir": tmp}
+    trig = _check_triggers()
+    main, exe, feed, loss = trig.pop("artifacts")
+    report["triggers"] = trig
+    report["census"] = _check_census(main, exe, feed, loss)
+    report["strict_serving"] = _check_strict_serving(tmp)
+    report["exporter"] = _check_exporter_roundtrip()
+    if not args.fast:
+        report["hlo_scan"] = _check_census_vs_hlo_scan_resnet()
+    report["wall_s"] = round(time.time() - t0, 1)
+    report["schema_version"] = REPORT_SCHEMA_VERSION
+    report["ts"] = time.time()
+    print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
+    print(
+        "PROBE PASS: triggers %s all classified + key-diff-attributed, "
+        "census flops=%s bytes=%s match the hlo_scan path, strict gate: "
+        "0 steady recompiles warmed + fired on the unwarmed compile, "
+        "/compiles round-tripped %d records + %d census gauges%s (%.1fs)"
+        % (sorted(report["triggers"]["by_trigger"]),
+           report["census"]["flops"], report["census"]["bytes_accessed"],
+           report["exporter"]["records"],
+           report["exporter"]["census_gauges"],
+           "" if args.fast else "; resnet census == hlo_scan subprocess",
+           report["wall_s"])
+    )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 subset: skip the ResNet hlo_scan "
+                         "cross-check")
+    ap.add_argument("--workdir", type=str, default=None)
+    args = ap.parse_args(argv)
+    return run_probe(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,14 +1,24 @@
-"""Knob lint (op_audit.py-style consistency check, run inside tier-1).
+"""Knob + metrics-name lint (op_audit.py-style consistency check, run
+inside tier-1).
 
-Every ``FLAGS_obs_*``, ``FLAGS_dist_*`` and ``FLAGS_elastic_*`` knob
-must be (1) registered in ``paddle_tpu/fluid/flags.py`` — an
-unregistered reference silently reads its fallback and ``FLAGS_`` env
-vars for it are dropped by the bridge — and (2) mentioned in README.md,
-so the Observability / Fault-tolerance quickstarts can't drift behind
-the code. The reverse direction is linted too: a registered knob nobody
-reads is a dead knob. (Scope grew obs_* -> +dist_*/elastic_* with the
-elastic-resize PR: the resize knobs are exactly the kind an operator
-reaches for mid-incident, when stale docs hurt most.)
+Every ``FLAGS_obs_*``, ``FLAGS_dist_*``, ``FLAGS_elastic_*`` and
+``FLAGS_serving_*`` knob must be (1) registered in
+``paddle_tpu/fluid/flags.py`` — an unregistered reference silently reads
+its fallback and ``FLAGS_`` env vars for it are dropped by the bridge —
+and (2) mentioned in README.md, so the Observability / Fault-tolerance /
+Serving quickstarts can't drift behind the code. The reverse direction
+is linted too: a registered knob nobody reads is a dead knob. (Scope
+grew obs_* -> +dist_*/elastic_* with the elastic-resize PR and
+-> +serving_* with the compile-telemetry PR, which added
+``FLAGS_serving_strict_compiles``.)
+
+A second pass lints METRIC names: every counter / histogram /
+scrape-time gauge the registry can render (every literal name at a
+``bump_counter`` / ``bump_histogram`` / ``register_gauge`` call site)
+must appear in the README "Metrics reference" table — a metric an
+operator can scrape but can't look up is a support ticket. Dynamic
+families built from a literal prefix (``register_gauge("xla_flops_" +
+key)``) document as ``<prefix>*``.
 
 Run standalone (``python tools/flags_lint.py``, exit 1 on findings) or
 via ``tests/test_observability.py::test_flags_lint_clean``.
@@ -23,7 +33,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the linted knob families (prefix with trailing underscore)
-PREFIXES = ("obs_", "dist_", "elastic_")
+PREFIXES = ("obs_", "dist_", "elastic_", "serving_")
 _NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
 
 # the spellings a knob is consumed under: the env-bridge name and the
@@ -74,6 +84,73 @@ def find_flag_refs():
 find_obs_flag_refs = find_flag_refs
 
 
+# -- metrics-name lint -------------------------------------------------------
+
+# call sites that PUBLISH a metric the registry renders. The NAME
+# argument (everything before the first comma / closing paren — a
+# conditional like ``"hits" if hit else "misses"`` keeps both literals)
+# is scanned for string literals: plain literals are exact metric names;
+# a literal ending in "_" that is concatenated (``"xla_flops_" + slug``)
+# is a dynamic FAMILY, documented as ``<prefix>*`` in the README table.
+_METRIC_CALLS = re.compile(
+    r"\b(?:bump_counter|bump_histogram|register_gauge)\s*\(\s*([^),]*)"
+)
+_METRIC_LIT = re.compile(r"""['"]([a-z][a-z0-9_]*)['"]\s*([%+]?)""")
+
+
+def find_metric_names():
+    """(exact_names, family_prefixes): every literal metric name (and
+    dynamic-family prefix) at a publish call site under paddle_tpu/ and
+    tools/, each mapped to the files referencing it."""
+    exact, families = {}, {}
+    self_rel = os.path.relpath(os.path.abspath(__file__), REPO)
+    for top in ("paddle_tpu", "tools"):
+        for root, _dirs, files in os.walk(os.path.join(REPO, top)):
+            if "__pycache__" in root:
+                continue
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                rel = os.path.relpath(path, REPO)
+                if rel == self_rel:  # this file's docstring examples
+                    continue
+                with open(path, errors="replace") as f:
+                    text = f.read()
+                for m in _METRIC_CALLS.finditer(text):
+                    for lit in _METRIC_LIT.finditer(m.group(1)):
+                        name, op = lit.group(1), lit.group(2)
+                        if op and name.endswith("_"):
+                            families.setdefault(name, []).append(rel)
+                        elif not op:
+                            exact.setdefault(name, []).append(rel)
+    return exact, families
+
+
+def lint_metrics():
+    """Problem strings for metric names missing from the README
+    "Metrics reference" table (empty = clean)."""
+    with open(os.path.join(REPO, "README.md"), errors="replace") as f:
+        readme = f.read()
+    problems = []
+    exact, families = find_metric_names()
+    for name in sorted(exact):
+        if "`%s`" % name not in readme:
+            problems.append(
+                "metric %r published (%s) but missing from the README "
+                "metrics table" % (name, ", ".join(sorted(set(exact[name]))[:3]))
+            )
+    for prefix in sorted(families):
+        if "`%s*`" % prefix not in readme:
+            problems.append(
+                "metric family %r published (%s) but `%s*` missing from "
+                "the README metrics table"
+                % (prefix, ", ".join(sorted(set(families[prefix]))[:3]),
+                   prefix)
+            )
+    return problems
+
+
 def lint():
     """Returns a list of human-readable problem strings (empty = clean)."""
     sys.path.insert(0, REPO)
@@ -108,14 +185,17 @@ def lint():
 
 
 def main():
-    problems = lint()
+    problems = lint() + lint_metrics()
     for p in problems:
         print("LINT: %s" % p)
     if problems:
         return 1
+    exact, families = find_metric_names()
     print(
-        "flags lint clean: %d %s knobs registered + documented"
-        % (len(find_flag_refs()), "/".join(p + "*" for p in PREFIXES))
+        "flags lint clean: %d %s knobs registered + documented; "
+        "%d metrics + %d families documented"
+        % (len(find_flag_refs()), "/".join(p + "*" for p in PREFIXES),
+           len(exact), len(families))
     )
     return 0
 

@@ -20,10 +20,8 @@ can reproduce PERF.md's "0 transposes" claim.
 """
 
 import argparse
-import collections
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -174,33 +172,23 @@ def main():
     compiled = lowered.compile()
     hb("compiled; cost analysis")
 
-    raw_cost = compiled.cost_analysis()
-    if isinstance(raw_cost, (list, tuple)):
-        cost = raw_cost[0] if raw_cost else {}
-    else:
-        cost = raw_cost or {}
-    hlo = compiled.as_text()
-    hist = collections.Counter()
-    # `%name = <type> opcode(...)`; the type may be a tuple `(f32[..], ..)`
-    # for multi-output fusions, so the type part must admit parentheses
-    for m in re.finditer(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\],{}()\s/]*\s"
-                         r"([a-z][a-z\-]*)\(", hlo, re.M):
-        hist[m.group(1)] += 1
-    interesting = {
-        k: hist.get(k, 0)
-        for k in ("transpose", "convert", "copy", "fusion", "dot",
-                  "convolution", "all-reduce", "custom-call")
-    }
+    # shared census library (observability/xla_stats.py): the always-on
+    # device-plane telemetry and this one-off scan run the SAME cost
+    # parsing + op-census regex, so they can never disagree. Output stays
+    # byte-compatible with the pre-refactor scan.
+    from paddle_tpu.observability import xla_stats
+
+    census = xla_stats.executable_census(compiled)
     line = json.dumps({
         "model": args.model,
         "flash": bool(args.flash),
         "batch": args.batch,
         "seq": args.seq if args.model in ("bert", "gpt") else None,
         "backend": jax.default_backend(),
-        "flops": cost.get("flops"),
-        "bytes_accessed": cost.get("bytes accessed"),
-        "hlo_ops": interesting,
-        "total_hlo_ops": sum(hist.values()),
+        "flops": census["flops"],
+        "bytes_accessed": census["bytes_accessed"],
+        "hlo_ops": xla_stats.interesting_ops(census["hlo_ops"]),
+        "total_hlo_ops": census["total_hlo_ops"],
     })
     print(line)
     if args.out:
