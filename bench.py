@@ -73,6 +73,28 @@ BANK_PATH = os.environ.get(
 )
 
 
+def best_window_rate(samples, min_window_s):
+    """Best (events/sec) over any sample window spanning at least
+    ``min_window_s``, from a monotone list of (t, cumulative_count)
+    pairs; falls back to the full span when no window is long enough.
+    The load-robust throughput estimator shared by the decode probe and
+    the BENCH_DECODE rung: external load only ever subtracts throughput,
+    so the max window is the undisturbed steady-state figure without the
+    admission ramp / drain tail. The O(n^2) pairwise scan is fine for
+    the sample counts involved (sub-second polling over seconds-long
+    runs — hundreds of samples)."""
+    best = 0.0
+    for i in range(len(samples)):
+        for j in range(i + 1, len(samples)):
+            dt = samples[j][0] - samples[i][0]
+            if dt >= min_window_s:
+                best = max(best, (samples[j][1] - samples[i][1]) / dt)
+    if best == 0.0 and len(samples) >= 2:
+        dt = samples[-1][0] - samples[0][0]
+        best = (samples[-1][1] - samples[0][1]) / max(dt, 1e-6)
+    return best
+
+
 def load_bank():
     try:
         with open(BANK_PATH) as f:
@@ -88,6 +110,10 @@ def _bank_entry(line):
             "remat", "flash_attention", "hostfeed", "plan_hit_rate",
             "h2d_overlapped", "serving", "offline_rps", "p99_ms",
             "batch_fill", "bucket_hit_rate", "clients",
+            # decode (BENCH_DECODE=1) rung facts: tokens/sec/user is the
+            # banked value; the aggregate rate and engine geometry ride
+            # along for context
+            "decode", "streams", "tok_per_sec", "max_len", "max_new",
             # per-rung cost census (observability/xla_stats): the
             # compiled step's FLOP/HBM-byte budget banks alongside the
             # throughput so PERF.md's bytes-budget table has provenance
@@ -165,6 +191,7 @@ def bank_best(prefix):
         if slot.startswith(prefix) and e.get("device") == "tpu"
         and ("hostfeed" in prefix or not e.get("hostfeed"))
         and ("serving" in prefix or not e.get("serving"))
+        and ("decode" in prefix or not e.get("decode"))
     ]
     if not cands:
         return None, None
@@ -396,9 +423,131 @@ def _serving_measure(cfg, inference, serving, np, export_dir, device, gcfg,
     }), flush=True)
 
 
+def decode_child_main(cfg):
+    """BENCH_DECODE=1 rung: autoregressive tokens/sec through the
+    KV-cache continuous-batching engine (paddle_tpu/serving/decode.py)
+    at N concurrent streams. Headline is tokens/sec/USER (= total
+    decode throughput / streams) — the metric the ROADMAP's
+    "millions of users" serving item is denominated in. Banked under
+    'gpt_decode', never promoted to a training headline. The decode-step
+    program's flops/bytes census rides along where cost analysis
+    permits (flash-decode engages the Pallas kernel, which cost
+    analysis cannot see inside — those rungs bank without a census,
+    like every other flash rung)."""
+    t_start = time.time()
+    if cfg["platform"]:
+        os.environ["JAX_PLATFORMS"] = cfg["platform"]
+
+    import jax
+
+    honor_jax_platforms(jax)
+    enable_compilation_cache(jax)
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_infer
+    from paddle_tpu.observability import xla_stats as _xla_stats
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    _hb("probe start (device discovery)")
+    if cfg["platform"] == "cpu":
+        device = "cpu"
+    elif fluid.core.get_tpu_device_count() == 0:
+        _child_fail("no_tpu", "no TPU device visible to this child")
+    else:
+        device = "tpu"
+    _hb("probe ok %.1fs device=%s" % (time.time() - t_start, device))
+
+    streams = cfg.get("streams", 8)
+    max_len = cfg.get("max_len", 256)
+    gcfg = GPTConfig(
+        vocab_size=cfg.get("vocab", 50257),
+        hidden_size=cfg.get("hidden", 768),
+        num_layers=cfg.get("layers", 12),
+        num_heads=cfg.get("heads", 12),
+        intermediate_size=cfg.get("hidden", 768) * 4,
+        max_position_embeddings=max(max_len, 256),
+        is_test=True,
+        use_flash_attention=bool(cfg.get("flash")),
+    )
+    t0 = time.time()
+    _hb("build start (GPT infer graph for params)")
+    with fluid.unique_name.guard():
+        main_prog, startup, _feeds, _logits = build_gpt_infer(gcfg, max_len)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+    _hb("params ok %.1fs" % (time.time() - t0))
+
+    t0 = time.time()
+    _hb("engine warmup start (prefill ladder + decode step compiles)")
+    prompt_len = cfg.get("prompt_len", 32)
+    engine = DecodeEngine(
+        gcfg, scope=scope, slots=streams, max_len=max_len,
+        prefill_buckets=[prompt_len, max_len], param_program=main_prog,
+    ).start()
+    _hb("engine warmup ok %.1fs" % (time.time() - t0))
+    try:
+        rs = np.random.RandomState(0)
+        n_requests = cfg.get("requests", 4 * streams)
+        max_new = cfg.get("max_new", 64)
+        handles = [
+            engine.generate(
+                list(rs.randint(0, gcfg.vocab_size, prompt_len)),
+                max_new_tokens=max_new,
+            )
+            for _ in range(n_requests)
+        ]
+        samples = [(time.perf_counter(),
+                    profiler.get_counters().get("decode_tokens", 0))]
+        while not all(h.done for h in handles):
+            time.sleep(0.1)
+            samples.append((time.perf_counter(),
+                            profiler.get_counters().get("decode_tokens", 0)))
+        for h in handles:
+            h.tokens(timeout=600)
+        # best >=2 s window = steady-state rate without ramp/drain tails
+        tok_s = best_window_rate(samples, 2.0)
+        stats = engine.stats()
+        census = None
+        if not cfg.get("flash"):
+            # census of the DECODE-STEP program specifically — the
+            # generic heaviest-program headline would pick a prefill
+            # bucket, whose bytes budget is not the serving steady state
+            dmain, dfetch = engine.session._decode
+            fp = _xla_stats.fingerprint(_xla_stats.make_key(
+                dmain, ["step_ids", "step_pos", "key_bias"], [dfetch]
+            ))
+            census = _xla_stats.census_by_key().get(fp)
+    finally:
+        engine.stop()
+    _hb("decode ok %.1f tok/s at %d streams" % (tok_s, streams))
+    result = {
+        "tok_per_sec": tok_s,
+        "tok_per_sec_user": tok_s / streams,
+        "streams": streams,
+        "max_len": max_len,
+        "max_new": max_new,
+        "requests": stats["requests"],
+        "steps": stats["steps"],
+        "device": device,
+    }
+    if census is not None:
+        for k in ("flops", "bytes_accessed", "out_bytes"):
+            if census.get(k) is not None:
+                result[k] = census[k]
+        result["census_source"] = "live_census"
+    print("RESULT " + json.dumps(result), flush=True)
+
+
 def child_main(cfg):
     if cfg.get("serving"):
         return serving_child_main(cfg)
+    if cfg.get("decode"):
+        return decode_child_main(cfg)
     t_start = time.time()
     if cfg["platform"]:
         os.environ["JAX_PLATFORMS"] = cfg["platform"]
@@ -886,7 +1035,7 @@ def parent_main():
     tpu_ok = {"resnet": False, "bert": False}
     # serving failures surface via note_fail's stderr trace only: the
     # rung is bank-only (no emit line exists to carry an error field)
-    errors = {"resnet": [], "bert": [], "serving": []}
+    errors = {"resnet": [], "bert": [], "serving": [], "decode": []}
     tunnel_suspect = False
     # test hook: shrink TPU slots (hang-path tests shouldn't take 20 min)
     tpu_scale = float(os.environ.get("BENCH_TPU_SLOT_SCALE", "1"))
@@ -1037,6 +1186,48 @@ def parent_main():
             tunnel_suspect = True
         return False
 
+    def try_decode_tpu(slot):
+        """BENCH_DECODE=1 rung: bank autoregressive decode tokens/sec/user
+        through the KV-cache continuous-batching engine under
+        'gpt_decode'. Bank-only (never an emit line): a serving-side
+        per-user rate, not a training-headline convention — bank_best
+        guards it behind a 'decode'-containing prefix like the serving
+        and hostfeed rungs."""
+        nonlocal tunnel_suspect
+        cfg = {
+            "platform": os.environ.get("BENCH_DECODE_PLATFORM", ""),
+            "decode": True,
+            "streams": int(os.environ.get("BENCH_DECODE_STREAMS", "8")),
+            "max_len": int(os.environ.get("BENCH_DECODE_MAXLEN", "256")),
+            "max_new": int(os.environ.get("BENCH_DECODE_MAXNEW", "64")),
+            "prompt_len": int(os.environ.get("BENCH_DECODE_PROMPT", "32")),
+            "layers": int(os.environ.get("BENCH_DECODE_LAYERS", "12")),
+            "hidden": int(os.environ.get("BENCH_DECODE_HIDDEN", "768")),
+            "heads": int(os.environ.get("BENCH_DECODE_HEADS", "12")),
+            "vocab": int(os.environ.get("BENCH_DECODE_VOCAB", "50257")),
+            "flash": os.environ.get("BENCH_DECODE_FLASH", "0") == "1",
+        }
+        label = "decode-gpt-%ds-m%d" % (cfg["streams"], cfg["max_len"])
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, tpu_deadline()
+        )
+        if result is not None:
+            if result["device"] == "tpu":
+                bank_write("gpt_decode", _bank_entry(dict(result, **{
+                    "metric": "gpt2_decode_throughput",
+                    "value": round(result["tok_per_sec_user"], 2),
+                    "unit": "tokens/sec/user",
+                    "device": "tpu",
+                    "decode": True,
+                    "tok_per_sec": round(result["tok_per_sec"], 1),
+                    "flash_attention": cfg["flash"],
+                })))
+            return True
+        note_fail("decode", label, kind, err)
+        if kind == "no_tpu" or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
     def bank_cpu_fallbacks():
         # a banked TPU number makes the CPU fallback pointless — skip it
         # and leave the window to phase-D TPU retries
@@ -1088,6 +1279,10 @@ def parent_main():
     # ---- phase B2: opt-in serving rung (BENCH_SERVING=1; bank-only) ----
     if os.environ.get("BENCH_SERVING", "0") == "1" and not tunnel_suspect:
         try_serving_tpu(300.0)
+
+    # ---- phase B3: opt-in decode rung (BENCH_DECODE=1; bank-only) ----
+    if os.environ.get("BENCH_DECODE", "0") == "1" and not tunnel_suspect:
+        try_decode_tpu(300.0)
 
     # ---- phase C: degraded CPU fallbacks for anything still missing ----
     bank_cpu_fallbacks()

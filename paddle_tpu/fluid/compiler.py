@@ -295,7 +295,12 @@ class CompiledProgram(object):
                 mesh=mesh,
             )
             executor._cache_put(key, compiled)
-        rng_key = executor._next_rng(self._program, scope)
+        # same rng-skip contract as Executor.run: programs with no random
+        # ops neither pay the fold_in nor bump the scope run index
+        if getattr(compiled, "needs_rng", True):
+            rng_key = executor._next_rng(self._program, scope)
+        else:
+            rng_key = _executor_mod._fixed_rng()
         outs = compiled.run(scope, feed, rng_key, executor.place)
         from .executor import _fetch_to_host
 

@@ -39,8 +39,14 @@ from .ops.registry import LowerCtx
 EMPTY_VAR = _registry.EMPTY_VAR
 GRAD_SUFFIX = _registry.GRAD_SUFFIX
 
+# ops whose lowering consumes ctx.next_key(): the needs_rng analysis
+# (per-plan for the inference predictor's rng threading; per-block for
+# the executor's per-run fold_in skip) keys off this set, so EVERY
+# next_key() caller in ops/ must be here (or in _ATTR_RANDOM_OPS below)
+# — a missing entry freezes that op's randomness to one fixed key.
 _RANDOM_OPS = {
     "uniform_random",
+    "uniform_random_batch_size_like",
     "gaussian_random",
     "truncated_gaussian_random",
     "gaussian_random_batch_size_like",
@@ -48,7 +54,16 @@ _RANDOM_OPS = {
     "nce",
     "dropout",
     "dpsgd",
+    "sampling_id",
+    "sample_logits",
 }
+
+# key consumers only when their attrs say dropout is LIVE: an is_test /
+# rate-0 flash op never reads a key (nn_ops lowering draws the seed only
+# then), and charging every flash INFERENCE step the per-run fold_in
+# would tax exactly the single-token decode path this analysis exists to
+# unburden. The grad replays the forward lowering, so it keys the same.
+_ATTR_RANDOM_OPS = ("flash_attention", "flash_attention_grad")
 
 
 def global_scope():
@@ -128,6 +143,30 @@ def _analyze_ops(ops, defined):
                 writes.append(n)
     _ = defined
     return reads, writes
+
+
+def _ops_need_rng(program, ops):
+    """True when any op in ``ops`` — or, recursively, in a control-flow
+    op's sub-block — consumes the PRNG key stream. The sub-block walk
+    matters: a dropout inside a ``while``/``conditional_block`` body is
+    invisible at the segment's top level, and missing it would hand the
+    body replays one frozen key per compile instead of a per-run key."""
+    for op_ in ops:
+        t = op_.type
+        if t in _RANDOM_OPS or (
+            t.endswith("_grad") and t[: -len("_grad")] in _RANDOM_OPS
+        ):
+            return True
+        if t in _ATTR_RANDOM_OPS:
+            if (float(op_.attr("dropout_rate", 0.0)) > 0.0
+                    and not bool(op_.attr("is_test", False))):
+                return True
+        if op_.has_attr("sub_block"):
+            idx = op_.attr("sub_block")
+            sub = program.block(idx if isinstance(idx, int) else idx.idx)
+            if _ops_need_rng(program, sub.ops):
+                return True
+    return False
 
 
 def _sub_block_external_reads(program, op_, block=None):
@@ -643,12 +682,21 @@ class _CompiledBlock(object):
         self.mesh = mesh  # jax.sharding.Mesh for SPMD execution, or None
         self.segments = split_segments(program, self.block)
         self.version = program._version
+        # True once any XLA segment contains a random(-grad) op: run()
+        # only pays the per-step fold_in (and bumps the scope's RNG run
+        # index) for programs whose key stream is ever consumed
+        self.needs_rng = False
 
         persistable = {
             v.name
             for v in self.block.program.list_vars()
             if v.persistable
         }
+        # snapshot for run(): the program version is pinned into this
+        # block's cache key, so recomputing the set per step (an
+        # O(#vars) list_vars walk — ~130 vars for a small GPT) would
+        # only ever reproduce this value
+        self._persistable = persistable
         feed_set = set(self.feed_names)
         defined = set(self.feed_names)
         all_later_reads = {}
@@ -747,15 +795,9 @@ class _CompiledBlock(object):
                 n for n in const_all if self._has_dist_attr(n)
             ]
             const = [n for n in const_all if n not in sharded_const]
-            needs_rng = any(
-                o.type in _RANDOM_OPS
-                or (
-                    o.type.endswith("_grad")
-                    and o.type[: -len("_grad")] in _RANDOM_OPS
-                )
-                for o in seg.ops
-            )
+            needs_rng = _ops_need_rng(program, seg.ops)
 
+            self.needs_rng = self.needs_rng or needs_rng
             fn = self._build_segment_fn(
                 seg, feeds, mutable, sharded_const, const, out_names
             )
@@ -764,7 +806,19 @@ class _CompiledBlock(object):
                 fn = self._shard_map_wrap(
                     fn, feeds, mutable, sharded_const, const, out_names
                 )
-            donate = (1,) if device_backend not in (None, "cpu") else ()
+            # mutable state (group 1) is donated on accelerators, where
+            # buffer reuse is the inplace-update replacement. Programs
+            # may opt in on CPU too (`program._donate_mutable`): the
+            # decode runtime's KV caches are session-owned buffers whose
+            # stale value is dead the moment the step runs, and donation
+            # lets XLA scatter the new token in place instead of copying
+            # the whole pool per token.
+            donate = (
+                (1,)
+                if device_backend not in (None, "cpu")
+                or getattr(program, "_donate_mutable", False)
+                else ()
+            )
             jfn = jax.jit(fn, donate_argnums=donate)
             self._plans.append(
                 (
@@ -1097,9 +1151,7 @@ class _CompiledBlock(object):
                 local_env[n] = v
 
         # persist writes + collect fetches
-        persistable = {
-            v.name for v in self.program.list_vars() if v.persistable
-        }
+        persistable = self._persistable
         for n, v in local_env.items():
             if n in persistable:
                 scope.set(n, v)
@@ -1113,11 +1165,24 @@ class _CompiledBlock(object):
 
 def _to_device(val, device):
     import jax
-
-    if isinstance(val, core.LoDTensor):
-        val = val.numpy()
     from jax.sharding import Sharding
 
+    if isinstance(val, jax.Array) and not isinstance(device, Sharding):
+        # already-resident fast path: state vars (params, KV caches,
+        # optimizer accumulators) come back from every step as device
+        # arrays, so the steady-state walk re-places values that never
+        # moved. jax.device_put would conclude the same — at ~40-50 µs of
+        # dispatch per value, which for a ~40-param program is a
+        # milliseconds-per-step tax (the decode probe measured it at a
+        # third of the whole single-token step). devices() is a stored
+        # set; the compare is ~0.1 µs.
+        try:
+            if val.devices() == {device}:
+                return val
+        except Exception:
+            pass  # fall through to the canonical path
+    if isinstance(val, core.LoDTensor):
+        val = val.numpy()
     if isinstance(device, Sharding) and not device.is_fully_addressable:
         # multi-process mesh (launch.py -> jax.distributed.initialize):
         # this process contributes its LOCAL block of the global array —
@@ -1328,7 +1393,16 @@ class Executor(object):
                 while len(self._plans) > self._CACHE_CAPACITY:
                     self._plans.popitem(last=False)
 
-        rng_key = self._next_rng(program, scope)
+        # programs with no random ops skip the per-run fold_in AND the
+        # scope run-index bump (a counter only random programs ever
+        # consume — skipping keeps "fresh scope -> same init" intact and
+        # shaves ~0.5 ms off every inference/decode step); the fixed key
+        # satisfies the compiled signature's rng argument, which the
+        # traced fn never reads
+        if getattr(compiled, "needs_rng", True):
+            rng_key = self._next_rng(program, scope)
+        else:
+            rng_key = _fixed_rng()
         # the step-loop span: one per run(), nesting under the trainer's
         # train_step span and over any RecordEvents ops open inside
         with _obs_trace.span("executor_run", cat="exec"):
@@ -1387,6 +1461,21 @@ class Executor(object):
             print_period, ckpt_manager=ckpt_manager,
             startup_program=startup_program,
         )
+
+
+_FIXED_RNG = None
+
+
+def _fixed_rng():
+    """Cached placeholder PRNG key for programs whose lowering never
+    consumes the key stream (no random ops): same aval as a real key, so
+    the compiled signature matches, zero per-step dispatch."""
+    global _FIXED_RNG
+    if _FIXED_RNG is None:
+        import jax
+
+        _FIXED_RNG = jax.random.key(0)
+    return _FIXED_RNG
 
 
 def _feed_value(v, feed, name):

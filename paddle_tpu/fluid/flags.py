@@ -70,6 +70,18 @@ _DEFAULTS = {
     # default per-request deadline; 0 = no deadline. Requests whose
     # deadline passes while queued are shed at dispatch time.
     "serving_default_deadline_ms": 0.0,
+    # autoregressive decode runtime (paddle_tpu/serving/decode.py): the
+    # KV-cache slot pool + continuous batching engine. decode_slots sizes
+    # the cache pool (= max concurrent streams per engine);
+    # decode_max_len caps the per-slot cache length (0 = the model's
+    # max_position_embeddings); decode_prefill_buckets overrides the
+    # powers-of-two prompt-length ladder with an explicit CSV ("16,64");
+    # decode_queue_depth bounds admission (beyond it submissions shed
+    # with retry-after, like the micro-batcher).
+    "decode_slots": 8,
+    "decode_max_len": 0,
+    "decode_prefill_buckets": "",
+    "decode_queue_depth": 64,
     # checkpoint manager (paddle_tpu/checkpoint): trainer-integrated save
     # cadence (0 = off), retention (newest keep_max steps survive GC,
     # every keep_every_n_steps-th step is pinned forever), writer-queue

@@ -17,6 +17,8 @@ __all__ = [
     "nce",
     "cos_sim",
     "flash_attention",
+    "flash_decode_attention",
+    "kv_cache_write",
     "scale",
     "sequence_pool",
     "sequence_first_step",
@@ -1373,6 +1375,53 @@ def flash_attention(q, k, v, key_bias=None, bias=None, causal=False,
                "interpret": bool(interpret)},
     )
     return out
+
+
+def flash_decode_attention(q, k, v, key_bias=None, scale=0.0,
+                           interpret=False, name=None):
+    """Decode-mode single-query fused attention: ``q`` [N, heads, 1,
+    d_head] (one live token per KV-cache slot) against the fixed-shape
+    cache ``k``/``v`` [N, heads, max_len, d_head]. ``key_bias``
+    [N, max_len] additively masks cache positions at/beyond each slot's
+    live length (-1e4) — the only mask decode needs, since a slot's cache
+    never holds a future token. Forward-only (inference); Pallas kernel
+    on TPU, dense reference elsewhere; ``scale`` 0 means 1/sqrt(d_head)."""
+    helper = LayerHelper("flash_decode_attention", **locals())
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if key_bias is not None:
+        inputs["KeyBias"] = [key_bias]
+    helper.append_op(
+        type="flash_decode_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "interpret": bool(interpret)},
+    )
+    return out
+
+
+def kv_cache_write(cache, new, pos, slot_mode=False, name=None):
+    """In-place-shaped KV-cache write: lands ``new`` into ``cache``
+    [slots, heads, max_len, d_head] by dynamic-update-slice — O(written
+    bytes), not O(cache) like a one-hot blend — and returns the SAME
+    cache variable carrying the updated value (the op's output aliases
+    its input var, so the executor persists the new buffer and, with
+    donation armed, XLA updates it in place).
+
+    ``slot_mode=False`` (decode): ``new`` [slots, heads, 1, d_head] is
+    one token per slot, ``pos`` [slots, ...] its per-slot cache
+    position. ``slot_mode=True`` (prefill): ``new`` [1, heads, T,
+    d_head] is one prompt's K/V, ``pos`` a scalar slot index — the row's
+    first T positions are replaced (stale tail stays masked until decode
+    overwrites it position by position). Inference-only (no gradient)."""
+    helper = LayerHelper("kv_cache_write", **locals())
+    helper.append_op(
+        type="kv_cache_write",
+        inputs={"Cache": [cache], "New": [new], "Pos": [pos]},
+        outputs={"Out": [cache]},
+        attrs={"slot_mode": bool(slot_mode)},
+    )
+    return cache
 
 
 def cos_sim(X, Y):

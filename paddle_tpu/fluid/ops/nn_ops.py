@@ -886,6 +886,67 @@ def _flash_attention(ctx, op_):
         ctx.set(oname + "@FLASH_SEED", seed)
 
 
+def _flash_decode_infer(op_, block):
+    q = in_var(op_, block, "Q")
+    set_out(op_, block, "Out", list(q.shape), q.dtype)
+
+
+@op("flash_decode_attention", infer_shape=_flash_decode_infer)
+def _flash_decode_attention(ctx, op_):
+    """Decode-mode single-query attention (kernels/flash_attention.py
+    flash_decode_attention): one live token per KV-cache slot against the
+    fixed-shape cache, per-slot length masking via KeyBias. Inference
+    only — no grad registered; the decode graph never differentiates."""
+    from ...kernels.flash_attention import flash_decode_attention
+
+    q = ctx.in1(op_, "Q")
+    k = ctx.in1(op_, "K")
+    v = ctx.in1(op_, "V")
+    kb_names = op_.inputs.get("KeyBias") or []
+    key_bias = ctx.in1(op_, "KeyBias") if kb_names else None
+    scale = op_.attr("scale", 0.0)
+    interpret = bool(op_.attr("interpret", False)) or None
+    ctx.out(op_, "Out", flash_decode_attention(
+        q, k, v, key_bias=key_bias,
+        scale=float(scale) if scale else None,
+        interpret=interpret,
+    ))
+
+
+def _kv_cache_write_infer(op_, block):
+    c = in_var(op_, block, "Cache")
+    set_out(op_, block, "Out", list(c.shape), c.dtype)
+
+
+@op("kv_cache_write", infer_shape=_kv_cache_write_infer)
+def _kv_cache_write(ctx, op_):
+    """KV-cache scatter via dynamic_update_slice: O(written bytes)
+    instead of the one-hot blend's O(cache) multiply-add passes — the
+    decode step is bandwidth-bound on exactly this traffic. Indices are
+    runtime DATA (never part of the compiled shape), so admission /
+    per-step writes reuse one executable. With the owning program's
+    mutable-donation opt-in the update happens in the cache's own
+    buffer. Inference-only — no gradient registered."""
+    import jax
+    import jax.numpy as jnp
+
+    cache = ctx.in1(op_, "Cache")
+    new = ctx.in1(op_, "New").astype(cache.dtype)
+    pos = ctx.in1(op_, "Pos")
+    z = jnp.int32(0)
+    if bool(op_.attr("slot_mode", False)):
+        slot = pos.reshape(()).astype(jnp.int32)
+        out = jax.lax.dynamic_update_slice(cache, new, (slot, z, z, z))
+    else:
+        p = pos.reshape(-1).astype(jnp.int32)  # [slots]
+
+        def one(c, n, p_):
+            return jax.lax.dynamic_update_slice(c, n, (z, p_, z))
+
+        out = jax.vmap(one)(cache, new, p)
+    ctx.out(op_, "Out", out)
+
+
 @op("flash_attention_grad")
 def _flash_attention_grad(ctx, op_):
     """Backward through the flash kernels from the forward's SAVED

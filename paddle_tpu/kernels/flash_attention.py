@@ -360,6 +360,102 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, key_bias_ref, bias_ref, do_ref,
             dbias_ref[0] += dbias
 
 
+def _decode_kernel(q_ref, k_ref, v_ref, key_bias_ref, o_ref, *, scale,
+                   kv_len, block_q, block_k):
+    """One head per program: the decode-mode single-query path. The whole
+    (padded) query block is one [BQ, D] tile — autoregressive decode has
+    exactly one live query row per slot, padded up to the Mosaic minimum —
+    swept over the K/V cache blocks with the same online softmax as the
+    training kernel. No lse output (nothing differentiates through
+    decode), no dropout (is_test), no causal flag: the per-slot key bias
+    carries ALL masking (cache positions at or beyond the slot's length
+    ride in at -1e4), which is what makes one compiled program serve every
+    mix of slot lengths."""
+    q = q_ref[0]                              # [BQ, D], input dtype
+    m = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    for kb in range(kv_len // block_k):
+        ks = slice(kb * block_k, (kb + 1) * block_k)
+        s = _scores(
+            q, k_ref[0, ks, :], scale, key_bias_ref[0, :, ks],
+            None, 0, kb * block_k, False, block_q, block_k,
+        )
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, ks, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m = m_new
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q, k, v, key_bias=None, scale=None,
+                           interpret=None):
+    """Decode-mode attention: ONE query token per (batch-slot, head)
+    against a fixed-shape K/V cache.
+
+    q [B, N, 1, D]; k/v [B, N, S, D] (the cache, S = max cache length);
+    ``key_bias`` additive mask over cache positions, [B, S] / [B*N, S] or
+    broadcastable — the caller masks positions >= the slot's live length
+    with -1e4 (and that mask alone carries causality: a slot's cache
+    never holds a future token). Forward-only (no custom VJP — decode is
+    inference), fp32 accumulation.
+
+    Runs the Pallas kernel on TPU (or under ``interpret=True``), and a
+    dense jnp reference on other backends — same dispatch contract as
+    ``flash_attention``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, N, Sq, D = q.shape
+    Sk = k.shape[2]
+    if Sq != 1:
+        raise ValueError(
+            "flash_decode_attention is the single-query path, got Sq=%d"
+            % Sq
+        )
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(D))
+    kb = _normalize_key_bias(key_bias, B, N, Sk)
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None and not on_tpu:
+        # dense fallback: bit-compatible math with reference_attention
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, k).astype(jnp.float32) * scale
+        if kb is not None:
+            s = s + kb.reshape(B, N, 1, Sk)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), v)
+    if kb is None:
+        kb = jnp.zeros((B * N, Sk), jnp.float32)
+    qf, kf, vf, kbp, _bf, _g, geom = _prep(q, k, v, kb, None)
+    _B, _N, _Sq, _Sk, Sqp, Skp, _bq, bk = geom
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, kv_len=Skp, block_q=Sqp, block_k=bk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * N, Sqp, D), q.dtype),
+        grid=(B * N,),
+        in_specs=[
+            pl.BlockSpec((1, Sqp, D), lambda h: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Skp, D), lambda h: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Skp, D), lambda h: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Skp), lambda h: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, Sqp, D), lambda h: (h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=bool(interpret),
+    )(qf, kf, vf, kbp[:, None, :])
+    return out[:, :1, :].reshape(B, N, 1, D)
+
+
 # --------------------------------------------------------------------------
 # padding / plumbing
 # --------------------------------------------------------------------------

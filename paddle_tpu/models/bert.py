@@ -119,8 +119,31 @@ def flash_wanted(cfg, seq_len=None):
     return bool(want)
 
 
+def _apply_kv_cache(cache, k, v, cfg):
+    """Write this call's split-head K/V into the cache described by
+    ``cache`` (see ``multi_head_attention``) via the ``kv_cache_write``
+    dynamic-update-slice op — O(written bytes), with the write position /
+    slot index as runtime DATA, so one compiled program covers every
+    admission pattern. Prefill lands the prompt's [1, heads, T, d] K/V
+    at the head of slot ``slot_idx``'s row (the stale tail beyond T
+    stays key-bias-masked until decode overwrites it position by
+    position); decode lands one token per slot at its ``pos``. Returns
+    (k, v) for the attention that follows: the LOCAL prompt K/V for
+    prefill (attention runs within the prompt), the full UPDATED cache
+    for decode (the query attends to everything written so far)."""
+    if cache["mode"] == "prefill":
+        fluid.layers.kv_cache_write(cache["k"], k, cache["slot_idx"],
+                                    slot_mode=True)
+        fluid.layers.kv_cache_write(cache["v"], v, cache["slot_idx"],
+                                    slot_mode=True)
+        return k, v
+    k_upd = fluid.layers.kv_cache_write(cache["k"], k, cache["pos"])
+    v_upd = fluid.layers.kv_cache_write(cache["v"], v, cache["pos"])
+    return k_upd, v_upd
+
+
 def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
-                         causal=False, use_flash=None):
+                         causal=False, use_flash=None, cache=None):
     """Self/cross attention on [N, S, H] inputs.
 
     With ``cfg.use_flash_attention`` the score/softmax/context chain runs
@@ -133,7 +156,26 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     choose which mask to construct from ``flash_wanted`` and must pass
     that same decision down, so a dynamic query dim here can never
     silently diverge from the mask they built (ADVICE r5). ``None`` keeps
-    the legacy behavior of re-resolving from the static query length."""
+    the legacy behavior of re-resolving from the static query length.
+
+    ``cache``: KV-cache plumbing for autoregressive serving (None for
+    training/encoder use). A dict with ``k``/``v`` — persistable
+    [slots, heads, max_len, d_head] cache vars — plus ``mode``:
+
+    - ``"prefill"``: attention runs the NORMAL path over the prompt
+      (causal + padding masks as usual) and, as a side effect, writes the
+      prompt's K/V into the cache slot indexed by the fed scalar
+      ``slot_idx``;
+    - ``"decode"``: the single-query step. Each slot's new-token K/V
+      lands at its fed ``pos`` [slots] cache position (free slots write
+      a dead row's position 0 — harmless, the row is masked and replaced
+      on admission), then the length-1 query attends over the updated
+      cache under ``key_bias`` [slots, max_len] (additive, -1e4 beyond
+      each slot's live length) — via the decode-mode flash kernel when
+      ``use_flash``, dense single-query attention otherwise.
+      ``attn_bias``/``causal`` are ignored: the per-slot key mask IS the
+      causal mask, since a slot's cache never holds an unmasked future
+      token."""
     d_head = cfg.hidden_size // cfg.num_heads
 
     def _proj(x, suffix):
@@ -150,6 +192,33 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     q = _split_heads(_proj(q_in, "q"))
     k = _split_heads(_proj(kv_in, "k"))
     v = _split_heads(_proj(kv_in, "v"))
+    if cache is not None:
+        k, v = _apply_kv_cache(cache, k, v, cfg)
+    if cache is not None and cache["mode"] == "decode":
+        scale_ = 1.0 / math.sqrt(d_head)
+        if use_flash:
+            ctxt = fluid.layers.flash_decode_attention(
+                q, k, v, key_bias=cache["key_bias"], scale=scale_,
+                interpret=getattr(cfg, "flash_interpret", False),
+            )
+        else:
+            scores = fluid.layers.matmul(
+                q, k, transpose_y=True, alpha=scale_
+            )
+            bias4 = fluid.layers.reshape(
+                cache["key_bias"], shape=[0, 1, 1, -1]
+            )
+            bias4.stop_gradient = True
+            weights = fluid.layers.softmax(
+                fluid.layers.elementwise_add(scores, bias4), axis=-1
+            )
+            ctxt = fluid.layers.matmul(weights, v)
+        ctxt = fluid.layers.transpose(ctxt, perm=[0, 2, 1, 3])
+        ctxt = fluid.layers.reshape(ctxt, shape=[0, 0, cfg.hidden_size])
+        return fluid.layers.fc(
+            input=ctxt, size=cfg.hidden_size, num_flatten_dims=2,
+            name="%s_out" % name,
+        )
     if use_flash is None:
         _sq = q_in.shape[1] if len(q_in.shape) >= 2 else -1
         use_flash = flash_engages(

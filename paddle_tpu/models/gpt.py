@@ -60,8 +60,14 @@ def _causal_bias(seq_len):
     return bias
 
 
-def gpt_decoder(ids, pos_ids, input_mask, cfg):
-    """Decoder stack on [N, T, 1] int64 ids; returns hidden [N, T, H]."""
+def gpt_decoder(ids, pos_ids, input_mask, cfg, kv_cache=None):
+    """Decoder stack on [N, T, 1] int64 ids; returns hidden [N, T, H].
+
+    ``kv_cache`` (None for training/full-forward inference) threads the
+    decode runtime's cache plumbing through every layer's attention —
+    see ``build_gpt_prefill`` / ``build_gpt_decode_step``. In ``decode``
+    mode ``input_mask`` is unused (the per-slot cache key bias carries
+    all masking) and T is 1."""
     emb = fluid.layers.embedding(
         input=ids, size=[cfg.vocab_size, cfg.hidden_size],
         param_attr=fluid.ParamAttr(name="tok_embedding"),
@@ -75,35 +81,54 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg):
 
     key_bias = None
     attn_bias = None
-    # resolve the flash policy ONCE and pass the decision down: the
-    # attention helper re-deriving it from a possibly-dynamic q_in seq dim
-    # could silently take the dense branch with attn_bias=None, dropping
-    # causal+padding masking entirely (ADVICE r5)
-    _s = ids.shape[1] if len(ids.shape) >= 2 else -1
-    use_flash = _bert.flash_wanted(
-        cfg, seq_len=None if _s in (-1, None) else int(_s)
-    )
-    if use_flash:
-        # padding as a key-only bias; causality rides the kernel flag
-        key_bias = _bert.mask_to_key_bias(input_mask)
+    mode = kv_cache["mode"] if kv_cache is not None else None
+    if mode == "decode":
+        # single-query step: masking lives entirely in the fed per-slot
+        # cache key bias; the flash policy keys on the CACHE length (the
+        # kv extent the kernel actually sweeps), not the length-1 query
+        use_flash = _bert.flash_wanted(
+            cfg, seq_len=int(kv_cache["max_len"])
+        )
     else:
-        # dense path: causal [1,1,T,T] + key padding [N,1,1,T] broadcast.
-        # Built whenever the shared attention helper would take its dense
-        # branch (attention dropout no longer forces it — the kernel
-        # drops in-VMEM), which would otherwise run with neither mask
-        pad = fluid.layers.scale(
-            fluid.layers.reshape(input_mask, shape=[0, 1, 1, -1]),
-            scale=1e4, bias=-1e4,
+        # resolve the flash policy ONCE and pass the decision down: the
+        # attention helper re-deriving it from a possibly-dynamic q_in seq
+        # dim could silently take the dense branch with attn_bias=None,
+        # dropping causal+padding masking entirely (ADVICE r5)
+        _s = ids.shape[1] if len(ids.shape) >= 2 else -1
+        use_flash = _bert.flash_wanted(
+            cfg, seq_len=None if _s in (-1, None) else int(_s)
         )
-        pad.stop_gradient = True
-        attn_bias = fluid.layers.elementwise_add(
-            _causal_bias(ids.shape[1]), pad
-        )
+        if use_flash:
+            # padding as a key-only bias; causality rides the kernel flag
+            key_bias = _bert.mask_to_key_bias(input_mask)
+        else:
+            # dense path: causal [1,1,T,T] + key padding [N,1,1,T]
+            # broadcast. Built whenever the shared attention helper would
+            # take its dense branch (attention dropout no longer forces
+            # it — the kernel drops in-VMEM), which would otherwise run
+            # with neither mask
+            pad = fluid.layers.scale(
+                fluid.layers.reshape(input_mask, shape=[0, 1, 1, -1]),
+                scale=1e4, bias=-1e4,
+            )
+            pad.stop_gradient = True
+            attn_bias = fluid.layers.elementwise_add(
+                _causal_bias(ids.shape[1]), pad
+            )
     for i in range(cfg.num_layers):
         name = "gpt_%d" % i
+        cache_i = None
+        if kv_cache is not None:
+            k_var, v_var = kv_cache["caches"][i]
+            cache_i = {"k": k_var, "v": v_var, "mode": mode}
+            if mode == "prefill":
+                cache_i["slot_idx"] = kv_cache["slot_idx"]
+            else:
+                cache_i["pos"] = kv_cache["pos"]
+                cache_i["key_bias"] = kv_cache["key_bias"]
         attn = _bert.multi_head_attention(
             h, h, attn_bias, cfg, name + "_att", key_bias=key_bias,
-            causal=True, use_flash=use_flash,
+            causal=True, use_flash=use_flash, cache=cache_i,
         )
         attn = _bert._dropout(attn, cfg.hidden_dropout, cfg.is_test)
         h = fluid.layers.layer_norm(
@@ -121,9 +146,9 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg):
     return h
 
 
-def gpt_lm_logits(ids, pos_ids, input_mask, cfg):
+def gpt_lm_logits(ids, pos_ids, input_mask, cfg, kv_cache=None):
     """[N, T, vocab] next-token logits."""
-    h = gpt_decoder(ids, pos_ids, input_mask, cfg)
+    h = gpt_decoder(ids, pos_ids, input_mask, cfg, kv_cache=kv_cache)
     return fluid.layers.fc(
         input=h, size=cfg.vocab_size, num_flatten_dims=2, name="lm_head"
     )
@@ -187,27 +212,201 @@ def build_gpt_infer(cfg, seq_len):
     return main, startup, ["ids", "pos_ids", "input_mask"], logits
 
 
-def greedy_generate(exe, infer_prog, logits_var, cfg, prompt_ids, max_len,
-                    scope=None):
-    """Host-driven greedy decode with a fixed-shape graph: the causal
-    mask makes positions >= the current length irrelevant, so one
-    compiled [1, max_len] program serves every step (the XLA-friendly
-    static-shape idiom; the NMT model's beam search is the batched
-    in-graph variant)."""
+# ---------------------------------------------------------------------------
+# autoregressive decode runtime graphs (KV-cache prefill / single-step decode)
+# ---------------------------------------------------------------------------
+
+
+def decode_cache_names(cfg, slots, max_len):
+    """Per-layer (K, V) cache var names — one fixed contract shared by
+    the prefill and decode programs (and the host-side cache init). The
+    pool geometry is part of the name: two sessions sharing one scope
+    (e.g. a 1-slot greedy_generate session next to an 8-slot serving
+    engine) must never read each other's differently-shaped buffers."""
+    return [
+        ("gpt_cache_k_%d_p%dx%d" % (i, slots, max_len),
+         "gpt_cache_v_%d_p%dx%d" % (i, slots, max_len))
+        for i in range(cfg.num_layers)
+    ]
+
+
+def decode_cache_shape(cfg, slots, max_len):
+    return [
+        int(slots), cfg.num_heads, int(max_len),
+        cfg.hidden_size // cfg.num_heads,
+    ]
+
+
+def _declare_cache_vars(cfg, slots, max_len):
+    """Declare the per-layer persistable cache vars in the CURRENT main
+    program. No initializer: the host seeds them with zeros directly in
+    the scope (running a startup here would also re-init the shared
+    model params)."""
+    block = fluid.default_main_program().global_block()
+    shape = decode_cache_shape(cfg, slots, max_len)
+    return [
+        tuple(
+            block.create_var(
+                name=n, shape=shape, dtype="float32", persistable=True
+            )
+            for n in names
+        )
+        for names in decode_cache_names(cfg, slots, max_len)
+    ]
+
+
+def build_gpt_prefill(cfg, slots, seq_len, max_len):
+    """Prefill graph: ONE prompt (batch 1, padded to the ``seq_len``
+    bucket) runs the normal causal forward and, per layer, writes its
+    K/V into the cache slot indexed by the fed scalar ``slot_idx``
+    (dynamic-update-slice — the index is runtime data, so every slot
+    shares this one compiled program). ``last_onehot`` [1, seq_len, 1]
+    selects the last real prompt position's logits in-graph, so the
+    fetch is [1, vocab] — not [seq_len, vocab].
+
+    Returns (main, startup, feed names, next_logits). The startup is a
+    byproduct (param initializers) and is NOT meant to be run by the
+    decode runtime — params come from the scope it attaches to."""
+    import copy
+
+    cfg = copy.copy(cfg)
+    cfg.is_test = True
+    main, startup = fluid.Program(), fluid.Program()
+    # the cache vars are this program's only mutable state and the
+    # session owns them outright: donate, so XLA writes the slot row in
+    # the cache's own buffer instead of copying the pool per prefill
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[seq_len, 1],
+                                dtype="int64")
+        pos_ids = fluid.layers.data(name="pos_ids", shape=[seq_len, 1],
+                                    dtype="int64")
+        input_mask = fluid.layers.data(
+            name="input_mask", shape=[seq_len, 1], dtype="float32"
+        )
+        slot_idx = fluid.layers.data(name="slot_idx", shape=[1],
+                                     dtype="int64")
+        last_onehot = fluid.layers.data(
+            name="last_onehot", shape=[seq_len, 1], dtype="float32"
+        )
+        kv_cache = {
+            "mode": "prefill",
+            "caches": _declare_cache_vars(cfg, slots, max_len),
+            "slot_idx": slot_idx,
+            "max_len": max_len,
+        }
+        logits = gpt_lm_logits(ids, pos_ids, input_mask, cfg,
+                               kv_cache=kv_cache)
+        next_logits = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(logits, last_onehot), dim=1
+        )
+    feeds = ["ids", "pos_ids", "input_mask", "slot_idx", "last_onehot"]
+    return main, startup, feeds, next_logits
+
+
+def build_gpt_decode_step(cfg, slots, max_len):
+    """Single-step decode graph: one new token per slot (query length 1)
+    against the per-layer KV caches. Feeds — all fixed-shape, so ONE
+    compiled program serves every mix of slot lengths / admissions /
+    retirements:
+
+    - ``step_ids`` / ``step_pos`` [slots, 1, 1] int64: each slot's newest
+      token and its cache position, which is also where its K/V is
+      scatter-written (inactive slots feed zeros: they write a dead
+      row's position 0, masked and replaced on admission);
+    - ``key_bias`` [slots, max_len]: additive mask, 0 on live cache
+      positions (<= the slot's current position), -1e4 beyond — the only
+      mask decode needs, and the causal mask by construction.
+
+    Returns (main, startup, feed names, step_logits [slots, vocab])."""
+    import copy
+
+    cfg = copy.copy(cfg)
+    cfg.is_test = True
+    main, startup = fluid.Program(), fluid.Program()
+    # donate the caches: the per-token step updates them in place
+    # instead of copying the whole pool every token (decode is
+    # bandwidth-bound on exactly this traffic)
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        step_ids = fluid.layers.data(name="step_ids", shape=[1, 1],
+                                     dtype="int64")
+        step_pos = fluid.layers.data(name="step_pos", shape=[1, 1],
+                                     dtype="int64")
+        key_bias = fluid.layers.data(
+            name="key_bias", shape=[max_len], dtype="float32"
+        )
+        kv_cache = {
+            "mode": "decode",
+            "caches": _declare_cache_vars(cfg, slots, max_len),
+            "pos": step_pos,
+            "key_bias": key_bias,
+            "max_len": max_len,
+        }
+        logits = gpt_lm_logits(step_ids, step_pos, None, cfg,
+                               kv_cache=kv_cache)
+        step_logits = fluid.layers.reshape(
+            logits, shape=[-1, cfg.vocab_size]
+        )
+    feeds = ["step_ids", "step_pos", "key_bias"]
+    return main, startup, feeds, step_logits
+
+
+def _reference_generate(exe, infer_prog, logits_var, cfg, prompt_ids,
+                        max_len, scope=None):
+    """The ORACLE: host-driven greedy decode recomputing the full
+    [1, max_len] forward per emitted token. O(T^2) model forwards — kept
+    verbatim (minus rebuilding the loop-constant pos_ids / position-index
+    arrays every iteration) as the parity reference the decode runtime's
+    tests and probe compare token-for-token against."""
     ids = list(prompt_ids)
+    pos_ids = np.arange(max_len).reshape(1, max_len, 1).astype("int64")
+    positions = np.arange(max_len)
+    padded = np.zeros((1, max_len, 1), "int64")
+    padded[0, : len(ids), 0] = ids
     for _ in range(max_len - len(prompt_ids)):
         cur = len(ids)
-        padded = np.zeros((1, max_len, 1), "int64")
         padded[0, :cur, 0] = ids
         feed = {
             "ids": padded,
-            "pos_ids": np.arange(max_len).reshape(1, max_len, 1)
-            .astype("int64"),
-            "input_mask": (np.arange(max_len) < cur)
+            "pos_ids": pos_ids,
+            "input_mask": (positions < cur)
             .astype("float32").reshape(1, max_len, 1),
         }
         (lv,) = exe.run(infer_prog, feed=feed, fetch_list=[logits_var],
                         scope=scope)
         nxt = int(np.asarray(lv)[0, cur - 1].argmax())
         ids.append(nxt)
+    return ids
+
+
+def greedy_generate(exe, infer_prog, logits_var, cfg, prompt_ids, max_len,
+                    scope=None):
+    """Greedy decode through the KV-cache runtime: one prefill over the
+    prompt, then O(1)-length incremental steps against the cache — O(T)
+    total model work instead of the O(T^2) full-forward-per-token loop
+    (kept as ``_reference_generate``, the parity oracle). Output is
+    token-exact vs the oracle: the cached K/V are the same projections
+    the full forward computes, masked-out positions carry exactly-zero
+    softmax weight in fp32, and the argmax sees bitwise-equal logits.
+
+    The single-slot decode session is cached per (scope, model geometry),
+    so repeated calls reuse the compiled prefill/decode programs."""
+    ids = list(prompt_ids)
+    if len(ids) >= max_len:
+        return ids
+    from paddle_tpu.serving import decode as _decode
+
+    sess = _decode.session_for_generate(exe, cfg, scope, max_len,
+                                        infer_prog)
+    # the session is cached per (scope, geometry): concurrent callers
+    # (the old per-call loop was trivially reentrant) serialize on its
+    # lock for the WHOLE generation so interleaved steps can never read
+    # each other's slot-0 cache
+    with sess.lock:
+        logits = sess.prefill(0, ids)
+        ids.append(int(np.asarray(logits).ravel().argmax()))
+        while len(ids) < max_len:
+            step = sess.decode_step([ids[-1]], [len(ids) - 1], [True])
+            ids.append(int(np.asarray(step)[0].argmax()))
     return ids

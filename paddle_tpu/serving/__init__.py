@@ -7,6 +7,11 @@ AnalysisPredictor clones sharing compiled plans, with bounded admission
 warmup (zero steady-state XLA compiles), and a ServingStats snapshot
 riding the always-on fluid.profiler counters.
 
+Autoregressive generation rides the decode runtime (serving/decode.py):
+a KV-cache slot pool with bucketed prefill + a single fused decode-step
+program, continuously batched — ``DecodeEngine`` standalone or through
+``InferenceServer.generate()``.
+
 Quickstart::
 
     from paddle_tpu import inference, serving
@@ -27,12 +32,20 @@ from .batcher import (  # noqa: F401
     ServingError,
 )
 from .buckets import BatchPlan, BucketLadder  # noqa: F401
+from .decode import (  # noqa: F401
+    DecodeEngine,
+    DecodeSession,
+    GenerationStream,
+)
 from .metrics import ServingStats, snapshot_stats  # noqa: F401
 from .pool import PredictorPool  # noqa: F401
 from .server import InferenceServer  # noqa: F401
 
 __all__ = [
     "InferenceServer",
+    "DecodeEngine",
+    "DecodeSession",
+    "GenerationStream",
     "MicroBatcher",
     "PredictorPool",
     "BucketLadder",

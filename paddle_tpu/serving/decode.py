@@ -1,0 +1,764 @@
+"""Autoregressive decode runtime: KV-cache slot pool + continuous batching.
+
+The serving stack's generation path. `InferenceServer` batches whole
+forwards; a GPT completion served that way recomputes the full
+[1, max_len] forward for every emitted token — O(T^2) model forwards at
+batch 1. This module replaces that with the production decode shape:
+
+  prefill  (one compiled program per PROMPT bucket): the prompt runs one
+           causal forward and writes its per-layer K/V into a cache slot;
+  decode   (ONE compiled program, ever): every engine tick runs a single
+           fused step over ALL slots — each active slot contributes one
+           query token against its cache row, masked by its own length.
+
+The cache is a fixed pool of ``slots`` rows per layer
+([slots, heads, max_len, d_head] persistable scope vars, device-resident
+between steps). Admission writes a slot row, retirement just frees the
+index — neither changes any compiled shape, so a churned request mix
+holds the PR 7 strict-compile gate at zero steady-state recompiles by
+construction. Decode is the bandwidth-bound regime (every token re-reads
+the weights plus the cache; PAPERS "Operator Fusion in XLA"), which is
+exactly why batching all slots into one step is the throughput lever:
+the weight traffic amortizes over every live stream.
+
+Layering: ``DecodeSession`` is the synchronous core (programs, cache
+init, prefill / fused step) — ``gpt.greedy_generate`` drives a 1-slot
+session inline; ``DecodeEngine`` owns the continuous-batching loop
+(admission queue, slot scheduler, streaming) and is what
+``InferenceServer.generate()`` fronts.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import re
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from ..fluid import flags as _flags
+from ..fluid import profiler as _profiler
+from ..models import gpt as _gpt
+from ..observability import exporter as _obs_exporter
+from ..observability import registry as _obs_registry
+from ..observability import trace as _trace
+from ..observability import xla_stats as _xla_stats
+from .batcher import ServerOverloadedError, ServingError
+
+__all__ = [
+    "DecodeSession",
+    "DecodeEngine",
+    "GenerationStream",
+    "prefill_ladder",
+    "session_for_generate",
+]
+
+
+def _flag(name, override):
+    return override if override is not None else _flags.get_flag(name)
+
+
+def prefill_ladder(max_len, buckets=None):
+    """Ascending prompt-length buckets, each a compiled prefill shape.
+    ``buckets``: explicit list/CSV (``FLAGS_decode_prefill_buckets``), or
+    None for the default powers-of-two ladder capped by (and always
+    including) ``max_len`` — mirroring the batch ladder in buckets.py."""
+    if isinstance(buckets, str):
+        buckets = [int(b) for b in buckets.split(",") if b.strip()]
+    if buckets:
+        out = sorted(set(int(b) for b in buckets))
+        if out[0] < 1:
+            raise ValueError("prefill buckets must be positive: %r"
+                             % (buckets,))
+        kept = [b for b in out if b <= max_len]
+        if len(kept) != len(out):
+            import warnings
+
+            # dropped, not fatal: FLAGS_decode_prefill_buckets may be
+            # shared across engines with different max_len — but an
+            # operator whose whole ladder exceeded max_len should hear
+            # that every prompt will now pad to the full-length program
+            warnings.warn(
+                "prefill buckets %r exceed max_len %d and were dropped"
+                "%s" % (
+                    [b for b in out if b > max_len], max_len,
+                    "; every prompt now pads to the full-length program"
+                    if not kept else "",
+                ), stacklevel=2)
+        out = kept
+        if not out or out[-1] != max_len:
+            out.append(int(max_len))
+        return out
+    out = []
+    b = 8
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(int(max_len))
+    return out
+
+
+class DecodeSession(object):
+    """Synchronous KV-cache decode core over one Executor + scope.
+
+    Builds the bucketed prefill programs and the single fused decode-step
+    program (all under fresh ``unique_name`` guards, so their parameter
+    names are the canonical ``<layer>.w_0`` spellings), seeds the cache
+    vars with zeros directly in the scope (no startup run — the scope's
+    model params are someone else's and must not be re-initialized), and
+    exposes ``prefill`` / ``decode_step``. Thread-compatible, not
+    thread-safe: one driver at a time (the engine's loop thread, or the
+    caller of ``greedy_generate``)."""
+
+    def __init__(self, cfg, place=None, scope=None, slots=None,
+                 max_len=None, prefill_buckets=None):
+        self.cfg = copy.copy(cfg)
+        self.cfg.is_test = True
+        self.slots = int(_flag("decode_slots", slots))
+        max_len = int(_flag("decode_max_len", max_len))
+        if max_len <= 0:
+            max_len = int(cfg.max_position_embeddings)
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                "decode max_len %d exceeds max_position_embeddings %d"
+                % (max_len, cfg.max_position_embeddings)
+            )
+        if self.slots < 1 or max_len < 2:
+            raise ValueError(
+                "need slots >= 1 and max_len >= 2, got %d / %d"
+                % (self.slots, max_len)
+            )
+        self.max_len = max_len
+        self.buckets = prefill_ladder(
+            max_len, _flag("decode_prefill_buckets", prefill_buckets) or None
+        )
+        self.place = place if place is not None else fluid.CPUPlace()
+        self.scope = scope if scope is not None else fluid.core.Scope()
+        # own executor: the session's program/plan caches never contend
+        # with (or evict) a caller's LRU entries
+        self.exe = fluid.Executor(self.place)
+        # session-local activity tallies (the process-global profiler
+        # counters aggregate every session in the process; per-engine
+        # stats need the unshared view)
+        self.prefills = 0
+        self.steps = 0
+        # one driver at a time: the engine's loop thread is naturally
+        # exclusive, but greedy_generate funnels arbitrary caller
+        # threads into one CACHED session per (scope, geometry) — they
+        # serialize on this lock so interleaved prefill/decode_step
+        # calls can never cross-contaminate the slot-0 cache
+        self.lock = threading.RLock()
+        self._prefill = {}
+        for seq_len in self.buckets:
+            with fluid.unique_name.guard():
+                main, _startup, _feeds, next_logits = _gpt.build_gpt_prefill(
+                    self.cfg, self.slots, seq_len, max_len
+                )
+            self._prefill[seq_len] = (main, next_logits.name)
+        with fluid.unique_name.guard():
+            main, _startup, _feeds, step_logits = _gpt.build_gpt_decode_step(
+                self.cfg, self.slots, max_len
+            )
+        self._decode = (main, step_logits.name)
+        self._cols = np.arange(max_len)
+        self._pos_cache = {
+            T: np.arange(T).reshape(1, T, 1).astype("int64")
+            for T in self.buckets
+        }
+        self.reset_caches()
+
+    # -- state ---------------------------------------------------------------
+    def reset_caches(self):
+        """Zero every cache var in the scope (host-side: no program, no
+        param re-init). Correctness never depends on this — prefill
+        replaces a slot's whole row — but fresh buffers make warmup and
+        tests deterministic."""
+        shape = _gpt.decode_cache_shape(self.cfg, self.slots, self.max_len)
+        for k_name, v_name in _gpt.decode_cache_names(
+            self.cfg, self.slots, self.max_len
+        ):
+            self.scope.set(k_name, np.zeros(shape, "float32"))
+            self.scope.set(v_name, np.zeros(shape, "float32"))
+
+    def bind_params(self, program):
+        """Alias ``program``'s parameters onto this session's canonical
+        names. A program built OUTSIDE a fresh ``unique_name.guard()``
+        carries shifted numeric suffixes (``gpt_0_att_q.w_3``); the
+        session's programs always say ``.w_0``. Aliasing the scope entry
+        (same array object — params are read-only here) lets the decode
+        runtime attach to any trained/initialized scope. Cheap;
+        re-invoked per generate call so retrained params stay current.
+
+        Contract: ``program`` is THE model of this scope — the alias
+        targets the canonical name, so a scope deliberately holding two
+        same-architecture models (one guard-built, one not) would see
+        the guard-built one's params replaced by this program's. Give
+        each model its own scope (the repo-wide convention) if both
+        must stay live."""
+        for v in program.list_vars():
+            if not getattr(v, "is_parameter", False):
+                continue
+            canon = re.sub(r"_(\d+)$", "_0", v.name)
+            if canon == v.name:
+                continue
+            val = self.scope.get(v.name)
+            if val is not None:
+                self.scope.set(canon, val)
+
+    def bucket_for(self, prompt_len):
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            "prompt of %d tokens exceeds the prefill ladder (max %d)"
+            % (prompt_len, self.buckets[-1])
+        )
+
+    # -- device steps --------------------------------------------------------
+    def prefill(self, slot, prompt_ids):
+        """Run the prompt through the bucketed prefill program, writing
+        slot ``slot``'s cache row; returns the next-token logits
+        [vocab] at the last real prompt position."""
+        P = len(prompt_ids)
+        if not 0 <= slot < self.slots:
+            raise ValueError("slot %d out of range" % slot)
+        if P < 1:
+            raise ValueError("empty prompt")
+        T = self.bucket_for(P)
+        main, fetch_name = self._prefill[T]
+        ids = np.zeros((1, T, 1), "int64")
+        ids[0, :P, 0] = prompt_ids
+        mask = (np.arange(T) < P).astype("float32").reshape(1, T, 1)
+        last_onehot = np.zeros((1, T, 1), "float32")
+        last_onehot[0, P - 1, 0] = 1.0
+        feed = {
+            "ids": ids,
+            "pos_ids": self._pos_cache[T],
+            "input_mask": mask,
+            "slot_idx": np.array([[slot]], "int64"),
+            "last_onehot": last_onehot,
+        }
+        t0 = time.perf_counter()
+        with _trace.span("decode_prefill", cat="serving", bucket=T, rows=P):
+            (lv,) = self.exe.run(
+                main, feed=feed, fetch_list=[fetch_name], scope=self.scope
+            )
+        _profiler.bump_counter("decode_prefills")
+        self.prefills += 1
+        _profiler.bump_histogram(
+            "decode_prefill_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return np.asarray(lv)[0]
+
+    def decode_step(self, tokens, positions, active):
+        """ONE fused step over all slots: slot i's ``tokens[i]`` lands at
+        cache position ``positions[i]`` and its next-token logits come
+        back; slots with ``active[i]`` False feed inert zeros (a free
+        slot's dead cache row takes a masked position-0 write; its
+        output is ignored and admission rewrites the row anyway).
+        Returns logits [slots, vocab]."""
+        act = np.asarray(active, bool)
+        pos = np.where(act, np.asarray(positions, "int64"), 0)
+        tok = np.where(act, np.asarray(tokens, "int64"), 0)
+        key_bias = (
+            ((self._cols[None, :] > pos[:, None]) | ~act[:, None])
+            .astype("float32") * -1e4
+        )
+        main, fetch_name = self._decode
+        feed = {
+            "step_ids": tok.reshape(self.slots, 1, 1),
+            "step_pos": pos.reshape(self.slots, 1, 1),
+            "key_bias": key_bias,
+        }
+        t0 = time.perf_counter()
+        with _trace.span(
+            "decode_step", cat="serving", active=int(act.sum())
+        ):
+            (lv,) = self.exe.run(
+                main, feed=feed, fetch_list=[fetch_name], scope=self.scope
+            )
+        _profiler.bump_counter("decode_steps")
+        self.steps += 1
+        _profiler.bump_histogram(
+            "decode_step_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return np.asarray(lv)
+
+
+# -- greedy_generate's session cache ----------------------------------------
+# stored ON the scope object (not in a module registry): a session holds
+# a strong reference to its scope, so any global map — even weak-keyed —
+# would pin every scope it ever saw (WeakKeyDictionary values that
+# reference their key are never collected). As a scope attribute, the
+# scope→session→scope cycle is ordinary garbage for the cycle collector
+# and sessions really do die with the scope. Keyed by model geometry +
+# flash policy so distinct configs in one scope never share programs.
+_GEN_LOCK = threading.Lock()
+
+
+def session_for_generate(exe, cfg, scope, max_len, param_program):
+    scope_obj = scope if scope is not None else fluid.core.global_scope()
+    key = (
+        cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
+        cfg.intermediate_size, cfg.max_position_embeddings,
+        repr(getattr(cfg, "use_flash_attention", False)),
+        bool(getattr(cfg, "flash_interpret", False)),
+        int(max_len), type(exe.place).__name__,
+    )
+    with _GEN_LOCK:
+        cache = getattr(scope_obj, "_decode_gen_sessions", None)
+        if cache is None:
+            cache = {"lock": threading.Lock(), "sessions": {}}
+            scope_obj._decode_gen_sessions = cache
+    # session construction (len(buckets)+1 graph builds) happens under
+    # the PER-SCOPE lock only: first-time callers on unrelated scopes
+    # build in parallel; same-scope callers serialize
+    with cache["lock"]:
+        sess = cache["sessions"].get(key)
+        if sess is None:
+            sess = DecodeSession(
+                cfg, place=exe.place, scope=scope_obj, slots=1,
+                max_len=max_len,
+            )
+            cache["sessions"][key] = sess
+    sess.bind_params(param_program)
+    return sess
+
+
+# ---------------------------------------------------------------------------
+# streaming handle
+# ---------------------------------------------------------------------------
+
+_SENTINEL = object()
+
+
+class GenerationStream(object):
+    """Per-request streaming handle. The engine pushes tokens as they are
+    generated; the caller iterates (``for tok in stream``) for live
+    streaming, or blocks on ``tokens()`` / ``result()`` for the whole
+    completion. Single consumer. ``finish_reason`` is ``"eos"`` /
+    ``"length"`` once done."""
+
+    def __init__(self, prompt_ids, max_new_tokens=None, eos_id=None):
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.finish_reason = None
+        # engine tick bookkeeping (scheduler tests / fairness probes):
+        # the tick a slot was admitted on and the last tick it decoded on
+        self.first_tick = None
+        self.last_tick = None
+        self._q = queue.Queue()
+        self._tokens = []
+        self._done = threading.Event()
+        self._error = None
+
+    # engine side
+    def _push(self, tok):
+        self._tokens.append(int(tok))
+        self._q.put(int(tok))
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self._done.set()
+        self._q.put(_SENTINEL)
+
+    def _fail(self, exc):
+        self._error = exc
+        self._done.set()
+        self._q.put(_SENTINEL)
+
+    # consumer side
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def tokens(self, timeout=None):
+        """Block until the request finishes; returns the GENERATED tokens
+        (prompt excluded)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still in flight")
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def result(self, timeout=None):
+        """prompt + generated tokens — ``greedy_generate``'s contract."""
+        return self.prompt_ids + self.tokens(timeout)
+
+
+class _Slot(object):
+    __slots__ = ("stream", "pending_token", "next_pos", "generated")
+
+    def __init__(self, stream, pending_token, next_pos):
+        self.stream = stream
+        self.pending_token = pending_token  # emitted, not yet cached
+        self.next_pos = next_pos            # cache position it writes next
+        self.generated = 1                  # prefill already emitted one
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching engine
+# ---------------------------------------------------------------------------
+
+
+class DecodeEngine(object):
+    """Continuous batching over a ``DecodeSession`` slot pool.
+
+    One loop thread ticks: admit queued requests into free slots via
+    prefill (mid-flight — active streams keep decoding across
+    admissions), then run ONE fused decode step for every active slot,
+    stream each new token out, and retire slots on EOS / max-tokens /
+    max-length. Greedy (argmax) decoding — token-exact with
+    ``gpt._reference_generate``.
+
+    ``start()`` eagerly compiles every prefill bucket and the decode
+    step inside a warmup window, then arms the PR 7 counted strict
+    serving gate: with ``FLAGS_serving_strict_compiles`` any later
+    request-path XLA compile raises ``SteadyStateRecompileError`` with
+    the sentinel's attribution. Admission/retirement churn cannot trip
+    it — no compiled shape depends on which slots are live."""
+
+    def __init__(self, cfg, place=None, scope=None, slots=None,
+                 max_len=None, prefill_buckets=None, queue_depth=None,
+                 param_program=None):
+        self._cfg = cfg
+        self._place = place
+        self._scope = scope
+        self._slots_arg = slots
+        self._max_len_arg = max_len
+        self._buckets_arg = prefill_buckets
+        self.queue_depth = int(_flag("decode_queue_depth", queue_depth))
+        self._param_program = param_program
+        self.session = None
+        self.started = False
+        self.tick = 0
+        self._pending = deque()
+        self._active = {}
+        self._free = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread = None
+        # engine-local tallies: stats() must report THIS engine, not the
+        # process-global counters shared with sibling sessions/engines
+        self._counts = {"requests": 0, "admissions": 0,
+                        "retirements": 0, "tokens": 0}
+        self._armed = False
+        self._occ_gauge = None
+        self._queue_gauge = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self.started:
+            raise RuntimeError("decode engine already started")
+        if self._thread is not None and self._thread.is_alive():
+            # a previous stop()'s thread-join timed out (loop wedged in a
+            # device call): refuse to spawn a second driver for the
+            # (thread-unsafe) session — _stop stays latched, so the old
+            # thread exits at its next loop-top check and a later start
+            # succeeds
+            raise RuntimeError(
+                "previous decode-engine loop thread has not exited yet"
+            )
+        self.session = DecodeSession(
+            self._cfg, place=self._place, scope=self._scope,
+            slots=self._slots_arg, max_len=self._max_len_arg,
+            prefill_buckets=self._buckets_arg,
+        )
+        if self._param_program is not None:
+            self.session.bind_params(self._param_program)
+        self._warmup()
+        self._free = list(range(self.session.slots))
+        self._stop = False
+        try:
+            # telemetry mirrors InferenceServer: exporter lights up from
+            # flags, occupancy/queue depth publish as scrape-time gauges,
+            # and the steady-compile gate arms COUNTED (ownership-scoped)
+            _obs_exporter.maybe_start_from_flags()
+            self._occ_gauge = lambda e=self: len(e._active)
+            _obs_registry.register_gauge(
+                "serving_slot_occupancy", self._occ_gauge
+            )
+            self._queue_gauge = lambda e=self: len(e._pending)
+            _obs_registry.register_gauge(
+                "decode_queue_depth", self._queue_gauge
+            )
+            _xla_stats.arm_serving_steady()
+            self._armed = True
+            self._thread = threading.Thread(
+                target=self._loop, name="decode-engine", daemon=True
+            )
+            self._thread.start()
+            # LAST: a half-started engine must never look started — a
+            # failure above (thread exhaustion, gauge clash) would
+            # otherwise leave submits feeding a queue nothing drains
+            self.started = True
+        except Exception:
+            if self._armed:
+                _xla_stats.disarm_serving_steady()
+                self._armed = False
+            if self._occ_gauge is not None:
+                _obs_registry.unregister_gauge(
+                    "serving_slot_occupancy", self._occ_gauge
+                )
+                self._occ_gauge = None
+            if self._queue_gauge is not None:
+                _obs_registry.unregister_gauge(
+                    "decode_queue_depth", self._queue_gauge
+                )
+                self._queue_gauge = None
+            raise
+        return self
+
+    def _warmup(self):
+        """Compile every shape the steady state can touch: each prefill
+        bucket once, the decode step once (its compiled shape is
+        independent of WHICH slots are active, so one all-inactive step
+        covers every future mix). Cache state is reset afterwards."""
+        sess = self.session
+        with _xla_stats.warmup_window(), _trace.span(
+            "decode_warmup", cat="serving"
+        ):
+            for T in sess.buckets:
+                P = min(T, sess.max_len - 1)
+                sess.prefill(0, [0] * P)
+            sess.decode_step(
+                [0] * sess.slots, [0] * sess.slots, [False] * sess.slots
+            )
+            sess.reset_caches()
+
+    def stop(self):
+        if not self.started:
+            return
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            # a still-wedged loop thread keeps its handle: start()
+            # refuses to run a second driver beside it (see start())
+            if not self._thread.is_alive():
+                self._thread = None
+        if self._armed:
+            _xla_stats.disarm_serving_steady()
+            self._armed = False
+        if self._occ_gauge is not None:
+            _obs_registry.unregister_gauge(
+                "serving_slot_occupancy", self._occ_gauge
+            )
+            self._occ_gauge = None
+        if self._queue_gauge is not None:
+            _obs_registry.unregister_gauge(
+                "decode_queue_depth", self._queue_gauge
+            )
+            self._queue_gauge = None
+        # drain under the SAME lock submit() enqueues under, and flip
+        # started inside it: a submit racing this stop either lands
+        # before the drain (failed here) or observes stopped and raises —
+        # it can never strand an unserved stream in a dead queue
+        with self._cond:
+            failed = list(self._active.values())
+            self._active.clear()
+            pending = list(self._pending)
+            self._pending.clear()
+            self.started = False
+        err = ServingError("decode engine stopped")
+        for slot in failed:
+            slot.stream._fail(err)
+        for stream in pending:
+            stream._fail(err)
+
+    def __enter__(self):
+        return self if self.started else self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request path --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=None, eos_id=None):
+        """Non-blocking admission; returns a ``GenerationStream``.
+        Bounded queue: beyond ``queue_depth`` waiting requests, sheds
+        with ``ServerOverloadedError`` (same backpressure contract as
+        the micro-batcher)."""
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if not self.started or self.session is None:
+            raise ServingError("decode engine not started")
+        if len(prompt) >= self.session.max_len:
+            raise ValueError(
+                "prompt of %d tokens leaves no room to generate "
+                "(max_len %d)" % (len(prompt), self.session.max_len)
+            )
+        self.session.bucket_for(len(prompt))  # validates against the ladder
+        if max_new_tokens is not None and max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        stream = GenerationStream(prompt, max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id)
+        with self._cond:
+            # re-checked under the lock stop() drains under: after the
+            # drain, started is already False here and the stream can
+            # never be stranded in a dead queue
+            if not self.started or self._stop:
+                raise ServingError("decode engine stopped")
+            if len(self._pending) >= self.queue_depth:
+                raise ServerOverloadedError(
+                    "decode admission queue full (%d pending)"
+                    % len(self._pending),
+                    retry_after_ms=50,
+                )
+            self._pending.append(stream)
+            # inside the lock: _counts is read-modify-write from
+            # arbitrary caller threads here (everything else touching it
+            # is the loop thread)
+            self._counts["requests"] += 1
+            self._cond.notify_all()
+        _profiler.bump_counter("decode_requests")
+        return stream
+
+    def generate(self, prompt_ids, max_new_tokens=None, eos_id=None):
+        """Submit and return the streaming handle (iterate for tokens as
+        they land; ``.tokens()`` / ``.result()`` to block)."""
+        return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id)
+
+    def stats(self):
+        """THIS engine's counters + live occupancy snapshot (the
+        process-global profiler counters additionally aggregate every
+        other decode session in the process — e.g. greedy_generate's
+        cached 1-slot sessions)."""
+        return {
+            "slots": self.session.slots if self.session else 0,
+            "active": len(self._active),
+            "queued": len(self._pending),
+            "ticks": self.tick,
+            "requests": self._counts["requests"],
+            "prefills": self.session.prefills if self.session else 0,
+            "steps": self.session.steps if self.session else 0,
+            "tokens": self._counts["tokens"],
+            "admissions": self._counts["admissions"],
+            "retirements": self._counts["retirements"],
+        }
+
+    # -- engine loop ---------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (not self._stop and not self._pending
+                       and not self._active):
+                    self._cond.wait()
+                if self._stop:
+                    return
+            try:
+                self._admit()
+                if self._active:
+                    self._step()
+            except Exception as e:  # noqa: BLE001 - fail the live streams
+                # a failed device step (incl. SteadyStateRecompileError
+                # from the strict gate) fails the requests it was serving;
+                # the engine itself stays up for the next submission. The
+                # freed slots COUNT as retirements so the documented
+                # admissions == retirements + occupancy invariant holds
+                # across recovered failures
+                for slot in list(self._active.values()):
+                    slot.stream._fail(e)
+                    _profiler.bump_counter("serving_slot_retirements")
+                    self._counts["retirements"] += 1
+                self._free.extend(self._active.keys())
+                self._active.clear()
+
+    def _admit(self):
+        """Prefill queued requests into free slots — mid-flight, between
+        decode steps, never evicting an active stream."""
+        while self._free:
+            with self._cond:
+                if not self._pending:
+                    return
+                stream = self._pending.popleft()
+            slot_idx = self._free.pop()
+            try:
+                with _xla_stats.serving_request_window():
+                    logits = self.session.prefill(
+                        slot_idx, stream.prompt_ids
+                    )
+            except Exception as e:  # noqa: BLE001 - per-request failure
+                self._free.append(slot_idx)
+                stream._fail(e)
+                continue
+            tok = int(np.asarray(logits).ravel().argmax())
+            slot = _Slot(stream, tok, next_pos=len(stream.prompt_ids))
+            with self._cond:
+                # stop() drains under this lock and flips started inside
+                # it: if the drain happened while the prefill above was
+                # in flight (stop's thread-join timed out), inserting
+                # now would strand the stream in a dead engine — fail it
+                # here instead
+                if self._stop or not self.started:
+                    self._free.append(slot_idx)
+                    stream._fail(ServingError("decode engine stopped"))
+                    continue
+                self._active[slot_idx] = slot
+            _profiler.bump_counter("serving_slot_admissions")
+            self._counts["admissions"] += 1
+            stream.first_tick = self.tick
+            self._emit(slot_idx, slot, tok)
+
+    def _emit(self, slot_idx, slot, tok):
+        """Stream one generated token and retire the slot if finished."""
+        stream = slot.stream
+        stream._push(tok)
+        stream.last_tick = self.tick
+        _profiler.bump_counter("decode_tokens")
+        self._counts["tokens"] += 1
+        reason = None
+        if stream.eos_id is not None and tok == stream.eos_id:
+            reason = "eos"
+        elif (stream.max_new_tokens is not None
+              and slot.generated >= stream.max_new_tokens):
+            reason = "length"
+        elif len(stream.prompt_ids) + slot.generated >= self.session.max_len:
+            reason = "length"
+        if reason is not None:
+            # pop, not del: a stop() whose thread-join timed out may have
+            # drained _active concurrently
+            self._active.pop(slot_idx, None)
+            self._free.append(slot_idx)
+            _profiler.bump_counter("serving_slot_retirements")
+            self._counts["retirements"] += 1
+            stream._finish(reason)
+
+    def _step(self):
+        """One fused decode step over every active slot."""
+        sess = self.session
+        tokens = [0] * sess.slots
+        positions = [0] * sess.slots
+        active = [False] * sess.slots
+        for idx, slot in self._active.items():
+            tokens[idx] = slot.pending_token
+            positions[idx] = slot.next_pos
+            active[idx] = True
+        with _xla_stats.serving_request_window():
+            logits = sess.decode_step(tokens, positions, active)
+        self.tick += 1
+        for idx in list(self._active.keys()):
+            slot = self._active[idx]
+            tok = int(logits[idx].argmax())
+            slot.next_pos += 1
+            slot.generated += 1
+            slot.pending_token = tok
+            self._emit(idx, slot, tok)

@@ -56,7 +56,7 @@ class InferenceServer(object):
 
     def __init__(self, predictor, max_batch_size=None, batch_timeout_ms=None,
                  queue_depth=None, num_workers=None, default_deadline_ms=None,
-                 ladder=None):
+                 ladder=None, decode_engine=None):
         self.max_batch_size = int(_flag("serving_max_batch_size",
                                         max_batch_size))
         self.batch_timeout_ms = float(_flag("serving_batch_timeout_ms",
@@ -82,6 +82,11 @@ class InferenceServer(object):
         self._pool_gauge = None
         self._steady_armed = False
         self._started = False
+        # autoregressive generation rides a DecodeEngine (serving/decode.py
+        # KV-cache slot pool + continuous batching); classification-style
+        # whole-forward traffic keeps the micro-batcher path
+        self._decode_engine = decode_engine
+        self._engine_started_here = False
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_inputs=None):
@@ -126,6 +131,18 @@ class InferenceServer(object):
         # disarm the gate under a live successor in the same process.
         _xla_stats.arm_serving_steady()
         self._steady_armed = True
+        if self._decode_engine is not None and not self._decode_engine.started:
+            # engine warmup also runs pre-arm windows of its own; a server
+            # that starts its engine also stops it. A FAILED engine start
+            # must unwind the whole server (batcher threads, gauges, the
+            # counted strict gate armed just above) — the caller of
+            # `InferenceServer(...).start()` has no handle to stop with
+            try:
+                self._decode_engine.start()
+                self._engine_started_here = True
+            except Exception:
+                self.stop()
+                raise
         return self
 
     def warmup(self, example_inputs):
@@ -201,6 +218,9 @@ class InferenceServer(object):
             self._pool_gauge = None
         if self._batcher is not None:
             self._batcher.stop()
+        if self._decode_engine is not None and self._engine_started_here:
+            self._decode_engine.stop()
+            self._engine_started_here = False
         self._started = False
 
     def __enter__(self):
@@ -236,6 +256,21 @@ class InferenceServer(object):
             # seq_plan carries padded_rows == rows)
             outs = self.ladder.unpad_outputs(outs, req.seq_plan)
         return outs
+
+    def generate(self, prompt_ids, max_new_tokens=None, eos_id=None):
+        """Autoregressive completion through the attached DecodeEngine:
+        returns a ``GenerationStream`` — iterate it for tokens as they
+        are generated, or block on ``.tokens()`` / ``.result()``. The
+        request joins the engine's continuous decode batch (admitted via
+        prefill into a KV-cache slot mid-flight; never recompiles)."""
+        if self._decode_engine is None:
+            raise ServingError(
+                "no decode engine attached: construct the server with "
+                "decode_engine=DecodeEngine(cfg, ...) to serve generation"
+            )
+        return self._decode_engine.generate(
+            prompt_ids, max_new_tokens=max_new_tokens, eos_id=eos_id
+        )
 
     def _seq_align(self, inputs):
         """(aligned_inputs, request_plan|None). With seq buckets enabled

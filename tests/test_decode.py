@@ -1,0 +1,692 @@
+"""Autoregressive decode runtime (ISSUE 8): KV-cache prefill/decode
+parity vs the full-forward oracle, continuous-batching scheduler
+behavior, the kv_cache_write / flash_decode_attention ops, streaming API,
+and the closed-loop probe acceptance."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import profiler
+from paddle_tpu.models import gpt
+from paddle_tpu.observability import registry as obs_registry
+from paddle_tpu.serving import decode as sdecode
+from paddle_tpu.serving.batcher import ServerOverloadedError, ServingError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+MAX_LEN = 20
+SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """One shared model + oracle + engine for the module: params in one
+    scope, the [1, MAX_LEN] full-forward program as the parity oracle,
+    and a started 4-slot engine attached to the same scope."""
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = MAX_LEN
+    with fluid.unique_name.guard():
+        infer, startup, _names, logits = gpt.build_gpt_infer(cfg, MAX_LEN)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+    engine = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=SLOTS, max_len=MAX_LEN,
+        prefill_buckets=[8, MAX_LEN], param_program=infer,
+    ).start()
+
+    def oracle(prompt):
+        return gpt._reference_generate(
+            exe, infer, logits, cfg, prompt, MAX_LEN, scope=scope
+        )
+
+    yield {"cfg": cfg, "infer": infer, "exe": exe, "scope": scope,
+           "engine": engine, "oracle": oracle, "logits": logits}
+    engine.stop()
+
+
+def test_greedy_generate_matches_reference(rig):
+    """The rebased greedy_generate (KV-cache session) must be token-exact
+    vs the kept full-forward oracle across prompt lengths, including a
+    1-token prompt and a prompt one shy of max_len."""
+    rs = np.random.RandomState(0)
+    for n in (1, 3, 9, MAX_LEN - 1):
+        p = list(rs.randint(0, rig["cfg"].vocab_size, n))
+        got = gpt.greedy_generate(
+            rig["exe"], rig["infer"], rig["logits"], rig["cfg"], p,
+            MAX_LEN, scope=rig["scope"],
+        )
+        assert got == rig["oracle"](p), "prompt len %d" % n
+        assert got[:n] == p
+
+
+def test_engine_parity_across_churned_slots(rig):
+    """More requests than slots, all in flight: every stream's full
+    completion is token-exact vs the oracle — admission and slot reuse
+    after retirement never leak another stream's cache."""
+    rs = np.random.RandomState(1)
+    prompts = [list(rs.randint(0, rig["cfg"].vocab_size, n))
+               for n in (2, 5, 9, 3, 7, 4, 1, 6)]  # 8 requests, 4 slots
+    streams = [rig["engine"].generate(p) for p in prompts]
+    for p, s in zip(prompts, streams):
+        assert s.result(timeout=120) == rig["oracle"](p)
+        assert s.finish_reason == "length"
+
+
+def test_engine_eos_midstream(rig):
+    """An eos_id the greedy stream emits mid-way stops the request right
+    after that token (included), token-exact up to the stop."""
+    rs = np.random.RandomState(2)
+    p = list(rs.randint(0, rig["cfg"].vocab_size, 4))
+    gen = rig["oracle"](p)[len(p):]
+    eos = gen[2]
+    s = rig["engine"].generate(p, eos_id=eos)
+    assert s.tokens(timeout=120) == gen[: gen.index(eos) + 1]
+    assert s.finish_reason == "eos"
+
+
+def test_engine_max_new_truncation(rig):
+    rs = np.random.RandomState(3)
+    p = list(rs.randint(0, rig["cfg"].vocab_size, 3))
+    gen = rig["oracle"](p)[len(p):]
+    s = rig["engine"].generate(p, max_new_tokens=4)
+    assert s.tokens(timeout=120) == gen[:4]
+    assert s.finish_reason == "length"
+
+
+def test_late_arrival_joins_inflight_batch(rig):
+    """Scheduler contract: a request submitted while a decode batch is in
+    flight is admitted into it mid-stream — active streams keep their
+    slots (no eviction) and the late stream decodes concurrently with
+    them, not after them."""
+    engine = rig["engine"]
+    rs = np.random.RandomState(4)
+    p1 = list(rs.randint(0, rig["cfg"].vocab_size, 2))
+    p2 = list(rs.randint(0, rig["cfg"].vocab_size, 3))
+    p3 = list(rs.randint(0, rig["cfg"].vocab_size, 5))
+    s1 = engine.generate(p1)  # runs to max_len: 18 tokens
+    s2 = engine.generate(p2)
+    # wait until the first streams are demonstrably mid-decode
+    deadline = time.monotonic() + 60
+    while len(s1._tokens) < 3 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(s1._tokens) >= 3 and not s1.done
+    s3 = engine.generate(p3)
+    out3 = s3.result(timeout=120)
+    out1 = s1.result(timeout=120)
+    out2 = s2.result(timeout=120)
+    # parity first: joining mid-flight never corrupts anyone's stream
+    assert out1 == rig["oracle"](p1)
+    assert out2 == rig["oracle"](p2)
+    assert out3 == rig["oracle"](p3)
+    # overlap: the late stream started before the early ones finished
+    # (ticks are engine decode-step indices)
+    assert s3.first_tick is not None
+    assert s1.last_tick > s3.first_tick
+    assert s2.last_tick > s3.first_tick
+
+
+def test_zero_steady_recompiles_and_gauges(rig):
+    """Churning admissions/retirements through the warmed engine causes
+    ZERO steady-state compiles (the bucketed-slot design's invariant),
+    and the occupancy/queue gauges are live while the engine runs."""
+    c0 = profiler.get_counters()
+    rs = np.random.RandomState(5)
+    streams = [
+        rig["engine"].generate(
+            list(rs.randint(0, rig["cfg"].vocab_size, 1 + i % 7)),
+            max_new_tokens=2 + i % 5,
+        )
+        for i in range(3 * SLOTS)
+    ]
+    for s in streams:
+        s.tokens(timeout=120)
+    c1 = profiler.get_counters()
+    assert c1.get("serving_steady_recompiles", 0) == c0.get(
+        "serving_steady_recompiles", 0
+    )
+    assert c1.get("xla_compiles", 0) == c0.get("xla_compiles", 0)
+    gauges = obs_registry.gauge_values()
+    assert "serving_slot_occupancy" in gauges
+    assert "decode_queue_depth" in gauges
+    assert c1.get("serving_slot_retirements", 0) >= c0.get(
+        "serving_slot_retirements", 0
+    ) + 3 * SLOTS
+
+
+def test_generation_stream_iterates_live(rig):
+    """The iterator API yields tokens as they are generated (streaming),
+    not after completion."""
+    rs = np.random.RandomState(6)
+    p = list(rs.randint(0, rig["cfg"].vocab_size, 2))
+    s = rig["engine"].generate(p)
+    seen = []
+    for tok in s:
+        seen.append(tok)
+        if len(seen) == 2:
+            # mid-iteration the request is still in flight
+            assert not s.done or len(s._tokens) > 2
+    assert seen == rig["oracle"](p)[len(p):]
+    assert s.finish_reason == "length"
+
+
+def test_submit_validation_and_overload(rig):
+    engine = rig["engine"]
+    with pytest.raises(ValueError):
+        engine.submit([])
+    with pytest.raises(ValueError):
+        engine.submit(list(range(MAX_LEN)))  # no room to generate
+    with pytest.raises(ValueError):
+        engine.submit([1], max_new_tokens=0)
+    # bounded admission: shrink the queue bound and flood
+    old = engine.queue_depth
+    engine.queue_depth = 2
+    try:
+        streams = []
+        with pytest.raises(ServerOverloadedError):
+            for _ in range(64):
+                streams.append(engine.submit([1], max_new_tokens=1))
+    finally:
+        engine.queue_depth = old
+        for s in streams:
+            try:
+                s.tokens(timeout=120)
+            except ServingError:
+                pass
+
+
+def test_flash_decode_engine_matches_dense():
+    """A flash-attention engine (interpret kernels: causal prefill kernel
+    + single-query decode kernel) reproduces the dense engine's tokens
+    exactly."""
+    outs = {}
+    for flash in (False, True):
+        cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                                 use_flash_attention=flash)
+        cfg.max_position_embeddings = 16
+        cfg.flash_interpret = True
+        with fluid.unique_name.guard():
+            infer, startup, _n, _logits = gpt.build_gpt_infer(cfg, 16)
+        infer.random_seed = startup.random_seed = 11
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        with fluid.executor.scope_guard(scope):
+            exe.run(startup)
+        engine = sdecode.DecodeEngine(
+            cfg, scope=scope, slots=2, max_len=16,
+            prefill_buckets=[16], param_program=infer,
+        ).start()
+        try:
+            outs[flash] = [
+                engine.generate([3, 7]).result(timeout=120),
+                engine.generate([5], max_new_tokens=6).tokens(timeout=120),
+            ]
+        finally:
+            engine.stop()
+    assert outs[True] == outs[False]
+
+
+def test_kv_cache_write_op_decode_and_prefill_modes():
+    """Unit test of the scatter op both ways: per-slot position writes
+    (decode) and whole-row-head writes at a slot index (prefill)."""
+    S, H, M, D = 3, 2, 8, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cache = main.global_block().create_var(
+            name="c", shape=[S, H, M, D], dtype="float32", persistable=True
+        )
+        new = fluid.layers.data(name="new", shape=[H, 1, D],
+                                dtype="float32")
+        pos = fluid.layers.data(name="pos", shape=[1, 1], dtype="int64")
+        out = fluid.layers.kv_cache_write(cache, new, pos)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    base = np.arange(S * H * M * D).reshape(S, H, M, D).astype("float32")
+    scope.set("c", base.copy())
+    newv = -np.ones((S, H, 1, D), "float32")
+    posv = np.array([1, 0, 5], "int64").reshape(S, 1, 1)
+    (got,) = exe.run(main, feed={"new": newv, "pos": posv},
+                     fetch_list=[out], scope=scope)
+    want = base.copy()
+    for s, p in enumerate([1, 0, 5]):
+        want[s, :, p, :] = -1.0
+    np.testing.assert_array_equal(got, want)
+    # the updated value persisted to the scope var
+    np.testing.assert_array_equal(np.asarray(scope.get("c")), want)
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        cache2 = main2.global_block().create_var(
+            name="c2", shape=[S, H, M, D], dtype="float32", persistable=True
+        )
+        new2 = fluid.layers.data(name="new2", shape=[H, 3, D],
+                                 dtype="float32")
+        slot = fluid.layers.data(name="slot", shape=[1], dtype="int64")
+        out2 = fluid.layers.kv_cache_write(cache2, new2, slot,
+                                           slot_mode=True)
+    scope.set("c2", base.copy())
+    new2v = 7 * np.ones((1, H, 3, D), "float32")
+    (got2,) = exe.run(main2, feed={"new2": new2v,
+                                   "slot": np.array([[2]], "int64")},
+                      fetch_list=[out2], scope=scope)
+    want2 = base.copy()
+    want2[2, :, :3, :] = 7.0  # row head replaced, stale tail kept
+    np.testing.assert_array_equal(got2, want2)
+
+
+def test_flash_decode_kernel_matches_reference():
+    """Kernel-level: the decode-mode single-query Pallas kernel (interpret)
+    and its dense fallback match reference_attention under per-slot
+    length masks."""
+    from paddle_tpu.kernels.flash_attention import (
+        flash_decode_attention, reference_attention)
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    B, N, S, D = 3, 4, 24, 16
+    q = jnp.asarray(rs.randn(B, N, 1, D).astype("float32"))
+    k = jnp.asarray(rs.randn(B, N, S, D).astype("float32"))
+    v = jnp.asarray(rs.randn(B, N, S, D).astype("float32"))
+    kb = np.zeros((B, S), "float32")
+    for b, ln in enumerate([5, 17, 24]):
+        kb[b, ln:] = -1e4
+    kb = jnp.asarray(kb)
+    ref = reference_attention(q, k, v, bias=kb.reshape(B, 1, 1, S))
+    dense = flash_decode_attention(q, k, v, key_bias=kb)
+    kern = flash_decode_attention(q, k, v, key_bias=kb, interpret=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        flash_decode_attention(k, k, v, key_bias=kb)  # Sq != 1
+
+
+def test_prefill_ladder_shapes():
+    import warnings
+
+    assert sdecode.prefill_ladder(48) == [8, 16, 32, 48]
+    assert sdecode.prefill_ladder(8) == [8]
+    assert sdecode.prefill_ladder(6) == [6]
+    assert sdecode.prefill_ladder(64, "16,64") == [16, 64]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert sdecode.prefill_ladder(64, [100, 16]) == [16, 64]
+        assert sdecode.prefill_ladder(64, [128]) == [64]
+    dropped = [x for x in w if "exceed max_len" in str(x.message)]
+    assert len(dropped) == 2
+    assert "full-length program" in str(dropped[1].message)
+    with pytest.raises(ValueError):
+        sdecode.prefill_ladder(64, [0, 16])
+
+
+def test_server_generate_wiring():
+    """InferenceServer.generate() fronts an attached engine; a server
+    without one raises; the server's stop() stops an engine it started."""
+
+    class _FakePredictor(object):
+        def run(self, arrays):
+            return [np.asarray(arrays[0])]
+
+        def clone(self):
+            return self
+
+    from paddle_tpu.serving import InferenceServer
+
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = 12
+    with fluid.unique_name.guard():
+        infer, startup, _n, _l = gpt.build_gpt_infer(cfg, 12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+    engine = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=2, max_len=12, prefill_buckets=[12],
+        param_program=infer,
+    )
+    server = InferenceServer(
+        _FakePredictor(), max_batch_size=2, num_workers=1,
+        decode_engine=engine,
+    ).start(warmup_inputs=[np.ones((1, 4), "float32")])
+    try:
+        assert engine.started
+        s = server.generate([3, 5], max_new_tokens=3)
+        toks = s.tokens(timeout=120)
+        assert len(toks) == 3
+        assert all(0 <= t < cfg.vocab_size for t in toks)
+    finally:
+        server.stop()
+    assert not engine.started  # server-started engine stops with it
+
+    bare = InferenceServer(_FakePredictor(), max_batch_size=2,
+                           num_workers=1)
+    bare.start(warmup_inputs=[np.ones((1, 4), "float32")])
+    try:
+        with pytest.raises(ServingError):
+            bare.generate([1])
+    finally:
+        bare.stop()
+
+
+def test_rng_run_index_skipped_for_random_free_programs():
+    """The executor's per-run fold_in skip: a program with no random ops
+    neither pays the PRNG derivation nor bumps the scope run index; a
+    program WITH random ops keeps the exact legacy behavior."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(x, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    feed = {"x": np.ones((2, 8), "float32")}
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+    counters = main.__dict__.get("_rng_run_counters")
+    assert counters is None or counters.get(scope, 0) == 0
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h2 = fluid.layers.dropout(x2, dropout_prob=0.5)
+    scope2 = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope2):
+        exe.run(startup2, scope=scope2)
+        for _ in range(3):
+            exe.run(main2, feed=feed, fetch_list=[h2], scope=scope2)
+    assert main2.__dict__["_rng_run_counters"].get(scope2) == 3
+
+
+def test_needs_rng_sees_random_ops_inside_sub_blocks():
+    """Review regression: a random op living only inside a control-flow
+    sub-block (conditional_block / while body) must still mark the
+    compiled block needs_rng — the segment's top level only shows the
+    control-flow op type, and a fixed key would freeze the body's
+    randomness across steps."""
+    from paddle_tpu.fluid import executor as ex_mod
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        one = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=1.0)
+        zero = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                          value=0.0)
+        pred = fluid.layers.greater_than(one, zero)
+        out = fluid.layers.cond(
+            pred,
+            lambda: fluid.layers.dropout(x, dropout_prob=0.5),
+            lambda: x,
+        )
+    compiled = ex_mod._CompiledBlock(
+        main, 0, ["x"], [out.name], fluid.CPUPlace()
+    )
+    assert compiled.needs_rng
+    # and the real run path bumps the per-scope run index accordingly
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(2):
+            exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                    fetch_list=[out], scope=scope)
+    assert main.__dict__["_rng_run_counters"].get(scope) == 2
+
+
+def test_needs_rng_flash_attention_attr_aware():
+    """flash_attention consumes a key only with LIVE dropout: an is_test
+    flash program (the decode step on TPU) keeps the rng skip, a flash
+    TRAINING program with attention dropout does not."""
+    from paddle_tpu.fluid import executor as ex_mod
+
+    def build(is_test, rate):
+        cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0,
+                                 attention_dropout=rate,
+                                 use_flash_attention=True,
+                                 is_test=is_test)
+        cfg.flash_interpret = True
+        with fluid.unique_name.guard():
+            if is_test:
+                main, _s, _n, out = gpt.build_gpt_infer(cfg, 12)
+                return main, ["ids", "pos_ids", "input_mask"], out.name
+            main, _s, _f, loss = gpt.build_gpt_lm_train(cfg, 12)
+            return main, ["ids", "pos_ids", "input_mask"], loss.name
+
+    main, feeds, fetch = build(is_test=True, rate=0.5)
+    assert not ex_mod._CompiledBlock(
+        main, 0, feeds, [fetch], fluid.CPUPlace()
+    ).needs_rng
+    main, feeds, fetch = build(is_test=False, rate=0.5)
+    assert ex_mod._CompiledBlock(
+        main, 0, feeds, [fetch], fluid.CPUPlace()
+    ).needs_rng
+
+
+def test_greedy_session_cache_dies_with_scope():
+    """Review regression: the per-scope greedy session cache lives ON the
+    scope (a module registry — even weak-keyed — would pin the scope via
+    the session's strong back-reference). Dropping the scope must free
+    the whole session graph."""
+    import gc
+    import weakref
+
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = 10
+    with fluid.unique_name.guard():
+        infer, startup, _n, logits = gpt.build_gpt_infer(cfg, 10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        out = gpt.greedy_generate(exe, infer, logits, cfg, [1, 2], 10,
+                                  scope=scope)
+    assert len(out) == 10
+    assert getattr(scope, "_decode_gen_sessions", None)
+    ref = weakref.ref(scope)
+    del scope
+    gc.collect()
+    assert ref() is None, "scope (and its cached decode session) leaked"
+
+
+def test_server_unwinds_when_engine_start_fails():
+    """Review regression: a failing DecodeEngine.start() inside
+    InferenceServer.start() must stop the half-started server — batcher
+    down, counted strict gate disarmed — since the caller never gets a
+    handle to stop."""
+    from paddle_tpu.observability import xla_stats as _xla_stats
+    from paddle_tpu.serving import InferenceServer
+
+    class _FakePredictor(object):
+        def run(self, arrays):
+            return [np.asarray(arrays[0])]
+
+        def clone(self):
+            return self
+
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = 12
+    # max_len beyond the model's positions: DecodeSession raises at start
+    engine = sdecode.DecodeEngine(cfg, scope=fluid.core.Scope(), slots=1,
+                                  max_len=64)
+    server = InferenceServer(_FakePredictor(), max_batch_size=2,
+                             num_workers=1, decode_engine=engine)
+    armed_before = _xla_stats._steady_count
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        server.start(warmup_inputs=[np.ones((1, 4), "float32")])
+    assert _xla_stats._steady_count == armed_before, "gate left armed"
+    assert not server._started
+    assert not engine.started
+
+
+def test_greedy_generate_concurrent_callers_stay_exact(rig):
+    """Review regression: greedy_generate funnels every caller thread
+    into ONE cached session per (scope, geometry); calls must serialize
+    on the session lock — interleaved prefill/decode steps would read
+    each other's slot-0 cache and return silently wrong tokens."""
+    rs = np.random.RandomState(9)
+    prompts = [list(rs.randint(0, rig["cfg"].vocab_size, n))
+               for n in (2, 4, 6, 3)]
+    want = {tuple(p): rig["oracle"](p) for p in prompts}
+    results, errors = {}, []
+
+    def worker(p):
+        try:
+            results[tuple(p)] = gpt.greedy_generate(
+                rig["exe"], rig["infer"], rig["logits"], rig["cfg"], p,
+                MAX_LEN, scope=rig["scope"],
+            )
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for p in prompts:
+        assert results[tuple(p)] == want[tuple(p)], p
+
+
+def test_engine_step_failure_retires_slots_and_recovers(rig):
+    """Review regression: a failing decode step fails the streams it was
+    serving, COUNTS their slots as retirements (admissions ==
+    retirements + occupancy must survive recovered failures), and leaves
+    the engine serving subsequent requests."""
+    engine = rig["engine"]
+    session = engine.session
+    real_step = session.decode_step
+    boom = {"armed": True}
+
+    def failing_step(*a, **kw):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected step failure")
+        return real_step(*a, **kw)
+
+    c0 = profiler.get_counters()
+    session.decode_step = failing_step
+    try:
+        s = engine.generate([1, 2], max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="injected step failure"):
+            s.tokens(timeout=120)
+    finally:
+        session.decode_step = real_step
+    c1 = profiler.get_counters()
+    assert c1.get("serving_slot_retirements", 0) >= c0.get(
+        "serving_slot_retirements", 0
+    ) + 1
+    # engine recovered: the freed slot serves the next request
+    rs = np.random.RandomState(8)
+    p = list(rs.randint(0, rig["cfg"].vocab_size, 3))
+    assert engine.generate(p).result(timeout=120) == rig["oracle"](p)
+    assert len(engine._free) + len(engine._active) == SLOTS
+
+
+def test_submit_after_stop_raises_not_hangs():
+    """Review regression: submit racing stop must never strand a stream —
+    after stop() every path raises ServingError instead of queueing."""
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = 12
+    with fluid.unique_name.guard():
+        infer, startup, _n, _l = gpt.build_gpt_infer(cfg, 12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup, scope=scope)
+    engine = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=1, max_len=12, prefill_buckets=[12],
+        param_program=infer,
+    ).start()
+    engine.stop()
+    with pytest.raises(ServingError):
+        engine.submit([1, 2])
+
+
+def test_flash_attention_dropout_mask_varies_per_step():
+    """Regression for the rng-skip analysis: flash_attention consumes a
+    PRNG key for in-kernel dropout, so a training program whose ONLY
+    random op is the flash kernel must still draw a fresh key per step —
+    a frozen mask would silently bias training."""
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.5,
+                             use_flash_attention=True)
+    cfg.flash_interpret = True
+    with fluid.unique_name.guard():
+        main, startup, _feeds, loss = gpt.build_gpt_lm_train(
+            cfg, 12, learning_rate=0.0)
+    types = [op.type for b in main.blocks for op in b.ops]
+    assert "dropout" not in types and "flash_attention" in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rs = np.random.RandomState(0)
+    feed = {
+        "ids": rs.randint(0, cfg.vocab_size, (2, 12, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(12)[None, :, None],
+                           (2, 1, 1)).astype("int64"),
+        "input_mask": np.ones((2, 12, 1), "float32"),
+    }
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = []
+        for _ in range(4):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    # lr=0 + identical feed: only the dropout mask can move the loss
+    assert len(set(losses)) > 1, losses
+
+
+def _run_probe_subprocess():
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "decode_probe.py"), "--fast"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=""),
+    )
+
+
+def _probe_report(stdout):
+    for ln in stdout.splitlines():
+        if ln.startswith("REPORT "):
+            return json.loads(ln[len("REPORT "):])
+    return None
+
+
+def test_decode_probe_fast_acceptance():
+    """ISSUE 8 closed loop: token-exact parity vs the full-forward
+    oracle, >= 10x tokens/sec over the per-token-recompute baseline at
+    8 streams, 0 steady-state recompiles under the armed strict gate
+    across an admission/retirement churn, REPORT schema."""
+    p = _run_probe_subprocess()
+    report = _probe_report(p.stdout)
+    if p.returncode != 0 and report is not None and report["failures"] \
+            and all(f.startswith("speedup") for f in report["failures"]):
+        # the 2-core driver box throttles under external load, which
+        # compresses BOTH loops' throughput but can catch the decode
+        # window alone; parity / recompile / metrics failures are not
+        # load-sensitive and fail immediately — only a throughput-only
+        # miss earns one retry
+        p = _run_probe_subprocess()
+        report = _probe_report(p.stdout)
+    assert p.returncode == 0, "probe failed:\n%s\n%s" % (
+        p.stdout[-3000:], p.stderr[-2000:]
+    )
+    assert "PROBE PASS" in p.stdout
+    assert report["schema_version"] == 1
+    assert all(report["parity"].values()), report["parity"]
+    assert report["strict"]["steady_recompiles"] == 0
+    assert report["strict"]["churn_errors"] == 0
+    assert report["throughput"]["speedup"] >= 10.0
+    assert report["throughput"]["streams"] == 8

@@ -1,0 +1,237 @@
+"""CPU-runnable closed-loop probe for the autoregressive decode runtime.
+
+Drives the KV-cache slot pool + continuous-batching engine
+(paddle_tpu/serving/decode.py) against `gpt._reference_generate` — the
+full-forward-per-token loop every GPT completion paid before this
+subsystem existed — and asserts the decode acceptance bars:
+
+- PARITY: engine output token-exact vs the oracle across prompt lengths,
+  an EOS stop mid-stream, max-new-token truncation, and slot reuse after
+  retirement (more requests than slots, churned through the pool);
+- THROUGHPUT: >= 10x generated tokens/sec over the per-token-recompute
+  baseline with 8 concurrent streams (the baseline serializes on the one
+  device whatever its client concurrency, so its serial rate IS its
+  8-stream rate);
+- ZERO RECOMPILES: with the PR 7 strict gate armed
+  (`FLAGS_serving_strict_compiles`), a churned admission/retirement
+  schedule (3x more requests than slots, staggered lengths) must finish
+  with `serving_steady_recompiles` unchanged and no stream failed — no
+  compiled shape depends on which slots are live;
+- METRICS: every decode_*/serving_slot_* counter/histogram/gauge renders
+  on the PR 5 exporter registry.
+
+Run directly (prints one REPORT json line + PROBE PASS/FAIL)::
+
+    JAX_PLATFORMS=cpu python tools/decode_probe.py --fast
+
+or via tests/test_decode.py, which runs --fast as a tier-1 gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA_VERSION = 1
+
+
+def run_probe(fast=True, verbose=False):
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import flags as _flags
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.models import gpt
+    from paddle_tpu.observability import registry as obs_registry
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    _flags.set_flags({"FLAGS_serving_strict_compiles": True})
+
+    slots = 8
+    max_len = 96 if fast else 160
+    # sized so device compute (not per-run host dispatch) dominates both
+    # loops — the regime the 10x bar is about; still compiles in seconds
+    # on the CPU backend
+    cfg = gpt.GPTConfig.tiny(
+        hidden_dropout=0.0, attention_dropout=0.0,
+        hidden_size=256, num_layers=2, intermediate_size=768,
+    )
+    cfg.max_position_embeddings = max_len
+
+    with fluid.unique_name.guard():
+        infer, startup, _names, logits = gpt.build_gpt_infer(cfg, max_len)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+
+    def oracle(prompt):
+        return gpt._reference_generate(
+            exe, infer, logits, cfg, prompt, max_len, scope=scope
+        )
+
+    report = {"schema_version": REPORT_SCHEMA_VERSION, "fast": bool(fast),
+              "slots": slots, "max_len": max_len}
+    failures = []
+
+    # ---- oracle outputs for parity (compiles the [1, max_len] program) ----
+    rs = np.random.RandomState(7)
+    prompts = [list(rs.randint(0, cfg.vocab_size, n))
+               for n in (1, 7, 12)]
+    oracle_out = {tuple(p): oracle(p) for p in prompts}
+
+    # ---- engine up (warmup compiles prefill ladder + decode step) ----
+    engine = DecodeEngine(
+        cfg, scope=scope, slots=slots, max_len=max_len,
+        prefill_buckets=[16, max_len], param_program=infer,
+    ).start()
+    try:
+        c_warm = profiler.get_counters()
+
+        # ---- parity: prompt lengths ----
+        parity = {}
+        for p in prompts:
+            got = engine.generate(p).result(timeout=120)
+            parity["len_%d" % len(p)] = got == oracle_out[tuple(p)]
+        # EOS mid-stream: stop at (and including) a token the greedy
+        # stream is known to emit a few steps in
+        p = prompts[1]
+        gen = oracle_out[tuple(p)][len(p):]
+        eos = gen[3]
+        stream = engine.generate(p, eos_id=eos)
+        got = stream.tokens(timeout=120)
+        parity["eos_midstream"] = (
+            got == gen[: gen.index(eos) + 1]
+            and stream.finish_reason == "eos"
+        )
+        # max-length truncation
+        stream = engine.generate(p, max_new_tokens=5)
+        parity["max_new_truncation"] = (
+            stream.tokens(timeout=120) == gen[:5]
+            and stream.finish_reason == "length"
+        )
+        # slot reuse after retirement: 2x slots sequential short requests
+        # through the same pool, every one token-exact
+        reuse_ok = True
+        for i in range(2 * slots):
+            p = prompts[i % len(prompts)]
+            got = engine.generate(p, max_new_tokens=4).tokens(timeout=120)
+            reuse_ok = reuse_ok and (
+                got == oracle_out[tuple(p)][len(p):len(p) + 4]
+            )
+        parity["slot_reuse"] = reuse_ok
+        report["parity"] = parity
+        if not all(parity.values()):
+            failures.append("parity: %r" % parity)
+
+        # ---- churn + throughput: 8 concurrent streams, requests
+        # admitted/retired mid-flight under the strict gate. The shared
+        # 2-core driver box drifts under external load (same finding as
+        # serving_load_probe.py), so load-robust estimators: the
+        # baseline takes the BEST of repeated short rounds (load only
+        # ever subtracts throughput), and decode takes the best
+        # >=0.7 s sliding window over the live decode_tokens counter —
+        # the steady-state rate with every prefill stall inside the
+        # window counted, without the admission ramp / drain tail ----
+        churn_errors = 0
+        base_prompt = list(rs.randint(0, cfg.vocab_size, max_len - 40))
+        baseline_tps = 0.0
+
+        def baseline_round():
+            t0 = time.perf_counter()
+            oracle(base_prompt)  # 40 full-forward tokens
+            return 40 / (time.perf_counter() - t0)
+
+        def tokens_now():
+            return profiler.get_counters().get("decode_tokens", 0)
+
+        baseline_tps = max(baseline_tps, baseline_round())
+        n_requests = 36 if fast else 48
+        churn = []
+        for i in range(n_requests):
+            p = prompts[i % len(prompts)]
+            # staggered lengths churn the retirement order
+            churn.append(engine.generate(
+                p, max_new_tokens=24 + 8 * (i % 4)
+            ))
+        samples = [(time.perf_counter(), tokens_now())]
+        while not all(s.done for s in churn):
+            time.sleep(0.05)
+            samples.append((time.perf_counter(), tokens_now()))
+        samples.append((time.perf_counter(), tokens_now()))
+        decode_tokens_total = 0
+        for s in churn:
+            try:
+                decode_tokens_total += len(s.tokens(timeout=300))
+            except Exception:  # noqa: BLE001 - counted, fails the probe
+                churn_errors += 1
+        from bench import best_window_rate
+
+        decode_tps = best_window_rate(samples, 0.7)
+        baseline_tps = max(baseline_tps, baseline_round())
+        c_end = profiler.get_counters()
+        steady = (c_end.get("serving_steady_recompiles", 0)
+                  - c_warm.get("serving_steady_recompiles", 0))
+        speedup = decode_tps / baseline_tps
+        report["throughput"] = {
+            "streams": slots,
+            "requests": n_requests,
+            "decode_tokens": decode_tokens_total,
+            "decode_tps": round(decode_tps, 1),
+            "baseline_tps": round(baseline_tps, 1),
+            "speedup": round(speedup, 2),
+        }
+        report["strict"] = {
+            "steady_recompiles": int(steady),
+            "churn_errors": churn_errors,
+            "gate_armed": True,
+        }
+        if churn_errors:
+            failures.append("%d churned streams failed" % churn_errors)
+        if steady != 0:
+            failures.append("%d steady-state recompiles" % steady)
+        if speedup < 10.0:
+            failures.append("speedup %.2f < 10x" % speedup)
+
+        # ---- metrics on the exporter registry ----
+        rendered = obs_registry.render_prometheus()
+        gauges = obs_registry.gauge_values()
+        need = ("decode_tokens", "decode_steps", "decode_prefills",
+                "decode_requests", "decode_step_ms", "decode_prefill_ms",
+                "serving_slot_admissions", "serving_slot_retirements")
+        missing = [m for m in need if m not in rendered]
+        for g in ("serving_slot_occupancy", "decode_queue_depth"):
+            if g not in gauges:
+                missing.append(g)
+        report["metrics"] = {"missing": missing}
+        if missing:
+            failures.append("metrics missing: %r" % missing)
+    finally:
+        engine.stop()
+
+    report["pass"] = not failures
+    report["failures"] = failures
+    if verbose:
+        print(json.dumps(report, indent=1), file=sys.stderr)
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 budget subset (< 15 s)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    report = run_probe(fast=args.fast, verbose=args.verbose)
+    print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
+    print("PROBE PASS" if report["pass"]
+          else "PROBE FAIL: %s" % "; ".join(report["failures"]))
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

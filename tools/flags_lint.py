@@ -8,9 +8,9 @@ its fallback and ``FLAGS_`` env vars for it are dropped by the bridge —
 and (2) mentioned in README.md, so the Observability / Fault-tolerance /
 Serving quickstarts can't drift behind the code. The reverse direction
 is linted too: a registered knob nobody reads is a dead knob. (Scope
-grew obs_* -> +dist_*/elastic_* with the elastic-resize PR and
--> +serving_* with the compile-telemetry PR, which added
-``FLAGS_serving_strict_compiles``.)
+grew obs_* -> +dist_*/elastic_* with the elastic-resize PR,
+-> +serving_* with the compile-telemetry PR, and -> +decode_* with the
+KV-cache decode runtime.)
 
 A second pass lints METRIC names: every counter / histogram /
 scrape-time gauge the registry can render (every literal name at a
@@ -33,7 +33,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the linted knob families (prefix with trailing underscore)
-PREFIXES = ("obs_", "dist_", "elastic_", "serving_")
+PREFIXES = ("obs_", "dist_", "elastic_", "serving_", "decode_")
 _NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
 
 # the spellings a knob is consumed under: the env-bridge name and the
