@@ -32,6 +32,7 @@ from .manager import (  # noqa: F401
 from .preempt import (  # noqa: F401
     PreemptionHandler,
     preemption_requested,
+    request_preemption,
 )
 
 __all__ = [
@@ -40,6 +41,7 @@ __all__ = [
     "ChecksumError",
     "PreemptionHandler",
     "preemption_requested",
+    "request_preemption",
     "latest_step",
     "list_steps",
 ]

@@ -32,6 +32,16 @@ def preemption_requested():
     return _requested.is_set()
 
 
+def request_preemption():
+    """Set the process-wide preemption latch programmatically — the same
+    sticky flag an installed ``PreemptionHandler`` sets on SIGTERM.
+    Transports that own their own signal hook (the HTTP gateway's
+    ``install_sigterm``) call this so every reader of the one latch —
+    the exporter's ``/healthz``, the gateway's ``/readyz``, trainer
+    step-boundary polls — flips to draining together."""
+    _requested.set()
+
+
 def _reset_for_tests():
     _requested.clear()
 

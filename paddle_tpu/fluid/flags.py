@@ -82,6 +82,29 @@ _DEFAULTS = {
     "decode_max_len": 0,
     "decode_prefill_buckets": "",
     "decode_queue_depth": 64,
+    # HTTP serving gateway (paddle_tpu/serving/gateway.py): the network
+    # front door over InferenceServer (+ attached DecodeEngine).
+    # gateway_port binds the listener (0 = ephemeral — tests/probes read
+    # the bound port back); admission control in FRONT of the engine:
+    # gateway_rate_limit_rps is a PER-TENANT token-bucket refill rate
+    # (0 = unlimited) with gateway_rate_burst capacity,
+    # gateway_tenant_max_inflight caps one tenant's concurrently served
+    # requests (0 = unlimited; the isolation knob — a flooding tenant
+    # 429s at its own quota instead of starving the others),
+    # gateway_max_inflight caps the whole gateway (beyond it requests
+    # WAIT in priority order — interactive before batch — up to
+    # gateway_admit_timeout_ms, then shed 429). gateway_drain_timeout_s
+    # bounds the graceful drain (SIGTERM/stop waits for in-flight
+    # streams before closing the listener); gateway_access_log appends
+    # one JSONL line per request to the given path ("" = off).
+    "gateway_port": 0,
+    "gateway_rate_limit_rps": 0.0,
+    "gateway_rate_burst": 20,
+    "gateway_tenant_max_inflight": 0,
+    "gateway_max_inflight": 64,
+    "gateway_admit_timeout_ms": 100.0,
+    "gateway_drain_timeout_s": 30.0,
+    "gateway_access_log": "",
     # checkpoint manager (paddle_tpu/checkpoint): trainer-integrated save
     # cadence (0 = off), retention (newest keep_max steps survive GC,
     # every keep_every_n_steps-th step is pinned forever), writer-queue

@@ -12,6 +12,13 @@ a KV-cache slot pool with bucketed prefill + a single fused decode-step
 program, continuously batched — ``DecodeEngine`` standalone or through
 ``InferenceServer.generate()``.
 
+The network front door is ``Gateway`` (serving/gateway.py): a threaded
+stdlib-HTTP listener exposing ``POST /v1/infer`` (JSON tensors through
+the batcher), ``POST /v1/generate`` (chunked SSE token streaming),
+``GET /healthz``/``/readyz`` — with per-tenant token-bucket rate
+limits, inflight quotas, interactive/batch priority, faithful 429/504
+backpressure mapping, and SIGTERM graceful drain.
+
 Quickstart::
 
     from paddle_tpu import inference, serving
@@ -21,7 +28,9 @@ Quickstart::
         pred, max_batch_size=8, batch_timeout_ms=5, num_workers=2
     ).start(warmup_inputs=[example_x])
     out, = server.infer([x_row], deadline_ms=100)
+    gw = serving.Gateway(server, port=8500).start()  # HTTP front door
     print(server.stats().as_dict())
+    gw.stop()     # graceful: drains in-flight requests first
     server.stop()
 """
 
@@ -36,14 +45,18 @@ from .decode import (  # noqa: F401
     DecodeEngine,
     DecodeSession,
     GenerationStream,
+    sample_token,
 )
+from .gateway import Gateway  # noqa: F401
 from .metrics import ServingStats, snapshot_stats  # noqa: F401
 from .pool import PredictorPool  # noqa: F401
 from .server import InferenceServer  # noqa: F401
 
 __all__ = [
     "InferenceServer",
+    "Gateway",
     "DecodeEngine",
+    "sample_token",
     "DecodeSession",
     "GenerationStream",
     "MicroBatcher",

@@ -55,6 +55,7 @@ __all__ = [
     "DecodeEngine",
     "GenerationStream",
     "prefill_ladder",
+    "sample_token",
     "session_for_generate",
 ]
 
@@ -331,6 +332,56 @@ def session_for_generate(exe, cfg, scope, max_len, param_program):
 
 
 # ---------------------------------------------------------------------------
+# sampling — host-side, over the decode step's FETCHED logits
+# ---------------------------------------------------------------------------
+
+
+def sample_token(logits, temperature=0.0, top_k=0, top_p=0.0, rng=None):
+    """Pick one token id from a ``[vocab]`` logits row.
+
+    Host-side by design: the compiled prefill/decode programs already
+    fetch the logits, so sampling over them adds zero graph surface — no
+    new compiled program, no shape change, the strict-compile gate never
+    sees it. ``temperature <= 0`` is GREEDY (argmax), the default
+    everywhere, which keeps every token-exact parity contract intact;
+    ``top_k``/``top_p`` only apply when temperature sampling is on.
+    ``rng`` is a ``np.random.RandomState`` (seeded per request by the
+    engine) so a given (prompt, knobs, seed) replays the same completion.
+    Filtering order matches the common serving convention: temperature
+    scale -> top-k cut -> softmax -> nucleus (top-p) cut -> renormalize.
+    """
+    z = np.asarray(logits, np.float64).ravel()
+    if temperature is None or temperature <= 0.0:
+        return int(z.argmax())
+    z = z / float(temperature)
+    if top_k and 0 < int(top_k) < z.size:
+        kth = np.partition(z, -int(top_k))[-int(top_k)]
+        z = np.where(z < kth, -np.inf, z)
+    z = z - z.max()
+    probs = np.exp(z)
+    probs /= probs.sum()
+    if top_p and 0.0 < float(top_p) < 1.0:
+        order = np.argsort(-probs)
+        csum = np.cumsum(probs[order])
+        # keep the minimal prefix whose mass reaches top_p: a token stays
+        # if the mass BEFORE it is still short of top_p (the first token
+        # always stays, so the cut can never empty the distribution)
+        drop = order[(csum - probs[order]) >= float(top_p)]
+        probs[drop] = 0.0
+        probs /= probs.sum()
+    if not np.isfinite(probs).all():
+        # a denormal temperature (1e-308) overflows the scaled logits to
+        # inf and the softmax to NaN; fail THIS request loudly instead
+        # of handing np.random.choice a poisoned distribution
+        raise ValueError(
+            "sampling produced non-finite probabilities "
+            "(temperature %r too extreme for the logits)" % (temperature,)
+        )
+    r = rng if rng is not None else np.random
+    return int(r.choice(probs.size, p=probs))
+
+
+# ---------------------------------------------------------------------------
 # streaming handle
 # ---------------------------------------------------------------------------
 
@@ -344,10 +395,23 @@ class GenerationStream(object):
     completion. Single consumer. ``finish_reason`` is ``"eos"`` /
     ``"length"`` once done."""
 
-    def __init__(self, prompt_ids, max_new_tokens=None, eos_id=None):
+    def __init__(self, prompt_ids, max_new_tokens=None, eos_id=None,
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
+        # sampling knobs (host-side over fetched logits — sample_token):
+        # temperature <= 0 keeps the request greedy/argmax regardless of
+        # top_k/top_p, so the token-exact default path is untouched. The
+        # per-request RandomState makes a seeded request replay exactly
+        # whatever other streams share its decode batch.
+        self.temperature = float(temperature or 0.0)
+        self.top_k = int(top_k or 0)
+        self.top_p = float(top_p or 0.0)
+        self.seed = seed
+        self._rng = (
+            np.random.RandomState(seed) if self.temperature > 0.0 else None
+        )
         self.finish_reason = None
         # engine tick bookkeeping (scheduler tests / fairness probes):
         # the tick a slot was admitted on and the last tick it decoded on
@@ -357,8 +421,28 @@ class GenerationStream(object):
         self._tokens = []
         self._done = threading.Event()
         self._error = None
+        self._cancelled = False
+
+    def cancel(self):
+        """Abandon the request: the engine retires its slot at the next
+        tick boundary (finish_reason ``"cancelled"``) instead of
+        decoding tokens nobody will read — a transport whose client
+        timed out or disconnected MUST call this, or dead requests keep
+        occupying decode slots to completion. Safe from any thread,
+        idempotent, a no-op once the stream already finished."""
+        self._cancelled = True
 
     # engine side
+    def pick(self, logits):
+        """Select this request's next token from a ``[vocab]`` logits
+        row: greedy argmax unless the request armed temperature
+        sampling (then ``sample_token`` with the per-request RNG)."""
+        if self._rng is None:
+            return int(np.asarray(logits).ravel().argmax())
+        return sample_token(logits, temperature=self.temperature,
+                            top_k=self.top_k, top_p=self.top_p,
+                            rng=self._rng)
+
     def _push(self, tok):
         self._tokens.append(int(tok))
         self._q.put(int(tok))
@@ -379,8 +463,26 @@ class GenerationStream(object):
         return self._done.is_set()
 
     def __iter__(self):
+        return self.stream_tokens(timeout=None)
+
+    def stream_tokens(self, timeout=None):
+        """Like iteration, but the WHOLE stream must finish within
+        ``timeout`` seconds (None = unbounded): raises ``TimeoutError``
+        mid-iteration when the budget runs out, so a transport (the HTTP
+        gateway's SSE writer) can bound a wedged stream instead of
+        holding its connection open forever. Single consumer — don't mix
+        with ``__iter__`` on the same stream."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            item = self._q.get()
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("generation still in flight")
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                raise TimeoutError("generation still in flight")
             if item is _SENTINEL:
                 if self._error is not None:
                     raise self._error
@@ -590,11 +692,14 @@ class DecodeEngine(object):
         return False
 
     # -- request path --------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens=None, eos_id=None):
+    def submit(self, prompt_ids, max_new_tokens=None, eos_id=None,
+               temperature=0.0, top_k=0, top_p=0.0, seed=None):
         """Non-blocking admission; returns a ``GenerationStream``.
         Bounded queue: beyond ``queue_depth`` waiting requests, sheds
         with ``ServerOverloadedError`` (same backpressure contract as
-        the micro-batcher)."""
+        the micro-batcher). Sampling knobs are per-request and host-side
+        (``sample_token``): greedy (``temperature=0``) is the default,
+        and a seeded sampling request replays deterministically."""
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt")
@@ -609,7 +714,8 @@ class DecodeEngine(object):
         if max_new_tokens is not None and max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         stream = GenerationStream(prompt, max_new_tokens=max_new_tokens,
-                                  eos_id=eos_id)
+                                  eos_id=eos_id, temperature=temperature,
+                                  top_k=top_k, top_p=top_p, seed=seed)
         with self._cond:
             # re-checked under the lock stop() drains under: after the
             # drain, started is already False here and the stream can
@@ -631,11 +737,13 @@ class DecodeEngine(object):
         _profiler.bump_counter("decode_requests")
         return stream
 
-    def generate(self, prompt_ids, max_new_tokens=None, eos_id=None):
+    def generate(self, prompt_ids, max_new_tokens=None, eos_id=None,
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
         """Submit and return the streaming handle (iterate for tokens as
         they land; ``.tokens()`` / ``.result()`` to block)."""
         return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
-                           eos_id=eos_id)
+                           eos_id=eos_id, temperature=temperature,
+                           top_k=top_k, top_p=top_p, seed=seed)
 
     def stats(self):
         """THIS engine's counters + live occupancy snapshot (the
@@ -665,6 +773,7 @@ class DecodeEngine(object):
                 if self._stop:
                     return
             try:
+                self._reap_cancelled()
                 self._admit()
                 if self._active:
                     self._step()
@@ -682,6 +791,31 @@ class DecodeEngine(object):
                 self._free.extend(self._active.keys())
                 self._active.clear()
 
+    def _reap_cancelled(self):
+        """Retire slots whose consumer abandoned the stream (transport
+        timeout / client disconnect) — BEFORE spending a prefill or a
+        decode step on them. Freed slots count as retirements so the
+        admissions == retirements + occupancy invariant holds. The
+        PENDING queue is swept too: a request cancelled while queued
+        must release its bounded-admission-queue entry immediately, not
+        sit shedding live traffic with 429s until a slot frees."""
+        for idx, slot in list(self._active.items()):
+            if slot.stream._cancelled:
+                self._active.pop(idx, None)
+                self._free.append(idx)
+                _profiler.bump_counter("serving_slot_retirements")
+                self._counts["retirements"] += 1
+                slot.stream._finish("cancelled")
+        with self._cond:
+            if any(s._cancelled for s in self._pending):
+                live = deque()
+                for s in self._pending:
+                    if s._cancelled:
+                        s._finish("cancelled")
+                    else:
+                        live.append(s)
+                self._pending = live
+
     def _admit(self):
         """Prefill queued requests into free slots — mid-flight, between
         decode steps, never evicting an active stream."""
@@ -690,17 +824,26 @@ class DecodeEngine(object):
                 if not self._pending:
                     return
                 stream = self._pending.popleft()
+            if stream._cancelled:
+                # cancelled while queued: never admitted, so no slot,
+                # no retirement tally — just finish the dead handle
+                stream._finish("cancelled")
+                continue
             slot_idx = self._free.pop()
             try:
                 with _xla_stats.serving_request_window():
                     logits = self.session.prefill(
                         slot_idx, stream.prompt_ids
                     )
+                # pick() INSIDE the per-request guard: a poisoned
+                # sampling request (e.g. a denormal temperature) must
+                # fail alone, not escape to the loop's handler and take
+                # every co-batched stream down with it
+                tok = stream.pick(logits)
             except Exception as e:  # noqa: BLE001 - per-request failure
                 self._free.append(slot_idx)
                 stream._fail(e)
                 continue
-            tok = int(np.asarray(logits).ravel().argmax())
             slot = _Slot(stream, tok, next_pos=len(stream.prompt_ids))
             with self._cond:
                 # stop() drains under this lock and flips started inside
@@ -757,7 +900,15 @@ class DecodeEngine(object):
         self.tick += 1
         for idx in list(self._active.keys()):
             slot = self._active[idx]
-            tok = int(logits[idx].argmax())
+            try:
+                tok = slot.stream.pick(logits[idx])
+            except Exception as e:  # noqa: BLE001 - fail THIS stream only
+                self._active.pop(idx, None)
+                self._free.append(idx)
+                _profiler.bump_counter("serving_slot_retirements")
+                self._counts["retirements"] += 1
+                slot.stream._fail(e)
+                continue
             slot.next_pos += 1
             slot.generated += 1
             slot.pending_token = tok

@@ -1,0 +1,1012 @@
+"""HTTP serving gateway — the multi-tenant network front door.
+
+Everything below this module is in-process: the micro-batcher, the
+bucket ladder, the KV-cache decode engine, the strict compile gate. The
+gateway is the integration layer that turns them into a *service* — the
+shape the reference era shipped as Paddle Serving fronting the
+AnalysisPredictor C-API surface this repo reproduces:
+
+  HTTP client --> [admission control] --> InferenceServer.infer()
+                    |                      (micro-batcher + buckets)
+                    +-----------------> InferenceServer.generate()
+                                           (DecodeEngine, SSE stream)
+
+Endpoints (stdlib ``http.server`` threaded listener, one handler thread
+per in-flight request):
+
+- ``POST /v1/infer`` — JSON tensors in, JSON tensors out, through the
+  dynamic batcher (concurrent HTTP clients coalesce into device
+  batches exactly like in-process callers);
+- ``POST /v1/generate`` — prompt ids in; chunked **SSE** token stream
+  out (one ``data:`` event per generated token riding the engine's
+  ``GenerationStream``), or a single JSON body with ``"stream": false``;
+- ``GET /healthz`` — liveness (always 200 while the process runs);
+- ``GET /readyz`` — readiness; flips 503 the moment the PR 3 preemption
+  latch is set (``checkpoint.preempt``) or a drain begins, so a load
+  balancer stops routing BEFORE the listener closes — the same latch
+  the observability exporter's ``/healthz`` reads.
+
+Admission control sits in FRONT of the engine, per tenant
+(``X-Tenant-Id`` header, "anon" when absent):
+
+- token-bucket rate limit (``FLAGS_gateway_rate_limit_rps`` refill,
+  ``FLAGS_gateway_rate_burst`` capacity) — over it, 429 + Retry-After;
+- max-inflight quota (``FLAGS_gateway_tenant_max_inflight``) — a
+  flooding tenant 429s at its own quota instead of starving the rest;
+- a global cap (``FLAGS_gateway_max_inflight``): beyond it requests
+  WAIT in priority order — ``X-Priority: interactive`` (default) is
+  granted freed slots before ``batch`` — up to
+  ``FLAGS_gateway_admit_timeout_ms``, then shed.
+
+Engine backpressure maps faithfully: ``ServerOverloadedError`` (shed at
+admission by the batcher/engine) -> 429 with the engine's own
+retry-after hint; ``DeadlineExceededError`` (shed at dispatch) -> 504.
+The two shed points stay distinguishable in metrics
+(``gateway_shed_admission`` vs ``gateway_shed_dispatch``).
+
+Every request gets an id (``X-Request-Id`` or generated), one JSONL
+access-log line (``FLAGS_gateway_access_log``), a ``gateway_request``
+span on the handler thread (it time-contains the batcher's
+``serving_dispatch``/``predictor_run`` spans, which run on their worker
+threads — Perfetto lines them up by containment), and ``gateway_*``
+counters/histograms on the PR 5 registry, so the existing ``/metrics``
+exporter publishes per-tenant request/shed/latency with no extra
+wiring.
+
+Graceful drain: ``stop()`` (or SIGTERM via ``install_sigterm()``, which
+sets the shared preemption latch) flips ``/readyz`` to 503, rejects new
+work with 503, waits for every in-flight request — including mid-flight
+SSE streams — to complete (bounded by ``FLAGS_gateway_drain_timeout_s``),
+and only then closes the listener.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..checkpoint import preempt as _preempt
+from ..fluid import flags as _flags
+from ..fluid import profiler as _profiler
+from ..observability import exporter as _obs_exporter
+from ..observability import registry as _obs_registry
+from ..observability import trace as _trace
+from .batcher import (
+    DeadlineExceededError,
+    ServerOverloadedError,
+    ServingError,
+)
+
+__all__ = ["Gateway", "encode_tensor", "decode_tensor"]
+
+
+def _flag(name, override):
+    return override if override is not None else _flags.get_flag(name)
+
+
+# -- JSON tensor wire format -------------------------------------------------
+# {"data": <nested lists>, "dtype": "float32", "shape": [2, 3]} — shape
+# optional (inferred from nesting), dtype defaults to float32. Exact for
+# float32: every float32 is exactly a double, json round-trips the
+# double, and the cast back recovers the original bits.
+
+
+def decode_tensor(obj):
+    if not isinstance(obj, dict) or "data" not in obj:
+        raise ValueError(
+            "tensor must be {'data': ..., 'dtype': ..., 'shape': ...}"
+        )
+    try:
+        # `or`: a JSON null dtype means "default" (float32), it must
+        # not fall through to np.dtype(None) == float64
+        dt = np.dtype(obj.get("dtype") or "float32")
+    except TypeError:
+        # np.dtype raises TypeError for unknown names; a malformed
+        # client body must map to 400, not the generic 500 path
+        raise ValueError("unknown dtype %r" % (obj.get("dtype"),))
+    try:
+        arr = np.asarray(obj["data"], dtype=dt)
+    except (TypeError, ValueError):
+        raise ValueError("tensor data does not parse as %s" % dt)
+    if obj.get("shape") is not None:
+        arr = arr.reshape([int(d) for d in obj["shape"]])
+    return arr
+
+
+def encode_tensor(arr):
+    arr = np.asarray(arr)
+    return {"data": arr.tolist(), "shape": list(arr.shape),
+            "dtype": str(arr.dtype)}
+
+
+# -- admission control -------------------------------------------------------
+
+
+# request bodies are buffered in the handler thread: bound them so a
+# client-supplied Content-Length cannot OOM the process (same
+# client-controlled-resource class as the tenant-table cap)
+_MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class _PayloadTooLarge(ValueError):
+    """Request body over _MAX_BODY_BYTES — mapped to HTTP 413."""
+
+
+class _AdmissionDenied(ServingError):
+    """Internal: request shed at GATEWAY admission (never dispatched).
+    ``reason`` in {"ratelimit", "quota", "overload"}."""
+
+    def __init__(self, reason, msg, retry_after_ms=1000):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_ms = max(1, int(retry_after_ms))
+
+
+class _TokenBucket(object):
+    """Classic token bucket: ``rate`` tokens/sec refill into ``burst``
+    capacity; one token per request. Not thread-safe on its own — the
+    controller's lock serializes access."""
+
+    __slots__ = ("rate", "burst", "tokens", "t")
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = float(max(1, burst))
+        self.tokens = self.burst
+        self.t = time.monotonic()
+
+    def try_take(self):
+        """None on success, else seconds until a token is available."""
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.t) * self.rate)
+        self.t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return None
+        return (1.0 - self.tokens) / self.rate
+
+
+# shared rate bucket for the >_MAX_TRACKED_TENANTS long tail — a
+# sentinel key no client-supplied tenant string can equal
+_OVERFLOW_BUCKET = object()
+
+
+class _Admission(object):
+    """Per-tenant rate limit + inflight quota + global cap with
+    priority-ordered waiting. ``admit()`` either returns (after
+    reserving an inflight slot) or raises ``_AdmissionDenied``;
+    ``release()`` frees the slot and wakes waiters — interactive
+    waiters are granted freed capacity before batch waiters."""
+
+    def __init__(self, rate_rps, burst, tenant_max_inflight, max_inflight,
+                 admit_timeout_ms):
+        self.rate_rps = float(rate_rps)
+        self.burst = int(burst)
+        self.tenant_max = int(tenant_max_inflight)
+        self.global_max = int(max_inflight)
+        self.admit_timeout_s = float(admit_timeout_ms) / 1e3
+        self._buckets = {}
+        self._inflight = {}
+        self._total = 0
+        self._interactive_waiting = 0
+        self._cond = threading.Condition()
+
+    @property
+    def total_inflight(self):
+        with self._cond:
+            return self._total
+
+    def admit(self, tenant, priority):
+        with self._cond:
+            # 1) rate limit: cheapest check first, fail fast with the
+            #    bucket's own refill estimate as the retry hint. Buckets
+            #    key on the RAW tenant name but bounded (the header is
+            #    client data): past _MAX_TRACKED_TENANTS distinct
+            #    tenants the long tail shares one sentinel-keyed
+            #    overflow bucket — a sentinel, not a name, so no real
+            #    tenant (not even one literally called "overflow") can
+            #    collide into it, and sanitization collisions ("a-b" vs
+            #    "a.b") can't couple two tenants' rates
+            if self.rate_rps > 0:
+                key = tenant
+                if (key not in self._buckets
+                        and len(self._buckets) >= _MAX_TRACKED_TENANTS):
+                    key = _OVERFLOW_BUCKET
+                bucket = self._buckets.get(key)
+                if bucket is None:
+                    bucket = self._buckets[key] = _TokenBucket(
+                        self.rate_rps, self.burst
+                    )
+                wait_s = bucket.try_take()
+                if wait_s is not None:
+                    raise _AdmissionDenied(
+                        "ratelimit",
+                        "tenant %r over %.3g req/s rate limit" %
+                        (tenant, self.rate_rps),
+                        retry_after_ms=wait_s * 1e3,
+                    )
+            # 2) tenant quota: the isolation knob — one tenant's flood
+            #    caps at its own share, the others' headroom survives
+            if (self.tenant_max > 0
+                    and self._inflight.get(tenant, 0) >= self.tenant_max):
+                raise _AdmissionDenied(
+                    "quota",
+                    "tenant %r at max inflight %d" %
+                    (tenant, self.tenant_max),
+                    # a slot frees when one of the tenant's own requests
+                    # completes; no better estimate than "soon"
+                    retry_after_ms=50,
+                )
+            # 3) global cap: WAIT (bounded) for a slot, interactive
+            #    ahead of batch — a batch waiter only takes a freed slot
+            #    while no interactive request is waiting
+            t_wait = time.monotonic()
+            deadline = t_wait + self.admit_timeout_s
+            waited = False
+            interactive = priority != "batch"
+            if interactive:
+                self._interactive_waiting += 1
+            try:
+                while self._total >= self.global_max or (
+                    not interactive and self._interactive_waiting > 0
+                ):
+                    waited = True
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise _AdmissionDenied(
+                            "overload",
+                            "gateway at max inflight %d (%s waited %.0fms)"
+                            % (self.global_max, priority,
+                               self.admit_timeout_s * 1e3),
+                            retry_after_ms=self.admit_timeout_s * 1e3,
+                        )
+                    self._cond.wait(remaining)
+            finally:
+                if interactive:
+                    self._interactive_waiting -= 1
+                    if self._interactive_waiting == 0:
+                        # unblock batch waiters parked on the
+                        # interactive-priority predicate
+                        self._cond.notify_all()
+            if waited:
+                _profiler.bump_histogram(
+                    "gateway_admit_wait_ms",
+                    (time.monotonic() - t_wait) * 1e3,
+                )
+                # re-check the quota AFTER the wait: several same-tenant
+                # requests can pass the pre-wait check with 0 inflight,
+                # park on the global cap, then all wake — without this
+                # (still under the lock, so increments serialize) they
+                # would all admit and exceed the tenant's share
+                if (self.tenant_max > 0
+                        and self._inflight.get(tenant, 0)
+                        >= self.tenant_max):
+                    raise _AdmissionDenied(
+                        "quota",
+                        "tenant %r at max inflight %d" %
+                        (tenant, self.tenant_max),
+                        retry_after_ms=50,
+                    )
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+            self._total += 1
+
+    def release(self, tenant):
+        with self._cond:
+            n = self._inflight.get(tenant, 0) - 1
+            if n > 0:
+                self._inflight[tenant] = n
+            else:
+                self._inflight.pop(tenant, None)
+            self._total -= 1
+            self._cond.notify_all()
+
+
+# -- access log --------------------------------------------------------------
+
+
+class _AccessLog(object):
+    """Append-only JSONL access log; one locked single-write per line
+    (concurrent handler threads at worst interleave whole lines, the
+    same contract as registry.write_snapshot). Disabled when pathless;
+    a full disk must not fail requests."""
+
+    def __init__(self, path):
+        self.path = str(path) if path else None
+        self._lock = threading.Lock()
+
+    def write(self, record):
+        if not self.path:
+            return
+        line = json.dumps(record, sort_keys=True) + "\n"
+        try:
+            with self._lock, open(self.path, "a") as f:
+                f.write(line)
+        except OSError:
+            pass
+
+
+_request_ids = itertools.count(1)  # .__next__ atomic under the GIL
+
+# X-Tenant-Id is CLIENT-CONTROLLED: per-tenant metric names and rate
+# buckets must not let an attacker grow process memory / Prometheus
+# cardinality without bound. The first _MAX_TRACKED_TENANTS distinct
+# tenants get their own slug (and so their own metric series and token
+# bucket); everyone after that shares the "overflow" slug+bucket. The
+# inflight-quota map needs no bound — entries pop at zero.
+_MAX_TRACKED_TENANTS = 256
+
+
+class _TenantTable(object):
+    """Bounded tenant -> prometheus-safe slug map (process-wide: the
+    metric registry the slugs land in is process-global too)."""
+
+    def __init__(self, cap=_MAX_TRACKED_TENANTS):
+        self.cap = int(cap)
+        self._map = {}
+        self._lock = threading.Lock()
+
+    def slug(self, tenant):
+        with self._lock:
+            s = self._map.get(tenant)
+            if s is None:
+                if len(self._map) >= self.cap:
+                    return "overflow"
+                s = _obs_registry.prom_name(tenant).lower()
+                self._map[tenant] = s
+            return s
+
+
+_tenants = _TenantTable()
+
+
+def _tenant_slug(tenant):
+    """Prometheus-safe tenant fragment for per-tenant metric families
+    (bounded — see _TenantTable)."""
+    return _tenants.slug(tenant)
+
+
+# -- the gateway -------------------------------------------------------------
+
+
+class Gateway(object):
+    """HTTP front door over an ``InferenceServer`` (whose attached
+    ``DecodeEngine``, if any, serves ``/v1/generate``). ``None``
+    parameters resolve from the ``FLAGS_gateway_*`` knobs.
+
+    Usage::
+
+        server = serving.InferenceServer(pred, decode_engine=engine)
+        server.start(warmup_inputs=[x])
+        gw = serving.Gateway(server, port=8500).start()
+        gw.install_sigterm()       # SIGTERM -> drain -> close listener
+        ...
+        gw.stop()                  # graceful: drains in-flight first
+    """
+
+    def __init__(self, server, port=None, host="127.0.0.1",
+                 rate_limit_rps=None, rate_burst=None,
+                 tenant_max_inflight=None, max_inflight=None,
+                 admit_timeout_ms=None, drain_timeout_s=None,
+                 access_log=None):
+        self.server = server
+        self.host = host
+        self.port_requested = int(_flag("gateway_port", port))
+        self.drain_timeout_s = float(
+            _flag("gateway_drain_timeout_s", drain_timeout_s)
+        )
+        self.admission = _Admission(
+            _flag("gateway_rate_limit_rps", rate_limit_rps),
+            _flag("gateway_rate_burst", rate_burst),
+            _flag("gateway_tenant_max_inflight", tenant_max_inflight),
+            _flag("gateway_max_inflight", max_inflight),
+            _flag("gateway_admit_timeout_ms", admit_timeout_ms),
+        )
+        self.access_log = _AccessLog(_flag("gateway_access_log", access_log))
+        self._httpd = None
+        self._http_thread = None
+        self._started = False
+        self._draining = False
+        self._drain_cond = threading.Condition()
+        self._inflight = 0
+        self._inflight_gauge = None
+        self._draining_gauge = None
+        self._prev_sigterm = None
+        self._sig_installed = False
+        self._drain_watch = None
+        self._stop_watch = threading.Event()
+        self._stopped = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._started:
+            raise RuntimeError("gateway already started")
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port_requested), handler
+        )
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="gateway_http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        self._draining = False
+        self._started = True
+        # telemetry: the obs exporter lights up /metrics etc. from
+        # FLAGS_obs_* (no-op when disarmed) — gateway metrics ride the
+        # same registry, so one scrape covers engine + gateway
+        _obs_exporter.maybe_start_from_flags()
+        self._inflight_gauge = lambda g=self: g._inflight
+        _obs_registry.register_gauge("gateway_inflight",
+                                     self._inflight_gauge)
+        self._draining_gauge = lambda g=self: 1.0 if g._draining else 0.0
+        _obs_registry.register_gauge("gateway_draining",
+                                     self._draining_gauge)
+        # watch the shared preemption latch: a SIGTERM seen by ANY
+        # installed handler (ours via install_sigterm, or a trainer's
+        # PreemptionHandler in the same process) drains this gateway
+        self._stop_watch.clear()
+        self._stopped.clear()
+        self._drain_watch = threading.Thread(
+            target=self._watch_preemption, name="gateway_drain_watch",
+            daemon=True,
+        )
+        self._drain_watch.start()
+        return self
+
+    @property
+    def port(self):
+        """The BOUND port (differs from 0-requested ephemeral binds);
+        None once the listener is closed."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def url(self, path="/healthz"):
+        if self._httpd is None:
+            raise RuntimeError("gateway is not listening")
+        return "http://%s:%d%s" % (self.host, self.port, path)
+
+    def install_sigterm(self):
+        """Route SIGTERM into the graceful-drain path: the handler sets
+        the shared preemption latch (``checkpoint.preempt``), which
+        flips ``/readyz`` AND the exporter's ``/healthz`` to draining;
+        the watch thread then drains in-flight streams and closes the
+        listener. A previously installed Python handler (a colocated
+        trainer's ``PreemptionHandler`` final save) is CHAINED after the
+        latch — its state must not be lost because a gateway installed
+        later. Caveat: a chained handler that exits the process
+        (``exit_after=True``) will cut the drain short; colocated
+        trainers that want the drain should install with
+        ``save_in_handler``/``exit_after`` off and poll the latch.
+        Main-thread only (signal API constraint) — a gateway driven from
+        a worker thread relies on the process's own PreemptionHandler
+        setting the same latch."""
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        if self._sig_installed:
+            # idempotent: a second install would capture OUR handler as
+            # _prev_sigterm and the chain would recurse on SIGTERM
+            return self
+        self._prev_sigterm = signal.signal(
+            signal.SIGTERM, self._on_sigterm
+        )
+        self._sig_installed = True
+        return self
+
+    def _on_sigterm(self, signum, frame):
+        # minimal handler: latch, then chain. The drain itself (bounded,
+        # seconds) must not run between arbitrary bytecodes on the main
+        # thread — the watch thread does it. Once the gateway has
+        # stopped the handler degrades to a pure pass-through: a stop()
+        # that ran on the watch thread cannot signal.signal() the old
+        # handler back (main-thread-only API), so this stays installed
+        # but transparent.
+        if self._started:
+            _preempt.request_preemption()
+        prev = self._prev_sigterm
+        if callable(prev):  # SIG_DFL / SIG_IGN / None are not
+            prev(signum, frame)
+
+    def _watch_preemption(self):
+        while not self._stop_watch.wait(0.05):
+            if _preempt.preemption_requested():
+                self.stop()
+                return
+
+    def draining(self):
+        return (self._draining or not self._started
+                or _preempt.preemption_requested())
+
+    def stop(self, drain_timeout_s=None):
+        """Graceful stop: flip NOT-READY, reject new work with 503, wait
+        (bounded) for every in-flight request — including mid-stream SSE
+        responses — then close the listener. Idempotent; concurrent
+        callers (SIGTERM watch + an explicit stop) drain once, and the
+        late caller BLOCKS until that drain completes — the documented
+        ``gw.stop(); server.stop()`` teardown must not rip the engine
+        out from under requests another thread is still draining."""
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else float(drain_timeout_s))
+        with self._drain_cond:
+            in_progress = self._draining
+            if not in_progress and not self._started:
+                self._restore_sigterm()
+                return
+            self._draining = True  # /readyz 503 + new requests 503
+        if in_progress:
+            # another thread owns the drain: wait it out (bounded)
+            self._stopped.wait(timeout + 10.0)
+            # a watch-thread stop couldn't restore the signal handler
+            # (main-thread-only API); finish the job if we can
+            self._restore_sigterm()
+            return
+        self._stop_watch.set()
+        deadline = time.monotonic() + timeout
+        with self._drain_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _profiler.bump_counter("gateway_drain_timeouts")
+                    break
+                self._drain_cond.wait(remaining)
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:
+                pass
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+        self._httpd = None
+        if self._inflight_gauge is not None:
+            _obs_registry.unregister_gauge("gateway_inflight",
+                                           self._inflight_gauge)
+            self._inflight_gauge = None
+        if self._draining_gauge is not None:
+            _obs_registry.unregister_gauge("gateway_draining",
+                                           self._draining_gauge)
+            self._draining_gauge = None
+        self._restore_sigterm()
+        self._started = False
+        self._stopped.set()  # unblock concurrent stop() callers
+
+    def _restore_sigterm(self):
+        """Put the previous SIGTERM handler back — only possible from
+        the main thread (signal API); a stop() driven by the watch
+        thread leaves ours installed as a pass-through (_on_sigterm
+        checks _started) until a main-thread stop() lands here."""
+        if (self._sig_installed
+                and threading.current_thread() is threading.main_thread()):
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+                self._sig_installed = False
+            except (ValueError, TypeError):
+                pass
+
+    def __enter__(self):
+        return self if self._started else self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- per-request bookkeeping (called from handler threads) ---------------
+    def _enter_request(self):
+        with self._drain_cond:
+            if self._draining or not self._started:
+                return False
+            self._inflight += 1
+            return True
+
+    def _exit_request(self):
+        with self._drain_cond:
+            self._inflight -= 1
+            self._drain_cond.notify_all()
+
+
+# -- HTTP handler ------------------------------------------------------------
+
+
+def _make_handler(gw):
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "paddle-tpu-gateway/1"
+        # socket timeout: a client that trickles its body (or stalls a
+        # read) is disconnected instead of pinning a handler thread
+        timeout = 60.0
+
+        def log_message(self, *args):  # access log is ours, not stderr's
+            pass
+
+        # -- plumbing --------------------------------------------------------
+        def _send_json(self, code, obj, headers=(), close=False):
+            """``close=True`` on any response sent WITHOUT having read
+            the request body (early 429/404/503) or after a partial
+            read: protocol_version is HTTP/1.1, so a kept-alive client
+            would otherwise see the unread body bytes parsed as its
+            next request line and desync."""
+            data = json.dumps(obj, sort_keys=True).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            if close:
+                self.send_header("Connection", "close")
+                self.close_connection = True
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _read_body(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                raise ValueError("bad Content-Length")
+            if n <= 0:
+                raise ValueError("missing request body")
+            if n > _MAX_BODY_BYTES:
+                raise _PayloadTooLarge(
+                    "request body of %d bytes exceeds the %d-byte cap"
+                    % (n, _MAX_BODY_BYTES)
+                )
+            body = self.rfile.read(n)
+            try:
+                obj = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                raise ValueError("request body is not valid JSON")
+            if not isinstance(obj, dict):
+                raise ValueError("request body must be a JSON object")
+            return obj
+
+        @staticmethod
+        def _opt_number(body, key):
+            """Optional numeric field -> float|None; a non-numeric value
+            is a 400 (ValueError), not a 500 from a downstream compare."""
+            v = body.get(key)
+            if v is None:
+                return None
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError("%r must be a number" % key)
+            return float(v)
+
+        def _send_shed_429(self, tenant, rid, reason, retry_after_ms,
+                           msg, close=False):
+            """The one 429 contract (admission sheds of every kind):
+            Retry-After header in ceil'd seconds, machine-readable body,
+            admission-shed + per-tenant counters."""
+            _profiler.bump_counter("gateway_shed_admission")
+            _profiler.bump_counter("gateway_tenant_shed_"
+                                   + _tenant_slug(tenant))
+            retry_after_ms = max(1, int(retry_after_ms))
+            self._send_json(
+                429,
+                {"error": msg, "reason": reason,
+                 "retry_after_ms": retry_after_ms, "request_id": rid},
+                headers=(("Retry-After",
+                          str(max(1, (retry_after_ms + 999) // 1000))),),
+                close=close,
+            )
+
+        def _request_meta(self):
+            # strip BEFORE the fallback: a whitespace-only header must
+            # land in "anon", not mint an empty-string tenant with its
+            # own bucket and a malformed metric slug
+            tenant = (self.headers.get("X-Tenant-Id") or "").strip() \
+                or "anon"
+            priority = (self.headers.get("X-Priority") or
+                        "interactive").strip().lower()
+            if priority not in ("interactive", "batch"):
+                priority = "interactive"
+            rid = (self.headers.get("X-Request-Id")
+                   or "req-%d-%d" % (os.getpid(), next(_request_ids)))
+            return tenant, priority, rid
+
+        # -- GET: health/readiness ------------------------------------------
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                # liveness: the process is up and handling sockets
+                self._send_json(200, {"status": "alive",
+                                      "pid": os.getpid()})
+            elif path == "/readyz":
+                if gw.draining():
+                    self._send_json(503, {"status": "draining"})
+                else:
+                    self._send_json(
+                        200,
+                        {"status": "ready",
+                         "inflight": gw.admission.total_inflight},
+                    )
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        # -- POST: the serving endpoints ------------------------------------
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/v1/infer":
+                self._serve(path, self._infer)
+            elif path == "/v1/generate":
+                self._serve(path, self._generate)
+            else:
+                # body unread -> close, or a kept-alive client desyncs
+                self._send_json(404, {"error": "not found"}, close=True)
+
+        def _serve(self, endpoint, fn):
+            """Shared request wrapper: drain gate, body read (BEFORE
+            admission — an admitted inflight slot must never wait on a
+            trickling client body), admission control, span, metrics,
+            access log, error->status mapping."""
+            tenant, priority, rid = self._request_meta()
+            t0 = time.monotonic()
+            _profiler.bump_counter("gateway_requests")
+            _profiler.bump_counter("gateway_tenant_requests_"
+                                   + _tenant_slug(tenant))
+            if not gw._enter_request():
+                self._send_json(
+                    503, {"error": "draining", "request_id": rid},
+                    close=True,
+                )
+                self._log(rid, tenant, priority, endpoint, 503, t0,
+                          reason="draining")
+                return
+            status, reason, tokens = 500, None, None
+            try:
+                with _trace.span("gateway_request", cat="gateway",
+                                 endpoint=endpoint, tenant=tenant,
+                                 request_id=rid, priority=priority) as sp:
+                    try:
+                        body = self._read_body()
+                    except _PayloadTooLarge as e:
+                        # refused unread -> must close the connection
+                        status, reason = 413, "too_large"
+                        self._send_json(413, {"error": str(e),
+                                              "request_id": rid},
+                                        close=True)
+                        return
+                    except ValueError as e:
+                        # ambiguous read state (bad/missing length,
+                        # undecodable body) -> close conservatively
+                        status, reason = 400, "bad_request"
+                        self._send_json(400, {"error": str(e),
+                                              "request_id": rid},
+                                        close=True)
+                        return
+                    try:
+                        gw.admission.admit(tenant, priority)
+                    except _AdmissionDenied as e:
+                        status, reason = 429, e.reason
+                        # body consumed above: keep-alive stays safe
+                        self._send_shed_429(tenant, rid, e.reason,
+                                            e.retry_after_ms, str(e))
+                        return
+                    try:
+                        status, reason, tokens = fn(tenant, rid, body)
+                    finally:
+                        gw.admission.release(tenant)
+                    if sp.args is not None:
+                        # the span records its kwargs dict by reference,
+                        # so the status lands in the exported trace args
+                        sp.args["status"] = status
+            except ConnectionError:
+                # BrokenPipe AND ConnectionReset/Aborted: the client
+                # went away — not a server error, don't write to the
+                # dead socket or pollute 5xx monitoring
+                status, reason = 499, "client_disconnected"
+            except Exception as e:  # handler must never kill the thread
+                status, reason = 500, repr(e)
+                try:
+                    # body state unknown here -> close the connection
+                    self._send_json(500, {"error": repr(e),
+                                          "request_id": rid}, close=True)
+                except Exception:
+                    pass
+            finally:
+                gw._exit_request()
+                ms = (time.monotonic() - t0) * 1e3
+                if status < 400:
+                    _profiler.bump_histogram("gateway_latency_ms", ms)
+                    _profiler.bump_histogram(
+                        "gateway_tenant_latency_ms_" + _tenant_slug(tenant),
+                        ms,
+                    )
+                self._log(rid, tenant, priority, endpoint, status, t0,
+                          reason=reason, tokens=tokens)
+
+        def _log(self, rid, tenant, priority, endpoint, status, t0,
+                 reason=None, tokens=None):
+            rec = {
+                "ts": time.time(),
+                "request_id": rid,
+                "tenant": tenant,
+                "priority": priority,
+                "endpoint": endpoint,
+                "status": int(status),
+                "ms": round((time.monotonic() - t0) * 1e3, 3),
+            }
+            if reason:
+                rec["reason"] = reason
+            if tokens is not None:
+                rec["tokens"] = int(tokens)
+            gw.access_log.write(rec)
+
+        # -- /v1/infer -------------------------------------------------------
+        def _infer(self, tenant, rid, body):
+            """Returns (status, reason, tokens) after writing the
+            response. Body: {"inputs": [tensor...], "deadline_ms": f}."""
+            try:
+                raw = body.get("inputs")
+                if not isinstance(raw, list) or not raw:
+                    raise ValueError("'inputs' must be a non-empty list "
+                                     "of tensors")
+                feeds = [decode_tensor(t) for t in raw]
+                deadline_ms = self._opt_number(body, "deadline_ms")
+            except ValueError as e:
+                # body fully consumed by _serve: keep-alive stays safe
+                self._send_json(400, {"error": str(e),
+                                      "request_id": rid})
+                return 400, "bad_request", None
+            try:
+                outs = gw.server.infer(feeds, deadline_ms=deadline_ms)
+            except ServerOverloadedError as e:
+                # shed at the ENGINE's admission queue: same 429 +
+                # Retry-After contract as the gateway's own sheds
+                self._send_shed_429(tenant, rid, "overload",
+                                    e.retry_after_ms, str(e))
+                return 429, "overload", None
+            except DeadlineExceededError as e:
+                # shed at DISPATCH: the deadline passed in the queue
+                _profiler.bump_counter("gateway_shed_dispatch")
+                _profiler.bump_counter("gateway_tenant_shed_"
+                                       + _tenant_slug(tenant))
+                self._send_json(504, {"error": str(e),
+                                      "reason": "deadline",
+                                      "request_id": rid})
+                return 504, "deadline", None
+            except ServingError as e:
+                self._send_json(500, {"error": str(e),
+                                      "request_id": rid})
+                return 500, "serving_error", None
+            self._send_json(200, {
+                "request_id": rid,
+                "outputs": [encode_tensor(o) for o in outs],
+            })
+            return 200, None, None
+
+        # -- /v1/generate ----------------------------------------------------
+        def _generate(self, tenant, rid, body):
+            """Body: {"prompt_ids": [...], "max_new_tokens", "eos_id",
+            "temperature", "top_k", "top_p", "seed", "stream" (default
+            true), "deadline_ms"}. Streaming responses are chunked SSE:
+            one ``data: {"token": t}`` event per generated token, then
+            ``data: {"done": true, ...}``."""
+            try:
+                prompt = body.get("prompt_ids")
+                if (not isinstance(prompt, list) or not prompt
+                        or not all(isinstance(t, int) for t in prompt)):
+                    raise ValueError(
+                        "'prompt_ids' must be a non-empty list of ints"
+                    )
+                stream_mode = bool(body.get("stream", True))
+                deadline_ms = self._opt_number(body, "deadline_ms")
+                kw = dict(
+                    max_new_tokens=body.get("max_new_tokens"),
+                    eos_id=body.get("eos_id"),
+                    temperature=self._opt_number(body, "temperature"),
+                    top_k=body.get("top_k", 0),
+                    top_p=self._opt_number(body, "top_p"),
+                    seed=body.get("seed"),
+                )
+            except ValueError as e:
+                self._send_json(400, {"error": str(e),
+                                      "request_id": rid})
+                return 400, "bad_request", None
+            timeout = (deadline_ms / 1e3
+                       if deadline_ms and deadline_ms > 0 else None)
+            try:
+                stream = gw.server.generate(prompt, **kw)
+            except ServerOverloadedError as e:
+                self._send_shed_429(tenant, rid, "overload",
+                                    e.retry_after_ms, str(e))
+                return 429, "overload", None
+            except (ValueError, TypeError, ServingError) as e:
+                code = 500 if isinstance(e, ServingError) else 400
+                self._send_json(code, {"error": str(e),
+                                       "request_id": rid})
+                return code, "bad_request" if code == 400 else "error", None
+            if not stream_mode:
+                try:
+                    toks = stream.tokens(timeout=timeout)
+                except TimeoutError as e:
+                    # the client's answer is gone: CANCEL so the engine
+                    # retires the slot instead of decoding to max_new
+                    stream.cancel()
+                    _profiler.bump_counter("gateway_shed_dispatch")
+                    _profiler.bump_counter("gateway_tenant_shed_"
+                                           + _tenant_slug(tenant))
+                    self._send_json(504, {"error": str(e),
+                                          "reason": "deadline",
+                                          "request_id": rid})
+                    return 504, "deadline", None
+                self._send_json(200, {
+                    "request_id": rid,
+                    "tokens": toks,
+                    "finish_reason": stream.finish_reason,
+                })
+                return 200, None, len(toks)
+            return self._stream_sse(stream, tenant, rid, timeout)
+
+        def _stream_sse(self, stream, tenant, rid, timeout):
+            """Chunked SSE: headers now, one data event per token as the
+            engine emits it, a final done event carrying finish_reason.
+            Errors after headers ride an in-band ``{"error": ...}``
+            event (the 200 is already on the wire)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("X-Request-Id", rid)
+            self.end_headers()
+            sent = 0
+            first_tok_ms = None
+            t0 = time.monotonic()
+            try:
+                for tok in stream.stream_tokens(timeout=timeout):
+                    if first_tok_ms is None:
+                        first_tok_ms = (time.monotonic() - t0) * 1e3
+                        _profiler.bump_histogram("gateway_ttft_ms",
+                                                 first_tok_ms)
+                    self._chunk('data: {"token": %d}\n\n' % tok)
+                    sent += 1
+                    _profiler.bump_counter("gateway_stream_tokens")
+            except TimeoutError:
+                stream.cancel()  # free the decode slot — see above
+                _profiler.bump_counter("gateway_shed_dispatch")
+                _profiler.bump_counter("gateway_tenant_shed_"
+                                       + _tenant_slug(tenant))
+                self._chunk('data: %s\n\n' % json.dumps(
+                    {"error": "deadline", "request_id": rid}
+                ))
+                self._chunk_end()
+                return 504, "deadline", sent
+            except OSError:
+                # client went away mid-stream: nothing left to write to,
+                # and nobody left to decode for
+                stream.cancel()
+                raise
+            except Exception as e:  # noqa: BLE001
+                # the 200 + chunked framing is already on the wire: ANY
+                # stream failure (the engine fails streams with the
+                # original exception type, not just ServingError) must
+                # ride an in-band error event — a late _send_json(500)
+                # would inject a raw status line into the chunked body
+                self._chunk('data: %s\n\n' % json.dumps(
+                    {"error": str(e) or repr(e), "request_id": rid}
+                ))
+                self._chunk_end()
+                return 500, "stream_error", sent
+            self._chunk('data: %s\n\n' % json.dumps(
+                {"done": True, "finish_reason": stream.finish_reason,
+                 "tokens": sent, "request_id": rid}, sort_keys=True,
+            ))
+            self._chunk_end()
+            return 200, None, sent
+
+        def _chunk(self, text):
+            data = text.encode("utf-8")
+            self.wfile.write(b"%x\r\n" % len(data))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+
+        def _chunk_end(self):
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+    return _Handler

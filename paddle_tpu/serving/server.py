@@ -257,19 +257,24 @@ class InferenceServer(object):
             outs = self.ladder.unpad_outputs(outs, req.seq_plan)
         return outs
 
-    def generate(self, prompt_ids, max_new_tokens=None, eos_id=None):
+    def generate(self, prompt_ids, max_new_tokens=None, eos_id=None,
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
         """Autoregressive completion through the attached DecodeEngine:
         returns a ``GenerationStream`` — iterate it for tokens as they
         are generated, or block on ``.tokens()`` / ``.result()``. The
         request joins the engine's continuous decode batch (admitted via
-        prefill into a KV-cache slot mid-flight; never recompiles)."""
+        prefill into a KV-cache slot mid-flight; never recompiles).
+        Sampling knobs are per-request, host-side over the fetched
+        logits (``decode.sample_token``): greedy is the default, a
+        seeded sampling request replays deterministically."""
         if self._decode_engine is None:
             raise ServingError(
                 "no decode engine attached: construct the server with "
                 "decode_engine=DecodeEngine(cfg, ...) to serve generation"
             )
         return self._decode_engine.generate(
-            prompt_ids, max_new_tokens=max_new_tokens, eos_id=eos_id
+            prompt_ids, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
         )
 
     def _seq_align(self, inputs):

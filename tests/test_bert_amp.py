@@ -1,6 +1,18 @@
 """BERT bf16 AMP build (the bench_bert.py path): the AMP rewrite must
 compose with the attention/FFN/layer-norm stack and train finite with a
-decreasing loss (BASELINE metric 2 runs this graph on the MXU)."""
+decreasing loss (BASELINE metric 2 runs this graph on the MXU).
+
+Triage note (PR 9): this test failed tier-1 for several PRs with
+losses[-1] ~ 0.722 > losses[0] ~ 0.692 at 6 steps. Measured: the AMP
+trajectory tracks the pure-fp32 build step-for-step (amp
+0.6923/1.3943/0.7856/0.5486/0.6896/0.7220... vs fp32
+0.6913/1.4082/0.7929/0.5492/0.6914/0.7269...), i.e. the bf16 rewrite is
+numerically faithful and the failure was TRAINING DYNAMICS — Adam at
+lr=1e-3 on this tiny config overshoots at step 2 and oscillates, and
+even the fp32 baseline fails a 6-step first-vs-last check. Both
+trajectories descend decisively by step 12 (amp 0.4781, fp32 0.4827;
+deterministic — fixed graph seed, fixed feed, single-threaded CPU XLA),
+so the assert now runs 12 steps instead of weakening the bound."""
 
 import numpy as np
 
@@ -27,7 +39,7 @@ def test_bert_classifier_amp_trains():
         "label": rs.randint(0, 2, (N, 1)).astype("int64"),
     }
     losses = []
-    for _ in range(6):
+    for _ in range(12):
         (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
         losses.append(float(np.asarray(lv).ravel()[0]))
     assert all(np.isfinite(losses)), losses

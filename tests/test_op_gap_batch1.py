@@ -183,7 +183,16 @@ class TestMaxPool2dWithIndex(OpTest):
         self.check_output()
 
     def test_grad(self):
-        self.check_grad(["X"], "Out")
+        # Triage note (PR 9, tier-1 failure since ~PR 6): the analytic
+        # grad is EXACT — 1/54 (the objective means over 2*3*3*3 outputs)
+        # at every window argmax, 0 elsewhere — but the numeric side
+        # evaluates that mean in fp32, where the objective's ~4e-7
+        # quantization divided by 2*delta=0.01 leaves ~4e-5 absolute FD
+        # noise: measured max relative error 0.0061 against the 0.005
+        # default. Same tolerance the grad-sweep uses for pooling ops
+        # (tol=0.02); the argmax itself can't flip (values spaced 0.05
+        # >> delta).
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
 
 
 class TestUnpool(OpTest):

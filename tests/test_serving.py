@@ -412,17 +412,26 @@ def test_config_engine_noops_warn_once_with_tpu_equivalent():
 def test_serving_load_probe_acceptance():
     """Dynamic batching >= 2x serial predictor.run at 8 concurrent
     clients, batch-fill >= 0.5, bucket-plan hit rate 100%, and ZERO
-    recompiles after warmup — the fast subset of
-    tools/serving_load_probe.py run in-process."""
-    import serving_load_probe as probe
+    recompiles after warmup — tools/serving_load_probe.py --fast.
 
-    result = probe.run_probe(
-        clients=8, requests_per_client=15, serial_requests=30, rounds=2
+    Decode-probe retry policy (the speedup bar flaked once under a
+    contended tier-1 run): the probe runs in a subprocess via the
+    shared conftest helper, and a throughput-ONLY miss (every failure
+    names 'speedup') earns exactly one retry — box load compresses
+    throughput but cannot corrupt outputs, bucket hits, or the
+    recompile count, so correctness misses fail immediately."""
+    from conftest import run_probe_subprocess
+
+    p, report = run_probe_subprocess("serving_load_probe.py",
+                                     retry_prefix="speedup")
+    assert p.returncode == 0, "probe failed:\n%s\n%s" % (
+        p.stdout[-3000:], p.stderr[-2000:]
     )
-    assert result["speedup"] >= 2.0, result
-    assert result["batch_fill_ratio"] >= 0.5, result
-    assert result["bucket_hit_rate"] == 1.0, result
-    assert result["recompiles_after_warmup"] == 0, result
+    assert "PROBE PASS" in p.stdout
+    assert report["speedup"] >= 2.0, report
+    assert report["batch_fill_ratio"] >= 0.5, report
+    assert report["bucket_hit_rate"] == 1.0, report
+    assert report["recompiles_after_warmup"] == 0, report
 
 
 class _EchoPredictor(object):

@@ -9,8 +9,8 @@ and (2) mentioned in README.md, so the Observability / Fault-tolerance /
 Serving quickstarts can't drift behind the code. The reverse direction
 is linted too: a registered knob nobody reads is a dead knob. (Scope
 grew obs_* -> +dist_*/elastic_* with the elastic-resize PR,
--> +serving_* with the compile-telemetry PR, and -> +decode_* with the
-KV-cache decode runtime.)
+-> +serving_* with the compile-telemetry PR, -> +decode_* with the
+KV-cache decode runtime, and -> +gateway_* with the HTTP gateway.)
 
 A second pass lints METRIC names: every counter / histogram /
 scrape-time gauge the registry can render (every literal name at a
@@ -33,7 +33,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the linted knob families (prefix with trailing underscore)
-PREFIXES = ("obs_", "dist_", "elastic_", "serving_", "decode_")
+PREFIXES = ("obs_", "dist_", "elastic_", "serving_", "decode_",
+            "gateway_")
 _NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
 
 # the spellings a knob is consumed under: the env-bridge name and the
