@@ -22,6 +22,7 @@ Quickstart::
     mgr.close()
 """
 
+from . import modeldir  # noqa: F401
 from .manager import (  # noqa: F401
     CheckpointError,
     CheckpointManager,
@@ -36,6 +37,7 @@ from .preempt import (  # noqa: F401
 )
 
 __all__ = [
+    "modeldir",
     "CheckpointManager",
     "CheckpointError",
     "ChecksumError",

@@ -205,10 +205,12 @@ class _Log(object):
         return rec
 
 
-def load_events(workdir):
+def load_events(workdir, filename=SUPERVISOR_LOG):
     """Parse workdir/supervisor.log back into a list of event dicts
-    (the probe's MTTR source)."""
-    path = os.path.join(workdir, SUPERVISOR_LOG)
+    (the probe's MTTR source). ``filename`` selects another log in the
+    same JSONL dialect (the serving fleet's ``fleet.log`` reuses this
+    parser and the ``_Log`` writer)."""
+    path = os.path.join(workdir, filename)
     events = []
     try:
         with open(path) as f:

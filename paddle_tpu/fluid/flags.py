@@ -105,6 +105,45 @@ _DEFAULTS = {
     "gateway_admit_timeout_ms": 100.0,
     "gateway_drain_timeout_s": 30.0,
     "gateway_access_log": "",
+    # serving fleet control plane (paddle_tpu/serving/fleet.py): a
+    # FleetController supervises N replica processes (each an
+    # InferenceServer+Gateway) behind one Router. The load-driven
+    # autoscaler scrapes each replica's /metrics every
+    # fleet_scale_interval_s and scales the pool between
+    # fleet_min_replicas and fleet_max_replicas: mean queue depth >=
+    # fleet_queue_high (or any admission shed, or — when
+    # fleet_latency_high_ms > 0 — p95 latency over it) sustained for
+    # fleet_scale_up_ticks consecutive scrapes adds a replica; queue
+    # depth <= fleet_queue_low for fleet_scale_down_ticks scrapes
+    # (hysteresis, so the pool doesn't flap) drains one. A replica must
+    # turn ready within fleet_replica_ready_timeout_s of spawn; crashed
+    # replicas are replaced with fleet_restart_backoff_s exponential
+    # backoff under a fleet_max_replica_restarts budget; scale-down and
+    # rollout drains SIGTERM the replica (gateway graceful drain) and
+    # SIGKILL only after fleet_drain_grace_s.
+    "fleet_min_replicas": 1,
+    "fleet_max_replicas": 4,
+    "fleet_scale_interval_s": 2.0,
+    "fleet_queue_high": 8.0,
+    "fleet_queue_low": 1.0,
+    "fleet_latency_high_ms": 0.0,
+    "fleet_scale_up_ticks": 2,
+    "fleet_scale_down_ticks": 5,
+    "fleet_replica_ready_timeout_s": 180.0,
+    "fleet_restart_backoff_s": 0.5,
+    "fleet_max_replica_restarts": 10,
+    "fleet_drain_grace_s": 15.0,
+    # replica router (paddle_tpu/serving/router.py): the fleet's single
+    # front door. router_port binds the listener (0 = ephemeral); a
+    # health thread polls every backend's /readyz each
+    # router_health_interval_s; idempotent /v1/infer requests that hit a
+    # dead/draining replica are retried on another backend up to
+    # router_retries times; router_backend_timeout_s bounds each proxied
+    # backend connect/read.
+    "router_port": 0,
+    "router_health_interval_s": 0.5,
+    "router_retries": 2,
+    "router_backend_timeout_s": 60.0,
     # checkpoint manager (paddle_tpu/checkpoint): trainer-integrated save
     # cadence (0 = off), retention (newest keep_max steps survive GC,
     # every keep_every_n_steps-th step is pinned forever), writer-queue

@@ -15,6 +15,13 @@ to the same file, and its newest snapshot reflects the life that
 mattered (counters are process-local, so they restart from zero with the
 process — the report keeps each life's final word, not a fake sum across
 lives).
+
+The serving fleet gets the same treatment (``fleet_report``): the
+controller's ``fleet.log`` narrative (scale/rollout/crash events, the
+ready-replica count over time) merges with each replica process's
+snapshot dir (``obs/replica_<id>/rank_0.jsonl``) into
+``fleet_report.json`` — per-replica request tallies beside the
+control-plane story.
 """
 
 from __future__ import annotations
@@ -28,13 +35,19 @@ from . import registry as _registry
 
 __all__ = [
     "GANG_REPORT",
+    "FLEET_REPORT",
     "read_rank_snapshots",
+    "read_replica_snapshots",
     "gang_report",
     "write_gang_report",
+    "fleet_report",
+    "write_fleet_report",
 ]
 
 GANG_REPORT = "gang_report.json"
+FLEET_REPORT = "fleet_report.json"
 _RANK_FILE = re.compile(r"^rank_(\d+)\.jsonl$")
+_REPLICA_DIR = re.compile(r"^replica_(\d+)$")
 
 # the counters/histograms worth surfacing per rank without dumping the
 # whole registry into the report (the full detail stays in the JSONL)
@@ -232,8 +245,184 @@ def write_gang_report(workdir, obs_dir=None, path=None):
     path."""
     report = gang_report(workdir, obs_dir=obs_dir)
     path = path or os.path.join(str(workdir), GANG_REPORT)
+    return _write_json(report, path)
+
+
+def _write_json(report, path):
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(report, f, sort_keys=True, indent=1)
     os.replace(tmp, path)
     return path
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet merge: replica snapshots + fleet.log -> fleet_report.json
+# ---------------------------------------------------------------------------
+
+# the per-replica counters worth surfacing in the fleet roll-up (the
+# request-path tallies an operator reads first; full detail stays in
+# each replica's JSONL snapshots)
+_REPLICA_COUNTERS = (
+    "gateway_requests",
+    "serving_requests",
+    "serving_completed",
+    "serving_batches",
+    "serving_shed_overload",
+    "serving_shed_deadline",
+    "gateway_shed_admission",
+    "gateway_shed_dispatch",
+)
+
+
+def read_replica_snapshots(obs_root):
+    """{replica_id: newest snapshot dict} from ``replica_<id>/`` dirs
+    under ``obs_root`` (each replica process writes the standard
+    per-rank JSONL snapshots into its own directory — a replica has no
+    gang rank, so its file is ``rank_0.jsonl``)."""
+    out = {}
+    try:
+        names = os.listdir(str(obs_root))
+    except OSError:
+        return out
+    for name in names:
+        m = _REPLICA_DIR.match(name)
+        if not m:
+            continue
+        snaps = read_rank_snapshots(os.path.join(str(obs_root), name))
+        if snaps:
+            # newest across whatever ranks the dir holds (normally
+            # exactly rank 0)
+            newest = max(snaps.values(),
+                         key=lambda s: s.get("ts_mono") or 0)
+            out[int(m.group(1))] = newest
+    return out
+
+
+def _last_fleet_run(events):
+    """The slice belonging to the newest controller run — anchored on
+    its ``fleet_boot`` event (fleet.log appends across runs in a reused
+    workdir, like supervisor.log)."""
+    start = 0
+    for i, e in enumerate(events):
+        if e.get("event") == "fleet_boot":
+            start = i
+    return events[start:]
+
+
+def _replica_summary(snap):
+    counters = snap.get("counters", {})
+    compiles = snap.get("compiles") or {}
+    hists = snap.get("histograms", {})
+    return {
+        "snapshot_ts": snap.get("ts"),
+        "pid": snap.get("pid"),
+        "counters": {
+            k: counters[k] for k in _REPLICA_COUNTERS if k in counters
+        },
+        "latency_ms": hists.get("serving_latency_ms"),
+        "steady_recompiles": int(compiles.get("steady_recompiles", 0)),
+    }
+
+
+def fleet_report(workdir, obs_root=None):
+    """Merge ``workdir/fleet.log`` + per-replica snapshot dirs (default
+    ``workdir/obs``) into one report: the ready-replica count over
+    time, every scale/rollout/crash event, and per-replica request
+    tallies — the serving-side twin of ``gang_report``."""
+    from ..distributed import supervisor as _sup
+
+    # the log filename is serving.fleet.FLEET_LOG; spelled literally so
+    # a report-only consumer (post-mortem tooling) never pays the whole
+    # serving-package import for one string constant
+    events = _last_fleet_run(
+        _sup.load_events(str(workdir), filename="fleet.log")
+    )
+    obs_root = obs_root or os.path.join(str(workdir), "obs")
+    snaps = read_replica_snapshots(obs_root)
+    # scope the snapshots to THIS run, like the events: a reused
+    # workdir keeps dead runs' replica_<id> dirs on disk, and replica
+    # ids restart per run — without the filter a previous run's
+    # replica would inflate per_replica and the fleet-wide
+    # steady_recompiles sum the probes gate on
+    spawned = {
+        e.get("replica") for e in events
+        if e.get("event") == "replica_spawn"
+    }
+    if spawned:
+        snaps = {r: s for r, s in snaps.items() if r in spawned}
+    # ready-replica count over time: every lifecycle event that moves
+    # the count carries ready_replicas, so the timeline is exact
+    timeline = [
+        {
+            "ts": e.get("ts"),
+            "ts_mono": e.get("ts_mono"),
+            "event": e.get("event"),
+            "ready_replicas": e.get("ready_replicas"),
+        }
+        for e in events if e.get("ready_replicas") is not None
+    ]
+    scale_events = [
+        {
+            "event": e["event"],
+            "from_replicas": e.get("from_replicas"),
+            "to_replicas": e.get("to_replicas"),
+            "reason": e.get("reason"),
+            "ts": e.get("ts"),
+        }
+        for e in events if e.get("event") in ("scale_up", "scale_down")
+    ]
+    rollouts = [
+        {k: e.get(k) for k in ("event", "version", "from_version",
+                               "model_dir", "ms", "error", "ts")
+         if k in e}
+        for e in events
+        if str(e.get("event", "")).startswith("rollout_")
+    ]
+    boot = next((e for e in events if e.get("event") == "fleet_boot"), {})
+    version = boot.get("version")
+    for e in events:
+        if e.get("event") == "rollout_done":
+            version = e.get("version")
+    ready_ms = [
+        e["ready_ms"] for e in events
+        if e.get("event") == "replica_ready"
+        and e.get("ready_ms") is not None
+    ]
+    summaries = {str(r): _replica_summary(s) for r, s in snaps.items()}
+    return {
+        "schema_version": _registry.SCHEMA_VERSION,
+        "ts": time.time(),
+        "ts_mono": time.monotonic(),
+        "workdir": str(workdir),
+        "version": version,
+        "replicas_ready_final": (
+            timeline[-1]["ready_replicas"] if timeline else 0
+        ),
+        "replica_timeline": timeline,
+        "scale_events": scale_events,
+        "scale_ups": sum(1 for e in scale_events
+                         if e["event"] == "scale_up"),
+        "scale_downs": sum(1 for e in scale_events
+                           if e["event"] == "scale_down"),
+        "rollouts": rollouts,
+        "crashes": sum(1 for e in events
+                       if e.get("event") == "replica_crash"),
+        "hangs": sum(1 for e in events
+                     if e.get("event") == "replica_hang"),
+        "replica_ready_ms": _registry.percentiles(ready_ms,
+                                                  points=(50, 99)),
+        "replicas_reporting": sorted(snaps),
+        "per_replica": summaries,
+        "steady_recompiles": sum(
+            s["steady_recompiles"] for s in summaries.values()
+        ),
+    }
+
+
+def write_fleet_report(workdir, obs_root=None, path=None):
+    """Emit ``fleet_report.json`` under ``workdir`` (atomic tmp+rename,
+    like the gang report). Returns the path."""
+    report = fleet_report(workdir, obs_root=obs_root)
+    path = path or os.path.join(str(workdir), FLEET_REPORT)
+    return _write_json(report, path)

@@ -19,6 +19,13 @@ the batcher), ``POST /v1/generate`` (chunked SSE token streaming),
 limits, inflight quotas, interactive/batch priority, faithful 429/504
 backpressure mapping, and SIGTERM graceful drain.
 
+Above one process sits the fleet control plane: ``FleetController``
+(serving/fleet.py) spawns and supervises N gateway-fronted replica
+processes behind a health-checked least-inflight ``Router``
+(serving/router.py), autoscales the pool on scraped queue-depth /
+shed / latency pressure, and rolls new model versions with zero
+downtime (warm the new replicas, flip the router, drain the old).
+
 Quickstart::
 
     from paddle_tpu import inference, serving
@@ -47,14 +54,19 @@ from .decode import (  # noqa: F401
     GenerationStream,
     sample_token,
 )
+from .fleet import AutoscalerPolicy, FleetController  # noqa: F401
 from .gateway import Gateway  # noqa: F401
 from .metrics import ServingStats, snapshot_stats  # noqa: F401
 from .pool import PredictorPool  # noqa: F401
+from .router import Router  # noqa: F401
 from .server import InferenceServer  # noqa: F401
 
 __all__ = [
     "InferenceServer",
     "Gateway",
+    "Router",
+    "FleetController",
+    "AutoscalerPolicy",
     "DecodeEngine",
     "sample_token",
     "DecodeSession",

@@ -29,6 +29,7 @@ from collections import deque
 import numpy as np
 
 from ..fluid import profiler as _profiler
+from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
 
 __all__ = [
@@ -135,6 +136,16 @@ class MicroBatcher(object):
         ]
         for t in self._workers:
             t.start()
+        # live admission-queue depth, owned by the BATCHER (the thing
+        # that owns the queue), not by whoever wrapped it: a standalone
+        # MicroBatcher publishes the same autoscaler signal the decode
+        # engine's decode_queue_depth gauge provides. Registration
+        # replaces any predecessor's (gauge-succession semantics);
+        # stop() unregisters ownership-scoped so a stopping batcher
+        # never tears down a live successor's gauge.
+        self._queue_gauge = lambda b=self: b.queue_len
+        _obs_registry.register_gauge("serving_queue_depth",
+                                     self._queue_gauge)
 
     # -- client side ---------------------------------------------------------
     def submit(self, inputs, deadline_ms=None):
@@ -304,6 +315,10 @@ class MicroBatcher(object):
     def stop(self, join_timeout=5.0):
         """Stop workers; queued-but-undispatched requests complete with
         ServingError so no caller blocks forever."""
+        if self._queue_gauge is not None:
+            _obs_registry.unregister_gauge("serving_queue_depth",
+                                           self._queue_gauge)
+            self._queue_gauge = None
         with self._cond:
             self._stop = True
             pending = list(self._q)

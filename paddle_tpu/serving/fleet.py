@@ -1,0 +1,1014 @@
+"""Serving fleet control plane: replica pool + autoscaler + rollout.
+
+The PR 8/9 stack serves one process well; "millions of users" is a
+*pool* of replicas behind one address. This module is the control loop
+that composes the existing primitives — the supervisor's
+heartbeat/backoff/spawn machinery (PR 4), the gateway's
+``/readyz``-flip graceful drain (PR 9), the metrics registry's
+Prometheus surface (PR 5), and the strict compile gate (PR 7) — into a
+fleet:
+
+- **FleetController** spawns and supervises N replica processes (each
+  ``python -m paddle_tpu.serving.replica``: an InferenceServer +
+  Gateway with its own metrics exporter port), watching process exits,
+  heartbeat staleness (``distributed.supervisor`` heartbeat files) and
+  a per-replica ready timeout. Crashed replicas are replaced with
+  exponential backoff under ``FLAGS_fleet_max_replica_restarts``;
+  drains (scale-down, rollout) SIGTERM the replica so its gateway
+  completes every in-flight request before the process exits.
+- A **Router** (serving/router.py) fronts the pool: the controller
+  adds a replica the moment its ``/readyz`` first answers 200 and
+  removes it before draining, so clients never see a dead pick beyond
+  one transparent retry.
+- The **autoscaler** scrapes every ready replica's ``/metrics``
+  (admission queue depth ``serving_queue_depth`` +
+  ``decode_queue_depth``, shed counters, ``serving_latency_ms`` p95)
+  each ``FLAGS_fleet_scale_interval_s`` and feeds
+  ``AutoscalerPolicy``: sustained pressure adds a replica, sustained
+  idle (longer streak — hysteresis) drains one, clamped to
+  ``[FLAGS_fleet_min_replicas, FLAGS_fleet_max_replicas]``.
+- ``deploy(model_dir)`` is a **zero-downtime versioned rollout**:
+  spawn the new version's replicas beside the old ones, wait until
+  every one is warm (the replica warms its bucket ladder before its
+  gateway starts, under the armed strict compile gate), atomically
+  flip the router's active version, then gracefully drain the old
+  version. ``model_dir`` may be a ``checkpoint.modeldir`` repository
+  (the ``LATEST`` pointer resolves) or a plain export dir.
+
+Structured JSONL events land in ``workdir/fleet.log`` (the supervisor
+log dialect: ``schema_version``/``ts``/``ts_mono``), and
+``observability.aggregate.write_fleet_report`` merges them with the
+per-replica snapshot files into ``workdir/fleet_report.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from ..distributed import supervisor as _supervisor
+from ..fluid import flags as _flags
+from ..fluid import profiler as _profiler
+from ..observability import registry as _obs_registry
+
+__all__ = [
+    "FLEET_LOG",
+    "AutoscalerPolicy",
+    "FleetController",
+    "load_events",
+]
+
+FLEET_LOG = "fleet.log"
+
+
+def _flag(name, override):
+    return override if override is not None else _flags.get_flag(name)
+
+
+def load_events(workdir):
+    """Parse ``workdir/fleet.log`` back into a list of event dicts."""
+    return _supervisor.load_events(workdir, filename=FLEET_LOG)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy (pure decision logic — unit-testable against a fake
+# metrics source, independent of processes and sockets)
+# ---------------------------------------------------------------------------
+class AutoscalerPolicy(object):
+    """Streak-based scaling decisions with hysteresis.
+
+    ``observe(samples, target)`` consumes one scrape round — a list of
+    per-replica dicts ``{"queue_depth", "shed_delta", "p95_ms"}`` — and
+    returns ``(new_target, reason|None)``:
+
+    - mean queue depth >= ``queue_high``, ANY admission shed since the
+      last round, or (when ``latency_high_ms`` > 0) mean p95 latency
+      over it, counts as a *pressured* round; ``up_ticks`` consecutive
+      pressured rounds scale up by one.
+    - mean queue depth <= ``queue_low`` with zero sheds counts as an
+      *idle* round; ``down_ticks`` consecutive idle rounds scale down
+      by one. ``down_ticks`` should be the larger streak — that
+      asymmetry IS the anti-flap hysteresis, and the band between
+      ``queue_low`` and ``queue_high`` resets neither streak.
+    - the returned target is always clamped to ``[min, max]``; an
+      empty sample round (no ready replicas — nothing trustworthy to
+      decide on) resets both streaks.
+    """
+
+    def __init__(self, min_replicas=None, max_replicas=None,
+                 queue_high=None, queue_low=None, up_ticks=None,
+                 down_ticks=None, latency_high_ms=None):
+        self.min_replicas = max(1, int(_flag("fleet_min_replicas",
+                                             min_replicas)))
+        self.max_replicas = max(self.min_replicas,
+                                int(_flag("fleet_max_replicas",
+                                          max_replicas)))
+        self.queue_high = float(_flag("fleet_queue_high", queue_high))
+        self.queue_low = float(_flag("fleet_queue_low", queue_low))
+        self.up_ticks = max(1, int(_flag("fleet_scale_up_ticks", up_ticks)))
+        self.down_ticks = max(1, int(_flag("fleet_scale_down_ticks",
+                                           down_ticks)))
+        self.latency_high_ms = float(_flag("fleet_latency_high_ms",
+                                           latency_high_ms))
+        self._high_streak = 0
+        self._low_streak = 0
+
+    def _clamp(self, n):
+        return max(self.min_replicas, min(self.max_replicas, int(n)))
+
+    def observe(self, samples, target):
+        target = self._clamp(target)
+        if not samples:
+            self._high_streak = self._low_streak = 0
+            return target, None
+        qs = [float(s.get("queue_depth") or 0.0) for s in samples]
+        mean_q = sum(qs) / len(qs)
+        sheds = sum(float(s.get("shed_delta") or 0.0) for s in samples)
+        p95s = [float(s["p95_ms"]) for s in samples
+                if s.get("p95_ms") is not None]
+        mean_p95 = (sum(p95s) / len(p95s)) if p95s else 0.0
+        pressured = (
+            mean_q >= self.queue_high
+            or sheds > 0
+            or (self.latency_high_ms > 0 and mean_p95 >= self.latency_high_ms)
+        )
+        idle = mean_q <= self.queue_low and sheds == 0
+        if pressured:
+            self._high_streak += 1
+            self._low_streak = 0
+        elif idle:
+            self._low_streak += 1
+            self._high_streak = 0
+        # the middle band holds both streaks where they are: a noisy
+        # sample between the thresholds neither arms nor disarms
+        if self._high_streak >= self.up_ticks and target < self.max_replicas:
+            self._high_streak = self._low_streak = 0
+            return target + 1, "queue_pressure"
+        if self._low_streak >= self.down_ticks and target > self.min_replicas:
+            self._low_streak = 0
+            return target - 1, "idle"
+        return target, None
+
+
+# ---------------------------------------------------------------------------
+# replica bookkeeping
+# ---------------------------------------------------------------------------
+class _Replica(object):
+    __slots__ = (
+        "id", "version", "model_dir", "proc", "endpoint_file", "hb_file",
+        "obs_dir", "state", "endpoint", "spawn_t", "drain_t", "shed_seen",
+        "hb_seen",
+    )
+
+    def __init__(self, rid, version, model_dir, proc, endpoint_file,
+                 hb_file, obs_dir):
+        self.id = int(rid)
+        self.version = int(version)
+        self.model_dir = str(model_dir)
+        self.proc = proc
+        self.endpoint_file = endpoint_file
+        self.hb_file = hb_file
+        self.obs_dir = obs_dir
+        self.state = "starting"  # starting|ready|draining|exited
+        self.endpoint = None     # {"gateway_port", "metrics_port", ...}
+        self.spawn_t = time.monotonic()
+        self.drain_t = None
+        self.shed_seen = 0.0     # autoscaler shed-delta bookkeeping
+        self.hb_seen = None      # (mtime, first-observed monotonic time)
+
+    @property
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+    def info(self):
+        ep = self.endpoint or {}
+        return {
+            "id": self.id,
+            "version": self.version,
+            "state": self.state,
+            "pid": self.pid,
+            "gateway_port": ep.get("gateway_port"),
+            "metrics_port": ep.get("metrics_port"),
+            "model_dir": self.model_dir,
+        }
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _resolve_model(model_dir):
+    """(model_dir, declared_version|None): a ``checkpoint.modeldir``
+    repository resolves through ``modeldir.latest()``, a published
+    versioned dir reads its manifest, a plain export dir is itself.
+    A repo is recognized by its LATEST pointer OR by published ``v_*``
+    dirs — a publish torn between the version landing and the pointer
+    flip must still resolve (latest() falls back to the highest
+    published version), not be mistaken for an export dir."""
+    from ..checkpoint import modeldir as _modeldir
+
+    model_dir = str(model_dir)
+    if (os.path.isfile(os.path.join(model_dir, _modeldir.LATEST))
+            or _modeldir.versions(model_dir)):
+        version, path = _modeldir.latest(model_dir)
+        if path is None:
+            raise ValueError("model repo %r has no published version"
+                             % model_dir)
+        return path, version
+    manifest = _modeldir.read_manifest(model_dir)
+    if manifest is not None:
+        return model_dir, int(manifest.get("version", 0)) or None
+    return model_dir, None
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+class FleetController(object):
+    """Spawns, supervises, scales, and rolls a pool of serving
+    replicas behind one Router.
+
+    Usage::
+
+        ctrl = serving.FleetController(
+            model_dir="models/repo",      # modeldir repo or export dir
+            workdir="fleet_work",
+            replicas=2,
+        ).start(wait_ready_s=120)
+        print(ctrl.router.url("/readyz"))   # the one address
+        ...
+        ctrl.deploy("models/export_v2")     # zero-downtime rollout
+        ctrl.stop()
+
+    ``replica_cmd`` (tests) overrides the spawned argv:
+    ``replica_cmd(rid, version, model_dir, endpoint_file) -> argv``.
+    ``replica_env`` adds environment (e.g. ``FLAGS_serving_*`` policy
+    or ``FLAGS_serving_strict_compiles`` for the hard zero-recompile
+    bar) to every replica.
+    """
+
+    def __init__(self, model_dir, workdir, replicas=None,
+                 min_replicas=None, max_replicas=None, policy=None,
+                 autoscale=True, replica_env=None, replica_args=(),
+                 replica_cmd=None, router=None, router_port=None,
+                 host="127.0.0.1", scale_interval_s=None,
+                 ready_timeout_s=None, drain_grace_s=None,
+                 restart_backoff_s=None, max_replica_restarts=None,
+                 heartbeat_timeout_s=None, poll_s=0.1, seed=None,
+                 echo_events=False):
+        self.workdir = str(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.model_dir, declared = _resolve_model(model_dir)
+        self.version = declared if declared is not None else 1
+        self.policy = policy or AutoscalerPolicy(
+            min_replicas=min_replicas, max_replicas=max_replicas
+        )
+        self.autoscale = bool(autoscale)
+        self.target = int(
+            self.policy.min_replicas if replicas is None else replicas
+        )
+        self.target = self.policy._clamp(self.target)
+        self.scale_interval_s = float(
+            _flag("fleet_scale_interval_s", scale_interval_s)
+        )
+        self.ready_timeout_s = float(
+            _flag("fleet_replica_ready_timeout_s", ready_timeout_s)
+        )
+        self.drain_grace_s = float(_flag("fleet_drain_grace_s",
+                                         drain_grace_s))
+        self.restart_backoff_s = float(
+            _flag("fleet_restart_backoff_s", restart_backoff_s)
+        )
+        self.max_replica_restarts = int(
+            _flag("fleet_max_replica_restarts", max_replica_restarts)
+        )
+        # replica heartbeats ride the supervisor's worker-side protocol
+        # (PADDLE_TPU_HEARTBEAT_FILE + WorkerHeartbeat): the staleness
+        # bound must clear the beat throttle, same as the supervisor's
+        self.heartbeat_timeout_s = max(
+            float(_flag("dist_heartbeat_timeout_s", heartbeat_timeout_s)),
+            2.0 * float(_flags.get_flag("dist_heartbeat_interval_s", 0.5)),
+        )
+        self.host = host
+        self.poll_s = float(poll_s)
+        self.replica_env = dict(replica_env or {})
+        self.replica_args = list(replica_args)
+        self._replica_cmd = replica_cmd
+        self._owns_router = router is None
+        from .router import Router
+
+        self.router = router or Router(port=router_port, host=host)
+        self._hb_dir = os.path.join(self.workdir, "heartbeats")
+        self._ep_dir = os.path.join(self.workdir, "endpoints")
+        self._log_dir = os.path.join(self.workdir, "logs")
+        self._obs_root = os.path.join(self.workdir, "obs")
+        for d in (self._hb_dir, self._ep_dir, self._log_dir,
+                  self._obs_root):
+            os.makedirs(d, exist_ok=True)
+        self.log = _supervisor._Log(
+            os.path.join(self.workdir, FLEET_LOG), echo=echo_events
+        )
+        self._rng = random.Random(seed)
+        self._replicas = {}  # rid -> _Replica
+        self._next_rid = 0
+        self._lock = threading.RLock()
+        self._stop_evt = threading.Event()
+        self._tick_thread = None
+        self._started = False
+        self._rollout = False
+        self.crashes = 0
+        self._gaveup = False
+        self._backoff_until = 0.0
+        self._next_scale_t = 0.0
+        self._crash_deficit = 0
+        self._pool_crashes = 0  # serving-version crashes (budget/backoff)
+        self._last_report_t = 0.0
+        self._last_tick_err = 0.0
+        self._ready_gauge = None
+        self._target_gauge = None
+
+    # -- public ------------------------------------------------------------
+    def start(self, wait_ready_s=None):
+        if self._started:
+            raise RuntimeError("fleet controller already started")
+        if self._owns_router:
+            self.router.start()
+        # pin routing to the serving version from the FIRST moment: a
+        # router left on "route all" (None) would serve live traffic
+        # from still-warming new-version replicas the instant
+        # _check_ready adds them during the first deploy() — before the
+        # atomic flip, violating the rollout contract
+        self.router.set_active_version(self.version)
+        self.log.event(
+            "fleet_boot", target=self.target,
+            min_replicas=self.policy.min_replicas,
+            max_replicas=self.policy.max_replicas,
+            version=self.version, model_dir=self.model_dir,
+            router_port=self.router.port,
+        )
+        self._stop_evt.clear()
+        with self._lock:
+            for _ in range(self.target):
+                self._spawn(self.version, self.model_dir)
+        self._started = True
+        self._ready_gauge = lambda c=self: c.ready_count()
+        _obs_registry.register_gauge("fleet_replicas_ready",
+                                     self._ready_gauge)
+        self._target_gauge = lambda c=self: c.target
+        _obs_registry.register_gauge("fleet_replicas_target",
+                                     self._target_gauge)
+        self._tick_thread = threading.Thread(
+            target=self._run, name="fleet_control", daemon=True
+        )
+        self._tick_thread.start()
+        if wait_ready_s:
+            self.wait_ready(timeout=float(wait_ready_s))
+        return self
+
+    def ready_count(self, version=None):
+        with self._lock:
+            return sum(
+                1 for r in self._replicas.values()
+                if r.state == "ready"
+                and (version is None or r.version == version)
+            )
+
+    def replica_info(self):
+        with self._lock:
+            return [r.info() for r in self._replicas.values()
+                    if r.state != "exited"]
+
+    def wait_ready(self, count=None, timeout=120.0):
+        """Block until ``count`` (default: the current target) replicas
+        of the serving version are ready; raises TimeoutError."""
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            want = self.target if count is None else int(count)
+            if self.ready_count(version=self.version) >= want:
+                return self
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "fleet: %d/%d replicas ready after %.0fs"
+                    % (self.ready_count(version=self.version), want,
+                       timeout)
+                )
+            if self._gaveup:
+                raise RuntimeError(
+                    "fleet gave up replacing crashed replicas "
+                    "(%d crashes; see %s)"
+                    % (self.crashes,
+                       os.path.join(self.workdir, FLEET_LOG))
+                )
+            time.sleep(0.05)
+
+    def scale_to(self, n, reason="manual"):
+        """Set the replica target; the control loop reconciles (spawn
+        up, or graceful-drain down). Clamped to the policy bounds."""
+        with self._lock:
+            n = self.policy._clamp(n)
+            if n == self.target:
+                return self.target
+            old, self.target = self.target, n
+            event = "scale_up" if n > old else "scale_down"
+            _profiler.bump_counter(
+                "fleet_scale_ups" if n > old else "fleet_scale_downs"
+            )
+            self.log.event(
+                event, from_replicas=old, to_replicas=n, reason=reason,
+                ready_replicas=self._ready_locked(),
+            )
+        self._write_report()
+        return n
+
+    def deploy(self, model_dir, ready_timeout_s=None):
+        """Zero-downtime rollout to ``model_dir`` (repo or export dir):
+        spawn the new version beside the old, wait warm, flip the
+        router, drain the old. Returns the new version number."""
+        new_dir, declared = _resolve_model(model_dir)
+        with self._lock:
+            if not self._started:
+                raise RuntimeError("fleet controller is not started")
+            if self._rollout:
+                raise RuntimeError("a rollout is already in progress")
+            self._rollout = True
+            old_version = self.version
+            new_version = (
+                declared if declared is not None and declared > old_version
+                else old_version + 1
+            )
+            count = self.target
+        t0 = time.monotonic()
+        self.log.event(
+            "rollout_start", version=new_version, from_version=old_version,
+            model_dir=new_dir, replicas=count,
+        )
+        timeout = float(ready_timeout_s if ready_timeout_s is not None
+                        else self.ready_timeout_s)
+        new_ids = []
+        flipped = False
+        try:
+            with self._lock:
+                for _ in range(count):
+                    new_ids.append(self._spawn(new_version, new_dir).id)
+            deadline = time.monotonic() + timeout
+            while True:
+                with self._lock:
+                    states = [
+                        self._replicas[i].state for i in new_ids
+                        if i in self._replicas
+                    ]
+                ready = sum(1 for s in states if s == "ready")
+                if ready >= count:
+                    break
+                if len(states) < len(new_ids) or "exited" in states:
+                    raise RuntimeError(
+                        "a new-version replica died during rollout "
+                        "warmup (version %d)" % new_version
+                    )
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "rollout: %d/%d new replicas ready after %.0fs"
+                        % (ready, count, timeout)
+                    )
+                time.sleep(0.05)
+            self.log.event(
+                "rollout_ready", version=new_version,
+                ready_ms=round((time.monotonic() - t0) * 1e3, 1),
+            )
+            # the traffic flip: atomic in the router — new requests only
+            # ever see the new version from here on
+            self.router.set_active_version(new_version)
+            flipped = True
+            with self._lock:
+                self.version = new_version
+                self.model_dir = new_dir
+                old = [r for r in self._replicas.values()
+                       if r.version == old_version
+                       and r.state in ("starting", "ready")]
+                for r in old:
+                    self._begin_drain(r, reason="rollout")
+            drained = self._await_exits([r.id for r in old],
+                                        timeout=self.drain_grace_s + 30.0)
+            ms = (time.monotonic() - t0) * 1e3
+            _profiler.bump_counter("fleet_rollouts")
+            _profiler.bump_histogram("fleet_rollout_ms", ms)
+            self.log.event(
+                "rollout_done", version=new_version, ms=round(ms, 1),
+                drained=drained,
+                ready_replicas=self.ready_count(version=new_version),
+            )
+            self._write_report(force=True)
+            return new_version
+        except Exception as e:
+            if not flipped:
+                # abort: the old version keeps serving; kill the
+                # half-born new replicas outright (pre-flip, they
+                # never took traffic)
+                with self._lock:
+                    doomed = [
+                        self._replicas[i] for i in new_ids
+                        if i in self._replicas
+                        and self._replicas[i].state != "exited"
+                    ]
+                    for r in doomed:
+                        # expected exits: the still-running tick
+                        # thread must not book these kills as crashes
+                        # (backoff, restart budget), and they must
+                        # stop routing now
+                        self.router.remove_backend(r.id)
+                        r.state = "draining"
+                        r.drain_t = time.monotonic()
+                self._kill_and_reap(doomed)
+            # POST-flip failures (old-drain hiccup, a full disk under
+            # the event log) must NOT roll the new version back: the
+            # router is already pinned to it and the old pool is
+            # draining — killing the new replicas would be a full
+            # outage. The new version stays; leftovers reconcile.
+            try:
+                self.log.event("rollout_abort", version=new_version,
+                               flipped=flipped, error=str(e))
+            except Exception:
+                pass
+            raise
+        finally:
+            with self._lock:
+                self._rollout = False
+
+    def stop(self):
+        """Drain every replica gracefully, stop the control loop and
+        (owned) router, and leave a final fleet report."""
+        if not self._started:
+            return
+        self._started = False
+        self._stop_evt.set()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=10.0)
+            self._tick_thread = None
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.state in ("starting", "ready", "draining")]
+            for r in live:
+                if r.state != "draining":
+                    self._begin_drain(r, reason="fleet_stop")
+        self._await_exits([r.id for r in live],
+                          timeout=self.drain_grace_s + 30.0,
+                          reap=True)
+        # stragglers past the grace: the drain watchdog is dead with
+        # the tick thread, so finish its job here
+        with self._lock:
+            stragglers = [r for r in self._replicas.values()
+                          if r.state != "exited"]
+        self._kill_and_reap(stragglers)
+        if self._owns_router:
+            self.router.stop()
+        if self._ready_gauge is not None:
+            _obs_registry.unregister_gauge("fleet_replicas_ready",
+                                           self._ready_gauge)
+            self._ready_gauge = None
+        if self._target_gauge is not None:
+            _obs_registry.unregister_gauge("fleet_replicas_target",
+                                           self._target_gauge)
+            self._target_gauge = None
+        self.log.event("fleet_stop", crashes=self.crashes)
+        self._write_report(force=True)
+
+    def __enter__(self):
+        return self if self._started else self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- spawn / drain / kill ----------------------------------------------
+    def _cmd(self, rid, version, model_dir, endpoint_file):
+        if self._replica_cmd is not None:
+            return list(self._replica_cmd(rid, version, model_dir,
+                                          endpoint_file))
+        return [
+            sys.executable, "-m", "paddle_tpu.serving.replica",
+            "--model-dir", model_dir,
+            "--endpoint-file", endpoint_file,
+            "--replica-id", str(rid),
+            "--version", str(version),
+            "--host", self.host,
+        ] + self.replica_args
+
+    def _spawn(self, version, model_dir, replacement=False):
+        """Start one replica process (caller holds the lock)."""
+        rid = self._next_rid
+        self._next_rid += 1
+        epf = os.path.join(self._ep_dir, "replica_%d.json" % rid)
+        hbf = os.path.join(self._hb_dir, "replica_%d.json" % rid)
+        obs = os.path.join(self._obs_root, "replica_%d" % rid)
+        for stale in (epf, hbf):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        env = dict(os.environ)
+        env.update(self.replica_env)
+        env[_supervisor.HEARTBEAT_ENV] = hbf
+        # the replica's own telemetry surface: metrics on an ephemeral
+        # port (reported back via the endpoint file — the autoscaler's
+        # scrape target) + periodic JSONL snapshots the fleet report
+        # merges. An operator's explicit choice wins the setdefault.
+        env.setdefault("FLAGS_obs_http_port", "0")
+        env["FLAGS_obs_dir"] = obs
+        env.setdefault("FLAGS_obs_snapshot_interval_s", "2.0")
+        # `python -m paddle_tpu...` must resolve no matter where the
+        # controller process was launched from
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else pkg_root
+        )
+        log_path = os.path.join(self._log_dir, "replica_%d.log" % rid)
+        fn = open(log_path, "a")
+        try:
+            proc = subprocess.Popen(
+                self._cmd(rid, version, model_dir, epf),
+                env=env, stdout=fn, stderr=fn,
+            )
+        finally:
+            # the child holds its own dup of the descriptor; keeping
+            # the parent's copy open per spawn would leak one fd per
+            # replica for the controller's lifetime (autoscale/restart
+            # churn is unbounded)
+            fn.close()
+        r = _Replica(rid, version, model_dir, proc, epf, hbf, obs)
+        self._replicas[rid] = r
+        if replacement:
+            _profiler.bump_counter("fleet_replica_restarts")
+        self.log.event(
+            "replica_spawn", replica=rid, version=version, pid=proc.pid,
+            replacement=bool(replacement),
+        )
+        return r
+
+    def _begin_drain(self, r, reason):
+        """Graceful scale-down of one replica (caller holds the lock):
+        stop routing to it FIRST, then SIGTERM — the gateway flips
+        /readyz, completes every in-flight request (bounded by its
+        drain timeout), and the process exits 0."""
+        self.router.remove_backend(r.id)
+        r.state = "draining"
+        r.drain_t = time.monotonic()
+        try:
+            r.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+        self.log.event("replica_drain", replica=r.id, reason=reason,
+                       ready_replicas=self._ready_locked())
+
+    def _kill(self, r):
+        try:
+            r.proc.kill()
+        except OSError:
+            pass
+
+    def _kill_and_reap(self, replicas):
+        """SIGKILL, then actually wait() each child before the exit
+        bookkeeping: a killed-but-never-waited Popen is a zombie for
+        the controller's whole lifetime, and reaping BEFORE the wait
+        would log returncode=None (poll() right after kill() still
+        races the kernel)."""
+        for r in replicas:
+            self._kill(r)
+        for r in replicas:
+            try:
+                r.proc.wait(timeout=10)
+            except Exception:
+                pass
+        with self._lock:
+            for r in replicas:
+                if r.state != "exited":
+                    self._reap_locked(r)
+
+    def _await_exits(self, rids, timeout, reap=False):
+        """Wait (bounded) for the given replicas to exit; returns how
+        many did. With ``reap`` the exit bookkeeping runs here (used
+        once the tick thread is down)."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                live = [
+                    self._replicas[i] for i in rids
+                    if i in self._replicas
+                    and self._replicas[i].state != "exited"
+                ]
+                if reap:
+                    for r in live:
+                        if r.proc.poll() is not None:
+                            self._reap_locked(r)
+                    live = [r for r in live if r.state != "exited"]
+            if not live:
+                break
+            time.sleep(0.05)
+        with self._lock:
+            return sum(
+                1 for i in rids
+                if i in self._replicas
+                and self._replicas[i].state == "exited"
+            )
+
+    def _ready_locked(self):
+        return sum(1 for x in self._replicas.values()
+                   if x.state == "ready")
+
+    # -- the control loop ----------------------------------------------------
+    def _run(self):
+        while not self._stop_evt.wait(self.poll_s):
+            try:
+                self._tick()
+            except Exception as e:
+                # supervision must outlive any one bad tick (a torn
+                # endpoint file, a scrape hiccup); the next tick
+                # retries — but a PERSISTENT fault must not leave the
+                # fleet silently unsupervised, so it surfaces in
+                # fleet.log (rate-limited, and itself guarded)
+                now = time.monotonic()
+                if now - self._last_tick_err > 5.0:
+                    self._last_tick_err = now
+                    try:
+                        self.log.event("tick_error", error=repr(e))
+                    except Exception:
+                        pass
+                continue
+
+    def _tick(self):
+        now = time.monotonic()
+        with self._lock:
+            replicas = list(self._replicas.values())
+        for r in replicas:
+            if r.state == "exited":
+                continue
+            rc = r.proc.poll()
+            if rc is not None:
+                self._on_exit(r, rc)
+                continue
+            if r.state == "starting":
+                self._check_ready(r, now)
+            elif r.state == "ready":
+                self._check_hang(r, now)
+            elif r.state == "draining":
+                if now - r.drain_t > self.drain_grace_s:
+                    # the gateway's drain never ended: stop waiting
+                    self._kill(r)
+        self._reconcile(now)
+        if self.autoscale and not self._rollout and now >= self._next_scale_t:
+            self._next_scale_t = now + self.scale_interval_s
+            self._autoscale_tick()
+
+    def _on_exit(self, r, rc):
+        with self._lock:
+            if r.state == "exited":
+                return
+            was = r.state
+            self._reap_locked(r, rc=rc)
+            if was != "draining" and r.version == self.version:
+                # the hole this crash tore in the CURRENT pool: only
+                # spawns that fill it are "replacements" subject to the
+                # crash backoff/budget — scale-up growth is not
+                self._crash_deficit += 1
+        if was != "draining":
+            _profiler.bump_counter("fleet_replica_crashes")
+            self.crashes += 1
+            self.log.event("replica_crash", replica=r.id, returncode=rc,
+                           version=r.version)
+            if r.version != self.version:
+                # a rollout-version replica dying during warmup is
+                # deploy()'s failure, surfaced to ITS caller — it must
+                # not escalate the serving pool's backoff or burn the
+                # budget that gates replacing the STABLE version
+                # (repeated bad deploys would otherwise latch _gaveup
+                # on a pool that was never unstable)
+                return
+            self._pool_crashes += 1
+            # exponential backoff before the replacement spawn, jittered
+            # so a fleet-wide outage doesn't respawn in lockstep
+            delay = min(
+                self.restart_backoff_s
+                * (2.0 ** min(self._pool_crashes - 1, 5)),
+                30.0,
+            ) * (0.5 + 0.5 * self._rng.random())
+            self._backoff_until = max(self._backoff_until,
+                                      time.monotonic() + delay)
+
+    def _reap_locked(self, r, rc=None):
+        self.router.remove_backend(r.id)
+        r.state = "exited"
+        self.log.event(
+            "replica_exit", replica=r.id,
+            returncode=r.proc.poll() if rc is None else rc,
+            ready_replicas=self._ready_locked(),
+        )
+
+    def _check_ready(self, r, now):
+        if r.endpoint is None:
+            r.endpoint = _read_json(r.endpoint_file)
+        ep = r.endpoint
+        if ep and ep.get("gateway_port"):
+            if self._probe_readyz(ep["gateway_port"]):
+                ready_ms = (now - r.spawn_t) * 1e3
+                with self._lock:
+                    if r.state != "starting":
+                        return
+                    r.state = "ready"
+                    self.router.add_backend(
+                        r.id, self.host, ep["gateway_port"],
+                        version=r.version, ready=True,
+                    )
+                _profiler.bump_histogram("fleet_replica_ready_ms",
+                                         ready_ms)
+                self.log.event(
+                    "replica_ready", replica=r.id, version=r.version,
+                    ready_ms=round(ready_ms, 1),
+                    gateway_port=ep["gateway_port"],
+                    metrics_port=ep.get("metrics_port"),
+                    ready_replicas=self._ready_locked(),
+                )
+                self._write_report()
+                return
+        if now - r.spawn_t > self.ready_timeout_s:
+            self.log.event("replica_hang", replica=r.id,
+                           phase="startup",
+                           stale_s=round(now - r.spawn_t, 1))
+            _profiler.bump_counter("fleet_replica_hangs")
+            self._kill(r)  # the exit reaper turns this into a crash
+
+    def _probe_readyz(self, port):
+        # the router's shared probe (one definition of "ready"); short
+        # timeout — this runs serially per STARTING replica on the
+        # supervision tick, and an accepting-but-wedged gateway must
+        # not stall crash detection for the rest of the pool
+        from .router import probe_readyz
+
+        return probe_readyz(self.host, port, timeout=0.5)
+
+    def _check_hang(self, r, now):
+        """Supervisor-style staleness watch over the replica heartbeat
+        file. A replica that never beats (a custom replica_cmd without
+        the hook) is unobservable — exit/ready checks still cover it."""
+        hb = _supervisor.read_heartbeat(r.hb_file)
+        if hb is None:
+            return
+        seen = r.hb_seen
+        if seen is None or seen[0] != hb["mtime"]:
+            r.hb_seen = (hb["mtime"], now)
+            return
+        if now - seen[1] > self.heartbeat_timeout_s:
+            self.log.event(
+                "replica_hang", replica=r.id, phase="serve",
+                stale_s=round(now - seen[1], 1),
+            )
+            _profiler.bump_counter("fleet_replica_hangs")
+            self._kill(r)
+
+    def _reconcile(self, now):
+        """Drive the pool of the SERVING version toward the target.
+        Rollout-version replicas are deploy()'s to manage; old-version
+        stragglers mid-rollout are already draining. A deficit is
+        split into crash REPLACEMENTS (throttled by the crash
+        backoff/budget) and scale-up GROWTH (a healthy fleet's target
+        raise must never be gated — or permanently blocked after a
+        giveup — by an old crash streak)."""
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.version == self.version
+                    and r.state in ("starting", "ready")]
+            deficit = self.target - len(live)
+            # a target lowered past a pending crash hole absorbs it
+            self._crash_deficit = min(self._crash_deficit,
+                                      max(0, deficit))
+            if deficit > 0:
+                growth = deficit - self._crash_deficit
+                for _ in range(growth):
+                    self._spawn(self.version, self.model_dir)
+                if not self._crash_deficit:
+                    return
+                if self._gaveup or now < self._backoff_until:
+                    return
+                # the budget counts SERVING-pool crashes only (rollout
+                # warmup failures are deploy()'s to report)
+                if self._pool_crashes > self.max_replica_restarts:
+                    self._gaveup = True
+                    self.log.event(
+                        "giveup", crashes=self._pool_crashes,
+                        max_replica_restarts=self.max_replica_restarts,
+                    )
+                    return
+                for _ in range(self._crash_deficit):
+                    self._spawn(self.version, self.model_dir,
+                                replacement=True)
+                self._crash_deficit = 0
+            elif deficit < 0:
+                # drain the newest first: the oldest replicas have the
+                # warmest caches and the longest uptime record
+                ready = sorted(
+                    (r for r in live if r.state == "ready"),
+                    key=lambda r: -r.id,
+                )
+                for r in ready[:-deficit]:
+                    self._begin_drain(r, reason="scale_down")
+
+    # -- autoscaler ----------------------------------------------------------
+    def _autoscale_tick(self):
+        samples = self._scrape_samples()
+        new_target, reason = self.policy.observe(samples, self.target)
+        if new_target != self.target:
+            self.scale_to(new_target, reason=reason or "autoscale")
+
+    def _scrape_samples(self):
+        with self._lock:
+            targets = [
+                (r, (r.endpoint or {}).get("metrics_port"))
+                for r in self._replicas.values()
+                if r.state == "ready" and r.version == self.version
+            ]
+        # scrape CONCURRENTLY (same reasoning as the router's health
+        # sweep): one wedged replica burning its scrape timeout on the
+        # single supervision thread would delay crash detection and
+        # drain-grace kills for the whole pool
+        samples = []
+        s_lock = threading.Lock()
+
+        def one(r, port):
+            parsed = self._scrape(port)
+            if parsed is None:
+                return
+            queue = (
+                parsed.get(("serving_queue_depth", ""), 0.0)
+                + parsed.get(("decode_queue_depth", ""), 0.0)
+            )
+            shed_total = (
+                parsed.get(("serving_shed_overload", ""), 0.0)
+                + parsed.get(("gateway_shed_admission", ""), 0.0)
+            )
+            shed_delta = max(0.0, shed_total - r.shed_seen)
+            r.shed_seen = shed_total
+            p95 = parsed.get(("serving_latency_ms", 'quantile="0.95"'))
+            with s_lock:
+                samples.append({
+                    "replica": r.id,
+                    "queue_depth": queue,
+                    "shed_delta": shed_delta,
+                    "p95_ms": p95,
+                })
+
+        scrapers = []
+        for r, port in targets:
+            if not port:
+                continue
+            t = threading.Thread(target=one, args=(r, port), daemon=True)
+            t.start()
+            scrapers.append(t)
+        for t in scrapers:
+            t.join(timeout=2.0)
+        with s_lock:
+            # a copy: a straggler past the join appends into the
+            # discarded original, never into a consumed round
+            return list(samples)
+
+    def _scrape(self, port):
+        try:
+            with urllib.request.urlopen(
+                "http://%s:%d/metrics" % (self.host, port), timeout=1.5
+            ) as resp:
+                return _obs_registry.parse_prometheus(
+                    resp.read().decode("utf-8")
+                )
+        except Exception:
+            return None
+
+    # -- reporting -----------------------------------------------------------
+    def _write_report(self, force=False):
+        """Best-effort fleet_report.json — reporting failures must
+        never take down supervision, and the rebuild (a full fleet.log
+        + snapshot re-parse) is throttled so event bursts on the tick
+        thread don't delay crash detection; ``force`` (stop, rollout
+        boundaries) always writes."""
+        now = time.monotonic()
+        if not force and now - self._last_report_t < 5.0:
+            return
+        self._last_report_t = now
+        try:
+            from ..observability import aggregate as _aggregate
+
+            _aggregate.write_fleet_report(
+                self.workdir, obs_root=self._obs_root
+            )
+        except Exception:
+            pass

@@ -395,9 +395,13 @@ class Gateway(object):
                  rate_limit_rps=None, rate_burst=None,
                  tenant_max_inflight=None, max_inflight=None,
                  admit_timeout_ms=None, drain_timeout_s=None,
-                 access_log=None):
+                 access_log=None, extra_headers=None):
         self.server = server
         self.host = host
+        # static response headers stamped on every reply (fleet
+        # replicas tag X-Replica-Id / X-Model-Version so the router and
+        # rollout audits can attribute each answer)
+        self.extra_headers = dict(extra_headers or {})
         self.port_requested = int(_flag("gateway_port", port))
         self.drain_timeout_s = float(
             _flag("gateway_drain_timeout_s", drain_timeout_s)
@@ -639,6 +643,8 @@ def _make_handler(gw):
             if close:
                 self.send_header("Connection", "close")
                 self.close_connection = True
+            for k, v in gw.extra_headers.items():
+                self.send_header(k, v)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
@@ -952,50 +958,85 @@ def _make_handler(gw):
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
             self.send_header("X-Request-Id", rid)
+            for k, v in gw.extra_headers.items():
+                self.send_header(k, v)
             self.end_headers()
             sent = 0
             first_tok_ms = None
             t0 = time.monotonic()
-            try:
-                for tok in stream.stream_tokens(timeout=timeout):
-                    if first_tok_ms is None:
-                        first_tok_ms = (time.monotonic() - t0) * 1e3
-                        _profiler.bump_histogram("gateway_ttft_ms",
-                                                 first_tok_ms)
+            # ENGINE exceptions (deadline, stream failure) and CLIENT
+            # write exceptions must be told apart by SOURCE, not type:
+            # on py3.10+ socket.timeout IS TimeoutError, so a write to
+            # a stalled client that times out is type-identical to the
+            # generation deadline — only next(it) can raise the
+            # deadline, only _chunk can raise the socket
+            it = iter(stream.stream_tokens(timeout=timeout))
+            while True:
+                try:
+                    tok = next(it)
+                except StopIteration:
+                    break
+                except TimeoutError:
+                    stream.cancel()  # free the decode slot — see above
+                    _profiler.bump_counter("gateway_shed_dispatch")
+                    _profiler.bump_counter("gateway_tenant_shed_"
+                                           + _tenant_slug(tenant))
+                    try:
+                        self._chunk('data: %s\n\n' % json.dumps(
+                            {"error": "deadline", "request_id": rid}
+                        ))
+                        self._chunk_end()
+                    except OSError:
+                        return 499, "client_stalled", sent
+                    return 504, "deadline", sent
+                except Exception as e:  # noqa: BLE001
+                    # the 200 + chunked framing is already on the
+                    # wire: ANY stream failure (the engine fails
+                    # streams with the original exception type, not
+                    # just ServingError) must ride an in-band error
+                    # event — a late _send_json(500) would inject a
+                    # raw status line into the chunked body
+                    try:
+                        self._chunk('data: %s\n\n' % json.dumps(
+                            {"error": str(e) or repr(e),
+                             "request_id": rid}
+                        ))
+                        self._chunk_end()
+                    except OSError:
+                        stream.cancel()
+                        return 499, "client_stalled", sent
+                    return 500, "stream_error", sent
+                if first_tok_ms is None:
+                    first_tok_ms = (time.monotonic() - t0) * 1e3
+                    _profiler.bump_histogram("gateway_ttft_ms",
+                                             first_tok_ms)
+                try:
                     self._chunk('data: {"token": %d}\n\n' % tok)
-                    sent += 1
-                    _profiler.bump_counter("gateway_stream_tokens")
-            except TimeoutError:
-                stream.cancel()  # free the decode slot — see above
-                _profiler.bump_counter("gateway_shed_dispatch")
-                _profiler.bump_counter("gateway_tenant_shed_"
-                                       + _tenant_slug(tenant))
+                except OSError as e:
+                    # client went away (reset/pipe) or STALLED (write
+                    # timeout) mid-stream: nothing left to write to,
+                    # and nobody left to decode for. A ConnectionError
+                    # re-raises into _serve's 499 mapping; a write
+                    # timeout must NOT re-raise — the generic handler
+                    # would _send_json(500) into the open chunked body
+                    stream.cancel()
+                    if isinstance(e, ConnectionError):
+                        raise
+                    return 499, "client_stalled", sent
+                sent += 1
+                _profiler.bump_counter("gateway_stream_tokens")
+            try:
                 self._chunk('data: %s\n\n' % json.dumps(
-                    {"error": "deadline", "request_id": rid}
+                    {"done": True,
+                     "finish_reason": stream.finish_reason,
+                     "tokens": sent, "request_id": rid},
+                    sort_keys=True,
                 ))
                 self._chunk_end()
-                return 504, "deadline", sent
-            except OSError:
-                # client went away mid-stream: nothing left to write to,
-                # and nobody left to decode for
-                stream.cancel()
-                raise
-            except Exception as e:  # noqa: BLE001
-                # the 200 + chunked framing is already on the wire: ANY
-                # stream failure (the engine fails streams with the
-                # original exception type, not just ServingError) must
-                # ride an in-band error event — a late _send_json(500)
-                # would inject a raw status line into the chunked body
-                self._chunk('data: %s\n\n' % json.dumps(
-                    {"error": str(e) or repr(e), "request_id": rid}
-                ))
-                self._chunk_end()
-                return 500, "stream_error", sent
-            self._chunk('data: %s\n\n' % json.dumps(
-                {"done": True, "finish_reason": stream.finish_reason,
-                 "tokens": sent, "request_id": rid}, sort_keys=True,
-            ))
-            self._chunk_end()
+            except OSError as e:
+                if isinstance(e, ConnectionError):
+                    raise
+                return 499, "client_stalled", sent
             return 200, None, sent
 
         def _chunk(self, text):

@@ -1,0 +1,130 @@
+"""One serving replica: the process the FleetController spawns.
+
+``python -m paddle_tpu.serving.replica --model-dir D --endpoint-file F``
+builds the full single-process serving stack over a saved inference
+model — AnalysisPredictor -> InferenceServer (micro-batcher + bucket
+ladder, eagerly warmed) -> Gateway (HTTP front door) — then reports its
+ephemeral ports back to the controller through an atomically written
+*endpoint file* and heartbeats through the supervisor's worker protocol
+(``PADDLE_TPU_HEARTBEAT_FILE``) until a SIGTERM drains it.
+
+Contract with the controller:
+
+- warmup happens BEFORE the gateway starts listening, so the first
+  ``/readyz`` 200 already implies a fully warmed bucket ladder (and,
+  under ``FLAGS_serving_strict_compiles``, an armed compile gate) —
+  the controller can shift rollout traffic on readiness alone;
+- ``warmup.npz`` beside the model (one array per feed, ``arr_0..``
+  order) provides the warmup example; without it the replica serves
+  unwarmed (strict mode would then fail its first request by design);
+- every ``/v1/infer`` response carries ``X-Replica-Id`` and
+  ``X-Model-Version`` headers (the router relays them), so rollout
+  audits can attribute each answer to the exact replica and version
+  that produced it;
+- SIGTERM (the controller's drain) rides the gateway's graceful path:
+  ``/readyz`` flips 503, every in-flight request completes, the
+  listener closes, the process exits 0. Only a crash exits nonzero.
+
+Scope: this stock replica serves ``/v1/infer`` over any
+``save_inference_model`` export. ``/v1/generate`` needs a
+``DecodeEngine`` (a GPT-config decode session, not an arbitrary saved
+model) — generation fleets supply a custom ``replica_cmd`` whose
+process attaches one (``InferenceServer(pred, decode_engine=...)`` +
+``Gateway``, exactly as in tools/gateway_probe.py) or register such
+gateways on the Router directly; the router's SSE pin/relay path works
+against any gateway backend and is tested against streaming backends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+__all__ = ["main"]
+
+
+def _write_endpoint(path, payload):
+    """Atomic tmp+replace: the controller must never read a torn file."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _load_warmup(model_dir, warmup_path):
+    import numpy as np
+
+    path = warmup_path or os.path.join(model_dir, "warmup.npz")
+    if not os.path.isfile(path):
+        return None
+    with np.load(path) as f:
+        return [f["arr_%d" % i] for i in range(len(f.files))]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-dir", required=True,
+                    help="saved inference model (save_inference_model)")
+    ap.add_argument("--endpoint-file", required=True,
+                    help="where to report the bound ports (atomic JSON)")
+    ap.add_argument("--replica-id", default="0")
+    ap.add_argument("--version", type=int, default=0,
+                    help="model version tag (rollout audit header)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--warmup-npz", default="",
+                    help="override the warmup example "
+                         "(default: <model-dir>/warmup.npz)")
+    args = ap.parse_args(argv)
+
+    # heavy imports AFTER argparse: --help must not pay for jax
+    from paddle_tpu import inference, serving
+    from paddle_tpu.distributed import supervisor as _supervisor
+    from paddle_tpu.observability import exporter as _obs_exporter
+
+    pred = inference.create_paddle_predictor(
+        inference.AnalysisConfig(args.model_dir)
+    )
+    warmup = _load_warmup(args.model_dir, args.warmup_npz)
+    server = serving.InferenceServer(pred).start(warmup_inputs=warmup)
+    gw = serving.Gateway(
+        server, port=0, host=args.host,
+        extra_headers={
+            "X-Replica-Id": str(args.replica_id),
+            "X-Model-Version": str(args.version),
+        },
+    ).start()
+    gw.install_sigterm()
+
+    exp = _obs_exporter.global_exporter()
+    _write_endpoint(args.endpoint_file, {
+        "pid": os.getpid(),
+        "replica_id": str(args.replica_id),
+        "version": int(args.version),
+        "model_dir": args.model_dir,
+        "gateway_port": gw.port,
+        "metrics_port": exp.port if exp is not None else None,
+        "warmed": warmup is not None,
+        "ts": time.time(),
+    })
+
+    hb = _supervisor.worker_heartbeat()
+    step = 0
+    try:
+        # serve until the gateway's drain closes the listener (SIGTERM
+        # -> /readyz 503 -> in-flight completes -> port is None)
+        while gw.port is not None:
+            if hb is not None:
+                hb.beat(step, status="serve")
+            step += 1
+            time.sleep(0.2)
+    finally:
+        gw.stop()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,676 @@
+"""Replica router — the serving fleet's single front door.
+
+One gateway (PR 9) serves one process; a fleet serves one *address*.
+The router is a thin L7 proxy over a dynamic set of replica gateways:
+
+  HTTP client --> Router --pick: least-inflight ready backend-->
+                      replica Gateway (/v1/infer | /v1/generate)
+
+- **Health**: a background thread polls every backend's ``/readyz``
+  each ``FLAGS_router_health_interval_s``; a 503 (the gateway's
+  preemption-latch drain flip) or an unreachable socket excludes the
+  backend from routing until it answers 200 again. A proxied request
+  that hits a dead socket marks the backend not-ready immediately —
+  the health thread's cadence never gates failover.
+- **Routing**: least-inflight among ready backends of the active
+  version (ties broken by id), tracked by the router's own in-flight
+  accounting — the cheapest useful load signal, and the one that stays
+  correct when a replica stalls.
+- **Retry**: ``POST /v1/infer`` is idempotent by contract, so a
+  connection-level failure (replica SIGKILLed mid-request, connect
+  refused during the controller's respawn window) or a backend 503
+  (drain began after the pick) transparently retries on another
+  backend, up to ``FLAGS_router_retries`` times. A client sees its
+  result, not the replica's death.
+- **Streaming**: ``POST /v1/generate`` PINS to its backend — a decode
+  stream lives in one engine's KV slot and cannot move. Failures
+  before the backend responds retry like infer (nothing decoded,
+  nothing sent); once the SSE stream is open, a replica death surfaces
+  as the PR 9 in-band ``data: {"error": ...}`` event followed by a
+  clean chunked terminator, so the client's SSE parser ends sanely
+  instead of seeing a torn socket.
+- **Versioned rollout**: every backend carries a model version;
+  ``set_active_version(v)`` atomically restricts routing to that
+  version (``None`` routes all). The fleet controller flips it once
+  the new version's replicas are warm, then drains the old ones.
+
+Endpoints: ``POST /v1/infer`` and ``POST /v1/generate`` (proxied),
+``GET /healthz`` (listener liveness), ``GET /readyz`` (200 while at
+least one routable backend is ready — a fleet-level load balancer can
+stack on top), ``GET /backends`` (state snapshot for operators and
+probes). Metrics ride the PR 5 registry: ``router_*`` counters /
+gauges / latency histogram, so one ``/metrics`` scrape covers the
+router beside whatever else the process runs.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..fluid import flags as _flags
+from ..fluid import profiler as _profiler
+from ..observability import exporter as _obs_exporter
+from ..observability import registry as _obs_registry
+from ..observability import trace as _trace
+from .gateway import _MAX_BODY_BYTES
+
+__all__ = ["Backend", "Router", "probe_readyz"]
+
+
+def probe_readyz(host, port, timeout=1.0):
+    """True iff ``GET /readyz`` on (host, port) answers 200 within
+    ``timeout`` — the ONE readiness-probe implementation, shared by the
+    router's health loop and the fleet controller's startup watch so
+    'ready' can never mean two different things."""
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        finally:
+            conn.close()
+    except (OSError, http.client.HTTPException):
+        # refused/reset/timeout or a torn read (IncompleteRead /
+        # BadStatusLine): not ready — never a probe-killing event
+        return False
+
+
+def _flag(name, override):
+    return override if override is not None else _flags.get_flag(name)
+
+
+# response headers worth relaying from a replica back to the client
+# (identity + backpressure + the rollout-audit version tag)
+_RELAY_HEADERS = (
+    "Content-Type",
+    "Retry-After",
+    "X-Request-Id",
+    "X-Replica-Id",
+    "X-Model-Version",
+)
+# request headers forwarded to the replica (tenant/priority/id reach the
+# replica gateway's admission control untouched)
+_FORWARD_HEADERS = (
+    "Content-Type",
+    "X-Tenant-Id",
+    "X-Priority",
+    "X-Request-Id",
+)
+
+
+class Backend(object):
+    """One routable replica gateway."""
+
+    __slots__ = ("id", "host", "port", "version", "ready", "inflight")
+
+    def __init__(self, backend_id, host, port, version=0, ready=False):
+        self.id = str(backend_id)
+        self.host = str(host)
+        self.port = int(port)
+        self.version = int(version)
+        self.ready = bool(ready)
+        self.inflight = 0
+
+    def as_dict(self):
+        return {
+            "id": self.id,
+            "host": self.host,
+            "port": self.port,
+            "version": self.version,
+            "ready": self.ready,
+            "inflight": self.inflight,
+        }
+
+
+class _ProxyFailure(Exception):
+    """Connection-level failure against one backend. ``timeout=True``
+    means the backend was SLOW, not dead — it keeps its ready state
+    (the health loop owns that call), and pinned work isn't re-run."""
+
+    def __init__(self, msg, timeout=False):
+        super().__init__(msg)
+        self.timeout = timeout
+
+
+class _PayloadTooLarge(ValueError):
+    """Request body over _MAX_BODY_BYTES — mapped to HTTP 413."""
+
+
+class Router(object):
+    """Health-checked least-inflight HTTP router over replica gateways.
+
+    The backend set is mutated live (the fleet controller adds a
+    replica the moment its ``/readyz`` first answers 200 and removes it
+    before draining it); requests already proxied to a removed backend
+    complete — removal only stops NEW picks.
+    """
+
+    def __init__(self, port=None, host="127.0.0.1", health_interval_s=None,
+                 retries=None, backend_timeout_s=None):
+        self.host = host
+        self.port_requested = int(_flag("router_port", port))
+        self.health_interval_s = float(
+            _flag("router_health_interval_s", health_interval_s)
+        )
+        self.retries = int(_flag("router_retries", retries))
+        self.backend_timeout_s = float(
+            _flag("router_backend_timeout_s", backend_timeout_s)
+        )
+        self._backends = {}  # id -> Backend
+        self._active_version = None  # None = route every version
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._http_thread = None
+        self._health_thread = None
+        self._stop = threading.Event()
+        self._started = False
+        self._inflight_gauge = None
+        self._ready_gauge = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._started:
+            raise RuntimeError("router already started")
+        self._stop.clear()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port_requested), handler
+        )
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="router_http", daemon=True
+        )
+        self._http_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="router_health", daemon=True
+        )
+        self._health_thread.start()
+        self._started = True
+        _obs_exporter.maybe_start_from_flags()
+        self._inflight_gauge = lambda r=self: r.total_inflight()
+        _obs_registry.register_gauge("router_inflight", self._inflight_gauge)
+        self._ready_gauge = lambda r=self: r.ready_count()
+        _obs_registry.register_gauge("router_backends_ready",
+                                     self._ready_gauge)
+        return self
+
+    def stop(self):
+        """Close the listener. Proxied requests run on daemon handler
+        threads with their own bounded backend timeouts; the fleet
+        controller stops the router only after draining the replicas,
+        so nothing meaningful can still be in flight."""
+        if not self._started:
+            return
+        self._started = False
+        self._stop.set()
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:
+                pass
+            self._httpd = None
+        for t in (self._http_thread, self._health_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=5.0)
+        self._http_thread = self._health_thread = None
+        if self._inflight_gauge is not None:
+            _obs_registry.unregister_gauge("router_inflight",
+                                           self._inflight_gauge)
+            self._inflight_gauge = None
+        if self._ready_gauge is not None:
+            _obs_registry.unregister_gauge("router_backends_ready",
+                                           self._ready_gauge)
+            self._ready_gauge = None
+
+    def __enter__(self):
+        return self if self._started else self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def url(self, path="/readyz"):
+        if self._httpd is None:
+            raise RuntimeError("router is not listening")
+        return "http://%s:%d%s" % (self.host, self.port, path)
+
+    # -- backend registry ----------------------------------------------------
+    def add_backend(self, backend_id, host, port, version=0, ready=False):
+        """Register (or replace) one replica gateway. ``ready=True``
+        skips the first health-probe gap — the fleet controller adds a
+        backend only after polling its ``/readyz`` itself."""
+        b = Backend(backend_id, host, port, version=version, ready=ready)
+        with self._lock:
+            self._backends[b.id] = b
+        return b
+
+    def remove_backend(self, backend_id):
+        with self._lock:
+            return self._backends.pop(str(backend_id), None)
+
+    def set_active_version(self, version):
+        """Atomically restrict routing to one model version (``None``
+        routes all) — the rollout traffic flip."""
+        with self._lock:
+            self._active_version = (
+                None if version is None else int(version)
+            )
+
+    @property
+    def active_version(self):
+        with self._lock:
+            return self._active_version
+
+    def backends(self):
+        with self._lock:
+            return [b.as_dict() for b in self._backends.values()]
+
+    def ready_count(self):
+        with self._lock:
+            return sum(1 for b in self._backends.values()
+                       if b.ready and self._routable(b))
+
+    def total_inflight(self):
+        with self._lock:
+            return sum(b.inflight for b in self._backends.values())
+
+    def _routable(self, b):
+        return (self._active_version is None
+                or b.version == self._active_version)
+
+    def _pick(self, exclude=()):
+        """Least-inflight ready backend of the active version (ties by
+        id, so picks are deterministic); reserves an inflight slot."""
+        with self._lock:
+            ready = [
+                b for b in self._backends.values()
+                if b.ready and b.id not in exclude and self._routable(b)
+            ]
+            if not ready:
+                return None
+            b = min(ready, key=lambda x: (x.inflight, x.id))
+            b.inflight += 1
+            return b
+
+    def _release(self, b):
+        with self._lock:
+            b.inflight = max(0, b.inflight - 1)
+
+    def _mark_failed(self, b):
+        """A request-path connection failure is a stronger signal than
+        the last health poll: stop routing to the backend immediately;
+        the health loop re-admits it when /readyz answers again."""
+        with self._lock:
+            b.ready = False
+        _profiler.bump_counter("router_backend_failures")
+
+    # -- health loop ---------------------------------------------------------
+    def _health_loop(self):
+        while not self._stop.wait(self.health_interval_s):
+            with self._lock:
+                targets = list(self._backends.values())
+            # probe CONCURRENTLY: one wedged backend (dropped SYN, hung
+            # accept) burning its full probe timeout must not delay
+            # every other backend's health transition past the
+            # configured cadence — re-admission latency is capacity
+            # during exactly the degraded windows this loop exists for
+            probes = []
+            for b in targets:
+                t = threading.Thread(target=self._probe_and_set,
+                                     args=(b,), daemon=True)
+                t.start()
+                probes.append(t)
+            for t in probes:
+                t.join(timeout=3.0)  # stragglers finish on their own
+
+    def _probe_and_set(self, b):
+        try:
+            ok = self._probe_ready(b)
+        except Exception:
+            # the supervision path must outlive ANY one bad probe — a
+            # dead health loop would strand every _mark_failed backend
+            # not-ready forever
+            ok = False
+        with self._lock:
+            # the backend may have been removed mid-probe; only flip
+            # state on the instance (harmless if orphaned)
+            b.ready = ok
+
+    def _probe_ready(self, b):
+        return probe_readyz(b.host, b.port,
+                            timeout=min(2.0, self.backend_timeout_s))
+
+
+# -- HTTP proxy handler ------------------------------------------------------
+
+
+def _make_handler(router):
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "paddle-tpu-router/1"
+        timeout = 60.0
+
+        def log_message(self, *args):
+            pass
+
+        # -- plumbing --------------------------------------------------------
+        def _send_json(self, code, obj, headers=(), close=False):
+            data = json.dumps(obj, sort_keys=True).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            if close:
+                self.send_header("Connection", "close")
+                self.close_connection = True
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _read_body(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                raise ValueError("bad Content-Length")
+            if n <= 0:
+                raise ValueError("missing request body")
+            if n > _MAX_BODY_BYTES:
+                # the router is the fleet's PUBLIC front door: the
+                # same client-controlled-memory bound the gateway
+                # enforces must hold here, before any buffering —
+                # otherwise a huge declared Content-Length OOMs the
+                # controller host without a backend ever seeing it
+                raise _PayloadTooLarge(
+                    "request body of %d bytes exceeds the %d-byte cap"
+                    % (n, _MAX_BODY_BYTES)
+                )
+            return self.rfile.read(n)
+
+        def _forward_headers(self):
+            out = {}
+            for k in _FORWARD_HEADERS:
+                v = self.headers.get(k)
+                if v is not None:
+                    out[k] = v
+            return out
+
+        # -- GET -------------------------------------------------------------
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                self._send_json(200, {"status": "alive",
+                                      "pid": os.getpid()})
+            elif path == "/readyz":
+                n = router.ready_count()
+                if n > 0:
+                    self._send_json(200, {
+                        "status": "ready", "backends_ready": n,
+                        "active_version": router.active_version,
+                    })
+                else:
+                    self._send_json(503, {"status": "no_ready_backends"})
+            elif path == "/backends":
+                self._send_json(200, {
+                    "active_version": router.active_version,
+                    "backends": router.backends(),
+                })
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        # -- POST ------------------------------------------------------------
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path not in ("/v1/infer", "/v1/generate"):
+                self._send_json(404, {"error": "not found"}, close=True)
+                return
+            try:
+                body = self._read_body()
+            except _PayloadTooLarge as e:
+                self._send_json(413, {"error": str(e)}, close=True)
+                return
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)}, close=True)
+                return
+            _profiler.bump_counter("router_requests")
+            t0 = time.monotonic()
+            try:
+                with _trace.span("router_request", cat="router",
+                                 endpoint=path):
+                    if path == "/v1/infer":
+                        status = self._proxy_json(path, body)
+                    else:
+                        status = self._proxy_generate(body)
+            except ConnectionError:
+                status = 499  # client went away; nothing left to write
+            except Exception as e:  # the handler thread must survive
+                status = 500
+                try:
+                    self._send_json(500, {"error": repr(e)}, close=True)
+                except Exception:
+                    pass
+            if status is not None and status < 400:
+                _profiler.bump_histogram(
+                    "router_latency_ms", (time.monotonic() - t0) * 1e3
+                )
+
+        def _no_backend(self):
+            _profiler.bump_counter("router_no_backend")
+            self._send_json(
+                503,
+                {"error": "no ready replica for the active version",
+                 "active_version": router.active_version},
+                headers=(("Retry-After", "1"),), close=True,
+            )
+            return 503
+
+        def _backend_request(self, b, path, body):
+            """One proxied POST; returns (conn, resp). Raises
+            _ProxyFailure on connection-level errors (the backend is
+            marked not-ready)."""
+            conn = http.client.HTTPConnection(
+                b.host, b.port, timeout=router.backend_timeout_s
+            )
+            try:
+                conn.request("POST", path, body=body,
+                             headers=self._forward_headers())
+                resp = conn.getresponse()
+                return conn, resp
+            except socket.timeout as e:
+                # a healthy-but-slow replica (a long non-stream
+                # generation) is NOT death: don't yank it from
+                # rotation on the request path — that's the health
+                # loop's judgment to make
+                conn.close()
+                _profiler.bump_counter("router_backend_timeouts")
+                raise _ProxyFailure(str(e) or "backend timeout",
+                                    timeout=True)
+            except (OSError, http.client.HTTPException) as e:
+                # OSError covers refused/reset; HTTPException covers a
+                # replica dying between accept and status line
+                # (BadStatusLine on a torn read)
+                conn.close()
+                router._mark_failed(b)
+                raise _ProxyFailure(str(e))
+
+        def _relay(self, resp, data, backend_id):
+            headers = [(k, resp.headers[k]) for k in _RELAY_HEADERS
+                       if k in resp.headers and k != "Content-Type"]
+            headers.append(("X-Routed-Backend", backend_id))
+            ctype = resp.headers.get("Content-Type", "application/json")
+            self.send_response(resp.status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+            return resp.status
+
+        def _proxy_json(self, path, body, pin_on_response=False):
+            """Retrying proxy for idempotent JSON requests. A backend
+            503 means the request was REJECTED unexecuted (drain began
+            after the pick) — as retriable as a dead socket. Everything
+            else, including 429 backpressure, is the replica's answer
+            and relays verbatim."""
+            tried = set()
+            for attempt in range(router.retries + 1):
+                b = router._pick(exclude=tried)
+                if b is None:
+                    return self._no_backend()
+                tried.add(b.id)
+                if attempt:
+                    _profiler.bump_counter("router_retries")
+                try:
+                    conn, resp = self._backend_request(b, path, body)
+                except _ProxyFailure as e:
+                    router._release(b)
+                    if e.timeout and pin_on_response:
+                        # a generation slower than the proxy timeout:
+                        # re-executing it elsewhere would burn another
+                        # replica's decode slots on work whose first
+                        # copy may still be running — shed 504 instead
+                        self._send_json(
+                            504,
+                            {"error": "backend timed out after %.0fs"
+                                      % router.backend_timeout_s,
+                             "reason": "backend_timeout"},
+                            close=True,
+                        )
+                        return 504
+                    continue
+                try:
+                    if pin_on_response and resp.status == 200:
+                        # /v1/generate with "stream": true answers SSE:
+                        # hand the open response to the stream relay
+                        ctype = resp.headers.get("Content-Type", "")
+                        if "text/event-stream" in ctype:
+                            return self._relay_stream(b, conn, resp)
+                    try:
+                        data = resp.read()
+                    except socket.timeout:
+                        # slow, not dead (see _backend_request)
+                        _profiler.bump_counter("router_backend_timeouts")
+                        if pin_on_response:
+                            self._send_json(
+                                504,
+                                {"error": "backend timed out mid-"
+                                          "response",
+                                 "reason": "backend_timeout"},
+                                close=True,
+                            )
+                            return 504
+                        continue
+                    except (OSError, http.client.HTTPException):
+                        # the replica died mid-response (reset or
+                        # IncompleteRead): idempotent, so the next
+                        # attempt re-executes safely
+                        router._mark_failed(b)
+                        continue
+                    if resp.status == 503:
+                        router._mark_failed(b)
+                        continue
+                    return self._relay(resp, data, b.id)
+                finally:
+                    conn.close()
+                    router._release(b)
+            _profiler.bump_counter("router_no_backend")
+            self._send_json(
+                502,
+                {"error": "every candidate replica failed "
+                          "(%d attempted)" % len(tried)},
+                close=True,
+            )
+            return 502
+
+        def _proxy_generate(self, body):
+            # pre-response failures retry exactly like infer (nothing
+            # was decoded, nothing was sent); an open stream pins
+            return self._proxy_json("/v1/generate", body,
+                                    pin_on_response=True)
+
+        def _relay_stream(self, b, conn, resp):
+            """Relay an open SSE stream chunk-for-chunk. Mid-stream
+            backend death rides the in-band error event contract —
+            the 200 + chunked framing is already on the client's wire."""
+            self.send_response(200)
+            for k in ("Content-Type", "Cache-Control", "X-Request-Id",
+                      "X-Replica-Id", "X-Model-Version"):
+                if k in resp.headers:
+                    self.send_header(k, resp.headers[k])
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("X-Routed-Backend", b.id)
+            self.end_headers()
+            try:
+                while True:
+                    try:
+                        # read1, NOT readline: http.client's readline
+                        # goes through _peek_chunked, which SWALLOWS
+                        # the IncompleteRead of a truncated chunked
+                        # stream and reports clean EOF — a replica
+                        # death would relay as a normal end of stream
+                        # with no error event; read1 raises.
+                        data = resp.read1(65536)
+                    except socket.timeout:
+                        # slow, not dead (timeout != death, same as the
+                        # non-stream path): the replica keeps its ready
+                        # state, the client gets an in-band timeout
+                        _profiler.bump_counter("router_backend_timeouts")
+                        self._chunk("data: %s\n\n" % json.dumps(
+                            {"error": "backend timed out mid-stream "
+                                      "after %.0fs"
+                                      % router.backend_timeout_s,
+                             "reason": "backend_timeout",
+                             "backend": b.id}
+                        ))
+                        self._chunk_end()
+                        return 504
+                    except (OSError, http.client.HTTPException) as e:
+                        # replica died mid-stream: the stream is pinned
+                        # — surface it in-band and end the stream sanely
+                        router._mark_failed(b)
+                        _profiler.bump_counter("router_stream_errors")
+                        self._chunk("data: %s\n\n" % json.dumps(
+                            {"error": "replica lost mid-stream: %s"
+                                      % (str(e) or repr(e)),
+                             "backend": b.id}
+                        ))
+                        self._chunk_end()
+                        return 502
+                    if not data:
+                        break
+                    # raw bytes: a decode/encode round-trip would
+                    # corrupt any multi-byte UTF-8 sequence read1
+                    # splits across a block boundary
+                    self._chunk(data)
+            except OSError:
+                # the CLIENT went away: stop pulling tokens for nobody
+                return 499
+            self._chunk_end()
+            return 200
+
+        def _chunk(self, data):
+            if isinstance(data, str):
+                data = data.encode("utf-8")
+            self.wfile.write(b"%x\r\n" % len(data))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+
+        def _chunk_end(self):
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+    return _Handler

@@ -78,7 +78,6 @@ class InferenceServer(object):
         self._warm_lock = threading.Lock()
         self._baseline = {}
         self._lat_base = 0
-        self._queue_gauge = None
         self._pool_gauge = None
         self._steady_armed = False
         self._started = False
@@ -112,14 +111,10 @@ class InferenceServer(object):
         )
         self._started = True
         # telemetry: FLAGS_obs_* light up /metrics /healthz /trace and
-        # JSONL snapshots with no code changes (no-op when disarmed), and
-        # the admission-queue depth + pool occupancy publish as
-        # scrape-time gauges
+        # JSONL snapshots with no code changes (no-op when disarmed).
+        # The admission-queue depth gauge (serving_queue_depth) is owned
+        # by the MicroBatcher itself; pool occupancy publishes here.
         _obs_exporter.maybe_start_from_flags()
-        self._queue_gauge = lambda b=self._batcher: b.queue_len
-        _obs_registry.register_gauge(
-            "serving_queue_depth", self._queue_gauge
-        )
         self._pool_gauge = lambda p=self._pool: p.free_count
         _obs_registry.register_gauge("serving_pool_free", self._pool_gauge)
         # warmup is over: from here every XLA compile is a steady-state
@@ -204,14 +199,10 @@ class InferenceServer(object):
         if getattr(self, "_steady_armed", False):
             _xla_stats.disarm_serving_steady()
             self._steady_armed = False
-        if self._queue_gauge is not None:
-            # ownership-scoped: a second server that re-registered the
-            # gauge keeps it when this (older) one stops
-            _obs_registry.unregister_gauge(
-                "serving_queue_depth", self._queue_gauge
-            )
-            self._queue_gauge = None
         if getattr(self, "_pool_gauge", None) is not None:
+            # ownership-scoped: a second server that re-registered the
+            # gauge keeps it when this (older) one stops; the queue
+            # gauge travels with the batcher (stopped below)
             _obs_registry.unregister_gauge(
                 "serving_pool_free", self._pool_gauge
             )
