@@ -114,6 +114,11 @@ def _bank_entry(line):
             # banked value; the aggregate rate and engine geometry ride
             # along for context
             "decode", "streams", "tok_per_sec", "max_len", "max_new",
+            # prefix rung (gpt_decode_prefix): prefix_cache is the
+            # bank_best guard flag; TTFT + share/hit-rate are the facts
+            # the rung exists to bank
+            "prefix_cache", "ttft_ms", "prefix_share", "prefix_hits",
+            "prefix_hit_rate", "cached_prefix_tokens",
             # per-rung cost census (observability/xla_stats): the
             # compiled step's FLOP/HBM-byte budget banks alongside the
             # throughput so PERF.md's bytes-budget table has provenance
@@ -184,7 +189,10 @@ def bank_best(prefix):
     containing 'hostfeed'. Serving rungs (BENCH_SERVING=1: requests/sec
     through the dynamic-batching runtime, a different metric entirely)
     are guarded the same way — only a prefix containing 'serving' sees
-    them."""
+    them. Decode rungs (tokens/sec/user) need 'decode' in the prefix,
+    and the BENCH_DECODE prefix-cache rung (tokens/sec/user at ~90%
+    prefix share — an amortized metric a cold-prompt decode headline
+    must never inherit) additionally needs 'prefix'."""
     cands = [
         (slot, e)
         for slot, e in load_bank().items()
@@ -192,6 +200,7 @@ def bank_best(prefix):
         and ("hostfeed" in prefix or not e.get("hostfeed"))
         and ("serving" in prefix or not e.get("serving"))
         and ("decode" in prefix or not e.get("decode"))
+        and ("prefix" in prefix or not e.get("prefix_cache"))
     ]
     if not cands:
         return None, None
@@ -485,20 +494,57 @@ def decode_child_main(cfg):
     t0 = time.time()
     _hb("engine warmup start (prefill ladder + decode step compiles)")
     prompt_len = cfg.get("prompt_len", 32)
+    rs = np.random.RandomState(0)
+    prefix_cache = bool(cfg.get("prefix_cache"))
+    eng_kw = {}
+    shared = None
+    if prefix_cache:
+        # BENCH_DECODE prefix rung: every request shares a system-prompt
+        # prefix of ~prefix_share of the prompt (block-aligned); the
+        # store is sized generously so the trial measures reuse, not
+        # eviction churn
+        from paddle_tpu.models.gpt import prefix_block_bytes
+
+        block = int(cfg.get("prefix_block", 16))
+        share = float(cfg.get("prefix_share", 0.9))
+        # block-aligned, and capped at prompt_len - 1 so the suffix is
+        # never empty (mirrors the engine's len-1 lookup cap); a prompt
+        # too short to hold even one shared block is a config error,
+        # reported instead of crashing mk_prompt with a negative size
+        shared_len = min(int(prompt_len * share) // block * block,
+                         (prompt_len - 1) // block * block)
+        if shared_len < block:
+            _child_fail(
+                "config",
+                "prefix rung needs prompt_len > prefix_block "
+                "(prompt_len %d, block %d, share %.2f)"
+                % (prompt_len, block, share),
+            )
+        shared = list(rs.randint(0, gcfg.vocab_size, shared_len))
+        blocks = 8 * (shared_len // block)
+        eng_kw = dict(
+            prefix_block=block,
+            prefix_cache_mb=blocks * prefix_block_bytes(gcfg, block)
+            / 2.0 ** 20,
+        )
     engine = DecodeEngine(
         gcfg, scope=scope, slots=streams, max_len=max_len,
         prefill_buckets=[prompt_len, max_len], param_program=main_prog,
+        **eng_kw
     ).start()
     _hb("engine warmup ok %.1fs" % (time.time() - t0))
     try:
-        rs = np.random.RandomState(0)
         n_requests = cfg.get("requests", 4 * streams)
         max_new = cfg.get("max_new", 64)
+
+        def mk_prompt():
+            if shared is None:
+                return list(rs.randint(0, gcfg.vocab_size, prompt_len))
+            return shared + list(rs.randint(
+                0, gcfg.vocab_size, prompt_len - len(shared)))
+
         handles = [
-            engine.generate(
-                list(rs.randint(0, gcfg.vocab_size, prompt_len)),
-                max_new_tokens=max_new,
-            )
+            engine.generate(mk_prompt(), max_new_tokens=max_new)
             for _ in range(n_requests)
         ]
         samples = [(time.perf_counter(),
@@ -535,6 +581,19 @@ def decode_child_main(cfg):
         "steps": stats["steps"],
         "device": device,
     }
+    if prefix_cache:
+        hit_ttfts = [h.ttft_ms for h in handles
+                     if getattr(h, "cached_prefix_tokens", 0) > 0
+                     and h.ttft_ms is not None]
+        result.update({
+            "prefix_share": round(len(shared) / prompt_len, 3),
+            "prefix_hits": stats.get("prefix_hits", 0),
+            "prefix_hit_rate": round(
+                stats.get("prefix_hits", 0) / max(1, n_requests), 3),
+            "cached_prefix_tokens": stats.get("prefix_cached_tokens", 0),
+            "ttft_ms": round(float(np.mean(hit_ttfts)), 2)
+            if hit_ttfts else None,
+        })
     if census is not None:
         for k in ("flops", "bytes_accessed", "out_bytes"):
             if census.get(k) is not None:
@@ -1228,6 +1287,56 @@ def parent_main():
             tunnel_suspect = True
         return False
 
+    def try_decode_prefix_tpu(slot):
+        """BENCH_DECODE=1 prefix rung: tokens/sec/user AND mean hit TTFT
+        through the prefix-cache + resume-prefill path at ~90% prefix
+        share, banked under 'gpt_decode_prefix'. Bank-only, and doubly
+        guarded: bank_best hides it from any prefix not containing
+        'prefix' (an amortized shared-prefix rate must never replace the
+        cold-prompt 'gpt_decode' headline)."""
+        nonlocal tunnel_suspect
+        cfg = {
+            "platform": os.environ.get("BENCH_DECODE_PLATFORM", ""),
+            "decode": True,
+            "prefix_cache": True,
+            "streams": int(os.environ.get("BENCH_DECODE_STREAMS", "8")),
+            "max_len": int(os.environ.get("BENCH_DECODE_MAXLEN", "256")),
+            "max_new": int(os.environ.get("BENCH_DECODE_MAXNEW", "64")),
+            "prompt_len": int(os.environ.get("BENCH_DECODE_PREFIX_PROMPT",
+                                             "128")),
+            "prefix_block": int(os.environ.get("BENCH_DECODE_PREFIX_BLOCK",
+                                               "16")),
+            "prefix_share": float(os.environ.get("BENCH_DECODE_PREFIX_SHARE",
+                                                 "0.9")),
+            "layers": int(os.environ.get("BENCH_DECODE_LAYERS", "12")),
+            "hidden": int(os.environ.get("BENCH_DECODE_HIDDEN", "768")),
+            "heads": int(os.environ.get("BENCH_DECODE_HEADS", "12")),
+            "vocab": int(os.environ.get("BENCH_DECODE_VOCAB", "50257")),
+            "flash": os.environ.get("BENCH_DECODE_FLASH", "0") == "1",
+        }
+        label = "decode-prefix-gpt-%ds-p%d" % (cfg["streams"],
+                                               cfg["prompt_len"])
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, tpu_deadline()
+        )
+        if result is not None:
+            if result["device"] == "tpu":
+                bank_write("gpt_decode_prefix", _bank_entry(dict(result, **{
+                    "metric": "gpt2_decode_prefix_throughput",
+                    "value": round(result["tok_per_sec_user"], 2),
+                    "unit": "tokens/sec/user",
+                    "device": "tpu",
+                    "decode": True,
+                    "prefix_cache": True,
+                    "tok_per_sec": round(result["tok_per_sec"], 1),
+                    "flash_attention": cfg["flash"],
+                })))
+            return True
+        note_fail("decode", label, kind, err)
+        if kind == "no_tpu" or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
     def bank_cpu_fallbacks():
         # a banked TPU number makes the CPU fallback pointless — skip it
         # and leave the window to phase-D TPU retries
@@ -1280,9 +1389,11 @@ def parent_main():
     if os.environ.get("BENCH_SERVING", "0") == "1" and not tunnel_suspect:
         try_serving_tpu(300.0)
 
-    # ---- phase B3: opt-in decode rung (BENCH_DECODE=1; bank-only) ----
+    # ---- phase B3: opt-in decode rungs (BENCH_DECODE=1; bank-only):
+    # the cold-prompt headline, then the ~90%-prefix-share rung ----
     if os.environ.get("BENCH_DECODE", "0") == "1" and not tunnel_suspect:
         try_decode_tpu(300.0)
+        try_decode_prefix_tpu(300.0)
 
     # ---- phase C: degraded CPU fallbacks for anything still missing ----
     bank_cpu_fallbacks()

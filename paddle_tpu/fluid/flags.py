@@ -82,6 +82,18 @@ _DEFAULTS = {
     "decode_max_len": 0,
     "decode_prefill_buckets": "",
     "decode_queue_depth": 64,
+    # prefix KV-cache reuse + chunked prefill: decode_prefix_cache_mb
+    # bounds the device-resident block store shared-prefix K/V is
+    # published to (0 = prefix caching off); decode_prefix_block is the
+    # reuse granularity in tokens (a prompt reuses its longest cached
+    # whole-block prefix, hash-chain keyed and token-verified);
+    # decode_prefill_chunk caps how many prompt tokens one engine tick
+    # may prefill (0 = monolithic prefill at admission) so a long
+    # prompt admits as bucket-shaped resume-prefill chunks interleaved
+    # with the fused decode steps instead of stalling live streams.
+    "decode_prefix_block": 64,
+    "decode_prefix_cache_mb": 0.0,
+    "decode_prefill_chunk": 0,
     # HTTP serving gateway (paddle_tpu/serving/gateway.py): the network
     # front door over InferenceServer (+ attached DecodeEngine).
     # gateway_port binds the listener (0 = ephemeral — tests/probes read

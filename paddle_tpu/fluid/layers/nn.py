@@ -19,6 +19,8 @@ __all__ = [
     "flash_attention",
     "flash_decode_attention",
     "kv_cache_write",
+    "kv_cache_copy",
+    "kv_cache_gather",
     "scale",
     "sequence_pool",
     "sequence_first_step",
@@ -1413,7 +1415,10 @@ def kv_cache_write(cache, new, pos, slot_mode=False, name=None):
     position. ``slot_mode=True`` (prefill): ``new`` [1, heads, T,
     d_head] is one prompt's K/V, ``pos`` a scalar slot index — the row's
     first T positions are replaced (stale tail stays masked until decode
-    overwrites it position by position). Inference-only (no gradient)."""
+    overwrites it position by position). A 2-element ``pos``
+    (slot, offset) lands the block at ``offset`` within the row instead
+    of position 0 — resume-prefill's suffix-window write after a cached
+    prefix. Inference-only (no gradient)."""
     helper = LayerHelper("kv_cache_write", **locals())
     helper.append_op(
         type="kv_cache_write",
@@ -1422,6 +1427,46 @@ def kv_cache_write(cache, new, pos, slot_mode=False, name=None):
         attrs={"slot_mode": bool(slot_mode)},
     )
     return cache
+
+
+def kv_cache_copy(dst, src, dst_loc, src_loc, length, name=None):
+    """Block-granular transfer between two K/V pools: copies
+    ``src[src_loc[0], :, src_loc[1]:src_loc[1]+length, :]`` into
+    ``dst[dst_loc[0], :, dst_loc[1]:dst_loc[1]+length, :]`` by a
+    dynamic-slice → dynamic-update-slice pair — O(copied bytes), the
+    same cost discipline as ``kv_cache_write``. Both 2-element
+    (row, position) locations are runtime data, so ONE compiled program
+    moves any cached prefix block between the prefix store and a slot
+    row (either direction: pass the store as ``src`` to admit a hit,
+    as ``dst`` to publish a finished prefill). Returns ``dst`` — the
+    op's output aliases its input var, so the executor persists the
+    updated pool and, with donation armed, XLA copies in place.
+    Inference-only (no gradient)."""
+    helper = LayerHelper("kv_cache_copy", **locals())
+    helper.append_op(
+        type="kv_cache_copy",
+        inputs={"Dst": [dst], "Src": [src], "DstLoc": [dst_loc],
+                "SrcLoc": [src_loc]},
+        outputs={"Out": [dst]},
+        attrs={"length": int(length)},
+    )
+    return dst
+
+
+def kv_cache_gather(cache, slot_idx, name=None):
+    """One slot's [1, heads, max_len, d_head] row of a
+    [slots, heads, max_len, d_head] cache pool, selected by a fed index
+    (runtime data — every slot shares one compiled program). The read
+    half of resume-prefill: the suffix window's queries attend over the
+    full updated row. Inference-only (no gradient)."""
+    helper = LayerHelper("kv_cache_gather", **locals())
+    out = helper.create_variable_for_type_inference(dtype=cache.dtype)
+    helper.append_op(
+        type="kv_cache_gather",
+        inputs={"Cache": [cache], "Pos": [slot_idx]},
+        outputs={"Out": [out]},
+    )
+    return out
 
 
 def cos_sim(X, Y):

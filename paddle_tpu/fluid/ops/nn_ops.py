@@ -935,8 +935,13 @@ def _kv_cache_write(ctx, op_):
     pos = ctx.in1(op_, "Pos")
     z = jnp.int32(0)
     if bool(op_.attr("slot_mode", False)):
-        slot = pos.reshape(()).astype(jnp.int32)
-        out = jax.lax.dynamic_update_slice(cache, new, (slot, z, z, z))
+        # Pos is (slot,) or (slot, offset) — the 2-element form lands the
+        # block at a fed position WITHIN the slot's row (resume-prefill:
+        # a suffix window written after a cached prefix). The element
+        # count is part of the fed shape, so the branch is static.
+        p = pos.reshape(-1).astype(jnp.int32)
+        off = p[1] if p.shape[0] > 1 else z
+        out = jax.lax.dynamic_update_slice(cache, new, (p[0], z, off, z))
     else:
         p = pos.reshape(-1).astype(jnp.int32)  # [slots]
 
@@ -945,6 +950,60 @@ def _kv_cache_write(ctx, op_):
 
         out = jax.vmap(one)(cache, new, p)
     ctx.out(op_, "Out", out)
+
+
+def _kv_cache_copy_infer(op_, block):
+    d = in_var(op_, block, "Dst")
+    set_out(op_, block, "Out", list(d.shape), d.dtype)
+
+
+@op("kv_cache_copy", infer_shape=_kv_cache_copy_infer)
+def _kv_cache_copy(ctx, op_):
+    """Block-granular K/V transfer between two cache pools (the prefix
+    store and a request's slot row): a ``length``-token block is sliced
+    out of ``Src`` at (src row, src position) and update-sliced into
+    ``Dst`` at (dst row, dst position) — slice-to-slice, O(copied
+    bytes), like ``kv_cache_write``. Every index is runtime DATA, so
+    one compiled program moves any block between any rows; only the
+    (static) block length is part of the shape. Inference-only — no
+    gradient registered."""
+    import jax
+    import jax.numpy as jnp
+
+    dst = ctx.in1(op_, "Dst")
+    src = ctx.in1(op_, "Src")
+    dl = ctx.in1(op_, "DstLoc").reshape(-1).astype(jnp.int32)
+    sl = ctx.in1(op_, "SrcLoc").reshape(-1).astype(jnp.int32)
+    length = int(op_.attr("length", 0))
+    z = jnp.int32(0)
+    heads, d_head = int(src.shape[1]), int(src.shape[3])
+    blk = jax.lax.dynamic_slice(
+        src, (sl[0], z, sl[1], z), (1, heads, length, d_head)
+    ).astype(dst.dtype)
+    ctx.out(op_, "Out",
+            jax.lax.dynamic_update_slice(dst, blk, (dl[0], z, dl[1], z)))
+
+
+def _kv_cache_gather_infer(op_, block):
+    c = in_var(op_, block, "Cache")
+    set_out(op_, block, "Out", [1] + list(c.shape)[1:], c.dtype)
+
+
+@op("kv_cache_gather", infer_shape=_kv_cache_gather_infer)
+def _kv_cache_gather(ctx, op_):
+    """Select ONE slot's [1, heads, max_len, d_head] cache row at a fed
+    index — the read half of resume-prefill: the window's queries attend
+    over the full updated row (cached prefix + just-written window).
+    The index is runtime data; O(row bytes). Inference-only."""
+    import jax
+    import jax.numpy as jnp
+
+    cache = ctx.in1(op_, "Cache")
+    p = ctx.in1(op_, "Pos").reshape(-1).astype(jnp.int32)
+    z = jnp.int32(0)
+    ctx.out(op_, "Out", jax.lax.dynamic_slice(
+        cache, (p[0], z, z, z), (1,) + tuple(cache.shape[1:])
+    ))
 
 
 @op("flash_attention_grad")

@@ -137,6 +137,20 @@ def _apply_kv_cache(cache, k, v, cfg):
         fluid.layers.kv_cache_write(cache["v"], v, cache["slot_idx"],
                                     slot_mode=True)
         return k, v
+    if cache["mode"] == "resume":
+        # resume-prefill: the window's K/V lands at the fed
+        # (slot, offset) — AFTER a cached prefix already copied into the
+        # row head — and attention needs the full updated row (prefix +
+        # window), so gather the slot back out. Both indices are runtime
+        # data: one compiled program per bucket covers every offset.
+        k_upd = fluid.layers.kv_cache_write(cache["k"], k,
+                                            cache["slot_off"],
+                                            slot_mode=True)
+        v_upd = fluid.layers.kv_cache_write(cache["v"], v,
+                                            cache["slot_off"],
+                                            slot_mode=True)
+        return (fluid.layers.kv_cache_gather(k_upd, cache["slot_off"]),
+                fluid.layers.kv_cache_gather(v_upd, cache["slot_off"]))
     k_upd = fluid.layers.kv_cache_write(cache["k"], k, cache["pos"])
     v_upd = fluid.layers.kv_cache_write(cache["v"], v, cache["pos"])
     return k_upd, v_upd
@@ -167,9 +181,10 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
       prompt's K/V into the cache slot indexed by the fed scalar
       ``slot_idx``;
     - ``"decode"``: the single-query step. Each slot's new-token K/V
-      lands at its fed ``pos`` [slots] cache position (free slots write
-      a dead row's position 0 — harmless, the row is masked and replaced
-      on admission), then the length-1 query attends over the updated
+      lands at its fed ``pos`` [slots] cache position (inactive slots
+      write wherever the engine aims them — a dead row tolerates any
+      spot; a mid-chunked-prefill row gets its next window start, which
+      the window rewrites), then the length-1 query attends over the updated
       cache under ``key_bias`` [slots, max_len] (additive, -1e4 beyond
       each slot's live length) — via the decode-mode flash kernel when
       ``use_flash``, dense single-query attention otherwise.
@@ -194,6 +209,29 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     v = _split_heads(_proj(kv_in, "v"))
     if cache is not None:
         k, v = _apply_kv_cache(cache, k, v, cfg)
+    if cache is not None and cache["mode"] == "resume":
+        # resume-prefill: window queries [1, heads, T, d] against the
+        # slot's full updated row [1, heads, max_len, d] under the FED
+        # [T, max_len] additive bias (0 on cache position j <= offset+i
+        # for window query i, -1e4 beyond) — the causal mask shifted by
+        # the runtime offset, which must stay out of the compiled shape.
+        # Dense by design even for flash configs: the causal flash
+        # kernel assumes an aligned q/k diagonal, and the window×row
+        # product is the decode-step regime, not the [T, T] prefill one.
+        scale_ = 1.0 / math.sqrt(d_head)
+        scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=scale_)
+        bias4 = fluid.layers.unsqueeze(cache["resume_bias"], axes=[1])
+        bias4.stop_gradient = True
+        weights = fluid.layers.softmax(
+            fluid.layers.elementwise_add(scores, bias4), axis=-1
+        )
+        ctxt = fluid.layers.matmul(weights, v)
+        ctxt = fluid.layers.transpose(ctxt, perm=[0, 2, 1, 3])
+        ctxt = fluid.layers.reshape(ctxt, shape=[0, 0, cfg.hidden_size])
+        return fluid.layers.fc(
+            input=ctxt, size=cfg.hidden_size, num_flatten_dims=2,
+            name="%s_out" % name,
+        )
     if cache is not None and cache["mode"] == "decode":
         scale_ = 1.0 / math.sqrt(d_head)
         if use_flash:

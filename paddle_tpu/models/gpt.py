@@ -82,7 +82,13 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg, kv_cache=None):
     key_bias = None
     attn_bias = None
     mode = kv_cache["mode"] if kv_cache is not None else None
-    if mode == "decode":
+    if mode == "resume":
+        # resume-prefill window: masking lives entirely in the fed
+        # [T, max_len] resume bias (offset-shifted causal + prefix),
+        # and attention is dense window×row by design — see
+        # multi_head_attention's resume branch
+        use_flash = False
+    elif mode == "decode":
         # single-query step: masking lives entirely in the fed per-slot
         # cache key bias; the flash policy keys on the CACHE length (the
         # kv extent the kernel actually sweeps), not the length-1 query
@@ -123,6 +129,9 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg, kv_cache=None):
             cache_i = {"k": k_var, "v": v_var, "mode": mode}
             if mode == "prefill":
                 cache_i["slot_idx"] = kv_cache["slot_idx"]
+            elif mode == "resume":
+                cache_i["slot_off"] = kv_cache["slot_off"]
+                cache_i["resume_bias"] = kv_cache["resume_bias"]
             else:
                 cache_i["pos"] = kv_cache["pos"]
                 cache_i["key_bias"] = kv_cache["key_bias"]
@@ -304,6 +313,134 @@ def build_gpt_prefill(cfg, slots, seq_len, max_len):
     return main, startup, feeds, next_logits
 
 
+def build_gpt_resume_prefill(cfg, slots, seq_len, max_len):
+    """Resume-prefill graph: ONE prompt *window* (batch 1, padded to the
+    ``seq_len`` bucket) prefills starting at a FED cache position — the
+    program-shape family behind prefix-cache hits and chunked prefill.
+    Per layer the window's K/V is written at (slot, offset) — both
+    runtime data via ``slot_off`` [2], so the whole bucket ladder keeps
+    compiling exactly once regardless of where windows land — and the
+    window's queries attend DENSE over the slot's full updated row
+    (cached prefix + window) under the fed ``resume_bias``
+    [seq_len, max_len]: 0 where cache position j <= offset + i for
+    window query i, -1e4 beyond. That bias IS the causal mask shifted
+    by the runtime offset; feeding it keeps the offset out of the
+    compiled shape. ``last_onehot`` selects the last real window
+    token's logits (meaningful on a prompt's FINAL window; earlier
+    chunks ignore the fetch).
+
+    Returns (main, startup, feed names, next_logits [1, vocab])."""
+    import copy
+
+    cfg = copy.copy(cfg)
+    cfg.is_test = True
+    main, startup = fluid.Program(), fluid.Program()
+    # donate: the window write updates the slot row in the cache's own
+    # buffer, like the prefill/decode programs
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[seq_len, 1],
+                                dtype="int64")
+        pos_ids = fluid.layers.data(name="pos_ids", shape=[seq_len, 1],
+                                    dtype="int64")
+        slot_off = fluid.layers.data(name="slot_off", shape=[2],
+                                     dtype="int64")
+        resume_bias = fluid.layers.data(
+            name="resume_bias", shape=[seq_len, max_len], dtype="float32"
+        )
+        last_onehot = fluid.layers.data(
+            name="last_onehot", shape=[seq_len, 1], dtype="float32"
+        )
+        kv_cache = {
+            "mode": "resume",
+            "caches": _declare_cache_vars(cfg, slots, max_len),
+            "slot_off": slot_off,
+            "resume_bias": resume_bias,
+            "max_len": max_len,
+        }
+        logits = gpt_lm_logits(ids, pos_ids, None, cfg, kv_cache=kv_cache)
+        next_logits = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(logits, last_onehot), dim=1
+        )
+    feeds = ["ids", "pos_ids", "slot_off", "resume_bias", "last_onehot"]
+    return main, startup, feeds, next_logits
+
+
+# -- prefix K/V store (device-resident block pool for prefix-cache reuse) ----
+
+
+def prefix_store_names(cfg, blocks, block):
+    """Per-layer (K, V) prefix-store var names. Pool geometry is part of
+    the name for the same reason as ``decode_cache_names``: two stores
+    of different shapes sharing one scope must never alias."""
+    return [
+        ("gpt_prefix_k_%d_n%dx%d" % (i, blocks, block),
+         "gpt_prefix_v_%d_n%dx%d" % (i, blocks, block))
+        for i in range(cfg.num_layers)
+    ]
+
+
+def prefix_store_shape(cfg, blocks, block):
+    return [
+        int(blocks), cfg.num_heads, int(block),
+        cfg.hidden_size // cfg.num_heads,
+    ]
+
+
+def prefix_block_bytes(cfg, block):
+    """Device bytes one cached prefix block costs across all layers
+    (K + V, fp32) — what ``FLAGS_decode_prefix_cache_mb`` divides by."""
+    d_head = cfg.hidden_size // cfg.num_heads
+    return cfg.num_layers * 2 * cfg.num_heads * int(block) * d_head * 4
+
+
+def _declare_prefix_store_vars(cfg, blocks, block):
+    main_block = fluid.default_main_program().global_block()
+    shape = prefix_store_shape(cfg, blocks, block)
+    return [
+        tuple(
+            main_block.create_var(
+                name=n, shape=shape, dtype="float32", persistable=True
+            )
+            for n in names
+        )
+        for names in prefix_store_names(cfg, blocks, block)
+    ]
+
+
+def build_gpt_prefix_copy(cfg, slots, max_len, blocks, block,
+                          publish=False):
+    """ONE compiled block move between the prefix store and the slot
+    cache, across every layer's K and V: ``publish=False`` copies store
+    block ``src_loc`` into the slot row at ``dst_loc`` (admitting a
+    hit), ``publish=True`` copies a slot-row block into the store
+    (publishing a finished prefill). Both 2-element (row, position)
+    locations are fed int64 — runtime data, so a prompt's whole cached
+    prefix is n runs of this one program, O(copied bytes) each, and the
+    strict-compile gate never sees block placement.
+
+    Returns (main, startup, feed names, ok) — ``ok`` is a dummy scalar
+    fetch; the real outputs are the persistable pools themselves."""
+    main, startup = fluid.Program(), fluid.Program()
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        dst_loc = fluid.layers.data(name="dst_loc", shape=[2],
+                                    dtype="int64")
+        src_loc = fluid.layers.data(name="src_loc", shape=[2],
+                                    dtype="int64")
+        caches = _declare_cache_vars(cfg, slots, max_len)
+        stores = _declare_prefix_store_vars(cfg, blocks, block)
+        for (ck, cv), (sk, sv) in zip(caches, stores):
+            if publish:
+                fluid.layers.kv_cache_copy(sk, ck, dst_loc, src_loc, block)
+                fluid.layers.kv_cache_copy(sv, cv, dst_loc, src_loc, block)
+            else:
+                fluid.layers.kv_cache_copy(ck, sk, dst_loc, src_loc, block)
+                fluid.layers.kv_cache_copy(cv, sv, dst_loc, src_loc, block)
+        ok = fluid.layers.fill_constant(shape=[1], dtype="int32", value=1)
+    return main, startup, ["dst_loc", "src_loc"], ok
+
+
 def build_gpt_decode_step(cfg, slots, max_len):
     """Single-step decode graph: one new token per slot (query length 1)
     against the per-layer KV caches. Feeds — all fixed-shape, so ONE
@@ -312,8 +449,10 @@ def build_gpt_decode_step(cfg, slots, max_len):
 
     - ``step_ids`` / ``step_pos`` [slots, 1, 1] int64: each slot's newest
       token and its cache position, which is also where its K/V is
-      scatter-written (inactive slots feed zeros: they write a dead
-      row's position 0, masked and replaced on admission);
+      scatter-written (inactive slots feed a zero token at a CALLER-
+      CHOSEN position — a free slot's dead row tolerates any landing
+      spot, but a mid-chunked-prefill row is live and the engine aims
+      the masked write at its next window start);
     - ``key_bias`` [slots, max_len]: additive mask, 0 on live cache
       positions (<= the slot's current position), -1e4 beyond — the only
       mask decode needs, and the causal mask by construction.

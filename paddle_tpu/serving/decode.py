@@ -21,10 +21,33 @@ the weights plus the cache; PAPERS "Operator Fusion in XLA"), which is
 exactly why batching all slots into one step is the throughput lever:
 the weight traffic amortizes over every live stream.
 
+Two prefill amortizations ride the same zero-recompile discipline:
+
+  prefix cache   a device-resident, block-granular K/V store
+                 (``PrefixCache`` host index + per-layer persistable
+                 pools) keyed by the hash-chain of prompt token blocks:
+                 admission copies the longest cached prefix into the
+                 slot row (``kv_cache_copy``, O(copied bytes)) and only
+                 the suffix runs a **resume-prefill** program — the
+                 bucket ladder with the start position FED as runtime
+                 data. Finished prefills publish their blocks back
+                 under LRU eviction bounded by
+                 ``FLAGS_decode_prefix_cache_mb``, ref-counted so an
+                 in-use block is never evicted mid-copy. Cached K/V are
+                 the same projections the full forward computes, so hit
+                 and miss paths stay token-exact vs the oracle.
+  chunked prefill  ``FLAGS_decode_prefill_chunk`` caps how many prompt
+                 tokens one tick may prefill: a long prompt admits as
+                 bucket-shaped resume windows interleaved with the
+                 fused decode steps, bounding live streams' inter-token
+                 latency instead of stalling them for a monolithic
+                 prefill.
+
 Layering: ``DecodeSession`` is the synchronous core (programs, cache
-init, prefill / fused step) — ``gpt.greedy_generate`` drives a 1-slot
-session inline; ``DecodeEngine`` owns the continuous-batching loop
-(admission queue, slot scheduler, streaming) and is what
+init, prefill / resume windows / block copies / fused step) —
+``gpt.greedy_generate`` drives a 1-slot session inline;
+``DecodeEngine`` owns the continuous-batching loop (admission queue,
+prefix store, chunked-prefill scheduler, streaming) and is what
 ``InferenceServer.generate()`` fronts.
 """
 
@@ -54,6 +77,7 @@ __all__ = [
     "DecodeSession",
     "DecodeEngine",
     "GenerationStream",
+    "PrefixCache",
     "prefill_ladder",
     "sample_token",
     "session_for_generate",
@@ -104,6 +128,164 @@ def prefill_ladder(max_len, buckets=None):
     return out
 
 
+# ---------------------------------------------------------------------------
+# prefix K/V cache — host index over the device-resident block store
+# ---------------------------------------------------------------------------
+
+
+def _block_hash(prev_key, tokens):
+    """Chain digest for one prompt block: block i's key folds in block
+    i-1's, so equal keys mean equal WHOLE prefixes. A real digest
+    (sha256 over prev_digest || token bytes), NOT ``hash()`` — the
+    gateway hands this map client-controlled token ids, and a
+    birthday-searchable 61-bit key would let a tenant engineer
+    cross-request K/V reuse. A module-level hook so tests can inject
+    colliding functions; the cache never trusts the key alone — every
+    match re-compares the stored (prev, tokens) link and falls through
+    to the full-prefill path on mismatch."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr(prev_key).encode())
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.hexdigest()
+
+
+class _PrefixEntry(object):
+    __slots__ = ("key", "prev", "tokens", "block_idx", "refs")
+
+    def __init__(self, key, prev, tokens, block_idx):
+        self.key = key
+        self.prev = prev
+        self.tokens = tokens
+        self.block_idx = block_idx
+        self.refs = 0
+
+
+class PrefixCache(object):
+    """Host-side index of the device prefix store: maps hash-chained
+    prompt-token blocks to store block indices, with LRU eviction and
+    ref-count pinning. The device pool itself (per-layer persistable
+    [blocks, heads, block, d_head] vars) is owned by ``DecodeSession``;
+    this class only decides WHICH block lives WHERE — the engine moves
+    the bytes via the compiled copy programs.
+
+    Single-mutator discipline: the engine's loop thread is the only
+    caller of ``lookup``/``publish``/``release``; pinning exists so an
+    eviction forced by one admission's publish can never reclaim a
+    block another in-flight admission is still copying from
+    (``refs > 0`` blocks are skipped by the LRU sweep)."""
+
+    def __init__(self, blocks, block):
+        if blocks < 1 or block < 1:
+            raise ValueError(
+                "need blocks >= 1 and block >= 1, got %d / %d"
+                % (blocks, block)
+            )
+        self.blocks = int(blocks)
+        self.block = int(block)
+        from collections import OrderedDict
+
+        self._entries = OrderedDict()  # key -> _PrefixEntry, LRU order
+        self._free = list(range(self.blocks))
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def lookup(self, prompt):
+        """Longest cached block-chain prefix of ``prompt``, capped at
+        ``len(prompt) - 1`` tokens so admission ALWAYS recomputes at
+        least the last prompt token (its logits are the first emitted
+        token — a full-prompt hit would leave nothing to emit from).
+        Returns (entries, tokens); every returned entry is PINNED —
+        the caller must ``release`` them once its device copy is done.
+        A hash collision (equal key, different stored tokens) stops the
+        chain: the suffix from there runs the normal prefill path."""
+        usable = (len(prompt) - 1) // self.block
+        out = []
+        prev = 0
+        for b in range(usable):
+            toks = tuple(prompt[b * self.block:(b + 1) * self.block])
+            key = _block_hash(prev, toks)
+            e = self._entries.get(key)
+            # verify the WHOLE chain link, not just this block's tokens:
+            # a key collision with equal tokens but a different parent
+            # (A||X vs B||X) would otherwise splice another prompt's
+            # prefix K/V into this request
+            if e is None or e.tokens != toks or e.prev != prev:
+                break
+            out.append(e)
+            prev = key
+        for e in out:
+            e.refs += 1
+            self._entries.move_to_end(e.key)
+        return out, len(out) * self.block
+
+    def release(self, entries):
+        for e in entries:
+            e.refs -= 1
+
+    def publish(self, prompt):
+        """Register every full block of ``prompt`` not cached yet.
+        Returns [(entry, prompt_block_index)] for the NEW entries — the
+        caller must copy those blocks from the slot row into
+        ``entry.block_idx`` (or ``forget`` them on failure). Allocation
+        evicts the least-recently-used UNPINNED entry when the free
+        list is empty; an all-pinned store stops publishing instead of
+        corrupting a block mid-copy."""
+        new = []
+        prev = 0
+        for b in range(len(prompt) // self.block):
+            toks = tuple(prompt[b * self.block:(b + 1) * self.block])
+            key = _block_hash(prev, toks)
+            e = self._entries.get(key)
+            if e is not None:
+                if e.tokens != toks or e.prev != prev:
+                    break  # collision squatting on the key: stop chaining
+                self._entries.move_to_end(key)
+                prev = key
+                continue
+            idx = self._alloc()
+            if idx is None:
+                break  # every block pinned by in-flight copies
+            e = _PrefixEntry(key, prev, toks, idx)
+            self._entries[key] = e
+            new.append((e, b))
+            prev = key
+        return new
+
+    def forget(self, entry):
+        """Drop a registration whose device copy failed — the block
+        returns to the free list and the key stops matching."""
+        if self._entries.get(entry.key) is entry:
+            del self._entries[entry.key]
+            self._free.append(entry.block_idx)
+
+    def _alloc(self):
+        if self._free:
+            return self._free.pop()
+        victim = None
+        for e in self._entries.values():  # oldest first
+            if e.refs <= 0:
+                victim = e
+                break
+        if victim is None:
+            return None
+        del self._entries[victim.key]
+        self.evictions += 1
+        _profiler.bump_counter("decode_prefix_evictions")
+        return victim.block_idx
+
+    def stats(self):
+        return {
+            "blocks": self.blocks,
+            "block": self.block,
+            "cached_blocks": len(self._entries),
+            "evictions": self.evictions,
+        }
+
+
 class DecodeSession(object):
     """Synchronous KV-cache decode core over one Executor + scope.
 
@@ -117,7 +299,8 @@ class DecodeSession(object):
     caller of ``greedy_generate``)."""
 
     def __init__(self, cfg, place=None, scope=None, slots=None,
-                 max_len=None, prefill_buckets=None):
+                 max_len=None, prefill_buckets=None, prefix_blocks=0,
+                 prefix_block=None, build_resume=False):
         self.cfg = copy.copy(cfg)
         self.cfg.is_test = True
         self.slots = int(_flag("decode_slots", slots))
@@ -166,6 +349,43 @@ class DecodeSession(object):
                 self.cfg, self.slots, max_len
             )
         self._decode = (main, step_logits.name)
+        # resume-prefill family (prefix-cache hits + chunked prefill):
+        # one program per bucket, prefilling a window at a FED offset.
+        # Graph-built only on request — a greedy_generate 1-slot session
+        # never pays the construction, and nothing compiles until the
+        # engine's warmup actually runs a window
+        self.prefix_block = int(_flag("decode_prefix_block", prefix_block))
+        self.prefix_blocks = int(prefix_blocks)
+        if self.prefix_blocks < 0 or self.prefix_block < 1:
+            raise ValueError(
+                "need prefix_blocks >= 0 and prefix_block >= 1, got %d / %d"
+                % (self.prefix_blocks, self.prefix_block)
+            )
+        self._resume = {}
+        if build_resume or self.prefix_blocks:
+            for seq_len in self.buckets:
+                with fluid.unique_name.guard():
+                    main, _s, _f, nl = _gpt.build_gpt_resume_prefill(
+                        self.cfg, self.slots, seq_len, max_len
+                    )
+                self._resume[seq_len] = (main, nl.name)
+        # block-copy programs between the prefix store and slot rows —
+        # both directions, each ONE compiled program with fed locations
+        self._copy_in = None
+        self._publish = None
+        if self.prefix_blocks:
+            with fluid.unique_name.guard():
+                m_in, _s, _f, ok_in = _gpt.build_gpt_prefix_copy(
+                    self.cfg, self.slots, max_len, self.prefix_blocks,
+                    self.prefix_block, publish=False,
+                )
+            self._copy_in = (m_in, ok_in.name)
+            with fluid.unique_name.guard():
+                m_pub, _s, _f, ok_pub = _gpt.build_gpt_prefix_copy(
+                    self.cfg, self.slots, max_len, self.prefix_blocks,
+                    self.prefix_block, publish=True,
+                )
+            self._publish = (m_pub, ok_pub.name)
         self._cols = np.arange(max_len)
         self._pos_cache = {
             T: np.arange(T).reshape(1, T, 1).astype("int64")
@@ -185,6 +405,15 @@ class DecodeSession(object):
         ):
             self.scope.set(k_name, np.zeros(shape, "float32"))
             self.scope.set(v_name, np.zeros(shape, "float32"))
+        if self.prefix_blocks:
+            pshape = _gpt.prefix_store_shape(
+                self.cfg, self.prefix_blocks, self.prefix_block
+            )
+            for k_name, v_name in _gpt.prefix_store_names(
+                self.cfg, self.prefix_blocks, self.prefix_block
+            ):
+                self.scope.set(k_name, np.zeros(pshape, "float32"))
+                self.scope.set(v_name, np.zeros(pshape, "float32"))
 
     def bind_params(self, program):
         """Alias ``program``'s parameters onto this session's canonical
@@ -256,15 +485,103 @@ class DecodeSession(object):
         )
         return np.asarray(lv)[0]
 
+    def resume_prefill(self, slot, window_ids, offset):
+        """Prefill a prompt *window* starting at cache position
+        ``offset`` of slot ``slot`` — the suffix after a copied prefix,
+        or one chunk of a chunked prefill. The window pads to its
+        bucket; the offset rides the feed, so the bucket ladder's
+        compiled programs cover every placement. Returns the logits
+        [vocab] at the window's last real token (the next-token logits
+        when this is the prompt's final window)."""
+        P = len(window_ids)
+        if not 0 <= slot < self.slots:
+            raise ValueError("slot %d out of range" % slot)
+        if P < 1:
+            raise ValueError("empty resume window")
+        if not self._resume:
+            raise RuntimeError("session built without resume programs")
+        T = self.bucket_for(P)
+        offset = int(offset)
+        if offset < 0 or offset + T > self.max_len:
+            raise ValueError(
+                "resume window bucket [%d, %d) exceeds max_len %d — the "
+                "engine's window planner must pick a fitting bucket"
+                % (offset, offset + T, self.max_len)
+            )
+        main, fetch_name = self._resume[T]
+        ids = np.zeros((1, T, 1), "int64")
+        ids[0, :P, 0] = window_ids
+        # offset-shifted causal mask over the full row: window query i
+        # (cache position offset+i) sees cache positions <= offset+i —
+        # the copied prefix plus its own causal window. Pad queries
+        # (i >= P) keep a finite row; their output is never selected
+        allow = self._cols[None, :] <= (offset + np.arange(T))[:, None]
+        bias = np.where(allow, 0.0, -1e4).astype("float32")[None]
+        last_onehot = np.zeros((1, T, 1), "float32")
+        last_onehot[0, P - 1, 0] = 1.0
+        feed = {
+            "ids": ids,
+            "pos_ids": (offset + np.arange(T)).reshape(1, T, 1)
+            .astype("int64"),
+            "slot_off": np.array([[slot, offset]], "int64"),
+            "resume_bias": bias,
+            "last_onehot": last_onehot,
+        }
+        t0 = time.perf_counter()
+        with _trace.span("decode_resume_prefill", cat="serving",
+                         bucket=T, rows=P, offset=offset):
+            (lv,) = self.exe.run(
+                main, feed=feed, fetch_list=[fetch_name], scope=self.scope
+            )
+        _profiler.bump_counter("decode_prefills")
+        self.prefills += 1
+        _profiler.bump_histogram(
+            "decode_prefill_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return np.asarray(lv)[0]
+
+    def prefix_copy_in(self, slot, dst_pos, src_block):
+        """Copy prefix-store block ``src_block`` into slot ``slot``'s
+        cache row at position ``dst_pos`` (all layers, K and V) — the
+        hit path's O(copied bytes) replacement for recomputing a
+        block's prefill."""
+        main, fetch_name = self._copy_in
+        with _trace.span("decode_prefix_copy", cat="serving",
+                         block=src_block, pos=dst_pos):
+            self.exe.run(
+                main,
+                feed={"dst_loc": np.array([[slot, dst_pos]], "int64"),
+                      "src_loc": np.array([[src_block, 0]], "int64")},
+                fetch_list=[fetch_name], scope=self.scope,
+            )
+
+    def prefix_publish(self, slot, src_pos, dst_block):
+        """Copy one block of slot ``slot``'s finished prefill (row
+        position ``src_pos``) into prefix-store block ``dst_block`` so
+        future admissions can reuse it."""
+        main, fetch_name = self._publish
+        with _trace.span("decode_prefix_publish", cat="serving",
+                         block=dst_block, pos=src_pos):
+            self.exe.run(
+                main,
+                feed={"dst_loc": np.array([[dst_block, 0]], "int64"),
+                      "src_loc": np.array([[slot, src_pos]], "int64")},
+                fetch_list=[fetch_name], scope=self.scope,
+            )
+
     def decode_step(self, tokens, positions, active):
         """ONE fused step over all slots: slot i's ``tokens[i]`` lands at
         cache position ``positions[i]`` and its next-token logits come
-        back; slots with ``active[i]`` False feed inert zeros (a free
-        slot's dead cache row takes a masked position-0 write; its
-        output is ignored and admission rewrites the row anyway).
-        Returns logits [slots, vocab]."""
+        back; slots with ``active[i]`` False feed an inert zero TOKEN
+        but keep their CALLER-CHOSEN position — the fused program
+        scatter-writes every slot unconditionally, and while a free
+        slot's dead row tolerates any landing spot, a slot mid-chunked-
+        prefill holds live prefix/window K/V, so the engine aims its
+        masked write at the next window's start (overwritten before
+        anything attends to it). The slot's attention output is fully
+        masked and ignored either way. Returns logits [slots, vocab]."""
         act = np.asarray(active, bool)
-        pos = np.where(act, np.asarray(positions, "int64"), 0)
+        pos = np.asarray(positions, "int64")
         tok = np.where(act, np.asarray(tokens, "int64"), 0)
         key_bias = (
             ((self._cols[None, :] > pos[:, None]) | ~act[:, None])
@@ -417,6 +734,15 @@ class GenerationStream(object):
         # the tick a slot was admitted on and the last tick it decoded on
         self.first_tick = None
         self.last_tick = None
+        # latency + prefix-cache facts, engine-stamped: ttft_ms is
+        # submit -> first generated token, cached_prefix_tokens how many
+        # prompt tokens the prefix cache served (0 on a miss / disabled)
+        # — the gateway surfaces both on the SSE done event and the
+        # access log
+        self.ttft_ms = None
+        self.cached_prefix_tokens = 0
+        self._t_submit = time.monotonic()
+        self._t_last_emit = None
         self._q = queue.Queue()
         self._tokens = []
         self._done = threading.Event()
@@ -513,6 +839,21 @@ class _Slot(object):
         self.generated = 1                  # prefill already emitted one
 
 
+class _PrefillJob(object):
+    """A slot mid-prefill: its prompt's remaining bucket-shaped windows.
+    Multi-window jobs (chunked prefill) advance one window per engine
+    tick; ``prefix_tokens`` is the cached-prefix length already copied
+    into the row head."""
+
+    __slots__ = ("stream", "windows", "wi", "prefix_tokens")
+
+    def __init__(self, stream, windows, prefix_tokens):
+        self.stream = stream
+        self.windows = windows
+        self.wi = 0
+        self.prefix_tokens = prefix_tokens
+
+
 # ---------------------------------------------------------------------------
 # continuous-batching engine
 # ---------------------------------------------------------------------------
@@ -537,7 +878,8 @@ class DecodeEngine(object):
 
     def __init__(self, cfg, place=None, scope=None, slots=None,
                  max_len=None, prefill_buckets=None, queue_depth=None,
-                 param_program=None):
+                 param_program=None, prefix_block=None,
+                 prefix_cache_mb=None, prefill_chunk=None):
         self._cfg = cfg
         self._place = place
         self._scope = scope
@@ -546,11 +888,27 @@ class DecodeEngine(object):
         self._buckets_arg = prefill_buckets
         self.queue_depth = int(_flag("decode_queue_depth", queue_depth))
         self._param_program = param_program
+        # prefix caching + chunked prefill knobs: prefix_cache_mb bounds
+        # the device block store (0 = prefix caching off), prefix_block
+        # is the reuse granularity in tokens, prefill_chunk caps how
+        # many prompt tokens one tick may prefill (0 = monolithic)
+        self.prefix_block = int(_flag("decode_prefix_block", prefix_block))
+        self.prefix_cache_mb = float(
+            _flag("decode_prefix_cache_mb", prefix_cache_mb)
+        )
+        self.prefill_chunk = int(_flag("decode_prefill_chunk",
+                                       prefill_chunk))
+        if self.prefill_chunk < 0 or self.prefix_cache_mb < 0:
+            raise ValueError(
+                "prefill_chunk and prefix_cache_mb must be >= 0"
+            )
+        self.prefix = None  # PrefixCache once started (store enabled)
         self.session = None
         self.started = False
         self.tick = 0
         self._pending = deque()
         self._active = {}
+        self._prefilling = {}
         self._free = []
         self._cond = threading.Condition()
         self._stop = False
@@ -558,7 +916,9 @@ class DecodeEngine(object):
         # engine-local tallies: stats() must report THIS engine, not the
         # process-global counters shared with sibling sessions/engines
         self._counts = {"requests": 0, "admissions": 0,
-                        "retirements": 0, "tokens": 0}
+                        "retirements": 0, "tokens": 0,
+                        "prefix_hits": 0, "prefix_misses": 0,
+                        "prefix_cached_tokens": 0}
         self._armed = False
         self._occ_gauge = None
         self._queue_gauge = None
@@ -576,11 +936,21 @@ class DecodeEngine(object):
             raise RuntimeError(
                 "previous decode-engine loop thread has not exited yet"
             )
+        blocks = 0
+        if self.prefix_cache_mb > 0:
+            blocks = max(1, int(
+                self.prefix_cache_mb * 2 ** 20
+                // _gpt.prefix_block_bytes(self._cfg, self.prefix_block)
+            ))
         self.session = DecodeSession(
             self._cfg, place=self._place, scope=self._scope,
             slots=self._slots_arg, max_len=self._max_len_arg,
-            prefill_buckets=self._buckets_arg,
+            prefill_buckets=self._buckets_arg, prefix_blocks=blocks,
+            prefix_block=self.prefix_block,
+            build_resume=bool(blocks or self.prefill_chunk),
         )
+        self.prefix = PrefixCache(blocks, self.prefix_block) \
+            if blocks else None
         if self._param_program is not None:
             self.session.bind_params(self._param_program)
         self._warmup()
@@ -591,7 +961,12 @@ class DecodeEngine(object):
             # flags, occupancy/queue depth publish as scrape-time gauges,
             # and the steady-compile gate arms COUNTED (ownership-scoped)
             _obs_exporter.maybe_start_from_flags()
-            self._occ_gauge = lambda e=self: len(e._active)
+            # occupancy = slots unavailable for admission: decoding AND
+            # mid-chunked-prefill — a fleet autoscaler reading 2/8 while
+            # 6 more slots hold prefilling long prompts would see free
+            # capacity that does not exist
+            self._occ_gauge = lambda e=self: (len(e._active)
+                                              + len(e._prefilling))
             _obs_registry.register_gauge(
                 "serving_slot_occupancy", self._occ_gauge
             )
@@ -638,6 +1013,16 @@ class DecodeEngine(object):
             for T in sess.buckets:
                 P = min(T, sess.max_len - 1)
                 sess.prefill(0, [0] * P)
+            # resume-prefill family + the block-copy programs are part
+            # of the steady state whenever prefix caching / chunking is
+            # armed: compile them here or the first hit/chunk trips the
+            # strict gate
+            if sess._resume:
+                for T in sess.buckets:
+                    sess.resume_prefill(0, [0] * T, 0)
+            if sess._copy_in is not None:
+                sess.prefix_copy_in(0, 0, 0)
+                sess.prefix_publish(0, 0, 0)
             sess.decode_step(
                 [0] * sess.slots, [0] * sess.slots, [False] * sess.slots
             )
@@ -673,14 +1058,16 @@ class DecodeEngine(object):
         # before the drain (failed here) or observes stopped and raises —
         # it can never strand an unserved stream in a dead queue
         with self._cond:
-            failed = list(self._active.values())
+            failed = [s.stream for s in self._active.values()]
+            failed += [j.stream for j in self._prefilling.values()]
             self._active.clear()
+            self._prefilling.clear()
             pending = list(self._pending)
             self._pending.clear()
             self.started = False
         err = ServingError("decode engine stopped")
-        for slot in failed:
-            slot.stream._fail(err)
+        for stream in failed:
+            stream._fail(err)
         for stream in pending:
             stream._fail(err)
 
@@ -750,9 +1137,10 @@ class DecodeEngine(object):
         process-global profiler counters additionally aggregate every
         other decode session in the process — e.g. greedy_generate's
         cached 1-slot sessions)."""
-        return {
+        out = {
             "slots": self.session.slots if self.session else 0,
             "active": len(self._active),
+            "prefilling": len(self._prefilling),
             "queued": len(self._pending),
             "ticks": self.tick,
             "requests": self._counts["requests"],
@@ -761,35 +1149,57 @@ class DecodeEngine(object):
             "tokens": self._counts["tokens"],
             "admissions": self._counts["admissions"],
             "retirements": self._counts["retirements"],
+            "prefix_hits": self._counts["prefix_hits"],
+            "prefix_misses": self._counts["prefix_misses"],
+            "prefix_cached_tokens": self._counts["prefix_cached_tokens"],
         }
+        if self.prefix is not None:
+            out["prefix_store"] = self.prefix.stats()
+        return out
 
     # -- engine loop ---------------------------------------------------------
     def _loop(self):
         while True:
             with self._cond:
                 while (not self._stop and not self._pending
-                       and not self._active):
+                       and not self._active and not self._prefilling):
                     self._cond.wait()
                 if self._stop:
                     return
             try:
-                self._reap_cancelled()
-                self._admit()
-                if self._active:
-                    self._step()
+                self._tick()
             except Exception as e:  # noqa: BLE001 - fail the live streams
                 # a failed device step (incl. SteadyStateRecompileError
                 # from the strict gate) fails the requests it was serving;
                 # the engine itself stays up for the next submission. The
                 # freed slots COUNT as retirements so the documented
                 # admissions == retirements + occupancy invariant holds
-                # across recovered failures
+                # across recovered failures (prefilling slots were never
+                # counted as admissions, so they free without a tally)
                 for slot in list(self._active.values()):
                     slot.stream._fail(e)
                     _profiler.bump_counter("serving_slot_retirements")
                     self._counts["retirements"] += 1
                 self._free.extend(self._active.keys())
                 self._active.clear()
+                for job in list(self._prefilling.values()):
+                    job.stream._fail(e)
+                self._free.extend(self._prefilling.keys())
+                self._prefilling.clear()
+
+    def _tick(self):
+        """One engine tick: reap cancellations, admit queued requests
+        (prefix-cache copy + their first window; short prompts finish
+        admission inline, long ones become chunked jobs), advance ONE
+        chunked-prefill window, then ONE fused decode step over every
+        active slot. The chunk cap is the inter-token latency bound: a
+        max-length prompt costs in-flight streams one bucket-shaped
+        window per tick instead of a monolithic prefill stall."""
+        self._reap_cancelled()
+        self._admit()
+        self._advance_prefills()
+        if self._active:
+            self._step()
 
     def _reap_cancelled(self):
         """Retire slots whose consumer abandoned the stream (transport
@@ -806,6 +1216,14 @@ class DecodeEngine(object):
                 _profiler.bump_counter("serving_slot_retirements")
                 self._counts["retirements"] += 1
                 slot.stream._finish("cancelled")
+        for idx, job in list(self._prefilling.items()):
+            if job.stream._cancelled:
+                # cancelled mid-chunked-prefill: the slot frees without a
+                # retirement tally — admission is only counted when the
+                # first token emits, which never happened
+                self._prefilling.pop(idx, None)
+                self._free.append(idx)
+                job.stream._finish("cancelled")
         with self._cond:
             if any(s._cancelled for s in self._pending):
                 live = deque()
@@ -816,9 +1234,43 @@ class DecodeEngine(object):
                         live.append(s)
                 self._pending = live
 
+    def _plan_windows(self, prompt_len, prefix_tokens):
+        """Bucket-shaped window plan covering [prefix, prompt_len):
+        returns (usable_prefix, [(start, end), ...]). Every window's
+        bucket must land within max_len (``dynamic_update_slice`` would
+        otherwise clamp-and-shift the write); when the trailing suffix's
+        bucket cannot fit after the cached prefix, the prefix shrinks a
+        block at a time (recompute beats corrupt). A custom bucket
+        ladder too sparse to tile the prompt degrades to one monolithic
+        window — never an error."""
+        sess = self.session
+        chunk = self.prefill_chunk
+        prefix = prefix_tokens
+        while prefix >= 0:
+            s, wins, ok = prefix, [], True
+            while s < prompt_len:
+                cand = [b for b in sess.buckets if s + b <= sess.max_len]
+                if not cand:
+                    ok = False
+                    break
+                length = prompt_len - s
+                if chunk:
+                    length = min(length, chunk)
+                length = min(length, max(cand))
+                wins.append((s, s + length))
+                s += length
+            if ok:
+                return prefix, wins
+            prefix -= self.prefix_block
+        return 0, [(0, prompt_len)]
+
     def _admit(self):
-        """Prefill queued requests into free slots — mid-flight, between
-        decode steps, never evicting an active stream."""
+        """Admit queued requests into free slots — mid-flight, between
+        decode steps, never evicting an active stream. Each admission
+        first copies the longest cached prefix into the slot row
+        (O(copied bytes) block copies, no recompute), then prefills the
+        suffix: single-window prompts inline (the PR 8 behavior), longer
+        ones as a chunked ``_PrefillJob`` advanced one window per tick."""
         while self._free:
             with self._cond:
                 if not self._pending:
@@ -830,42 +1282,171 @@ class DecodeEngine(object):
                 stream._finish("cancelled")
                 continue
             slot_idx = self._free.pop()
+            prompt = stream.prompt_ids
+            entries, hit_tokens = [], 0
+            if self.prefix is not None:
+                entries, hit_tokens = self.prefix.lookup(prompt)
+            prefix_tokens, wins = self._plan_windows(len(prompt),
+                                                     hit_tokens)
+            if prefix_tokens < hit_tokens:
+                # the planner gave blocks back (suffix bucket didn't
+                # fit): unpin what we won't copy
+                keep = prefix_tokens // self.prefix_block
+                self.prefix.release(entries[keep:])
+                entries = entries[:keep]
             try:
-                with _xla_stats.serving_request_window():
-                    logits = self.session.prefill(
-                        slot_idx, stream.prompt_ids
-                    )
-                # pick() INSIDE the per-request guard: a poisoned
-                # sampling request (e.g. a denormal temperature) must
-                # fail alone, not escape to the loop's handler and take
-                # every co-batched stream down with it
-                tok = stream.pick(logits)
-            except Exception as e:  # noqa: BLE001 - per-request failure
+                if entries:
+                    with _xla_stats.serving_request_window():
+                        for j, e in enumerate(entries):
+                            self.session.prefix_copy_in(
+                                slot_idx, j * self.prefix_block,
+                                e.block_idx,
+                            )
+            except Exception as exc:  # noqa: BLE001 - per-request failure
                 self._free.append(slot_idx)
-                stream._fail(e)
+                stream._fail(exc)
                 continue
-            slot = _Slot(stream, tok, next_pos=len(stream.prompt_ids))
-            with self._cond:
-                # stop() drains under this lock and flips started inside
-                # it: if the drain happened while the prefill above was
-                # in flight (stop's thread-join timed out), inserting
-                # now would strand the stream in a dead engine — fail it
-                # here instead
-                if self._stop or not self.started:
-                    self._free.append(slot_idx)
-                    stream._fail(ServingError("decode engine stopped"))
-                    continue
-                self._active[slot_idx] = slot
-            _profiler.bump_counter("serving_slot_admissions")
-            self._counts["admissions"] += 1
-            stream.first_tick = self.tick
-            self._emit(slot_idx, slot, tok)
+            finally:
+                # copy done (or failed): the store may evict these
+                # blocks again — the slot row now owns its bytes.
+                # (finally runs before the except-branch's continue, so
+                # failure paths unpin exactly once too)
+                if entries:
+                    self.prefix.release(entries)
+            stream.cached_prefix_tokens = prefix_tokens
+            if self.prefix is not None:
+                if prefix_tokens:
+                    _profiler.bump_counter("decode_prefix_hits")
+                    _profiler.bump_counter("decode_prefix_cached_tokens",
+                                           prefix_tokens)
+                    self._counts["prefix_hits"] += 1
+                    self._counts["prefix_cached_tokens"] += prefix_tokens
+                else:
+                    _profiler.bump_counter("decode_prefix_misses")
+                    self._counts["prefix_misses"] += 1
+            job = _PrefillJob(stream, wins, prefix_tokens)
+            if len(wins) == 1:
+                self._run_prefill_window(slot_idx, job)
+            else:
+                # chunked: the first window runs via _advance_prefills
+                # on THIS tick; in-flight streams decode between windows.
+                # Same stop/drain re-check as _active insertion: if
+                # stop()'s drain ran while the copies above were in
+                # flight, parking the job now would strand the stream
+                # in a dead engine
+                with self._cond:
+                    if self._stop or not self.started:
+                        self._free.append(slot_idx)
+                        stream._fail(ServingError("decode engine stopped"))
+                        continue
+                    self._prefilling[slot_idx] = job
+
+    def _advance_prefills(self):
+        """Run ONE window of ONE chunked-prefill job — oldest first.
+        One bucket-shaped window per tick total is the tick bound:
+        however many long prompts are queued, live streams pay at most
+        (one window + one fused step) of latency per token."""
+        if not self._prefilling:
+            return
+        slot_idx = next(iter(self._prefilling))
+        self._run_prefill_window(slot_idx, self._prefilling[slot_idx])
+
+    def _run_prefill_window(self, slot_idx, job):
+        """Advance ``job`` by one window; on the prompt's final window,
+        finish admission: publish the prompt's blocks to the prefix
+        store, emit the first token, and join the decode batch."""
+        stream = job.stream
+        prompt = stream.prompt_ids
+        s, e = job.windows[job.wi]
+        try:
+            with _xla_stats.serving_request_window():
+                if s == 0 and e == len(prompt):
+                    # whole prompt in one window from position 0: the
+                    # monolithic prefill program (cheaper — window-local
+                    # [T, T] attention, flash-capable)
+                    logits = self.session.prefill(slot_idx, prompt)
+                else:
+                    logits = self.session.resume_prefill(
+                        slot_idx, prompt[s:e], s
+                    )
+            job.wi += 1
+            if job.wi < len(job.windows):
+                # re-park under the drain lock: a stop() whose
+                # thread-join timed out may have drained _prefilling
+                # while this window ran — re-inserting would strand
+                # the stream (same race _active insertion guards)
+                with self._cond:
+                    if self._stop or not self.started:
+                        self._prefilling.pop(slot_idx, None)
+                        self._free.append(slot_idx)
+                        stream._fail(ServingError("decode engine stopped"))
+                        return
+                    self._prefilling[slot_idx] = job
+                return
+            # pick() INSIDE the per-request guard: a poisoned sampling
+            # request (e.g. a denormal temperature) must fail alone, not
+            # escape to the loop's handler and take every co-batched
+            # stream down with it
+            tok = stream.pick(logits)
+        except Exception as exc:  # noqa: BLE001 - per-request failure
+            self._prefilling.pop(slot_idx, None)
+            self._free.append(slot_idx)
+            stream._fail(exc)
+            return
+        self._prefilling.pop(slot_idx, None)
+        if self.prefix is not None:
+            self._publish_blocks(slot_idx, prompt)
+        slot = _Slot(stream, tok, next_pos=len(prompt))
+        with self._cond:
+            # stop() drains under this lock and flips started inside
+            # it: if the drain happened while the prefill above was
+            # in flight (stop's thread-join timed out), inserting
+            # now would strand the stream in a dead engine — fail it
+            # here instead
+            if self._stop or not self.started:
+                self._free.append(slot_idx)
+                stream._fail(ServingError("decode engine stopped"))
+                return
+            self._active[slot_idx] = slot
+        _profiler.bump_counter("serving_slot_admissions")
+        self._counts["admissions"] += 1
+        stream.first_tick = self.tick
+        stream.ttft_ms = (time.monotonic() - stream._t_submit) * 1e3
+        _profiler.bump_histogram("decode_ttft_ms", stream.ttft_ms)
+        self._emit(slot_idx, slot, tok)
+
+    def _publish_blocks(self, slot_idx, prompt):
+        """Publish the finished prefill's full blocks to the prefix
+        store. Best-effort: a failed device copy unregisters the new
+        entries (a key must never point at bytes that were not written)
+        and the request streams on — publishing is an optimization,
+        never a correctness dependency."""
+        new = self.prefix.publish(prompt)
+        if not new:
+            return
+        try:
+            with _xla_stats.serving_request_window():
+                for entry, b in new:
+                    self.session.prefix_publish(
+                        slot_idx, b * self.prefix_block, entry.block_idx
+                    )
+        except Exception:  # noqa: BLE001 - publish is best-effort
+            for entry, _b in new:
+                self.prefix.forget(entry)
 
     def _emit(self, slot_idx, slot, tok):
         """Stream one generated token and retire the slot if finished."""
         stream = slot.stream
         stream._push(tok)
         stream.last_tick = self.tick
+        now = time.monotonic()
+        if stream._t_last_emit is not None:
+            # the latency a live stream actually feels per token — what
+            # chunked prefill bounds while long prompts admit
+            _profiler.bump_histogram(
+                "decode_intertoken_ms", (now - stream._t_last_emit) * 1e3
+            )
+        stream._t_last_emit = now
         _profiler.bump_counter("decode_tokens")
         self._counts["tokens"] += 1
         reason = None
@@ -895,6 +1476,15 @@ class DecodeEngine(object):
             tokens[idx] = slot.pending_token
             positions[idx] = slot.next_pos
             active[idx] = True
+        for idx, job in self._prefilling.items():
+            # the fused program scatter-writes EVERY slot, active or
+            # not: a mid-chunked-prefill row is live (copied prefix +
+            # finished windows), so its masked write must land on the
+            # next window's start — the window overwrites that position
+            # before any attention reads it. The free-slot convention
+            # (position 0) would corrupt the row head and poison blocks
+            # later published to the prefix store.
+            positions[idx] = job.windows[job.wi][0]
         with _xla_stats.serving_request_window():
             logits = sess.decode_step(tokens, positions, active)
         self.tick += 1

@@ -751,6 +751,11 @@ def _make_handler(gw):
             access log, error->status mapping."""
             tenant, priority, rid = self._request_meta()
             t0 = time.monotonic()
+            # reset BEFORE any _log call (including the draining-reject
+            # below): the handler object is reused across a kept-alive
+            # connection, and a stale stash from the previous request
+            # must never leak into this request's access-log line
+            self._log_extra = None
             _profiler.bump_counter("gateway_requests")
             _profiler.bump_counter("gateway_tenant_requests_"
                                    + _tenant_slug(tenant))
@@ -840,6 +845,9 @@ def _make_handler(gw):
                 rec["reason"] = reason
             if tokens is not None:
                 rec["tokens"] = int(tokens)
+            extra = getattr(self, "_log_extra", None)
+            if extra:
+                rec.update(extra)
             gw.access_log.write(rec)
 
         # -- /v1/infer -------------------------------------------------------
@@ -940,13 +948,32 @@ def _make_handler(gw):
                                           "reason": "deadline",
                                           "request_id": rid})
                     return 504, "deadline", None
-                self._send_json(200, {
+                facts = self._stash_gen_facts(stream)
+                self._send_json(200, dict({
                     "request_id": rid,
                     "tokens": toks,
                     "finish_reason": stream.finish_reason,
-                })
+                }, **facts))
                 return 200, None, len(toks)
             return self._stream_sse(stream, tenant, rid, timeout)
+
+        def _stash_gen_facts(self, stream, fallback_ttft_ms=None):
+            """Engine-stamped latency + prefix-cache facts, derived ONCE
+            per request: stashed for the access-log line and returned
+            for the response payload (JSON body or SSE done event), so
+            the two surfaces can never disagree. ``fallback_ttft_ms``
+            covers a stream the engine didn't stamp (the SSE writer's
+            gateway-side first-chunk wall)."""
+            ttft = getattr(stream, "ttft_ms", None)
+            if ttft is None:
+                ttft = fallback_ttft_ms
+            facts = {
+                "ttft_ms": round(ttft, 3) if ttft is not None else None,
+                "cached_prefix_tokens": int(getattr(
+                    stream, "cached_prefix_tokens", 0) or 0),
+            }
+            self._log_extra = facts
+            return facts
 
         def _stream_sse(self, stream, tenant, rid, timeout):
             """Chunked SSE: headers now, one data event per token as the
@@ -1025,11 +1052,17 @@ def _make_handler(gw):
                     return 499, "client_stalled", sent
                 sent += 1
                 _profiler.bump_counter("gateway_stream_tokens")
+            # the done event carries the engine-stamped TTFT (falling
+            # back to the gateway-side first-chunk wall) and the
+            # prefix-cache reuse fact, so a streaming client sees its
+            # amortization — same dict the access log records
+            facts = self._stash_gen_facts(stream,
+                                          fallback_ttft_ms=first_tok_ms)
             try:
                 self._chunk('data: %s\n\n' % json.dumps(
-                    {"done": True,
-                     "finish_reason": stream.finish_reason,
-                     "tokens": sent, "request_id": rid},
+                    dict({"done": True,
+                          "finish_reason": stream.finish_reason,
+                          "tokens": sent, "request_id": rid}, **facts),
                     sort_keys=True,
                 ))
                 self._chunk_end()

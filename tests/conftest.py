@@ -39,7 +39,9 @@ def run_probe_subprocess(script, args=("--fast",), retry_prefix=None,
     string starts with the prefix (a throughput-only miss — the 2-core
     driver box throttles under load, which compresses throughput but
     cannot corrupt outputs/parities/recompile counts), the probe earns
-    exactly one retry; correctness misses fail immediately.
+    exactly one retry; correctness misses fail immediately. A tuple of
+    prefixes (str.startswith semantics) covers probes with several
+    load-sensitive bars (throughput, TTFT gain, inter-token p99).
     """
     import json
     import subprocess

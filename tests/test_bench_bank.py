@@ -125,6 +125,38 @@ def test_bank_best_never_promotes_serving_entry(bench_mod):
     assert e["p99_ms"] == 12.0 and e["bucket_hit_rate"] == 1.0
 
 
+def test_bank_best_never_promotes_prefix_entry(bench_mod):
+    """The BENCH_DECODE prefix rung banks tokens/sec/user at ~90%
+    prefix share — an amortized rate the cold-prompt 'gpt_decode'
+    headline must never inherit (mirror of the serving/hostfeed/decode
+    guards). Only a prefix containing 'prefix' retrieves it, and its
+    TTFT/share facts survive the bank round-trip."""
+    b = bench_mod
+    b.bank_write(
+        "gpt_decode_prefix",
+        {"metric": "gpt2_decode_prefix_throughput", "value": 88888.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 256,
+         "device": "tpu", "decode": True, "prefix_cache": True,
+         "ttft_ms": 3.2, "prefix_share": 0.9, "prefix_hit_rate": 0.97},
+    )
+    b.bank_write(
+        "gpt_decode",
+        {"metric": "gpt2_decode_throughput", "value": 120.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 256,
+         "device": "tpu", "decode": True},
+    )
+    # the generic decode prefix must pick the COLD rung despite the
+    # prefix rung's (much) larger value
+    slot, e = b.bank_best("gpt_decode")
+    assert slot == "gpt_decode" and not e.get("prefix_cache")
+    # and the training-headline prefix sees neither decode rung
+    slot, e = b.bank_best("gpt")
+    assert slot is None or not e.get("decode")
+    slot, e = b.bank_best("gpt_decode_prefix")
+    assert e["prefix_cache"] is True and e["value"] == 88888.0
+    assert e["ttft_ms"] == 3.2 and e["prefix_share"] == 0.9
+
+
 def test_degraded_cpu_line_has_null_vs_baseline(bench_mod):
     b = bench_mod
     line = b._resnet_line({"ips": 0.7, "device": "cpu"}, 8, ["tpu: killed"], True)

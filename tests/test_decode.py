@@ -650,27 +650,43 @@ def test_flash_attention_dropout_mask_varies_per_step():
 
 
 def test_decode_probe_fast_acceptance():
-    """ISSUE 8 closed loop: token-exact parity vs the full-forward
-    oracle, >= 10x tokens/sec over the per-token-recompute baseline at
-    8 streams, 0 steady-state recompiles under the armed strict gate
-    across an admission/retirement churn, REPORT schema. Runs via the
-    shared conftest subprocess helper with the one-retry-on-
-    throughput-only-miss policy (parity / recompile / metrics failures
-    are not load-sensitive and fail immediately)."""
+    """ISSUE 8 + ISSUE 12 closed loop: token-exact parity vs the
+    full-forward oracle (including prefix-cache hit/miss and chunked
+    admission paths), >= 10x tokens/sec over the per-token-recompute
+    baseline at 8 streams, >= 2x TTFT improvement at high prefix share,
+    bounded inter-token p99 while a max-bucket prompt admits chunked,
+    LRU evictions under store overflow, and 0 steady-state recompiles
+    under the armed strict gate across the whole churn. Runs via the
+    shared conftest subprocess helper; the retry prefixes are the
+    LOAD-SENSITIVE bars only (throughput, TTFT gain, inter-token p99 —
+    the 2-core driver box throttles under external load) — parity /
+    recompile / metrics / eviction failures fail immediately."""
     from conftest import run_probe_subprocess
 
-    p, report = run_probe_subprocess("decode_probe.py",
-                                     retry_prefix="speedup")
+    p, report = run_probe_subprocess(
+        "decode_probe.py",
+        retry_prefix=("speedup", "ttft gain", "intertoken"),
+    )
     assert p.returncode == 0, "probe failed:\n%s\n%s" % (
         p.stdout[-3000:], p.stderr[-2000:]
     )
     assert "PROBE PASS" in p.stdout
-    assert report["schema_version"] == 1
+    assert report["schema_version"] == 2
     assert all(report["parity"].values()), report["parity"]
     assert report["strict"]["steady_recompiles"] == 0
     assert report["strict"]["churn_errors"] == 0
     assert report["throughput"]["speedup"] >= 10.0
     assert report["throughput"]["streams"] == 8
+    # ISSUE 12 tentpole bars
+    pre = report["prefix"]
+    assert pre["ttft_gain"] >= 2.0, pre
+    assert pre["miss_parity"] and pre["hit_parity"], pre
+    assert pre["hits"] >= 3 and pre["cached_tokens"] >= 3 * 64, pre
+    ch = report["chunked"]
+    assert ch["long_parity"], ch
+    assert ch["intertoken_p99_ms"] < ch["bound_ms"], ch
+    ev = report["evictions"]
+    assert ev["evictions"] >= 1 and ev["evicted_readmit_parity"], ev
 
 
 # ---------------------------------------------------------------------------
@@ -807,3 +823,345 @@ def test_poisoned_sampling_request_fails_alone(rig):
         time.sleep(0.01)
     st = engine.stats()
     assert st["retirements"] == st["admissions"] - st["active"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: prefix KV-cache reuse + chunked prefill
+# ---------------------------------------------------------------------------
+
+
+def test_kv_cache_copy_op_both_directions():
+    """Unit test of the block-copy op: store -> slot (admitting a hit)
+    and slot -> store (publishing), arbitrary fed rows/positions, value
+    persisted to the scope var."""
+    S, H, M, D, NB, B = 3, 2, 12, 4, 4, 3
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    cache0 = np.arange(S * H * M * D).reshape(S, H, M, D).astype("f4")
+    store0 = -np.arange(NB * H * B * D).reshape(NB, H, B, D).astype("f4")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cache = main.global_block().create_var(
+            name="cc", shape=[S, H, M, D], dtype="float32",
+            persistable=True)
+        store = main.global_block().create_var(
+            name="ss", shape=[NB, H, B, D], dtype="float32",
+            persistable=True)
+        dl = fluid.layers.data(name="dl", shape=[2], dtype="int64")
+        sl = fluid.layers.data(name="sl", shape=[2], dtype="int64")
+        out = fluid.layers.kv_cache_copy(cache, store, dl, sl, B)
+    scope.set("cc", cache0.copy())
+    scope.set("ss", store0.copy())
+    # store block 2 -> slot 1 row positions [5, 8)
+    (got,) = exe.run(main, feed={"dl": np.array([[1, 5]], "int64"),
+                                 "sl": np.array([[2, 0]], "int64")},
+                     fetch_list=[out], scope=scope)
+    want = cache0.copy()
+    want[1, :, 5:5 + B, :] = store0[2]
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(scope.get("cc")), want)
+    # untouched rows/positions intact
+    np.testing.assert_array_equal(np.asarray(scope.get("ss")), store0)
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        cache2 = main2.global_block().create_var(
+            name="cc", shape=[S, H, M, D], dtype="float32",
+            persistable=True)
+        store2 = main2.global_block().create_var(
+            name="ss", shape=[NB, H, B, D], dtype="float32",
+            persistable=True)
+        dl2 = fluid.layers.data(name="dl", shape=[2], dtype="int64")
+        sl2 = fluid.layers.data(name="sl", shape=[2], dtype="int64")
+        out2 = fluid.layers.kv_cache_copy(store2, cache2, dl2, sl2, B)
+    # slot 0 row positions [3, 6) -> store block 1
+    (got2,) = exe.run(main2, feed={"dl": np.array([[1, 0]], "int64"),
+                                   "sl": np.array([[0, 3]], "int64")},
+                      fetch_list=[out2], scope=scope)
+    want2 = store0.copy()
+    want2[1] = want[0, :, 3:3 + B, :]
+    np.testing.assert_array_equal(got2, want2)
+    np.testing.assert_array_equal(np.asarray(scope.get("ss")), want2)
+
+
+def test_kv_cache_gather_op():
+    S, H, M, D = 4, 2, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cache = main.global_block().create_var(
+            name="cg", shape=[S, H, M, D], dtype="float32",
+            persistable=True)
+        idx = fluid.layers.data(name="idx", shape=[1], dtype="int64")
+        row = fluid.layers.kv_cache_gather(cache, idx)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    base = np.random.RandomState(0).randn(S, H, M, D).astype("f4")
+    scope.set("cg", base)
+    for s in (0, 2, 3):
+        (got,) = exe.run(main, feed={"idx": np.array([[s]], "int64")},
+                         fetch_list=[row], scope=scope)
+        assert got.shape == (1, H, M, D)
+        np.testing.assert_array_equal(got, base[s:s + 1])
+
+
+def test_prefix_cache_lookup_publish_and_lru():
+    """Host-index unit: hash-chain lookup returns the longest cached
+    WHOLE-block prefix capped at len-1; publish registers only new
+    blocks; LRU evicts the oldest unpinned entry."""
+    pc = sdecode.PrefixCache(3, 4)
+    p1 = list(range(10))  # blocks [0..3], [4..7]; 8,9 never cached
+    assert pc.lookup(p1) == ([], 0)
+    new = pc.publish(p1)
+    assert [b for _e, b in new] == [0, 1]
+    # full-prompt hit is capped: a 9-token prompt sharing both blocks
+    # reuses only 8 tokens, never all of itself
+    ent, toks = pc.lookup(p1[:9])
+    assert toks == 8 and len(ent) == 2
+    pc.release(ent)
+    # an 8-token prompt (exactly two blocks) caps at one block
+    ent, toks = pc.lookup(p1[:8])
+    assert toks == 4 and len(ent) == 1
+    pc.release(ent)
+    # re-publish registers nothing new
+    assert pc.publish(p1) == []
+    # a third distinct block fills the store; a fourth evicts the LRU
+    p2 = list(range(100, 108))
+    new2 = pc.publish(p2[:4] + [1])  # one block
+    assert len(new2) == 1 and len(pc) == 3
+    ev0 = pc.evictions
+    new3 = pc.publish(list(range(200, 204)) + [1])
+    assert len(new3) == 1 and pc.evictions == ev0 + 1
+    assert len(pc) == 3
+
+
+def test_prefix_cache_refcount_blocks_eviction():
+    """ISSUE 12 satellite: an evict attempt during an in-flight copy
+    must not corrupt a live slot — pinned entries (lookup refs) are
+    skipped by the LRU sweep; an all-pinned store stops allocating
+    instead of reusing a block mid-copy."""
+    pc = sdecode.PrefixCache(2, 4)
+    pa = list(range(8)) + [0]
+    pc.publish(pa)  # 2 blocks -> store full
+    pinned, toks = pc.lookup(pa)
+    assert toks == 8 and all(e.refs == 1 for e in pinned)
+    # everything pinned: publishing a new prefix cannot evict anything
+    assert pc.publish(list(range(50, 54)) + [0]) == []
+    assert pc.evictions == 0
+    assert {e.block_idx for e in pinned} == {0, 1}  # blocks intact
+    # release ONE: the sweep may now take exactly the unpinned victim.
+    # releasing the chain head makes block 0 LRU-evictable while the
+    # still-pinned second block must survive
+    pc.release(pinned[:1])
+    new = pc.publish(list(range(50, 54)) + [0])
+    assert len(new) == 1 and pc.evictions == 1
+    assert new[0][0].block_idx == pinned[0].block_idx  # took the free one
+    assert pc._entries.get(pinned[1].key) is pinned[1]  # pinned survived
+    pc.release(pinned[1:])
+
+
+def test_prefix_cache_collision_verified_not_trusted(monkeypatch):
+    """A hash collision (equal chain key, different tokens) must stop
+    the chain at lookup AND at publish — the token tuples are compared,
+    never the key alone."""
+    monkeypatch.setattr(sdecode, "_block_hash", lambda prev, toks: 42)
+    pc = sdecode.PrefixCache(4, 2)
+    pa = [1, 2, 9]
+    pb = [3, 4, 9]  # different tokens, same (engineered) key
+    assert len(pc.publish(pa)) == 1
+    ent, toks = pc.lookup(pb)
+    assert toks == 0 and ent == []  # collision -> miss fallthrough
+    assert pc.publish(pb) == []     # cannot chain past the squatter
+    ent, toks = pc.lookup(pa)
+    assert toks == 2                # the real owner still hits
+    pc.release(ent)
+
+
+def test_prefix_cache_verifies_chain_parent_not_just_tokens(monkeypatch):
+    """Review regression: a key collision with EQUAL tokens but a
+    different parent (prefixes A||X vs B||X under a tokens-only hash)
+    must not splice A's X-block K/V into B's chain — the stored
+    (prev, tokens) link is verified, never the tokens alone."""
+    monkeypatch.setattr(sdecode, "_block_hash",
+                        lambda prev, toks: ("t", toks))  # ignores prev
+    pc = sdecode.PrefixCache(4, 2)
+    a, b, x = [1, 2], [3, 4], [7, 8]
+    assert len(pc.publish(a + x + [0])) == 2   # chain A -> X
+    # lookup B||X: block B misses; even a direct walk that reached the
+    # X entry must reject it (its parent is A's key, not B's)
+    ent, toks = pc.lookup(b + x + [0])
+    assert toks == 0 and ent == []
+    # publish B||X: B registers, but X's colliding entry (parent A)
+    # stops the chain — B's X-block is NOT registered under A's entry
+    new = pc.publish(b + x + [0])
+    assert [blk for _e, blk in new] == [0]
+    # the genuine A||X chain still hits end to end
+    ent, toks = pc.lookup(a + x + [0])
+    assert toks == 4
+    pc.release(ent)
+
+
+@pytest.fixture(scope="module")
+def prig():
+    """Prefix/chunk rig: one model + oracle + engine with prefix caching
+    (block 4, 6-block store) and chunked prefill (chunk 8) armed."""
+    from paddle_tpu.models.gpt import prefix_block_bytes
+
+    max_len = 32
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = max_len
+    with fluid.unique_name.guard():
+        infer, startup, _names, logits = gpt.build_gpt_infer(cfg, max_len)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+    engine = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=2, max_len=max_len,
+        prefill_buckets=[8, max_len], param_program=infer,
+        prefix_block=4,
+        prefix_cache_mb=6 * prefix_block_bytes(cfg, 4) / 2.0 ** 20,
+        prefill_chunk=8,
+    ).start()
+
+    def oracle(prompt):
+        return gpt._reference_generate(
+            exe, infer, logits, cfg, prompt, max_len, scope=scope
+        )
+
+    yield {"cfg": cfg, "engine": engine, "oracle": oracle,
+           "max_len": max_len}
+    engine.stop()
+
+
+def test_prefix_hit_parity_vs_oracle(prig):
+    """Parity-on-hit: the same long prompt admitted twice — the second
+    admission copies its cached prefix instead of recomputing, and both
+    completions are token-exact vs the full-forward oracle."""
+    engine, oracle = prig["engine"], prig["oracle"]
+    rs = np.random.RandomState(21)
+    p = list(rs.randint(0, prig["cfg"].vocab_size, 14))
+    want = oracle(p)[len(p):][:6]
+    s1 = engine.generate(p, max_new_tokens=6)
+    assert s1.tokens(timeout=120) == want
+    assert s1.cached_prefix_tokens == 0
+    s2 = engine.generate(p, max_new_tokens=6)
+    assert s2.tokens(timeout=120) == want
+    # 14 tokens = 3 full blocks of 4 cached (the 13-token cap allows 3)
+    assert s2.cached_prefix_tokens == 12
+    st = engine.stats()
+    assert st["prefix_hits"] >= 1 and st["prefix_cached_tokens"] >= 12
+
+
+def test_chunked_prefill_boundaries(prig):
+    """Chunk-plan edge cases, each token-exact: prompt shorter than the
+    chunk, prompt an exact chunk multiple, a prompt whose windows
+    resume across the bucket boundary, and EOS on the first token of a
+    chunked admission."""
+    engine, oracle = prig["engine"], prig["oracle"]
+    rs = np.random.RandomState(22)
+    vocab = prig["cfg"].vocab_size
+    for n in (5, 16, 27):  # < chunk, exact 2x chunk, crosses buckets
+        p = list(rs.randint(0, vocab, n))
+        got = engine.generate(p, max_new_tokens=4).tokens(timeout=120)
+        assert got == oracle(p)[len(p):][:4], "prompt len %d" % n
+    # EOS during chunked admit: the eos lands on the very first emitted
+    # token of a multi-window prompt — retire immediately, token-exact
+    p = list(rs.randint(0, vocab, 20))
+    first = oracle(p)[len(p)]
+    s = engine.generate(p, eos_id=first)
+    assert s.tokens(timeout=120) == [first]
+    assert s.finish_reason == "eos"
+
+
+def test_step_write_never_touches_prefilling_rows(prig):
+    """Review regression (reproduced live): the fused decode step
+    scatter-writes EVERY slot — inactive included — so a slot
+    mid-chunked-prefill must have its masked write aimed at the next
+    window start, not the free-slot convention of position 0, which
+    held the live row head (copied prefix / first window) and poisoned
+    blocks later published to the prefix store. Session-level: an
+    inactive slot's fed position is honored; engine-level: a chunked
+    admission concurrent with a decoding stream stays token-exact."""
+    engine, oracle = prig["engine"], prig["oracle"]
+    sess = engine.session
+    # session contract: the inactive slot writes where the CALLER says
+    kname = gpt.decode_cache_names(
+        prig["cfg"], sess.slots, sess.max_len)[0][0]
+    before = np.asarray(engine.session.scope.get(kname))[1, :, :8, :]\
+        .copy()
+    sess.decode_step([0, 0], [0, 8], [False, False])
+    after = np.asarray(engine.session.scope.get(kname))[1, :, :8, :]
+    np.testing.assert_array_equal(before, after)
+    # engine contract: chunked admit + live decode stream, both exact
+    rs = np.random.RandomState(26)
+    vocab = prig["cfg"].vocab_size
+    pa = list(rs.randint(0, vocab, 3))
+    pb = list(rs.randint(0, vocab, 20))  # 3 chunked windows
+    sa = engine.generate(pa, max_new_tokens=20)
+    deadline = time.monotonic() + 30
+    while len(sa._tokens) < 2 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    sb = engine.generate(pb, max_new_tokens=5)
+    assert sb.tokens(timeout=120) == oracle(pb)[len(pb):][:5]
+    assert sa.tokens(timeout=120) == oracle(pa)[len(pa):][:20]
+
+
+def test_engine_eviction_churn_stays_exact(prig):
+    """Distinct prefixes overflowing the 6-block store force LRU
+    evictions mid-churn; every stream (including a re-admission of an
+    evicted prefix) stays token-exact."""
+    from paddle_tpu.fluid import profiler
+
+    engine, oracle = prig["engine"], prig["oracle"]
+    rs = np.random.RandomState(23)
+    vocab = prig["cfg"].vocab_size
+    ev0 = profiler.get_counters().get("decode_prefix_evictions", 0)
+    first = list(rs.randint(0, vocab, 9))
+    prompts = [first] + [list(rs.randint(0, vocab, 9)) for _ in range(5)]
+    for p in prompts:  # 2 blocks each x 6 prompts = 12 > 6-block store
+        got = engine.generate(p, max_new_tokens=3).tokens(timeout=120)
+        assert got == oracle(p)[len(p):][:3]
+    assert profiler.get_counters().get(
+        "decode_prefix_evictions", 0) > ev0
+    # the first prefix is long evicted: re-admitting is a miss that
+    # must still be exact
+    got = engine.generate(first, max_new_tokens=3).tokens(timeout=120)
+    assert got == oracle(first)[len(first):][:3]
+
+
+def test_engine_collision_fallthrough_runs_full_prefill(prig,
+                                                        monkeypatch):
+    """Engine-level hash-collision fallthrough: with every chain key
+    colliding, a second DIFFERENT prompt must detect the token mismatch,
+    run the full-prefill path (cached_prefix_tokens == 0), and stay
+    token-exact."""
+    engine, oracle = prig["engine"], prig["oracle"]
+    monkeypatch.setattr(sdecode, "_block_hash",
+                        lambda prev, toks: "collide")
+    rs = np.random.RandomState(24)
+    vocab = prig["cfg"].vocab_size
+    pa = list(rs.randint(0, vocab, 9))
+    pb = list(rs.randint(0, vocab, 9))
+    assert pa[:4] != pb[:4]
+    sa = engine.generate(pa, max_new_tokens=3)
+    assert sa.tokens(timeout=120) == oracle(pa)[len(pa):][:3]
+    misses0 = engine.stats()["prefix_misses"]
+    sb = engine.generate(pb, max_new_tokens=3)
+    assert sb.tokens(timeout=120) == oracle(pb)[len(pb):][:3]
+    assert sb.cached_prefix_tokens == 0
+    assert engine.stats()["prefix_misses"] == misses0 + 1
+
+
+def test_ttft_and_intertoken_histograms_populate(prig):
+    """The TTFT / inter-token histograms land on the profiler (and via
+    it the exporter registry) once streams run."""
+    from paddle_tpu.fluid import profiler
+
+    engine = prig["engine"]
+    s = engine.generate([1, 2, 3], max_new_tokens=4)
+    s.tokens(timeout=120)
+    assert s.ttft_ms is not None and s.ttft_ms >= 0
+    hists = profiler.get_histograms()
+    assert len(hists.get("decode_ttft_ms", [])) >= 1
+    assert len(hists.get("decode_intertoken_ms", [])) >= 1
