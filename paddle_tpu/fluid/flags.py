@@ -156,6 +156,22 @@ _DEFAULTS = {
     "router_health_interval_s": 0.5,
     "router_retries": 2,
     "router_backend_timeout_s": 60.0,
+    # durable streaming generations: a pinned /v1/generate stream whose
+    # replica dies (or times out) mid-stream is re-admitted on a healthy
+    # replica with the already-emitted token suffix (token-exact resume)
+    # up to router_generate_retries times, within the request deadline.
+    # 0 disables failover (mid-stream death degrades to the in-band
+    # error event).
+    "router_generate_retries": 2,
+    # per-backend circuit breaker: router_breaker_failures consecutive
+    # request-path failures open the breaker (the backend is excluded
+    # from routing even while /readyz answers 200 — a flapping replica
+    # can't eat one retry from every in-flight request); after
+    # router_breaker_cooldown_s the breaker goes half-open and admits a
+    # single probe request, which closes it on success or re-opens it
+    # on failure. 0 failures disables the breaker.
+    "router_breaker_failures": 3,
+    "router_breaker_cooldown_s": 2.0,
     # checkpoint manager (paddle_tpu/checkpoint): trainer-integrated save
     # cadence (0 = off), retention (newest keep_max steps survive GC,
     # every keep_every_n_steps-th step is pinned forever), writer-queue
@@ -219,6 +235,13 @@ _DEFAULTS = {
     "chaos_rpc_fail_n": 0,
     "chaos_target_rank": -1,
     "chaos_marker_dir": "",
+    # mid-stream serving fault: the replica process SIGKILLs itself
+    # after writing exactly chaos_die_after_tokens SSE stream tokens
+    # (process-wide count), scoped to the replica whose
+    # PADDLE_TPU_REPLICA_ID matches chaos_die_replica (-1 = any) — the
+    # deterministic rig behind the router failover trials
+    "chaos_die_after_tokens": -1,
+    "chaos_die_replica": -1,
     # observability (paddle_tpu/observability): one telemetry spine over
     # tracing + metrics. obs_trace gates the span tracer (on by default —
     # bounded ring buffer, ~µs per span, measured <2% of the step path by

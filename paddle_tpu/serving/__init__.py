@@ -25,6 +25,11 @@ processes behind a health-checked least-inflight ``Router``
 (serving/router.py), autoscales the pool on scraped queue-depth /
 shed / latency pressure, and rolls new model versions with zero
 downtime (warm the new replicas, flip the router, drain the old).
+Generations are DURABLE: a pinned SSE stream whose replica dies
+mid-stream is resumed token-exactly on a survivor (the engine's
+``resume_tokens`` form + ``fast_forward_rng`` replaying the seeded
+picks), spliced onto the open client connection behind a
+``: failover`` comment frame.
 
 Quickstart::
 
@@ -52,6 +57,7 @@ from .decode import (  # noqa: F401
     DecodeEngine,
     DecodeSession,
     GenerationStream,
+    fast_forward_rng,
     sample_token,
 )
 from .fleet import AutoscalerPolicy, FleetController  # noqa: F401
@@ -69,6 +75,7 @@ __all__ = [
     "AutoscalerPolicy",
     "DecodeEngine",
     "sample_token",
+    "fast_forward_rng",
     "DecodeSession",
     "GenerationStream",
     "MicroBatcher",

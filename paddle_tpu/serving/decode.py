@@ -78,6 +78,7 @@ __all__ = [
     "DecodeEngine",
     "GenerationStream",
     "PrefixCache",
+    "fast_forward_rng",
     "prefill_ladder",
     "sample_token",
     "session_for_generate",
@@ -666,6 +667,16 @@ def sample_token(logits, temperature=0.0, top_k=0, top_p=0.0, rng=None):
     engine) so a given (prompt, knobs, seed) replays the same completion.
     Filtering order matches the common serving convention: temperature
     scale -> top-k cut -> softmax -> nucleus (top-p) cut -> renormalize.
+
+    RNG-consumption CONTRACT (what makes mid-stream resume replayable):
+    a temperature-sampled pick consumes EXACTLY ONE uniform draw
+    (``rng.random_sample()`` — the inverse-CDF selection below is
+    explicit, never ``rng.choice`` whose internal consumption is an
+    implementation detail); a greedy pick consumes ZERO. So a
+    generation resumed after k emitted tokens reproduces the
+    uninterrupted run exactly by seeding the same RandomState and
+    ``fast_forward_rng(rng, k)`` — no logits needed for the skipped
+    draws.
     """
     z = np.asarray(logits, np.float64).ravel()
     if temperature is None or temperature <= 0.0:
@@ -695,7 +706,35 @@ def sample_token(logits, temperature=0.0, top_k=0, top_p=0.0, rng=None):
             "(temperature %r too extreme for the logits)" % (temperature,)
         )
     r = rng if rng is not None else np.random
-    return int(r.choice(probs.size, p=probs))
+    # one uniform, inverse-CDF: token i owns the interval
+    # (cdf[i-1], cdf[i]] so zero-probability (filtered) tokens have a
+    # zero-width interval and can never be drawn; scaling u by cdf[-1]
+    # absorbs float summation error instead of leaving a dead tail.
+    # The nextafter clamp keeps the scaled draw STRICTLY below cdf[-1]:
+    # u < 1, but u * cdf[-1] can round UP to exactly cdf[-1], and
+    # side="right" would then land past the flat zero-probability tail
+    # (a filtered token) instead of on the last positive one
+    u = float(r.random_sample())
+    cdf = np.cumsum(probs)
+    x = min(u * cdf[-1], np.nextafter(cdf[-1], 0.0))
+    return int(min(np.searchsorted(cdf, x, side="right"),
+                   probs.size - 1))
+
+
+def fast_forward_rng(rng, n):
+    """Advance ``rng`` past ``n`` sampled-token draws — the explicit
+    resume API: by the consumption contract above, discarding ``n``
+    uniforms puts a freshly seeded RandomState in EXACTLY the state the
+    uninterrupted run's RNG held after emitting its first ``n``
+    temperature-sampled tokens (greedy tokens consume nothing, so a
+    greedy resume never calls this). One vectorized draw, not ``n``
+    dummy ``sample_token`` calls into the void."""
+    n = int(n)
+    if n < 0:
+        raise ValueError("cannot fast-forward a negative draw count")
+    if n:
+        rng.random_sample(n)
+    return rng
 
 
 # ---------------------------------------------------------------------------
@@ -713,7 +752,8 @@ class GenerationStream(object):
     ``"length"`` once done."""
 
     def __init__(self, prompt_ids, max_new_tokens=None, eos_id=None,
-                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None,
+                 resume_tokens=None):
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -726,9 +766,19 @@ class GenerationStream(object):
         self.top_k = int(top_k or 0)
         self.top_p = float(top_p or 0.0)
         self.seed = seed
+        # resume form: ``resume_tokens`` is the suffix an interrupted
+        # run of this request already emitted. The engine re-prefills
+        # prompt + resume_tokens (through the prefix/chunked admission
+        # path) and this stream emits ONLY the continuation — token
+        # exactly equal to what the uninterrupted run would have said
+        # next, because the logits after caching prompt+emitted are the
+        # same and the RNG is fast-forwarded past the emitted picks.
+        self.resume_tokens = [int(t) for t in (resume_tokens or [])]
         self._rng = (
             np.random.RandomState(seed) if self.temperature > 0.0 else None
         )
+        if self._rng is not None and self.resume_tokens:
+            fast_forward_rng(self._rng, len(self.resume_tokens))
         self.finish_reason = None
         # engine tick bookkeeping (scheduler tests / fairness probes):
         # the tick a slot was admitted on and the last tick it decoded on
@@ -738,9 +788,12 @@ class GenerationStream(object):
         # submit -> first generated token, cached_prefix_tokens how many
         # prompt tokens the prefix cache served (0 on a miss / disabled)
         # — the gateway surfaces both on the SSE done event and the
-        # access log
+        # access log. admit_windows counts the bucket-shaped prefill
+        # windows the admission ran (1 = monolithic), so a resume
+        # admission can prove it rode the chunked/prefix path
         self.ttft_ms = None
         self.cached_prefix_tokens = 0
+        self.admit_windows = 0
         self._t_submit = time.monotonic()
         self._t_last_emit = None
         self._q = queue.Queue()
@@ -748,6 +801,19 @@ class GenerationStream(object):
         self._done = threading.Event()
         self._error = None
         self._cancelled = False
+
+    def full_prompt(self):
+        """What the engine actually prefills: the request prompt plus
+        the resume suffix (every token whose K/V must be in the cache
+        before the next token can be picked)."""
+        return self.prompt_ids + self.resume_tokens
+
+    @property
+    def emitted_count(self):
+        """Tokens of the LOGICAL generation emitted so far: the resumed
+        suffix plus everything this stream pushed — what a transport
+        needs to build the next resume form."""
+        return len(self.resume_tokens) + len(self._tokens)
 
     def cancel(self):
         """Abandon the request: the engine retires its slot at the next
@@ -825,18 +891,23 @@ class GenerationStream(object):
         return list(self._tokens)
 
     def result(self, timeout=None):
-        """prompt + generated tokens — ``greedy_generate``'s contract."""
-        return self.prompt_ids + self.tokens(timeout)
+        """prompt + generated tokens — ``greedy_generate``'s contract.
+        On a resume form this includes the resumed suffix, so the result
+        is the SAME full sequence the uninterrupted run returns."""
+        return self.prompt_ids + self.resume_tokens + self.tokens(timeout)
 
 
 class _Slot(object):
     __slots__ = ("stream", "pending_token", "next_pos", "generated")
 
-    def __init__(self, stream, pending_token, next_pos):
+    def __init__(self, stream, pending_token, next_pos, generated=1):
         self.stream = stream
         self.pending_token = pending_token  # emitted, not yet cached
         self.next_pos = next_pos            # cache position it writes next
-        self.generated = 1                  # prefill already emitted one
+        # LOGICAL tokens generated so far (prefill already emitted one;
+        # a resume admission starts past its replayed suffix so
+        # max_new/max_len budgets stay those of the original request)
+        self.generated = generated
 
 
 class _PrefillJob(object):
@@ -918,7 +989,8 @@ class DecodeEngine(object):
         self._counts = {"requests": 0, "admissions": 0,
                         "retirements": 0, "tokens": 0,
                         "prefix_hits": 0, "prefix_misses": 0,
-                        "prefix_cached_tokens": 0}
+                        "prefix_cached_tokens": 0,
+                        "resume_admissions": 0, "resume_tokens": 0}
         self._armed = False
         self._occ_gauge = None
         self._queue_gauge = None
@@ -1080,29 +1152,81 @@ class DecodeEngine(object):
 
     # -- request path --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_id=None,
-               temperature=0.0, top_k=0, top_p=0.0, seed=None):
+               temperature=0.0, top_k=0, top_p=0.0, seed=None,
+               resume_tokens=None):
         """Non-blocking admission; returns a ``GenerationStream``.
         Bounded queue: beyond ``queue_depth`` waiting requests, sheds
         with ``ServerOverloadedError`` (same backpressure contract as
         the micro-batcher). Sampling knobs are per-request and host-side
         (``sample_token``): greedy (``temperature=0``) is the default,
-        and a seeded sampling request replays deterministically."""
+        and a seeded sampling request replays deterministically.
+
+        ``resume_tokens`` is the RESUME form: the suffix an interrupted
+        run of this exact request (same prompt, knobs, seed) already
+        emitted elsewhere. The engine re-prefills prompt + suffix — one
+        admission through the prefix-cache/chunked path, so the
+        re-prefill costs block copies plus bucket windows, never a
+        recompile — fast-forwards the request RNG past the replayed
+        picks, and the returned stream emits exactly the tokens the
+        uninterrupted run would have emitted from there on. A sampled
+        request (temperature > 0) MUST carry its seed to be resumable:
+        without one the continuation could not replay the original
+        draws."""
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt")
+        resume = [int(t) for t in (resume_tokens or [])]
+        if resume:
+            if temperature is not None and float(temperature or 0.0) > 0.0 \
+                    and seed is None:
+                raise ValueError(
+                    "resume of a temperature-sampled generation requires "
+                    "its seed (the replayed picks are otherwise "
+                    "unreproducible)"
+                )
+            if eos_id is not None and int(eos_id) in resume:
+                raise ValueError(
+                    "resume_tokens already contain eos_id %d — the "
+                    "generation is finished, not resumable" % int(eos_id)
+                )
+            if max_new_tokens is not None and max_new_tokens <= len(resume):
+                raise ValueError(
+                    "resume_tokens (%d) meet or exceed max_new_tokens "
+                    "(%d) — nothing left to generate"
+                    % (len(resume), max_new_tokens)
+                )
         if not self.started or self.session is None:
             raise ServingError("decode engine not started")
-        if len(prompt) >= self.session.max_len:
+        if len(prompt) + len(resume) >= self.session.max_len:
+            if resume:
+                # the resumed generation already hit the max_len wall:
+                # it is COMPLETE, not invalid. Unlike the eos/max_new
+                # refusals above (budgets the CALLER set and can check),
+                # max_len is server-side config a resuming router cannot
+                # know — a replica dying between its final token and the
+                # done frame would otherwise turn a fully-delivered
+                # generation into a 400. Answer with an already-finished
+                # stream (zero continuation, finish_reason "length");
+                # no slot, no queue entry, no admission tallies.
+                stream = GenerationStream(
+                    prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=seed, resume_tokens=resume,
+                )
+                stream._finish("length")
+                return stream
             raise ValueError(
                 "prompt of %d tokens leaves no room to generate "
                 "(max_len %d)" % (len(prompt), self.session.max_len)
             )
-        self.session.bucket_for(len(prompt))  # validates against the ladder
+        # validates the FULL re-prefilled length against the ladder
+        self.session.bucket_for(len(prompt) + len(resume))
         if max_new_tokens is not None and max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         stream = GenerationStream(prompt, max_new_tokens=max_new_tokens,
                                   eos_id=eos_id, temperature=temperature,
-                                  top_k=top_k, top_p=top_p, seed=seed)
+                                  top_k=top_k, top_p=top_p, seed=seed,
+                                  resume_tokens=resume)
         with self._cond:
             # re-checked under the lock stop() drains under: after the
             # drain, started is already False here and the stream can
@@ -1125,12 +1249,14 @@ class DecodeEngine(object):
         return stream
 
     def generate(self, prompt_ids, max_new_tokens=None, eos_id=None,
-                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None,
+                 resume_tokens=None):
         """Submit and return the streaming handle (iterate for tokens as
         they land; ``.tokens()`` / ``.result()`` to block)."""
         return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
                            eos_id=eos_id, temperature=temperature,
-                           top_k=top_k, top_p=top_p, seed=seed)
+                           top_k=top_k, top_p=top_p, seed=seed,
+                           resume_tokens=resume_tokens)
 
     def stats(self):
         """THIS engine's counters + live occupancy snapshot (the
@@ -1152,6 +1278,8 @@ class DecodeEngine(object):
             "prefix_hits": self._counts["prefix_hits"],
             "prefix_misses": self._counts["prefix_misses"],
             "prefix_cached_tokens": self._counts["prefix_cached_tokens"],
+            "resume_admissions": self._counts["resume_admissions"],
+            "resume_tokens": self._counts["resume_tokens"],
         }
         if self.prefix is not None:
             out["prefix_store"] = self.prefix.stats()
@@ -1282,7 +1410,11 @@ class DecodeEngine(object):
                 stream._finish("cancelled")
                 continue
             slot_idx = self._free.pop()
-            prompt = stream.prompt_ids
+            # the resume form re-prefills prompt + emitted suffix — the
+            # same admission machinery (prefix copies, window planning)
+            # serves both, which is exactly what makes a resumed
+            # re-prefill cost ~one suffix window instead of a stall
+            prompt = stream.full_prompt()
             entries, hit_tokens = [], 0
             if self.prefix is not None:
                 entries, hit_tokens = self.prefix.lookup(prompt)
@@ -1324,6 +1456,7 @@ class DecodeEngine(object):
                 else:
                     _profiler.bump_counter("decode_prefix_misses")
                     self._counts["prefix_misses"] += 1
+            stream.admit_windows = len(wins)
             job = _PrefillJob(stream, wins, prefix_tokens)
             if len(wins) == 1:
                 self._run_prefill_window(slot_idx, job)
@@ -1356,7 +1489,7 @@ class DecodeEngine(object):
         finish admission: publish the prompt's blocks to the prefix
         store, emit the first token, and join the decode batch."""
         stream = job.stream
-        prompt = stream.prompt_ids
+        prompt = stream.full_prompt()
         s, e = job.windows[job.wi]
         try:
             with _xla_stats.serving_request_window():
@@ -1396,7 +1529,10 @@ class DecodeEngine(object):
         self._prefilling.pop(slot_idx, None)
         if self.prefix is not None:
             self._publish_blocks(slot_idx, prompt)
-        slot = _Slot(stream, tok, next_pos=len(prompt))
+        # a resume admission's budget accounting continues the ORIGINAL
+        # request: the replayed suffix counts as already generated
+        slot = _Slot(stream, tok, next_pos=len(prompt),
+                     generated=1 + len(stream.resume_tokens))
         with self._cond:
             # stop() drains under this lock and flips started inside
             # it: if the drain happened while the prefill above was
@@ -1410,6 +1546,15 @@ class DecodeEngine(object):
             self._active[slot_idx] = slot
         _profiler.bump_counter("serving_slot_admissions")
         self._counts["admissions"] += 1
+        if stream.resume_tokens:
+            # the facts a failover probe reads: how many generations
+            # were resumed here and how much emitted suffix they
+            # replayed through the prefill path instead of re-decoding
+            _profiler.bump_counter("decode_resume_admissions")
+            _profiler.bump_counter("decode_resume_tokens",
+                                   len(stream.resume_tokens))
+            self._counts["resume_admissions"] += 1
+            self._counts["resume_tokens"] += len(stream.resume_tokens)
         stream.first_tick = self.tick
         stream.ttft_ms = (time.monotonic() - stream._t_submit) * 1e3
         _profiler.bump_histogram("decode_ttft_ms", stream.ttft_ms)

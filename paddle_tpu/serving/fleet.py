@@ -619,6 +619,10 @@ class FleetController(object):
         env = dict(os.environ)
         env.update(self.replica_env)
         env[_supervisor.HEARTBEAT_ENV] = hbf
+        # stable replica identity in the environment: chaos faults
+        # (FLAGS_chaos_die_replica) and any per-replica tooling address
+        # one member of a pool spawned with a SHARED replica_env
+        env["PADDLE_TPU_REPLICA_ID"] = str(rid)
         # the replica's own telemetry surface: metrics on an ephemeral
         # port (reported back via the endpoint file — the autoscaler's
         # scrape target) + periodic JSONL snapshots the fleet report

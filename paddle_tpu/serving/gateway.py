@@ -75,6 +75,7 @@ import numpy as np
 from ..checkpoint import preempt as _preempt
 from ..fluid import flags as _flags
 from ..fluid import profiler as _profiler
+from ..testing import chaos as _chaos
 from ..observability import exporter as _obs_exporter
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
@@ -897,9 +898,20 @@ def _make_handler(gw):
         def _generate(self, tenant, rid, body):
             """Body: {"prompt_ids": [...], "max_new_tokens", "eos_id",
             "temperature", "top_k", "top_p", "seed", "stream" (default
-            true), "deadline_ms"}. Streaming responses are chunked SSE:
-            one ``data: {"token": t}`` event per generated token, then
-            ``data: {"done": true, ...}``."""
+            true), "deadline_ms", "resume_tokens"}. Streaming responses
+            are chunked SSE: one ``data: {"token": t}`` event per
+            generated token, then ``data: {"done": true, ...}``.
+
+            ``resume_tokens`` is the durable-generation resume form:
+            the suffix an interrupted run of this exact request already
+            emitted (the router builds it from the tokens it relayed
+            before a replica died). The stream then emits only the
+            token-exact continuation; the done/error events carry
+            ``emitted_count`` + seed/knobs so ANY caller can
+            reconstruct the next resume request. A temperature-sampled
+            resume without its seed is a 400 (the engine's
+            seed-required rule — the replayed picks would be
+            unreproducible)."""
             try:
                 prompt = body.get("prompt_ids")
                 if (not isinstance(prompt, list) or not prompt
@@ -907,6 +919,15 @@ def _make_handler(gw):
                     raise ValueError(
                         "'prompt_ids' must be a non-empty list of ints"
                     )
+                resume = body.get("resume_tokens")
+                if resume is not None:
+                    if (not isinstance(resume, list)
+                            or not all(isinstance(t, int)
+                                       and not isinstance(t, bool)
+                                       for t in resume)):
+                        raise ValueError(
+                            "'resume_tokens' must be a list of ints"
+                        )
                 stream_mode = bool(body.get("stream", True))
                 deadline_ms = self._opt_number(body, "deadline_ms")
                 kw = dict(
@@ -916,6 +937,7 @@ def _make_handler(gw):
                     top_k=body.get("top_k", 0),
                     top_p=self._opt_number(body, "top_p"),
                     seed=body.get("seed"),
+                    resume_tokens=resume or None,
                 )
             except ValueError as e:
                 self._send_json(400, {"error": str(e),
@@ -953,9 +975,30 @@ def _make_handler(gw):
                     "request_id": rid,
                     "tokens": toks,
                     "finish_reason": stream.finish_reason,
-                }, **facts))
+                }, **facts, **self._resume_state(stream, len(toks))))
                 return 200, None, len(toks)
             return self._stream_sse(stream, tenant, rid, timeout)
+
+        @staticmethod
+        def _resume_state(stream, sent):
+            """The reconstruction state every generate done/error event
+            carries: how many tokens of the LOGICAL generation are out
+            (the resumed suffix plus this stream's emissions) and the
+            determinism knobs — enough for any caller (the router's
+            failover path, or an end client) to build the next resume
+            request without having tracked anything but the tokens."""
+            # getattr like _stash_gen_facts: duck-typed stream fakes
+            # (tests, bespoke servers) must not break the error path
+            return {
+                "emitted_count": (
+                    len(getattr(stream, "resume_tokens", ()) or ())
+                    + int(sent)
+                ),
+                "seed": getattr(stream, "seed", None),
+                "temperature": getattr(stream, "temperature", 0.0),
+                "top_k": getattr(stream, "top_k", 0),
+                "top_p": getattr(stream, "top_p", 0.0),
+            }
 
         def _stash_gen_facts(self, stream, fallback_ttft_ms=None):
             """Engine-stamped latency + prefix-cache facts, derived ONCE
@@ -971,6 +1014,13 @@ def _make_handler(gw):
                 "ttft_ms": round(ttft, 3) if ttft is not None else None,
                 "cached_prefix_tokens": int(getattr(
                     stream, "cached_prefix_tokens", 0) or 0),
+                # windowed-admission fact (1 = monolithic prefill):
+                # with resumed_tokens > 0 this is the proof a resume's
+                # re-prefill rode the chunked/prefix path
+                "admit_windows": int(getattr(
+                    stream, "admit_windows", 0) or 0),
+                "resumed_tokens": len(getattr(
+                    stream, "resume_tokens", ()) or ()),
             }
             self._log_extra = facts
             return facts
@@ -1009,8 +1059,14 @@ def _make_handler(gw):
                     _profiler.bump_counter("gateway_tenant_shed_"
                                            + _tenant_slug(tenant))
                     try:
+                        # carries the reconstruction state (emitted
+                        # count, seed, knobs) like every terminal
+                        # generate event — a caller can resume even a
+                        # deadline-cut stream with a fresh budget
                         self._chunk('data: %s\n\n' % json.dumps(
-                            {"error": "deadline", "request_id": rid}
+                            dict({"error": "deadline",
+                                  "request_id": rid},
+                                 **self._resume_state(stream, sent))
                         ))
                         self._chunk_end()
                     except OSError:
@@ -1025,8 +1081,9 @@ def _make_handler(gw):
                     # raw status line into the chunked body
                     try:
                         self._chunk('data: %s\n\n' % json.dumps(
-                            {"error": str(e) or repr(e),
-                             "request_id": rid}
+                            dict({"error": str(e) or repr(e),
+                                  "request_id": rid},
+                                 **self._resume_state(stream, sent))
                         ))
                         self._chunk_end()
                     except OSError:
@@ -1052,6 +1109,11 @@ def _make_handler(gw):
                     return 499, "client_stalled", sent
                 sent += 1
                 _profiler.bump_counter("gateway_stream_tokens")
+                # chaos seam (no-op unless FLAGS_chaos_die_after_tokens
+                # is armed): the process dies AFTER this token hit the
+                # wire, pinning replica-death trials to an exact token
+                # boundary
+                _chaos.on_stream_token()
             # the done event carries the engine-stamped TTFT (falling
             # back to the gateway-side first-chunk wall) and the
             # prefix-cache reuse fact, so a streaming client sees its
@@ -1062,7 +1124,8 @@ def _make_handler(gw):
                 self._chunk('data: %s\n\n' % json.dumps(
                     dict({"done": True,
                           "finish_reason": stream.finish_reason,
-                          "tokens": sent, "request_id": rid}, **facts),
+                          "tokens": sent, "request_id": rid}, **facts,
+                         **self._resume_state(stream, sent)),
                     sort_keys=True,
                 ))
                 self._chunk_end()

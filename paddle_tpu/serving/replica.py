@@ -27,12 +27,17 @@ Contract with the controller:
 
 Scope: this stock replica serves ``/v1/infer`` over any
 ``save_inference_model`` export. ``/v1/generate`` needs a
-``DecodeEngine`` (a GPT-config decode session, not an arbitrary saved
-model) — generation fleets supply a custom ``replica_cmd`` whose
-process attaches one (``InferenceServer(pred, decode_engine=...)`` +
-``Gateway``, exactly as in tools/gateway_probe.py) or register such
-gateways on the Router directly; the router's SSE pin/relay path works
-against any gateway backend and is tested against streaming backends.
+``DecodeEngine``: pass ``--gpt-decode '<json spec>'`` and the replica
+builds a GPT decode session beside the predictor — the spec carries the
+GPTConfig geometry plus ``{"seed", "max_len", "slots",
+"prefill_buckets"}``, and the params initialize from a SEEDED startup
+program, so every replica spawned with the same spec holds bit-identical
+weights (the property that makes a mid-stream failover token-exact: the
+resumed replica's logits equal the dead one's). Engine knobs
+(``FLAGS_decode_prefix_cache_mb``, ``FLAGS_decode_prefill_chunk``, ...)
+ride the environment like everything else. Fleets with bespoke engines
+still supply a custom ``replica_cmd``; the router's SSE pin/relay path
+works against any gateway backend.
 """
 
 from __future__ import annotations
@@ -43,7 +48,7 @@ import os
 import sys
 import time
 
-__all__ = ["main"]
+__all__ = ["build_gpt_decode_engine", "main"]
 
 
 def _write_endpoint(path, payload):
@@ -64,6 +69,40 @@ def _load_warmup(model_dir, warmup_path):
         return [f["arr_%d" % i] for i in range(len(f.files))]
 
 
+def build_gpt_decode_engine(spec):
+    """A ``DecodeEngine`` from a ``--gpt-decode`` spec dict: tiny-based
+    GPTConfig overrides plus ``seed`` (params initialize from a seeded
+    startup program — bit-identical across every process given the same
+    spec, the replica-interchangeability contract failover rests on),
+    ``max_len``, ``slots`` and ``prefill_buckets``. Shared with the
+    failover probe, which builds ITS oracle engine from the same spec."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt as _gpt
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    spec = dict(spec)
+    seed = int(spec.pop("seed", 0))
+    max_len = int(spec.pop("max_len", 64))
+    slots = int(spec.pop("slots", 8))
+    buckets = spec.pop("prefill_buckets", None)
+    spec.setdefault("hidden_dropout", 0.0)
+    spec.setdefault("attention_dropout", 0.0)
+    cfg = _gpt.GPTConfig.tiny(**spec)
+    cfg.max_position_embeddings = max_len
+    with fluid.unique_name.guard():
+        infer_prog, startup, _names, _logits = _gpt.build_gpt_infer(
+            cfg, max_len
+        )
+    startup.random_seed = seed
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+    return DecodeEngine(cfg, scope=scope, slots=slots, max_len=max_len,
+                        prefill_buckets=buckets,
+                        param_program=infer_prog)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-dir", required=True,
@@ -77,6 +116,10 @@ def main(argv=None):
     ap.add_argument("--warmup-npz", default="",
                     help="override the warmup example "
                          "(default: <model-dir>/warmup.npz)")
+    ap.add_argument("--gpt-decode", default="",
+                    help="JSON spec: attach a seeded GPT DecodeEngine "
+                         "so this replica serves /v1/generate "
+                         "(see build_gpt_decode_engine)")
     args = ap.parse_args(argv)
 
     # heavy imports AFTER argparse: --help must not pay for jax
@@ -87,8 +130,13 @@ def main(argv=None):
     pred = inference.create_paddle_predictor(
         inference.AnalysisConfig(args.model_dir)
     )
+    engine = None
+    if args.gpt_decode:
+        engine = build_gpt_decode_engine(json.loads(args.gpt_decode))
     warmup = _load_warmup(args.model_dir, args.warmup_npz)
-    server = serving.InferenceServer(pred).start(warmup_inputs=warmup)
+    server = serving.InferenceServer(
+        pred, decode_engine=engine
+    ).start(warmup_inputs=warmup)
     gw = serving.Gateway(
         server, port=0, host=args.host,
         extra_headers={

@@ -107,9 +107,11 @@ _FORWARD_HEADERS = (
 
 
 class Backend(object):
-    """One routable replica gateway."""
+    """One routable replica gateway (+ its circuit-breaker state)."""
 
-    __slots__ = ("id", "host", "port", "version", "ready", "inflight")
+    __slots__ = ("id", "host", "port", "version", "ready", "inflight",
+                 "fail_streak", "breaker_until", "probe_inflight",
+                 "probe_t")
 
     def __init__(self, backend_id, host, port, version=0, ready=False):
         self.id = str(backend_id)
@@ -118,6 +120,24 @@ class Backend(object):
         self.version = int(version)
         self.ready = bool(ready)
         self.inflight = 0
+        # circuit breaker: consecutive request-path failures open it
+        # (excluded from picks until breaker_until), then half-open —
+        # a single probe request (probe_inflight) decides re-admission.
+        # Orthogonal to `ready` on purpose: the health loop re-admits a
+        # backend whose /readyz answers, but a FLAPPING replica (ready
+        # yet failing requests) would then eat one transparent retry
+        # from every in-flight request — the breaker is what remembers
+        # the request-path verdict across health re-admissions.
+        self.fail_streak = 0
+        self.breaker_until = 0.0  # monotonic expiry of the OPEN state
+        self.probe_inflight = False
+        self.probe_t = 0.0        # when the half-open probe was admitted
+
+    def breaker_state(self, now=None):
+        if self.breaker_until <= 0.0:
+            return "closed"
+        now = time.monotonic() if now is None else now
+        return "open" if now < self.breaker_until else "half_open"
 
     def as_dict(self):
         return {
@@ -127,6 +147,8 @@ class Backend(object):
             "version": self.version,
             "ready": self.ready,
             "inflight": self.inflight,
+            "breaker": self.breaker_state(),
+            "fail_streak": self.fail_streak,
         }
 
 
@@ -144,6 +166,115 @@ class _PayloadTooLarge(ValueError):
     """Request body over _MAX_BODY_BYTES — mapped to HTTP 413."""
 
 
+class _GenCtx(object):
+    """Per-generation failover context threaded through the SSE relay:
+    the parsed request (to build resume forms), the router-receipt
+    clock + client deadline (a failover must carry the REMAINING
+    budget, never a fresh one), and the set of backends this
+    generation already failed on."""
+
+    __slots__ = ("parsed", "t_recv", "deadline_ms", "tried", "version")
+
+    def __init__(self, parsed, t_recv, deadline_ms):
+        self.parsed = parsed
+        self.t_recv = t_recv
+        self.deadline_ms = deadline_ms
+        self.tried = set()
+        # the MODEL VERSION of the backend that opened the stream: a
+        # resume must land on the same version — during a rollout the
+        # router's active version may already have flipped, and
+        # re-prefilling on different weights would silently splice a
+        # diverged continuation into a stream sold as token-exact
+        self.version = None
+
+    def resumable(self):
+        """A generation can move replicas only if its continuation is
+        deterministic: greedy always is; a temperature-sampled request
+        must carry its seed (the engine-side seed-required rule). An
+        unparseable body can't grow a resume form at all."""
+        p = self.parsed
+        if not isinstance(p, dict):
+            return False
+        prompt = p.get("prompt_ids")
+        if not isinstance(prompt, list) or not prompt:
+            return False
+        t = p.get("temperature")
+        sampled = (isinstance(t, (int, float))
+                   and not isinstance(t, bool) and t > 0)
+        return (not sampled) or p.get("seed") is not None
+
+
+def _split_sse_frames(buf):
+    """(complete_frames, rest): SSE frames end at a blank line — LF-LF
+    (what this repo's gateways emit) or the spec-equally-valid
+    CRLF-CRLF a foreign backend may use. The relay forwards COMPLETE
+    frames only, so a backend death mid-frame never leaks half an
+    event onto the client's wire — the torn tail is discarded and the
+    resumed replica re-emits that token."""
+    frames = []
+    while True:
+        i1 = buf.find(b"\n\n")
+        i2 = buf.find(b"\r\n\r\n")
+        if i2 >= 0 and (i1 < 0 or i2 < i1):
+            frames.append(buf[:i2])
+            buf = buf[i2 + 4:]
+        elif i1 >= 0:
+            frames.append(buf[:i1])
+            buf = buf[i1 + 2:]
+        else:
+            return frames, buf
+
+
+def _rewrite_spliced_done(frame, total_tokens, rid):
+    """A SPLICED stream's relayed done event must describe the whole
+    stream the client saw, not the final hop: the resumed gateway's
+    ``tokens`` counts only its own continuation and its ``request_id``
+    is the resume hop's — rewrite both to the stream-level truth (the
+    full relayed count, the first hop's id). Non-done frames (tokens,
+    in-band errors, comments) pass through untouched, as does every
+    frame of an unspliced stream (the caller only rewrites after a
+    failover)."""
+    for line in frame.split(b"\n"):
+        sline = line.rstrip(b"\r")
+        if not sline.startswith(b"data: "):
+            continue
+        try:
+            obj = json.loads(sline[len(b"data: "):].decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            return frame
+        if not isinstance(obj, dict) or not obj.get("done"):
+            return frame
+        obj["tokens"] = int(total_tokens)
+        if rid is not None:
+            obj["request_id"] = rid
+        return b"data: " + json.dumps(obj, sort_keys=True).encode("utf-8")
+    return frame
+
+
+def _frame_token(frame):
+    """(token|None, terminal): the token carried by a ``data:`` event
+    frame, and whether the frame ends the stream (done or in-band
+    error). Non-JSON / comment frames parse as (None, False)."""
+    for line in frame.split(b"\n"):
+        line = line.rstrip(b"\r")  # CRLF-framed backends
+        if not line.startswith(b"data: "):
+            continue
+        try:
+            obj = json.loads(line[len(b"data: "):].decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            continue
+        if not isinstance(obj, dict):
+            continue
+        if "token" in obj:
+            try:
+                return int(obj["token"]), False
+            except (TypeError, ValueError):
+                return None, False
+        if "done" in obj or "error" in obj:
+            return None, True
+    return None, False
+
+
 class Router(object):
     """Health-checked least-inflight HTTP router over replica gateways.
 
@@ -154,7 +285,9 @@ class Router(object):
     """
 
     def __init__(self, port=None, host="127.0.0.1", health_interval_s=None,
-                 retries=None, backend_timeout_s=None):
+                 retries=None, backend_timeout_s=None,
+                 generate_retries=None, breaker_failures=None,
+                 breaker_cooldown_s=None):
         self.host = host
         self.port_requested = int(_flag("router_port", port))
         self.health_interval_s = float(
@@ -163,6 +296,20 @@ class Router(object):
         self.retries = int(_flag("router_retries", retries))
         self.backend_timeout_s = float(
             _flag("router_backend_timeout_s", backend_timeout_s)
+        )
+        # durable generations: mid-stream backend death/timeout re-admits
+        # the generation elsewhere (token-exact resume) up to this many
+        # times per stream, within the request deadline; 0 = old
+        # behavior (in-band error event)
+        self.generate_retries = int(
+            _flag("router_generate_retries", generate_retries)
+        )
+        # per-backend circuit breaker (0 failures = disabled)
+        self.breaker_failures = int(
+            _flag("router_breaker_failures", breaker_failures)
+        )
+        self.breaker_cooldown_s = float(
+            _flag("router_breaker_cooldown_s", breaker_cooldown_s)
         )
         self._backends = {}  # id -> Backend
         self._active_version = None  # None = route every version
@@ -174,6 +321,7 @@ class Router(object):
         self._started = False
         self._inflight_gauge = None
         self._ready_gauge = None
+        self._breaker_gauge = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -200,6 +348,9 @@ class Router(object):
         self._ready_gauge = lambda r=self: r.ready_count()
         _obs_registry.register_gauge("router_backends_ready",
                                      self._ready_gauge)
+        self._breaker_gauge = lambda r=self: r.breaker_open_count()
+        _obs_registry.register_gauge("router_breaker_open",
+                                     self._breaker_gauge)
         return self
 
     def stop(self):
@@ -230,6 +381,10 @@ class Router(object):
             _obs_registry.unregister_gauge("router_backends_ready",
                                            self._ready_gauge)
             self._ready_gauge = None
+        if self._breaker_gauge is not None:
+            _obs_registry.unregister_gauge("router_breaker_open",
+                                           self._breaker_gauge)
+            self._breaker_gauge = None
 
     def __enter__(self):
         return self if self._started else self.start()
@@ -287,35 +442,100 @@ class Router(object):
         with self._lock:
             return sum(b.inflight for b in self._backends.values())
 
+    def breaker_open_count(self):
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for b in self._backends.values()
+                       if b.breaker_state(now) == "open")
+
     def _routable(self, b):
         return (self._active_version is None
                 or b.version == self._active_version)
 
-    def _pick(self, exclude=()):
+    def _pick(self, exclude=(), version=None):
         """Least-inflight ready backend of the active version (ties by
-        id, so picks are deterministic); reserves an inflight slot."""
+        id, so picks are deterministic); reserves an inflight slot.
+        ``version`` (a generate-resume pick) additionally pins to ONE
+        model version regardless of the active-version filter — the
+        resumed continuation must come from the same weights.
+        Breaker-aware: OPEN backends are skipped outright; a HALF-OPEN
+        backend is eligible for exactly ONE concurrent probe request —
+        its zero inflight makes it the least-inflight pick, so the next
+        request probes it promptly, but a traffic wave can't pile onto
+        a replica that hasn't proven itself yet."""
+        now = time.monotonic()
         with self._lock:
-            ready = [
-                b for b in self._backends.values()
-                if b.ready and b.id not in exclude and self._routable(b)
-            ]
+            ready = []
+            for b in self._backends.values():
+                if not b.ready or b.id in exclude:
+                    continue
+                if version is not None:
+                    if b.version != version:
+                        continue
+                elif not self._routable(b):
+                    continue
+                state = b.breaker_state(now)
+                if state == "open":
+                    continue
+                if state == "half_open" and b.probe_inflight:
+                    # one probe at a time — but an ABANDONED probe (its
+                    # request resolved neither success nor failure, e.g.
+                    # the client vanished mid-relay) must not block
+                    # re-admission forever: past the backend timeout it
+                    # can no longer be outstanding, reclaim the slot
+                    if now - b.probe_t <= self.backend_timeout_s:
+                        continue
+                ready.append((b, state))
             if not ready:
                 return None
-            b = min(ready, key=lambda x: (x.inflight, x.id))
+            b, state = min(ready, key=lambda x: (x[0].inflight, x[0].id))
+            if state == "half_open":
+                b.probe_inflight = True
+                b.probe_t = now
             b.inflight += 1
             return b
 
     def _release(self, b):
         with self._lock:
             b.inflight = max(0, b.inflight - 1)
+            # NOTE: probe_inflight is NOT cleared here — _release runs
+            # for every request on the backend (e.g. a long-lived pinned
+            # stream ending), and clearing unconditionally would reopen
+            # the single-probe slot while the real probe is still out,
+            # letting a traffic wave pile onto an unproven replica. The
+            # probe's own terminal outcomes (_note_success /
+            # _mark_failed) clear it; an abandoned probe is reclaimed by
+            # _pick after the backend timeout.
 
     def _mark_failed(self, b):
         """A request-path connection failure is a stronger signal than
         the last health poll: stop routing to the backend immediately;
-        the health loop re-admits it when /readyz answers again."""
+        the health loop re-admits it when /readyz answers again. The
+        failure also feeds the per-backend circuit breaker: at
+        ``breaker_failures`` CONSECUTIVE request-path failures the
+        breaker opens for ``breaker_cooldown_s`` (excluded from picks
+        even if /readyz flips healthy in between), then goes half-open
+        for a single probe."""
+        now = time.monotonic()
         with self._lock:
             b.ready = False
+            b.probe_inflight = False
+            b.fail_streak += 1
+            if (self.breaker_failures > 0
+                    and b.fail_streak >= self.breaker_failures):
+                if b.breaker_state(now) != "open":
+                    _profiler.bump_counter("router_breaker_open_total")
+                b.breaker_until = now + self.breaker_cooldown_s
         _profiler.bump_counter("router_backend_failures")
+
+    def _note_success(self, b):
+        """The backend ANSWERED (any relayed status — even a 429 is a
+        healthy replica talking): reset the failure streak and close the
+        breaker. This is what ends a half-open probe in re-admission."""
+        with self._lock:
+            b.fail_streak = 0
+            b.breaker_until = 0.0
+            b.probe_inflight = False
 
     # -- health loop ---------------------------------------------------------
     def _health_loop(self):
@@ -444,15 +664,25 @@ def _make_handler(router):
             except ValueError as e:
                 self._send_json(400, {"error": str(e)}, close=True)
                 return
+            # parse ONCE at receipt: the deadline clock starts here (the
+            # router's own queue/forward time draws the client's budget
+            # down), and /v1/generate failover needs the parsed form to
+            # build resume bodies. An unparseable body forwards verbatim
+            # — the replica's 400 is the answer
+            t_recv = time.monotonic()
+            parsed = self._parse_json(body)
+            deadline_ms = self._deadline_of(parsed)
             _profiler.bump_counter("router_requests")
             t0 = time.monotonic()
             try:
                 with _trace.span("router_request", cat="router",
                                  endpoint=path):
                     if path == "/v1/infer":
-                        status = self._proxy_json(path, body)
+                        status = self._proxy_json(path, body, parsed,
+                                                  t_recv, deadline_ms)
                     else:
-                        status = self._proxy_generate(body)
+                        status = self._proxy_generate(body, parsed,
+                                                      t_recv, deadline_ms)
             except ConnectionError:
                 status = 499  # client went away; nothing left to write
             except Exception as e:  # the handler thread must survive
@@ -465,6 +695,57 @@ def _make_handler(router):
                 _profiler.bump_histogram(
                     "router_latency_ms", (time.monotonic() - t0) * 1e3
                 )
+
+        @staticmethod
+        def _parse_json(body):
+            try:
+                obj = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                return None
+            return obj if isinstance(obj, dict) else None
+
+        @staticmethod
+        def _deadline_of(parsed):
+            if parsed is None:
+                return None
+            v = parsed.get("deadline_ms")
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            return float(v) if v > 0 else None
+
+        @staticmethod
+        def _remaining_ms(t_recv, deadline_ms):
+            """The client budget LEFT after the router's own elapsed
+            time (None = no deadline armed)."""
+            if deadline_ms is None:
+                return None
+            return deadline_ms - (time.monotonic() - t_recv) * 1e3
+
+        def _forward_body(self, body, parsed, t_recv, deadline_ms):
+            """The bytes to forward: with a deadline armed, the body is
+            re-serialized with ``deadline_ms`` decremented by the
+            router's elapsed time — a replica (and, critically, a
+            failover re-admission) can never be granted more budget
+            than the client has left, so a resumed request 504s at the
+            same wall-clock instant the unbroken one would. Returns
+            None when the budget is already gone."""
+            left = self._remaining_ms(t_recv, deadline_ms)
+            if left is None:
+                return body
+            if left <= 0:
+                return None
+            return json.dumps(dict(parsed, deadline_ms=left),
+                              sort_keys=True).encode("utf-8")
+
+        def _send_deadline_504(self):
+            _profiler.bump_counter("router_deadline_sheds")
+            self._send_json(
+                504,
+                {"error": "client deadline exhausted at the router",
+                 "reason": "deadline"},
+                close=True,
+            )
+            return 504
 
         def _no_backend(self):
             _profiler.bump_counter("router_no_backend")
@@ -519,25 +800,36 @@ def _make_handler(router):
             self.wfile.write(data)
             return resp.status
 
-        def _proxy_json(self, path, body, pin_on_response=False):
+        def _proxy_json(self, path, body, parsed, t_recv, deadline_ms,
+                        gen_ctx=None):
             """Retrying proxy for idempotent JSON requests. A backend
             503 means the request was REJECTED unexecuted (drain began
             after the pick) — as retriable as a dead socket. Everything
             else, including 429 backpressure, is the replica's answer
-            and relays verbatim."""
-            tried = set()
+            and relays verbatim. ``gen_ctx`` marks the /v1/generate
+            path: a 200 SSE response hands off to the failover-capable
+            stream relay, and pre-response timeouts shed instead of
+            re-executing pinned work."""
+            tried = set() if gen_ctx is None else gen_ctx.tried
             for attempt in range(router.retries + 1):
+                fwd = self._forward_body(body, parsed, t_recv,
+                                         deadline_ms)
+                if fwd is None:
+                    # the budget died in the router's own queue — the
+                    # same 504 the replica's dispatch shed would return
+                    return self._send_deadline_504()
                 b = router._pick(exclude=tried)
                 if b is None:
                     return self._no_backend()
                 tried.add(b.id)
                 if attempt:
                     _profiler.bump_counter("router_retries")
+                handed_off = False
                 try:
-                    conn, resp = self._backend_request(b, path, body)
+                    conn, resp = self._backend_request(b, path, fwd)
                 except _ProxyFailure as e:
                     router._release(b)
-                    if e.timeout and pin_on_response:
+                    if e.timeout and gen_ctx is not None:
                         # a generation slower than the proxy timeout:
                         # re-executing it elsewhere would burn another
                         # replica's decode slots on work whose first
@@ -552,18 +844,24 @@ def _make_handler(router):
                         return 504
                     continue
                 try:
-                    if pin_on_response and resp.status == 200:
+                    if gen_ctx is not None and resp.status == 200:
                         # /v1/generate with "stream": true answers SSE:
-                        # hand the open response to the stream relay
+                        # hand the open response to the stream relay,
+                        # which owns the connection/slot from here
                         ctype = resp.headers.get("Content-Type", "")
                         if "text/event-stream" in ctype:
-                            return self._relay_stream(b, conn, resp)
+                            handed_off = True
+                            # resumes pin to the weights that opened
+                            # the stream (see _GenCtx.version)
+                            gen_ctx.version = b.version
+                            return self._relay_stream(b, conn, resp,
+                                                      gen_ctx)
                     try:
                         data = resp.read()
                     except socket.timeout:
                         # slow, not dead (see _backend_request)
                         _profiler.bump_counter("router_backend_timeouts")
-                        if pin_on_response:
+                        if gen_ctx is not None:
                             self._send_json(
                                 504,
                                 {"error": "backend timed out mid-"
@@ -582,10 +880,14 @@ def _make_handler(router):
                     if resp.status == 503:
                         router._mark_failed(b)
                         continue
+                    # the replica ANSWERED: feed the breaker's
+                    # consecutive-failure reset before relaying
+                    router._note_success(b)
                     return self._relay(resp, data, b.id)
                 finally:
-                    conn.close()
-                    router._release(b)
+                    if not handed_off:
+                        conn.close()
+                        router._release(b)
             _profiler.bump_counter("router_no_backend")
             self._send_json(
                 502,
@@ -595,16 +897,113 @@ def _make_handler(router):
             )
             return 502
 
-        def _proxy_generate(self, body):
+        def _proxy_generate(self, body, parsed, t_recv, deadline_ms):
             # pre-response failures retry exactly like infer (nothing
-            # was decoded, nothing was sent); an open stream pins
-            return self._proxy_json("/v1/generate", body,
-                                    pin_on_response=True)
+            # was decoded, nothing was sent); an open SSE stream pins —
+            # but a DETERMINISTIC generation (greedy, or sampled with a
+            # seed) survives its replica's mid-stream death via a
+            # token-exact resume on another replica (_relay_stream)
+            ctx = _GenCtx(parsed, t_recv, deadline_ms)
+            return self._proxy_json("/v1/generate", body, parsed,
+                                    t_recv, deadline_ms, gen_ctx=ctx)
 
-        def _relay_stream(self, b, conn, resp):
-            """Relay an open SSE stream chunk-for-chunk. Mid-stream
-            backend death rides the in-band error event contract —
-            the 200 + chunked framing is already on the client's wire."""
+        @staticmethod
+        def _finished_reason(ctx, base, captured):
+            """The finish_reason of a generation whose relayed tokens
+            already satisfy its own termination rules (eos emitted, or
+            the max_new_tokens budget reached) — None while more tokens
+            are genuinely owed. The engine stops AT eos, so an eos id
+            in the captured suffix is necessarily its final token."""
+            p = ctx.parsed if isinstance(ctx.parsed, dict) else {}
+            eos = p.get("eos_id")
+            if (isinstance(eos, int) and not isinstance(eos, bool)
+                    and eos in captured):
+                return "eos"
+            mn = p.get("max_new_tokens")
+            if (isinstance(mn, (int, float)) and not isinstance(mn, bool)
+                    and mn > 0 and base + len(captured) >= mn):
+                return "length"
+            return None
+
+        def _resume_attempt(self, ctx, resume_tokens):
+            """Try to re-admit an interrupted generation on a healthy
+            replica: returns (backend, conn, resp) on success, or
+            (None, None, reason) when the generation cannot continue.
+            Each call consumes one pick; transient failures (dead
+            socket, 503 drain) are the CALLER's to retry under its
+            failover budget."""
+            nb = router._pick(exclude=ctx.tried, version=ctx.version)
+            if nb is None:
+                return None, None, "no healthy replica of the stream's " \
+                                   "model version"
+            rb = dict(ctx.parsed)
+            rb["resume_tokens"] = resume_tokens
+            left = self._remaining_ms(ctx.t_recv, ctx.deadline_ms)
+            if left is not None:
+                if left <= 0:
+                    router._release(nb)
+                    return None, None, "deadline"
+                # the REMAINING budget, never a fresh one: the resumed
+                # request must 504 at the same wall-clock instant the
+                # unbroken one would
+                rb["deadline_ms"] = left
+            fwd = json.dumps(rb, sort_keys=True).encode("utf-8")
+            try:
+                nconn, nresp = self._backend_request(nb, "/v1/generate",
+                                                     fwd)
+            except _ProxyFailure:
+                router._release(nb)
+                ctx.tried.add(nb.id)
+                return None, None, None  # transient — caller may retry
+            ok = (nresp.status == 200
+                  and "text/event-stream"
+                  in nresp.headers.get("Content-Type", ""))
+            if ok:
+                return nb, nconn, nresp
+            try:
+                nresp.read()
+            except Exception:  # noqa: BLE001 - drain is best-effort
+                pass
+            nconn.close()
+            router._release(nb)
+            ctx.tried.add(nb.id)
+            if nresp.status == 503:
+                # drain began after the pick: transient, try another
+                router._mark_failed(nb)
+                return None, None, None
+            if nresp.status == 429:
+                # backpressure shed (momentarily full admission queue /
+                # rate bucket): transient by definition — NOT a failure
+                # mark, and the stream's remaining failover budget may
+                # find a freer replica
+                return None, None, None
+            # the replica REFUSED the resume form (validation, seed
+            # rule): deterministic rejection, do not hammer the pool
+            return None, None, "resume rejected (%d)" % nresp.status
+
+        def _relay_stream(self, b, conn, resp, ctx):
+            """Relay an open SSE stream and fail OVER a mid-stream
+            replica death or timeout by resuming the generation
+            token-exactly on another replica (durable generations).
+
+            Only COMPLETE SSE frames are forwarded (buffered until the
+            blank-line frame boundary), so the client's wire never
+            carries half an event: on failover the continued stream
+            splices cleanly after a ``: failover`` comment frame —
+            every token exactly once, then the ordinary done event.
+            The relay parses the token ids it forwards; prompt +
+            relayed tokens + the request's seed/knobs ARE the resume
+            form, so no state beyond this handler is needed. Bounded by
+            ``FLAGS_router_generate_retries`` and the client deadline;
+            unresumable cases (non-deterministic request, budget or
+            deadline exhausted, no healthy replica, resume rejected)
+            degrade to the in-band error event + clean terminator.
+
+            read1, NOT readline: http.client's readline goes through
+            _peek_chunked, which SWALLOWS the IncompleteRead of a
+            truncated chunked stream and reports clean EOF — a replica
+            death would look like a normal end of stream; read1
+            raises."""
             self.send_response(200)
             for k in ("Content-Type", "Cache-Control", "X-Request-Id",
                       "X-Replica-Id", "X-Model-Version"):
@@ -613,53 +1012,184 @@ def _make_handler(router):
             self.send_header("Transfer-Encoding", "chunked")
             self.send_header("X-Routed-Backend", b.id)
             self.end_headers()
-            try:
-                while True:
+            # tokens a client-sent resume form already covers: the
+            # failover's resume body and emitted_count attribution both
+            # continue the LOGICAL generation, not just this hop
+            base = 0
+            if (ctx.parsed
+                    and isinstance(ctx.parsed.get("resume_tokens"), list)):
+                base = len(ctx.parsed["resume_tokens"])
+            # the id the first replica minted (relayed in the headers
+            # above): router-synthesized terminal events must carry it
+            # like every gateway-written one does
+            rid = resp.headers.get("X-Request-Id")
+            captured = []  # token ids relayed to the client (this req)
+            failovers = 0
+            cur, cconn, cresp = b, conn, resp
+            while True:  # one iteration per backend hop
+                fail = None  # ("timeout"|"death", detail) on loss
+                finished = False
+                buf = b""
+                try:
+                    while True:
+                        try:
+                            data = cresp.read1(65536)
+                        except socket.timeout as e:
+                            # slow, not dead: no failover mark — but the
+                            # CLIENT's stream can still move replicas
+                            _profiler.bump_counter(
+                                "router_backend_timeouts")
+                            fail = ("timeout", str(e) or "backend timeout")
+                            break
+                        except (OSError,
+                                http.client.HTTPException) as e:
+                            router._mark_failed(cur)
+                            fail = ("death", str(e) or repr(e))
+                            break
+                        if not data:
+                            # clean chunked terminator: the gateway
+                            # always precedes it with done/error, so
+                            # this is the stream's legitimate end
+                            finished = True
+                            break
+                        buf += data
+                        frames, buf = _split_sse_frames(buf)
+                        for fr in frames:
+                            tok, terminal = _frame_token(fr)
+                            if tok is not None:
+                                captured.append(tok)
+                            if terminal and failovers:
+                                # spliced stream: the done event must
+                                # carry stream-level tokens/request_id,
+                                # not the final hop's locals
+                                fr = _rewrite_spliced_done(
+                                    fr, len(captured), rid)
+                            # raw frame bytes otherwise: no decode/
+                            # encode (UTF-8 sequences split by read1
+                            # stay intact inside the buffered frame)
+                            self._chunk(fr + b"\n\n")
+                            if terminal:
+                                finished = True
+                        if finished:
+                            break
+                except OSError:
+                    # the CLIENT went away: stop pulling tokens for
+                    # nobody
+                    cconn.close()
+                    router._release(cur)
+                    return 499
+                cconn.close()
+                router._release(cur)
+                if finished:
+                    if fail is None:
+                        router._note_success(cur)
                     try:
-                        # read1, NOT readline: http.client's readline
-                        # goes through _peek_chunked, which SWALLOWS
-                        # the IncompleteRead of a truncated chunked
-                        # stream and reports clean EOF — a replica
-                        # death would relay as a normal end of stream
-                        # with no error event; read1 raises.
-                        data = resp.read1(65536)
-                    except socket.timeout:
-                        # slow, not dead (timeout != death, same as the
-                        # non-stream path): the replica keeps its ready
-                        # state, the client gets an in-band timeout
-                        _profiler.bump_counter("router_backend_timeouts")
+                        self._chunk_end()
+                    except OSError:
+                        return 499
+                    return 200
+                ctx.tried.add(cur.id)
+                # the generation may already be COMPLETE: a replica
+                # dying in the gap between its last token frame and the
+                # done frame (exactly where the chaos hook kills) would
+                # produce a resume form every engine REJECTS (budget
+                # spent / eos already emitted). The router holds every
+                # token, so it synthesizes the done event instead of
+                # erroring a fully-delivered generation.
+                fin = self._finished_reason(ctx, base, captured)
+                if fin is not None:
+                    p = ctx.parsed or {}
+                    ev = {"done": True, "finish_reason": fin,
+                          "tokens": len(captured),
+                          "emitted_count": base + len(captured),
+                          "synthesized": True,
+                          # the state every gateway-written terminal
+                          # event carries (seed/knobs echoed from the
+                          # request the router already parsed)
+                          "seed": p.get("seed"),
+                          "temperature": p.get("temperature"),
+                          "top_k": p.get("top_k"),
+                          "top_p": p.get("top_p")}
+                    if rid is not None:
+                        ev["request_id"] = rid
+                    try:
                         self._chunk("data: %s\n\n" % json.dumps(
+                            ev, sort_keys=True))
+                        self._chunk_end()
+                    except OSError:
+                        return 499
+                    return 200
+                # -- failover: resume the generation elsewhere ---------
+                reason = None
+                if not ctx.resumable():
+                    reason = "request is not resumable (sampled " \
+                             "without a seed, or unparseable)"
+                spliced = False
+                while reason is None and failovers < router.generate_retries:
+                    failovers += 1
+                    resume = None
+                    if ctx.parsed.get("resume_tokens"):
+                        resume = list(ctx.parsed["resume_tokens"])
+                    nb, nconn, nresp = self._resume_attempt(
+                        ctx, (resume or []) + captured
+                    )
+                    if nb is None:
+                        reason = nresp  # terminal reason | None=transient
+                        if reason is None and \
+                                failovers >= router.generate_retries:
+                            reason = "failover budget exhausted"
+                        continue
+                    _profiler.bump_counter("router_generate_failovers")
+                    try:
+                        # attributable seam: an SSE COMMENT frame (":"
+                        # prefix — every spec-compliant parser ignores
+                        # it), so the client's data stream stays pure
+                        self._chunk(
+                            ": failover from=%s to=%s resume_at=%d\n\n"
+                            % (cur.id, nb.id, base + len(captured))
+                        )
+                    except OSError:
+                        nconn.close()
+                        router._release(nb)
+                        return 499
+                    cur, cconn, cresp = nb, nconn, nresp
+                    spliced = True
+                    break
+                if spliced:
+                    continue
+                if reason is None:
+                    reason = "failover budget exhausted" \
+                        if router.generate_retries > 0 else \
+                        "failover disabled (router_generate_retries=0)"
+                # -- give up: the in-band error contract ---------------
+                kind, detail = fail
+                p = ctx.parsed or {}
+                # the same reconstruction state every other terminal
+                # generate event carries: this is THE path where the
+                # client must resume by itself
+                state = {"emitted_count": base + len(captured),
+                         "resume": reason, "backend": cur.id,
+                         "seed": p.get("seed"),
+                         "temperature": p.get("temperature"),
+                         "top_k": p.get("top_k"),
+                         "top_p": p.get("top_p")}
+                try:
+                    if kind == "timeout":
+                        self._chunk("data: %s\n\n" % json.dumps(dict(
                             {"error": "backend timed out mid-stream "
                                       "after %.0fs"
                                       % router.backend_timeout_s,
-                             "reason": "backend_timeout",
-                             "backend": b.id}
-                        ))
+                             "reason": "backend_timeout"}, **state)))
                         self._chunk_end()
                         return 504
-                    except (OSError, http.client.HTTPException) as e:
-                        # replica died mid-stream: the stream is pinned
-                        # — surface it in-band and end the stream sanely
-                        router._mark_failed(b)
-                        _profiler.bump_counter("router_stream_errors")
-                        self._chunk("data: %s\n\n" % json.dumps(
-                            {"error": "replica lost mid-stream: %s"
-                                      % (str(e) or repr(e)),
-                             "backend": b.id}
-                        ))
-                        self._chunk_end()
-                        return 502
-                    if not data:
-                        break
-                    # raw bytes: a decode/encode round-trip would
-                    # corrupt any multi-byte UTF-8 sequence read1
-                    # splits across a block boundary
-                    self._chunk(data)
-            except OSError:
-                # the CLIENT went away: stop pulling tokens for nobody
-                return 499
-            self._chunk_end()
-            return 200
+                    _profiler.bump_counter("router_stream_errors")
+                    self._chunk("data: %s\n\n" % json.dumps(dict(
+                        {"error": "replica lost mid-stream: %s"
+                                  % detail}, **state)))
+                    self._chunk_end()
+                    return 502
+                except OSError:
+                    return 499
 
         def _chunk(self, data):
             if isinstance(data, str):

@@ -249,7 +249,8 @@ class InferenceServer(object):
         return outs
 
     def generate(self, prompt_ids, max_new_tokens=None, eos_id=None,
-                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None,
+                 resume_tokens=None):
         """Autoregressive completion through the attached DecodeEngine:
         returns a ``GenerationStream`` — iterate it for tokens as they
         are generated, or block on ``.tokens()`` / ``.result()``. The
@@ -257,7 +258,11 @@ class InferenceServer(object):
         prefill into a KV-cache slot mid-flight; never recompiles).
         Sampling knobs are per-request, host-side over the fetched
         logits (``decode.sample_token``): greedy is the default, a
-        seeded sampling request replays deterministically."""
+        seeded sampling request replays deterministically.
+        ``resume_tokens`` is the durable-generation resume form (the
+        suffix an interrupted run already emitted — see
+        ``DecodeEngine.submit``); the stream then emits only the
+        token-exact continuation."""
         if self._decode_engine is None:
             raise ServingError(
                 "no decode engine attached: construct the server with "
@@ -266,6 +271,7 @@ class InferenceServer(object):
         return self._decode_engine.generate(
             prompt_ids, max_new_tokens=max_new_tokens, eos_id=eos_id,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            resume_tokens=resume_tokens,
         )
 
     def _seq_align(self, inputs):

@@ -44,6 +44,16 @@ Injection points and their hosts:
 - ``rpc_fail_n`` — the pserver client's retry wrapper raises
   ``ConnectionError`` for the first N calls via ``maybe_rpc_error()``
   (models a pserver that is still restarting).
+- ``die_after_tokens`` (+ ``die_replica``) — the mid-stream serving
+  fault: the gateway's SSE writer calls ``on_stream_token()`` after
+  each token it puts on the wire, and the process SIGKILLs itself the
+  moment its process-wide count reaches the armed N — so a router
+  failover trial kills the replica at a token boundary
+  deterministically instead of racing a SIGKILL against the engine's
+  tick loop. ``die_replica`` scopes it to the replica whose
+  ``PADDLE_TPU_REPLICA_ID`` (injected by the fleet controller) matches
+  (-1 = any process with the fault armed), the serving-side analogue of
+  ``lose_rank``'s slot addressing.
 """
 
 from __future__ import annotations
@@ -59,6 +69,7 @@ __all__ = [
     "clear",
     "active_plan",
     "on_step",
+    "on_stream_token",
     "maybe_slow_feed",
     "corrupt_ckpt_bytes",
     "maybe_rpc_error",
@@ -67,6 +78,7 @@ __all__ = [
 _lock = threading.Lock()
 _plan = None  # in-process FaultPlan (overrides flags when installed)
 _rpc_faults_raised = 0  # process-local count for rpc_fail_n
+_stream_tokens_emitted = 0  # process-local count for die_after_tokens
 # flags-derived plan cache keyed on the flags version: the injection
 # points sit on per-step / per-batch / per-tensor hot paths and the
 # common (disarmed) case must cost one lock + one integer compare, not
@@ -83,7 +95,8 @@ class FaultPlan(object):
     def __init__(self, crash_at_step=None, hang_at_step=None,
                  corrupt_ckpt=False, slow_feed_ms=0.0, rpc_fail_n=0,
                  target_rank=None, marker_dir=None, lose_rank=None,
-                 lose_rank_at_step=None, lose_rank_for=-1):
+                 lose_rank_at_step=None, lose_rank_for=-1,
+                 die_after_tokens=None, die_replica=None):
         self.crash_at_step = crash_at_step
         self.hang_at_step = hang_at_step
         self.corrupt_ckpt = bool(corrupt_ckpt)
@@ -97,6 +110,11 @@ class FaultPlan(object):
         self.lose_rank = lose_rank
         self.lose_rank_at_step = lose_rank_at_step
         self.lose_rank_for = int(lose_rank_for)
+        # mid-stream serving fault: SIGKILL after exactly N stream
+        # tokens hit the wire, addressed by replica id (the serving-side
+        # analogue of lose_rank's slot addressing; None/-1 = any)
+        self.die_after_tokens = die_after_tokens
+        self.die_replica = die_replica
 
     @classmethod
     def from_flags(cls):
@@ -115,8 +133,11 @@ class FaultPlan(object):
         lose = int(_flags.get_flag("chaos_lose_rank", -1))
         lose_at = int(_flags.get_flag("chaos_lose_rank_at_step", -1))
         lose_for = int(_flags.get_flag("chaos_lose_rank_for", -1))
+        die_after = int(_flags.get_flag("chaos_die_after_tokens", -1))
+        die_replica = int(_flags.get_flag("chaos_die_replica", -1))
         if (crash < 0 and hang < 0 and not corrupt and slow <= 0
-                and rpc_n <= 0 and (lose < 0 or lose_at < 0)):
+                and rpc_n <= 0 and (lose < 0 or lose_at < 0)
+                and die_after <= 0):
             return None
         return cls(
             crash_at_step=crash if crash >= 0 else None,
@@ -129,6 +150,8 @@ class FaultPlan(object):
             lose_rank=lose if lose >= 0 and lose_at >= 0 else None,
             lose_rank_at_step=lose_at if lose_at >= 0 else None,
             lose_rank_for=lose_for,
+            die_after_tokens=die_after if die_after > 0 else None,
+            die_replica=die_replica if die_replica >= 0 else None,
         )
 
     def targets_me(self):
@@ -143,6 +166,20 @@ class FaultPlan(object):
         if self.lose_rank is None or self.lose_rank_at_step is None:
             return False
         return _my_slot() == int(self.lose_rank)
+
+    def dies_me(self):
+        """die_after_tokens is armed and aimed at THIS serving replica
+        (its PADDLE_TPU_REPLICA_ID, injected by the fleet controller;
+        an unaddressed fault targets any process it is armed in)."""
+        if self.die_after_tokens is None:
+            return False
+        if self.die_replica is None:
+            return True
+        raw = os.environ.get("PADDLE_TPU_REPLICA_ID", "")
+        try:
+            return int(raw) == int(self.die_replica)
+        except ValueError:
+            return False
 
 
 def _my_slot():
@@ -169,10 +206,11 @@ def install(plan):
 
 
 def clear():
-    global _plan, _rpc_faults_raised
+    global _plan, _rpc_faults_raised, _stream_tokens_emitted
     with _lock:
         _plan = None
         _rpc_faults_raised = 0
+        _stream_tokens_emitted = 0
 
 
 def active_plan():
@@ -256,6 +294,32 @@ def on_step(step):
                   flush=True)
             while True:
                 time.sleep(0.25)
+
+
+def on_stream_token():
+    """Serving-gateway hook, called after each SSE stream token is
+    written to the wire: SIGKILL this process the moment its
+    process-wide emitted-token count reaches the armed
+    ``die_after_tokens`` — a replica death pinned to a token boundary,
+    so failover trials replay deterministically. SIGKILL (not exit):
+    like ``crash_at_step``, a real replica loss gives no atexit /
+    drain, and the router must detect it at the socket."""
+    global _stream_tokens_emitted
+    plan = active_plan()
+    if plan is None or not plan.dies_me():
+        return
+    with _lock:
+        _stream_tokens_emitted += 1
+        n = _stream_tokens_emitted
+    if n == int(plan.die_after_tokens) and _fire_once(plan,
+                                                      "die_after_tokens"):
+        print(
+            "CHAOS die_after_tokens=%d replica=%s pid=%d"
+            % (n, os.environ.get("PADDLE_TPU_REPLICA_ID", "?"),
+               os.getpid()),
+            flush=True,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_slow_feed():

@@ -1165,3 +1165,164 @@ def test_ttft_and_intertoken_histograms_populate(prig):
     hists = profiler.get_histograms()
     assert len(hists.get("decode_ttft_ms", [])) >= 1
     assert len(hists.get("decode_intertoken_ms", [])) >= 1
+
+
+# ---------------------------------------------------------------------------
+# durable generations (ISSUE 13): RNG fast-forward + token-exact resume
+# ---------------------------------------------------------------------------
+def test_fast_forward_rng_equals_discarded_draws():
+    """``fast_forward_rng(k)`` must leave a freshly seeded RandomState
+    in EXACTLY the state ``k`` ``sample_token`` picks leave it — the
+    one-uniform-per-pick consumption contract — for every sampling-knob
+    combination a request can arm."""
+    rows = np.random.RandomState(0).randn(12, 40)
+    for knobs in ({"temperature": 0.9},
+                  {"temperature": 1.2, "top_k": 7},
+                  {"temperature": 0.7, "top_p": 0.85},
+                  {"temperature": 1.1, "top_k": 11, "top_p": 0.9}):
+        r_full = np.random.RandomState(5)
+        seq = [sdecode.sample_token(z, rng=r_full, **knobs) for z in rows]
+        for k in range(len(rows) + 1):
+            r_ff = sdecode.fast_forward_rng(np.random.RandomState(5), k)
+            tail = [sdecode.sample_token(z, rng=r_ff, **knobs)
+                    for z in rows[k:]]
+            assert tail == seq[k:], (knobs, k)
+
+
+def test_greedy_pick_consumes_no_rng_state():
+    """Greedy picks consume ZERO draws — that's why a greedy resume
+    needs no fast-forward at all: the rng is bit-identical after any
+    number of greedy sample_token calls."""
+    rows = np.random.RandomState(1).randn(5, 16)
+    rng = np.random.RandomState(3)
+    for z in rows:
+        sdecode.sample_token(z, temperature=0.0, top_k=5, top_p=0.9,
+                             rng=rng)
+    assert rng.random_sample() == np.random.RandomState(3).random_sample()
+
+
+def test_fast_forward_rng_rejects_negative():
+    with pytest.raises(ValueError):
+        sdecode.fast_forward_rng(np.random.RandomState(0), -1)
+
+
+def test_engine_resume_token_exact_every_split_greedy(rig):
+    """The resume form vs the full-forward ORACLE at every split point:
+    resuming after k emitted tokens produces exactly the suffix the
+    uninterrupted run emits — greedy path."""
+    engine, oracle = rig["engine"], rig["oracle"]
+    p = [3, 1, 4, 1, 5]
+    want = oracle(p)[len(p):][:8]
+    resumes0 = engine.stats()["resume_admissions"]
+    for k in range(1, len(want)):
+        st = engine.generate(p, max_new_tokens=8,
+                             resume_tokens=want[:k])
+        cont = st.tokens(timeout=120)
+        assert want[:k] + cont == want, "split at %d" % k
+        assert st.emitted_count == len(want)
+        assert st.result(timeout=1) == p + want
+    stats = engine.stats()
+    assert stats["resume_admissions"] >= resumes0 + len(want) - 1
+    assert stats["resume_tokens"] >= sum(range(1, len(want)))
+
+
+def test_engine_resume_token_exact_seeded_sampling(rig):
+    """Sampled path: a seeded temperature/top-k/top-p generation
+    resumed at every split point replays the uninterrupted run's picks
+    exactly (RNG fast-forwarded past the emitted suffix)."""
+    engine = rig["engine"]
+    p = [7, 2, 9]
+    kn = dict(temperature=1.4, top_k=12, top_p=0.9, seed=77)
+    full = engine.generate(p, max_new_tokens=9, **kn).tokens(timeout=120)
+    assert len(full) == 9
+    for k in range(1, len(full)):
+        cont = engine.generate(p, max_new_tokens=9,
+                               resume_tokens=full[:k],
+                               **kn).tokens(timeout=120)
+        assert full[:k] + cont == full, "split at %d" % k
+
+
+def test_engine_resume_validation(rig):
+    """The resume form's refusal cases: sampled-without-seed (the
+    seed-required rule), already-finished generations, spent budgets,
+    and a resumed length that overflows the cache row."""
+    engine = rig["engine"]
+    with pytest.raises(ValueError, match="seed"):
+        engine.submit([1, 2], temperature=1.0, resume_tokens=[3])
+    with pytest.raises(ValueError, match="eos"):
+        engine.submit([1, 2], eos_id=5, resume_tokens=[3, 5])
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.submit([1, 2], max_new_tokens=2, resume_tokens=[3, 4])
+    # a resume at the max_len WALL is a COMPLETE generation, not a 400:
+    # the resuming router cannot know max_len (server-side config), so
+    # the engine answers with an already-finished zero-continuation
+    # stream — while a plain over-long PROMPT stays a loud error
+    s = engine.submit([1, 2], resume_tokens=[0] * (MAX_LEN - 2))
+    assert s.tokens(timeout=5) == []
+    assert s.finish_reason == "length"
+    assert s.emitted_count == MAX_LEN - 2
+    with pytest.raises(ValueError, match="room"):
+        engine.submit([0] * MAX_LEN)
+    # a seeded sampled resume is accepted (and so is plain greedy)
+    s = engine.submit([1, 2], temperature=1.0, seed=3, resume_tokens=[4],
+                      max_new_tokens=3)
+    s.tokens(timeout=120)
+
+
+def test_engine_resume_respects_budgets(rig):
+    """max_new_tokens counts the LOGICAL generation: a resume with k
+    replayed tokens emits only max_new - k more, and the max_len wall
+    lands at the same total as the unbroken run."""
+    engine, oracle = rig["engine"], rig["oracle"]
+    p = [11, 4]
+    want = oracle(p)[len(p):][:6]
+    st = engine.generate(p, max_new_tokens=6, resume_tokens=want[:4])
+    cont = st.tokens(timeout=120)
+    assert cont == want[4:]
+    assert st.finish_reason == "length"
+
+
+def test_resume_rides_chunked_prefix_admission(prig):
+    """A resumed long generation re-prefills through the SAME
+    prefix/chunked admission as any other: published blocks serve the
+    head (cached_prefix_tokens > 0), the suffix windows through the
+    bucket ladder, and the continuation stays token-exact vs the
+    oracle."""
+    engine, oracle = prig["engine"], prig["oracle"]
+    rs = np.random.RandomState(31)
+    p = list(rs.randint(0, prig["cfg"].vocab_size, 13))
+    want = oracle(p)[len(p):][:8]
+    # uninterrupted run first: publishes the prompt's blocks
+    assert engine.generate(p, max_new_tokens=8).tokens(timeout=120) \
+        == want
+    k = 5
+    st = engine.generate(p, max_new_tokens=8, resume_tokens=want[:k])
+    assert st.tokens(timeout=120) == want[k:]
+    # the first run published the 13-token prompt's 3 full blocks of 4:
+    # the resume's 18-token re-prefill hits them instead of recomputing
+    assert st.cached_prefix_tokens >= 12
+    assert st.admit_windows >= 1
+    assert engine.stats()["resume_admissions"] >= 1
+
+
+def test_sample_token_boundary_draw_never_picks_filtered_token():
+    """The u≈1 float boundary: u < 1 but u*cdf[-1] can round UP to
+    exactly cdf[-1]; side='right' would then land past the flat
+    zero-probability tail left by top-k/top-p filtering. The nextafter
+    clamp keeps every draw on a positive-probability token."""
+
+    class _Boundary(object):
+        @staticmethod
+        def random_sample():
+            return 1.0 - 2.0 ** -53  # the largest double below 1.0
+
+    logits = np.array([5.0, 4.0, 3.0, 0.1, 0.05])
+    # top_k=3 zeroes tokens 3 and 4 -> their cdf entries sit flat at
+    # cdf[-1]; a boundary draw must land on token 2, never 3/4
+    tok = sdecode.sample_token(logits, temperature=1.0, top_k=3,
+                               rng=_Boundary())
+    assert tok == 2
+    # and the top-p variant of the same flat-tail shape
+    tok = sdecode.sample_token(logits, temperature=1.0, top_p=0.95,
+                               rng=_Boundary())
+    assert tok in (0, 1, 2)

@@ -121,14 +121,18 @@ class TestAutoscalerPolicy:
 # ---------------------------------------------------------------------------
 def _fake_backend(backend_id, version=1, ready=True, tokens=(1, 2, 3),
                   die_after=None, stall_after=None, stall_s=1.0,
-                  infer_status=200):
+                  infer_status=200, gen_status=200):
     """A stub replica gateway: /readyz (togglable), /v1/infer (echoes
-    its id/version), /v1/generate (SSE; optionally dies mid-stream
-    after ``die_after`` tokens, or stalls ``stall_s`` after
-    ``stall_after`` tokens)."""
+    its id/version and the FORWARDED deadline), /v1/generate (SSE over
+    ``tokens``, honoring the resume form — ``resume_tokens`` slices the
+    already-emitted prefix off, like a real engine's token-exact
+    resume; optionally dies mid-stream after ``die_after`` tokens of a
+    request, or stalls ``stall_s`` after ``stall_after`` tokens).
+    Every POST body lands in ``state["bodies"]``."""
     state = {"ready": ready, "die_after": die_after,
              "stall_after": stall_after, "stall_s": stall_s,
-             "infer_status": infer_status, "hits": 0}
+             "infer_status": infer_status, "gen_status": gen_status,
+             "hits": 0, "bodies": []}
 
     class _H(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -161,6 +165,7 @@ def _fake_backend(backend_id, version=1, ready=True, tokens=(1, 2, 3),
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n)) if n else {}
             state["hits"] += 1
+            state["bodies"].append(body)
             if self.path == "/v1/infer":
                 if state["infer_status"] != 200:
                     self._json(state["infer_status"],
@@ -170,9 +175,14 @@ def _fake_backend(backend_id, version=1, ready=True, tokens=(1, 2, 3),
                 self._json(200, {"backend": backend_id,
                                  "version": version,
                                  "echo": body.get("inputs"),
+                                 "deadline": body.get("deadline_ms"),
                                  "tenant": self.headers.get(
                                      "X-Tenant-Id")})
             elif self.path == "/v1/generate":
+                if state["gen_status"] != 200:
+                    self._json(state["gen_status"], {"error": "busy"},
+                               headers=(("Retry-After", "1"),))
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -186,7 +196,9 @@ def _fake_backend(backend_id, version=1, ready=True, tokens=(1, 2, 3),
                     self.wfile.write(b"\r\n")
                     self.wfile.flush()
 
-                for i, t in enumerate(tokens):
+                resume = body.get("resume_tokens") or []
+                send = list(tokens)[len(resume):]
+                for i, t in enumerate(send):
                     if state["die_after"] is not None \
                             and i >= state["die_after"]:
                         # abrupt death mid-stream: RST (SO_LINGER 0),
@@ -210,6 +222,20 @@ def _fake_backend(backend_id, version=1, ready=True, tokens=(1, 2, 3),
                         time.sleep(state["stall_s"])
                     chunk('data: {"token": %d}\n\n' % t)
                     time.sleep(0.01)
+                if state["die_after"] is not None \
+                        and len(send) >= state["die_after"]:
+                    # die_after >= the tokens sent: death in the GAP
+                    # between the last token frame and the done frame
+                    # (exactly where chaos die_after_tokens kills)
+                    import socket as _socket
+                    import struct as _struct
+
+                    self.connection.setsockopt(
+                        _socket.SOL_SOCKET, _socket.SO_LINGER,
+                        _struct.pack("ii", 1, 0),
+                    )
+                    self.close_connection = True
+                    return
                 chunk('data: {"done": true, "finish_reason": "length"}'
                       '\n\n')
                 self.wfile.write(b"0\r\n\r\n")
@@ -228,6 +254,19 @@ def _fake_backend(backend_id, version=1, ready=True, tokens=(1, 2, 3),
 # one copy of the HTTP helper across probes and tests (tools/ is on
 # sys.path above; gateway_probe owns the implementation)
 from fleet_probe import _post  # noqa: E402
+
+
+def _sse_full(url, body, timeout=30):
+    """SSE including comment frames (":"-prefixed — the router's
+    failover seam): one parser copy, owned by fleet_probe (same
+    sharing contract as ``_post``). Comments come back as bare lines
+    (the probe's (line, event-index) pairs collapsed)."""
+    from fleet_probe import _sse_collect
+
+    status, events, comments, _gaps, headers = _sse_collect(
+        url, body, timeout=timeout
+    )
+    return status, events, [c for c, _i in comments], headers
 
 
 def _sse_lines(url, body, timeout=30):
@@ -1085,3 +1124,380 @@ def test_fleet_probe_fast_acceptance():
     assert report["rollout"]["post_wrong"] == 0
     assert report["strict"]["steady_recompiles"] == 0
     assert report["fleet_report"]["scale_ups"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# durable streaming generations (ISSUE 13): router failover + resume
+# ---------------------------------------------------------------------------
+class TestDurableGenerations:
+    def test_sse_failover_splices_resumed_stream(self, router):
+        """Mid-stream replica death with a survivor available: the
+        router re-admits the generation with the emitted suffix and
+        splices the continuation — the client sees every token exactly
+        once, a failover comment frame at the seam, a clean done event,
+        and NO error event. The resumed backend receives the resume
+        form with a DECREMENTED deadline."""
+        a = _fake_backend("a", tokens=(5, 6, 7, 8), die_after=2)
+        b = _fake_backend("b", tokens=(5, 6, 7, 8))
+        try:
+            router.add_backend("a", "127.0.0.1", a.server_address[1],
+                               ready=True)
+            router.add_backend("b", "127.0.0.1", b.server_address[1],
+                               ready=True)
+            c0 = obs_registry.counter("router_generate_failovers").value()
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"),
+                {"prompt_ids": [1], "deadline_ms": 30000},
+            )
+            assert st == 200
+            toks = [e["token"] for e in events if "token" in e]
+            assert toks == [5, 6, 7, 8]
+            assert not [e for e in events if "error" in e]
+            assert events[-1].get("done") is True
+            # the spliced done is rewritten to STREAM-level truth: the
+            # client saw 4 tokens, not just the resumed hop's 2
+            assert events[-1]["tokens"] == 4
+            assert any("failover" in c for c in comments), comments
+            assert obs_registry.counter(
+                "router_generate_failovers").value() > c0
+            rb = b.state["bodies"][-1]
+            assert rb["resume_tokens"] == [5, 6]
+            assert 0 < rb["deadline_ms"] < 30000
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_sse_failover_survives_second_death(self, router):
+        """Two consecutive mid-stream deaths within the failover budget
+        (router_generate_retries defaults to 2): the stream still
+        completes token-exact across THREE backends."""
+        a = _fake_backend("a", tokens=(1, 2, 3, 4, 5), die_after=2)
+        b = _fake_backend("b", tokens=(1, 2, 3, 4, 5), die_after=1)
+        c = _fake_backend("c", tokens=(1, 2, 3, 4, 5))
+        try:
+            for srv, bid in ((a, "a"), (b, "b"), (c, "c")):
+                router.add_backend(bid, "127.0.0.1",
+                                   srv.server_address[1], ready=True)
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"), {"prompt_ids": [1]})
+            assert st == 200
+            toks = [e["token"] for e in events if "token" in e]
+            assert toks == [1, 2, 3, 4, 5]
+            assert not [e for e in events if "error" in e]
+            assert len([x for x in comments if "failover" in x]) == 2
+        finally:
+            a.shutdown()
+            b.shutdown()
+            c.shutdown()
+
+    def test_sse_unresumable_without_seed_keeps_inband_error(self,
+                                                            router):
+        """A temperature-sampled request WITHOUT a seed cannot replay:
+        mid-stream death degrades to the in-band error event (the
+        PR 11 contract) and the survivor is never asked to resume."""
+        a = _fake_backend("a", tokens=(1, 2, 3), die_after=1)
+        b = _fake_backend("b", tokens=(1, 2, 3))
+        try:
+            router.add_backend("a", "127.0.0.1", a.server_address[1],
+                               ready=True)
+            router.add_backend("b", "127.0.0.1", b.server_address[1],
+                               ready=True)
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"),
+                {"prompt_ids": [1], "temperature": 1.0},
+            )
+            assert st == 200
+            last = events[-1]
+            assert "error" in last and "resumable" in last["resume"]
+            assert last["emitted_count"] == 1
+            assert not comments
+            assert b.state["bodies"] == []
+            # wait for the health loop to re-admit "a" (readyz is 200;
+            # only the request path died) so the next request picks it
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if [x for x in router.backends()
+                        if x["id"] == "a"][0]["ready"]:
+                    break
+                time.sleep(0.02)
+            # ...while the SAME request WITH a seed fails over fine
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"),
+                {"prompt_ids": [1], "temperature": 1.0, "seed": 11},
+            )
+            toks = [e["token"] for e in events if "token" in e]
+            assert toks == [1, 2, 3]
+            assert any("failover" in x for x in comments)
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_death_in_done_gap_synthesizes_done_event(self, router):
+        """A replica dying AFTER its last token frame but BEFORE the
+        done frame (exactly where chaos die_after_tokens kills): every
+        token was delivered, so the resume form would be rejected by
+        any engine (budget spent / eos emitted) — the router must
+        synthesize the done event itself, not error a fully-delivered
+        generation."""
+        # die_after == len(tokens): the fake dies in the done gap
+        a = _fake_backend("a", tokens=(4, 5, 6), die_after=3)
+        b = _fake_backend("b", tokens=(4, 5, 6))
+        try:
+            router.add_backend("a", "127.0.0.1", a.server_address[1],
+                               ready=True)
+            router.add_backend("b", "127.0.0.1", b.server_address[1],
+                               ready=True)
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"),
+                {"prompt_ids": [1], "max_new_tokens": 3},
+            )
+            assert st == 200
+            assert [e["token"] for e in events if "token" in e] \
+                == [4, 5, 6]
+            assert not [e for e in events if "error" in e]
+            last = events[-1]
+            assert last.get("done") and last.get("synthesized")
+            assert last["finish_reason"] == "length"
+            assert last["emitted_count"] == 3
+            assert b.state["bodies"] == []  # never asked to resume
+            # eos variant: the captured suffix contains the eos id —
+            # a fresh dying backend as the only route, so the death in
+            # the done gap is deterministic
+            router.remove_backend("a")
+            router.remove_backend("b")
+            c = _fake_backend("c", tokens=(4, 5, 6), die_after=3)
+            try:
+                router.add_backend("c", "127.0.0.1",
+                                   c.server_address[1], ready=True)
+                st, events, _c, _h = _sse_full(
+                    router.url("/v1/generate"),
+                    {"prompt_ids": [1], "eos_id": 6},
+                )
+                last = events[-1]
+                assert last.get("done") and last.get("synthesized")
+                assert last["finish_reason"] == "eos"
+                assert not [e for e in events if "error" in e]
+            finally:
+                c.shutdown()
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_failover_denied_once_deadline_spent(self):
+        """The deadline-propagation regression: a failover carries only
+        the REMAINING client budget, so a replica death after the
+        deadline has passed gives up in-band (resume reason
+        'deadline') — the resumed request would 504 at the same
+        wall-clock instant the unbroken one would, and the survivor is
+        never burdened."""
+        r = Router(port=0, health_interval_s=5.0, retries=2,
+                   backend_timeout_s=10.0)
+        r.start()
+        # stalls 0.35s after token 1, then dies at token 2: by the
+        # death, the 200ms client budget is long gone
+        a = _fake_backend("a", tokens=(1, 2, 3, 4), die_after=2,
+                          stall_after=1, stall_s=0.35)
+        b = _fake_backend("b", tokens=(1, 2, 3, 4))
+        try:
+            r.add_backend("a", "127.0.0.1", a.server_address[1],
+                          ready=True)
+            r.add_backend("b", "127.0.0.1", b.server_address[1],
+                          ready=True)
+            st, events, comments, _h = _sse_full(
+                r.url("/v1/generate"),
+                {"prompt_ids": [1], "deadline_ms": 200},
+            )
+            last = events[-1]
+            assert "error" in last and last["resume"] == "deadline"
+            assert not comments
+            assert b.state["bodies"] == []
+        finally:
+            a.shutdown()
+            b.shutdown()
+            r.stop()
+
+    def test_chaos_die_after_tokens_kills_at_exact_token(self):
+        """The deterministic mid-stream fault: the armed process
+        SIGKILLs itself the moment its Nth stream token hits the wire;
+        a process addressed as a DIFFERENT replica never fires."""
+        import subprocess
+
+        script = (
+            "import os\n"
+            "from paddle_tpu.testing import chaos\n"
+            "for i in range(5):\n"
+            "    print('tok', i, flush=True)\n"
+            "    chaos.on_stream_token()\n"
+            "print('survived', flush=True)\n"
+        )
+        env = dict(os.environ, FLAGS_chaos_die_after_tokens="3",
+                   FLAGS_chaos_die_replica="0",
+                   PADDLE_TPU_REPLICA_ID="0", JAX_PLATFORMS="cpu")
+        p = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == -9, (p.returncode, p.stdout, p.stderr)
+        assert "tok 2" in p.stdout and "tok 3" not in p.stdout
+        assert "survived" not in p.stdout
+        env["PADDLE_TPU_REPLICA_ID"] = "1"
+        p = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0 and "survived" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# per-backend circuit breaker + deadline propagation
+# ---------------------------------------------------------------------------
+class TestBreakerAndDeadline:
+    def test_breaker_opens_on_flapping_backend_then_half_open_probe(
+            self):
+        """A FLAPPING replica — /readyz 200 (so the health loop keeps
+        re-admitting it) but every request 503s — opens its breaker
+        after the consecutive-failure threshold and stops eating a
+        retry from each request; once healed, a single half-open probe
+        closes the breaker and traffic returns."""
+        r = Router(port=0, health_interval_s=0.05, retries=2,
+                   backend_timeout_s=10.0, breaker_failures=3,
+                   breaker_cooldown_s=1.0)
+        r.start()
+        flap = _fake_backend("a", infer_status=503)
+        good = _fake_backend("b")
+        try:
+            r.add_backend("a", "127.0.0.1", flap.server_address[1],
+                          ready=True)
+            r.add_backend("b", "127.0.0.1", good.server_address[1],
+                          ready=True)
+            c0 = obs_registry.counter(
+                "router_breaker_open_total").value()
+            opened = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st, body, _ = _post(r.url("/v1/infer"), {"x": 1})
+                assert st == 200 and body["backend"] == "b"
+                a = [x for x in r.backends() if x["id"] == "a"][0]
+                if a["breaker"] == "open":
+                    opened = True
+                    break
+                time.sleep(0.07)  # health loop re-admits the flapper
+            assert opened, r.backends()
+            assert obs_registry.counter(
+                "router_breaker_open_total").value() > c0
+            assert r.breaker_open_count() == 1
+            # while OPEN: excluded from picks even though health says
+            # ready — the very next request never touches it
+            hits0 = flap.state["hits"]
+            st, body, _ = _post(r.url("/v1/infer"), {"x": 1})
+            assert body["backend"] == "b"
+            assert flap.state["hits"] == hits0
+            # heal it; after the cooldown ONE half-open probe readmits
+            flap.state["infer_status"] = 200
+            closed = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                _post(r.url("/v1/infer"), {"x": 1})
+                a = [x for x in r.backends() if x["id"] == "a"][0]
+                if a["breaker"] == "closed" and a["fail_streak"] == 0:
+                    closed = True
+                    break
+                time.sleep(0.1)
+            assert closed, r.backends()
+            assert flap.state["hits"] > hits0  # the probe went through
+        finally:
+            flap.shutdown()
+            good.shutdown()
+            r.stop()
+
+    def test_deadline_decremented_across_the_hop(self, router):
+        """The router forwards deadline_ms minus its own elapsed time —
+        never the client's original budget; a request with no deadline
+        forwards untouched; a budget already spent at the router sheds
+        504 without touching a backend."""
+        be = _fake_backend("a")
+        try:
+            router.add_backend("a", "127.0.0.1", be.server_address[1],
+                               ready=True)
+            st, body, _ = _post(router.url("/v1/infer"),
+                                {"inputs": [1], "deadline_ms": 5000})
+            assert st == 200
+            assert 0 < body["deadline"] < 5000
+            st, body, _ = _post(router.url("/v1/infer"),
+                                {"inputs": [1]})
+            assert st == 200 and body["deadline"] is None
+            hits0 = be.state["hits"]
+            st, body, _ = _post(router.url("/v1/infer"),
+                                {"inputs": [1], "deadline_ms": 0.0001})
+            assert st == 504 and body["reason"] == "deadline"
+            assert be.state["hits"] == hits0
+        finally:
+            be.shutdown()
+
+
+class TestFailoverHardening:
+    def test_resume_pins_to_the_streams_model_version(self, router):
+        """A resume must land on the SAME model version that opened the
+        stream: during a rollout the active version may have flipped,
+        and re-prefilling on different weights would silently splice a
+        diverged continuation into a stream sold as token-exact. With
+        only a new-version replica left, the stream degrades to the
+        in-band error naming the version constraint."""
+        a = _fake_backend("a", version=1, tokens=(1, 2, 3), die_after=2)
+        b = _fake_backend("b", version=2, tokens=(1, 2, 3))
+        try:
+            router.add_backend("a", "127.0.0.1", a.server_address[1],
+                               version=1, ready=True)
+            router.add_backend("b", "127.0.0.1", b.server_address[1],
+                               version=2, ready=True)
+            router.set_active_version(1)  # a opens the stream
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"), {"prompt_ids": [1]})
+            last = events[-1]
+            assert "error" in last
+            assert "model version" in last["resume"]
+            assert not comments
+            assert b.state["bodies"] == []  # v2 never asked to resume
+        finally:
+            router.set_active_version(None)
+            a.shutdown()
+            b.shutdown()
+
+    def test_resume_429_is_transient_not_terminal(self, router):
+        """A 429 backpressure shed from a resume target (momentarily
+        full admission queue) must not kill the durable stream: the
+        remaining failover budget tries the next replica, and the busy
+        one keeps its ready state (backpressure is not failure)."""
+        a = _fake_backend("a", tokens=(1, 2, 3, 4), die_after=2)
+        b = _fake_backend("b", tokens=(1, 2, 3, 4), gen_status=429)
+        c = _fake_backend("c", tokens=(1, 2, 3, 4))
+        try:
+            for srv, bid in ((a, "a"), (b, "b"), (c, "c")):
+                router.add_backend(bid, "127.0.0.1",
+                                   srv.server_address[1], ready=True)
+            st, events, comments, _h = _sse_full(
+                router.url("/v1/generate"), {"prompt_ids": [1]})
+            toks = [e["token"] for e in events if "token" in e]
+            assert toks == [1, 2, 3, 4]
+            assert not [e for e in events if "error" in e]
+            assert any("failover" in x for x in comments)
+            assert b.state["hits"] == 1  # asked once, shed 429
+            bstate = [x for x in router.backends() if x["id"] == "b"][0]
+            assert bstate["ready"] is True  # backpressure != failure
+        finally:
+            a.shutdown()
+            b.shutdown()
+            c.shutdown()
+
+    def test_sse_frame_splitter_handles_crlf(self):
+        """The spec permits CRLF line endings: a foreign CRLF-framed
+        backend's events must still split, parse, and count."""
+        from paddle_tpu.serving.router import (
+            _frame_token,
+            _split_sse_frames,
+        )
+
+        frames, rest = _split_sse_frames(
+            b'data: {"token": 1}\r\n\r\ndata: {"token": 2}\n\n'
+            b'data: {"done": true}\r\n\r\ndata: {"tok'
+        )
+        assert len(frames) == 3 and rest == b'data: {"tok'
+        assert _frame_token(frames[0]) == (1, False)
+        assert _frame_token(frames[1]) == (2, False)
+        assert _frame_token(frames[2]) == (None, True)

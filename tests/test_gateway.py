@@ -879,3 +879,64 @@ def test_concurrent_stop_blocks_until_drain_completes(gen_server):
     assert gw.port is None
     t.join(10)
     drainer.join(10)
+
+
+def test_generate_resume_form_matches_uninterrupted_suffix(gen_server):
+    """The HTTP resume form (durable generations): a stream resumed
+    after k tokens emits exactly the uninterrupted run's suffix, and
+    the done event carries the reconstruction state (emitted_count,
+    seed, knobs) plus the windowed/prefix admission facts."""
+    gw = serving.Gateway(gen_server, port=0).start()
+    try:
+        base = "http://127.0.0.1:%d" % gw.port
+        prompt = [2, 9, 4]
+        full = gen_server.generate(prompt, max_new_tokens=8)\
+            .tokens(timeout=60)
+        toks, done = sse(base + "/v1/generate",
+                         {"prompt_ids": prompt, "max_new_tokens": 8,
+                          "resume_tokens": full[:3]})
+        assert toks == full[3:]
+        assert done["emitted_count"] == len(full)
+        assert done["resumed_tokens"] == 3
+        for k in ("seed", "temperature", "top_k", "top_p",
+                  "admit_windows"):
+            assert k in done, k
+        # non-stream resume carries the same state
+        st, body, _ = post(base + "/v1/generate",
+                           {"prompt_ids": prompt, "max_new_tokens": 8,
+                            "resume_tokens": full[:5],
+                            "stream": False}, timeout=60)
+        assert st == 200 and body["tokens"] == full[5:]
+        assert body["emitted_count"] == len(full)
+    finally:
+        gw.stop()
+
+
+def test_generate_resume_form_validation_400s(gen_server):
+    """Malformed resume forms are the client's fault: non-int lists
+    400, and the seed-required rule (a temperature-sampled resume
+    without its seed is unreproducible) 400s with the engine's
+    message."""
+    gw = serving.Gateway(gen_server, port=0).start()
+    try:
+        base = "http://127.0.0.1:%d" % gw.port
+        st, body, _ = post(base + "/v1/generate",
+                           {"prompt_ids": [1],
+                            "resume_tokens": ["x"]})
+        assert st == 400 and "resume_tokens" in body["error"]
+        st, body, _ = post(base + "/v1/generate",
+                           {"prompt_ids": [1],
+                            "resume_tokens": [True, False]})
+        assert st == 400  # bools are not token ids
+        st, body, _ = post(base + "/v1/generate",
+                           {"prompt_ids": [1], "temperature": 1.0,
+                            "resume_tokens": [4]})
+        assert st == 400 and "seed" in body["error"]
+        # seeded: accepted
+        st, body, _ = post(base + "/v1/generate",
+                           {"prompt_ids": [1], "temperature": 1.0,
+                            "seed": 9, "resume_tokens": [4],
+                            "stream": False}, timeout=60)
+        assert st == 200
+    finally:
+        gw.stop()

@@ -19,6 +19,17 @@ armed) behind a real Router — and asserts the control-plane bars:
   claims, and post-deploy traffic is all new-version;
 - STRICT GATE: every replica holds 0 steady-state recompiles across
   the whole storm (``FLAGS_serving_strict_compiles`` armed);
+- DURABLE GENERATIONS: a second fleet of GPT decode replicas (seeded
+  identical params via ``--gpt-decode``) serves concurrent SSE streams
+  while the chaos harness SIGKILLs one replica after EXACTLY N stream
+  tokens (``FLAGS_chaos_die_after_tokens``) — every client stream
+  still completes token-exact vs the uninterrupted oracle (greedy AND
+  seeded sampling), with zero in-band errors: the router resumes each
+  interrupted generation on the survivor with the emitted suffix, the
+  resume re-prefill rides the windowed/prefix admission
+  (``admit_windows``/``cached_prefix_tokens`` on the done event), the
+  failover blip is measured, and the fleet still holds 0 steady
+  recompiles;
 - the router hop's added latency is measured (PERF.md), and
   ``fleet_report.json`` carries the replica timeline + scale/rollout
   events + per-replica tallies.
@@ -81,6 +92,240 @@ def build_model(dirname, seed, dim=24, hidden=48, classes=8):
     xd = np.random.RandomState(7).rand(1, dim).astype("float32")
     np.savez(os.path.join(dirname, "warmup.npz"), xd)
     return xd
+
+
+def _sse_collect(url, body, headers=None, timeout=120):
+    """POST and consume a chunked SSE stream, keeping EVERYTHING:
+    (status, data_events, comment_lines, inter_event_gaps_s,
+    response_headers). Comment lines (":"-prefixed — the router's
+    failover seam) are invisible to the plain ``_sse`` helper, and the
+    gaps measure the client-felt blip. The ONE SSE-with-comments
+    parser — tests/test_fleet.py imports it (same contract as _post)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    events, comments, gaps = [], [], []
+    t_last = time.monotonic()
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        status, hdrs = r.status, dict(r.headers)
+        for line in r:
+            line = line.decode("utf-8").strip()
+            if line.startswith("data: "):
+                now = time.monotonic()
+                gaps.append(now - t_last)
+                t_last = now
+                events.append(json.loads(line[len("data: "):]))
+            elif line.startswith(":"):
+                # (comment_line, index of the NEXT data event): gaps[i]
+                # then brackets the comment — the client-felt blip of a
+                # failover seam, as opposed to e.g. the TTFT gap
+                comments.append((line, len(events)))
+    return status, events, comments, gaps, hdrs
+
+
+def run_generate_failover_trial(tmp, model_dir, report, failures, fast):
+    """Durable streaming generations: chaos-kill a GPT decode replica at
+    an exact stream-token boundary under concurrent streams and demand
+    token-exact, zero-error completion of every stream via router
+    failover + resume."""
+    import numpy as np
+
+    from paddle_tpu.observability import registry as _reg
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving.fleet import FleetController
+    from paddle_tpu.serving.replica import build_gpt_decode_engine
+
+    spec = {"seed": 17, "vocab_size": 97, "hidden_size": 32,
+            "num_layers": 2, "num_heads": 2, "intermediate_size": 64,
+            "max_len": 48, "slots": 8, "prefill_buckets": [8, 16, 48]}
+    # the uninterrupted ORACLE: an in-process engine built from the same
+    # seeded spec as every replica (seeded startup => bit-identical
+    # params across processes), run with no chaos and no failover
+    oracle_engine = build_gpt_decode_engine(spec).start()
+    rs = np.random.RandomState(23)
+    streams = []
+    for i in range(4):
+        prompt = [int(t) for t in rs.randint(0, spec["vocab_size"],
+                                             10 + i)]
+        knobs = ({} if i % 2 == 0 else
+                 {"temperature": 1.3, "top_k": 20, "seed": 100 + i})
+        streams.append({"prompt": prompt, "knobs": knobs})
+    try:
+        for s in streams:
+            s["oracle"] = oracle_engine.generate(
+                s["prompt"], max_new_tokens=10, **s["knobs"]
+            ).tokens(timeout=120)
+    finally:
+        oracle_engine.stop()
+
+    workdir = os.path.join(tmp, "fleet_gen")
+    gen_env = {
+        "FLAGS_serving_strict_compiles": "1",
+        # chunked prefill + prefix store armed: a resume's re-prefill
+        # must ride the windowed/prefix admission, not a monolithic
+        # full prefill
+        "FLAGS_decode_prefill_chunk": "8",
+        "FLAGS_decode_prefix_cache_mb": "2",
+        "FLAGS_decode_prefix_block": "8",
+        # the deterministic mid-stream fault: replica 0 SIGKILLs itself
+        # after its 6th stream token hits the wire
+        "FLAGS_chaos_die_after_tokens": "6",
+        "FLAGS_chaos_die_replica": "0",
+        "FLAGS_obs_snapshot_interval_s": "1.0",
+    }
+    ctrl = FleetController(
+        model_dir=model_dir, workdir=workdir, replicas=2,
+        replica_env=gen_env, autoscale=False, seed=0,
+        replica_args=["--gpt-decode", json.dumps(spec)],
+    )
+    t0 = time.monotonic()
+    ctrl.start()
+    results = [None] * len(streams)
+    try:
+        ctrl.wait_ready(timeout=180 if fast else 300)
+        url = ctrl.router.url("/v1/generate")
+
+        def client(i):
+            s = streams[i]
+            body = dict(prompt_ids=s["prompt"], max_new_tokens=10,
+                        deadline_ms=60000, **s["knobs"])
+            try:
+                _st, events, comments, gaps, _h = _sse_collect(
+                    url, body, timeout=90)
+                results[i] = {"events": events, "comments": comments,
+                              "gaps": gaps}
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                results[i] = {"error": repr(e)}
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(len(streams))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+        failed_over, resume_gaps = 0, []
+        for i, (s, res) in enumerate(zip(streams, results)):
+            if res is None or "error" in (res or {}):
+                failures.append(
+                    "gen-failover stream %d transport error: %r"
+                    % (i, res)
+                )
+                continue
+            evs = res["events"]
+            toks = [e["token"] for e in evs if "token" in e]
+            errs = [e for e in evs if "error" in e]
+            done = [e for e in evs if e.get("done")]
+            if errs:
+                failures.append(
+                    "gen-failover stream %d saw an in-band error: %r"
+                    % (i, errs[:1])
+                )
+            if not done:
+                failures.append(
+                    "gen-failover stream %d never finished" % i
+                )
+            if toks != s["oracle"]:
+                failures.append(
+                    "gen-failover stream %d tokens diverge from the "
+                    "uninterrupted oracle: %r != %r"
+                    % (i, toks, s["oracle"])
+                )
+            if res["comments"]:
+                failed_over += 1
+                # the blip is the gap BRACKETING the failover comment
+                # (event i-1 -> seam -> event i), not max(gaps) — the
+                # first gap is TTFT (connect + admission + prefill) and
+                # can dominate an otherwise fast stream
+                blips = [res["gaps"][i]
+                         for _c, i in res["comments"]
+                         if i < len(res["gaps"])]
+                if blips:
+                    resume_gaps.append(max(blips) * 1e3)
+                if done and not (
+                    done[0].get("cached_prefix_tokens", 0) > 0
+                    or done[0].get("admit_windows", 0) > 1
+                ):
+                    failures.append(
+                        "gen-failover stream %d resume did not ride "
+                        "the prefix/chunked path: %r" % (i, done[0])
+                    )
+        if failed_over == 0:
+            failures.append(
+                "gen-failover: no stream failed over (the chaos kill "
+                "never hit a pinned stream)"
+            )
+
+        # the controller replaced the chaos-killed replica. Wait for
+        # the crash to be DETECTED first: the streams finish (failover
+        # is fast) well before the supervision tick polls the corpse,
+        # and wait_ready would sail through while the dead replica
+        # still counts as ready
+        deadline = time.monotonic() + 60
+        crashed = False
+        while time.monotonic() < deadline:
+            if any(e.get("event") == "replica_crash"
+                   for e in fleet_mod.load_events(workdir)):
+                crashed = True
+                break
+            time.sleep(0.1)
+        if not crashed:
+            failures.append(
+                "gen-failover: no replica_crash event after the kill"
+            )
+        try:
+            ctrl.wait_ready(timeout=120)
+        except Exception as e:  # noqa: BLE001
+            failures.append("gen-failover pool never recovered: %r" % e)
+
+        # strict gate + resume-admission facts, fleet-wide
+        steady = resumes = scraped = 0
+        for info in ctrl.replica_info():
+            port = info.get("metrics_port")
+            if not port or info["state"] != "ready":
+                continue
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics" % port, timeout=5
+                ) as r:
+                    parsed = _reg.parse_prometheus(
+                        r.read().decode("utf-8"))
+                scraped += 1
+                steady += int(parsed.get(
+                    ("serving_steady_recompiles", ""), 0))
+                resumes += int(parsed.get(
+                    ("decode_resume_admissions", ""), 0))
+            except Exception as e:  # noqa: BLE001
+                failures.append(
+                    "gen-failover metrics scrape failed: %r" % e)
+        if not scraped:
+            failures.append("gen-failover: no replica metrics scraped")
+        if steady != 0:
+            failures.append(
+                "gen-failover: %d steady-state recompiles under the "
+                "armed strict gate" % steady
+            )
+        if failed_over and resumes == 0:
+            failures.append(
+                "gen-failover: failovers happened but no replica "
+                "counted a resume admission"
+            )
+        report["generate_failover"] = {
+            "streams": len(streams),
+            "failed_over": failed_over,
+            "resume_admissions": resumes,
+            "steady_recompiles": steady,
+            "resume_blip_ms": (round(max(resume_gaps), 1)
+                               if resume_gaps else None),
+            "wall_s": round(time.monotonic() - t0, 1),
+        }
+    finally:
+        try:
+            ctrl.stop()
+        except Exception as e:  # noqa: BLE001
+            failures.append(
+                "gen-failover controller stop failed: %r" % e)
 
 
 def run_probe(fast=True, verbose=False):
@@ -447,6 +692,15 @@ def run_probe(fast=True, verbose=False):
             ctrl.stop()
         except Exception as e:  # noqa: BLE001
             failures.append("controller stop failed: %r" % e)
+
+    # ---- durable generations: mid-stream failover, token-exact -------
+    _flags.set_flags({"FLAGS_router_generate_retries": 2})
+    try:
+        run_generate_failover_trial(
+            tmp, os.path.join(tmp, "export_v1"), report, failures, fast
+        )
+    except Exception as e:  # noqa: BLE001 - the trial must report, not die
+        failures.append("gen-failover trial crashed: %r" % e)
 
     # ---- merged fleet report -----------------------------------------
     fr_path = os.path.join(workdir, "fleet_report.json")
