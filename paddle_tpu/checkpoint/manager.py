@@ -191,6 +191,15 @@ class CheckpointManager(object):
         # (trainer LR rescale) read the world size the checkpoint was
         # SAVED at from here rather than assuming the submitted topology.
         self.last_restore_info = None
+        # background scrubbing (FLAGS_ckpt_scrub): after each commit the
+        # writer thread re-verifies committed steps' checksums off the
+        # critical path, so rollback consumers (the training guardian)
+        # can ask for the newest KNOWN-GOOD step instead of merely the
+        # newest one. {step: bool} of scrub outcomes, lock-guarded —
+        # the writer thread records, the trainer thread reads.
+        self._auto_scrub = bool(_flags.get_flag("ckpt_scrub", False))
+        self._scrub_state = {}
+        self._scrub_lock = threading.Lock()
         os.makedirs(self.dirname, exist_ok=True)
         # resume-time hygiene: a crashed run's staging dirs are garbage.
         # Only rank 0 sweeps (peers may be slower to start, but no save
@@ -417,6 +426,82 @@ class CheckpointManager(object):
             count += 1
         return count
 
+    # -- scrubbing (known-good rollback targets) ----------------------------
+
+    def _scrub_one(self, step):
+        """Verify one committed step, record the outcome, bump the
+        ckpt_scrub_ok/_corrupt counters. Returns the bool outcome."""
+        import logging
+
+        from ..fluid import profiler as _profiler
+
+        try:
+            self.verify(step)
+            ok = True
+            _profiler.bump_counter("ckpt_scrub_ok")
+        except (ChecksumError, CheckpointError, OSError, ValueError,
+                KeyError) as e:
+            ok = False
+            _profiler.bump_counter("ckpt_scrub_corrupt")
+            logging.getLogger("paddle_tpu.checkpoint").warning(
+                "scrub: step %d under %r is damaged (%s: %s)",
+                step, self.dirname, type(e).__name__, e,
+            )
+        with self._scrub_lock:
+            self._scrub_state[step] = ok
+        return ok
+
+    def scrub(self, recheck=False):
+        """Re-verify committed steps' checksums (off the critical path
+        when called from the writer thread — FLAGS_ckpt_scrub arms that
+        automatically after every commit). Incremental by default: each
+        committed step is verified once, newest first; ``recheck=True``
+        forgets prior outcomes and re-reads everything (bit-rot after a
+        first pass). Returns {step: ok}."""
+        if recheck:
+            with self._scrub_lock:
+                self._scrub_state.clear()
+        results = {}
+        for s in reversed(self.all_steps()):
+            with self._scrub_lock:
+                known = self._scrub_state.get(s)
+            results[s] = self._scrub_one(s) if known is None else known
+        return results
+
+    def newest_verified_step(self):
+        """The newest committed step that passed a scrub — the training
+        guardian's rollback target. Steps the scrubber has not covered
+        yet are verified on demand, newest first. Returns None when no
+        committed step verifies."""
+        for s in reversed(self.all_steps()):
+            with self._scrub_lock:
+                ok = self._scrub_state.get(s)
+            if ok is None:
+                ok = self._scrub_one(s)
+            if ok:
+                return s
+        return None
+
+    def discard_steps_after(self, step):
+        """Delete committed steps NEWER than ``step`` (guardian
+        rollback: checkpoints from the rolled-past window must not
+        shadow the replay's fresh saves through the already-committed
+        early return, and a corrupt newest step must not survive the
+        rollback that routed around it). Manifest-first deletion, like
+        GC, so a racing reader never sees a half-deleted dir as
+        committed. Returns the discarded steps."""
+        doomed = [s for s in list_steps(self.dirname) if s > int(step)]
+        for s in doomed:
+            victim = os.path.join(self.dirname, _step_dirname(s))
+            try:
+                os.unlink(os.path.join(victim, MANIFEST))
+            except OSError:
+                pass
+            shutil.rmtree(victim, ignore_errors=True)
+            with self._scrub_lock:
+                self._scrub_state.pop(s, None)
+        return doomed
+
     # -- snapshot -----------------------------------------------------------
 
     def _snapshot(self, program, scope):
@@ -524,6 +609,18 @@ class CheckpointManager(object):
         )
         _profiler.bump_histogram("ckpt_save_bytes", float(nbytes))
         _profiler.bump_counter("ckpt_saves_committed")
+        if (self._auto_scrub and (self.nranks <= 1 or self.rank == 0)
+                and threading.current_thread() is self._writer):
+            # FLAGS_ckpt_scrub: verify the just-committed step (and any
+            # step the scrubber hasn't covered) right here on the
+            # writer thread — off the step critical path, so the
+            # guardian's newest_verified_step() answer is usually
+            # already warm when a rollback needs it. Sync saves (the
+            # preemption final save inside the supervisor's SIGTERM
+            # grace) run this method on the CALLER thread and must not
+            # pay a full read-back there; their steps stay uncovered
+            # until newest_verified_step() verifies on demand.
+            self.scrub()
 
     def _write_shard(self, shard_dir, step, snap):
         """Serialize the snapshot into ``shard_dir`` (reference LoDTensor

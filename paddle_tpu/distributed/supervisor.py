@@ -43,6 +43,16 @@ and the job dies without retry. The supervisor closes the loop:
   attempt's world size and rank->pid map so a resized run is auditable
   post-hoc. (Single-node scope: remapping cannot re-home a lost
   multi-node DCN coordinator — that needs a rendezvous service.)
+- **SDC quarantine** (training guardian, ISSUE 14): workers publish a
+  cross-replica state digest ring through their heartbeat files every
+  ``FLAGS_guardian_digest_interval`` steps; the monitor majority-votes
+  each complete round (>= 3 voters) and quarantines a diverging rank —
+  open-ended down marker on its slot, ``replica_quarantined`` event,
+  ``sdc_quarantines`` counter — then restarts the gang under the
+  preempt budget (elastic gangs resize around the quarantined slot and
+  resume from checkpoint). A worker mid checkpoint-restore beats
+  ``status="rollback"`` and is judged under the startup-style
+  instrumented grace, so a multi-second restore is never hang-killed.
 - **Observability**: structured JSONL events in ``supervisor.log``
   (gang_start / worker_exit / crash_detected / hang_detected /
   gang_teardown / restart / gang_done / giveup / preempted; each
@@ -58,6 +68,7 @@ and the job dies without retry. The supervisor closes the loop:
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import random
@@ -118,21 +129,35 @@ class WorkerHeartbeat(object):
         )
         self._last_write = 0.0
         self._last_status = None
+        # cross-replica SDC digests ride the heartbeat file as a small
+        # ring of the newest (step, digest) pairs: every beat carries
+        # the ring, so the supervisor's poll loop can miss individual
+        # writes and still reconstruct every publish round
+        self._digests = collections.deque(maxlen=8)
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
+
+    def publish_digest(self, step, digest):
+        """Record one (step, digest) pair in the ring and force a beat
+        — the worker half of the supervisor's SDC majority vote."""
+        self._digests.append((int(step), str(digest)))
+        return self.beat(step, force=True)
 
     def beat(self, step, status="step", force=False):
         now = time.monotonic()
         if (not force and status == self._last_status
                 and now - self._last_write < self.interval_s):
             return False
-        payload = json.dumps({
+        record = {
             "pid": os.getpid(),
             "step": int(step),
             "status": str(status),
             "time": time.time(),
-        })
+        }
+        if self._digests:
+            record["digests"] = [[s, d] for s, d in self._digests]
+        payload = json.dumps(record)
         tmp = "%s.tmp.%d" % (self.path, os.getpid())
         try:
             with open(tmp, "w") as f:
@@ -235,6 +260,10 @@ class GangOutcome(object):
     # one WORKER exited 143 / died to SIGTERM (slice preemption): restart
     # under the separate preempt budget, re-planning the world size
     WORKER_PREEMPT = "worker_preempt"
+    # the cross-replica digest vote quarantined a diverging rank: its
+    # slot is marked down (suspect hardware), the gang restarts under
+    # the preempt budget — SDC is the pool's lifecycle, not a crash loop
+    SDC = "sdc_quarantine"
 
 
 class _SpawnFailed(Exception):
@@ -471,7 +500,13 @@ class Supervisor(object):
                 if outcome == GangOutcome.HANG:
                     _profiler.bump_counter("dist_hang_kills")
                 self._teardown(outcome, self.sigterm_grace_s)
-                preempt = outcome == GangOutcome.WORKER_PREEMPT
+                # SDC quarantines draw from the preempt budget: like a
+                # slice preemption they are the pool's lifecycle (the
+                # quarantined slot is marked down and planned around),
+                # not a crash loop worth damping
+                preempt = outcome in (
+                    GangOutcome.WORKER_PREEMPT, GangOutcome.SDC
+                )
                 used = (
                     self.preempt_restarts_used if preempt
                     else self.restarts_used
@@ -665,6 +700,10 @@ class Supervisor(object):
         # an NTP step of the wall clock can neither forge a hang nor
         # mask one
         self._hb_seen = {}
+        # SDC digest rounds for THIS attempt: {digest_step: {local idx:
+        # digest}} accumulated from heartbeat digest rings; a round is
+        # judged once every current gang member has voted
+        self._digest_votes = {}
         for j, idx in enumerate(plan):
             spec = self.specs[idx]
             slot = self._slot(idx)
@@ -778,6 +817,11 @@ class Supervisor(object):
                 rank = spec.rank if spec.rank is not None else i
                 hb = read_heartbeat(self._hb_path(i))
                 status = (hb or {}).get("status")
+                if hb is not None and hb.get("digests"):
+                    self._collect_digests(i, hb)
+                    verdict = self._sdc_vote()
+                    if verdict is not None:
+                        return verdict
                 if hb is None:
                     # never beat: unobservable unless an explicit grace
                     # was configured
@@ -789,6 +833,20 @@ class Supervisor(object):
                     # instrumented but pre-first-step (restore + first
                     # XLA compile): laxer, but FINITE, bound
                     age = now - self._gang_t0
+                    limit = self._instrumented_grace_s
+                elif status == "rollback":
+                    # mid-run checkpoint restore (the training
+                    # guardian's rollback): a multi-second restore
+                    # inside a live worker must not be hang-killed by
+                    # the per-step staleness bound — it gets the
+                    # startup-style instrumented grace, measured from
+                    # when this beat was first observed (the rollback
+                    # may start late in a long run)
+                    seen = self._hb_seen.get(i)
+                    if seen is None or seen[0] != hb["mtime"]:
+                        self._hb_seen[i] = (hb["mtime"], now)
+                        continue
+                    age = now - seen[1]
                     limit = self._instrumented_grace_s
                 elif status == "done":
                     # Training progress is complete; what follows (final
@@ -820,6 +878,86 @@ class Supervisor(object):
                         "rank": rank, "stale_s": round(age, 3),
                     }
             time.sleep(self.poll_s)
+
+    def _collect_digests(self, i, hb):
+        """Accumulate one worker's heartbeat digest ring into the
+        per-attempt vote table (the ring carries the newest 8 publishes,
+        so a poll that missed individual beats still sees every
+        round)."""
+        for pair in hb.get("digests") or []:
+            try:
+                ds, dg = int(pair[0]), str(pair[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            self._digest_votes.setdefault(ds, {})[i] = dg
+
+    def _sdc_vote(self):
+        """Majority-vote every COMPLETE digest round (all current gang
+        members reported for the same step). A diverging minority rank
+        is quarantined: its slot gets a down marker (open-ended — SDC
+        means suspect hardware, an operator or scheduler clears it),
+        a ``replica_quarantined`` event lands in the log, and the gang
+        restarts under the preempt budget. Returns (outcome, detail) or
+        None. Needs >= 3 voters: a 2-way disagreement cannot attribute
+        blame (logged as ``sdc_vote_inconclusive``)."""
+        from ..fluid import profiler as _profiler
+
+        world = len(self._procs)
+        for ds in sorted(self._digest_votes):
+            votes = self._digest_votes.get(ds)
+            if votes is None or len(votes) < world:
+                continue
+            # judged exactly once; older incomplete rounds are
+            # superseded (a rank that never completes round K but
+            # completes K+1 is healthy — the ring just rolled)
+            del self._digest_votes[ds]
+            for old in [s for s in self._digest_votes if s < ds]:
+                del self._digest_votes[old]
+            counts = {}
+            for dg in votes.values():
+                counts[dg] = counts.get(dg, 0) + 1
+            if len(counts) == 1:
+                continue  # unanimous round
+            majority, n = max(counts.items(), key=lambda kv: kv[1])
+            if world < 3 or n <= world // 2:
+                self.log.event(
+                    "sdc_vote_inconclusive", step=ds, world=world,
+                    votes={str(k): v for k, v in votes.items()},
+                )
+                continue
+            quarantined = []
+            for i, dg in sorted(votes.items()):
+                if dg == majority:
+                    continue
+                spec = self._procs[i][0]
+                rank = spec.rank if spec.rank is not None else i
+                # the down marker must land on the spec's STABLE slot
+                # (the identity _plan_gang probes) — after a resize the
+                # attempt-local index and the spec-list index differ,
+                # and marking the wrong slot would bench a healthy
+                # worker while re-planning the corrupt one back in
+                try:
+                    slot = (
+                        spec.rank if spec.rank is not None
+                        else self.specs.index(spec)
+                    )
+                except ValueError:
+                    slot = rank
+                if self._elastic:
+                    elastic.write_down_marker(
+                        self._down_path(slot), down_for=-1, slot=slot,
+                        reason="sdc_quarantine",
+                    )
+                _profiler.bump_counter("sdc_quarantines")
+                self.log.event(
+                    "replica_quarantined", rank=rank, slot=slot,
+                    step=ds, digest=dg, majority=majority,
+                    pid=self._procs[i][1].pid,
+                    marker_written=self._elastic,
+                )
+                quarantined.append(rank)
+            return GangOutcome.SDC, {"ranks": quarantined, "step": ds}
+        return None
 
     def _teardown(self, reason, grace_s, quiet=False):
         """SIGTERM the gang (the PR 3 preemption path: workers' handlers

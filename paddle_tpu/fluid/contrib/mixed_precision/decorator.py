@@ -91,13 +91,13 @@ class OptimizerWithMixedPrecision(object):
                 self._decr_ratio,
             )
             if finite is not None:
-                # mask non-finite grads to zero — the XLA-friendly "skip step"
-                from ...layers import nn as lnn
-
-                params_grads = [
-                    (p, lnn.elementwise_mul(g, finite) if g is not None else g)
-                    for p, g in params_grads
-                ]
+                # zero non-finite grads via where-select — the
+                # XLA-friendly "skip step" (NOT g * finite: inf * 0 is
+                # NaN, which would poison the very update the scaler is
+                # trying to skip)
+                params_grads = fp16_utils.mask_nonfinite_grads(
+                    params_grads, finite
+                )
         return self._optimizer.apply_gradients(params_grads)
 
     def apply_optimize(self, loss, startup_program, params_grads):

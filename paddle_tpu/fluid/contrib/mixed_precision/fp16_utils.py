@@ -218,6 +218,29 @@ def unscale_grads(params_grads, loss_scaling_var):
     return out
 
 
+def mask_nonfinite_grads(params_grads, finite):
+    """Route each gradient through a where-select against the all-finite
+    predicate: a found_inf step applies an exactly-zero update. The
+    multiply form (``g * cast(finite)``) is WRONG here — ``inf * 0`` is
+    NaN in IEEE 754, so the "masked" update would itself poison every
+    parameter it touches and the scaler's skip-step would never actually
+    skip."""
+    from ...layers import nn as lnn
+    from ...layers import tensor as ltensor
+
+    zeros = {}  # one shared [1] zero per grad dtype (where broadcasts)
+    out = []
+    for p, g in params_grads:
+        if g is None:
+            out.append((p, g))
+            continue
+        dtype = g.dtype
+        if dtype not in zeros:
+            zeros[dtype] = ltensor.fill_constant([1], dtype, 0.0)
+        out.append((p, lnn.where(finite, g, zeros[dtype])))
+    return out
+
+
 def update_loss_scaling(
     grads,
     loss_scaling_var,
@@ -228,8 +251,9 @@ def update_loss_scaling(
     decr_ratio,
 ):
     """In-graph dynamic loss-scale update (reference: fp16_utils.py:300).
-    Returns the all-finite predicate var; caller multiplies grads by it to
-    mask non-finite steps (the XLA-friendly form of "skip the update")."""
+    Returns the all-finite BOOL predicate var; the caller routes grads
+    through ``mask_nonfinite_grads`` with it so a found_inf step applies
+    a zero update (the XLA-friendly form of "skip the update")."""
     from ...layers import tensor as ltensor
     from ...layers import nn as lnn
     from ...layer_helper import LayerHelper
@@ -288,4 +312,4 @@ def update_loss_scaling(
         attrs={OP_ROLE_KEY: OpRole.Optimize},
     )
     _ = zero
-    return finite_f
+    return finite

@@ -1,19 +1,86 @@
 """Numerical debugging (reference: python/paddle/fluid/debugger.py pretty
 program dumps; NaN/Inf checking at operator.cc:945-956 FLAGS_check_nan_inf).
 
-TPU-native: NaN checking maps to jax debug_nans plus an executor-level
-post-run fetch scan when FLAGS_check_nan_inf is set."""
+TPU-native: NaN checking maps to jax debug_nans (the per-primitive
+attribution path — jax re-runs the offending op un-jitted and names it)
+PLUS the executor-level post-run fetch scan: when FLAGS_check_nan_inf is
+set, ``Executor.run`` routes every fetched value through
+``scan_fetches``, which raises a structured ``NanInfError`` naming the
+offending fetch var. The scan is the layer debug_nans cannot cover —
+Inf values (debug_nans checks NaN only), host-op fetches, and backends
+where the config toggle is unavailable. The training guardian
+(distributed/guardian.py) reuses ``nonfinite_kind`` as its immediate
+NaN/Inf detector."""
 
 from __future__ import annotations
 
+import numpy as np
+
 from . import core
 
-__all__ = ["pprint_program_codes", "draw_block_graphviz", "set_check_nan_inf"]
+__all__ = [
+    "pprint_program_codes",
+    "draw_block_graphviz",
+    "set_check_nan_inf",
+    "NanInfError",
+    "nonfinite_kind",
+    "scan_fetches",
+]
+
+
+class NanInfError(RuntimeError):
+    """A fetched value contains NaN/Inf (the FLAGS_check_nan_inf
+    executor post-run fetch scan). Carries the offending fetch var's
+    name (``var_name``) and the failure ``kind`` ("nan" / "inf") so
+    supervising layers can react structurally instead of parsing a
+    message."""
+
+    def __init__(self, var_name, kind, message=None):
+        super().__init__(
+            message
+            or "fetch var %r contains %s (FLAGS_check_nan_inf post-run "
+               "fetch scan; reference operator.cc:945)"
+               % (var_name, kind)
+        )
+        self.var_name = str(var_name)
+        self.kind = str(kind)
+
+
+def nonfinite_kind(value):
+    """"nan" / "inf" when a fetched value contains a non-finite float,
+    else None (non-float dtypes scan as None — an int fetch can never be
+    non-finite). Shared detector: the executor's post-run scan and the
+    training guardian's immediate anomaly check both key off it."""
+    if value is None:
+        return None
+    arr = np.asarray(value.numpy() if hasattr(value, "numpy") else value)
+    if not np.issubdtype(arr.dtype, np.floating):
+        return None
+    if np.isnan(arr).any():
+        return "nan"
+    if np.isinf(arr).any():
+        return "inf"
+    return None
+
+
+def scan_fetches(names, values):
+    """The executor-level post-run fetch scan: raise ``NanInfError``
+    naming the first fetch var whose value contains NaN/Inf. Returns the
+    number of values scanned (for tests)."""
+    scanned = 0
+    for name, value in zip(names, values):
+        scanned += 1
+        kind = nonfinite_kind(value)
+        if kind is not None:
+            raise NanInfError(name, kind)
+    return scanned
 
 
 def set_check_nan_inf(enabled=True):
-    """Enable jax debug_nans — the XLA-native equivalent of
-    FLAGS_check_nan_inf's per-op output scan."""
+    """Enable NaN/Inf checking: jax debug_nans (the XLA-native
+    equivalent of FLAGS_check_nan_inf's per-op output scan) plus the
+    executor's post-run fetch scan (``scan_fetches``) that names the
+    offending fetch var."""
     core.set_flag("FLAGS_check_nan_inf", bool(enabled))
     try:
         import jax

@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 from . import core
+from . import flags as _flags
 from . import profiler as _profiler
 from ..observability import trace as _obs_trace
 from ..observability import xla_stats as _xla_stats
@@ -812,11 +813,18 @@ class _CompiledBlock(object):
             # decode runtime's KV caches are session-owned buffers whose
             # stale value is dead the moment the step runs, and donation
             # lets XLA scatter the new token in place instead of copying
-            # the whole pool per token.
+            # the whole pool per token. `program._keep_mutable` forces
+            # donation OFF even on accelerators: the training guardian's
+            # skip-step holds the previous step's state buffers alive so
+            # an anomalous update can be discarded by re-referencing
+            # them — donated inputs would already be invalidated. Costs
+            # one params-sized HBM allocation of double buffering while
+            # armed.
             donate = (
                 (1,)
-                if device_backend not in (None, "cpu")
-                or getattr(program, "_donate_mutable", False)
+                if (device_backend not in (None, "cpu")
+                    or getattr(program, "_donate_mutable", False))
+                and not getattr(program, "_keep_mutable", False)
                 else ()
             )
             jfn = jax.jit(fn, donate_argnums=donate)
@@ -1408,6 +1416,15 @@ class Executor(object):
         with _obs_trace.span("executor_run", cat="exec"):
             outs = compiled.run(scope, feed, rng_key, self.place)
         outs = [None if o is None else _fetch_to_host(o) for o in outs]
+        if _flags.get_flag("check_nan_inf", False):
+            # the executor-level post-run fetch scan the reference ran
+            # per op (operator.cc:945): raises a structured NanInfError
+            # naming the offending fetch var. Complements the
+            # jax_debug_nans side effect (which attributes NaN to a
+            # primitive but misses Inf and host-op fetches).
+            from . import debugger as _debugger
+
+            _debugger.scan_fetches(fetch_names, outs)
         if return_numpy:
             return [None if o is None else np.asarray(o) for o in outs]
         return [

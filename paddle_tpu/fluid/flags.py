@@ -187,6 +187,36 @@ _DEFAULTS = {
     # crc32 manifest check, restore_or_initialize logs the ChecksumError
     # and falls back to the next-newest valid step instead of hard-failing
     "ckpt_restore_fallback": True,
+    # background checkpoint scrubbing: after each commit the writer
+    # thread re-verifies committed steps' checksums off the critical
+    # path (ckpt_scrub_ok/_corrupt counters), so the guardian's rollback
+    # target is always a known-good step, not merely the newest one
+    "ckpt_scrub": False,
+    # training guardian (paddle_tpu/distributed/guardian.py): data-plane
+    # anomaly defense wired through fluid/trainer.py. guardian_enable
+    # arms the in-graph health fetch (global grad-norm + isfinite folded
+    # into the step program) and the host-side anomaly policy: NaN/Inf
+    # is immediate; loss spikes / grad-norm explosions are judged by a
+    # robust rolling window (EWMA center, MAD scale) at
+    # guardian_spike_sigma z-score over guardian_spike_window samples
+    # after guardian_warmup_steps. The graduated response ladder:
+    # skip-step (discard the update, advance the stream) up to
+    # guardian_max_skips times, then rollback to the newest VERIFIED
+    # checkpoint up to guardian_max_rollbacks times (dropping the
+    # poisoned batch window on replay), then structured giveup.
+    # guardian_marker_dir persists poisoned-step markers across process
+    # restarts (chaos-style one-shot: a deterministic bad batch can
+    # never rollback-loop); guardian_digest_interval > 0 publishes a
+    # cross-replica state digest through the heartbeat file every N
+    # steps for the supervisor's SDC majority vote (0 = off).
+    "guardian_enable": False,
+    "guardian_spike_sigma": 6.0,
+    "guardian_spike_window": 64,
+    "guardian_warmup_steps": 8,
+    "guardian_max_skips": 2,
+    "guardian_max_rollbacks": 1,
+    "guardian_digest_interval": 0,
+    "guardian_marker_dir": "",
     # elastic supervisor (paddle_tpu/distributed/supervisor.py): hang
     # watchdog threshold over worker heartbeat files, worker-side beat
     # write throttle, and the restart backoff (base doubles per restart,
@@ -230,6 +260,18 @@ _DEFAULTS = {
     "chaos_lose_rank": -1,
     "chaos_lose_rank_at_step": -1,
     "chaos_lose_rank_for": -1,
+    # data-plane faults for the training guardian's closed loop:
+    # chaos_nan_grad_at_step poisons the armed step's feed batch with a
+    # NaN (loss and every grad go non-finite — detection must be
+    # within one step); chaos_loss_spike_at_step scales the batch so
+    # the loss spikes while staying finite (the robust-window path);
+    # chaos_bitflip_grad_at_step flips the sign bit of one parameter
+    # element AFTER the armed step's update on the chaos_target_rank
+    # worker — silent data corruption only the cross-replica digest
+    # vote can see
+    "chaos_nan_grad_at_step": -1,
+    "chaos_loss_spike_at_step": -1,
+    "chaos_bitflip_grad_at_step": -1,
     "chaos_corrupt_ckpt": False,
     "chaos_slow_feed_ms": 0.0,
     "chaos_rpc_fail_n": 0,

@@ -46,10 +46,12 @@ class MultiTrainer(TrainerBase):
               ckpt_manager=None, startup_program=None):
         import time as _time
 
+        from . import debugger as _debugger
         from . import flags as _flags
         from . import io_pipeline as _io_pipeline
         from . import profiler as _profiler
         from ..distributed import elastic as _elastic
+        from ..distributed import guardian as _guardian
         from ..distributed import supervisor as _sup
         from ..observability import exporter as _obs_exporter
         from ..observability import trace as _trace
@@ -142,15 +144,28 @@ class MultiTrainer(TrainerBase):
                 exit_after=False,
             ).install()
 
-        def _feeds():
+        # training guardian (FLAGS_guardian_enable): in-graph health
+        # fetch + host anomaly policy + skip/rollback/giveup ladder +
+        # cross-replica SDC digests (distributed/guardian.py). The
+        # extra fetches are constant across steps, so the compiled step
+        # program — and the PR 7 zero-recompile invariant — is
+        # unchanged by arming it.
+        guardian = _guardian.Guardian.maybe_create(
+            program, ckpt_manager=ckpt_manager
+        )
+        user_fetches = list(fetch_list or [])
+        run_fetches = (
+            guardian.wrap_fetches(user_fetches)
+            if guardian is not None else user_fetches
+        )
+
+        def _feeds(start):
             for i, batch in enumerate(dataset._iter_batches()):
-                if i < start_step:
+                if i < start:
                     continue  # replayed prefix: drop BEFORE the H2D copy
                 yield dict(zip(feed_names, batch))
 
-        pipe = _io_pipeline.DeviceFeeder(
-            _feeds(), place=getattr(executor, "place", None)
-        )
+        pipe = None
         step = start_step
         preempted_break = False
 
@@ -170,70 +185,172 @@ class MultiTrainer(TrainerBase):
                 _profiler.bump_counter("dist_degraded_steps")
 
         try:
-            for feed in pipe:
-                t_step = _time.perf_counter()
-                # the per-step umbrella span: executor_run, ckpt_snapshot
-                # and any RecordEvents nest under it, so the exported
-                # timeline answers "where did this step's ms go"
-                with _trace.span("train_step", cat="train", step=step):
-                    outs = executor.run(
-                        program, feed=feed, fetch_list=fetch_list or [],
-                        scope=scope,
-                    )
-                    if (fetch_list and print_period
-                            and step % print_period == 0):
-                        info = fetch_info or [
-                            getattr(f, "name", str(f)) for f in fetch_list
-                        ]
-                        msg = ", ".join(
-                            "%s=%s" % (n, np.asarray(o).ravel()[:4])
-                            for n, o in zip(info, outs)
-                        )
-                        print("step %d: %s" % (step, msg))
-                    if on_step is not None:
-                        on_step(step)
-                    if hb is not None:
-                        hb.beat(step)
-                    if ckpt_manager is not None:
-                        # per-install latch, not the sticky module flag:
-                        # a driver that deliberately re-enters train()
-                        # after a survived SIGTERM gets a full run, not
-                        # 1-step stops
-                        requested = (
-                            handler.requested.is_set()
-                            if handler is not None and handler._installed
-                            else preempt_mod.preemption_requested()
-                        )
-                        if requested:
-                            preempted_break = True
-                            # the final save must not be skipped because
-                            # an EARLIER interval save failed on the
-                            # writer — drain + swallow the stale error
-                            # first (same contract as
-                            # PreemptionHandler._final_save)
-                            try:
-                                ckpt_manager.wait()
-                            except Exception:
-                                pass
-                            ckpt_manager.save(
-                                step, program, scope=scope, async_=False
-                            )
-                            # the final preempted step ran in full (plus
-                            # its terminal save) — it must count in the
-                            # progress/step-time telemetry the gang
-                            # report compares across ranks
-                            _account_step()
+            # guardian-rollback retry loop: a RollbackSignal unwinds the
+            # stream, restores the newest verified checkpoint, and
+            # replays the (deterministic) dataset from there with the
+            # poisoned batch window dropped. Without a guardian the
+            # loop body runs exactly once.
+            while True:
+                pipe = _io_pipeline.DeviceFeeder(
+                    _feeds(start_step),
+                    place=getattr(executor, "place", None),
+                )
+                try:
+                    for feed in pipe:
+                        t_step = _time.perf_counter()
+                        if (guardian is not None
+                                and guardian.should_drop(step)):
+                            # a batch an earlier anomaly identified as
+                            # poisoned: consume it from the stream
+                            # WITHOUT running — the rollback replay's
+                            # surviving data schedule
+                            guardian.note_dropped(step)
+                            if hb is not None:
+                                hb.beat(step)
                             step += 1
-                            break
-                        if ckpt_interval and (step + 1) % ckpt_interval == 0:
-                            ckpt_manager.save(step, program, scope=scope)
-                    # fault-injection point AFTER the interval save was
-                    # enqueued: a crash here lands while the async writer
-                    # may be mid-commit — the worst case the chaos
-                    # harness exists to make reproducible
-                    _chaos.on_step(step)
-                _account_step()
-                step += 1
+                            continue
+                        # the per-step umbrella span: executor_run,
+                        # ckpt_snapshot and any RecordEvents nest under
+                        # it, so the exported timeline answers "where
+                        # did this step's ms go"
+                        with _trace.span("train_step", cat="train",
+                                         step=step):
+                            # data-plane fault injection BEFORE the run
+                            # (no-op when disarmed): NaN/spike poisons
+                            # the batch the guardian must catch
+                            feed = _chaos.poison_feed(step, feed)
+                            if guardian is not None:
+                                guardian.pre_step(scope)
+                            try:
+                                outs = executor.run(
+                                    program, feed=feed,
+                                    fetch_list=run_fetches, scope=scope,
+                                )
+                            except _debugger.NanInfError as e:
+                                # FLAGS_check_nan_inf post-run scan
+                                # fired under an armed guardian: same
+                                # anomaly, structured attribution
+                                if guardian is None:
+                                    raise
+                                outs = None
+                                verdict = guardian.on_nan_error(step, e)
+                            if outs is not None:
+                                if guardian is not None:
+                                    outs, verdict = guardian.post_step(
+                                        step, outs
+                                    )
+                                else:
+                                    verdict = None
+                            skipped = (
+                                verdict
+                                == _guardian.Guardian.VERDICT_SKIP
+                            )
+                            if skipped:
+                                # discard the update (pre-step buffers
+                                # re-referenced), keep the stream
+                                # advanced; the step still counts in
+                                # progress telemetry — work happened —
+                                # and control falls through to the
+                                # shared preemption / interval-save
+                                # tail: a SIGTERM or a checkpoint
+                                # boundary landing on a skipped step
+                                # must not be missed (the saved state
+                                # is the restored pre-step state — a
+                                # valid checkpoint)
+                                guardian.restore_skip(scope, program)
+                            else:
+                                # silent-corruption fault injection
+                                # AFTER the update landed (no-op when
+                                # disarmed): invisible to this rank's
+                                # health fetch by construction — only
+                                # the cross-replica digest vote can
+                                # see it
+                                _chaos.maybe_bitflip_state(
+                                    step, program, scope
+                                )
+                                if (guardian is not None
+                                        and hb is not None
+                                        and guardian.digest_due(step)):
+                                    hb.publish_digest(
+                                        step,
+                                        guardian.state_digest(scope),
+                                    )
+                                if (user_fetches and print_period
+                                        and step % print_period == 0):
+                                    info = fetch_info or [
+                                        getattr(f, "name", str(f))
+                                        for f in user_fetches
+                                    ]
+                                    msg = ", ".join(
+                                        "%s=%s"
+                                        % (n, np.asarray(o).ravel()[:4])
+                                        for n, o in zip(info, outs)
+                                    )
+                                    print("step %d: %s" % (step, msg))
+                            if on_step is not None:
+                                on_step(step)
+                            if hb is not None:
+                                hb.beat(step)
+                            if ckpt_manager is not None:
+                                # per-install latch, not the sticky
+                                # module flag: a driver that
+                                # deliberately re-enters train() after
+                                # a survived SIGTERM gets a full run,
+                                # not 1-step stops
+                                requested = (
+                                    handler.requested.is_set()
+                                    if handler is not None
+                                    and handler._installed
+                                    else preempt_mod.preemption_requested()
+                                )
+                                if requested:
+                                    preempted_break = True
+                                    # the final save must not be
+                                    # skipped because an EARLIER
+                                    # interval save failed on the
+                                    # writer — drain + swallow the
+                                    # stale error first (same contract
+                                    # as PreemptionHandler._final_save)
+                                    try:
+                                        ckpt_manager.wait()
+                                    except Exception:
+                                        pass
+                                    ckpt_manager.save(
+                                        step, program, scope=scope,
+                                        async_=False,
+                                    )
+                                    # the final preempted step ran in
+                                    # full (plus its terminal save) —
+                                    # it must count in the
+                                    # progress/step-time telemetry the
+                                    # gang report compares across ranks
+                                    _account_step()
+                                    step += 1
+                                    break
+                                if (ckpt_interval
+                                        and (step + 1) % ckpt_interval
+                                        == 0):
+                                    ckpt_manager.save(
+                                        step, program, scope=scope
+                                    )
+                            # fault-injection point AFTER the interval
+                            # save was enqueued: a crash here lands
+                            # while the async writer may be mid-commit
+                            # — the worst case the chaos harness exists
+                            # to make reproducible
+                            _chaos.on_step(step)
+                        _account_step()
+                        step += 1
+                except _guardian.RollbackSignal as rb:
+                    pipe.close()
+                    pipe = None
+                    restored = guardian.execute_rollback(
+                        rb, scope, hb=hb
+                    )
+                    start_step = restored + 1
+                    step = start_step
+                    continue
+                break
             if hb is not None:
                 # a preempted stop is NOT completion: "done" would exempt
                 # this worker from the supervisor's hang watchdog while
@@ -245,7 +362,8 @@ class MultiTrainer(TrainerBase):
                     force=True,
                 )
         finally:
-            pipe.close()
+            if pipe is not None:
+                pipe.close()
             if handler is not None:
                 handler.uninstall()
             if ckpt_manager is not None:

@@ -225,6 +225,9 @@ def gang_report(workdir, obs_dir=None):
         "preemptions": sum(
             1 for e in events if e.get("event") == "worker_preempted"
         ),
+        "sdc_quarantines": sum(
+            1 for e in events if e.get("event") == "replica_quarantined"
+        ),
         "resizes": sum(
             1 for e in events if e.get("event") == "gang_resize"
         ),

@@ -37,6 +37,16 @@ Injection points and their hosts:
   the slot and grow back when the marker expires, deterministically.
 - ``slow_feed_ms`` — ``fluid/io_pipeline.py``'s producer thread calls
   ``maybe_slow_feed()`` per batch (models a degraded input host).
+- ``nan_grad_at_step`` / ``loss_spike_at_step`` — data-plane faults for
+  the training guardian: ``fluid/trainer.py`` routes each step's feed
+  through ``poison_feed(step, feed)`` before the executor runs (NaN
+  poisons the whole loss/grad chain; the spike scales the batch so the
+  loss jumps while staying finite).
+- ``bitflip_grad_at_step`` — silent data corruption:
+  ``maybe_bitflip_state(step, program, scope)`` flips one parameter
+  sign bit AFTER the armed step's update on the ``target_rank`` worker,
+  invisible to that rank's own health fetch — the fault only the
+  supervisor's cross-replica digest vote can catch.
 - ``corrupt_ckpt`` — the checkpoint writer routes serialized tensor
   bytes through ``corrupt_ckpt_bytes()`` AFTER the manifest crc32 is
   computed, producing exactly the torn-file signature the restore
@@ -73,7 +83,13 @@ __all__ = [
     "maybe_slow_feed",
     "corrupt_ckpt_bytes",
     "maybe_rpc_error",
+    "poison_feed",
+    "maybe_bitflip_state",
 ]
+
+# loss_spike feed scaling: big enough that any training loss jumps far
+# outside a robust rolling window, small enough to stay finite in fp32
+_SPIKE_FACTOR = 1024.0
 
 _lock = threading.Lock()
 _plan = None  # in-process FaultPlan (overrides flags when installed)
@@ -96,9 +112,20 @@ class FaultPlan(object):
                  corrupt_ckpt=False, slow_feed_ms=0.0, rpc_fail_n=0,
                  target_rank=None, marker_dir=None, lose_rank=None,
                  lose_rank_at_step=None, lose_rank_for=-1,
-                 die_after_tokens=None, die_replica=None):
+                 die_after_tokens=None, die_replica=None,
+                 nan_grad_at_step=None, loss_spike_at_step=None,
+                 bitflip_grad_at_step=None):
         self.crash_at_step = crash_at_step
         self.hang_at_step = hang_at_step
+        # data-plane faults (the training guardian's closed loop):
+        # nan_grad poisons the armed step's feed batch with a NaN,
+        # loss_spike scales it so the loss jumps while staying finite,
+        # bitflip_grad flips one parameter sign bit AFTER the armed
+        # step's update (silent corruption — only a cross-replica
+        # digest can see it). All three honor target_rank + marker_dir.
+        self.nan_grad_at_step = nan_grad_at_step
+        self.loss_spike_at_step = loss_spike_at_step
+        self.bitflip_grad_at_step = bitflip_grad_at_step
         self.corrupt_ckpt = bool(corrupt_ckpt)
         self.slow_feed_ms = float(slow_feed_ms)
         self.rpc_fail_n = int(rpc_fail_n)
@@ -135,9 +162,13 @@ class FaultPlan(object):
         lose_for = int(_flags.get_flag("chaos_lose_rank_for", -1))
         die_after = int(_flags.get_flag("chaos_die_after_tokens", -1))
         die_replica = int(_flags.get_flag("chaos_die_replica", -1))
+        nan_at = int(_flags.get_flag("chaos_nan_grad_at_step", -1))
+        spike_at = int(_flags.get_flag("chaos_loss_spike_at_step", -1))
+        bitflip_at = int(_flags.get_flag("chaos_bitflip_grad_at_step", -1))
         if (crash < 0 and hang < 0 and not corrupt and slow <= 0
                 and rpc_n <= 0 and (lose < 0 or lose_at < 0)
-                and die_after <= 0):
+                and die_after <= 0 and nan_at < 0 and spike_at < 0
+                and bitflip_at < 0):
             return None
         return cls(
             crash_at_step=crash if crash >= 0 else None,
@@ -152,6 +183,9 @@ class FaultPlan(object):
             lose_rank_for=lose_for,
             die_after_tokens=die_after if die_after > 0 else None,
             die_replica=die_replica if die_replica >= 0 else None,
+            nan_grad_at_step=nan_at if nan_at >= 0 else None,
+            loss_spike_at_step=spike_at if spike_at >= 0 else None,
+            bitflip_grad_at_step=bitflip_at if bitflip_at >= 0 else None,
         )
 
     def targets_me(self):
@@ -342,6 +376,97 @@ def corrupt_ckpt_bytes(blob):
     if not blob or not _fire_once(plan, "corrupt_ckpt"):
         return blob
     return blob[:-1] + bytes([blob[-1] ^ 0xFF])
+
+
+def poison_feed(step, feed):
+    """Trainer hook BEFORE the executor runs a step: return ``feed``
+    (untouched on the common disarmed path), or a poisoned copy when
+    ``nan_grad_at_step`` / ``loss_spike_at_step`` is armed for this
+    step+rank. The first float entry of the feed dict is hit — NaN at
+    flat index 0 for ``nan_grad`` (the whole loss/grad chain goes
+    non-finite), a x%g scale for ``loss_spike`` (the loss jumps but
+    stays finite; ``_SPIKE_FACTOR``). Returns a plain host dict for the
+    poisoned step, so the io_pipeline's committed device batch is simply
+    bypassed for that one step."""
+    plan = active_plan()
+    if plan is None or not plan.targets_me():
+        return feed
+    mode = None
+    if (plan.nan_grad_at_step is not None
+            and step == int(plan.nan_grad_at_step)):
+        mode = "nan_grad"
+    elif (plan.loss_spike_at_step is not None
+            and step == int(plan.loss_spike_at_step)):
+        mode = "loss_spike"
+    if mode is None or not _fire_once(plan, mode):
+        return feed
+    import numpy as np
+
+    out = {}
+    poisoned = None
+    for name, val in feed.items():
+        if poisoned is None and not hasattr(val, "lod"):
+            arr = np.array(np.asarray(val))  # writable host copy
+            if np.issubdtype(arr.dtype, np.floating):
+                if mode == "nan_grad":
+                    arr.reshape(-1)[0] = np.nan
+                else:
+                    arr *= _SPIKE_FACTOR
+                out[name] = arr
+                poisoned = name
+                continue
+        out[name] = val
+    print(
+        "CHAOS %s step=%d var=%s pid=%d"
+        % (mode, step, poisoned, os.getpid()),
+        flush=True,
+    )
+    return out
+
+
+def maybe_bitflip_state(step, program, scope):
+    """Trainer hook AFTER a step's update landed in the scope: flip the
+    LOWEST mantissa bit of element 0 of the alphabetically-first
+    parameter on the targeted rank — a deterministic stand-in for
+    silent data corruption (SDC) in one replica's weight update. One
+    ulp is invisible to the rank's own loss/grad-norm anomaly policy BY
+    DESIGN (that is what makes SDC silent — a loud corruption would
+    trip the local detector as a spike); only the supervisor's
+    cross-replica digest vote, which compares exact bytes, can see it.
+    Returns the corrupted var name, or None."""
+    plan = active_plan()
+    if (plan is None or plan.bitflip_grad_at_step is None
+            or step != int(plan.bitflip_grad_at_step)
+            or not plan.targets_me()
+            or not _fire_once(plan, "bitflip_grad")):
+        return None
+    import numpy as np
+
+    if scope is None:
+        from ..fluid import core as _core
+
+        scope = _core.global_scope()
+    for name in sorted(p.name for p in program.all_parameters()):
+        val = scope.get(name)
+        if val is None:
+            continue
+        arr = np.array(np.asarray(
+            val.numpy() if hasattr(val, "numpy") else val
+        ))
+        flat = arr.reshape(-1)
+        if flat.size == 0 or flat.dtype not in (np.float32, np.float64):
+            continue
+        bits = flat.view(np.uint32 if flat.dtype == np.float32
+                         else np.uint64)
+        bits[0] ^= np.array(1, bits.dtype)
+        scope.set(name, arr)
+        print(
+            "CHAOS bitflip_grad step=%d var=%s pid=%d"
+            % (step, name, os.getpid()),
+            flush=True,
+        )
+        return name
+    return None
 
 
 def maybe_rpc_error(what):

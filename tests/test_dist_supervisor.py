@@ -236,6 +236,61 @@ def test_supervisor_start_status_hang_bounded_by_instrumented_grace(
         fluid.set_flags(old)
 
 
+def test_supervisor_rollback_status_judged_under_startup_grace(tmp_path):
+    # the training guardian's checkpoint rollback beats
+    # status="rollback" and then goes quiet for the length of the
+    # restore — MUCH longer than the per-step staleness bound. The
+    # supervisor must judge it under the startup-style instrumented
+    # grace (like "start"), not hang-kill a live worker mid-restore.
+    code = (
+        "import json, os, time\n"
+        "p = os.environ['PADDLE_TPU_HEARTBEAT_FILE']\n"
+        "def beat(step, status):\n"
+        "    open(p, 'w').write(json.dumps({'pid': os.getpid(),"
+        " 'step': step, 'status': status, 'time': time.time()}))\n"
+        "beat(3, 'step')\n"
+        "time.sleep(0.1)\n"
+        "beat(3, 'rollback')\n"
+        "time.sleep(1.0)\n"  # the restore: 5x the per-step hang bound
+        "beat(4, 'step')\n"
+        "beat(4, 'done')\n"
+    )
+    sup = Supervisor(
+        [_spec(code, tmp_path, 0)],
+        workdir=str(tmp_path), max_restarts=0,
+        heartbeat_timeout_s=0.2, poll_s=0.05, sigterm_grace_s=0.3,
+    )
+    assert sup.run() == 0
+    assert not _events(tmp_path, "hang_detected")
+
+
+def test_supervisor_rollback_status_hang_still_bounded(tmp_path):
+    # ...but the rollback grace is FINITE: a worker that beats
+    # "rollback" and never comes back is still a hang, bounded by the
+    # instrumented grace — rollback must not become a hang-proof cloak
+    code = (
+        "import json, os, time\n"
+        "p = os.environ['PADDLE_TPU_HEARTBEAT_FILE']\n"
+        "open(p, 'w').write(json.dumps({'pid': os.getpid(), 'step': 3,"
+        " 'status': 'rollback', 'time': time.time()}))\n"
+        "time.sleep(120)\n"
+    )
+    old = fluid.get_flags("FLAGS_dist_startup_grace_s")
+    try:
+        fluid.set_flags({"FLAGS_dist_startup_grace_s": 0.4})
+        sup = Supervisor(
+            [_spec(code, tmp_path, 0)], workdir=str(tmp_path),
+            max_restarts=0, heartbeat_timeout_s=0.1,
+            poll_s=0.05, sigterm_grace_s=0.3,
+        )
+        t0 = time.monotonic()
+        assert sup.run() == 1
+        assert time.monotonic() - t0 < 30.0
+        assert _events(tmp_path, "hang_detected")
+    finally:
+        fluid.set_flags(old)
+
+
 def test_supervisor_preemption_during_backoff_skips_respawn(tmp_path):
     # SIGTERM landing in the restart-backoff sleep must exit 143 without
     # spawning (and immediately killing) a fresh gang

@@ -1,0 +1,498 @@
+"""Training guardian (distributed/guardian.py + the trainer/executor/
+checkpoint wiring): in-graph health fetch, robust anomaly policy with
+the AMP found_inf exemption, the skip/rollback/giveup response ladder,
+poisoned-step markers, the FLAGS_check_nan_inf executor post-run fetch
+scan, and the fast deterministic closed loop of
+tools/train_guardian_probe.py (ISSUE 14 acceptance)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.core as core
+from paddle_tpu.distributed import guardian as guardian_mod
+from paddle_tpu.distributed.guardian import (
+    Guardian,
+    GuardianGiveup,
+    RobustWindow,
+    RollbackSignal,
+    attach_health_fetch,
+    state_digest,
+)
+from paddle_tpu.fluid.debugger import NanInfError, nonfinite_kind, scan_fetches
+from paddle_tpu.testing import chaos
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PROBE = os.path.join(REPO, "tools", "train_guardian_probe.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    yield
+    chaos.clear()
+
+
+@pytest.fixture()
+def guardian_flags():
+    """Arm the guardian with fast test-sized knobs; restore after."""
+    names = [
+        "FLAGS_guardian_enable", "FLAGS_guardian_warmup_steps",
+        "FLAGS_guardian_max_skips", "FLAGS_guardian_max_rollbacks",
+        "FLAGS_guardian_marker_dir", "FLAGS_guardian_spike_sigma",
+    ]
+    old = {n: fluid.get_flags(n)[n] for n in names}
+    fluid.set_flags({
+        "FLAGS_guardian_enable": True,
+        "FLAGS_guardian_warmup_steps": 3,
+    })
+    yield
+    fluid.set_flags(old)
+
+
+def _build_mlp(hidden=8):
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(input=x, size=hidden, act="relu")
+            logits = fluid.layers.fc(input=h, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y)
+            )
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(seed=0, bad=None, scale=1.0):
+    r = np.random.RandomState(seed)
+    x = (r.rand(8, 4) * scale).astype("float32")
+    if bad == "nan":
+        x[0, 0] = np.nan
+    elif bad == "inf":
+        x[0, 0] = np.inf
+    return {"x": x, "y": r.randint(0, 3, (8, 1)).astype("int64")}
+
+
+# ---------------------------------------------------------------------------
+# robust spike window
+# ---------------------------------------------------------------------------
+def test_robust_window_follows_trend_and_flags_spikes():
+    w = RobustWindow(sigma=6.0, window=32, warmup=4)
+    # a drifting-but-smooth series is never flagged
+    for i in range(30):
+        spike, _z = w.judge(2.0 - 0.02 * i + 0.01 * ((-1) ** i))
+        assert not spike, "smooth step %d flagged" % i
+    spike, z = w.judge(50.0)
+    assert spike and z > 6.0
+    # the spike was NOT admitted: the next normal value still fits
+    spike, _ = w.judge(1.4)
+    assert not spike
+
+
+def test_robust_window_nonfinite_is_always_a_spike():
+    w = RobustWindow(sigma=6.0, window=8, warmup=4)
+    spike, z = w.judge(float("nan"))
+    assert spike and z == float("inf")
+    spike, _ = w.judge(float("inf"))
+    assert spike
+
+
+def test_robust_window_plateau_does_not_flag_noise():
+    w = RobustWindow(sigma=6.0, window=16, warmup=4)
+    for i in range(20):
+        spike, _ = w.judge(0.5)  # MAD -> 0: the scale floor must hold
+        assert not spike
+    spike, _ = w.judge(0.5005)
+    assert not spike
+
+
+# ---------------------------------------------------------------------------
+# debugger: the FLAGS_check_nan_inf post-run fetch scan
+# ---------------------------------------------------------------------------
+def test_nonfinite_kind_classification():
+    assert nonfinite_kind(np.array([1.0, 2.0])) is None
+    assert nonfinite_kind(np.array([1.0, np.nan])) == "nan"
+    assert nonfinite_kind(np.array([np.inf])) == "inf"
+    assert nonfinite_kind(np.array([1, 2], dtype=np.int64)) is None
+    assert nonfinite_kind(None) is None
+
+
+def test_scan_fetches_names_the_offending_var():
+    with pytest.raises(NanInfError) as ei:
+        scan_fetches(["a", "b"], [np.ones(3), np.array([np.nan])])
+    assert ei.value.var_name == "b" and ei.value.kind == "nan"
+    assert scan_fetches(["a"], [np.ones(2)]) == 1
+
+
+def test_executor_post_run_scan_raises_on_nan_fetch():
+    # isolate the EXECUTOR-level post-run scan (the behavior
+    # fluid/debugger.py documented but PR 0 never built) from the
+    # jax_debug_nans side effect the flag also arms — debug_nans would
+    # otherwise raise its own FloatingPointError first
+    import jax
+
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    old = fluid.get_flags("FLAGS_check_nan_inf")
+    try:
+        fluid.set_flags({"FLAGS_check_nan_inf": True})
+        jax.config.update("jax_debug_nans", False)
+        # clean fetches pass with the flag armed
+        exe.run(main, feed=_batch(1), fetch_list=[loss], scope=scope)
+        with pytest.raises(NanInfError) as ei:
+            exe.run(main, feed=_batch(bad="nan"), fetch_list=[loss],
+                    scope=scope)
+        assert ei.value.var_name == loss.name
+        assert ei.value.kind == "nan"
+    finally:
+        fluid.set_flags(old)
+        jax.config.update("jax_debug_nans", False)
+
+
+# ---------------------------------------------------------------------------
+# in-graph health fetch
+# ---------------------------------------------------------------------------
+def _host_norm(partial_vals):
+    import math
+
+    ssq = math.fsum(
+        float(np.asarray(v).ravel()[0]) for v in partial_vals
+    )
+    return math.sqrt(ssq) if math.isfinite(ssq) else ssq
+
+
+def test_attach_health_fetch_is_the_grad_norm_and_nan_detector():
+    main, startup, loss = _build_mlp()
+    partials = attach_health_fetch(main)
+    # one sum-of-squares partial PER parameter gradient (2 fc layers x
+    # (w, b)); the host sum of the series is the global grad norm
+    assert len(partials) == 4
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    outs = exe.run(main, feed=_batch(), fetch_list=[loss] + partials,
+                   scope=scope)
+    h = _host_norm(outs[1:])
+    assert np.isfinite(h) and h > 0.0  # a real grad norm
+    # a poisoned batch propagates into the series within the same step
+    outs = exe.run(main, feed=_batch(bad="nan"),
+                   fetch_list=[loss] + partials, scope=scope)
+    assert not np.isfinite(_host_norm(outs[1:]))
+
+
+def test_attach_health_fetch_empty_without_grads():
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            fluid.layers.fc(input=x, size=3)
+    assert attach_health_fetch(main) == []
+
+
+# ---------------------------------------------------------------------------
+# guardian ladder (no executor: verdicts from fabricated fetch values)
+# ---------------------------------------------------------------------------
+def _mk_guardian(tmp_path=None, **flag_overrides):
+    flags = {"FLAGS_guardian_enable": True,
+             "FLAGS_guardian_warmup_steps": 3}
+    if tmp_path is not None:
+        flags["FLAGS_guardian_marker_dir"] = str(tmp_path / "markers")
+    flags.update(flag_overrides)
+    fluid.set_flags(flags)
+    main, _startup, _loss = _build_mlp()
+    return Guardian.maybe_create(main)
+
+
+def _outs(g, loss, health):
+    """Fabricate one step's fetched values for guardian ``g``: the user
+    loss plus its per-grad partials, loaded so the host sum's sqrt comes
+    out to ``health`` (non-finite values ride the first partial)."""
+    partials = [np.zeros(1, "float32") for _ in g.health_vars]
+    if partials:
+        import math
+
+        partials[0] = np.array(
+            [health * health if math.isfinite(health) else health],
+            "float32",
+        )
+    return [np.array([loss], "float32")] + partials
+
+
+def test_guardian_ladder_skip_then_rollback_then_giveup(
+        guardian_flags, tmp_path):
+    g = _mk_guardian(tmp_path, FLAGS_guardian_max_skips=1)
+    g.ckpt_manager = object()  # present: the ladder may offer rollback
+    assert len(g.health_vars) == 4 and g.loss_scale_var is None
+    # healthy step
+    user, verdict = g.post_step(0, _outs(g, 1.0, 2.0))
+    assert verdict == Guardian.VERDICT_OK and len(user) == 1
+    # anomaly 1 -> skip (budget 1)
+    _, verdict = g.post_step(1, _outs(g, float("nan"), 1.0))
+    assert verdict == Guardian.VERDICT_SKIP
+    assert g.should_drop(1) and not g.should_drop(0)
+    # anomaly 2 -> rollback
+    with pytest.raises(RollbackSignal) as ei:
+        g.post_step(2, _outs(g, float("nan"), 1.0))
+    assert ei.value.step == 2
+    g.rollbacks_used += 1  # what execute_rollback would record
+    # anomaly 3 -> structured giveup
+    with pytest.raises(GuardianGiveup) as ei:
+        g.post_step(3, _outs(g, float("nan"), 1.0))
+    assert ei.value.report["anomaly_step"] == 3
+    assert ei.value.report["skips_used"] == 1
+    # markers persisted the poisoned steps for the next life
+    g2 = _mk_guardian(tmp_path, FLAGS_guardian_max_skips=1)
+    assert {1, 2, 3} <= g2.drop_steps
+
+
+def test_guardian_no_ckpt_manager_skips_then_gives_up(guardian_flags):
+    g = _mk_guardian(None, FLAGS_guardian_max_skips=0)
+    assert g.ckpt_manager is None
+    with pytest.raises(GuardianGiveup) as ei:
+        g.post_step(5, _outs(g, float("inf"), 1.0))
+    assert ei.value.report["has_ckpt_manager"] is False
+
+
+def test_guardian_grad_explosion_without_amp_is_immediate(guardian_flags):
+    g = _mk_guardian(None)
+    # finite loss + non-finite health, NO loss_scaling var in the
+    # program: not a scaler backoff — immediate anomaly
+    _, verdict = g.post_step(0, _outs(g, 0.7, float("inf")))
+    assert verdict == Guardian.VERDICT_SKIP
+    assert g.stats["kinds"] == {"nan_inf_grad": 1}
+
+
+def test_guardian_disarmed_and_pipeline_programs(guardian_flags):
+    fluid.set_flags({"FLAGS_guardian_enable": False})
+    main, _s, _l = _build_mlp()
+    assert Guardian.maybe_create(main) is None
+    fluid.set_flags({"FLAGS_guardian_enable": True})
+    main2, _s2, _l2 = _build_mlp()
+    main2._pipeline_config = {"cut": 1}
+    assert Guardian.maybe_create(main2) is None
+
+
+def test_state_digest_diverges_on_one_ulp(guardian_flags):
+    main, startup, _loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    names = sorted(p.name for p in main.all_parameters())
+    d1 = state_digest(names, scope)
+    assert d1 == state_digest(names, scope)  # deterministic
+    arr = np.array(np.asarray(scope.get(names[0])))
+    arr.reshape(-1).view(np.uint32)[0] ^= 1  # 1-ulp SDC
+    scope.set(names[0], arr)
+    assert state_digest(names, scope) != d1
+
+
+# ---------------------------------------------------------------------------
+# AMP interplay: found_inf backoff steps are the scaler working
+# ---------------------------------------------------------------------------
+def _build_amp_fp16(init_scale):
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(input=x, size=8, act="relu")
+            logits = fluid.layers.fc(input=h, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y)
+            )
+            opt = mp.decorate(
+                fluid.optimizer.SGD(learning_rate=0.05),
+                init_loss_scaling=init_scale,
+                use_dynamic_loss_scaling=True,
+                decr_every_n_nan_or_inf=1,
+                decr_ratio=0.25,
+                use_bf16=False,
+            )
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_amp_backoff_steps_record_zero_guardian_anomalies(guardian_flags):
+    # an fp16 run whose loss scale starts absurdly high: the first
+    # steps' grads overflow (found_inf), the scaler masks the update
+    # and shrinks the scale — the guardian must record ZERO anomalies
+    # for these, because the loss itself stays finite
+    from paddle_tpu.fluid import profiler
+
+    main, startup, loss = _build_amp_fp16(init_scale=1e38)
+    g = Guardian.maybe_create(main)
+    assert g is not None and g.loss_scale_var is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    fetches = g.wrap_fetches([loss])
+    before = profiler.get_counter("train_anomalies")
+    backoffs = 0
+    for s in range(6):
+        outs = exe.run(main, feed=_batch(s), fetch_list=fetches,
+                       scope=scope)
+        user, verdict = g.post_step(s, outs)
+        assert verdict == Guardian.VERDICT_OK, (
+            "backoff step %d flagged: stats %s" % (s, g.stats)
+        )
+        assert np.isfinite(float(np.asarray(user[0]).ravel()[0]))
+    backoffs = g.stats["amp_backoff_steps"]
+    assert backoffs >= 1, "loss scale never overflowed: %s" % g.stats
+    assert g.stats["anomalies"] == 0
+    assert profiler.get_counter("train_anomalies") == before
+    # ... but a genuinely NaN-poisoned AMP step must still trip it
+    outs = exe.run(main, feed=_batch(9, bad="nan"), fetch_list=fetches,
+                   scope=scope)
+    _, verdict = g.post_step(9, outs)
+    assert verdict == Guardian.VERDICT_SKIP
+    assert g.stats["kinds"].get("nan_inf_loss") == 1
+
+
+def _amp_outs(g, loss, first_partial, scale):
+    """Fabricated fetches for an AMP guardian: loss + partials + the
+    loss-scale value."""
+    partials = [np.zeros(1, "float32") for _ in g.health_vars]
+    partials[0] = np.array([first_partial], "float32")
+    return ([np.array([loss], "float32")] + partials
+            + [np.array([scale], "float32")])
+
+
+def test_amp_health_is_normalized_by_the_grad_scale(guardian_flags):
+    # the @GRAD vars hold SCALED grads under AMP: a routine loss-scale
+    # increase must not read as a grad explosion. The health series is
+    # divided by the scale the grads were computed under (last step's
+    # fetched value), so it stays flat across scaler moves.
+    main, _s, _l = _build_amp_fp16(init_scale=1024.0)
+    g = Guardian.maybe_create(main)
+    assert g.loss_scale_var is not None
+    unscaled = 0.5
+    # step 0 at scale 1024: raw grad norm = 0.5 * 1024
+    _, v = g.post_step(0, _amp_outs(g, 1.0, (unscaled * 1024.0) ** 2,
+                                    1024.0))
+    assert v == Guardian.VERDICT_OK
+    assert abs(g._last_health - unscaled) < 1e-4
+    # step 1: the scaler doubles the scale IN-GRAPH after the backward
+    # — this step's grads were still computed at 1024 (last step's
+    # fetched value) while this step's fetch sees the new 2048; the
+    # normalizer must be the former
+    _, v = g.post_step(1, _amp_outs(g, 1.0, (unscaled * 1024.0) ** 2,
+                                    2048.0))
+    assert v == Guardian.VERDICT_OK
+    assert abs(g._last_health - unscaled) < 1e-4
+    # step 2 runs at the grown scale: still flat
+    _, v = g.post_step(2, _amp_outs(g, 1.0, (unscaled * 2048.0) ** 2,
+                                    2048.0))
+    assert v == Guardian.VERDICT_OK
+    assert abs(g._last_health - unscaled) < 1e-4
+    assert g.stats["anomalies"] == 0
+
+
+def test_amp_backoff_exemption_is_bounded(guardian_flags):
+    # persistent non-finite grads shrink the scale forever without a
+    # good step — corruption, not overflow: the exemption must run out
+    # and the ladder take over. A GROWN scale with non-finite grads
+    # (found_inf cannot have fired) is immediate.
+    main, _s, _l = _build_amp_fp16(init_scale=1024.0)
+    g = Guardian.maybe_create(main)
+    scale = 1024.0
+    step = 0
+    for _ in range(guardian_mod._AMP_BACKOFF_RUN_LIMIT):
+        scale *= 0.5
+        _, v = g.post_step(step, _amp_outs(g, 0.4, np.nan, scale))
+        assert v == Guardian.VERDICT_OK, (step, g.stats)
+        step += 1
+    assert g.stats["amp_backoff_steps"] == \
+        guardian_mod._AMP_BACKOFF_RUN_LIMIT
+    scale *= 0.5
+    _, v = g.post_step(step, _amp_outs(g, 0.4, np.nan, scale))
+    assert v == Guardian.VERDICT_SKIP
+    assert g.stats["kinds"] == {"nan_inf_grad": 1}
+    # fresh guardian, scale GREW while grads are non-finite: no backoff
+    # story — immediate anomaly
+    main2, _s2, _l2 = _build_amp_fp16(init_scale=1024.0)
+    g2 = Guardian.maybe_create(main2)
+    _, v = g2.post_step(0, _amp_outs(g2, 0.4, 1.0, 1024.0))  # healthy
+    assert v == Guardian.VERDICT_OK
+    _, v = g2.post_step(1, _amp_outs(g2, 0.4, np.nan, 2048.0))
+    assert v == Guardian.VERDICT_SKIP
+    assert g2.stats["kinds"] == {"nan_inf_grad": 1}
+
+
+def test_attach_health_fetch_is_idempotent_per_program():
+    # train() re-entry on the same Program must not append a second
+    # generation of reduction ops (compiled-but-never-fetched waste +
+    # a forced recompile)
+    main, _s, _l = _build_mlp()
+    first = attach_health_fetch(main)
+    n_ops = len(main.global_block().ops)
+    again = attach_health_fetch(main)
+    assert [v.name for v in again] == [v.name for v in first]
+    assert len(main.global_block().ops) == n_ops
+
+
+# ---------------------------------------------------------------------------
+# skip-step restores the pre-step state byte-exactly
+# ---------------------------------------------------------------------------
+def test_skip_restore_is_byte_exact(guardian_flags):
+    main, startup, loss = _build_mlp()
+    g = Guardian.maybe_create(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    exe.run(startup, scope=scope)
+    names = sorted(p.name for p in main.all_parameters())
+    fetches = g.wrap_fetches([loss])
+    exe.run(main, feed=_batch(0), fetch_list=fetches, scope=scope)
+    before = {n: np.array(np.asarray(scope.get(n))) for n in names}
+    g.pre_step(scope)
+    outs = exe.run(main, feed=_batch(1, bad="nan"), fetch_list=fetches,
+                   scope=scope)
+    _, verdict = g.post_step(1, outs)
+    assert verdict == Guardian.VERDICT_SKIP
+    # the poisoned update DID land before the verdict...
+    poisoned = np.asarray(scope.get(names[0]))
+    assert not np.array_equal(np.asarray(poisoned), before[names[0]]) \
+        or np.isnan(np.asarray(poisoned)).any()
+    # ...and restore_skip discards it byte-exactly
+    g.restore_skip(scope, main)
+    for n in names:
+        assert np.array_equal(
+            np.asarray(scope.get(n)), before[n]
+        ), "param %s not restored" % n
+
+
+# ---------------------------------------------------------------------------
+# the closed loop (ISSUE 14 acceptance): probe fast subset
+# ---------------------------------------------------------------------------
+def test_train_guardian_probe_fast(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        [sys.executable, PROBE, "--fast", "--workdir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, "probe failed:\n%s" % out
+    assert "PROBE PASS" in out
+    report = None
+    for line in out.splitlines():
+        if line.startswith("REPORT "):
+            report = json.loads(line[len("REPORT "):])
+    assert report is not None
+    assert report["sdc"]["sdc_quarantines"] == 1
+    assert report["sdc"]["quarantined_slot"] == 2
+    assert report["health_fetch"]["overhead_pct"] < 2.0
+    assert report["rollback_ms"]["count"] == 1

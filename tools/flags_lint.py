@@ -11,8 +11,9 @@ is linted too: a registered knob nobody reads is a dead knob. (Scope
 grew obs_* -> +dist_*/elastic_* with the elastic-resize PR,
 -> +serving_* with the compile-telemetry PR, -> +decode_* with the
 KV-cache decode runtime, -> +gateway_* with the HTTP gateway,
--> +fleet_*/router_* with the serving fleet control plane, and
--> +chaos_* with the durable-generations failover PR.)
+-> +fleet_*/router_* with the serving fleet control plane,
+-> +chaos_* with the durable-generations failover PR, and
+-> +guardian_* with the training-guardian PR.)
 
 A second pass lints METRIC names: every counter / histogram /
 scrape-time gauge the registry can render (every literal name at a
@@ -36,7 +37,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the linted knob families (prefix with trailing underscore)
 PREFIXES = ("obs_", "dist_", "elastic_", "serving_", "decode_",
-            "gateway_", "fleet_", "router_", "chaos_")
+            "gateway_", "fleet_", "router_", "chaos_", "guardian_")
 _NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
 
 # the spellings a knob is consumed under: the env-bridge name and the

@@ -1,0 +1,515 @@
+"""Closed-loop probe for the training guardian (ISSUE 14 acceptance).
+
+Proves the data-plane fault-tolerance properties of
+``paddle_tpu/distributed/guardian.py`` end to end, on real trainers and
+(for the SDC trial) a real supervised OS-process gang:
+
+  1. **NaN defense, skip path** — a chaos ``nan_grad_at_step`` batch is
+     detected within ONE step (the in-graph health scalar + loss both go
+     non-finite), the update is discarded (skip-step) and the run's
+     final param digest is byte-equal to a clean run on the surviving
+     data schedule (the same stream with the poisoned batch dropped —
+     built by pre-seeding the guardian's poisoned-step marker).
+  2. **Spike defense** — a chaos ``loss_spike_at_step`` batch (finite
+     but far outside the robust rolling window) takes the same skip
+     path with the same digest parity.
+  3. **Rollback path** — with the skip budget at 0, the same NaN fault
+     forces a rollback to the newest VERIFIED checkpoint
+     (FLAGS_ckpt_scrub keeps it warm) and a deterministic replay that
+     drops the poisoned batch: digest parity again, ``train_rollbacks``
+     == 1, rollback MTTR measured from ``guardian_rollback_ms``.
+  4. **SDC quarantine** — a 3-proc supervised gang whose rank 2 takes a
+     chaos ``bitflip_grad`` (silent post-update corruption, invisible
+     to its own health fetch) is caught by the supervisor's
+     cross-replica digest majority vote: the corrupt rank is
+     quarantined via the elastic down-marker path
+     (``replica_quarantined`` event, ``sdc_quarantines`` counter), the
+     gang resizes to the survivors and converges — surviving ranks'
+     digests byte-equal the clean fixed-gang reference.
+  5. **Zero-recompile + overhead** — every worker asserts the XLA
+     compile count is flat after its first step (guardian armed = 0
+     steady-state recompiles), and an interleaved A/B bench measures
+     the health-fetch cost per step (< 2% of the CPU step).
+
+Modes::
+
+    python tools/train_guardian_probe.py --fast   # tier-1 subset
+    python tools/train_guardian_probe.py          # same, more bench steps
+
+The worker is this file with ``--worker``: the ckpt_crash_probe MLP
+trained through ``fluid.trainer.MultiTrainer`` with the guardian armed
+via FLAGS (env-bridged by the driver)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+for _p in (REPO, TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+STEPS = 24
+INTERVAL = 3
+POISON_STEP = 16  # past the guardian's default 8-step spike warmup
+REPORT_SCHEMA_VERSION = 1
+
+# SDC gang trial geometry: bitflip early, steps padded with a per-step
+# sleep so the supervisor's vote lands while the gang is mid-run
+GANG_STEPS = 12
+GANG_BITFLIP_STEP = 2
+GANG_DIGEST_INTERVAL = 2
+GANG_STEP_SLEEP_MS = 40.0
+
+
+def _finalize_report(report):
+    report["schema_version"] = REPORT_SCHEMA_VERSION
+    report["ts"] = time.time()
+    report["ts_mono"] = time.monotonic()
+    return report
+
+
+# -- worker ------------------------------------------------------------------
+
+def run_worker(args):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import checkpoint
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.fluid.trainer import MultiTrainer
+    from paddle_tpu.observability import xla_stats
+
+    from ckpt_crash_probe import _build, _StepDataset, _params_digest
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    fluid.set_flags({"FLAGS_ckpt_save_interval_steps": args.interval})
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    mgr = checkpoint.CheckpointManager(
+        os.path.join(args.dir, "rank_%d" % rank), keep_max=4
+    )
+    resumed = mgr.latest_step()
+    print("RESUMED %s" % ("FRESH" if resumed is None else resumed),
+          flush=True)
+    dataset = _StepDataset(
+        [main.global_block().var("x"), main.global_block().var("y")],
+        args.steps,
+    )
+
+    # zero-recompile evidence: the XLA compile count after the FIRST
+    # step must equal the count at the end — an armed guardian adds one
+    # constant fetch, never a steady-state recompile
+    compile_mark = {}
+
+    def on_step(_s):
+        if "first" not in compile_mark:
+            compile_mark["first"] = xla_stats.summary()["compiles"]
+        if args.step_sleep_ms > 0:
+            time.sleep(args.step_sleep_ms / 1000.0)
+
+    trained = MultiTrainer().train(
+        exe, main, dataset, fetch_list=[loss], print_period=0,
+        on_step=on_step, ckpt_manager=mgr, startup_program=startup,
+    )
+    if trained < args.steps or checkpoint.preemption_requested():
+        mgr.close()
+        print("PREEMPTED %d" % trained, flush=True)
+        return 143
+    mgr.save(args.steps - 1, main, async_=False)
+    mgr.close()
+    digest = _params_digest(main, fluid.global_scope())
+    path = os.path.join(args.dir, "digest_%d.txt" % rank)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        f.write(digest)
+    os.replace(tmp, path)
+    report = {
+        "train_anomalies": profiler.get_counter("train_anomalies"),
+        "train_skipped_steps": profiler.get_counter("train_skipped_steps"),
+        "train_rollbacks": profiler.get_counter("train_rollbacks"),
+        "guardian_rollback_ms": profiler.summarize_histogram(
+            "guardian_rollback_ms"
+        ),
+        "ckpt_scrub_ok": profiler.get_counter("ckpt_scrub_ok"),
+        "ckpt_scrub_corrupt": profiler.get_counter("ckpt_scrub_corrupt"),
+        "compiles_first": compile_mark.get("first"),
+        "compiles_final": xla_stats.summary()["compiles"],
+    }
+    print("REPORT_GUARDIAN " + json.dumps(report, sort_keys=True),
+          flush=True)
+    print("FINAL %s" % digest, flush=True)
+    return 0
+
+
+# -- driver helpers ----------------------------------------------------------
+
+def _worker_cmd(dirname, steps=STEPS, interval=INTERVAL, step_sleep_ms=0.0):
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--dir", dirname, "--steps", str(steps),
+        "--interval", str(interval),
+    ]
+    if step_sleep_ms:
+        cmd += ["--step_sleep_ms", str(step_sleep_ms)]
+    return cmd
+
+
+def _guardian_env(trial_dir, max_skips=2, digest_interval=0, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "PADDLE_TRAINER_ID": "0",
+        "FLAGS_guardian_enable": "1",
+        "FLAGS_guardian_max_skips": str(max_skips),
+        "FLAGS_guardian_marker_dir": os.path.join(
+            trial_dir, "guardian_markers"
+        ),
+        "FLAGS_ckpt_scrub": "1",
+    })
+    if digest_interval:
+        env["FLAGS_guardian_digest_interval"] = str(digest_interval)
+    env.pop("PADDLE_TPU_HEARTBEAT_FILE", None)
+    env.update(extra or {})
+    return env
+
+
+def _run_worker_proc(trial_dir, env, steps=STEPS, interval=INTERVAL):
+    os.makedirs(trial_dir, exist_ok=True)
+    p = subprocess.run(
+        _worker_cmd(trial_dir, steps, interval), env=env,
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    out = p.stdout + p.stderr
+    assert p.returncode == 0, (
+        "worker under %s failed rc=%d:\n%s" % (trial_dir, p.returncode, out)
+    )
+    digest = None
+    report = None
+    for line in out.splitlines():
+        if line.startswith("FINAL "):
+            digest = line.split()[1]
+        elif line.startswith("REPORT_GUARDIAN "):
+            report = json.loads(line[len("REPORT_GUARDIAN "):])
+    assert digest and report, "worker printed no FINAL/REPORT:\n%s" % out
+    assert report["compiles_final"] == report["compiles_first"], (
+        "steady-state recompile with guardian armed: %s" % report
+    )
+    return digest, report, out
+
+
+def _seed_drop_marker(trial_dir, step):
+    mdir = os.path.join(trial_dir, "guardian_markers")
+    os.makedirs(mdir, exist_ok=True)
+    with open(os.path.join(mdir, "poisoned_step_%d" % step), "w") as f:
+        f.write(json.dumps({"step": step, "kind": "seed"}))
+
+
+def _assert_detection_at(trial_dir, step):
+    """Detection within one step: the anomaly was attributed to exactly
+    the poisoned batch — one marker, naming that step."""
+    mdir = os.path.join(trial_dir, "guardian_markers")
+    markers = sorted(
+        n for n in os.listdir(mdir) if n.startswith("poisoned_step_")
+    )
+    assert markers == ["poisoned_step_%d" % step], (
+        "anomaly misattributed: markers %s != [poisoned_step_%d]"
+        % (markers, step)
+    )
+
+
+# -- SDC gang trial ----------------------------------------------------------
+
+def _sdc_quarantine_trial(tmp, ref_full):
+    from paddle_tpu.distributed.supervisor import (
+        Supervisor, WorkerSpec, load_events,
+    )
+
+    d = os.path.join(tmp, "sdc_gang")
+    os.makedirs(d, exist_ok=True)
+    specs = []
+    for r in range(3):
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",
+            "PADDLE_TRAINER_ID": str(r),
+            "PADDLE_TRAINERS_NUM": "3",
+            "FLAGS_guardian_enable": "1",
+            "FLAGS_guardian_digest_interval": str(GANG_DIGEST_INTERVAL),
+            "FLAGS_guardian_marker_dir": os.path.join(
+                d, "guardian_markers_%d" % r
+            ),
+            "FLAGS_ckpt_scrub": "1",
+            # rank-addressed silent corruption: rank 2's step-2 update
+            # gets one sign bit flipped, one-shot across restarts
+            "FLAGS_chaos_bitflip_grad_at_step": str(GANG_BITFLIP_STEP),
+            "FLAGS_chaos_target_rank": "2",
+            "FLAGS_chaos_marker_dir": os.path.join(d, "chaos_markers"),
+        }
+        specs.append(WorkerSpec(
+            _worker_cmd(d, GANG_STEPS, INTERVAL, GANG_STEP_SLEEP_MS),
+            env=env,
+            log_path=os.path.join(d, "workerlog.%d" % r),
+            rank=r,
+        ))
+    sup = Supervisor(
+        specs, workdir=d, max_restarts=1, heartbeat_timeout_s=30.0,
+        startup_grace_s=120.0, backoff_base_s=0.1, backoff_max_s=0.5,
+        sigterm_grace_s=5.0, poll_s=0.02, min_world_size=2,
+        max_preempt_restarts=3,
+    )
+    rc = sup.run()
+    assert rc == 0, "sdc gang: supervisor rc %d" % rc
+    assert sup.alive_pids() == {}, "stranded gang"
+    events = load_events(d)
+    quar = [e for e in events if e["event"] == "replica_quarantined"]
+    assert quar, "no replica_quarantined event:\n%s" % events
+    assert quar[0]["slot"] == 2 and quar[0]["rank"] == 2, quar
+    assert quar[0]["digest"] != quar[0]["majority"], quar
+    resizes = [
+        (e["from_world"], e["to_world"])
+        for e in events if e["event"] == "gang_resize"
+    ]
+    assert (3, 2) in resizes, "gang never resized around the corrupt rank"
+    # the quarantine drew from the preempt budget, not the crash budget
+    assert sup.restarts_used == 0, (
+        "SDC leaked into the crash budget: %d" % sup.restarts_used
+    )
+    # survivors converged to the clean fixed-gang reference
+    for r in (0, 1):
+        dpath = os.path.join(d, "digest_%d.txt" % r)
+        assert os.path.isfile(dpath), "survivor %d wrote no digest" % r
+        with open(dpath) as f:
+            got = f.read().strip()
+        assert got == ref_full, (
+            "survivor %d diverged\n  ref %s\n  got %s" % (r, ref_full, got)
+        )
+    # the corrupt rank never finished
+    assert not os.path.isfile(os.path.join(d, "digest_2.txt")), (
+        "the quarantined rank completed anyway"
+    )
+    # merged gang report tells the same story post-hoc
+    with open(os.path.join(d, "gang_report.json")) as f:
+        gang_report = json.load(f)
+    assert gang_report["sdc_quarantines"] == 1, gang_report
+    # quarantine-detection -> respawn MTTR
+    detect = None
+    mttr = []
+    for e in events:
+        if e["event"] == "replica_quarantined":
+            detect = e["ts_mono"]
+        elif e["event"] == "gang_start" and detect is not None:
+            mttr.append((e["ts_mono"] - detect) * 1000.0)
+            detect = None
+    print(
+        "sdc quarantine trial OK: rank 2 quarantined at digest step %d, "
+        "world 3 -> 2, survivors == reference, MTTR %s ms"
+        % (quar[0]["step"], [round(m) for m in mttr]),
+        flush=True,
+    )
+    return {
+        "quarantined_slot": quar[0]["slot"],
+        "vote_step": quar[0]["step"],
+        "resizes": resizes,
+        "mttr_ms": mttr,
+        "sdc_quarantines": gang_report["sdc_quarantines"],
+    }
+
+
+# -- health-fetch overhead bench --------------------------------------------
+
+def _overhead_bench(pairs=30, hidden=512, batch=2048):
+    """Interleaved A/B: the same MLP step with and without the attached
+    health fetch, alternating so machine drift hits both arms equally.
+    Returns {base_ms, health_ms, overhead_pct}."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.fluid.core as core
+    from paddle_tpu.distributed import guardian as _guardian
+
+    from ckpt_crash_probe import _build
+
+    def build(with_health):
+        main, startup, loss = _build(hidden=hidden)
+        partials = _guardian.attach_health_fetch(main) if with_health else []
+        scope = core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        return exe, main, scope, [loss] + partials
+
+    def batch_of(s):
+        r = np.random.RandomState(1000 + s)
+        return {
+            "x": r.rand(batch, 8).astype("float32"),
+            "y": r.randint(0, 4, (batch, 1)).astype("int64"),
+        }
+
+    arms = {"base": build(False), "health": build(True)}
+    # warmup compiles both arms
+    for exe, main, scope, fetches in arms.values():
+        exe.run(main, feed=batch_of(0), fetch_list=fetches, scope=scope)
+    times = {"base": [], "health": []}
+    for s in range(pairs):
+        feed = batch_of(s)
+        for name in ("base", "health"):
+            exe, main, scope, fetches = arms[name]
+            t0 = time.perf_counter()
+            outs = exe.run(main, feed=feed, fetch_list=fetches, scope=scope)
+            for o in outs:  # force every D2H — the guardian's real cost
+                float(np.asarray(o).ravel()[0])
+            times[name].append((time.perf_counter() - t0) * 1000.0)
+    base = sorted(times["base"])[pairs // 2]
+    health = sorted(times["health"])[pairs // 2]
+    return {
+        "pairs": pairs,
+        "hidden": hidden,
+        "batch": batch,
+        "base_ms": round(base, 3),
+        "health_ms": round(health, 3),
+        "overhead_pct": round((health - base) / base * 100.0, 3),
+    }
+
+
+# -- driver ------------------------------------------------------------------
+
+def run_probe(args):
+    import tempfile
+
+    tmp = args.workdir or tempfile.mkdtemp(prefix="train_guardian_probe_")
+    t0 = time.time()
+
+    # reference on the SURVIVING schedule: the same deterministic stream
+    # with the poisoned batch dropped (marker-seeded — the exact skip
+    # machinery under test, minus any fault)
+    ref_dir = os.path.join(tmp, "ref_surviving")
+    os.makedirs(ref_dir, exist_ok=True)
+    _seed_drop_marker(ref_dir, POISON_STEP)
+    ref, ref_rep, _ = _run_worker_proc(
+        ref_dir, _guardian_env(ref_dir), steps=args.steps,
+    )
+    assert ref_rep["train_anomalies"] == 0, ref_rep
+    print("surviving-schedule reference %s" % ref[:16], flush=True)
+
+    # 1. NaN -> detected within one step -> skip -> digest parity
+    d = os.path.join(tmp, "nan_skip")
+    dig, rep, _ = _run_worker_proc(
+        d,
+        _guardian_env(d, extra={
+            "FLAGS_chaos_nan_grad_at_step": str(POISON_STEP),
+            "FLAGS_chaos_marker_dir": os.path.join(d, "chaos_markers"),
+        }),
+        steps=args.steps,
+    )
+    assert rep["train_anomalies"] == 1, rep
+    assert rep["train_skipped_steps"] == 1, rep
+    assert rep["train_rollbacks"] == 0, rep
+    _assert_detection_at(d, POISON_STEP)
+    assert dig == ref, (
+        "nan-skip digest diverged\n  ref %s\n  got %s" % (ref, dig)
+    )
+    print("nan skip trial OK (detected at step %d, digest == reference)"
+          % POISON_STEP, flush=True)
+
+    # 2. loss spike -> robust-window detection -> skip -> digest parity
+    d = os.path.join(tmp, "spike_skip")
+    dig, rep, _ = _run_worker_proc(
+        d,
+        _guardian_env(d, extra={
+            "FLAGS_chaos_loss_spike_at_step": str(POISON_STEP),
+            "FLAGS_chaos_marker_dir": os.path.join(d, "chaos_markers"),
+        }),
+        steps=args.steps,
+    )
+    assert rep["train_anomalies"] == 1 and rep["train_skipped_steps"] == 1, rep
+    _assert_detection_at(d, POISON_STEP)
+    assert dig == ref, (
+        "spike-skip digest diverged\n  ref %s\n  got %s" % (ref, dig)
+    )
+    print("loss spike trial OK (digest == reference)", flush=True)
+
+    # 3. skip budget 0 -> rollback to the newest verified checkpoint,
+    # replay drops the poisoned batch, digest parity holds
+    d = os.path.join(tmp, "rollback")
+    dig, rep, _ = _run_worker_proc(
+        d,
+        _guardian_env(d, max_skips=0, extra={
+            "FLAGS_chaos_nan_grad_at_step": str(POISON_STEP),
+            "FLAGS_chaos_marker_dir": os.path.join(d, "chaos_markers"),
+        }),
+        steps=args.steps,
+    )
+    assert rep["train_rollbacks"] == 1, rep
+    assert rep["train_anomalies"] == 1, rep
+    assert rep["ckpt_scrub_ok"] > 0, rep
+    rollback_ms = rep["guardian_rollback_ms"]
+    _assert_detection_at(d, POISON_STEP)
+    assert dig == ref, (
+        "rollback digest diverged\n  ref %s\n  got %s" % (ref, dig)
+    )
+    print("rollback trial OK (MTTR %s ms, digest == reference)"
+          % rollback_ms.get("mean"), flush=True)
+
+    # 4. full-schedule reference + SDC quarantine gang
+    ref_full_dir = os.path.join(tmp, "ref_full")
+    ref_full, _, _ = _run_worker_proc(
+        ref_full_dir, _guardian_env(ref_full_dir), steps=GANG_STEPS,
+    )
+    sdc = _sdc_quarantine_trial(tmp, ref_full)
+
+    # 5. health-fetch overhead (interleaved medians)
+    bench = _overhead_bench(pairs=args.bench_pairs)
+    assert bench["overhead_pct"] < 2.0, (
+        "health fetch costs %.2f%% of the step (>= 2%%): %s"
+        % (bench["overhead_pct"], bench)
+    )
+    print("health-fetch overhead %.3f%% of a %.1f ms step"
+          % (bench["overhead_pct"], bench["base_ms"]), flush=True)
+
+    report = _finalize_report({
+        "trials": ["nan_skip", "spike_skip", "rollback", "sdc_quarantine"],
+        "poison_step": POISON_STEP,
+        "rollback_ms": rollback_ms,
+        "sdc": sdc,
+        "health_fetch": bench,
+        "wall_s": round(time.time() - t0, 1),
+    })
+    print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
+    print(
+        "PROBE PASS: NaN + spike each detected within one step and "
+        "recovered (skip and rollback digests == surviving-schedule "
+        "reference), rank 2 quarantined by digest vote (gang 3 -> 2, "
+        "survivors == clean reference), 0 steady-state recompiles "
+        "armed, health fetch %.2f%% of the CPU step (%.1fs)"
+        % (bench["overhead_pct"], report["wall_s"])
+    )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--dir", type=str, default=None)
+    ap.add_argument("--steps", type=int, default=STEPS)
+    ap.add_argument("--interval", type=int, default=INTERVAL)
+    ap.add_argument("--step_sleep_ms", type=float, default=0.0)
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 subset (fewer bench pairs)")
+    ap.add_argument("--bench_pairs", type=int, default=None)
+    ap.add_argument("--workdir", type=str, default=None)
+    args = ap.parse_args(argv)
+    if args.worker:
+        assert args.dir, "--worker needs --dir"
+        return run_worker(args)
+    if args.bench_pairs is None:
+        args.bench_pairs = 20 if args.fast else 60
+    return run_probe(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
