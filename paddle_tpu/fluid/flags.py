@@ -108,7 +108,9 @@ _DEFAULTS = {
     # gateway_admit_timeout_ms, then shed 429). gateway_drain_timeout_s
     # bounds the graceful drain (SIGTERM/stop waits for in-flight
     # streams before closing the listener); gateway_access_log appends
-    # one JSONL line per request to the given path ("" = off).
+    # one JSONL line per request to the given path ("" = off), rotated
+    # (keep-1 rollover to <path>.1) the moment it passes
+    # gateway_access_log_max_mb (0 = unbounded).
     "gateway_port": 0,
     "gateway_rate_limit_rps": 0.0,
     "gateway_rate_burst": 20,
@@ -117,6 +119,7 @@ _DEFAULTS = {
     "gateway_admit_timeout_ms": 100.0,
     "gateway_drain_timeout_s": 30.0,
     "gateway_access_log": "",
+    "gateway_access_log_max_mb": 0.0,
     # serving fleet control plane (paddle_tpu/serving/fleet.py): a
     # FleetController supervises N replica processes (each an
     # InferenceServer+Gateway) behind one Router. The load-driven
@@ -172,6 +175,20 @@ _DEFAULTS = {
     # on failure. 0 failures disables the breaker.
     "router_breaker_failures": 3,
     "router_breaker_cooldown_s": 2.0,
+    # the router's own JSONL access log (the fleet's PUBLIC front door:
+    # one line per request with trace_id, backend chosen, retries,
+    # failover count; "" = off), same writer + size rotation as the
+    # gateway's (router_access_log_max_mb, 0 = unbounded).
+    "router_access_log": "",
+    "router_access_log_max_mb": 0.0,
+    # distributed tracing (observability/trace.py + fleet_trace.py):
+    # trace_flight_records bounds the per-process flight-recorder ring
+    # (one journey record per request, dumped to FLAGS_obs_dir on
+    # drain/error/snapshot); trace_dump_spans bounds the black-box span
+    # dump (trace_rank_<r>.json) written beside it, the newest-N spans
+    # a dead process leaves for the fleet merge.
+    "trace_flight_records": 256,
+    "trace_dump_spans": 4096,
     # checkpoint manager (paddle_tpu/checkpoint): trainer-integrated save
     # cadence (0 = off), retention (newest keep_max steps survive GC,
     # every keep_every_n_steps-th step is pinned forever), writer-queue

@@ -15,6 +15,11 @@ as one spine over the whole reproduction:
 - ``xla_stats`` — device-plane telemetry: compile spans + recompile
   sentinel with cache-key attribution, per-program-key FLOP/HBM-byte
   census, device-memory gauges, strict serving compile gate
+- ``flight``    — per-request flight recorder: bounded journey-record
+  ring dumped to disk on drain/error (the telemetry that survives a
+  dead replica)
+- ``fleet_trace`` — merge N processes' ``/trace`` pulls into ONE
+  clock-aligned Perfetto timeline with cross-process span trees
 
 Submodules load lazily (PEP 562): ``trace`` sits on hot paths inside
 ``fluid`` itself, so this package must import without dragging the rest
@@ -23,7 +28,8 @@ of the stack in (and without import cycles through ``fluid.profiler``).
 
 import importlib
 
-_SUBMODULES = ("trace", "registry", "exporter", "aggregate", "xla_stats")
+_SUBMODULES = ("trace", "registry", "exporter", "aggregate", "xla_stats",
+               "flight", "fleet_trace")
 
 __all__ = list(_SUBMODULES)
 

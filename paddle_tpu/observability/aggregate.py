@@ -36,6 +36,9 @@ from . import registry as _registry
 __all__ = [
     "GANG_REPORT",
     "FLEET_REPORT",
+    "iter_obs_dumps",
+    "read_flight_records",
+    "slowest_requests",
     "read_rank_snapshots",
     "read_replica_snapshots",
     "gang_report",
@@ -278,6 +281,75 @@ _REPLICA_COUNTERS = (
 )
 
 
+_FLIGHT_DUMP = re.compile(r"^flight_rank_\d+\.json$")
+
+
+def iter_obs_dumps(obs_root, pattern):
+    """Yield ``(subdir, filename, path)`` for every dump whose filename
+    fully matches ``pattern`` (a compiled regex) under ``obs_root``: the
+    root itself (``subdir == ""``) plus ONE level of subdirectories —
+    the fleet layout (``replica_<id>/`` dirs, and a ``controller/`` dir
+    when the router keeps its obs out of the root). The single walker
+    both the flight-record reader and ``fleet_trace.find_trace_dumps``
+    use, so the layout knowledge cannot drift between them. Unreadable
+    or concurrently-removed dirs skip — never raise; a half-dead obs
+    tree is this code's NORMAL operating condition."""
+    try:
+        names = sorted(os.listdir(str(obs_root)))
+    except OSError:
+        return
+    for name in names:
+        p = os.path.join(str(obs_root), name)
+        if pattern.match(name):
+            yield "", name, p
+        elif os.path.isdir(p):
+            try:
+                subs = sorted(os.listdir(p))
+            except OSError:
+                continue
+            for sub in subs:
+                if pattern.match(sub):
+                    yield name, sub, os.path.join(p, sub)
+
+
+def read_flight_records(obs_root):
+    """[(source_label, record), ...] from every flight-recorder dump
+    (``flight_rank_*.json``) under ``obs_root``: the root itself (the
+    controller/router process, labelled ``controller``) plus one level
+    of subdirectories (``replica_<id>/`` and a controller dir, labelled
+    by dir name). Torn or missing dumps read as empty."""
+    from . import flight as _flight
+
+    out = []
+    for subdir, _fn, path in iter_obs_dumps(obs_root, _FLIGHT_DUMP):
+        for rec in _flight.load(path):
+            out.append((subdir or "controller", rec))
+    return out
+
+
+def slowest_requests(obs_root, top=10, replicas=None):
+    """The fleet's slowest requests across every process's flight
+    recorder, slowest first — each row keeps its journey facts
+    (trace_id, backend, retries/failovers, admission wait, windows,
+    ticks) plus which process recorded it. The table an operator reads
+    FIRST in a latency incident: it names the trace_id to pull from
+    the merged fleet trace. ``replicas=`` scopes ``replica_<id>``
+    sources to those ids (a reused workdir keeps dead runs' replica
+    dirs; their dumps must not name trace_ids the current fleet never
+    saw); non-replica sources (the controller) always pass."""
+    rows = []
+    for label, rec in read_flight_records(obs_root):
+        m = _REPLICA_DIR.match(label)
+        if replicas is not None and m and int(m.group(1)) not in replicas:
+            continue
+        ms = rec.get("ms")
+        if not isinstance(ms, (int, float)):
+            continue
+        rows.append(dict(rec, process=label))
+    rows.sort(key=lambda r: -float(r["ms"]))
+    return rows[:int(top)]
+
+
 def read_replica_snapshots(obs_root):
     """{replica_id: newest snapshot dict} from ``replica_<id>/`` dirs
     under ``obs_root`` (each replica process writes the standard
@@ -419,6 +491,13 @@ def fleet_report(workdir, obs_root=None):
         "per_replica": summaries,
         "steady_recompiles": sum(
             s["steady_recompiles"] for s in summaries.values()
+        ),
+        # the flight recorders' fleet-wide slowest-requests table (the
+        # journey record of each: trace_id, backend, retries, admission
+        # wait, windows/ticks) — empty when no process dumped yet;
+        # replica sources scoped to THIS run, like the snapshots above
+        "slowest_requests": slowest_requests(
+            obs_root, replicas=spawned if spawned else None
         ),
     }
 

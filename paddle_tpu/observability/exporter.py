@@ -36,10 +36,12 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..fluid import flags as _flags
 from ..fluid import profiler as _profiler
+from . import flight as _flight
 from . import registry as _registry
 from . import trace as _trace
 
@@ -49,6 +51,7 @@ __all__ = [
     "global_exporter",
     "stop_global",
     "final_snapshot",
+    "dump_blackbox",
 ]
 
 
@@ -90,8 +93,15 @@ def _make_handler(exporter):
                         "application/json",
                     )
                 elif path == "/trace":
+                    # ?trace_id= narrows the pull to one request's spans
+                    # (fleet_trace.py and foreign consumers negotiate on
+                    # the payload's schema_version stamp)
+                    qs = urllib.parse.parse_qs(
+                        self.path.partition("?")[2]
+                    )
+                    tid = (qs.get("trace_id") or [None])[0]
                     self._send(
-                        200, json.dumps(_trace.chrome_trace()),
+                        200, json.dumps(_trace.chrome_trace(trace_id=tid)),
                         "application/json",
                     )
                 elif path == "/compiles":
@@ -242,17 +252,30 @@ class Exporter(object):
 
     def healthz(self):
         draining = (not self._healthy) or self._stop.is_set() or _preempting()
+        # the clock-anchor pair rides every health answer: ts is wall,
+        # ts_mono the SAME clock spans record — what fleet_trace.py
+        # aligns per-process trace timelines with (and the NTP-style
+        # skew estimate reads ts against the puller's own clock)
+        anchor = _trace.clock_anchor()
         return {
             "status": "draining" if draining else "ok",
             "rank": self.rank,
             "pid": os.getpid(),
-            "ts": time.time(),
+            "ts": anchor["ts"],
+            "ts_mono": anchor["ts_mono"],
         }
 
     # -- snapshots -----------------------------------------------------------
     def write_snapshot(self):
         if not self.snapshot_dir:
             raise RuntimeError("exporter has no snapshot dir")
+        # the black box rides the snapshot cadence: a replica that is
+        # later SIGKILLed leaves at most one interval's worth of spans
+        # and flight records unrecorded on disk. Dumped BEFORE the
+        # registry snapshot so the dump's own counter bumps are inside
+        # it — a quiescent process's snapshot must equal its live
+        # counters exactly (the obs probe's round-trip bar).
+        dump_blackbox(self.snapshot_dir, rank=self.rank)
         return _registry.write_snapshot(self.snapshot_dir, rank=self.rank)
 
     def _snapshot_loop(self):
@@ -314,11 +337,53 @@ def final_snapshot():
     """Write one registry snapshot for this rank if FLAGS_obs_dir is set
     — works with or without a running exporter (the trainer calls this
     in its ``finally`` so even a worker that never started HTTP leaves
-    the per-rank record the gang aggregator merges)."""
+    the per-rank record the gang aggregator merges). The flight-recorder
+    and span-dump black boxes ride along: drain/SIGTERM teardowns all
+    funnel through here, which is exactly when the post-mortem record
+    must hit disk."""
     snap_dir = str(_flags.get_flag("obs_dir", "") or "")
     if not snap_dir:
         return None
+    # black box FIRST, same ordering invariant as the snapshot loop:
+    # the dump's own counter bumps must land inside the snapshot, so a
+    # quiescent process's final snapshot equals its live counters
+    dump_blackbox(snap_dir)
     try:
-        return _registry.write_snapshot(snap_dir)
+        path = _registry.write_snapshot(snap_dir)
+    except OSError:
+        path = None
+    return path
+
+
+def trace_dump_path(dirname, rank=None):
+    return os.path.join(
+        str(dirname), "trace_rank_%d.json" % _trace.gang_rank(rank)
+    )
+
+
+def dump_blackbox(dirname=None, rank=None):
+    """Persist the post-mortem pair for this process into ``dirname``
+    (default FLAGS_obs_dir): the flight-recorder ring
+    (``flight_rank_<r>.json``) and a bounded span dump
+    (``trace_rank_<r>.json``, the newest ``FLAGS_trace_dump_spans``
+    spans as a standard /trace payload). Atomic whole-file replaces —
+    newest state wins — so fleet_trace.py can merge a process that can
+    no longer be pulled over HTTP. Never raises."""
+    dirname = dirname or str(_flags.get_flag("obs_dir", "") or "")
+    if not dirname:
+        return None
+    _flight.dump(dirname, rank=rank)
+    try:
+        cap = max(int(_flags.get_flag("trace_dump_spans", 4096)), 1)
+    except (TypeError, ValueError):
+        cap = 4096
+    try:
+        os.makedirs(str(dirname), exist_ok=True)
+        path = trace_dump_path(dirname, rank=rank)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(_trace.chrome_trace(newest=cap), f)
+        os.replace(tmp, path)
+        return path
     except OSError:
         return None

@@ -68,7 +68,7 @@ _DISPATCH_MARGIN_S = 0.002
 
 class _Request(object):
     __slots__ = ("inputs", "rows", "sig", "enqueue_t", "deadline_t",
-                 "event", "result", "error", "seq_plan")
+                 "event", "result", "error", "seq_plan", "trace_ctx")
 
     def __init__(self, inputs, rows, sig, deadline_t):
         self.seq_plan = None  # set by the server's seq-bucket alignment
@@ -80,6 +80,11 @@ class _Request(object):
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # distributed-trace hand-off: captured on the SUBMITTING thread
+        # (the gateway handler's ambient trace_scope), read by the
+        # dispatch worker so the coalesced batch's span can name every
+        # request it served — None outside a scope
+        self.trace_ctx = _trace.current_context()
 
     def complete(self, result=None, error=None):
         self.result = result
@@ -279,9 +284,19 @@ class MicroBatcher(object):
             rows = sum(r.rows for r in live)
             # dispatch span on this batcher worker's trace row: covers
             # stacking + the runner (whose predictor_run span nests
-            # inside), so queue time vs device time separate cleanly
+            # inside), so queue time vs device time separate cleanly.
+            # A coalesced batch serves SEVERAL requests' traces at once,
+            # so the span carries every member's trace_id (the merge
+            # tool attaches shared-work spans by this list) instead of
+            # adopting any single request's context.
+            # tid collection skipped when span recording is off — the
+            # requests still carry ids for the round-trip surfaces, but
+            # a disarmed tracer must not tax every dispatched batch
+            tids = (sorted({r.trace_ctx[0] for r in live if r.trace_ctx})
+                    if _trace.enabled() else [])
             with _trace.span("serving_dispatch", cat="serving",
-                             rows=rows, requests=len(live)):
+                             rows=rows, requests=len(live),
+                             **({"trace_ids": tids} if tids else {})):
                 stacked = [
                     np.concatenate([r.inputs[i] for r in live], axis=0)
                     if len(live) > 1 else live[0].inputs[i]

@@ -794,6 +794,13 @@ class GenerationStream(object):
         self.ttft_ms = None
         self.cached_prefix_tokens = 0
         self.admit_windows = 0
+        # distributed-trace hand-off: the stream is constructed on the
+        # SUBMITTING thread (the gateway handler inside its
+        # trace_scope); the engine loop re-enters this context around
+        # the slot's prefill windows and lists the trace_id on every
+        # decode tick the slot is active in — the engine-side spans of
+        # the request's cross-process tree
+        self.trace_ctx = _trace.current_context()
         self._t_submit = time.monotonic()
         self._t_last_emit = None
         self._q = queue.Queue()
@@ -895,6 +902,15 @@ class GenerationStream(object):
         On a resume form this includes the resumed suffix, so the result
         is the SAME full sequence the uninterrupted run returns."""
         return self.prompt_ids + self.resume_tokens + self.tokens(timeout)
+
+
+def _stream_scope(stream):
+    """The ambient trace context of one request's stream, re-entered on
+    the engine loop thread so the slot's prefill/copy/publish spans join
+    the request's distributed tree. A no-op scope for untraced streams
+    (duck-typed fakes included)."""
+    ctx = getattr(stream, "trace_ctx", None) or (None, None)
+    return _trace.trace_scope(*ctx)
 
 
 class _Slot(object):
@@ -1428,7 +1444,8 @@ class DecodeEngine(object):
                 entries = entries[:keep]
             try:
                 if entries:
-                    with _xla_stats.serving_request_window():
+                    with _stream_scope(stream), \
+                            _xla_stats.serving_request_window():
                         for j, e in enumerate(entries):
                             self.session.prefix_copy_in(
                                 slot_idx, j * self.prefix_block,
@@ -1459,7 +1476,8 @@ class DecodeEngine(object):
             stream.admit_windows = len(wins)
             job = _PrefillJob(stream, wins, prefix_tokens)
             if len(wins) == 1:
-                self._run_prefill_window(slot_idx, job)
+                with _stream_scope(stream):
+                    self._run_prefill_window(slot_idx, job)
             else:
                 # chunked: the first window runs via _advance_prefills
                 # on THIS tick; in-flight streams decode between windows.
@@ -1482,7 +1500,9 @@ class DecodeEngine(object):
         if not self._prefilling:
             return
         slot_idx = next(iter(self._prefilling))
-        self._run_prefill_window(slot_idx, self._prefilling[slot_idx])
+        job = self._prefilling[slot_idx]
+        with _stream_scope(job.stream):
+            self._run_prefill_window(slot_idx, job)
 
     def _run_prefill_window(self, slot_idx, job):
         """Advance ``job`` by one window; on the prompt's final window,
@@ -1630,8 +1650,25 @@ class DecodeEngine(object):
             # (position 0) would corrupt the row head and poison blocks
             # later published to the prefix store.
             positions[idx] = job.windows[job.wi][0]
-        with _xla_stats.serving_request_window():
-            logits = sess.decode_step(tokens, positions, active)
+        # a fused tick decodes EVERY traced stream at once: annotate it
+        # with the slots' trace ids (like the batcher's dispatch span)
+        # so each request's merged tree shows the ticks it rode —
+        # skipped entirely for untraced traffic (greedy_generate et al.)
+        # and when span recording is off (gateway streams always carry
+        # trace ids for the header/log round-trip, but a disarmed
+        # tracer must cost the tick loop nothing)
+        tids = sorted({
+            s.stream.trace_ctx[0] for s in self._active.values()
+            if getattr(s.stream, "trace_ctx", None)
+        }) if _trace.enabled() else None
+        if tids:
+            with _trace.span("decode_tick", cat="serving",
+                             tick=self.tick, trace_ids=tids), \
+                    _xla_stats.serving_request_window():
+                logits = sess.decode_step(tokens, positions, active)
+        else:
+            with _xla_stats.serving_request_window():
+                logits = sess.decode_step(tokens, positions, active)
         self.tick += 1
         for idx in list(self._active.keys()):
             slot = self._active[idx]

@@ -77,8 +77,10 @@ from ..fluid import flags as _flags
 from ..fluid import profiler as _profiler
 from ..testing import chaos as _chaos
 from ..observability import exporter as _obs_exporter
+from ..observability import flight as _flight
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
+from .access_log import AccessLog
 from .batcher import (
     DeadlineExceededError,
     ServerOverloadedError,
@@ -310,29 +312,9 @@ class _Admission(object):
             self._cond.notify_all()
 
 
-# -- access log --------------------------------------------------------------
-
-
-class _AccessLog(object):
-    """Append-only JSONL access log; one locked single-write per line
-    (concurrent handler threads at worst interleave whole lines, the
-    same contract as registry.write_snapshot). Disabled when pathless;
-    a full disk must not fail requests."""
-
-    def __init__(self, path):
-        self.path = str(path) if path else None
-        self._lock = threading.Lock()
-
-    def write(self, record):
-        if not self.path:
-            return
-        line = json.dumps(record, sort_keys=True) + "\n"
-        try:
-            with self._lock, open(self.path, "a") as f:
-                f.write(line)
-        except OSError:
-            pass
-
+# the JSONL access-log writer (with size-based rotation) moved to
+# serving/access_log.py — one helper shared with the router's front
+# door, so the two logs can never drift apart in format or bounding
 
 _request_ids = itertools.count(1)  # .__next__ atomic under the GIL
 
@@ -396,7 +378,8 @@ class Gateway(object):
                  rate_limit_rps=None, rate_burst=None,
                  tenant_max_inflight=None, max_inflight=None,
                  admit_timeout_ms=None, drain_timeout_s=None,
-                 access_log=None, extra_headers=None):
+                 access_log=None, access_log_max_mb=None,
+                 extra_headers=None):
         self.server = server
         self.host = host
         # static response headers stamped on every reply (fleet
@@ -414,7 +397,10 @@ class Gateway(object):
             _flag("gateway_max_inflight", max_inflight),
             _flag("gateway_admit_timeout_ms", admit_timeout_ms),
         )
-        self.access_log = _AccessLog(_flag("gateway_access_log", access_log))
+        self.access_log = AccessLog(
+            _flag("gateway_access_log", access_log),
+            max_mb=_flag("gateway_access_log_max_mb", access_log_max_mb),
+        )
         self._httpd = None
         self._http_thread = None
         self._started = False
@@ -570,6 +556,10 @@ class Gateway(object):
         if self._http_thread is not None:
             self._http_thread.join(timeout=5.0)
         self._httpd = None
+        # the drain is a terminal moment for this process's serving
+        # life: leave the flight-recorder/trace black box on disk (no-op
+        # when FLAGS_obs_dir is unarmed)
+        _obs_exporter.dump_blackbox()
         if self._inflight_gauge is not None:
             _obs_registry.unregister_gauge("gateway_inflight",
                                            self._inflight_gauge)
@@ -644,6 +634,11 @@ def _make_handler(gw):
             if close:
                 self.send_header("Connection", "close")
                 self.close_connection = True
+            # every response names its distributed trace: the client
+            # (or the router relaying this) correlates the answer with
+            # the merged fleet trace by this one header
+            if getattr(self, "_trace_id", None):
+                self.send_header("X-Trace-Id", self._trace_id)
             for k, v in gw.extra_headers.items():
                 self.send_header(k, v)
             for k, v in headers:
@@ -717,11 +712,18 @@ def _make_handler(gw):
 
         # -- GET: health/readiness ------------------------------------------
         def do_GET(self):
+            # the handler object persists across a kept-alive
+            # connection: a previous POST's trace id must not leak onto
+            # a health probe's response
+            self._trace_id = None
             path = self.path.split("?", 1)[0]
             if path == "/healthz":
-                # liveness: the process is up and handling sockets
-                self._send_json(200, {"status": "alive",
-                                      "pid": os.getpid()})
+                # liveness: the process is up and handling sockets —
+                # plus the clock-anchor pair (ts wall / ts_mono span
+                # clock) fleet_trace.py aligns this process's spans with
+                self._send_json(200, dict(
+                    {"status": "alive", "pid": os.getpid()},
+                    **_trace.clock_anchor()))
             elif path == "/readyz":
                 if gw.draining():
                     self._send_json(503, {"status": "draining"})
@@ -736,6 +738,9 @@ def _make_handler(gw):
 
         # -- POST: the serving endpoints ------------------------------------
         def do_POST(self):
+            # same kept-alive hygiene as do_GET: a previous request's
+            # trace id must not stamp an unmatched route's 404
+            self._trace_id = None
             path = self.path.split("?", 1)[0]
             if path == "/v1/infer":
                 self._serve(path, self._infer)
@@ -749,14 +754,29 @@ def _make_handler(gw):
             """Shared request wrapper: drain gate, body read (BEFORE
             admission — an admitted inflight slot must never wait on a
             trickling client body), admission control, span, metrics,
-            access log, error->status mapping."""
+            access log, error->status mapping.
+
+            Distributed trace: an incoming W3C ``traceparent`` (the
+            router's, or any foreign caller's) is ADOPTED — this hop's
+            ``gateway_request`` span becomes a child of the remote span
+            and every engine-side span opened under the scope inherits
+            the trace — and a gateway fronted directly mints its own.
+            The id goes back out on ``X-Trace-Id``, the SSE terminal
+            events, the access-log line, and the flight record."""
             tenant, priority, rid = self._request_meta()
+            tp = _trace.parse_traceparent(self.headers.get("traceparent"))
+            trace_id, parent_span = tp if tp else (_trace.new_trace_id(),
+                                                  None)
+            self._trace_id = trace_id
+            self._parent_span = parent_span
+            self._span_id = None
             t0 = time.monotonic()
             # reset BEFORE any _log call (including the draining-reject
             # below): the handler object is reused across a kept-alive
             # connection, and a stale stash from the previous request
             # must never leak into this request's access-log line
             self._log_extra = None
+            self._flight_extra = None
             _profiler.bump_counter("gateway_requests")
             _profiler.bump_counter("gateway_tenant_requests_"
                                    + _tenant_slug(tenant))
@@ -770,9 +790,12 @@ def _make_handler(gw):
                 return
             status, reason, tokens = 500, None, None
             try:
-                with _trace.span("gateway_request", cat="gateway",
-                                 endpoint=endpoint, tenant=tenant,
-                                 request_id=rid, priority=priority) as sp:
+                with _trace.trace_scope(trace_id, parent_span), \
+                        _trace.span("gateway_request", cat="gateway",
+                                    endpoint=endpoint, tenant=tenant,
+                                    request_id=rid,
+                                    priority=priority) as sp:
+                    self._span_id = sp.span_id
                     try:
                         body = self._read_body()
                     except _PayloadTooLarge as e:
@@ -790,6 +813,10 @@ def _make_handler(gw):
                                               "request_id": rid},
                                         close=True)
                         return
+                    # journey facts for the flight record: queue depth
+                    # as seen AT entry and how long admission held us
+                    inflight_at_entry = gw.admission.total_inflight
+                    t_adm = time.monotonic()
                     try:
                         gw.admission.admit(tenant, priority)
                     except _AdmissionDenied as e:
@@ -798,6 +825,12 @@ def _make_handler(gw):
                         self._send_shed_429(tenant, rid, e.reason,
                                             e.retry_after_ms, str(e))
                         return
+                    finally:
+                        self._flight_extra = {
+                            "admit_wait_ms": round(
+                                (time.monotonic() - t_adm) * 1e3, 3),
+                            "inflight_at_entry": inflight_at_entry,
+                        }
                     try:
                         status, reason, tokens = fn(tenant, rid, body)
                     finally:
@@ -842,6 +875,12 @@ def _make_handler(gw):
                 "status": int(status),
                 "ms": round((time.monotonic() - t0) * 1e3, 3),
             }
+            if getattr(self, "_trace_id", None):
+                rec["trace_id"] = self._trace_id
+                if self._span_id:
+                    rec["span_id"] = self._span_id
+                if self._parent_span:
+                    rec["parent_span_id"] = self._parent_span
             if reason:
                 rec["reason"] = reason
             if tokens is not None:
@@ -850,6 +889,13 @@ def _make_handler(gw):
             if extra:
                 rec.update(extra)
             gw.access_log.write(rec)
+            # the same record is this request's flight-recorder entry
+            # (plus the admission journey facts) — one shape, two
+            # sinks, so the black box and the log can never disagree
+            fx = getattr(self, "_flight_extra", None)
+            _flight.note(dict(rec, **fx) if fx else rec)
+            if status >= 500:
+                _flight.dump_on_error()
 
         # -- /v1/infer -------------------------------------------------------
         def _infer(self, tenant, rid, body):
@@ -979,17 +1025,19 @@ def _make_handler(gw):
                 return 200, None, len(toks)
             return self._stream_sse(stream, tenant, rid, timeout)
 
-        @staticmethod
-        def _resume_state(stream, sent):
+        def _resume_state(self, stream, sent):
             """The reconstruction state every generate done/error event
             carries: how many tokens of the LOGICAL generation are out
             (the resumed suffix plus this stream's emissions) and the
             determinism knobs — enough for any caller (the router's
             failover path, or an end client) to build the next resume
-            request without having tracked anything but the tokens."""
+            request without having tracked anything but the tokens.
+            ``trace_id`` rides along so the terminal event correlates
+            with the merged fleet trace even when the headers are long
+            gone (a buffered SSE consumer)."""
             # getattr like _stash_gen_facts: duck-typed stream fakes
             # (tests, bespoke servers) must not break the error path
-            return {
+            state = {
                 "emitted_count": (
                     len(getattr(stream, "resume_tokens", ()) or ())
                     + int(sent)
@@ -999,6 +1047,9 @@ def _make_handler(gw):
                 "top_k": getattr(stream, "top_k", 0),
                 "top_p": getattr(stream, "top_p", 0.0),
             }
+            if getattr(self, "_trace_id", None):
+                state["trace_id"] = self._trace_id
+            return state
 
         def _stash_gen_facts(self, stream, fallback_ttft_ms=None):
             """Engine-stamped latency + prefix-cache facts, derived ONCE
@@ -1022,6 +1073,12 @@ def _make_handler(gw):
                 "resumed_tokens": len(getattr(
                     stream, "resume_tokens", ()) or ()),
             }
+            # engine-tick journey fact for the flight record: how many
+            # fused decode ticks this generation spanned
+            ft = getattr(stream, "first_tick", None)
+            lt = getattr(stream, "last_tick", None)
+            if ft is not None and lt is not None:
+                facts["ticks_spanned"] = int(lt) - int(ft) + 1
             self._log_extra = facts
             return facts
 
@@ -1035,6 +1092,8 @@ def _make_handler(gw):
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
             self.send_header("X-Request-Id", rid)
+            if getattr(self, "_trace_id", None):
+                self.send_header("X-Trace-Id", self._trace_id)
             for k, v in gw.extra_headers.items():
                 self.send_header(k, v)
             self.end_headers()

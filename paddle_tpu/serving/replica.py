@@ -146,7 +146,13 @@ def main(argv=None):
     ).start()
     gw.install_sigterm()
 
+    from paddle_tpu.observability import trace as _trace
+
     exp = _obs_exporter.global_exporter()
+    # the clock-anchor pair (ts wall / ts_mono span clock) rides the
+    # endpoint file so the controller can align this replica's trace
+    # timeline even before (or without) pulling its /healthz
+    anchor = _trace.clock_anchor()
     _write_endpoint(args.endpoint_file, {
         "pid": os.getpid(),
         "replica_id": str(args.replica_id),
@@ -155,7 +161,8 @@ def main(argv=None):
         "gateway_port": gw.port,
         "metrics_port": exp.port if exp is not None else None,
         "warmed": warmup is not None,
-        "ts": time.time(),
+        "ts": anchor["ts"],
+        "ts_mono": anchor["ts_mono"],
     })
 
     hb = _supervisor.worker_heartbeat()

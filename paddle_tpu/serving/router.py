@@ -56,8 +56,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..fluid import flags as _flags
 from ..fluid import profiler as _profiler
 from ..observability import exporter as _obs_exporter
+from ..observability import flight as _flight
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
+from .access_log import AccessLog
 from .gateway import _MAX_BODY_BYTES
 
 __all__ = ["Backend", "Router", "probe_readyz"]
@@ -287,9 +289,17 @@ class Router(object):
     def __init__(self, port=None, host="127.0.0.1", health_interval_s=None,
                  retries=None, backend_timeout_s=None,
                  generate_retries=None, breaker_failures=None,
-                 breaker_cooldown_s=None):
+                 breaker_cooldown_s=None, access_log=None,
+                 access_log_max_mb=None):
         self.host = host
         self.port_requested = int(_flag("router_port", port))
+        # the fleet's PUBLIC front door logs one JSONL line per request
+        # (FLAGS_router_access_log; same writer + size rotation as the
+        # gateway's): trace_id, backend chosen, retries, failover count
+        self.access_log = AccessLog(
+            _flag("router_access_log", access_log),
+            max_mb=_flag("router_access_log_max_mb", access_log_max_mb),
+        )
         self.health_interval_s = float(
             _flag("router_health_interval_s", health_interval_s)
         )
@@ -385,6 +395,9 @@ class Router(object):
             _obs_registry.unregister_gauge("router_breaker_open",
                                            self._breaker_gauge)
             self._breaker_gauge = None
+        # terminal moment for the front door: persist the flight
+        # recorder + span black box (no-op when FLAGS_obs_dir unarmed)
+        _obs_exporter.dump_blackbox()
 
     def __enter__(self):
         return self if self._started else self.start()
@@ -595,6 +608,11 @@ def _make_handler(router):
             if close:
                 self.send_header("Connection", "close")
                 self.close_connection = True
+            # the router is authoritative for the trace id (it minted
+            # or adopted it): stamp every response, including sheds
+            # that never reached a replica
+            if getattr(self, "_trace_id", None):
+                self.send_header("X-Trace-Id", self._trace_id)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
@@ -625,14 +643,25 @@ def _make_handler(router):
                 v = self.headers.get(k)
                 if v is not None:
                     out[k] = v
+            # context propagation: every hop of this request — first
+            # attempt, infer retry, generate-resume re-admission —
+            # carries the SAME trace_id with the router's span as the
+            # remote parent, so the replicas' spans all join one tree
+            if getattr(self, "_fwd_traceparent", None):
+                out["traceparent"] = self._fwd_traceparent
             return out
 
         # -- GET -------------------------------------------------------------
         def do_GET(self):
+            self._trace_id = None  # kept-alive reuse: no stale stamp
             path = self.path.split("?", 1)[0]
             if path == "/healthz":
-                self._send_json(200, {"status": "alive",
-                                      "pid": os.getpid()})
+                # liveness + the clock-anchor pair fleet_trace.py uses
+                # to align this process's spans (ts_mono is the span
+                # clock, ts the wall it maps to)
+                self._send_json(200, dict(
+                    {"status": "alive", "pid": os.getpid()},
+                    **_trace.clock_anchor()))
             elif path == "/readyz":
                 n = router.ready_count()
                 if n > 0:
@@ -652,17 +681,36 @@ def _make_handler(router):
 
         # -- POST ------------------------------------------------------------
         def do_POST(self):
+            self._trace_id = None
+            self._fwd_traceparent = None
             path = self.path.split("?", 1)[0]
             if path not in ("/v1/infer", "/v1/generate"):
                 self._send_json(404, {"error": "not found"}, close=True)
                 return
+            # the fleet's front door owns the trace: adopt a caller's
+            # W3C traceparent (a foreign mesh tracing through us) or
+            # mint a fresh trace_id; every hop this request makes —
+            # retries and mid-stream failover resumes included — reuses
+            # the SAME id
+            tp = _trace.parse_traceparent(self.headers.get("traceparent"))
+            trace_id, remote_parent = tp if tp else (
+                _trace.new_trace_id(), None
+            )
+            self._trace_id = trace_id
+            # journey facts for the access log + flight recorder
+            self._journey = {"backend": None, "retries": 0,
+                             "failovers": 0}
             try:
                 body = self._read_body()
             except _PayloadTooLarge as e:
+                # rejects are logged too — "one line per request" means
+                # abuse traffic is visible in the log, like the gateway
                 self._send_json(413, {"error": str(e)}, close=True)
+                self._log_request(path, 413, time.monotonic())
                 return
             except ValueError as e:
                 self._send_json(400, {"error": str(e)}, close=True)
+                self._log_request(path, 400, time.monotonic())
                 return
             # parse ONCE at receipt: the deadline clock starts here (the
             # router's own queue/forward time draws the client's budget
@@ -675,14 +723,29 @@ def _make_handler(router):
             _profiler.bump_counter("router_requests")
             t0 = time.monotonic()
             try:
-                with _trace.span("router_request", cat="router",
-                                 endpoint=path):
+                with _trace.trace_scope(trace_id, remote_parent), \
+                        _trace.span("router_request", cat="router",
+                                    endpoint=path) as sp:
+                    # propagation must not depend on the ring buffer
+                    # being armed: with the tracer flagged off the span
+                    # records nothing, but the hops still need a parent
+                    # id so the replicas' ids stay consistent. Prefer
+                    # the caller's remote parent then — the replicas'
+                    # spans chain to a span that really exists (in the
+                    # foreign mesh) instead of a fabricated id
+                    self._fwd_traceparent = _trace.format_traceparent(
+                        trace_id,
+                        sp.span_id or remote_parent or os.urandom(8).hex(),
+                    )
                     if path == "/v1/infer":
                         status = self._proxy_json(path, body, parsed,
                                                   t_recv, deadline_ms)
                     else:
                         status = self._proxy_generate(body, parsed,
                                                       t_recv, deadline_ms)
+                    if sp.args is not None:
+                        sp.args["status"] = status
+                        sp.args["backend"] = self._journey["backend"]
             except ConnectionError:
                 status = 499  # client went away; nothing left to write
             except Exception as e:  # the handler thread must survive
@@ -695,6 +758,32 @@ def _make_handler(router):
                 _profiler.bump_histogram(
                     "router_latency_ms", (time.monotonic() - t0) * 1e3
                 )
+            self._log_request(path, status, t0)
+
+        def _log_request(self, endpoint, status, t0):
+            """One JSONL access-log line + one flight-recorder record
+            per proxied request: the trace id, which backend answered,
+            how many transparent retries and mid-stream failovers the
+            client never saw. The router's log is what an operator
+            greps FIRST — it names the replica to look at next."""
+            j = getattr(self, "_journey", None) or {}
+            rec = {
+                "ts": time.time(),
+                "endpoint": endpoint,
+                "status": int(status) if status is not None else None,
+                "ms": round((time.monotonic() - t0) * 1e3, 3),
+                "trace_id": self._trace_id,
+                "backend": j.get("backend"),
+                "retries": j.get("retries", 0),
+                "failovers": j.get("failovers", 0),
+            }
+            rid = self.headers.get("X-Request-Id")
+            if rid:
+                rec["request_id"] = rid
+            router.access_log.write(rec)
+            _flight.note(rec)
+            if status is not None and status >= 500:
+                _flight.dump_on_error()
 
         @staticmethod
         def _parse_json(body):
@@ -790,6 +879,8 @@ def _make_handler(router):
             headers = [(k, resp.headers[k]) for k in _RELAY_HEADERS
                        if k in resp.headers and k != "Content-Type"]
             headers.append(("X-Routed-Backend", backend_id))
+            if getattr(self, "_trace_id", None):
+                headers.append(("X-Trace-Id", self._trace_id))
             ctype = resp.headers.get("Content-Type", "application/json")
             self.send_response(resp.status)
             self.send_header("Content-Type", ctype)
@@ -822,8 +913,10 @@ def _make_handler(router):
                 if b is None:
                     return self._no_backend()
                 tried.add(b.id)
+                self._journey["backend"] = b.id
                 if attempt:
                     _profiler.bump_counter("router_retries")
+                    self._journey["retries"] += 1
                 handed_off = False
                 try:
                     conn, resp = self._backend_request(b, path, fwd)
@@ -1011,6 +1104,8 @@ def _make_handler(router):
                     self.send_header(k, resp.headers[k])
             self.send_header("Transfer-Encoding", "chunked")
             self.send_header("X-Routed-Backend", b.id)
+            if getattr(self, "_trace_id", None):
+                self.send_header("X-Trace-Id", self._trace_id)
             self.end_headers()
             # tokens a client-sent resume form already covers: the
             # failover's resume body and emitted_count attribution both
@@ -1140,6 +1235,17 @@ def _make_handler(router):
                             reason = "failover budget exhausted"
                         continue
                     _profiler.bump_counter("router_generate_failovers")
+                    self._journey["failovers"] += 1
+                    self._journey["backend"] = nb.id
+                    # the failover seam as a TRACE event: an instant
+                    # mark inside the router span's context naming both
+                    # replicas — the merged fleet trace links the dead
+                    # backend's segment to the survivor's through it
+                    _trace.instant(
+                        "generate_failover", cat="router",
+                        from_backend=cur.id, to_backend=nb.id,
+                        resume_at=base + len(captured),
+                    )
                     try:
                         # attributable seam: an SSE COMMENT frame (":"
                         # prefix — every spec-compliant parser ignores

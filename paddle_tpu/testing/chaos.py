@@ -353,6 +353,18 @@ def on_stream_token():
                os.getpid()),
             flush=True,
         )
+        # flush the observability black box (flight ring + bounded span
+        # dump) before dying: a REAL SIGKILL loses at most one snapshot
+        # interval of telemetry, but a staged death must replay
+        # deterministically — the failover trial asserts on the
+        # victim's trace segment, so the harness closes that interval
+        # gap itself. Best-effort; the kill happens regardless.
+        try:
+            from ..observability import exporter as _obs_exporter
+
+            _obs_exporter.dump_blackbox()
+        except Exception:
+            pass
         os.kill(os.getpid(), signal.SIGKILL)
 
 
