@@ -119,6 +119,15 @@ def _bank_entry(line):
             # the rung exists to bank
             "prefix_cache", "ttft_ms", "prefix_share", "prefix_hits",
             "prefix_hit_rate", "cached_prefix_tokens",
+            # decode engine v2 rungs: gpt_decode_paged banks the seq-4k
+            # block-table rate with its pool-byte budget (the claim is
+            # "longer streams at UNCHANGED pool bytes"); gpt_decode_spec
+            # banks the speculative rate with its width-1 baseline,
+            # controlled drafter accuracy, and measured acceptance
+            "paged", "paged_block", "pool_blocks", "pool_bytes",
+            "pool_anchor_len", "oom_sheds",
+            "spec", "spec_tokens", "spec_speedup", "spec_acceptance",
+            "spec_parity", "draft_accuracy", "baseline_tok_per_sec_user",
             # per-rung cost census (observability/xla_stats): the
             # compiled step's FLOP/HBM-byte budget banks alongside the
             # throughput so PERF.md's bytes-budget table has provenance
@@ -201,6 +210,8 @@ def bank_best(prefix):
         and ("serving" in prefix or not e.get("serving"))
         and ("decode" in prefix or not e.get("decode"))
         and ("prefix" in prefix or not e.get("prefix_cache"))
+        and ("paged" in prefix or not e.get("paged"))
+        and ("spec" in prefix or not e.get("spec"))
     ]
     if not cands:
         return None, None
@@ -471,13 +482,19 @@ def decode_child_main(cfg):
 
     streams = cfg.get("streams", 8)
     max_len = cfg.get("max_len", 256)
+    # decode engine v2 knobs: paged_block > 0 routes through the
+    # block-table runtime; spec_tokens > 1 additionally arms the k-token
+    # speculative verify (spec rung runs a width-1 baseline first)
+    paged_block = int(cfg.get("paged_block", 0) or 0)
+    spec_k = int(cfg.get("spec_tokens", 0) or 0)
     gcfg = GPTConfig(
         vocab_size=cfg.get("vocab", 50257),
         hidden_size=cfg.get("hidden", 768),
         num_layers=cfg.get("layers", 12),
         num_heads=cfg.get("heads", 12),
         intermediate_size=cfg.get("hidden", 768) * 4,
-        max_position_embeddings=max(max_len, 256),
+        # spec verify embeds positions up to max_len + k - 2
+        max_position_embeddings=max(max_len + max(spec_k - 1, 0), 256),
         is_test=True,
         use_flash_attention=bool(cfg.get("flash")),
     )
@@ -527,25 +544,38 @@ def decode_child_main(cfg):
             prefix_cache_mb=blocks * prefix_block_bytes(gcfg, block)
             / 2.0 ** 20,
         )
-    engine = DecodeEngine(
-        gcfg, scope=scope, slots=streams, max_len=max_len,
-        prefill_buckets=[prompt_len, max_len], param_program=main_prog,
-        **eng_kw
-    ).start()
-    _hb("engine warmup ok %.1fs" % (time.time() - t0))
-    try:
-        n_requests = cfg.get("requests", 4 * streams)
-        max_new = cfg.get("max_new", 64)
+    pool_blocks = 0
+    pool_bytes = None
+    if paged_block:
+        from paddle_tpu.models.gpt import paged_block_bytes
 
-        def mk_prompt():
-            if shared is None:
-                return list(rs.randint(0, gcfg.vocab_size, prompt_len))
-            return shared + list(rs.randint(
-                0, gcfg.vocab_size, prompt_len - len(shared)))
+        # pool sized to the HBM an anchor-geometry LEGACY engine spends
+        # on contiguous [slots, anchor_len] rows (+ the sink block) —
+        # the seq-4k rung's claim is "longer streams at UNCHANGED pool
+        # bytes", so the anchor is the budget, not max_len
+        anchor = int(cfg.get("pool_anchor_len", 0) or 0)
+        if anchor:
+            pool_blocks = streams * anchor // paged_block + 1
+        eng_kw.update(block_size=paged_block, pool_blocks=pool_blocks)
 
+    n_requests = cfg.get("requests", 4 * streams)
+    max_new = cfg.get("max_new", 64)
+
+    def mk_prompt():
+        if shared is None:
+            return list(rs.randint(0, gcfg.vocab_size, prompt_len))
+        return shared + list(rs.randint(
+            0, gcfg.vocab_size, prompt_len - len(shared)))
+
+    # fixed prompt pool (cycled) so the spec rung's replay-drafter phase
+    # sees the exact workload its width-1 baseline recorded
+    prompt_pool = [mk_prompt() for _ in range(2 * streams)]
+
+    def run_workload(engine):
         handles = [
-            engine.generate(mk_prompt(), max_new_tokens=max_new)
-            for _ in range(n_requests)
+            engine.generate(prompt_pool[i % len(prompt_pool)],
+                            max_new_tokens=max_new)
+            for i in range(n_requests)
         ]
         samples = [(time.perf_counter(),
                     profiler.get_counters().get("decode_tokens", 0))]
@@ -553,21 +583,96 @@ def decode_child_main(cfg):
             time.sleep(0.1)
             samples.append((time.perf_counter(),
                             profiler.get_counters().get("decode_tokens", 0)))
+        samples.append((time.perf_counter(),
+                        profiler.get_counters().get("decode_tokens", 0)))
         for h in handles:
             h.tokens(timeout=600)
         # best >=2 s window = steady-state rate without ramp/drain tails
-        tok_s = best_window_rate(samples, 2.0)
+        return best_window_rate(samples, 2.0), handles
+
+    base_kw = dict(gcfg=gcfg, scope=scope, slots=streams, max_len=max_len,
+                   prefill_buckets=[prompt_len, max_len],
+                   param_program=main_prog)
+    spec_facts = {}
+    drafter = None
+    if spec_k > 1:
+        # phase 1 of the spec rung: the SAME paged geometry at width 1.
+        # Greedy decode is deterministic, so its streams double as the
+        # recorded continuations the replay drafter proposes in phase 2
+        # at a controlled accuracy — the banked speedup measures the
+        # k-token verify/rollback machinery at that acceptance, not
+        # drafter luck on random weights
+        _hb("spec baseline start (width-1 paged engine)")
+        kw = dict(base_kw)
+        g = kw.pop("gcfg")
+        base_eng = DecodeEngine(g, **kw, **dict(eng_kw, spec_tokens=0))\
+            .start()
+        try:
+            base_tps, base_handles = run_workload(base_eng)
+        finally:
+            base_eng.stop()
+        recorded = {}
+        for h in base_handles:
+            p = list(h.prompt_ids)
+            recorded[tuple(p)] = p + h.tokens(timeout=10)
+        accuracy = float(cfg.get("draft_accuracy", 0.9))
+        drs = np.random.RandomState(11)
+
+        def drafter(hist, k):
+            full = recorded.get(tuple(hist[:prompt_len]))
+            if full is None:
+                return [0] * k
+            d = list(full[len(hist):len(hist) + k])
+            d += [0] * (k - len(d))
+            return [t if drs.random_sample() < accuracy
+                    else (int(t) + 1) % gcfg.vocab_size for t in d]
+
+        eng_kw["spec_tokens"] = spec_k
+        spec_facts = {
+            "baseline_tok_per_sec_user": round(base_tps / streams, 2),
+            "draft_accuracy": accuracy,
+        }
+        _hb("spec baseline ok %.1f tok/s" % base_tps)
+
+    engine = DecodeEngine(
+        gcfg, scope=scope, slots=streams, max_len=max_len,
+        prefill_buckets=[prompt_len, max_len], param_program=main_prog,
+        drafter=drafter, **eng_kw
+    ).start()
+    _hb("engine warmup ok %.1fs" % (time.time() - t0))
+    try:
+        tok_s, handles = run_workload(engine)
         stats = engine.stats()
+        if spec_k > 1:
+            base_u = spec_facts["baseline_tok_per_sec_user"]
+            spec_facts.update({
+                "spec_speedup": round(
+                    tok_s / streams / max(base_u, 1e-9), 2),
+                "spec_acceptance": round(
+                    stats.get("spec_acceptance", 0.0), 3),
+                # greedy determinism: the spec streams must be byte-
+                # identical to the width-1 recordings
+                "spec_parity": all(
+                    list(h.prompt_ids) + h.tokens(timeout=10)
+                    == recorded.get(tuple(h.prompt_ids))
+                    for h in handles
+                ),
+            })
         census = None
-        if not cfg.get("flash"):
+        if not cfg.get("flash") and not paged_block:
             # census of the DECODE-STEP program specifically — the
             # generic heaviest-program headline would pick a prefill
             # bucket, whose bytes budget is not the serving steady state
+            # (the paged step is fed block tables; its census rides the
+            # same xla_stats path but is not this rung's banked fact)
             dmain, dfetch = engine.session._decode
             fp = _xla_stats.fingerprint(_xla_stats.make_key(
                 dmain, ["step_ids", "step_pos", "key_bias"], [dfetch]
             ))
             census = _xla_stats.census_by_key().get(fp)
+        if paged_block:
+            pool_blocks = engine.session.pool_blocks
+            pool_bytes = pool_blocks * paged_block_bytes(gcfg, paged_block)
     finally:
         engine.stop()
     _hb("decode ok %.1f tok/s at %d streams" % (tok_s, streams))
@@ -581,6 +686,18 @@ def decode_child_main(cfg):
         "steps": stats["steps"],
         "device": device,
     }
+    if paged_block:
+        result.update({
+            "paged": True,
+            "paged_block": paged_block,
+            "pool_blocks": pool_blocks,
+            "pool_bytes": int(pool_bytes),
+            "pool_anchor_len": int(cfg.get("pool_anchor_len", 0) or 0),
+            "oom_sheds": stats.get("oom_sheds", 0),
+        })
+    if spec_k > 1:
+        result.update(spec_facts)
+        result.update({"spec": True, "spec_tokens": spec_k})
     if prefix_cache:
         hit_ttfts = [h.ttft_ms for h in handles
                      if getattr(h, "cached_prefix_tokens", 0) > 0
@@ -1337,6 +1454,111 @@ def parent_main():
             tunnel_suspect = True
         return False
 
+    def try_decode_paged_tpu(slot):
+        """BENCH_DECODE=1 paged rung: tokens/sec/user through the
+        block-table (paged KV) runtime at seq-4k max_len, with the pool
+        byte-budget ANCHORED to the cold-prompt rung's geometry
+        (streams x 256 contiguous rows) — the banked fact is that 16x
+        longer streams fit at unchanged pool bytes because a slot holds
+        ceil(len/block) blocks, not max_len rows. Banked under
+        'gpt_decode_paged'; bank_best hides it from any prefix not
+        containing 'paged'."""
+        nonlocal tunnel_suspect
+        cfg = {
+            "platform": os.environ.get("BENCH_DECODE_PLATFORM", ""),
+            "decode": True,
+            "streams": int(os.environ.get("BENCH_DECODE_STREAMS", "8")),
+            "max_len": int(os.environ.get("BENCH_DECODE_PAGED_MAXLEN",
+                                          "4096")),
+            "max_new": int(os.environ.get("BENCH_DECODE_MAXNEW", "64")),
+            "prompt_len": int(os.environ.get("BENCH_DECODE_PROMPT", "32")),
+            "paged_block": int(os.environ.get("BENCH_DECODE_PAGED_BLOCK",
+                                              "16")),
+            "pool_anchor_len": int(os.environ.get("BENCH_DECODE_MAXLEN",
+                                                  "256")),
+            "layers": int(os.environ.get("BENCH_DECODE_LAYERS", "12")),
+            "hidden": int(os.environ.get("BENCH_DECODE_HIDDEN", "768")),
+            "heads": int(os.environ.get("BENCH_DECODE_HEADS", "12")),
+            "vocab": int(os.environ.get("BENCH_DECODE_VOCAB", "50257")),
+            "flash": os.environ.get("BENCH_DECODE_FLASH", "0") == "1",
+        }
+        label = "decode-paged-gpt-%ds-m%d" % (cfg["streams"],
+                                              cfg["max_len"])
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, tpu_deadline()
+        )
+        if result is not None:
+            if result["device"] == "tpu":
+                bank_write("gpt_decode_paged", _bank_entry(dict(result, **{
+                    "metric": "gpt2_decode_paged_throughput",
+                    "value": round(result["tok_per_sec_user"], 2),
+                    "unit": "tokens/sec/user",
+                    "device": "tpu",
+                    "decode": True,
+                    "tok_per_sec": round(result["tok_per_sec"], 1),
+                    "flash_attention": cfg["flash"],
+                })))
+            return True
+        note_fail("decode", label, kind, err)
+        if kind == "no_tpu" or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
+    def try_decode_spec_tpu(slot):
+        """BENCH_DECODE=1 speculative rung: tokens/sec/user with the
+        k-token draft/verify armed, vs the width-1 baseline the SAME
+        child measures first on identical paged geometry + workload.
+        The drafter replays the baseline's recorded continuations at a
+        controlled accuracy (default 0.9), so the banked speedup prices
+        the fused verify + rollback machinery at that acceptance rather
+        than n-gram drafter luck. Banked under 'gpt_decode_spec' with
+        the 'spec' guard flag ('paged' is dropped from the entry — the
+        spec guard alone isolates it; the rung is paged by
+        construction)."""
+        nonlocal tunnel_suspect
+        cfg = {
+            "platform": os.environ.get("BENCH_DECODE_PLATFORM", ""),
+            "decode": True,
+            "streams": int(os.environ.get("BENCH_DECODE_STREAMS", "8")),
+            "max_len": int(os.environ.get("BENCH_DECODE_MAXLEN", "256")),
+            "max_new": int(os.environ.get("BENCH_DECODE_MAXNEW", "64")),
+            "prompt_len": int(os.environ.get("BENCH_DECODE_PROMPT", "32")),
+            "paged_block": int(os.environ.get("BENCH_DECODE_PAGED_BLOCK",
+                                              "16")),
+            "spec_tokens": int(os.environ.get("BENCH_DECODE_SPEC_TOKENS",
+                                              "4")),
+            "draft_accuracy": float(os.environ.get(
+                "BENCH_DECODE_SPEC_ACCURACY", "0.9")),
+            "layers": int(os.environ.get("BENCH_DECODE_LAYERS", "12")),
+            "hidden": int(os.environ.get("BENCH_DECODE_HIDDEN", "768")),
+            "heads": int(os.environ.get("BENCH_DECODE_HEADS", "12")),
+            "vocab": int(os.environ.get("BENCH_DECODE_VOCAB", "50257")),
+            "flash": os.environ.get("BENCH_DECODE_FLASH", "0") == "1",
+        }
+        label = "decode-spec-gpt-%ds-k%d" % (cfg["streams"],
+                                             cfg["spec_tokens"])
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, tpu_deadline()
+        )
+        if result is not None:
+            if result["device"] == "tpu":
+                entry = _bank_entry(dict(result, **{
+                    "metric": "gpt2_decode_spec_throughput",
+                    "value": round(result["tok_per_sec_user"], 2),
+                    "unit": "tokens/sec/user",
+                    "device": "tpu",
+                    "decode": True,
+                    "tok_per_sec": round(result["tok_per_sec"], 1),
+                    "flash_attention": cfg["flash"],
+                }))
+                entry.pop("paged", None)
+                bank_write("gpt_decode_spec", entry)
+            return True
+        note_fail("decode", label, kind, err)
+        if kind == "no_tpu" or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
     def bank_cpu_fallbacks():
         # a banked TPU number makes the CPU fallback pointless — skip it
         # and leave the window to phase-D TPU retries
@@ -1394,6 +1616,10 @@ def parent_main():
     if os.environ.get("BENCH_DECODE", "0") == "1" and not tunnel_suspect:
         try_decode_tpu(300.0)
         try_decode_prefix_tpu(300.0)
+        # decode engine v2 rungs: the seq-4k block-table rate at the
+        # cold rung's pool byte budget, then speculative vs width-1
+        try_decode_paged_tpu(300.0)
+        try_decode_spec_tpu(340.0)
 
     # ---- phase C: degraded CPU fallbacks for anything still missing ----
     bank_cpu_fallbacks()

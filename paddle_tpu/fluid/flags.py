@@ -94,6 +94,23 @@ _DEFAULTS = {
     "decode_prefix_block": 64,
     "decode_prefix_cache_mb": 0.0,
     "decode_prefill_chunk": 0,
+    # decode engine v2 — paged KV + speculative decoding:
+    # decode_block_size > 0 switches the engine to block-table
+    # addressing over ONE shared pool (slot footprint becomes
+    # ceil(len/block) blocks instead of a max_len row; prefix hits are
+    # zero-copy table edits; in paged mode it is ALSO the prefix reuse
+    # granularity, superseding decode_prefix_block). 0 keeps the legacy
+    # contiguous runtime. decode_spec_tokens = k > 1 arms speculative
+    # decoding on top of the paged runtime: a k-1-token draft per slot
+    # per tick, ONE batched verify program scoring all k positions, and
+    # host-side longest-matching-prefix acceptance that stays token-
+    # exact with sequential decoding (greedy and seeded-sampled).
+    # decode_spec_draft picks the drafter: "ngram" (self-draft from the
+    # stream's own history) or "repeat" (last-token run-length); a
+    # small-model drafter plugs in via DecodeEngine(drafter=...).
+    "decode_block_size": 0,
+    "decode_spec_tokens": 0,
+    "decode_spec_draft": "ngram",
     # HTTP serving gateway (paddle_tpu/serving/gateway.py): the network
     # front door over InferenceServer (+ attached DecodeEngine).
     # gateway_port binds the listener (0 = ephemeral — tests/probes read

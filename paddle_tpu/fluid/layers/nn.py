@@ -18,9 +18,13 @@ __all__ = [
     "cos_sim",
     "flash_attention",
     "flash_decode_attention",
+    "flash_decode_paged_attention",
     "kv_cache_write",
     "kv_cache_copy",
     "kv_cache_gather",
+    "kv_cache_write_paged",
+    "kv_cache_gather_paged",
+    "kv_cache_block_copy",
     "scale",
     "sequence_pool",
     "sequence_first_step",
@@ -1467,6 +1471,85 @@ def kv_cache_gather(cache, slot_idx, name=None):
         outputs={"Out": [out]},
     )
     return out
+
+
+def flash_decode_paged_attention(q, k, v, tables, key_bias=None,
+                                 scale=0.0, interpret=False, name=None):
+    """Decode-mode single-query fused attention THROUGH a block table:
+    ``q`` [N, heads, 1, d_head] against the shared paged pool ``k``/``v``
+    [blocks, heads, block, d_head], with ``tables`` [N, max_blocks]
+    int32 mapping each slot's logical blocks to physical pool blocks.
+    ``key_bias`` [N, max_blocks*block] masks positions at/beyond each
+    slot's live length (and any sink-block garbage). Tables are runtime
+    data (scalar-prefetched on TPU) — one compiled program serves every
+    table layout. Forward-only; ``scale`` 0 means 1/sqrt(d_head)."""
+    helper = LayerHelper("flash_decode_paged_attention", **locals())
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v], "Tables": [tables]}
+    if key_bias is not None:
+        inputs["KeyBias"] = [key_bias]
+    helper.append_op(
+        type="flash_decode_paged_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "interpret": bool(interpret)},
+    )
+    return out
+
+
+def kv_cache_write_paged(cache, new, tables, pos, name=None):
+    """Block-table KV write: lands each slot's token window into ONE
+    shared [blocks, heads, block, d_head] pool through its fed
+    [slots, max_blocks] int32 block table. ``new`` [slots, heads, T,
+    d_head]; ``pos`` [slots] logical start positions — token j of slot
+    s goes to pool block ``tables[s, (pos[s]+j)//block]`` at offset
+    ``(pos[s]+j)%block``. Tables and positions are runtime DATA; one
+    compiled program serves every table layout at 0 recompiles.
+    Returns ``cache`` (output aliases input; donation-friendly).
+    Inference-only (no gradient)."""
+    helper = LayerHelper("kv_cache_write_paged", **locals())
+    helper.append_op(
+        type="kv_cache_write_paged",
+        inputs={"Cache": [cache], "New": [new], "Tables": [tables],
+                "Pos": [pos]},
+        outputs={"Out": [cache]},
+    )
+    return cache
+
+
+def kv_cache_gather_paged(cache, tables, name=None):
+    """Materialize each slot's logical [heads, max_blocks*block, d_head]
+    cache row by gathering pool blocks through its fed block table —
+    the read half of the paged step/window programs. Out
+    [slots, heads, max_blocks*block, d_head]; positions past a slot's
+    live length carry whatever the mapped blocks hold and MUST be
+    masked by the caller's additive key bias. Inference-only."""
+    helper = LayerHelper("kv_cache_gather_paged", **locals())
+    out = helper.create_variable_for_type_inference(dtype=cache.dtype)
+    helper.append_op(
+        type="kv_cache_gather_paged",
+        inputs={"Cache": [cache], "Tables": [tables]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def kv_cache_block_copy(cache, src, dst, name=None):
+    """Pool-internal whole-block copy ``cache[dst[i]] = cache[src[i]]``
+    — the copy-on-write primitive: duplicate a shared block's contents
+    into a fresh block before its new owner writes the partial tail.
+    ``src``/``dst`` are fed int32 vectors (runtime data; only their
+    count is shape — pad with src==dst identity pairs to reuse one
+    compiled count). Reads happen before writes (functional gather →
+    scatter), so overlapping pairs see pre-copy values. Returns
+    ``cache`` (output aliases input). Inference-only."""
+    helper = LayerHelper("kv_cache_block_copy", **locals())
+    helper.append_op(
+        type="kv_cache_block_copy",
+        inputs={"Cache": [cache], "Src": [src], "Dst": [dst]},
+        outputs={"Out": [cache]},
+    )
+    return cache
 
 
 def cos_sim(X, Y):

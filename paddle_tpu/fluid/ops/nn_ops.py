@@ -913,6 +913,36 @@ def _flash_decode_attention(ctx, op_):
     ))
 
 
+def _flash_decode_paged_infer(op_, block):
+    q = in_var(op_, block, "Q")
+    set_out(op_, block, "Out", list(q.shape), q.dtype)
+
+
+@op("flash_decode_paged_attention", infer_shape=_flash_decode_paged_infer)
+def _flash_decode_paged_attention(ctx, op_):
+    """Paged decode-mode attention (kernels/flash_attention.py
+    flash_decode_paged_attention): one live token per slot reads K/V
+    THROUGH a fed [slots, max_blocks] block table over the shared
+    [blocks, heads, block, d_head] pool — on TPU the table rides scalar
+    prefetch so the kernel's DMA chases the indirection without ever
+    materializing the logical rows. Inference-only; no grad."""
+    from ...kernels.flash_attention import flash_decode_paged_attention
+
+    q = ctx.in1(op_, "Q")
+    k = ctx.in1(op_, "K")
+    v = ctx.in1(op_, "V")
+    tables = ctx.in1(op_, "Tables")
+    kb_names = op_.inputs.get("KeyBias") or []
+    key_bias = ctx.in1(op_, "KeyBias") if kb_names else None
+    scale = op_.attr("scale", 0.0)
+    interpret = bool(op_.attr("interpret", False)) or None
+    ctx.out(op_, "Out", flash_decode_paged_attention(
+        q, k, v, tables, key_bias=key_bias,
+        scale=float(scale) if scale else None,
+        interpret=interpret,
+    ))
+
+
 def _kv_cache_write_infer(op_, block):
     c = in_var(op_, block, "Cache")
     set_out(op_, block, "Out", list(c.shape), c.dtype)
@@ -1004,6 +1034,100 @@ def _kv_cache_gather(ctx, op_):
     ctx.out(op_, "Out", jax.lax.dynamic_slice(
         cache, (p[0], z, z, z), (1,) + tuple(cache.shape[1:])
     ))
+
+
+def _kv_cache_write_paged_infer(op_, block):
+    c = in_var(op_, block, "Cache")
+    set_out(op_, block, "Out", list(c.shape), c.dtype)
+
+
+@op("kv_cache_write_paged", infer_shape=_kv_cache_write_paged_infer)
+def _kv_cache_write_paged(ctx, op_):
+    """Block-table KV scatter: the paged generalization of
+    ``kv_cache_write``. ``Cache`` is ONE shared [blocks, heads, block,
+    d_head] pool for every slot AND the prefix index; ``New`` carries
+    each slot's token window [slots, heads, T, d_head]; ``Tables``
+    [slots, max_blocks] int32 maps a slot's logical block number to a
+    physical pool block; ``Pos`` [slots] is each slot's logical start
+    position. Token j of slot s lands at pool block
+    ``tables[s, (pos[s]+j) // block]`` offset ``(pos[s]+j) % block`` —
+    all of it runtime DATA, so one compiled program serves every table
+    layout (permuted, shared, COW-swapped) at 0 recompiles. O(written
+    bytes) scatter; duplicate targets (inactive slots parked on the
+    sink block) are garbage-by-contract and never read unmasked.
+    Inference-only — no gradient registered."""
+    import jax.numpy as jnp
+
+    cache = ctx.in1(op_, "Cache")
+    new = ctx.in1(op_, "New").astype(cache.dtype)
+    tables = ctx.in1(op_, "Tables").astype(jnp.int32)
+    pos = ctx.in1(op_, "Pos").reshape(-1).astype(jnp.int32)
+    S, heads, T, d_head = new.shape
+    block = int(cache.shape[2])
+    # absolute logical positions per (slot, token): [S, T]
+    abs_pos = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    blk_log = abs_pos // block                       # logical block no.
+    off = (abs_pos % block).reshape(-1)              # [S*T] in-block off
+    blk_phys = jnp.take_along_axis(tables, blk_log, axis=1).reshape(-1)
+    new_flat = new.transpose(0, 2, 1, 3).reshape(S * T, heads, d_head)
+    out = cache.at[blk_phys, :, off, :].set(
+        new_flat, mode="drop", unique_indices=False
+    )
+    ctx.out(op_, "Out", out)
+
+
+def _kv_cache_gather_paged_infer(op_, block):
+    c = in_var(op_, block, "Cache")
+    t = in_var(op_, block, "Tables")
+    S, max_blocks = int(t.shape[0]), int(t.shape[1])
+    heads, blk, d_head = (int(c.shape[1]), int(c.shape[2]),
+                          int(c.shape[3]))
+    set_out(op_, block, "Out", [S, heads, max_blocks * blk, d_head],
+            c.dtype)
+
+
+@op("kv_cache_gather_paged", infer_shape=_kv_cache_gather_paged_infer)
+def _kv_cache_gather_paged(ctx, op_):
+    """Materialize each slot's logical cache row THROUGH its block
+    table: Out[s] = concat(pool[tables[s, b]] for b) reshaped to
+    [slots, heads, max_blocks*block, d_head] — the read half of the
+    paged step/window programs. Tables are runtime data; O(gathered
+    bytes). Positions beyond a slot's live length read whatever the
+    mapped blocks hold (sink garbage included) — the caller's additive
+    key bias masks them, same contract as the contiguous pool.
+    Inference-only."""
+    import jax.numpy as jnp
+
+    cache = ctx.in1(op_, "Cache")
+    tables = ctx.in1(op_, "Tables").astype(jnp.int32)
+    S, max_blocks = tables.shape
+    heads, blk, d_head = cache.shape[1], cache.shape[2], cache.shape[3]
+    rows = cache[tables]                # [S, max_blocks, heads, blk, d]
+    ctx.out(op_, "Out", rows.transpose(0, 2, 1, 3, 4).reshape(
+        S, heads, max_blocks * blk, d_head
+    ))
+
+
+def _kv_cache_block_copy_infer(op_, block):
+    c = in_var(op_, block, "Cache")
+    set_out(op_, block, "Out", list(c.shape), c.dtype)
+
+
+@op("kv_cache_block_copy", infer_shape=_kv_cache_block_copy_infer)
+def _kv_cache_block_copy(ctx, op_):
+    """Whole-block pool-internal copy: Out = Cache with
+    ``Cache[Dst[i]] = Cache[Src[i]]`` for each i — the copy-on-write
+    primitive (a shared block's partial tail is duplicated into a fresh
+    block before the owner writes into it). Src/Dst are fed int32
+    vectors (runtime data); only their (static) count is shape. A
+    Src==Dst pair degenerates to an identity write, so callers may pad
+    with no-op pairs to reuse one compiled count. Inference-only."""
+    import jax.numpy as jnp
+
+    cache = ctx.in1(op_, "Cache")
+    src = ctx.in1(op_, "Src").reshape(-1).astype(jnp.int32)
+    dst = ctx.in1(op_, "Dst").reshape(-1).astype(jnp.int32)
+    ctx.out(op_, "Out", cache.at[dst].set(cache[src], mode="drop"))
 
 
 @op("flash_attention_grad")

@@ -456,6 +456,146 @@ def flash_decode_attention(q, k, v, key_bias=None, scale=None,
     return out[:, :1, :].reshape(B, N, 1, D)
 
 
+def _decode_paged_kernel(tables_ref, q_ref, k_ref, v_ref, kb_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, scale, block_q):
+    """Paged decode step, one (slot, head, logical-block) program: the
+    grid's innermost dimension sweeps a slot's LOGICAL blocks while the
+    K/V BlockSpec index maps read the slot's block TABLE (a
+    scalar-prefetch operand) to pick the physical pool block — the DMA
+    engine chases the indirection, the kernel body never sees it. Online
+    softmax state (m, l, acc) lives in VMEM scratch across the sweep;
+    the output block is written once on the last logical block. Same
+    masking contract as ``_decode_kernel``: the per-slot key bias
+    carries ALL masking, including sink-block garbage past the slot's
+    live length."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]                               # [BQ, D], input dtype
+    kblk = k_ref[0, 0]                            # [blk, D]
+    block_k = kblk.shape[0]
+    s = _scores(q, kblk, scale, kb_ref[0], None, 0, 0, False,
+                block_q, block_k)
+    m = m_ref[...]
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = m_new
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_ref[...]
+                       / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_decode_paged_attention(q, k_pool, v_pool, tables, key_bias=None,
+                                 scale=None, interpret=None):
+    """Decode-mode attention reading K/V THROUGH a block table: ``q``
+    [B, N, 1, D] (one live token per slot) against a shared paged pool
+    ``k_pool``/``v_pool`` [blocks, N, block, D], with ``tables``
+    [B, max_blocks] int32 mapping each slot's logical block number to a
+    physical pool block. ``key_bias`` [B, S] (S = max_blocks*block)
+    additively masks positions at/beyond the slot's live length — which
+    also covers any garbage the mapped blocks hold (the serving layer
+    parks idle table entries on a sink block). Tables are runtime data:
+    on TPU they ride scalar prefetch, so the index maps resolve the
+    indirection before each DMA and ONE compiled kernel serves every
+    table layout. Forward-only; dense gather-then-softmax fallback off
+    TPU — bit-compatible with gathering the logical rows and calling
+    ``flash_decode_attention``."""
+    from jax.experimental import pallas as pl  # noqa: F401 (dispatch)
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, N, Sq, D = q.shape
+    blocks, Np, blk, Dp = k_pool.shape
+    MB = tables.shape[1]
+    S = MB * blk
+    if Sq != 1:
+        raise ValueError(
+            "flash_decode_paged_attention is the single-query path, "
+            "got Sq=%d" % Sq
+        )
+    if (Np, Dp) != (N, D):
+        raise ValueError(
+            "pool geometry %r does not match q heads/depth (%d, %d)"
+            % (k_pool.shape, N, D)
+        )
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(D))
+    kb = _normalize_key_bias(key_bias, B, N, S)
+    on_tpu = jax.default_backend() == "tpu"
+    tables = tables.astype(jnp.int32)
+    if interpret is None and not on_tpu:
+        # dense fallback: gather the logical rows, then the same math as
+        # flash_decode_attention's reference path
+        rows_k = k_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+            B, N, S, D
+        )
+        rows_v = v_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+            B, N, S, D
+        )
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, rows_k).astype(
+            jnp.float32
+        ) * scale
+        if kb is not None:
+            s = s + kb.reshape(B, N, 1, S)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bnqk,bnkd->bnqd", p.astype(q.dtype), rows_v)
+    if kb is None:
+        kb = jnp.zeros((B * N, S), jnp.float32)
+    kb = kb.reshape(B, N, S)
+    BQ = _round_up(Sq, 8)                      # Mosaic sublane minimum
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, BQ - Sq), (0, 0)))
+    kernel = functools.partial(
+        _decode_paged_kernel, scale=scale, block_q=BQ,
+    )
+    # index maps receive the grid indices first, then the prefetched
+    # scalar ref (the table) — the K/V maps dereference it so each DMA
+    # pulls the slot's PHYSICAL block for logical block i
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, N, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, BQ, D), lambda b, n, i, t: (b, n, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk, D),
+                         lambda b, n, i, t: (t[b, i], n, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk, D),
+                         lambda b, n, i, t: (t[b, i], n, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk), lambda b, n, i, t: (b, n, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BQ, D),
+                               lambda b, n, i, t: (b, n, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((BQ, 1), jnp.float32),
+            pltpu.VMEM((BQ, 1), jnp.float32),
+            pltpu.VMEM((BQ, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, N, BQ, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=bool(interpret),
+    )(tables, qp, k_pool, v_pool, kb)
+    return out[:, :, :1, :]
+
+
 # --------------------------------------------------------------------------
 # padding / plumbing
 # --------------------------------------------------------------------------

@@ -151,6 +151,32 @@ def _apply_kv_cache(cache, k, v, cfg):
                                             slot_mode=True)
         return (fluid.layers.kv_cache_gather(k_upd, cache["slot_off"]),
                 fluid.layers.kv_cache_gather(v_upd, cache["slot_off"]))
+    if cache["mode"] == "paged_window":
+        # batch-1 window through the slot's block TABLE: the window's
+        # K/V lands at logical positions pos..pos+T-1, scattered into
+        # whichever physical pool blocks the fed table row maps them
+        # to, then the full logical row (every table block, sink
+        # garbage included — resume_bias masks it) is gathered back for
+        # the window's queries. Covers monolithic prefill (pos 0) and
+        # chunked resume alike: offset, table, and positions are all
+        # runtime data, so ONE program per bucket serves both.
+        k_upd = fluid.layers.kv_cache_write_paged(
+            cache["k"], k, cache["tables"], cache["pos"])
+        v_upd = fluid.layers.kv_cache_write_paged(
+            cache["v"], v, cache["tables"], cache["pos"])
+        return (fluid.layers.kv_cache_gather_paged(k_upd, cache["tables"]),
+                fluid.layers.kv_cache_gather_paged(v_upd, cache["tables"]))
+    if cache["mode"] == "paged_step":
+        # fused multi-slot step (T=1 decode / T=k speculative verify):
+        # each slot's T-token window scatters through its table row;
+        # the attention branch reads the pool back through the tables
+        # (paged flash kernel or gather+dense), so just return the
+        # updated pool vars.
+        k_upd = fluid.layers.kv_cache_write_paged(
+            cache["k"], k, cache["tables"], cache["pos"])
+        v_upd = fluid.layers.kv_cache_write_paged(
+            cache["v"], v, cache["tables"], cache["pos"])
+        return k_upd, v_upd
     k_upd = fluid.layers.kv_cache_write(cache["k"], k, cache["pos"])
     v_upd = fluid.layers.kv_cache_write(cache["v"], v, cache["pos"])
     return k_upd, v_upd
@@ -209,7 +235,47 @@ def multi_head_attention(q_in, kv_in, attn_bias, cfg, name, key_bias=None,
     v = _split_heads(_proj(kv_in, "v"))
     if cache is not None:
         k, v = _apply_kv_cache(cache, k, v, cfg)
-    if cache is not None and cache["mode"] == "resume":
+    if cache is not None and cache["mode"] == "paged_step":
+        # unified paged step/verify: q [slots, heads, T, d_head] (T=1
+        # decode, T=k speculative verify) against each slot's logical
+        # row read THROUGH its block table. ``step_bias``
+        # [slots, T, max_blocks*block] is the fed offset-shifted causal
+        # mask (0 where cache position j <= pos_s + i for window query
+        # i, -1e4 beyond — which also buries sink-block garbage), so
+        # inactive slots and every live-length mix share one program.
+        scale_ = 1.0 / math.sqrt(d_head)
+        T_static = q.shape[2]
+        if use_flash and T_static == 1:
+            # single-query path: the Pallas kernel chases the table via
+            # scalar prefetch — the logical rows never materialize.
+            kb = fluid.layers.reshape(cache["step_bias"], shape=[0, -1])
+            kb.stop_gradient = True
+            ctxt = fluid.layers.flash_decode_paged_attention(
+                q, cache["k"], cache["v"], cache["tables"], key_bias=kb,
+                scale=scale_,
+                interpret=getattr(cfg, "flash_interpret", False),
+            )
+        else:
+            rows_k = fluid.layers.kv_cache_gather_paged(
+                cache["k"], cache["tables"])
+            rows_v = fluid.layers.kv_cache_gather_paged(
+                cache["v"], cache["tables"])
+            scores = fluid.layers.matmul(
+                q, rows_k, transpose_y=True, alpha=scale_
+            )
+            bias4 = fluid.layers.unsqueeze(cache["step_bias"], axes=[1])
+            bias4.stop_gradient = True
+            weights = fluid.layers.softmax(
+                fluid.layers.elementwise_add(scores, bias4), axis=-1
+            )
+            ctxt = fluid.layers.matmul(weights, rows_v)
+        ctxt = fluid.layers.transpose(ctxt, perm=[0, 2, 1, 3])
+        ctxt = fluid.layers.reshape(ctxt, shape=[0, 0, cfg.hidden_size])
+        return fluid.layers.fc(
+            input=ctxt, size=cfg.hidden_size, num_flatten_dims=2,
+            name="%s_out" % name,
+        )
+    if cache is not None and cache["mode"] in ("resume", "paged_window"):
         # resume-prefill: window queries [1, heads, T, d] against the
         # slot's full updated row [1, heads, max_len, d] under the FED
         # [T, max_len] additive bias (0 on cache position j <= offset+i

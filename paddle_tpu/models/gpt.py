@@ -82,12 +82,21 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg, kv_cache=None):
     key_bias = None
     attn_bias = None
     mode = kv_cache["mode"] if kv_cache is not None else None
-    if mode == "resume":
+    if mode in ("resume", "paged_window"):
         # resume-prefill window: masking lives entirely in the fed
         # [T, max_len] resume bias (offset-shifted causal + prefix),
         # and attention is dense window×row by design — see
-        # multi_head_attention's resume branch
+        # multi_head_attention's resume branch. The paged variant is
+        # the same regime with the row read through the block table.
         use_flash = False
+    elif mode == "paged_step":
+        # fused paged step/verify: masking lives in the fed per-slot
+        # step bias; flash (the table-chasing decode kernel) engages
+        # only on the T=1 single-query form — the T=k verify is the
+        # window×row dense regime like resume
+        use_flash = _bert.flash_wanted(
+            cfg, seq_len=int(kv_cache["max_len"])
+        )
     elif mode == "decode":
         # single-query step: masking lives entirely in the fed per-slot
         # cache key bias; the flash policy keys on the CACHE length (the
@@ -132,6 +141,14 @@ def gpt_decoder(ids, pos_ids, input_mask, cfg, kv_cache=None):
             elif mode == "resume":
                 cache_i["slot_off"] = kv_cache["slot_off"]
                 cache_i["resume_bias"] = kv_cache["resume_bias"]
+            elif mode == "paged_window":
+                cache_i["tables"] = kv_cache["tables"]
+                cache_i["pos"] = kv_cache["pos"]
+                cache_i["resume_bias"] = kv_cache["resume_bias"]
+            elif mode == "paged_step":
+                cache_i["tables"] = kv_cache["tables"]
+                cache_i["pos"] = kv_cache["pos"]
+                cache_i["step_bias"] = kv_cache["step_bias"]
             else:
                 cache_i["pos"] = kv_cache["pos"]
                 cache_i["key_bias"] = kv_cache["key_bias"]
@@ -489,6 +506,182 @@ def build_gpt_decode_step(cfg, slots, max_len):
         )
     feeds = ["step_ids", "step_pos", "key_bias"]
     return main, startup, feeds, step_logits
+
+
+# -- paged KV pool (block-table addressing: ONE shared pool for live slots
+# -- AND the prefix cache; a slot's row is whatever its fed table maps to) ---
+
+
+def paged_pool_names(cfg, blocks, block):
+    """Per-layer (K, V) paged-pool var names. Pool geometry is part of
+    the name for the same reason as ``decode_cache_names``: two pools of
+    different shapes sharing one scope must never alias."""
+    return [
+        ("gpt_paged_k_%d_n%dx%d" % (i, blocks, block),
+         "gpt_paged_v_%d_n%dx%d" % (i, blocks, block))
+        for i in range(cfg.num_layers)
+    ]
+
+
+def paged_pool_shape(cfg, blocks, block):
+    return [
+        int(blocks), cfg.num_heads, int(block),
+        cfg.hidden_size // cfg.num_heads,
+    ]
+
+
+def paged_block_bytes(cfg, block):
+    """Device bytes one pool block costs across all layers (K + V,
+    fp32) — what sizes the allocator and the HBM-footprint accounting
+    (a slot costs ``ceil(len/block)`` of these, not ``max_len``)."""
+    d_head = cfg.hidden_size // cfg.num_heads
+    return cfg.num_layers * 2 * cfg.num_heads * int(block) * d_head * 4
+
+
+def _declare_paged_pool_vars(cfg, blocks, block):
+    main_block = fluid.default_main_program().global_block()
+    shape = paged_pool_shape(cfg, blocks, block)
+    return [
+        tuple(
+            main_block.create_var(
+                name=n, shape=shape, dtype="float32", persistable=True
+            )
+            for n in names
+        )
+        for names in paged_pool_names(cfg, blocks, block)
+    ]
+
+
+def build_gpt_paged_window(cfg, blocks, block, max_blocks, seq_len):
+    """Paged prefill-window graph: ONE prompt window (batch 1, padded to
+    the ``seq_len`` bucket) lands THROUGH the slot's fed block table —
+    the paged runtime's only prefill form (a monolithic prefill is a
+    window at position 0). Per layer the window's K/V scatters into the
+    pool blocks its ``table`` [max_blocks] maps logical positions
+    ``window_pos .. window_pos+T-1`` to, then the window's queries
+    attend dense over the gathered logical row under the fed
+    ``resume_bias`` [seq_len, max_blocks*block] (offset-shifted causal;
+    -1e4 also buries sink-block garbage past the live length). Table,
+    position, and bias are all runtime data: one program per bucket, 0
+    steady-state recompiles.
+
+    Returns (main, startup, feed names, next_logits [1, vocab])."""
+    import copy
+
+    cfg = copy.copy(cfg)
+    cfg.is_test = True
+    main, startup = fluid.Program(), fluid.Program()
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[seq_len, 1],
+                                dtype="int64")
+        pos_ids = fluid.layers.data(name="pos_ids", shape=[seq_len, 1],
+                                    dtype="int64")
+        table = fluid.layers.data(name="table", shape=[max_blocks],
+                                  dtype="int64")
+        window_pos = fluid.layers.data(name="window_pos", shape=[1],
+                                       dtype="int64")
+        resume_bias = fluid.layers.data(
+            name="resume_bias", shape=[seq_len, max_blocks * block],
+            dtype="float32"
+        )
+        last_onehot = fluid.layers.data(
+            name="last_onehot", shape=[seq_len, 1], dtype="float32"
+        )
+        kv_cache = {
+            "mode": "paged_window",
+            "caches": _declare_paged_pool_vars(cfg, blocks, block),
+            "tables": table,
+            "pos": window_pos,
+            "resume_bias": resume_bias,
+            "max_len": max_blocks * block,
+        }
+        logits = gpt_lm_logits(ids, pos_ids, None, cfg, kv_cache=kv_cache)
+        next_logits = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(logits, last_onehot), dim=1
+        )
+    feeds = ["ids", "pos_ids", "table", "window_pos", "resume_bias",
+             "last_onehot"]
+    return main, startup, feeds, next_logits
+
+
+def build_gpt_paged_step(cfg, slots, blocks, block, max_blocks, step_w=1):
+    """Unified paged step/verify graph: every slot advances a
+    ``step_w``-token window per tick against the shared paged pool —
+    ``step_w=1`` is the fused decode step, ``step_w=k`` the speculative
+    VERIFY program that scores all k draft positions in one call. Feeds
+    (all fixed-shape; tables/positions/bias are runtime data, so one
+    compiled program per window width serves every table layout):
+
+    - ``step_ids`` / ``step_pos`` [slots, step_w, 1] int64: each slot's
+      token window and its contiguous cache positions (window start =
+      ``step_pos[s, 0]``); inactive slots park their table on the sink
+      block and tolerate any position;
+    - ``tables`` [slots, max_blocks] int64 block tables;
+    - ``step_bias`` [slots, step_w, max_blocks*block]: additive mask, 0
+      where cache position j <= step_pos[s, i] for window query i, -1e4
+      beyond — per-query causal by construction, and it buries sink /
+      stale-tail garbage.
+
+    Returns (main, startup, feeds, step_logits [slots, step_w, vocab]
+    reshaped to [slots*step_w, vocab])."""
+    import copy
+
+    cfg = copy.copy(cfg)
+    cfg.is_test = True
+    main, startup = fluid.Program(), fluid.Program()
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        step_ids = fluid.layers.data(name="step_ids", shape=[step_w, 1],
+                                     dtype="int64")
+        step_pos = fluid.layers.data(name="step_pos", shape=[step_w, 1],
+                                     dtype="int64")
+        tables = fluid.layers.data(name="tables", shape=[max_blocks],
+                                   dtype="int64")
+        step_bias = fluid.layers.data(
+            name="step_bias", shape=[step_w, max_blocks * block],
+            dtype="float32"
+        )
+        # write start = each slot's first window position
+        write_pos = fluid.layers.reshape(
+            fluid.layers.slice(step_pos, axes=[1], starts=[0], ends=[1]),
+            shape=[-1],
+        )
+        kv_cache = {
+            "mode": "paged_step",
+            "caches": _declare_paged_pool_vars(cfg, blocks, block),
+            "tables": tables,
+            "pos": write_pos,
+            "step_bias": step_bias,
+            "max_len": max_blocks * block,
+        }
+        logits = gpt_lm_logits(step_ids, step_pos, None, cfg,
+                               kv_cache=kv_cache)
+        step_logits = fluid.layers.reshape(
+            logits, shape=[-1, cfg.vocab_size]
+        )
+    feeds = ["step_ids", "step_pos", "tables", "step_bias"]
+    return main, startup, feeds, step_logits
+
+
+def build_gpt_paged_block_copy(cfg, blocks, block, npairs):
+    """ONE compiled pool-internal block copy across every layer's K and
+    V: ``cache[dst[i]] = cache[src[i]]`` for each of the ``npairs`` fed
+    pairs — the copy-on-write program (duplicate a shared block before
+    its new owner writes the partial tail). Pad unused pairs with
+    src==dst identity copies to reuse one compiled pair count.
+
+    Returns (main, startup, feed names, ok)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main._donate_mutable = True
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[npairs], dtype="int64")
+        dst = fluid.layers.data(name="dst", shape=[npairs], dtype="int64")
+        for pk, pv in _declare_paged_pool_vars(cfg, blocks, block):
+            fluid.layers.kv_cache_block_copy(pk, src, dst)
+            fluid.layers.kv_cache_block_copy(pv, src, dst)
+        ok = fluid.layers.fill_constant(shape=[1], dtype="int32", value=1)
+    return main, startup, ["src", "dst"], ok
 
 
 def _reference_generate(exe, infer_prog, logits_var, cfg, prompt_ids,

@@ -287,6 +287,203 @@ class PrefixCache(object):
         }
 
 
+class BlockAllocator(object):
+    """Host free-list + refcount ledger over the paged pool's physical
+    blocks. Block 0 is the reserved SINK (idle / prefilling slots park
+    their tables on it so the fused step's unconditional scatter-writes
+    never touch a live block) and is never handed out. Sharing is a
+    refcount: a prefix-store entry and any number of admitted slots may
+    reference one block; whoever drops the last reference returns it to
+    the free list — eviction and retirement are both just ``decref``.
+
+    Single-mutator discipline like ``PrefixCache``: only the engine's
+    loop thread allocates/increfs/decrefs."""
+
+    SINK = 0
+
+    def __init__(self, blocks):
+        if blocks < 2:
+            raise ValueError(
+                "paged pool needs >= 2 blocks (sink + 1), got %d" % blocks
+            )
+        self.blocks = int(blocks)
+        self._free = list(range(self.blocks - 1, 0, -1))  # pop() -> low ids
+        self._refs = [0] * self.blocks
+        self._refs[self.SINK] = 1  # permanently pinned
+
+    def alloc(self, n):
+        """Take ``n`` fresh blocks (refcount 1 each) or None if the free
+        list can't cover all of them — all-or-nothing so a half-admitted
+        slot never holds partial tables."""
+        if n < 0:
+            raise ValueError("alloc(%d)" % n)
+        if n == 0:
+            return []
+        if len(self._free) < n:
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def incref(self, block_ids):
+        for b in block_ids:
+            if not 0 < b < self.blocks or self._refs[b] <= 0:
+                raise ValueError("incref on dead/sink block %d" % b)
+            self._refs[b] += 1
+
+    def decref(self, block_ids):
+        """Drop one reference per id; blocks hitting zero return to the
+        free list. Returns the number actually freed."""
+        freed = 0
+        for b in block_ids:
+            if not 0 < b < self.blocks or self._refs[b] <= 0:
+                raise ValueError("decref on dead/sink block %d" % b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+                freed += 1
+        return freed
+
+    def refs(self, block_id):
+        return self._refs[block_id]
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def shared_blocks(self):
+        return sum(1 for r in self._refs[1:] if r > 1)
+
+    def stats(self):
+        return {
+            "blocks": self.blocks,
+            "free": self.free_blocks,
+            "shared": self.shared_blocks,
+        }
+
+
+class PagedPrefixIndex(object):
+    """Hash-chain prefix index for the PAGED runtime: same chained-
+    digest lookup discipline as ``PrefixCache`` but ZERO-copy — entries
+    point straight at pool blocks (the slot's own finished-prefill
+    blocks at publish time), held alive by one allocator reference each.
+    A hit extends the admitted slot's table with the entry's block and
+    increfs it; no device copy moves in either direction. Eviction is a
+    refcount decrement — a block still referenced by live slots survives
+    until the last slot retires.
+
+    ``max_blocks`` caps how many pool blocks the store itself may pin
+    (the paged reading of ``FLAGS_decode_prefix_cache_mb``)."""
+
+    def __init__(self, block, max_blocks, allocator):
+        if block < 1 or max_blocks < 1:
+            raise ValueError(
+                "need block >= 1 and max_blocks >= 1, got %d / %d"
+                % (block, max_blocks)
+            )
+        self.block = int(block)
+        self.max_blocks = int(max_blocks)
+        self.allocator = allocator
+        from collections import OrderedDict
+
+        self._entries = OrderedDict()  # key -> _PrefixEntry, LRU order
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def lookup(self, prompt):
+        """Longest cached block-chain prefix of ``prompt`` (capped at
+        ``len(prompt) - 1`` tokens like the legacy cache). Every matched
+        entry's block is INCREF'D for the caller — the references become
+        the admitted slot's table entries; on a failed admission the
+        caller must decref them back."""
+        usable = (len(prompt) - 1) // self.block
+        out = []
+        prev = 0
+        for b in range(usable):
+            toks = tuple(prompt[b * self.block:(b + 1) * self.block])
+            key = _block_hash(prev, toks)
+            e = self._entries.get(key)
+            if e is None or e.tokens != toks or e.prev != prev:
+                break
+            out.append(e)
+            prev = key
+        for e in out:
+            self.allocator.incref([e.block_idx])
+            self._entries.move_to_end(e.key)
+        return out, len(out) * self.block
+
+    def publish(self, prompt, slot_blocks):
+        """Register every full block of ``prompt`` not indexed yet,
+        pointing each entry at the admitted slot's OWN pool block
+        (``slot_blocks[b]`` for prompt block b) — zero-copy publish.
+        Each new entry increfs its block (the store's reference).
+        Stops chaining at a collision, a missing slot block, or the
+        store's pin budget. Returns the new entries."""
+        new = []
+        prev = 0
+        for b in range(len(prompt) // self.block):
+            toks = tuple(prompt[b * self.block:(b + 1) * self.block])
+            key = _block_hash(prev, toks)
+            e = self._entries.get(key)
+            if e is not None:
+                if e.tokens != toks or e.prev != prev:
+                    break  # collision squatting on the key
+                self._entries.move_to_end(key)
+                prev = key
+                continue
+            if b >= len(slot_blocks):
+                break
+            if len(self._entries) >= self.max_blocks:
+                if not self.evict_one():
+                    break  # budget full of blocks slots still share
+            e = _PrefixEntry(key, prev, toks, slot_blocks[b])
+            self.allocator.incref([e.block_idx])
+            self._entries[key] = e
+            new.append(e)
+            prev = key
+        return new
+
+    def forget(self, entry):
+        if self._entries.get(entry.key) is entry:
+            del self._entries[entry.key]
+            self.allocator.decref([entry.block_idx])
+
+    def evict_one(self, need_free=False):
+        """Drop the least-recently-used entry — preferring one whose
+        block the store alone references (decref actually FREES it).
+        With ``need_free`` the sweep only takes such entries (the
+        allocator-pressure path: evicting a slot-shared block releases
+        no memory). Returns True if an entry was dropped."""
+        victim = None
+        for e in self._entries.values():  # oldest first
+            if self.allocator.refs(e.block_idx) == 1:
+                victim = e
+                break
+        if victim is None:
+            if need_free:
+                return False
+            victim = next(iter(self._entries.values()), None)
+            if victim is None:
+                return False
+        del self._entries[victim.key]
+        self.allocator.decref([victim.block_idx])
+        self.evictions += 1
+        _profiler.bump_counter("decode_prefix_evictions")
+        return True
+
+    def stats(self):
+        return {
+            "block": self.block,
+            "max_blocks": self.max_blocks,
+            "cached_blocks": len(self._entries),
+            "evictions": self.evictions,
+        }
+
+
 class DecodeSession(object):
     """Synchronous KV-cache decode core over one Executor + scope.
 
@@ -301,7 +498,8 @@ class DecodeSession(object):
 
     def __init__(self, cfg, place=None, scope=None, slots=None,
                  max_len=None, prefill_buckets=None, prefix_blocks=0,
-                 prefix_block=None, build_resume=False):
+                 prefix_block=None, build_resume=False, block_size=None,
+                 pool_blocks=0, spec_tokens=None, window_cap=0):
         self.cfg = copy.copy(cfg)
         self.cfg.is_test = True
         self.slots = int(_flag("decode_slots", slots))
@@ -319,9 +517,49 @@ class DecodeSession(object):
                 % (self.slots, max_len)
             )
         self.max_len = max_len
-        self.buckets = prefill_ladder(
-            max_len, _flag("decode_prefill_buckets", prefill_buckets) or None
-        )
+        # paged mode (decode engine v2): block-table addressing over ONE
+        # shared pool for live slots AND the prefix store. 0 = the
+        # legacy contiguous [slots, max_len] rows (greedy_generate's
+        # sessions stay legacy by construction — session_for_generate
+        # pins block_size=0)
+        self.block_size = int(_flag("decode_block_size", block_size))
+        self.spec_tokens = max(int(_flag("decode_spec_tokens",
+                                         spec_tokens)), 0)
+        self.paged = self.block_size > 0
+        if self.paged:
+            width = max(self.spec_tokens, 1)
+            # speculative verify writes/embeds positions up to
+            # max_len + k - 2 (a slot one token from the wall still
+            # feeds a full k-window; emission stops at the budget)
+            if max_len + width - 1 > cfg.max_position_embeddings:
+                raise ValueError(
+                    "paged decode needs max_len + spec_tokens - 1 <= "
+                    "max_position_embeddings (%d + %d - 1 > %d): lower "
+                    "decode_max_len or decode_spec_tokens"
+                    % (max_len, width, cfg.max_position_embeddings)
+                )
+            self.max_blocks = -(-(max_len + width - 1) // self.block_size)
+            self.pool_blocks = int(pool_blocks) or (
+                self.slots * self.max_blocks + 1
+            )
+            # block 0 is the SINK: reserved garbage target every idle /
+            # prefilling slot's table points at, so the fused step's
+            # unconditional scatter-writes can never touch a live block
+            if self.pool_blocks < 2:
+                raise ValueError(
+                    "paged pool needs >= 2 blocks (sink + 1), got %d"
+                    % self.pool_blocks
+                )
+            wcap = int(window_cap) or max_len
+            self.buckets = prefill_ladder(
+                min(max_len, max(wcap, 1)),
+                _flag("decode_prefill_buckets", prefill_buckets) or None,
+            )
+        else:
+            self.buckets = prefill_ladder(
+                max_len,
+                _flag("decode_prefill_buckets", prefill_buckets) or None,
+            )
         self.place = place if place is not None else fluid.CPUPlace()
         self.scope = scope if scope is not None else fluid.core.Scope()
         # own executor: the session's program/plan caches never contend
@@ -339,17 +577,51 @@ class DecodeSession(object):
         # calls can never cross-contaminate the slot-0 cache
         self.lock = threading.RLock()
         self._prefill = {}
-        for seq_len in self.buckets:
+        self._decode = None
+        self._paged_window = {}
+        self._paged_step = {}
+        self._block_copy = None
+        if not self.paged:
+            for seq_len in self.buckets:
+                with fluid.unique_name.guard():
+                    main, _startup, _feeds, next_logits = (
+                        _gpt.build_gpt_prefill(
+                            self.cfg, self.slots, seq_len, max_len
+                        )
+                    )
+                self._prefill[seq_len] = (main, next_logits.name)
             with fluid.unique_name.guard():
-                main, _startup, _feeds, next_logits = _gpt.build_gpt_prefill(
-                    self.cfg, self.slots, seq_len, max_len
+                main, _startup, _feeds, step_logits = (
+                    _gpt.build_gpt_decode_step(self.cfg, self.slots, max_len)
                 )
-            self._prefill[seq_len] = (main, next_logits.name)
-        with fluid.unique_name.guard():
-            main, _startup, _feeds, step_logits = _gpt.build_gpt_decode_step(
-                self.cfg, self.slots, max_len
-            )
-        self._decode = (main, step_logits.name)
+            self._decode = (main, step_logits.name)
+        else:
+            # one window program per bucket handles ALL prefill in paged
+            # mode (a monolithic prefill is just a window at offset 0),
+            # one fused step per width (1 = plain decode, spec_tokens =
+            # the batched verify), and one block-copy for COW
+            for seq_len in self.buckets:
+                with fluid.unique_name.guard():
+                    main, _s, _f, nl = _gpt.build_gpt_paged_window(
+                        self.cfg, self.pool_blocks, self.block_size,
+                        self.max_blocks, seq_len,
+                    )
+                self._paged_window[seq_len] = (main, nl.name)
+            widths = [1]
+            if self.spec_tokens > 1:
+                widths.append(self.spec_tokens)
+            for w in widths:
+                with fluid.unique_name.guard():
+                    main, _s, _f, sl = _gpt.build_gpt_paged_step(
+                        self.cfg, self.slots, self.pool_blocks,
+                        self.block_size, self.max_blocks, step_w=w,
+                    )
+                self._paged_step[w] = (main, sl.name)
+            with fluid.unique_name.guard():
+                main, _s, _f, ok = _gpt.build_gpt_paged_block_copy(
+                    self.cfg, self.pool_blocks, self.block_size, npairs=1
+                )
+            self._block_copy = (main, ok.name)
         # resume-prefill family (prefix-cache hits + chunked prefill):
         # one program per bucket, prefilling a window at a FED offset.
         # Graph-built only on request — a greedy_generate 1-slot session
@@ -363,7 +635,7 @@ class DecodeSession(object):
                 % (self.prefix_blocks, self.prefix_block)
             )
         self._resume = {}
-        if build_resume or self.prefix_blocks:
+        if (build_resume or self.prefix_blocks) and not self.paged:
             for seq_len in self.buckets:
                 with fluid.unique_name.guard():
                     main, _s, _f, nl = _gpt.build_gpt_resume_prefill(
@@ -374,7 +646,7 @@ class DecodeSession(object):
         # both directions, each ONE compiled program with fed locations
         self._copy_in = None
         self._publish = None
-        if self.prefix_blocks:
+        if self.prefix_blocks and not self.paged:
             with fluid.unique_name.guard():
                 m_in, _s, _f, ok_in = _gpt.build_gpt_prefix_copy(
                     self.cfg, self.slots, max_len, self.prefix_blocks,
@@ -387,7 +659,10 @@ class DecodeSession(object):
                     self.prefix_block, publish=True,
                 )
             self._publish = (m_pub, ok_pub.name)
-        self._cols = np.arange(max_len)
+        if self.paged:
+            self._cols = np.arange(self.max_blocks * self.block_size)
+        else:
+            self._cols = np.arange(max_len)
         self._pos_cache = {
             T: np.arange(T).reshape(1, T, 1).astype("int64")
             for T in self.buckets
@@ -400,6 +675,16 @@ class DecodeSession(object):
         param re-init). Correctness never depends on this — prefill
         replaces a slot's whole row — but fresh buffers make warmup and
         tests deterministic."""
+        if self.paged:
+            pshape = _gpt.paged_pool_shape(
+                self.cfg, self.pool_blocks, self.block_size
+            )
+            for k_name, v_name in _gpt.paged_pool_names(
+                self.cfg, self.pool_blocks, self.block_size
+            ):
+                self.scope.set(k_name, np.zeros(pshape, "float32"))
+                self.scope.set(v_name, np.zeros(pshape, "float32"))
+            return
         shape = _gpt.decode_cache_shape(self.cfg, self.slots, self.max_len)
         for k_name, v_name in _gpt.decode_cache_names(
             self.cfg, self.slots, self.max_len
@@ -608,6 +893,134 @@ class DecodeSession(object):
         )
         return np.asarray(lv)
 
+    # -- paged device steps --------------------------------------------------
+    def paged_window(self, table, window_ids, offset):
+        """Prefill one prompt window (batch 1) THROUGH a fed block
+        table: window token i lands at logical position ``offset + i``,
+        which ``table`` maps to a physical pool block — the paged
+        runtime's only prefill form (offset 0 = monolithic). Returns
+        the logits [vocab] at the window's last real token."""
+        P = len(window_ids)
+        if not self.paged:
+            raise RuntimeError("paged_window on a non-paged session")
+        if P < 1:
+            raise ValueError("empty prefill window")
+        T = self.bucket_for(P)
+        offset = int(offset)
+        span = self.max_blocks * self.block_size
+        if offset < 0 or offset + T > span:
+            raise ValueError(
+                "paged window bucket [%d, %d) exceeds the table span %d"
+                % (offset, offset + T, span)
+            )
+        main, fetch_name = self._paged_window[T]
+        ids = np.zeros((1, T, 1), "int64")
+        ids[0, :P, 0] = window_ids
+        # offset-shifted causal mask over the gathered logical row; the
+        # -1e4 side also buries sink garbage past the live length
+        allow = self._cols[None, :] <= (offset + np.arange(T))[:, None]
+        bias = np.where(allow, 0.0, -1e4).astype("float32")[None]
+        last_onehot = np.zeros((1, T, 1), "float32")
+        last_onehot[0, P - 1, 0] = 1.0
+        tbl = np.zeros((1, self.max_blocks), "int64")
+        tbl[0, :len(table)] = table
+        feed = {
+            "ids": ids,
+            "pos_ids": (offset + np.arange(T)).reshape(1, T, 1)
+            .astype("int64"),
+            "table": tbl,
+            "window_pos": np.array([[offset]], "int64"),
+            "resume_bias": bias,
+            "last_onehot": last_onehot,
+        }
+        t0 = time.perf_counter()
+        with _trace.span("decode_paged_window", cat="serving",
+                         bucket=T, rows=P, offset=offset):
+            (lv,) = self.exe.run(
+                main, feed=feed, fetch_list=[fetch_name], scope=self.scope
+            )
+        _profiler.bump_counter("decode_prefills")
+        self.prefills += 1
+        _profiler.bump_histogram(
+            "decode_prefill_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return np.asarray(lv)[0]
+
+    def paged_step(self, tokens, positions, tables, active, width=1):
+        """ONE fused paged step over all slots: slot s advances the
+        ``width``-token window ``tokens[s]`` at contiguous logical
+        positions ``positions[s] .. positions[s]+width-1`` through its
+        block table ``tables[s]``. width=1 is the plain decode tick;
+        width=k is the speculative VERIFY (all k draft positions scored
+        in one call). Inactive slots feed an all-sink table, so their
+        unconditional scatter-writes land in reserved block 0 and can
+        never corrupt a live block — unlike the legacy contiguous step
+        there is no caller-aimed masked write to reason about. Returns
+        logits [slots, width, vocab]."""
+        if not self.paged:
+            raise RuntimeError("paged_step on a non-paged session")
+        if width not in self._paged_step:
+            raise ValueError(
+                "no paged step program of width %d (built: %s)"
+                % (width, sorted(self._paged_step))
+            )
+        act = np.asarray(active, bool)
+        pos = np.asarray(positions, "int64")
+        tok = np.where(act[:, None],
+                       np.asarray(tokens, "int64").reshape(self.slots,
+                                                           width), 0)
+        qpos = pos[:, None] + np.arange(width)[None, :]
+        # query i of slot s sees logical cache positions <= qpos[s, i];
+        # inactive rows mask everything (finite softmax over garbage,
+        # output ignored)
+        bias = (
+            ((self._cols[None, None, :] > qpos[:, :, None])
+             | ~act[:, None, None]).astype("float32") * -1e4
+        )
+        tbl = np.zeros((self.slots, self.max_blocks), "int64")
+        for s in range(self.slots):
+            row = tables[s] if tables is not None else ()
+            if len(row):
+                tbl[s, :len(row)] = row
+        main, fetch_name = self._paged_step[width]
+        feed = {
+            "step_ids": tok.reshape(self.slots, width, 1),
+            "step_pos": qpos.reshape(self.slots, width, 1)
+            .astype("int64"),
+            "tables": tbl,
+            "step_bias": bias,
+        }
+        t0 = time.perf_counter()
+        with _trace.span("decode_paged_step", cat="serving",
+                         active=int(act.sum()), width=width):
+            (lv,) = self.exe.run(
+                main, feed=feed, fetch_list=[fetch_name], scope=self.scope
+            )
+        _profiler.bump_counter("decode_steps")
+        self.steps += 1
+        _profiler.bump_histogram(
+            "decode_step_ms", (time.perf_counter() - t0) * 1e3
+        )
+        return np.asarray(lv).reshape(self.slots, width, -1)
+
+    def block_copy(self, src_blocks, dst_blocks):
+        """Pool-internal block copy (all layers, K and V):
+        ``pool[dst[i]] = pool[src[i]]`` — the copy-on-write device op.
+        The compiled program carries one pair; callers pass equal-length
+        lists and pairs run back to back."""
+        if self._block_copy is None:
+            raise RuntimeError("session built without block-copy program")
+        main, fetch_name = self._block_copy
+        for src, dst in zip(src_blocks, dst_blocks):
+            with _trace.span("decode_block_copy", cat="serving",
+                             src=int(src), dst=int(dst)):
+                self.exe.run(
+                    main,
+                    feed={"src": np.array([[src]], "int64"),
+                          "dst": np.array([[dst]], "int64")},
+                    fetch_list=[fetch_name], scope=self.scope,
+                )
+
 
 # -- greedy_generate's session cache ----------------------------------------
 # stored ON the scope object (not in a module registry): a session holds
@@ -640,9 +1053,12 @@ def session_for_generate(exe, cfg, scope, max_len, param_program):
     with cache["lock"]:
         sess = cache["sessions"].get(key)
         if sess is None:
+            # block_size pinned 0: greedy_generate's 1-slot sessions
+            # stay on the legacy contiguous path regardless of the
+            # serving-engine paged flags
             sess = DecodeSession(
                 cfg, place=exe.place, scope=scope_obj, slots=1,
-                max_len=max_len,
+                max_len=max_len, block_size=0, spec_tokens=0,
             )
             cache["sessions"][key] = sess
     sess.bind_params(param_program)
@@ -794,6 +1210,13 @@ class GenerationStream(object):
         self.ttft_ms = None
         self.cached_prefix_tokens = 0
         self.admit_windows = 0
+        # speculative-decoding facts, engine-stamped (0 unless the
+        # engine runs with decode_spec_tokens > 1): how many draft
+        # tokens the verify program scored for this stream and how many
+        # it accepted — the per-request acceptance rate the gateway
+        # surfaces beside ttft_ms
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # distributed-trace hand-off: the stream is constructed on the
         # SUBMITTING thread (the gateway handler inside its
         # trace_scope); the engine loop re-enters this context around
@@ -942,6 +1365,51 @@ class _PrefillJob(object):
 
 
 # ---------------------------------------------------------------------------
+# speculative drafters — host-side, correctness-neutral proposals
+# ---------------------------------------------------------------------------
+
+
+def _ngram_draft(history, k):
+    """Self-draft from the stream's own history: find the most recent
+    earlier occurrence of the trailing n-gram (n = 3 shrinking to 1)
+    and propose the continuation that followed it, padded with its last
+    token to exactly ``k`` tokens. A wrong draft only costs verify
+    compute — the accept loop guarantees the emitted tokens match
+    sequential decoding bit for bit — so the drafter optimizes for the
+    repetition-heavy spans (code, templates, copied context) where
+    n-gram continuation is usually right."""
+    hist = [int(t) for t in history]
+    draft = []
+    for n in (3, 2, 1):
+        if len(hist) <= n:
+            continue
+        key = tuple(hist[-n:])
+        for i in range(len(hist) - n - 1, -1, -1):
+            if tuple(hist[i:i + n]) == key:
+                draft = hist[i + n:i + n + k]
+                break
+        if draft:
+            break
+    if not draft:
+        draft = [hist[-1]] if hist else [0]
+    while len(draft) < k:
+        draft.append(draft[-1])
+    return draft[:k]
+
+
+def _repeat_draft(history, k):
+    """Degenerate drafter: propose the last token ``k`` times — the
+    cheapest possible proposal, right exactly on run-length spans."""
+    last = int(history[-1]) if history else 0
+    return [last] * k
+
+
+# the FLAGS_decode_spec_draft seam: named built-ins here; a small-model
+# drafter plugs in as DecodeEngine(drafter=callable(history, k) -> [k])
+_SPEC_DRAFTERS = {"ngram": _ngram_draft, "repeat": _repeat_draft}
+
+
+# ---------------------------------------------------------------------------
 # continuous-batching engine
 # ---------------------------------------------------------------------------
 
@@ -966,7 +1434,9 @@ class DecodeEngine(object):
     def __init__(self, cfg, place=None, scope=None, slots=None,
                  max_len=None, prefill_buckets=None, queue_depth=None,
                  param_program=None, prefix_block=None,
-                 prefix_cache_mb=None, prefill_chunk=None):
+                 prefix_cache_mb=None, prefill_chunk=None,
+                 block_size=None, spec_tokens=None, spec_draft=None,
+                 pool_blocks=0, drafter=None):
         self._cfg = cfg
         self._place = place
         self._scope = scope
@@ -989,7 +1459,40 @@ class DecodeEngine(object):
             raise ValueError(
                 "prefill_chunk and prefix_cache_mb must be >= 0"
             )
+        # decode engine v2: block_size > 0 arms the PAGED runtime (one
+        # shared pool, per-slot block tables, zero-copy prefix sharing);
+        # spec_tokens > 1 arms speculative decoding on top of it
+        self.block_size = int(_flag("decode_block_size", block_size))
+        self.spec_tokens = int(_flag("decode_spec_tokens", spec_tokens))
+        self._paged = self.block_size > 0
+        if self.spec_tokens > 1 and not self._paged:
+            raise ValueError(
+                "speculative decoding rides the paged runtime: set "
+                "decode_block_size > 0 alongside decode_spec_tokens"
+            )
+        self._spec_width = (
+            self.spec_tokens if self._paged and self.spec_tokens > 1 else 1
+        )
+        self._pool_blocks_arg = int(pool_blocks or 0)
+        if drafter is not None:
+            self._drafter = drafter
+        else:
+            name = str(_flag("decode_spec_draft", spec_draft) or "ngram")
+            if name not in _SPEC_DRAFTERS:
+                raise ValueError(
+                    "unknown decode_spec_draft %r (built-ins: %s; pass "
+                    "drafter= for a model-based one)"
+                    % (name, sorted(_SPEC_DRAFTERS))
+                )
+            self._drafter = _SPEC_DRAFTERS[name]
+        if self._paged:
+            # paged reuse granularity IS the KV block — the legacy
+            # prefix_block knob only sizes the contiguous store
+            self.prefix_block = self.block_size
         self.prefix = None  # PrefixCache once started (store enabled)
+        self.pindex = None  # PagedPrefixIndex once started (paged mode)
+        self.allocator = None  # BlockAllocator once started (paged mode)
+        self._slot_blocks = {}  # slot_idx -> [pool block ids], paged mode
         self.session = None
         self.started = False
         self.tick = 0
@@ -1006,10 +1509,15 @@ class DecodeEngine(object):
                         "retirements": 0, "tokens": 0,
                         "prefix_hits": 0, "prefix_misses": 0,
                         "prefix_cached_tokens": 0,
-                        "resume_admissions": 0, "resume_tokens": 0}
+                        "resume_admissions": 0, "resume_tokens": 0,
+                        "spec_drafted": 0, "spec_accepted": 0,
+                        "oom_sheds": 0}
         self._armed = False
         self._occ_gauge = None
         self._queue_gauge = None
+        self._blocks_free_gauge = None
+        self._blocks_shared_gauge = None
+        self._spec_gauge = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -1024,21 +1532,47 @@ class DecodeEngine(object):
             raise RuntimeError(
                 "previous decode-engine loop thread has not exited yet"
             )
-        blocks = 0
-        if self.prefix_cache_mb > 0:
-            blocks = max(1, int(
-                self.prefix_cache_mb * 2 ** 20
-                // _gpt.prefix_block_bytes(self._cfg, self.prefix_block)
-            ))
-        self.session = DecodeSession(
-            self._cfg, place=self._place, scope=self._scope,
-            slots=self._slots_arg, max_len=self._max_len_arg,
-            prefill_buckets=self._buckets_arg, prefix_blocks=blocks,
-            prefix_block=self.prefix_block,
-            build_resume=bool(blocks or self.prefill_chunk),
-        )
-        self.prefix = PrefixCache(blocks, self.prefix_block) \
-            if blocks else None
+        if self._paged:
+            self.session = DecodeSession(
+                self._cfg, place=self._place, scope=self._scope,
+                slots=self._slots_arg, max_len=self._max_len_arg,
+                prefill_buckets=self._buckets_arg,
+                block_size=self.block_size,
+                pool_blocks=self._pool_blocks_arg,
+                spec_tokens=self.spec_tokens,
+                window_cap=self.prefill_chunk,
+            )
+            self.allocator = BlockAllocator(self.session.pool_blocks)
+            self.prefix = None
+            self.pindex = None
+            if self.prefix_cache_mb > 0:
+                # the paged store is ZERO-copy (entries pin pool blocks
+                # slots already wrote), so the mb budget caps how many
+                # blocks the store may pin, not a separate allocation
+                cap = max(1, int(
+                    self.prefix_cache_mb * 2 ** 20
+                    // _gpt.paged_block_bytes(self._cfg, self.block_size)
+                ))
+                self.pindex = PagedPrefixIndex(
+                    self.block_size, cap, self.allocator
+                )
+        else:
+            blocks = 0
+            if self.prefix_cache_mb > 0:
+                blocks = max(1, int(
+                    self.prefix_cache_mb * 2 ** 20
+                    // _gpt.prefix_block_bytes(self._cfg,
+                                               self.prefix_block)
+                ))
+            self.session = DecodeSession(
+                self._cfg, place=self._place, scope=self._scope,
+                slots=self._slots_arg, max_len=self._max_len_arg,
+                prefill_buckets=self._buckets_arg, prefix_blocks=blocks,
+                prefix_block=self.prefix_block,
+                build_resume=bool(blocks or self.prefill_chunk),
+            )
+            self.prefix = PrefixCache(blocks, self.prefix_block) \
+                if blocks else None
         if self._param_program is not None:
             self.session.bind_params(self._param_program)
         self._warmup()
@@ -1062,6 +1596,29 @@ class DecodeEngine(object):
             _obs_registry.register_gauge(
                 "decode_queue_depth", self._queue_gauge
             )
+            if self.allocator is not None:
+                # pool pressure at a glance: free blocks left, and how
+                # many are multiply-referenced (prefix sharing at work)
+                self._blocks_free_gauge = lambda e=self: (
+                    e.allocator.free_blocks if e.allocator else 0
+                )
+                _obs_registry.register_gauge(
+                    "decode_blocks_free", self._blocks_free_gauge
+                )
+                self._blocks_shared_gauge = lambda e=self: (
+                    e.allocator.shared_blocks if e.allocator else 0
+                )
+                _obs_registry.register_gauge(
+                    "decode_blocks_shared", self._blocks_shared_gauge
+                )
+            if self._spec_width > 1:
+                self._spec_gauge = lambda e=self: (
+                    e._counts["spec_accepted"]
+                    / max(e._counts["spec_drafted"], 1)
+                )
+                _obs_registry.register_gauge(
+                    "decode_spec_acceptance", self._spec_gauge
+                )
             _xla_stats.arm_serving_steady()
             self._armed = True
             self._thread = threading.Thread(
@@ -1076,18 +1633,24 @@ class DecodeEngine(object):
             if self._armed:
                 _xla_stats.disarm_serving_steady()
                 self._armed = False
-            if self._occ_gauge is not None:
-                _obs_registry.unregister_gauge(
-                    "serving_slot_occupancy", self._occ_gauge
-                )
-                self._occ_gauge = None
-            if self._queue_gauge is not None:
-                _obs_registry.unregister_gauge(
-                    "decode_queue_depth", self._queue_gauge
-                )
-                self._queue_gauge = None
+            self._drop_gauges()
             raise
         return self
+
+    def _drop_gauges(self):
+        """Unregister every gauge this engine published (start-failure
+        unwind and stop share the teardown)."""
+        for name, attr in (
+            ("serving_slot_occupancy", "_occ_gauge"),
+            ("decode_queue_depth", "_queue_gauge"),
+            ("decode_blocks_free", "_blocks_free_gauge"),
+            ("decode_blocks_shared", "_blocks_shared_gauge"),
+            ("decode_spec_acceptance", "_spec_gauge"),
+        ):
+            fn = getattr(self, attr)
+            if fn is not None:
+                _obs_registry.unregister_gauge(name, fn)
+                setattr(self, attr, None)
 
     def _warmup(self):
         """Compile every shape the steady state can touch: each prefill
@@ -1098,6 +1661,24 @@ class DecodeEngine(object):
         with _xla_stats.warmup_window(), _trace.span(
             "decode_warmup", cat="serving"
         ):
+            if sess.paged:
+                # every paged shape: each window bucket, each step
+                # width (1 + the spec verify), and the COW block copy.
+                # All-sink tables make every warmup write inert garbage
+                # in reserved block 0 — nothing live to reset but the
+                # pool zeroing below keeps tests deterministic
+                sink = [0] * sess.max_blocks
+                for T in sess.buckets:
+                    sess.paged_window(sink, [0] * T, 0)
+                for w in sorted(sess._paged_step):
+                    sess.paged_step(
+                        np.zeros((sess.slots, w), "int64"),
+                        [0] * sess.slots, [()] * sess.slots,
+                        [False] * sess.slots, width=w,
+                    )
+                sess.block_copy([0], [0])
+                sess.reset_caches()
+                return
             for T in sess.buckets:
                 P = min(T, sess.max_len - 1)
                 sess.prefill(0, [0] * P)
@@ -1131,16 +1712,7 @@ class DecodeEngine(object):
         if self._armed:
             _xla_stats.disarm_serving_steady()
             self._armed = False
-        if self._occ_gauge is not None:
-            _obs_registry.unregister_gauge(
-                "serving_slot_occupancy", self._occ_gauge
-            )
-            self._occ_gauge = None
-        if self._queue_gauge is not None:
-            _obs_registry.unregister_gauge(
-                "decode_queue_depth", self._queue_gauge
-            )
-            self._queue_gauge = None
+        self._drop_gauges()
         # drain under the SAME lock submit() enqueues under, and flip
         # started inside it: a submit racing this stop either lands
         # before the drain (failed here) or observes stopped and raises —
@@ -1152,6 +1724,9 @@ class DecodeEngine(object):
             self._prefilling.clear()
             pending = list(self._pending)
             self._pending.clear()
+            # paged block ownership dies with the session+allocator the
+            # next start() rebuilds — just drop the host-side tables
+            self._slot_blocks.clear()
             self.started = False
         err = ServingError("decode engine stopped")
         for stream in failed:
@@ -1235,8 +1810,11 @@ class DecodeEngine(object):
                 "prompt of %d tokens leaves no room to generate "
                 "(max_len %d)" % (len(prompt), self.session.max_len)
             )
-        # validates the FULL re-prefilled length against the ladder
-        self.session.bucket_for(len(prompt) + len(resume))
+        # validates the FULL re-prefilled length against the ladder —
+        # legacy only: paged windows tile ANY prompt length under
+        # max_len (the ladder there only shapes window buckets)
+        if not self._paged:
+            self.session.bucket_for(len(prompt) + len(resume))
         if max_new_tokens is not None and max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         stream = GenerationStream(prompt, max_new_tokens=max_new_tokens,
@@ -1274,6 +1852,26 @@ class DecodeEngine(object):
                            top_k=top_k, top_p=top_p, seed=seed,
                            resume_tokens=resume_tokens)
 
+    def set_spec_width(self, width):
+        """Runtime speculation toggle for a paged engine: switch the
+        fused step between its COMPILED widths — 1 (plain decode) and
+        ``spec_tokens`` (the batched verify). Both programs are built
+        and warmed at ``start()``, so this is an ops lever, not a
+        recompile: a workload whose measured ``decode_spec_acceptance``
+        makes drafting a net loss drops to width 1 without an engine
+        restart (and back). Token streams are identical either way —
+        the verify path's accept loop guarantees it."""
+        w = int(width)
+        if not self._paged:
+            raise ValueError("spec width is a paged-engine knob")
+        if w != 1 and w != max(self.spec_tokens, 1):
+            raise ValueError(
+                "width %d not compiled (this engine has 1%s)"
+                % (w, " and %d" % self.spec_tokens
+                   if self.spec_tokens > 1 else "")
+            )
+        self._spec_width = w
+
     def stats(self):
         """THIS engine's counters + live occupancy snapshot (the
         process-global profiler counters additionally aggregate every
@@ -1296,9 +1894,23 @@ class DecodeEngine(object):
             "prefix_cached_tokens": self._counts["prefix_cached_tokens"],
             "resume_admissions": self._counts["resume_admissions"],
             "resume_tokens": self._counts["resume_tokens"],
+            "spec_drafted": self._counts["spec_drafted"],
+            "spec_accepted": self._counts["spec_accepted"],
+            "oom_sheds": self._counts["oom_sheds"],
         }
+        if self._counts["spec_drafted"]:
+            out["spec_acceptance"] = (
+                self._counts["spec_accepted"]
+                / self._counts["spec_drafted"]
+            )
+        if self.allocator is not None:
+            paged = self.allocator.stats()
+            paged["block_size"] = self.block_size
+            out["paged"] = paged
         if self.prefix is not None:
             out["prefix_store"] = self.prefix.stats()
+        if self.pindex is not None:
+            out["prefix_store"] = self.pindex.stats()
         return out
 
     # -- engine loop ---------------------------------------------------------
@@ -1320,14 +1932,16 @@ class DecodeEngine(object):
                 # admissions == retirements + occupancy invariant holds
                 # across recovered failures (prefilling slots were never
                 # counted as admissions, so they free without a tally)
-                for slot in list(self._active.values()):
+                for idx, slot in list(self._active.items()):
                     slot.stream._fail(e)
+                    self._release_slot_blocks(idx)
                     _profiler.bump_counter("serving_slot_retirements")
                     self._counts["retirements"] += 1
                 self._free.extend(self._active.keys())
                 self._active.clear()
-                for job in list(self._prefilling.values()):
+                for idx, job in list(self._prefilling.items()):
                     job.stream._fail(e)
+                    self._release_slot_blocks(idx)
                 self._free.extend(self._prefilling.keys())
                 self._prefilling.clear()
 
@@ -1357,6 +1971,7 @@ class DecodeEngine(object):
             if slot.stream._cancelled:
                 self._active.pop(idx, None)
                 self._free.append(idx)
+                self._release_slot_blocks(idx)
                 _profiler.bump_counter("serving_slot_retirements")
                 self._counts["retirements"] += 1
                 slot.stream._finish("cancelled")
@@ -1367,6 +1982,7 @@ class DecodeEngine(object):
                 # first token emits, which never happened
                 self._prefilling.pop(idx, None)
                 self._free.append(idx)
+                self._release_slot_blocks(idx)
                 job.stream._finish("cancelled")
         with self._cond:
             if any(s._cancelled for s in self._pending):
@@ -1426,6 +2042,9 @@ class DecodeEngine(object):
                 stream._finish("cancelled")
                 continue
             slot_idx = self._free.pop()
+            if self._paged:
+                self._admit_paged(slot_idx, stream)
+                continue
             # the resume form re-prefills prompt + emitted suffix — the
             # same admission machinery (prefix copies, window planning)
             # serves both, which is exactly what makes a resumed
@@ -1492,6 +2111,121 @@ class DecodeEngine(object):
                         continue
                     self._prefilling[slot_idx] = job
 
+    def _admit_paged(self, slot_idx, stream):
+        """Paged admission: a prefix hit EDITS the slot's block table
+        (matched store blocks incref'd straight in — no device copy),
+        fresh blocks cover exactly ``ceil(len(prompt)/block)`` minus the
+        hit, and the prompt prefills through bucket-shaped windows fed
+        the table. Slot HBM footprint is the prompt's ceil, not max_len.
+        Pool exhaustion (after refcount-eviction of store-only blocks)
+        sheds the request with the overload contract instead of
+        corrupting a neighbor."""
+        prompt = stream.full_prompt()
+        entries, hit_tokens = [], 0
+        if self.pindex is not None:
+            # lookup increfs each matched block — those references ARE
+            # the slot's table entries on success
+            entries, hit_tokens = self.pindex.lookup(prompt)
+        prefix_tokens, wins = self._plan_windows(len(prompt), hit_tokens)
+        bs = self.block_size
+        if prefix_tokens < hit_tokens:
+            keep = prefix_tokens // bs
+            self.allocator.decref([e.block_idx for e in entries[keep:]])
+            entries = entries[:keep]
+        blocks = [e.block_idx for e in entries]
+        need = -(-len(prompt) // bs) - len(blocks)
+        owned = self._alloc_blocks(need)
+        if owned is None:
+            if blocks:
+                self.allocator.decref(blocks)
+            self._free.append(slot_idx)
+            _profiler.bump_counter("decode_paged_oom_sheds")
+            self._counts["oom_sheds"] += 1
+            stream._fail(ServerOverloadedError(
+                "paged KV pool exhausted (%d blocks short after "
+                "eviction)" % need, retry_after_ms=50,
+            ))
+            return
+        self._slot_blocks[slot_idx] = blocks + owned
+        stream.cached_prefix_tokens = prefix_tokens
+        if self.pindex is not None:
+            if prefix_tokens:
+                _profiler.bump_counter("decode_prefix_hits")
+                _profiler.bump_counter("decode_prefix_cached_tokens",
+                                       prefix_tokens)
+                self._counts["prefix_hits"] += 1
+                self._counts["prefix_cached_tokens"] += prefix_tokens
+            else:
+                _profiler.bump_counter("decode_prefix_misses")
+                self._counts["prefix_misses"] += 1
+        stream.admit_windows = len(wins)
+        job = _PrefillJob(stream, wins, prefix_tokens)
+        if len(wins) == 1:
+            with _stream_scope(stream):
+                self._run_prefill_window(slot_idx, job)
+        else:
+            with self._cond:
+                if self._stop or not self.started:
+                    self._free.append(slot_idx)
+                    self._release_slot_blocks(slot_idx)
+                    stream._fail(ServingError("decode engine stopped"))
+                    return
+                self._prefilling[slot_idx] = job
+
+    # -- paged block bookkeeping ---------------------------------------------
+    def _alloc_blocks(self, n):
+        """Allocator take with prefix-store pressure relief: when the
+        free list runs dry, evict store entries whose block the store
+        alone references (each decref actually frees a block) and retry.
+        None = genuinely out of memory — the caller sheds."""
+        got = self.allocator.alloc(n)
+        while got is None and self.pindex is not None \
+                and self.pindex.evict_one(need_free=True):
+            got = self.allocator.alloc(n)
+        return got
+
+    def _release_slot_blocks(self, slot_idx):
+        """Drop the slot's reference on every block its table holds —
+        owned blocks free, prefix-shared blocks survive under the
+        store's (or another slot's) remaining references. The paged
+        retirement path; a no-op for legacy engines."""
+        blocks = self._slot_blocks.pop(slot_idx, None)
+        if blocks and self.allocator is not None:
+            self.allocator.decref(blocks)
+
+    def _ensure_writable(self, slot_idx, block_i):
+        """Copy-on-write: if logical block ``block_i`` of the slot's
+        table is shared (refs > 1), duplicate it into a fresh block and
+        swap the table entry before this tick writes it. Block-aligned
+        admission never shares a block any writer touches, so this is a
+        defensive invariant, not a hot path."""
+        blocks = self._slot_blocks[slot_idx]
+        blk = blocks[block_i]
+        if self.allocator.refs(blk) <= 1:
+            return
+        got = self._alloc_blocks(1)
+        if got is None:
+            raise ServerOverloadedError(
+                "paged KV pool exhausted during copy-on-write",
+                retry_after_ms=50,
+            )
+        with _xla_stats.serving_request_window():
+            self.session.block_copy([blk], got)
+        blocks[block_i] = got[0]
+        self.allocator.decref([blk])
+
+    def _trim_blocks(self, slot_idx, next_pos):
+        """Speculative rollback by table edit: free the slot's blocks
+        strictly past the one its next write position lands in — the
+        rejected draft tail's K/V becomes unreferenced pool garbage
+        (the step bias already never let anything attend to it)."""
+        blocks = self._slot_blocks.get(slot_idx)
+        keep = next_pos // self.block_size + 1
+        if blocks and len(blocks) > keep:
+            tail = blocks[keep:]
+            del blocks[keep:]
+            self.allocator.decref(tail)
+
     def _advance_prefills(self):
         """Run ONE window of ONE chunked-prefill job — oldest first.
         One bucket-shaped window per tick total is the tick bound:
@@ -1513,7 +2247,13 @@ class DecodeEngine(object):
         s, e = job.windows[job.wi]
         try:
             with _xla_stats.serving_request_window():
-                if s == 0 and e == len(prompt):
+                if self._paged:
+                    # every paged prefill is a table-fed window
+                    # (monolithic = a window at offset 0)
+                    logits = self.session.paged_window(
+                        self._slot_blocks[slot_idx], prompt[s:e], s
+                    )
+                elif s == 0 and e == len(prompt):
                     # whole prompt in one window from position 0: the
                     # monolithic prefill program (cheaper — window-local
                     # [T, T] attention, flash-capable)
@@ -1532,6 +2272,7 @@ class DecodeEngine(object):
                     if self._stop or not self.started:
                         self._prefilling.pop(slot_idx, None)
                         self._free.append(slot_idx)
+                        self._release_slot_blocks(slot_idx)
                         stream._fail(ServingError("decode engine stopped"))
                         return
                     self._prefilling[slot_idx] = job
@@ -1544,10 +2285,18 @@ class DecodeEngine(object):
         except Exception as exc:  # noqa: BLE001 - per-request failure
             self._prefilling.pop(slot_idx, None)
             self._free.append(slot_idx)
+            self._release_slot_blocks(slot_idx)
             stream._fail(exc)
             return
         self._prefilling.pop(slot_idx, None)
-        if self.prefix is not None:
+        if self._paged:
+            if self.pindex is not None:
+                # zero-copy publish: the store indexes the slot's OWN
+                # blocks (one incref each) — no device program runs, so
+                # unlike the legacy copy path there is no failure mode
+                # to unwind
+                self.pindex.publish(prompt, self._slot_blocks[slot_idx])
+        elif self.prefix is not None:
             self._publish_blocks(slot_idx, prompt)
         # a resume admission's budget accounting continues the ORIGINAL
         # request: the replayed suffix counts as already generated
@@ -1561,6 +2310,7 @@ class DecodeEngine(object):
             # here instead
             if self._stop or not self.started:
                 self._free.append(slot_idx)
+                self._release_slot_blocks(slot_idx)
                 stream._fail(ServingError("decode engine stopped"))
                 return
             self._active[slot_idx] = slot
@@ -1627,12 +2377,18 @@ class DecodeEngine(object):
             # drained _active concurrently
             self._active.pop(slot_idx, None)
             self._free.append(slot_idx)
+            # paged retirement is a refcount decrement: owned blocks
+            # free, published blocks live on under the store's reference
+            self._release_slot_blocks(slot_idx)
             _profiler.bump_counter("serving_slot_retirements")
             self._counts["retirements"] += 1
             stream._finish(reason)
 
     def _step(self):
         """One fused decode step over every active slot."""
+        if self._paged:
+            self._step_paged()
+            return
         sess = self.session
         tokens = [0] * sess.slots
         positions = [0] * sess.slots
@@ -1685,3 +2441,129 @@ class DecodeEngine(object):
             slot.generated += 1
             slot.pending_token = tok
             self._emit(idx, slot, tok)
+
+    def _step_paged(self):
+        """One fused paged tick over every active slot — the plain
+        decode step when speculation is off, or the batched VERIFY when
+        ``decode_spec_tokens`` = k > 1: each slot's window is its
+        pending token plus a k-1-token draft, ONE program scores all k
+        positions, and the host accepts the longest emitted prefix that
+        matches what sequential decoding would have said.
+
+        Token-exactness: query j's logits are computed with positions
+        <= next_pos+j holding exactly the window tokens, and the accept
+        loop only consumes logits[j+1] after confirming the token at
+        position next_pos+j+1 (draft j+1) equals the one it just
+        emitted — so every consumed logits row is bitwise the row the
+        sequential engine would have produced. Each EMITTED token costs
+        exactly one ``pick`` (greedy: zero RNG draws; sampled: the PR 13
+        one-uniform inverse-CDF draw), so ``fast_forward_rng`` resume
+        and seeded replay hold unchanged. The rejected tail's K/V is
+        dead weight the step bias never exposes; ``_trim_blocks`` rolls
+        whole rejected blocks back by table edit."""
+        sess = self.session
+        width = self._spec_width
+        bs = self.block_size
+        # grow each active slot's table through this window's last
+        # write; a slot the pool cannot cover (even after store
+        # eviction) sheds with the overload contract
+        for idx, slot in list(self._active.items()):
+            need = (slot.next_pos + width - 1) // bs + 1
+            blocks = self._slot_blocks[idx]
+            shed = None
+            if need > len(blocks):
+                got = self._alloc_blocks(need - len(blocks))
+                if got is None:
+                    shed = ServerOverloadedError(
+                        "paged KV pool exhausted mid-generation",
+                        retry_after_ms=50,
+                    )
+                else:
+                    blocks.extend(got)
+            if shed is None:
+                try:
+                    for bi in range(slot.next_pos // bs, need):
+                        self._ensure_writable(idx, bi)
+                except Exception as exc:  # noqa: BLE001 - shed this slot
+                    shed = exc
+            if shed is not None:
+                self._active.pop(idx, None)
+                self._free.append(idx)
+                self._release_slot_blocks(idx)
+                _profiler.bump_counter("serving_slot_retirements")
+                self._counts["retirements"] += 1
+                _profiler.bump_counter("decode_paged_oom_sheds")
+                self._counts["oom_sheds"] += 1
+                slot.stream._fail(shed)
+        if not self._active:
+            return
+        tokens = np.zeros((sess.slots, width), "int64")
+        positions = [0] * sess.slots
+        active = [False] * sess.slots
+        tables = [()] * sess.slots
+        windows = {}
+        for idx, slot in self._active.items():
+            win = [slot.pending_token]
+            if width > 1:
+                hist = slot.stream.full_prompt() + slot.stream._tokens
+                win += self._drafter(hist, width - 1)
+            windows[idx] = win
+            tokens[idx, :] = win
+            positions[idx] = slot.next_pos
+            active[idx] = True
+            tables[idx] = self._slot_blocks[idx]
+        # idle AND mid-prefill slots keep the all-sink default table:
+        # their scatter-writes land in reserved block 0, so unlike the
+        # legacy step there is no write position to aim
+        tids = sorted({
+            s.stream.trace_ctx[0] for s in self._active.values()
+            if getattr(s.stream, "trace_ctx", None)
+        }) if _trace.enabled() else None
+        if tids:
+            with _trace.span("decode_tick", cat="serving",
+                             tick=self.tick, trace_ids=tids), \
+                    _xla_stats.serving_request_window():
+                logits = sess.paged_step(tokens, positions, tables,
+                                         active, width=width)
+        else:
+            with _xla_stats.serving_request_window():
+                logits = sess.paged_step(tokens, positions, tables,
+                                         active, width=width)
+        self.tick += 1
+        for idx in list(self._active.keys()):
+            slot = self._active[idx]
+            win = windows[idx]
+            emitted = 0
+            failed = False
+            for j in range(width):
+                try:
+                    tok = slot.stream.pick(logits[idx, j])
+                except Exception as e:  # noqa: BLE001 - this stream only
+                    self._active.pop(idx, None)
+                    self._free.append(idx)
+                    self._release_slot_blocks(idx)
+                    _profiler.bump_counter("serving_slot_retirements")
+                    self._counts["retirements"] += 1
+                    slot.stream._fail(e)
+                    failed = True
+                    break
+                emitted += 1
+                slot.next_pos += 1
+                slot.generated += 1
+                slot.pending_token = tok
+                self._emit(idx, slot, tok)
+                if idx not in self._active:
+                    break  # retired: eos / length budget hit mid-window
+                if j < width - 1 and tok != win[j + 1]:
+                    break  # draft diverged — the tail is dead weight
+            if width > 1 and not failed:
+                drafted = width - 1
+                accepted = max(emitted - 1, 0)
+                _profiler.bump_counter("decode_spec_drafted", drafted)
+                _profiler.bump_counter("decode_spec_accepted", accepted)
+                self._counts["spec_drafted"] += drafted
+                self._counts["spec_accepted"] += accepted
+                slot.stream.spec_drafted += drafted
+                slot.stream.spec_accepted += accepted
+            if idx in self._active:
+                self._trim_blocks(idx, slot.next_pos)

@@ -1073,6 +1073,16 @@ def _make_handler(gw):
                 "resumed_tokens": len(getattr(
                     stream, "resume_tokens", ()) or ()),
             }
+            # speculative-decoding facts (paged engine v2): drafted /
+            # accepted counts plus the per-request acceptance rate —
+            # only when the engine actually drafted, so legacy engines'
+            # payloads and log lines stay byte-identical
+            drafted = int(getattr(stream, "spec_drafted", 0) or 0)
+            if drafted:
+                accepted = int(getattr(stream, "spec_accepted", 0) or 0)
+                facts["spec_drafted"] = drafted
+                facts["spec_accepted"] = accepted
+                facts["spec_acceptance"] = round(accepted / drafted, 4)
             # engine-tick journey fact for the flight record: how many
             # fused decode ticks this generation spanned
             ft = getattr(stream, "first_tick", None)

@@ -157,6 +157,47 @@ def test_bank_best_never_promotes_prefix_entry(bench_mod):
     assert e["ttft_ms"] == 3.2 and e["prefix_share"] == 0.9
 
 
+def test_bank_best_never_promotes_paged_or_spec_entry(bench_mod):
+    """The ISSUE 16 rungs bank amortized rates the cold 'gpt_decode'
+    headline must never inherit: gpt_decode_paged serves seq-4k streams
+    off a small anchored pool, and gpt_decode_spec multiplies
+    tokens/sec by drafting — both are guarded behind their own prefix
+    words, mirroring the serving/prefix guards."""
+    b = bench_mod
+    b.bank_write(
+        "gpt_decode_paged",
+        {"metric": "gpt2_decode_paged_throughput", "value": 77777.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 4096,
+         "device": "tpu", "decode": True, "paged": True,
+         "paged_block": 16, "pool_blocks": 129, "oom_sheds": 0},
+    )
+    b.bank_write(
+        "gpt_decode_spec",
+        {"metric": "gpt2_decode_spec_throughput", "value": 66666.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 256,
+         "device": "tpu", "decode": True, "spec": True,
+         "spec_tokens": 4, "spec_speedup": 2.4, "spec_acceptance": 0.8,
+         "draft_accuracy": 0.9},
+    )
+    b.bank_write(
+        "gpt_decode",
+        {"metric": "gpt2_decode_throughput", "value": 120.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 256,
+         "device": "tpu", "decode": True},
+    )
+    # the cold decode headline sees neither v2 rung
+    slot, e = b.bank_best("gpt_decode")
+    assert slot == "gpt_decode"
+    assert not e.get("paged") and not e.get("spec")
+    # each v2 rung is retrievable only by its own prefix word, with its
+    # facts intact through the bank round-trip
+    slot, e = b.bank_best("gpt_decode_paged")
+    assert e["paged"] is True and e["pool_blocks"] == 129
+    slot, e = b.bank_best("gpt_decode_spec")
+    assert e["spec"] is True and e["spec_speedup"] == 2.4
+    assert e["spec_acceptance"] == 0.8 and e["draft_accuracy"] == 0.9
+
+
 def test_degraded_cpu_line_has_null_vs_baseline(bench_mod):
     b = bench_mod
     line = b._resnet_line({"ips": 0.7, "device": "cpu"}, 8, ["tpu: killed"], True)
@@ -166,10 +207,13 @@ def test_degraded_cpu_line_has_null_vs_baseline(bench_mod):
     assert bline["vs_baseline"] is None
 
 
+@pytest.mark.slow  # ~20 s: spawns the real bench parent + per-rung children
 def test_parent_emits_banked_line_when_tunnel_dead(tmp_path):
     """End-to-end: with a pre-seeded bank and a dead 'tunnel' (TPU slots
     scaled to ~instant kills on a CPU-only child), bench.py must emit the
-    banked TPU line, skip the CPU fallback, and exit 0."""
+    banked TPU line, skip the CPU fallback, and exit 0. The banked-line
+    CONTENT is covered in-process by the tests above; this is the
+    subprocess wiring only, so it rides tier-2."""
     bank = {
         "resnet50": {"metric": "resnet50_train_throughput", "value": 1384.0,
                      "unit": "images/sec/chip", "batch": 256, "device": "tpu",

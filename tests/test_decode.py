@@ -671,7 +671,7 @@ def test_decode_probe_fast_acceptance():
         p.stdout[-3000:], p.stderr[-2000:]
     )
     assert "PROBE PASS" in p.stdout
-    assert report["schema_version"] == 2
+    assert report["schema_version"] == 3
     assert all(report["parity"].values()), report["parity"]
     assert report["strict"]["steady_recompiles"] == 0
     assert report["strict"]["churn_errors"] == 0
@@ -687,6 +687,13 @@ def test_decode_probe_fast_acceptance():
     assert ch["intertoken_p99_ms"] < ch["bound_ms"], ch
     ev = report["evictions"]
     assert ev["evictions"] >= 1 and ev["evicted_readmit_parity"], ev
+    # ISSUE 16 tentpole bars: paged + speculative engine v2
+    assert all(report["paged_parity"].values()), report["paged_parity"]
+    sp = report["spec"]
+    assert sp["spec_parity"], sp
+    assert sp["acceptance"] > 0.5, sp
+    assert sp["spec_gain"] >= 1.3, sp
+    assert sp["steady_recompiles"] == 0, sp
 
 
 # ---------------------------------------------------------------------------

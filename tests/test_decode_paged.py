@@ -1,0 +1,459 @@
+"""Decode engine v2: paged KV block tables + speculative decoding.
+
+Covers the ISSUE-16 tentpole surfaces: the paged cache ops as units
+(permuted / shared / copy-on-write tables), the host-side block
+allocator and zero-copy prefix index, and the engine end-to-end —
+greedy + seeded-sampled token parity vs the full-forward oracle with
+speculation forced through EVERY accept/reject split point, prefix
+hit / chunked / resume admissions, prefix eviction, pool-OOM shedding,
+and the zero-steady-recompile invariant under the armed strict gate.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import profiler
+from paddle_tpu.models import gpt
+from paddle_tpu.observability import registry as obs_registry
+from paddle_tpu.serving import decode as sdecode
+from paddle_tpu.serving.batcher import ServerOverloadedError
+
+MAX_LEN = 20
+SLOTS = 3
+BLOCK = 4
+SPEC_K = 4
+
+
+# -- op units ---------------------------------------------------------------
+def test_kv_cache_paged_write_gather_ops():
+    """The paged scatter/gather pair through arbitrary runtime tables:
+    a permuted write lands each token at tables[s, pos//B] offset
+    pos%B, and a gather materializes each slot's logical row through
+    its table — including one pool block SHARED by two tables."""
+    NB, H, B, D, S, MB = 7, 2, BLOCK, 3, 2, 3
+    T = 6  # window longer than one block, not block-aligned at the end
+    rs = np.random.RandomState(3)
+    pool0 = rs.randn(NB, H, B, D).astype("f4")
+    new = rs.randn(S, H, T, D).astype("f4")
+    tables = np.array([[5, 2, 6], [3, 1, 4]], "int64")
+    pos = np.array([[2], [0]], "int64")  # slot 0 starts mid-block
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cache = main.global_block().create_var(
+            name="pp", shape=[NB, H, B, D], dtype="float32",
+            persistable=True)
+        nv = fluid.layers.data(name="nv", shape=[H, T, D],
+                               dtype="float32")
+        tb = fluid.layers.data(name="tb", shape=[MB], dtype="int64")
+        ps = fluid.layers.data(name="ps", shape=[1], dtype="int64")
+        out = fluid.layers.kv_cache_write_paged(cache, nv, tb, ps)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    scope.set("pp", pool0.copy())
+    (got,) = exe.run(main, feed={"nv": new, "tb": tables, "ps": pos},
+                     fetch_list=[out], scope=scope)
+    want = pool0.copy()
+    for s in range(S):
+        for j in range(T):
+            a = int(pos[s, 0]) + j
+            want[tables[s, a // B], :, a % B, :] = new[s, :, j, :]
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(scope.get("pp")), want)
+
+    # gather through tables that SHARE pool block 2 between both slots
+    gtab = np.array([[5, 2, 6], [2, 1, 4]], "int64")
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        cache2 = main2.global_block().create_var(
+            name="pp", shape=[NB, H, B, D], dtype="float32",
+            persistable=True)
+        tb2 = fluid.layers.data(name="tb", shape=[MB], dtype="int64")
+        row = fluid.layers.kv_cache_gather_paged(cache2, tb2)
+    (grow,) = exe.run(main2, feed={"tb": gtab}, fetch_list=[row],
+                      scope=scope)
+    assert grow.shape == (S, H, MB * B, D)
+    for s in range(S):
+        wrow = np.concatenate([want[gtab[s, b]] for b in range(MB)],
+                              axis=1)
+        np.testing.assert_array_equal(grow[s], wrow)
+
+
+def test_kv_cache_block_copy_op_cow():
+    """The COW primitive: Cache[dst] = Cache[src] per fed pair, with a
+    src==dst pair degenerating to a no-op (callers pad with those to
+    reuse one compiled pair count)."""
+    NB, H, B, D = 5, 2, BLOCK, 3
+    rs = np.random.RandomState(7)
+    pool0 = rs.randn(NB, H, B, D).astype("f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cache = main.global_block().create_var(
+            name="bc", shape=[NB, H, B, D], dtype="float32",
+            persistable=True)
+        src = fluid.layers.data(name="src", shape=[2], dtype="int64")
+        dst = fluid.layers.data(name="dst", shape=[2], dtype="int64")
+        out = fluid.layers.kv_cache_block_copy(cache, src, dst)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    scope.set("bc", pool0.copy())
+    (got,) = exe.run(
+        main, feed={"src": np.array([[3, 1]], "int64"),
+                    "dst": np.array([[4, 1]], "int64")},
+        fetch_list=[out], scope=scope)
+    want = pool0.copy()
+    want[4] = pool0[3]  # the COW duplicate; [1]->[1] is the no-op pad
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(scope.get("bc")), want)
+
+
+# -- host-ledger units ------------------------------------------------------
+def test_block_allocator_freelist_refcount_oom():
+    al = sdecode.BlockAllocator(6)  # sink + 5
+    assert al.free_blocks == 5 and al.shared_blocks == 0
+    a = al.alloc(2)
+    assert sorted(a) == [1, 2]  # low ids first
+    assert al.alloc(4) is None  # all-or-nothing: 3 free < 4
+    assert al.free_blocks == 3  # the failed alloc took nothing
+    al.incref([a[0]])
+    assert al.refs(a[0]) == 2 and al.shared_blocks == 1
+    assert al.decref(a) == 1  # a[0] survives under the extra ref
+    assert al.refs(a[0]) == 1 and al.free_blocks == 4
+    assert al.decref([a[0]]) == 1
+    assert al.free_blocks == 5
+    with pytest.raises(ValueError):
+        al.decref([a[0]])  # double free
+    with pytest.raises(ValueError):
+        al.incref([sdecode.BlockAllocator.SINK])  # sink is untouchable
+    with pytest.raises(ValueError):
+        al.decref([0])
+    assert al.alloc(0) == []
+    assert al.stats() == {"blocks": 6, "free": 5, "shared": 0}
+
+
+def test_paged_prefix_index_lookup_publish_evict():
+    """Zero-copy store semantics: publish pins the slot's own blocks by
+    refcount, lookup increfs every matched block for the caller, and
+    eviction under allocator pressure (need_free) only takes entries
+    whose block the store ALONE references."""
+    al = sdecode.BlockAllocator(10)
+    ix = sdecode.PagedPrefixIndex(BLOCK, 3, al)
+    p1 = list(range(10))  # blocks [0:4], [4:8]; tail never cached
+    assert ix.lookup(p1) == ([], 0)
+    owned = al.alloc(3)  # an admitted slot's table
+    new = ix.publish(p1, owned)
+    assert [e.block_idx for e in new] == owned[:2]
+    assert al.refs(owned[0]) == 2  # slot ref + store pin
+    # the slot retires: store pins keep both published blocks alive
+    al.decref(owned)
+    assert al.refs(owned[0]) == 1 and al.refs(owned[2]) == 0
+    ent, toks = ix.lookup(p1[:9])  # 9 tokens -> both blocks usable
+    assert toks == 8 and [e.block_idx for e in ent] == owned[:2]
+    assert al.refs(owned[0]) == 2  # lookup increfed for the caller
+    # full-block prompt caps at len-1 like the legacy cache
+    ent2, toks2 = ix.lookup(p1[:8])
+    assert toks2 == 4 and len(ent2) == 1
+    al.decref([e.block_idx for e in ent2])
+    # need_free eviction skips blocks a live slot still shares
+    free0 = al.free_blocks
+    assert ix.evict_one(need_free=True) is False  # both blocks shared
+    al.decref([e.block_idx for e in ent])  # "slot" drops its refs
+    assert ix.evict_one(need_free=True) is True
+    assert al.free_blocks == free0 + 1  # entry's decref freed its block
+    # pin budget: publishing past max_blocks evicts LRU entries
+    b2 = al.alloc(3)
+    ix.publish(list(range(100, 112)), b2)
+    assert len(ix) <= ix.max_blocks
+    assert ix.evictions >= 2
+
+
+def test_spec_drafters():
+    """Built-in drafters: trailing-n-gram continuation (longest n wins,
+    most recent earlier match) and last-token repetition; both pad to
+    k and never crash on short histories."""
+    h = [5, 1, 2, 3, 9, 1, 2, 3]
+    assert sdecode._ngram_draft(h, 3) == [9, 1, 2]  # trigram [1,2,3]
+    assert sdecode._repeat_draft(h, 2) == [3, 3]
+    assert len(sdecode._ngram_draft([7], 4)) == 4
+    assert sdecode._ngram_draft([], 2) == [0, 0]
+    with pytest.raises(ValueError):
+        sdecode.DecodeEngine(gpt.GPTConfig.tiny(), spec_draft="nope",
+                             block_size=BLOCK)
+    with pytest.raises(ValueError):
+        # speculation without the paged runtime is a config error
+        sdecode.DecodeEngine(gpt.GPTConfig.tiny(), spec_tokens=3)
+
+
+# -- engine end-to-end ------------------------------------------------------
+@pytest.fixture(scope="module")
+def pg():
+    """One model + oracle shared by a paged+speculative engine (k=4,
+    prefix index 4 blocks, chunked prefill 8) and a LEGACY engine on
+    the same params — the cross-engine sampled-parity reference. The
+    spec engine's drafter is swappable per-test via the dict."""
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = MAX_LEN + SPEC_K  # spec headroom
+    with fluid.unique_name.guard():
+        infer, startup, _names, logits = gpt.build_gpt_infer(cfg, MAX_LEN)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+    draft = {"fn": sdecode._ngram_draft}
+    engine = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=SLOTS, max_len=MAX_LEN,
+        param_program=infer, block_size=BLOCK, spec_tokens=SPEC_K,
+        prefill_chunk=8,
+        prefix_cache_mb=4 * gpt.paged_block_bytes(cfg, BLOCK) / 2.0 ** 20,
+        drafter=lambda h, k: draft["fn"](h, k),
+    ).start()
+    legacy = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=2, max_len=MAX_LEN,
+        prefill_buckets=[8, MAX_LEN], param_program=infer,
+    ).start()
+
+    def oracle(prompt):
+        return gpt._reference_generate(
+            exe, infer, logits, cfg, prompt, MAX_LEN, scope=scope
+        )
+
+    yield {"cfg": cfg, "infer": infer, "exe": exe, "scope": scope,
+           "engine": engine, "legacy": legacy, "oracle": oracle,
+           "draft": draft}
+    engine.stop()
+    legacy.stop()
+
+
+def _simulate_spec(prompt, full, max_new, width, drafter):
+    """Host mirror of one slot's paged spec schedule: prefill emits
+    token 0, then each tick verifies [pending, drafts] and accepts the
+    longest matching prefix. Returns (tokens, drafted, accepted) — the
+    exact per-stream accounting the engine must report."""
+    out = [full[len(prompt)]]
+    drafted = accepted = 0
+    while len(out) < max_new:
+        win = [out[-1]] + drafter(prompt + out, width - 1)
+        emitted = 0
+        for j in range(width):
+            tok = full[len(prompt) + len(out)]
+            emitted += 1
+            out.append(tok)
+            if len(out) >= max_new:
+                break
+            if j < width - 1 and tok != win[j + 1]:
+                break
+        drafted += width - 1
+        accepted += max(emitted - 1, 0)
+    return out, drafted, accepted
+
+
+def test_paged_spec_parity_every_split_point(pg):
+    """Forced drafters hit every accept/reject split: a perfect drafter
+    (full acceptance), corruption at each draft index c (acceptance
+    stops exactly at c), and an alien drafter (zero acceptance). Token
+    streams stay EXACT vs the full-forward oracle at every split, and
+    the per-stream drafted/accepted tallies match the host schedule."""
+    engine, oracle = pg["engine"], pg["oracle"]
+    rs = np.random.RandomState(11)
+    p = list(rs.randint(0, pg["cfg"].vocab_size, 5))
+    full = oracle(p)
+    max_new = 12
+
+    def forced(corrupt):
+        def fn(hist, k):
+            d = list(full[len(hist):len(hist) + k])
+            d += [0] * (k - len(d))
+            if corrupt is not None and corrupt < len(d):
+                d[corrupt] = (d[corrupt] + 1) % pg["cfg"].vocab_size
+            return d
+        return fn
+
+    want = full[len(p):len(p) + max_new]
+    for corrupt in (None, 0, 1, 2):
+        pg["draft"]["fn"] = forced(corrupt)
+        sim_toks, sim_d, sim_a = _simulate_spec(
+            p, full, max_new, SPEC_K, forced(corrupt))
+        assert sim_toks == want  # the mirror is itself exact
+        s = engine.generate(p, max_new_tokens=max_new)
+        assert s.tokens(timeout=120) == want, "corrupt=%r" % corrupt
+        assert (s.spec_drafted, s.spec_accepted) == (sim_d, sim_a), \
+            "corrupt=%r" % corrupt
+        if corrupt is None:
+            assert s.spec_accepted > 0
+        if corrupt == 0:
+            assert s.spec_accepted == 0
+    pg["draft"]["fn"] = sdecode._ngram_draft
+    st = engine.stats()
+    assert st["spec_drafted"] > 0
+    assert 0.0 <= st["spec_acceptance"] <= 1.0
+
+
+def test_set_spec_width_runtime_toggle(pg):
+    """set_spec_width flips a paged engine between its two compiled
+    verify widths without a restart: width 1 runs token-exact with
+    ZERO drafting (the drafter is never consulted), width k restores
+    speculation, and uncompiled widths or legacy engines refuse."""
+    engine, oracle = pg["engine"], pg["oracle"]
+    rs = np.random.RandomState(7)
+    p = list(rs.randint(0, pg["cfg"].vocab_size, 6))
+    want = oracle(p)[6:][:8]
+
+    def bomb(hist, k):  # width 1 must never draft
+        raise AssertionError("drafter called at width 1")
+
+    pg["draft"]["fn"] = bomb
+    engine.set_spec_width(1)
+    try:
+        s = engine.generate(p, max_new_tokens=8)
+        assert s.tokens(timeout=120) == want
+        assert (s.spec_drafted, s.spec_accepted) == (0, 0)
+    finally:
+        engine.set_spec_width(SPEC_K)
+        pg["draft"]["fn"] = sdecode._ngram_draft
+    s2 = engine.generate(p, max_new_tokens=8)
+    assert s2.tokens(timeout=120) == want
+    assert s2.spec_drafted > 0  # speculation is back on
+    for bad in (0, 2, SPEC_K + 1):
+        with pytest.raises(ValueError):
+            engine.set_spec_width(bad)
+    with pytest.raises(ValueError):
+        pg["legacy"].set_spec_width(1)
+
+
+def test_paged_greedy_parity_and_prefix_hit(pg):
+    """Greedy parity across prompt lengths through the spec engine
+    (acceptance rate must never perturb tokens), then a re-submitted
+    long prompt rides the ZERO-COPY prefix index: cached whole blocks,
+    token-exact, no device copy programs in the paged session."""
+    engine, oracle = pg["engine"], pg["oracle"]
+    rs = np.random.RandomState(0)
+    for n in (1, 3, 9, MAX_LEN - 6):
+        p = list(rs.randint(0, pg["cfg"].vocab_size, n))
+        want = oracle(p)[n:]
+        got = engine.generate(p).tokens(timeout=120)
+        assert got == want, "prompt len %d" % n
+    p = list(rs.randint(0, pg["cfg"].vocab_size, 14))
+    want = oracle(p)[14:][:4]
+    s1 = engine.generate(p, max_new_tokens=4)
+    assert s1.tokens(timeout=120) == want
+    assert s1.cached_prefix_tokens == 0
+    s2 = engine.generate(p, max_new_tokens=4)
+    assert s2.tokens(timeout=120) == want
+    assert s2.cached_prefix_tokens == 12  # 3 whole blocks of the 13 cap
+    st = engine.stats()
+    assert st["prefix_hits"] >= 1
+    assert st["paged"]["block_size"] == BLOCK
+    assert st["prefix_store"]["cached_blocks"] >= 1
+
+
+def test_paged_chunked_resume_and_eviction(pg):
+    """Chunked prefill (windows at block-aligned offsets), resume
+    re-prefill, and prefix-store eviction under the 4-block pin budget
+    all stay token-exact."""
+    engine, oracle = pg["engine"], pg["oracle"]
+    rs = np.random.RandomState(5)
+    p = list(rs.randint(0, pg["cfg"].vocab_size, 13))  # 2 windows @ 8
+    full = oracle(p)
+    s = engine.generate(p, max_new_tokens=5)
+    assert s.tokens(timeout=120) == full[13:18]
+    assert s.admit_windows == 2
+    # resume: the engine re-prefills prompt + suffix and continues
+    sr = engine.generate(p, max_new_tokens=5,
+                         resume_tokens=full[13:15])
+    assert sr.tokens(timeout=120) == full[15:18]
+    # churn distinct prompts through the 4-block store -> evictions;
+    # the original prompt stays exact whatever survived
+    ev0 = engine.pindex.evictions
+    for seed in (31, 32, 33):
+        q = list(np.random.RandomState(seed).randint(
+            0, pg["cfg"].vocab_size, 14))
+        engine.generate(q, max_new_tokens=2).tokens(timeout=120)
+    assert engine.pindex.evictions > ev0
+    s3 = engine.generate(p, max_new_tokens=5)
+    assert s3.tokens(timeout=120) == full[13:18]
+
+
+def test_paged_sampled_parity_vs_legacy_engine(pg):
+    """Seeded sampling through the spec verify path must reproduce the
+    LEGACY engine's stream bit-for-bit: each consumed verify row is the
+    sequential logits row, and one uniform per emitted token keeps the
+    PR-13 resume contract (fast_forward_rng) intact."""
+    engine, legacy = pg["engine"], pg["legacy"]
+    pg["draft"]["fn"] = sdecode._ngram_draft
+    p = [2, 9, 4, 9, 4]
+    kw = dict(max_new_tokens=10, temperature=0.8, top_k=32, seed=123)
+    want = legacy.generate(p, **kw).tokens(timeout=120)
+    got = engine.generate(p, **kw).tokens(timeout=120)
+    assert got == want
+    # and the sampled stream replays deterministically on the spec path
+    assert engine.generate(p, **kw).tokens(timeout=120) == want
+
+
+def test_paged_zero_steady_recompiles_and_gauges(pg):
+    """Churn through the warmed engine (its strict gate armed at
+    start): block-table admissions, spec verify ticks, prefix hits and
+    retirements cause ZERO steady-state compiles (tables/positions are
+    runtime data), and the v2 gauges are live."""
+    engine = pg["engine"]
+    pg["draft"]["fn"] = sdecode._ngram_draft
+    c0 = profiler.get_counters()
+    rs = np.random.RandomState(8)
+    streams = [
+        engine.generate(
+            list(rs.randint(0, pg["cfg"].vocab_size, 1 + i % 7)),
+            max_new_tokens=2 + i % 5,
+        )
+        for i in range(2 * SLOTS)
+    ]
+    for s in streams:
+        s.tokens(timeout=120)
+    c1 = profiler.get_counters()
+    assert c1.get("serving_steady_recompiles", 0) == c0.get(
+        "serving_steady_recompiles", 0
+    )
+    assert c1.get("xla_compiles", 0) == c0.get("xla_compiles", 0)
+    gauges = obs_registry.gauge_values()
+    assert "decode_blocks_free" in gauges
+    assert "decode_blocks_shared" in gauges
+    assert "decode_spec_acceptance" in gauges
+    st = engine.stats()
+    assert st["paged"]["free"] + (len(engine._active)
+                                  + len(engine._prefilling)) >= 0
+    assert st["paged"]["blocks"] == engine.session.pool_blocks
+
+
+def test_paged_pool_oom_sheds_not_wedges():
+    """A pool sized for ONE full-length stream: the first admission
+    completes exactly; a concurrent second admission sheds with
+    ServerOverloadedError (retryable) instead of wedging the loop, and
+    the shed slot's blocks return to the free list."""
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = MAX_LEN
+    with fluid.unique_name.guard():
+        infer, startup, _names, logits = gpt.build_gpt_infer(cfg, MAX_LEN)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+    engine = sdecode.DecodeEngine(
+        cfg, scope=scope, slots=2, max_len=MAX_LEN,
+        param_program=infer, block_size=BLOCK,
+        pool_blocks=1 + MAX_LEN // BLOCK,  # sink + one stream's worth
+    ).start()
+    try:
+        p = [3, 1, 4, 1, 5, 9, 2, 6, 5]  # 9 tokens -> 3 blocks at admit
+        want = gpt._reference_generate(
+            exe, infer, logits, cfg, p, MAX_LEN, scope=scope
+        )[len(p):]
+        s1 = engine.submit(p, max_new_tokens=MAX_LEN - len(p))
+        s2 = engine.submit(list(reversed(p)),
+                           max_new_tokens=MAX_LEN - len(p))
+        with pytest.raises(ServerOverloadedError):
+            s2.tokens(timeout=120)
+        assert s1.tokens(timeout=120) == want
+        st = engine.stats()
+        assert st["oom_sheds"] >= 1
+        assert st["paged"]["free"] == engine.session.pool_blocks - 1
+    finally:
+        engine.stop()

@@ -860,8 +860,8 @@ def test_dist_crash_probe_fast(tmp_path):
         json.loads(ln[len("REPORT "):])
         for ln in p.stdout.splitlines() if ln.startswith("REPORT ")
     )
-    assert report["trials_kill"] == 2 and report["trials_hang"] == 2
-    assert report["restarts"] >= 4  # every trial restarted at least once
+    assert report["trials_kill"] == 1 and report["trials_hang"] == 1
+    assert report["restarts"] >= 2  # every trial restarted at least once
     assert report["mttr_ms"]["mean"] > 0
     # ISSUE 6 acceptance: the shrink trial resumed at world 2 without
     # exhausting the restart budget, the regrow trial returned to 3, and

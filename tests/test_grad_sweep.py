@@ -555,7 +555,18 @@ def _run_case(op_type, spec):
     )
 
 
-@pytest.mark.parametrize("op_type", sorted(CASES))
+# the deformable trio FD-probes 300+ input elements each (2 evals per
+# element) — ~23 s of tier-1 budget for three ops whose kernels don't
+# change between PRs; they keep full coverage under -m slow
+_SLOW_CASES = {"deformable_conv", "deformable_conv_v1",
+               "deformable_psroi_pooling"}
+
+
+@pytest.mark.parametrize(
+    "op_type",
+    [pytest.param(op, marks=pytest.mark.slow) if op in _SLOW_CASES
+     else op for op in sorted(CASES)],
+)
 def test_grad_sweep(op_type):
     _run_case(op_type, CASES[op_type])
 

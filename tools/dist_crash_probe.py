@@ -35,9 +35,10 @@ Modes::
     python tools/dist_crash_probe.py --trials 5
 
     # fast deterministic subset (tier-1 via tests/test_dist_supervisor.py):
-    # 2 fixed-step kill trials + 2 fixed-step hang trials + the
+    # 1 fixed-step kill trial + 1 fixed-step hang trial + the
     # shrink->regrow elasticity trial + the restart-budget-exhaustion
-    # check
+    # check (one trial pair covers both detection paths; extra pairs
+    # only vary the injection step and cost ~20 s of tier-1 budget)
     python tools/dist_crash_probe.py --fast
 
 The worker is this same file with ``--worker`` (rank from
@@ -546,7 +547,7 @@ def main(argv=None):
         assert args.dir, "--worker needs --dir"
         return run_worker(args)
     if args.fast:
-        args.trials = 2
+        args.trials = 1
     return run_probe(args)
 
 

@@ -42,7 +42,7 @@ import time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-REPORT_SCHEMA_VERSION = 1
+REPORT_SCHEMA_VERSION = 2
 
 
 def build_model(dirname, dim=64, hidden=128, classes=8, seed=0):
@@ -159,10 +159,19 @@ def run_probe(clients=8, requests_per_client=25, serial_requests=40,
                 serial_pred.run([xd])
             return serial_requests / (time.perf_counter() - t0)
 
-        serial_rps = dynamic_rps = 0.0
+        # Box contention correlates WITHIN a round: a stall squeezes
+        # that round's serial loop and its batched burst together. So
+        # the bar rides the best per-round RATIO — a clean serial round
+        # is never paired against a contended dynamic round, which was
+        # the one residual flake after the windowed-rate estimator.
+        # The headline rates stay best-of-rounds for reporting.
+        serial_rps = dynamic_rps = speedup = 0.0
         for _ in range(rounds):
-            serial_rps = max(serial_rps, serial_round())
-            dynamic_rps = max(dynamic_rps, dynamic_round())
+            s_rps = serial_round()
+            d_rps = dynamic_round()
+            serial_rps = max(serial_rps, s_rps)
+            dynamic_rps = max(dynamic_rps, d_rps)
+            speedup = max(speedup, d_rps / s_rps)
         stats = server.stats()
         server.stop()
         if errors:
@@ -178,7 +187,7 @@ def run_probe(clients=8, requests_per_client=25, serial_requests=40,
             "rounds": rounds,
             "serial_rps": round(serial_rps, 1),
             "dynamic_rps": round(dynamic_rps, 1),
-            "speedup": round(dynamic_rps / serial_rps, 3),
+            "speedup": round(speedup, 3),
             "batch_fill_ratio": stats.batch_fill_ratio,
             "bucket_hit_rate": stats.bucket_hit_rate,
             "recompiles_after_warmup": int(recompiles),
