@@ -111,6 +111,26 @@ _DEFAULTS = {
     "decode_block_size": 0,
     "decode_spec_tokens": 0,
     "decode_spec_draft": "ngram",
+    # fleet KV tier (paddle_tpu/serving/kv_tier.py): tiered prefix-block
+    # cache over the paged pool. kv_tier_host_mb sizes the host-spill
+    # store (LRU-evicted device blocks spill D2H and re-admit H2D on a
+    # later chain hit; 0 = off, blocks vanish on eviction as before).
+    # kv_tier_advert_k bounds the hot chain-head keys each replica
+    # advertises via /readyz for the router's cache-affinity scoring;
+    # kv_tier_advert_ttl_s is the router-side staleness bound past which
+    # an advertisement is ignored (a dead replica's heads can't
+    # black-hole traffic). The role-split pull path: the controller
+    # writes prefill-replica endpoints to kv_tier_peers_file; a
+    # decode-role replica whose admission would cache fewer than
+    # kv_tier_pull_min_tokens prompt tokens locally pulls published
+    # blocks from a peer first (per-request budget
+    # kv_tier_pull_timeout_s; any failure degrades to local prefill).
+    "kv_tier_host_mb": 0.0,
+    "kv_tier_advert_k": 8,
+    "kv_tier_advert_ttl_s": 5.0,
+    "kv_tier_peers_file": "",
+    "kv_tier_pull_min_tokens": 0,
+    "kv_tier_pull_timeout_s": 2.0,
     # HTTP serving gateway (paddle_tpu/serving/gateway.py): the network
     # front door over InferenceServer (+ attached DecodeEngine).
     # gateway_port binds the listener (0 = ephemeral — tests/probes read

@@ -278,7 +278,66 @@ _REPLICA_COUNTERS = (
     "serving_shed_deadline",
     "gateway_shed_admission",
     "gateway_shed_dispatch",
+    # prefix-cache / KV-tier effectiveness (the fleet_report roll-up
+    # computes hit rates and byte totals from these)
+    "decode_prefix_hits",
+    "decode_prefix_misses",
+    "decode_prefix_cached_tokens",
+    "decode_prompt_tokens",
+    "kv_tier_spills",
+    "kv_tier_readmits",
+    "kv_tier_bytes_d2h",
+    "kv_tier_bytes_h2d",
+    "kv_tier_pulls",
+    "kv_tier_pull_tokens",
 )
+
+
+def _prefix_cache_rollup(summaries):
+    """Fleet-wide prefix-cache effectiveness from the per-replica
+    counter summaries: hit rate over admissions, the fraction of all
+    prompt tokens served from cache, and the KV-tier spill/re-admit
+    byte flow. Per-replica rows keep the same shape so an operator can
+    spot the one cold replica dragging the fleet rate down."""
+    def one(counters):
+        hits = int(counters.get("decode_prefix_hits", 0))
+        misses = int(counters.get("decode_prefix_misses", 0))
+        cached = int(counters.get("decode_prefix_cached_tokens", 0))
+        prompt = int(counters.get("decode_prompt_tokens", 0))
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "cached_tokens": cached,
+            "prompt_tokens": prompt,
+            "cached_token_fraction": round(cached / prompt, 4)
+            if prompt else None,
+            "spills": int(counters.get("kv_tier_spills", 0)),
+            "readmits": int(counters.get("kv_tier_readmits", 0)),
+            "bytes_d2h": int(counters.get("kv_tier_bytes_d2h", 0)),
+            "bytes_h2d": int(counters.get("kv_tier_bytes_h2d", 0)),
+        }
+
+    per_replica = {}
+    totals = {}
+    for rid, s in summaries.items():
+        row = one(s.get("counters", {}))
+        per_replica[rid] = row
+        for k, v in row.items():
+            if isinstance(v, int):
+                totals[k] = totals.get(k, 0) + v
+    fleet = one(totals and {
+        "decode_prefix_hits": totals.get("hits", 0),
+        "decode_prefix_misses": totals.get("misses", 0),
+        "decode_prefix_cached_tokens": totals.get("cached_tokens", 0),
+        "decode_prompt_tokens": totals.get("prompt_tokens", 0),
+        "kv_tier_spills": totals.get("spills", 0),
+        "kv_tier_readmits": totals.get("readmits", 0),
+        "kv_tier_bytes_d2h": totals.get("bytes_d2h", 0),
+        "kv_tier_bytes_h2d": totals.get("bytes_h2d", 0),
+    } or {})
+    return {"fleet": fleet, "per_replica": per_replica}
 
 
 _FLIGHT_DUMP = re.compile(r"^flight_rank_\d+\.json$")
@@ -489,6 +548,10 @@ def fleet_report(workdir, obs_root=None):
                                                   points=(50, 99)),
         "replicas_reporting": sorted(snaps),
         "per_replica": summaries,
+        # fleet-wide prefix-cache / KV-tier effectiveness: hit rate,
+        # cached-token fraction, spill/re-admit byte flow — per replica
+        # and rolled up (the number the KV tier exists to move)
+        "prefix_cache": _prefix_cache_rollup(summaries),
         "steady_recompiles": sum(
             s["steady_recompiles"] for s in summaries.values()
         ),

@@ -71,6 +71,7 @@ from ..observability import exporter as _obs_exporter
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
 from ..observability import xla_stats as _xla_stats
+from . import kv_tier as _kv_tier
 from .batcher import ServerOverloadedError, ServingError
 
 __all__ = [
@@ -134,22 +135,14 @@ def prefill_ladder(max_len, buckets=None):
 # ---------------------------------------------------------------------------
 
 
-def _block_hash(prev_key, tokens):
-    """Chain digest for one prompt block: block i's key folds in block
-    i-1's, so equal keys mean equal WHOLE prefixes. A real digest
-    (sha256 over prev_digest || token bytes), NOT ``hash()`` — the
-    gateway hands this map client-controlled token ids, and a
-    birthday-searchable 61-bit key would let a tenant engineer
-    cross-request K/V reuse. A module-level hook so tests can inject
-    colliding functions; the cache never trusts the key alone — every
-    match re-compares the stored (prev, tokens) link and falls through
-    to the full-prefill path on mismatch."""
-    import hashlib
-
-    h = hashlib.sha256()
-    h.update(repr(prev_key).encode())
-    h.update(np.asarray(tokens, np.int64).tobytes())
-    return h.hexdigest()
+# The chain digest is shared fleet-wide now — the router's affinity
+# scorer and the host-spill store must compute the exact keys this
+# module publishes, so the one definition lives in kv_tier. Still a
+# module-level hook here so tests can inject colliding functions; the
+# cache never trusts the key alone — every match re-compares the stored
+# (prev, tokens) link and falls through to the full-prefill path on
+# mismatch.
+_block_hash = _kv_tier.block_hash
 
 
 class _PrefixEntry(object):
@@ -375,9 +368,14 @@ class PagedPrefixIndex(object):
     until the last slot retires.
 
     ``max_blocks`` caps how many pool blocks the store itself may pin
-    (the paged reading of ``FLAGS_decode_prefix_cache_mb``)."""
+    (the paged reading of ``FLAGS_decode_prefix_cache_mb``).
 
-    def __init__(self, block, max_blocks, allocator):
+    ``on_evict`` is the host-spill seam (kv_tier): called with the
+    victim entry BEFORE the index drops its reference, while the block's
+    bytes are still live — the engine's hook pins the block and hands it
+    to the spill worker. Must not mutate the index."""
+
+    def __init__(self, block, max_blocks, allocator, on_evict=None):
         if block < 1 or max_blocks < 1:
             raise ValueError(
                 "need block >= 1 and max_blocks >= 1, got %d / %d"
@@ -386,6 +384,7 @@ class PagedPrefixIndex(object):
         self.block = int(block)
         self.max_blocks = int(max_blocks)
         self.allocator = allocator
+        self.on_evict = on_evict
         from collections import OrderedDict
 
         self._entries = OrderedDict()  # key -> _PrefixEntry, LRU order
@@ -469,11 +468,46 @@ class PagedPrefixIndex(object):
             victim = next(iter(self._entries.values()), None)
             if victim is None:
                 return False
+        if self.on_evict is not None:
+            try:
+                self.on_evict(victim)
+            except Exception:  # noqa: BLE001 - spill is best-effort
+                pass
         del self._entries[victim.key]
         self.allocator.decref([victim.block_idx])
         self.evictions += 1
         _profiler.bump_counter("decode_prefix_evictions")
         return True
+
+    def admit(self, key, prev, tokens, block_idx):
+        """Register a block REBUILT from outside the device pool (a
+        host-store re-admission or a pulled peer payload) under its
+        chain key. The caller owns ``block_idx`` with exactly one
+        reference and hands it to the index — unlike ``publish`` there
+        is no slot also holding it, so no extra incref. Returns the new
+        entry, or None when the key is already (or cannot be) indexed —
+        then the caller keeps its reference."""
+        toks = tuple(int(t) for t in tokens)
+        if self._entries.get(key) is not None:
+            return None
+        if len(self._entries) >= self.max_blocks:
+            if not self.evict_one():
+                return None
+        e = _PrefixEntry(key, prev, toks, block_idx)
+        self._entries[key] = e
+        return e
+
+    def head_keys(self, k):
+        """Newest-``k`` chain keys — the replica's cache-affinity
+        advertisement. Read lock-free off the gateway thread: the dict
+        view is copied first and a racing mutation at worst yields a
+        slightly stale list, which the router's staleness bound already
+        tolerates."""
+        try:
+            keys = list(self._entries.keys())
+        except RuntimeError:  # resized mid-copy — advertise nothing
+            return []
+        return keys[-int(k):][::-1] if k > 0 else []
 
     def stats(self):
         return {
@@ -1508,16 +1542,34 @@ class DecodeEngine(object):
         self._counts = {"requests": 0, "admissions": 0,
                         "retirements": 0, "tokens": 0,
                         "prefix_hits": 0, "prefix_misses": 0,
-                        "prefix_cached_tokens": 0,
+                        "prefix_cached_tokens": 0, "prompt_tokens": 0,
                         "resume_admissions": 0, "resume_tokens": 0,
                         "spec_drafted": 0, "spec_accepted": 0,
-                        "oom_sheds": 0}
+                        "oom_sheds": 0,
+                        "kv_readmits": 0, "kv_readmit_tokens": 0}
+        # fleet KV tier (kv_tier.py): host-spill store behind the paged
+        # prefix index. Evicted device blocks spill D2H off the tick
+        # thread; a later admission whose chain outruns the device index
+        # re-admits the spilled payload H2D instead of re-prefilling.
+        self.kv_host_mb = float(_flags.get_flag("kv_tier_host_mb"))
+        self.kv_advert_k = int(_flags.get_flag("kv_tier_advert_k"))
+        self.host_store = None   # kv_tier.HostBlockStore once started
+        self._spill_worker = None
+        # worker -> loop thread hand-back: block ids whose D2H read
+        # finished (deque append/popleft are atomic — no lock needed)
+        self._spill_done = deque()
+        # gateway -> loop thread: chain-export jobs for the prefill-role
+        # /v1/kv/prefill endpoint (the pool read must run on the single
+        # mutator thread)
+        self._export_jobs = deque()
         self._armed = False
         self._occ_gauge = None
         self._queue_gauge = None
         self._blocks_free_gauge = None
         self._blocks_shared_gauge = None
         self._spec_gauge = None
+        self._host_blocks_gauge = None
+        self._host_bytes_gauge = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -1556,6 +1608,18 @@ class DecodeEngine(object):
                 self.pindex = PagedPrefixIndex(
                     self.block_size, cap, self.allocator
                 )
+                if self.kv_host_mb > 0:
+                    # host tier behind the device index: eviction spills
+                    # instead of vanishing, admission walks here when
+                    # the device chain runs out
+                    self.host_store = _kv_tier.HostBlockStore(
+                        int(self.kv_host_mb * 2 ** 20)
+                    )
+                    self.pindex.on_evict = self._on_index_evict
+                    self._spill_done.clear()
+                    self._spill_worker = _kv_tier.SpillWorker(
+                        self._spill_batch
+                    )
         else:
             blocks = 0
             if self.prefix_cache_mb > 0:
@@ -1619,6 +1683,21 @@ class DecodeEngine(object):
                 _obs_registry.register_gauge(
                     "decode_spec_acceptance", self._spec_gauge
                 )
+            if self.host_store is not None:
+                # host-tier pressure at a glance: resident spilled
+                # blocks and the bytes they hold against the cap
+                self._host_blocks_gauge = lambda e=self: (
+                    len(e.host_store) if e.host_store else 0
+                )
+                _obs_registry.register_gauge(
+                    "kv_tier_host_blocks", self._host_blocks_gauge
+                )
+                self._host_bytes_gauge = lambda e=self: (
+                    e.host_store.bytes_used if e.host_store else 0
+                )
+                _obs_registry.register_gauge(
+                    "kv_tier_host_bytes", self._host_bytes_gauge
+                )
             _xla_stats.arm_serving_steady()
             self._armed = True
             self._thread = threading.Thread(
@@ -1646,6 +1725,8 @@ class DecodeEngine(object):
             ("decode_blocks_free", "_blocks_free_gauge"),
             ("decode_blocks_shared", "_blocks_shared_gauge"),
             ("decode_spec_acceptance", "_spec_gauge"),
+            ("kv_tier_host_blocks", "_host_blocks_gauge"),
+            ("kv_tier_host_bytes", "_host_bytes_gauge"),
         ):
             fn = getattr(self, attr)
             if fn is not None:
@@ -1709,6 +1790,12 @@ class DecodeEngine(object):
             # refuses to run a second driver beside it (see start())
             if not self._thread.is_alive():
                 self._thread = None
+        if self._spill_worker is not None:
+            # finishes queued spill batches first (the loop thread is
+            # gone, so the scope reads race nothing), then exits; the
+            # pinned-block refs die with the allocator on next start
+            self._spill_worker.stop()
+            self._spill_worker = None
         if self._armed:
             _xla_stats.disarm_serving_steady()
             self._armed = False
@@ -1903,6 +1990,7 @@ class DecodeEngine(object):
                 self._counts["spec_accepted"]
                 / self._counts["spec_drafted"]
             )
+        out["prompt_tokens"] = self._counts["prompt_tokens"]
         if self.allocator is not None:
             paged = self.allocator.stats()
             paged["block_size"] = self.block_size
@@ -1911,6 +1999,10 @@ class DecodeEngine(object):
             out["prefix_store"] = self.prefix.stats()
         if self.pindex is not None:
             out["prefix_store"] = self.pindex.stats()
+        if self.host_store is not None:
+            kv = self.host_store.stats()
+            kv["readmit_tokens"] = self._counts["kv_readmit_tokens"]
+            out["kv_tier"] = kv
         return out
 
     # -- engine loop ---------------------------------------------------------
@@ -1918,7 +2010,8 @@ class DecodeEngine(object):
         while True:
             with self._cond:
                 while (not self._stop and not self._pending
-                       and not self._active and not self._prefilling):
+                       and not self._active and not self._prefilling
+                       and not self._export_jobs):
                     self._cond.wait()
                 if self._stop:
                     return
@@ -1953,6 +2046,8 @@ class DecodeEngine(object):
         active slot. The chunk cap is the inter-token latency bound: a
         max-length prompt costs in-flight streams one bucket-shaped
         window per tick instead of a monolithic prefill stall."""
+        self._drain_spill_done()
+        self._serve_export_jobs()
         self._reap_cancelled()
         self._admit()
         self._advance_prefills()
@@ -2082,6 +2177,8 @@ class DecodeEngine(object):
                 if entries:
                     self.prefix.release(entries)
             stream.cached_prefix_tokens = prefix_tokens
+            _profiler.bump_counter("decode_prompt_tokens", len(prompt))
+            self._counts["prompt_tokens"] += len(prompt)
             if self.prefix is not None:
                 if prefix_tokens:
                     _profiler.bump_counter("decode_prefix_hits")
@@ -2126,6 +2223,13 @@ class DecodeEngine(object):
             # lookup increfs each matched block — those references ARE
             # the slot's table entries on success
             entries, hit_tokens = self.pindex.lookup(prompt)
+            if self.host_store is not None:
+                # chain ran past the device index: spilled (or pulled)
+                # blocks re-admit H2D instead of re-prefilling — each
+                # re-admitted entry joins ``entries`` with the same
+                # slot reference lookup hands out
+                entries = self._readmit_from_host(prompt, entries)
+                hit_tokens = len(entries) * self.block_size
         prefix_tokens, wins = self._plan_windows(len(prompt), hit_tokens)
         bs = self.block_size
         if prefix_tokens < hit_tokens:
@@ -2148,6 +2252,10 @@ class DecodeEngine(object):
             return
         self._slot_blocks[slot_idx] = blocks + owned
         stream.cached_prefix_tokens = prefix_tokens
+        # denominator for the fleet cached-token fraction: every prompt
+        # token admitted, hit or miss
+        _profiler.bump_counter("decode_prompt_tokens", len(prompt))
+        self._counts["prompt_tokens"] += len(prompt)
         if self.pindex is not None:
             if prefix_tokens:
                 _profiler.bump_counter("decode_prefix_hits")
@@ -2177,12 +2285,302 @@ class DecodeEngine(object):
         """Allocator take with prefix-store pressure relief: when the
         free list runs dry, evict store entries whose block the store
         alone references (each decref actually frees a block) and retry.
-        None = genuinely out of memory — the caller sheds."""
+        With the host tier armed an eviction doesn't free immediately —
+        the spill pin holds the block until its D2H read completes — so
+        the retry loop also reaps completed spills, and when allocation
+        is still short with spills in flight it waits (bounded) for the
+        worker's current batch. None = genuinely out of memory — the
+        caller sheds."""
         got = self.allocator.alloc(n)
-        while got is None and self.pindex is not None \
-                and self.pindex.evict_one(need_free=True):
+        while got is None:
+            progressed = self._drain_spill_done()
+            if self.pindex is not None \
+                    and self.pindex.evict_one(need_free=True):
+                progressed = True
+            if not progressed and self._spill_worker is not None \
+                    and self._spill_worker.pending:
+                self._spill_worker.drain(timeout=0.2)
+                progressed = self._drain_spill_done()
+            if not progressed:
+                return None
             got = self.allocator.alloc(n)
         return got
+
+    # -- fleet KV tier (kv_tier.py) ------------------------------------------
+    def _pool_arrays(self):
+        """Host views of every per-layer (K, V) pool tensor, snapshotted
+        once per call: [(k_host, v_host)] in layer order. ``np.asarray``
+        on a device-resident array is one D2H copy; on a host-resident
+        scope value (post reset/readmit) it is a zero-copy view."""
+        sess = self.session
+        out = []
+        for k_name, v_name in _gpt.paged_pool_names(
+            sess.cfg, sess.pool_blocks, sess.block_size
+        ):
+            out.append((np.asarray(sess.scope.get(k_name)),
+                        np.asarray(sess.scope.get(v_name))))
+        return out
+
+    def _on_index_evict(self, victim):
+        """Device-index eviction hook (loop thread, before the index
+        decrefs): pin the victim's block with one extra reference and
+        hand it to the spill worker. The pin keeps the allocator from
+        re-issuing the block — and since no program ever writes a block
+        it didn't allocate (COW covers shared writes), the row's bytes
+        stay frozen for the worker's D2H read."""
+        if self._spill_worker is None:
+            return
+        self.allocator.incref([victim.block_idx])
+        self._spill_worker.submit(
+            (victim.key, victim.prev, victim.tokens, victim.block_idx)
+        )
+
+    def _spill_batch(self, jobs):
+        """Spill-worker body: ONE pool snapshot covers every queued
+        eviction, then each victim's rows copy into the host store.
+        Donation race: a concurrently dispatched step may invalidate the
+        pool array mid-read (jax raises on a deleted donated buffer) —
+        re-fetching from the scope retries against the replacement
+        array, whose pinned rows hold identical bytes. Every block id
+        returns through ``_spill_done`` even on failure, so a lost
+        spill never leaks a pin."""
+        try:
+            pools = None
+            for _attempt in range(8):
+                try:
+                    pools = self._pool_arrays()
+                    break
+                except Exception:  # noqa: BLE001 - donated mid-read
+                    time.sleep(0.005)
+            if pools is None:
+                return
+            for key, prev, tokens, blk in jobs:
+                payload = [(k[blk].copy(), v[blk].copy())
+                           for k, v in pools]
+                self.host_store.put(key, prev, tokens, payload)
+        finally:
+            for job in jobs:
+                self._spill_done.append(job[3])
+
+    def _drain_spill_done(self):
+        """Reap completed spills (loop thread): drop the pin the evict
+        hook took — for a store-only block this is the decref that
+        actually frees it. Returns True when any block was released."""
+        freed = False
+        while True:
+            try:
+                blk = self._spill_done.popleft()
+            except IndexError:
+                return freed
+            self.allocator.decref([blk])
+            freed = True
+
+    def _readmit_from_host(self, prompt, entries):
+        """Extend a device-index hit from the host tier: walk the
+        prompt's chain past the device entries, and for every spilled
+        block found, allocate a fresh pool block, write the payload H2D,
+        and re-register it in the device index. Returns the extended
+        entries list (each new entry carries the caller's slot
+        reference, same contract as ``lookup``).
+
+        The H2D write scatters only the hit rows into the device pool
+        (a cached jax row-scatter — never an executor program, so the
+        strict steady-state gate never fires), falling back to a host
+        round-trip when the pool is host-resident. All hit blocks batch
+        into one scatter per layer tensor: the cost scales with the
+        re-admitted bytes, not the pool size."""
+        bs = self.block_size
+        usable = (len(prompt) - 1) // bs
+        hits = []  # (host_entry, fresh_block_idx)
+        prev = entries[-1].key if entries else 0
+        for b in range(len(entries), usable):
+            toks = tuple(prompt[b * bs:(b + 1) * bs])
+            key = _block_hash(prev, toks)
+            if self.pindex._entries.get(key) is not None:
+                break  # raced back into the device index — rare; stop
+            he = self.host_store.get(key, prev, toks)
+            if he is None:
+                break
+            got = self._alloc_blocks(1)
+            if got is None:
+                break  # pool pressure: keep what we have, prefill rest
+            hits.append((he, got[0]))
+            prev = key
+        if not hits:
+            return entries
+        sess = self.session
+        names = _gpt.paged_pool_names(sess.cfg, sess.pool_blocks,
+                                      sess.block_size)
+        idx = np.array([blk for _he, blk in hits], np.int32)
+        for li, (k_name, v_name) in enumerate(names):
+            k_rows = np.stack([he.payload[li][0] for he, _b in hits])
+            v_rows = np.stack([he.payload[li][1] for he, _b in hits])
+            k_cur = sess.scope.get(k_name)
+            v_cur = sess.scope.get(v_name)
+            # big pools scatter on device (cost ∝ re-admitted rows);
+            # small pools take the host row-write — the fixed dispatch
+            # cost of the scatter ops would exceed a full-pool copy
+            if hasattr(k_cur, "at") and k_cur.nbytes > (4 << 20):
+                sess.scope.set(k_name, k_cur.at[idx].set(k_rows))
+                sess.scope.set(v_name, v_cur.at[idx].set(v_rows))
+            else:
+                k_host = np.array(k_cur)
+                v_host = np.array(v_cur)
+                k_host[idx] = k_rows
+                v_host[idx] = v_rows
+                sess.scope.set(k_name, k_host)
+                sess.scope.set(v_name, v_host)
+        out = list(entries)
+        for he, blk in hits:
+            e = self.pindex.admit(he.key, he.prev, he.tokens, blk)
+            if e is None:
+                # index refused (full of slot-shared blocks): the block
+                # still serves THIS admission — wrap a detached entry;
+                # the slot's decref at retirement frees it
+                e = _PrefixEntry(he.key, he.prev, he.tokens, blk)
+            else:
+                # index took the allocated ref; the slot needs its own
+                self.allocator.incref([blk])
+            self.host_store.note_readmit(he)
+            _profiler.bump_counter("kv_tier_readmit_tokens", bs)
+            self._counts["kv_readmits"] += 1
+            self._counts["kv_readmit_tokens"] += bs
+            out.append(e)
+        return out
+
+    def prefix_heads(self, k=None):
+        """The replica's cache-affinity advertisement: up to ``k`` hot
+        chain-head keys, device index first (newest-first), then host
+        tier. Gateway-thread safe — both reads are lock-free copies and
+        a stale head only costs the router a mis-score within its
+        staleness bound."""
+        if k is None:
+            k = self.kv_advert_k
+        k = int(k)
+        if k <= 0 or self.pindex is None:
+            return []
+        heads = self.pindex.head_keys(k)
+        if self.host_store is not None and len(heads) < k:
+            seen = set(heads)
+            try:
+                host_keys = list(self.host_store._entries.keys())
+            except RuntimeError:
+                host_keys = []
+            for key in reversed(host_keys):
+                if key not in seen:
+                    heads.append(key)
+                    seen.add(key)
+                if len(heads) >= k:
+                    break
+        return heads
+
+    def estimate_cached_tokens(self, prompt_ids):
+        """Approximate cached-token count for ``prompt_ids`` across the
+        device index and host tier — the gateway's pull-or-not signal.
+        Lock-free dict reads off the gateway thread: a racing eviction
+        at worst skews the estimate, and the admission path re-verifies
+        every link anyway."""
+        if self.pindex is None:
+            return 0
+        bs = self.block_size
+        prompt = list(prompt_ids)
+        cached = 0
+        prev = 0
+        for b in range((len(prompt) - 1) // bs):
+            toks = tuple(prompt[b * bs:(b + 1) * bs])
+            key = _block_hash(prev, toks)
+            try:
+                e = self.pindex._entries.get(key)
+            except RuntimeError:
+                break
+            if e is None and self.host_store is not None:
+                e = self.host_store.get(key, prev, toks)
+            if e is None:
+                break
+            cached += bs
+            prev = key
+        return cached
+
+    def offer_blocks(self, entries):
+        """Inject chain blocks pulled from a prefill-role peer
+        (gateway thread). They land in the thread-safe host store —
+        the very next admission whose chain reaches them re-admits
+        H2D through the standard spilled-block path, with the same
+        verification. Returns the number of blocks accepted."""
+        if self.host_store is None:
+            return 0
+        n = 0
+        for key, prev, tokens, payload in entries:
+            if self.host_store.put(key, prev, tokens, payload,
+                                   tally=False):
+                n += 1
+        return n
+
+    def request_export(self, prompt_ids, timeout=5.0):
+        """Serialize the prompt's published chain blocks (prefill-role
+        endpoint, gateway thread). The pool read must run on the loop
+        thread — the single mutator — so this parks a job the tick
+        serves and waits (bounded). Returns [(key, prev, tokens,
+        payload)] in chain order, or None on timeout/stopped."""
+        if not self.started or self.pindex is None:
+            return None
+        ev = threading.Event()
+        box = {}
+        with self._cond:
+            if self._stop or not self.started:
+                return None
+            self._export_jobs.append((list(prompt_ids), ev, box))
+            self._cond.notify_all()
+        if not ev.wait(timeout):
+            return None
+        return box.get("entries")
+
+    def _serve_export_jobs(self):
+        """Loop-thread half of ``request_export``: read the chain's
+        blocks out of the pool (one snapshot per tick serves every
+        queued job) and hand the payloads back."""
+        if not self._export_jobs:
+            return
+        pools = None
+        while True:
+            try:
+                prompt, ev, box = self._export_jobs.popleft()
+            except IndexError:
+                return
+            try:
+                bs = self.block_size
+                chain = []
+                prev = 0
+                for b in range(len(prompt) // bs):
+                    toks = tuple(prompt[b * bs:(b + 1) * bs])
+                    key = _block_hash(prev, toks)
+                    e = self.pindex._entries.get(key)
+                    if e is not None and (e.tokens != toks
+                                          or e.prev != prev):
+                        break  # collision squatting on the key
+                    if e is not None:
+                        if pools is None:
+                            pools = self._pool_arrays()
+                        blk = e.block_idx
+                        payload = [(k[blk].copy(), v[blk].copy())
+                                   for k, v in pools]
+                    elif self.host_store is not None:
+                        # already spilled: the payload is host-resident
+                        # — serve it straight from the tier, no pool
+                        # read at all
+                        he = self.host_store.get(key, prev, toks)
+                        if he is None:
+                            break
+                        payload = he.payload
+                    else:
+                        break
+                    chain.append((key, prev, toks, payload))
+                    prev = key
+                box["entries"] = chain
+            except Exception:  # noqa: BLE001 - export is best-effort
+                box["entries"] = None
+            finally:
+                ev.set()
 
     def _release_slot_blocks(self, slot_idx):
         """Drop the slot's reference on every block its table holds —

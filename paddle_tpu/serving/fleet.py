@@ -164,11 +164,11 @@ class _Replica(object):
     __slots__ = (
         "id", "version", "model_dir", "proc", "endpoint_file", "hb_file",
         "obs_dir", "state", "endpoint", "spawn_t", "drain_t", "shed_seen",
-        "hb_seen",
+        "hb_seen", "role",
     )
 
     def __init__(self, rid, version, model_dir, proc, endpoint_file,
-                 hb_file, obs_dir):
+                 hb_file, obs_dir, role="mixed"):
         self.id = int(rid)
         self.version = int(version)
         self.model_dir = str(model_dir)
@@ -182,6 +182,7 @@ class _Replica(object):
         self.drain_t = None
         self.shed_seen = 0.0     # autoscaler shed-delta bookkeeping
         self.hb_seen = None      # (mtime, first-observed monotonic time)
+        self.role = str(role)    # prefill|decode|mixed (KV-tier split)
 
     @property
     def pid(self):
@@ -197,6 +198,7 @@ class _Replica(object):
             "gateway_port": ep.get("gateway_port"),
             "metrics_port": ep.get("metrics_port"),
             "model_dir": self.model_dir,
+            "role": self.role,
         }
 
 
@@ -266,9 +268,20 @@ class FleetController(object):
                  ready_timeout_s=None, drain_grace_s=None,
                  restart_backoff_s=None, max_replica_restarts=None,
                  heartbeat_timeout_s=None, poll_s=0.1, seed=None,
-                 echo_events=False):
+                 echo_events=False, roles=None):
         self.workdir = str(workdir)
         os.makedirs(self.workdir, exist_ok=True)
+        # role-split topology (KV tier): {"prefill": 1, "decode": 2}.
+        # Spawn order fills prefill slots first, then decode; extras
+        # beyond the declared counts serve "decode" under a role spec
+        # (the spec means traffic is split) and "mixed" without one.
+        self.roles = {}
+        for k, v in dict(roles or {}).items():
+            if k not in ("prefill", "decode", "mixed"):
+                raise ValueError("unknown replica role %r" % (k,))
+            if int(v) > 0:
+                self.roles[k] = int(v)
+        self._peers_file = os.path.join(self.workdir, "kv_peers.json")
         self.model_dir, declared = _resolve_model(model_dir)
         self.version = declared if declared is not None else 1
         self.policy = policy or AutoscalerPolicy(
@@ -591,8 +604,9 @@ class FleetController(object):
         return False
 
     # -- spawn / drain / kill ----------------------------------------------
-    def _cmd(self, rid, version, model_dir, endpoint_file):
+    def _cmd(self, rid, version, model_dir, endpoint_file, role="mixed"):
         if self._replica_cmd is not None:
+            # custom argv (tests): the role rides the environment only
             return list(self._replica_cmd(rid, version, model_dir,
                                           endpoint_file))
         return [
@@ -602,12 +616,30 @@ class FleetController(object):
             "--replica-id", str(rid),
             "--version", str(version),
             "--host", self.host,
+            "--role", role,
         ] + self.replica_args
+
+    def _role_for_next(self):
+        """Role for the next spawn (caller holds the lock): refill the
+        declared prefill pool first — decode replicas degrade to local
+        prefill while it's short, so a prefill hole hurts the whole
+        fleet's TTFT — then decode; extras are decode under a role
+        spec, mixed without one."""
+        if not self.roles:
+            return "mixed"
+        live = [r for r in self._replicas.values()
+                if r.state in ("starting", "ready")]
+        for role in ("prefill", "decode", "mixed"):
+            want = self.roles.get(role, 0)
+            if want and sum(1 for r in live if r.role == role) < want:
+                return role
+        return "decode" if self.roles.get("prefill") else "mixed"
 
     def _spawn(self, version, model_dir, replacement=False):
         """Start one replica process (caller holds the lock)."""
         rid = self._next_rid
         self._next_rid += 1
+        role = self._role_for_next()
         epf = os.path.join(self._ep_dir, "replica_%d.json" % rid)
         hbf = os.path.join(self._hb_dir, "replica_%d.json" % rid)
         obs = os.path.join(self._obs_root, "replica_%d" % rid)
@@ -630,6 +662,11 @@ class FleetController(object):
         env.setdefault("FLAGS_obs_http_port", "0")
         env["FLAGS_obs_dir"] = obs
         env.setdefault("FLAGS_obs_snapshot_interval_s", "2.0")
+        if self.roles.get("prefill"):
+            # role-split fleet: every replica learns where the prefill
+            # pool publishes KV blocks (the controller maintains the
+            # file as prefill members come and go)
+            env.setdefault("FLAGS_kv_tier_peers_file", self._peers_file)
         # `python -m paddle_tpu...` must resolve no matter where the
         # controller process was launched from
         pkg_root = os.path.dirname(os.path.dirname(
@@ -643,7 +680,7 @@ class FleetController(object):
         fn = open(log_path, "a")
         try:
             proc = subprocess.Popen(
-                self._cmd(rid, version, model_dir, epf),
+                self._cmd(rid, version, model_dir, epf, role=role),
                 env=env, stdout=fn, stderr=fn,
             )
         finally:
@@ -652,13 +689,14 @@ class FleetController(object):
             # replica for the controller's lifetime (autoscale/restart
             # churn is unbounded)
             fn.close()
-        r = _Replica(rid, version, model_dir, proc, epf, hbf, obs)
+        r = _Replica(rid, version, model_dir, proc, epf, hbf, obs,
+                     role=role)
         self._replicas[rid] = r
         if replacement:
             _profiler.bump_counter("fleet_replica_restarts")
         self.log.event(
             "replica_spawn", replica=rid, version=version, pid=proc.pid,
-            replacement=bool(replacement),
+            replacement=bool(replacement), role=role,
         )
         return r
 
@@ -670,6 +708,8 @@ class FleetController(object):
         self.router.remove_backend(r.id)
         r.state = "draining"
         r.drain_t = time.monotonic()
+        if r.role == "prefill":
+            self._update_peers_locked()
         try:
             r.proc.send_signal(signal.SIGTERM)
         except OSError:
@@ -811,9 +851,31 @@ class FleetController(object):
             self._backoff_until = max(self._backoff_until,
                                       time.monotonic() + delay)
 
+    def _update_peers_locked(self):
+        """Atomically rewrite the KV peers file from the ready prefill
+        pool (caller holds the lock). Decode replicas re-read it per
+        pull, so a prefill member joining or dying propagates without
+        restarting anyone."""
+        peers = [
+            {"id": r.id, "host": self.host,
+             "port": (r.endpoint or {}).get("gateway_port")}
+            for r in self._replicas.values()
+            if r.role == "prefill" and r.state == "ready"
+            and (r.endpoint or {}).get("gateway_port")
+        ]
+        tmp = "%s.tmp.%d" % (self._peers_file, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"peers": peers}, f, sort_keys=True)
+            os.replace(tmp, self._peers_file)
+        except OSError:
+            pass
+
     def _reap_locked(self, r, rc=None):
         self.router.remove_backend(r.id)
         r.state = "exited"
+        if r.role == "prefill":
+            self._update_peers_locked()
         self.log.event(
             "replica_exit", replica=r.id,
             returncode=r.proc.poll() if rc is None else rc,
@@ -831,10 +893,16 @@ class FleetController(object):
                     if r.state != "starting":
                         return
                     r.state = "ready"
-                    self.router.add_backend(
-                        r.id, self.host, ep["gateway_port"],
-                        version=r.version, ready=True,
-                    )
+                    if r.role == "prefill":
+                        # prefill replicas serve the fleet-internal
+                        # /v1/kv/prefill endpoint only — never client
+                        # traffic through the router
+                        self._update_peers_locked()
+                    else:
+                        self.router.add_backend(
+                            r.id, self.host, ep["gateway_port"],
+                            version=r.version, ready=True,
+                        )
                 _profiler.bump_histogram("fleet_replica_ready_ms",
                                          ready_ms)
                 self.log.event(

@@ -62,6 +62,7 @@ and only then closes the listener.
 
 from __future__ import annotations
 
+import http.client
 import itertools
 import json
 import os
@@ -80,6 +81,7 @@ from ..observability import exporter as _obs_exporter
 from ..observability import flight as _flight
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
+from . import kv_tier as _kv_tier
 from .access_log import AccessLog
 from .batcher import (
     DeadlineExceededError,
@@ -379,9 +381,25 @@ class Gateway(object):
                  tenant_max_inflight=None, max_inflight=None,
                  admit_timeout_ms=None, drain_timeout_s=None,
                  access_log=None, access_log_max_mb=None,
-                 extra_headers=None):
+                 extra_headers=None, role=None):
         self.server = server
         self.host = host
+        # fleet KV-tier role: "prefill" replicas compute + publish
+        # chain blocks over /v1/kv/prefill; "decode" replicas own
+        # slots and pull published blocks on admission miss; "mixed"
+        # (default, and the only pre-role behavior) does both locally.
+        # Advertised on /readyz so the router and operators see it.
+        self.role = str(role or "mixed")
+        if self.role not in ("prefill", "decode", "mixed"):
+            raise ValueError("role must be prefill|decode|mixed, got %r"
+                             % (role,))
+        self.kv_peers_file = str(_flags.get_flag("kv_tier_peers_file"))
+        self.kv_pull_min_tokens = int(
+            _flags.get_flag("kv_tier_pull_min_tokens")
+        )
+        self.kv_pull_timeout_s = float(
+            _flags.get_flag("kv_tier_pull_timeout_s")
+        )
         # static response headers stamped on every reply (fleet
         # replicas tag X-Replica-Id / X-Model-Version so the router and
         # rollout audits can attribute each answer)
@@ -514,6 +532,22 @@ class Gateway(object):
     def draining(self):
         return (self._draining or not self._started
                 or _preempt.preemption_requested())
+
+    def kv_advert(self):
+        """The /readyz KV-tier advertisement: this replica's role plus
+        (when a paged prefix index is live) its block size and hot
+        chain-head keys — what the router's affinity scorer matches an
+        incoming prompt's chain against. Cheap and lock-free; an engine
+        without an index advertises role only."""
+        out = {"role": self.role}
+        eng = getattr(self.server, "_decode_engine", None)
+        try:
+            if eng is not None and getattr(eng, "pindex", None) is not None:
+                out["block"] = eng.block_size
+                out["heads"] = eng.prefix_heads()
+        except Exception:  # noqa: BLE001 - advert is best-effort
+            pass
+        return out
 
     def stop(self, drain_timeout_s=None):
         """Graceful stop: flip NOT-READY, reject new work with 503, wait
@@ -728,10 +762,14 @@ def _make_handler(gw):
                 if gw.draining():
                     self._send_json(503, {"status": "draining"})
                 else:
+                    # the KV-tier advertisement rides the readiness
+                    # poll the router already makes: hot prefix-chain
+                    # heads + block size + role, for affinity scoring
                     self._send_json(
                         200,
                         {"status": "ready",
-                         "inflight": gw.admission.total_inflight},
+                         "inflight": gw.admission.total_inflight,
+                         "kv": gw.kv_advert()},
                     )
             else:
                 self._send_json(404, {"error": "not found"})
@@ -746,6 +784,11 @@ def _make_handler(gw):
                 self._serve(path, self._infer)
             elif path == "/v1/generate":
                 self._serve(path, self._generate)
+            elif path == "/v1/kv/prefill":
+                # internal fleet endpoint (prefill-role replicas):
+                # bypasses tenant admission — peers are fleet traffic,
+                # not tenants; the engine's own queue bound still sheds
+                self._kv_prefill()
             else:
                 # body unread -> close, or a kept-alive client desyncs
                 self._send_json(404, {"error": "not found"}, close=True)
@@ -991,6 +1034,12 @@ def _make_handler(gw):
                 return 400, "bad_request", None
             timeout = (deadline_ms / 1e3
                        if deadline_ms and deadline_ms > 0 else None)
+            # decode-role pull: a cold prompt chain (below the pull
+            # threshold) fetches published blocks from a prefill-role
+            # peer BEFORE admission, so the local prefill shrinks to
+            # the unpulled suffix; any failure degrades to plain local
+            # prefill — the pull is never on the correctness path
+            self._kv_pull_if_cold(prompt)
             try:
                 stream = gw.server.generate(prompt, **kw)
             except ServerOverloadedError as e:
@@ -1024,6 +1073,127 @@ def _make_handler(gw):
                 }, **facts, **self._resume_state(stream, len(toks))))
                 return 200, None, len(toks)
             return self._stream_sse(stream, tenant, rid, timeout)
+
+        def _kv_pull_if_cold(self, prompt):
+            """Fleet KV pull (decode-role path): when the local tier
+            would cache fewer than ``FLAGS_kv_tier_pull_min_tokens`` of
+            this prompt, fetch the chain's published blocks from a
+            prefill-role peer (controller-maintained peers file) and
+            drop them into the host store — the admission that follows
+            re-admits them H2D through the standard spilled-block path.
+            Wholly best-effort: any failure (no peers, timeout, dead
+            peer, mismatched geometry) counts ``kv_tier_pull_failures``
+            and the request prefills locally, token-exact either way."""
+            if gw.kv_pull_min_tokens <= 0 or not gw.kv_peers_file:
+                return
+            eng = getattr(gw.server, "_decode_engine", None)
+            if eng is None or getattr(eng, "host_store", None) is None:
+                return
+            try:
+                bs = eng.block_size
+                if len(prompt) <= bs:
+                    return  # nothing a peer could hand us
+                if (eng.estimate_cached_tokens(prompt)
+                        >= gw.kv_pull_min_tokens):
+                    return
+                peers = _kv_tier.read_peers(gw.kv_peers_file)
+                if not peers:
+                    return
+                # chain-root key spreads prompts across peers
+                # deterministically: the same prefix always asks the
+                # same peer, so peer-side caches stay hot too
+                keys = _kv_tier.chain_keys(prompt, bs)
+                peer = peers[int(keys[0][:8], 16) % len(peers)]
+                conn = http.client.HTTPConnection(
+                    str(peer.get("host", "127.0.0.1")),
+                    int(peer["port"]), timeout=gw.kv_pull_timeout_s,
+                )
+                try:
+                    conn.request(
+                        "POST", "/v1/kv/prefill",
+                        json.dumps({"prompt_ids": list(prompt)}),
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                finally:
+                    conn.close()
+                if resp.status != 200:
+                    raise ServingError(
+                        "kv pull got HTTP %d" % resp.status
+                    )
+                doc = json.loads(raw.decode("utf-8"))
+                if int(doc.get("block") or 0) != bs:
+                    raise ServingError("kv pull block-size mismatch")
+                cfg = eng.session.cfg
+                row_shape = [cfg.num_heads, bs,
+                             cfg.hidden_size // cfg.num_heads]
+                entries = _kv_tier.decode_entries(
+                    doc.get("blocks") or [], row_shape
+                )
+                n = eng.offer_blocks(entries)
+                _profiler.bump_counter("kv_tier_pulls")
+                _profiler.bump_counter("kv_tier_pull_tokens", n * bs)
+            except Exception:  # noqa: BLE001 - degrade to local prefill
+                _profiler.bump_counter("kv_tier_pull_failures")
+
+        def _kv_prefill(self):
+            """POST /v1/kv/prefill (internal fleet endpoint): compute
+            and serialize the prompt's chain blocks. If the chain is
+            not fully published yet, one 1-token generation drives the
+            chunked prefill + publish, then the loop thread exports the
+            blocks (host-store blocks serve straight from the tier).
+            Returns base64 float32 payloads in chain order."""
+            t0 = time.monotonic()
+            rid = self.headers.get("X-Request-Id") or "-"
+            try:
+                body = self._read_body()
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)}, close=True)
+                return
+            eng = getattr(gw.server, "_decode_engine", None)
+            try:
+                prompt = body.get("prompt_ids") \
+                    if isinstance(body, dict) else None
+                if (not isinstance(prompt, list) or not prompt
+                        or not all(isinstance(t, int) for t in prompt)):
+                    raise ValueError(
+                        "'prompt_ids' must be a non-empty list of ints"
+                    )
+                if eng is None or getattr(eng, "pindex", None) is None:
+                    self._send_json(503, {
+                        "error": "no paged prefix index on this replica",
+                        "request_id": rid,
+                    })
+                    return
+                bs = eng.block_size
+                want = len(prompt) // bs
+                if want < 1:
+                    raise ValueError(
+                        "prompt shorter than one block (%d)" % bs
+                    )
+                entries = eng.request_export(prompt, timeout=5.0) or []
+                if len(entries) < want:
+                    # cold chain: one 1-token generation prefills and
+                    # publishes it (counts as normal engine traffic)
+                    stream = gw.server.generate(prompt, max_new_tokens=1)
+                    stream.tokens(timeout=60)
+                    entries = eng.request_export(prompt, timeout=5.0) or []
+                self._send_json(200, {
+                    "block": bs,
+                    "count": len(entries),
+                    "served_ms": round((time.monotonic() - t0) * 1e3, 3),
+                    "blocks": _kv_tier.encode_entries(entries),
+                })
+            except ValueError as e:
+                self._send_json(400, {"error": str(e),
+                                      "request_id": rid})
+            except ServerOverloadedError as e:
+                self._send_json(429, {"error": str(e),
+                                      "request_id": rid})
+            except Exception as e:  # noqa: BLE001 - internal endpoint
+                self._send_json(500, {"error": str(e),
+                                      "request_id": rid})
 
         def _resume_state(self, stream, sent):
             """The reconstruction state every generate done/error event

@@ -120,6 +120,12 @@ def main(argv=None):
                     help="JSON spec: attach a seeded GPT DecodeEngine "
                          "so this replica serves /v1/generate "
                          "(see build_gpt_decode_engine)")
+    ap.add_argument("--role", default="mixed",
+                    choices=("prefill", "decode", "mixed"),
+                    help="fleet KV-tier role: prefill replicas compute "
+                         "+ publish chain blocks over /v1/kv/prefill; "
+                         "decode replicas own slots and pull published "
+                         "blocks on admission miss; mixed does both")
     args = ap.parse_args(argv)
 
     # heavy imports AFTER argparse: --help must not pay for jax
@@ -138,7 +144,7 @@ def main(argv=None):
         pred, decode_engine=engine
     ).start(warmup_inputs=warmup)
     gw = serving.Gateway(
-        server, port=0, host=args.host,
+        server, port=0, host=args.host, role=args.role,
         extra_headers={
             "X-Replica-Id": str(args.replica_id),
             "X-Model-Version": str(args.version),
@@ -160,6 +166,7 @@ def main(argv=None):
         "model_dir": args.model_dir,
         "gateway_port": gw.port,
         "metrics_port": exp.port if exp is not None else None,
+        "role": args.role,
         "warmed": warmup is not None,
         "ts": anchor["ts"],
         "ts_mono": anchor["ts_mono"],

@@ -59,10 +59,11 @@ from ..observability import exporter as _obs_exporter
 from ..observability import flight as _flight
 from ..observability import registry as _obs_registry
 from ..observability import trace as _trace
+from . import kv_tier as _kv_tier
 from .access_log import AccessLog
 from .gateway import _MAX_BODY_BYTES
 
-__all__ = ["Backend", "Router", "probe_readyz"]
+__all__ = ["Backend", "Router", "probe_readyz", "probe_readyz_body"]
 
 
 def probe_readyz(host, port, timeout=1.0):
@@ -70,19 +71,35 @@ def probe_readyz(host, port, timeout=1.0):
     ``timeout`` — the ONE readiness-probe implementation, shared by the
     router's health loop and the fleet controller's startup watch so
     'ready' can never mean two different things."""
+    return probe_readyz_body(host, port, timeout=timeout)[0]
+
+
+def probe_readyz_body(host, port, timeout=1.0):
+    """``(ok, body_dict)`` form of the readiness probe: the 200 body
+    now carries the replica's KV-tier advertisement (hot prefix-chain
+    heads, block size, role) — the router's health loop reads it so
+    affinity data rides the poll that already exists instead of a
+    second request. A 200 with an unparseable body is still ready
+    (affinity is an optimization; readiness is the contract)."""
     try:
         conn = http.client.HTTPConnection(host, port, timeout=timeout)
         try:
             conn.request("GET", "/readyz")
             resp = conn.getresponse()
-            resp.read()
-            return resp.status == 200
+            raw = resp.read()
+            if resp.status != 200:
+                return False, None
+            try:
+                body = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                body = None
+            return True, body if isinstance(body, dict) else None
         finally:
             conn.close()
     except (OSError, http.client.HTTPException):
         # refused/reset/timeout or a torn read (IncompleteRead /
         # BadStatusLine): not ready — never a probe-killing event
-        return False
+        return False, None
 
 
 def _flag(name, override):
@@ -113,7 +130,8 @@ class Backend(object):
 
     __slots__ = ("id", "host", "port", "version", "ready", "inflight",
                  "fail_streak", "breaker_until", "probe_inflight",
-                 "probe_t")
+                 "probe_t", "prefix_heads", "advert_block", "advert_t",
+                 "affinity_score", "role")
 
     def __init__(self, backend_id, host, port, version=0, ready=False):
         self.id = str(backend_id)
@@ -122,6 +140,16 @@ class Backend(object):
         self.version = int(version)
         self.ready = bool(ready)
         self.inflight = 0
+        # KV-tier advertisement (stamped by the health loop from the
+        # /readyz body): the replica's hot prefix-chain head keys, its
+        # paged block size, and when the advert was taken — _pick's
+        # affinity scorer ignores adverts older than the staleness
+        # bound, so a dead replica's heads can't black-hole traffic
+        self.prefix_heads = frozenset()
+        self.advert_block = 0
+        self.advert_t = 0.0        # monotonic stamp of the last advert
+        self.affinity_score = 0    # cached tokens of the LAST routed pick
+        self.role = "mixed"
         # circuit breaker: consecutive request-path failures open it
         # (excluded from picks until breaker_until), then half-open —
         # a single probe request (probe_inflight) decides re-admission.
@@ -142,7 +170,7 @@ class Backend(object):
         return "open" if now < self.breaker_until else "half_open"
 
     def as_dict(self):
-        return {
+        out = {
             "id": self.id,
             "host": self.host,
             "port": self.port,
@@ -151,7 +179,21 @@ class Backend(object):
             "inflight": self.inflight,
             "breaker": self.breaker_state(),
             "fail_streak": self.fail_streak,
+            "role": self.role,
         }
+        # affinity debuggability (/backends): what this backend
+        # advertises, how it scored on its last routed request, and how
+        # stale the advert is — without these, a misroute (stale advert,
+        # empty heads, wrong block size) is undiagnosable from outside
+        out["prefix_heads"] = len(self.prefix_heads)
+        out["prefix_head_sample"] = sorted(self.prefix_heads)[:4]
+        out["advert_block"] = self.advert_block
+        out["advert_age_s"] = (
+            round(time.monotonic() - self.advert_t, 3)
+            if self.advert_t else None
+        )
+        out["affinity_score"] = self.affinity_score
+        return out
 
 
 class _ProxyFailure(Exception):
@@ -321,6 +363,9 @@ class Router(object):
         self.breaker_cooldown_s = float(
             _flag("router_breaker_cooldown_s", breaker_cooldown_s)
         )
+        # cache-affinity staleness bound: an advert the health loop has
+        # not refreshed within this window scores zero in _pick
+        self.advert_ttl_s = float(_flags.get_flag("kv_tier_advert_ttl_s"))
         self._backends = {}  # id -> Backend
         self._active_version = None  # None = route every version
         self._lock = threading.Lock()
@@ -465,7 +510,7 @@ class Router(object):
         return (self._active_version is None
                 or b.version == self._active_version)
 
-    def _pick(self, exclude=(), version=None):
+    def _pick(self, exclude=(), version=None, prompt_ids=None):
         """Least-inflight ready backend of the active version (ties by
         id, so picks are deterministic); reserves an inflight slot.
         ``version`` (a generate-resume pick) additionally pins to ONE
@@ -475,8 +520,19 @@ class Router(object):
         backend is eligible for exactly ONE concurrent probe request —
         its zero inflight makes it the least-inflight pick, so the next
         request probes it promptly, but a traffic wave can't pile onto
-        a replica that hasn't proven itself yet."""
+        a replica that hasn't proven itself yet.
+
+        ``prompt_ids`` arms CACHE-AFFINITY scoring: each eligible
+        backend is scored by the expected cached tokens for this
+        prompt's hash chain against its advertised head keys (a chain
+        key at depth i names the WHOLE (i+1)-block prefix, so the
+        deepest advertised match IS the expected hit length). The best
+        positive scorer wins (ties by inflight then id); all-zero
+        scores fall back to plain least-inflight — and an advert older
+        than the staleness bound scores 0, so a dead replica's last
+        advertisement can't keep attracting its prefix traffic."""
         now = time.monotonic()
+        chain_cache = {}  # block size -> this prompt's chain keys
         with self._lock:
             ready = []
             for b in self._backends.values():
@@ -498,15 +554,51 @@ class Router(object):
                     # can no longer be outstanding, reclaim the slot
                     if now - b.probe_t <= self.backend_timeout_s:
                         continue
-                ready.append((b, state))
+                score = self._affinity_score(b, prompt_ids, now,
+                                             chain_cache)
+                ready.append((b, state, score))
             if not ready:
                 return None
-            b, state = min(ready, key=lambda x: (x[0].inflight, x[0].id))
+            best = max(s for _b, _st, s in ready)
+            if best > 0:
+                b, state, _s = min(
+                    ((b, st, s) for b, st, s in ready if s == best),
+                    key=lambda x: (x[0].inflight, x[0].id),
+                )
+                _profiler.bump_counter("router_affinity_hits")
+                b.affinity_score = best
+            else:
+                b, state, _s = min(ready,
+                                   key=lambda x: (x[0].inflight, x[0].id))
+                if prompt_ids:
+                    _profiler.bump_counter("router_affinity_misses")
+                b.affinity_score = 0
             if state == "half_open":
                 b.probe_inflight = True
                 b.probe_t = now
             b.inflight += 1
             return b
+
+    def _affinity_score(self, b, prompt_ids, now, chain_cache):
+        """Expected cached tokens on ``b`` for this prompt: the deepest
+        advertised chain key, times the block size. Chain keys are
+        computed once per (request, block size) and shared across
+        backends via ``chain_cache``. Caller holds the lock."""
+        if not prompt_ids or not b.prefix_heads or b.advert_block < 1:
+            return 0
+        if now - b.advert_t > self.advert_ttl_s:
+            _profiler.bump_counter("router_affinity_stale")
+            return 0
+        bs = b.advert_block
+        keys = chain_cache.get(bs)
+        if keys is None:
+            keys = _kv_tier.chain_keys(prompt_ids, bs)
+            chain_cache[bs] = keys
+        score = 0
+        for i, key in enumerate(keys):
+            if key in b.prefix_heads:
+                score = (i + 1) * bs
+        return score
 
     def _release(self, b):
         with self._lock:
@@ -571,20 +663,37 @@ class Router(object):
 
     def _probe_and_set(self, b):
         try:
-            ok = self._probe_ready(b)
+            ok, body = self._probe_ready(b)
         except Exception:
             # the supervision path must outlive ANY one bad probe — a
             # dead health loop would strand every _mark_failed backend
             # not-ready forever
-            ok = False
+            ok, body = False, None
+        kv = body.get("kv") if isinstance(body, dict) else None
         with self._lock:
             # the backend may have been removed mid-probe; only flip
             # state on the instance (harmless if orphaned)
             b.ready = ok
+            if isinstance(kv, dict):
+                # the replica's KV-tier advertisement rides the health
+                # poll: hot chain heads + block size + role, stamped
+                # with THIS probe's clock so staleness is measurable
+                heads = kv.get("heads")
+                b.prefix_heads = frozenset(
+                    h for h in heads if isinstance(h, str)
+                ) if isinstance(heads, list) else frozenset()
+                try:
+                    b.advert_block = int(kv.get("block") or 0)
+                except (TypeError, ValueError):
+                    b.advert_block = 0
+                b.advert_t = time.monotonic()
+                role = kv.get("role")
+                if role in ("prefill", "decode", "mixed"):
+                    b.role = role
 
     def _probe_ready(self, b):
-        return probe_readyz(b.host, b.port,
-                            timeout=min(2.0, self.backend_timeout_s))
+        return probe_readyz_body(b.host, b.port,
+                                 timeout=min(2.0, self.backend_timeout_s))
 
 
 # -- HTTP proxy handler ------------------------------------------------------
@@ -909,7 +1018,14 @@ def _make_handler(router):
                     # the budget died in the router's own queue — the
                     # same 504 the replica's dispatch shed would return
                     return self._send_deadline_504()
-                b = router._pick(exclude=tried)
+                # /v1/generate bodies carry prompt_ids — the affinity
+                # scorer's input; /v1/infer feeds score None (no chain)
+                prompt = (parsed.get("prompt_ids")
+                          if isinstance(parsed, dict) else None)
+                b = router._pick(
+                    exclude=tried,
+                    prompt_ids=prompt if isinstance(prompt, list) else None,
+                )
                 if b is None:
                     return self._no_backend()
                 tried.add(b.id)
@@ -1025,7 +1141,12 @@ def _make_handler(router):
             Each call consumes one pick; transient failures (dead
             socket, 503 drain) are the CALLER's to retry under its
             failover budget."""
-            nb = router._pick(exclude=ctx.tried, version=ctx.version)
+            prompt = (ctx.parsed.get("prompt_ids")
+                      if isinstance(ctx.parsed, dict) else None)
+            nb = router._pick(
+                exclude=ctx.tried, version=ctx.version,
+                prompt_ids=prompt if isinstance(prompt, list) else None,
+            )
             if nb is None:
                 return None, None, "no healthy replica of the stream's " \
                                    "model version"

@@ -156,6 +156,7 @@ def test_bert_flash_flag_matches_dense_path():
     np.testing.assert_allclose(flash, dense, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~14 s; fast parity retained: bert flag-path + kernel-level tests
 def test_transformer_flash_flag_matches_dense_path():
     """Transformer NMT with use_flash_attention (causal decoder self-attn
     via the kernel's causal flag, padding via key-only biases) must match
@@ -596,6 +597,7 @@ def test_flash_dropout_keeps_expectation():
     assert err(16) < err(2) * 0.75  # converging toward the dense output
 
 
+@pytest.mark.slow  # ~9 s; fast equivalents: bert_trains_through_flash_kernel + dropout kernel parity
 def test_bert_trains_through_flash_with_dropout():
     """End-to-end: default-dropout BERT config trains THROUGH the kernel
     (interpret mode) with finite, decreasing loss."""

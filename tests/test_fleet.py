@@ -1124,6 +1124,15 @@ def test_fleet_probe_fast_acceptance():
     assert report["rollout"]["post_wrong"] == 0
     assert report["strict"]["steady_recompiles"] == 0
     assert report["fleet_report"]["scale_ups"] >= 1
+    # fleet KV tier (ISSUE 17): affinity-steered hits within 1.5x of a
+    # warmed single replica, and host spill/re-admission beating
+    # chunked re-prefill past the banked crossover — both trials report
+    # or the probe fails above, so just pin the load-bearing facts
+    assert report["kv_tier"]["measure_hits"] >= 5
+    assert report["kv_tier"]["router_affinity_hits"] >= 1
+    assert report["kv_tier"]["steady_recompiles"] == 0
+    assert report["kv_tier_churn"]["spills"] >= 1
+    assert report["kv_tier_churn"]["readmits"] >= 1
 
 
 # ---------------------------------------------------------------------------

@@ -2,6 +2,7 @@
 vs dense logits parity, loss masking, and greedy generation."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.models import gpt
@@ -120,6 +121,7 @@ def test_gpt_greedy_generate():
     assert out == out2  # greedy decode is deterministic
 
 
+@pytest.mark.slow  # ~8 s; fast equivalents: gpt_flash_matches_dense + flash dropout kernel parity
 def test_gpt_flash_with_dropout_rides_kernel_and_stays_causal():
     """Round 5: attention dropout runs INSIDE the flash kernel, so a
     default training config (dropout 0.1) engages it — with the causal
@@ -161,6 +163,7 @@ def test_gpt_flash_with_dropout_rides_kernel_and_stays_causal():
     assert min(losses[3:]) < losses[0], losses
 
 
+@pytest.mark.slow  # ~9 s; fast equivalents: gpt_flash_matches_dense + gpt_greedy_generate
 def test_gpt_greedy_generate_through_flash_kernel():
     """Generation drives the CAUSAL kernel at full graph length with a
     growing mask — the flash path must reproduce the dense path's greedy

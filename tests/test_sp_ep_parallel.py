@@ -2,6 +2,7 @@
 on the virtual 8-device mesh, each against a single-device oracle."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -167,6 +168,7 @@ def test_ring_attention_flash_matches_dense_ring():
                                    err_msg="causal=%s" % causal)
 
 
+@pytest.mark.slow  # ~12 s; fast equivalents: ring_attention_flash fwd parity + dense ring grads (test_spmd_parallel) + flash grad kernel tests
 def test_ring_attention_flash_gradients():
     """Training through flash-ring: grads wrt q/k/v match the
     single-device full-attention grads (the lse cotangent path through
@@ -196,6 +198,7 @@ def test_ring_attention_flash_gradients():
                 err_msg="causal=%s argnum=%d" % (causal, i))
 
 
+@pytest.mark.slow  # ~12 s; fast equivalents: ulysses dense parity + flash kernel parity/grad tests
 def test_ulysses_flash_matches_dense():
     """Ulysses with the Pallas kernels after the head-scatter: forward and
     gradient parity vs the dense ulysses path, causal and not."""

@@ -328,6 +328,268 @@ def run_generate_failover_trial(tmp, model_dir, report, failures, fast):
                 "gen-failover controller stop failed: %r" % e)
 
 
+def run_kv_tier_trial(tmp, model_dir, report, failures, fast):
+    """Fleet KV tier, closed loop: (a) cache-affinity routing — three
+    replicas under an 80%-shared-prefix load must serve hits with a
+    fleet mean TTFT within 1.5x of a single warmed replica's hit TTFT
+    (the router steering repeats to the replica already holding the
+    chain); (b) spill churn — a device index squeezed to one block
+    spills every chain to host, and H2D re-admission must still beat
+    chunked re-prefill past the banked crossover (~2 blocks; PERF.md).
+    Every stream stays token-exact against an in-process oracle and
+    the strict compile gate stays at zero fleet-wide."""
+    import numpy as np
+
+    from paddle_tpu.fluid import flags as _flags
+    from paddle_tpu.observability import registry as _reg
+    from paddle_tpu.serving.fleet import FleetController
+    from paddle_tpu.serving.replica import build_gpt_decode_engine
+
+    spec = {"seed": 17, "vocab_size": 97, "hidden_size": 32,
+            "num_layers": 2, "num_heads": 2, "intermediate_size": 64,
+            "max_len": 48, "slots": 8, "prefill_buckets": [8, 16, 48]}
+    oracle_engine = build_gpt_decode_engine(spec).start()
+    rs = np.random.RandomState(31)
+    shared = [int(t) for t in rs.randint(0, spec["vocab_size"], 24)]
+    streams = []
+    for i in range(10):
+        if i < 8:  # 80% share the 24-token prefix
+            prompt = shared + [int(t) for t in rs.randint(0, 97, 2)]
+        else:
+            prompt = [int(t) for t in rs.randint(0, 97, 26)]
+        streams.append({"prompt": prompt})
+    try:
+        for s in streams:
+            s["oracle"] = oracle_engine.generate(
+                s["prompt"], max_new_tokens=4).tokens(timeout=120)
+    finally:
+        oracle_engine.stop()
+
+    workdir = os.path.join(tmp, "fleet_kv")
+    kv_env = {
+        "FLAGS_serving_strict_compiles": "1",
+        "FLAGS_decode_block_size": "8",
+        "FLAGS_decode_prefill_chunk": "8",
+        "FLAGS_decode_prefix_cache_mb": "2",
+        "FLAGS_kv_tier_host_mb": "4",
+        "FLAGS_obs_snapshot_interval_s": "1.0",
+    }
+    ctrl = FleetController(
+        model_dir=model_dir, workdir=workdir, replicas=3,
+        replica_env=kv_env, autoscale=False, seed=0,
+        replica_args=["--gpt-decode", json.dumps(spec)],
+    )
+    t0 = time.monotonic()
+    ctrl.start()
+    try:
+        ctrl.wait_ready(count=3, timeout=180 if fast else 300)
+        url = ctrl.router.url("/v1/generate")
+
+        def one(target_url, s):
+            body = dict(prompt_ids=s["prompt"], max_new_tokens=4,
+                        deadline_ms=60000)
+            _st, events, _c, gaps, _h = _sse_collect(
+                target_url, body, timeout=90)
+            toks = [e["token"] for e in events if "token" in e]
+            done = next((e for e in events if e.get("done")), {})
+            return toks, done, (gaps[0] * 1e3 if gaps else None)
+
+        # warm wave: seed the caches wherever the router lands them
+        for s in streams:
+            toks, _d, _t = one(url, s)
+            if toks != s["oracle"]:
+                failures.append(
+                    "kv-tier warm stream diverged: %r != %r"
+                    % (toks, s["oracle"]))
+        # let the router's health sweep pick up the new adverts
+        time.sleep(1.2)
+
+        hit_ttfts, hits = [], 0
+        for s in streams:
+            toks, done, ttft = one(url, s)
+            if toks != s["oracle"]:
+                failures.append(
+                    "kv-tier measure stream diverged: %r != %r"
+                    % (toks, s["oracle"]))
+            if done.get("cached_prefix_tokens", 0) > 0:
+                hits += 1
+                if ttft is not None:
+                    hit_ttfts.append(ttft)
+        if hits < len(streams) // 2:
+            failures.append(
+                "kv-tier: only %d/%d measure streams hit the prefix "
+                "cache" % (hits, len(streams)))
+
+        # single-replica hit baseline: one warmed backend, direct
+        info = [i for i in ctrl.replica_info() if i["state"] == "ready"]
+        base_ttft = None
+        if info:
+            direct = "http://127.0.0.1:%d/v1/generate" \
+                % info[0]["gateway_port"]
+            s0 = streams[0]
+            one(direct, s0)  # warm this exact replica
+            samples = []
+            for _ in range(3):
+                _t, _d, ttft = one(direct, s0)
+                if ttft is not None:
+                    samples.append(ttft)
+            base_ttft = sorted(samples)[len(samples) // 2] \
+                if samples else None
+        fleet_mean = (sum(hit_ttfts) / len(hit_ttfts)
+                      if hit_ttfts else None)
+        if fleet_mean is not None and base_ttft is not None:
+            if fleet_mean > 1.5 * max(base_ttft, 2.0):
+                failures.append(
+                    "throughput: kv-tier fleet mean hit TTFT %.1fms "
+                    "exceeds 1.5x single-replica hit TTFT %.1fms"
+                    % (fleet_mean, base_ttft))
+        else:
+            failures.append("kv-tier: no TTFT samples collected")
+
+        # the router steered by affinity, and /backends says how
+        aff_hits = int(_reg.snapshot()["counters"].get(
+            "router_affinity_hits", 0))
+        if aff_hits == 0:
+            failures.append("kv-tier: router never scored an affinity "
+                            "hit under a shared-prefix load")
+        with urllib.request.urlopen(ctrl.router.url("/backends"),
+                                    timeout=5) as r:
+            backends = json.loads(r.read().decode()).get("backends", [])
+        if not any(b.get("prefix_heads") for b in backends):
+            failures.append("kv-tier: no backend advertises prefix "
+                            "heads on /backends")
+        for key in ("advert_block", "affinity_score", "role"):
+            if backends and key not in backends[0]:
+                failures.append("kv-tier: /backends rows missing %r"
+                                % key)
+
+        # strict gate + spill traffic, fleet-wide
+        steady = spills = readmits = scraped = 0
+        for i in info:
+            port = i.get("metrics_port")
+            if not port:
+                continue
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics" % port, timeout=5
+                ) as r:
+                    parsed = _reg.parse_prometheus(
+                        r.read().decode("utf-8"))
+                scraped += 1
+                steady += int(parsed.get(
+                    ("serving_steady_recompiles", ""), 0))
+                spills += int(parsed.get(("kv_tier_spills", ""), 0))
+                readmits += int(parsed.get(("kv_tier_readmits", ""), 0))
+            except Exception as e:  # noqa: BLE001
+                failures.append("kv-tier metrics scrape failed: %r" % e)
+        if not scraped:
+            failures.append("kv-tier: no replica metrics scraped")
+        if steady != 0:
+            failures.append(
+                "kv-tier: %d steady-state recompiles under the armed "
+                "strict gate" % steady)
+        report["kv_tier"] = {
+            "streams": len(streams),
+            "measure_hits": hits,
+            "fleet_mean_hit_ttft_ms": (round(fleet_mean, 1)
+                                       if fleet_mean else None),
+            "single_replica_hit_ttft_ms": (round(base_ttft, 1)
+                                           if base_ttft else None),
+            "router_affinity_hits": aff_hits,
+            "fleet_spills": spills,
+            "fleet_readmits": readmits,
+            "steady_recompiles": steady,
+            "wall_s": round(time.monotonic() - t0, 1),
+        }
+    finally:
+        try:
+            ctrl.stop()
+        except Exception as e:  # noqa: BLE001
+            failures.append("kv-tier controller stop failed: %r" % e)
+
+    # ---- spill churn: re-admission vs chunked re-prefill -------------
+    # device index squeezed to ONE block => every admitted chain spills
+    # to host and comes back H2D on the next admission. Past the banked
+    # crossover (PERF.md: ~2 blocks of 8) that round-trip must beat
+    # re-running chunked prefill over the prefix.
+    churn_spec = {"seed": 17, "vocab_size": 97, "hidden_size": 64,
+                  "num_layers": 4, "num_heads": 4,
+                  "intermediate_size": 128, "max_len": 96, "slots": 8,
+                  "prefill_buckets": [8, 16, 48, 96]}
+    saved = {k: _flags.get_flag(k) for k in
+             ("decode_prefix_cache_mb", "decode_block_size",
+              "decode_prefill_chunk", "kv_tier_host_mb")}
+    engR = engP = None
+    try:
+        _flags.set_flags({
+            "FLAGS_decode_prefix_cache_mb": 8.0,
+            "FLAGS_decode_block_size": 8,
+            "FLAGS_decode_prefill_chunk": 8,
+            "FLAGS_kv_tier_host_mb": 8.0,
+        })
+        engR = build_gpt_decode_engine(churn_spec).start()
+        engR.pindex.max_blocks = 1  # force evict->spill on every chain
+        _flags.set_flags({"FLAGS_kv_tier_host_mb": 0.0})
+        engP = build_gpt_decode_engine(churn_spec).start()
+        engP.pindex.max_blocks = 0  # nothing cached: always re-prefill
+
+        def ttft_ms(eng, prompt, n=5):
+            ts = []
+            for _ in range(n):
+                t1 = time.monotonic()
+                eng.generate(list(prompt),
+                             max_new_tokens=1).tokens(timeout=60)
+                ts.append((time.monotonic() - t1) * 1e3)
+            return sorted(ts)[len(ts) // 2]
+
+        rows = []
+        for ln in ((16, 48) if fast else (8, 16, 32, 48, 64, 80)):
+            prefix = [int(t) for t in rs.randint(0, 97, ln)]
+            # warm: prefill once; the squeezed index spills it to host
+            wa = engR.generate(prefix + [3],
+                               max_new_tokens=2).tokens(timeout=60)
+            wb = engP.generate(prefix + [3],
+                               max_new_tokens=2).tokens(timeout=60)
+            if wa != wb:
+                failures.append(
+                    "kv-tier churn diverged at len %d: %r != %r"
+                    % (ln, wa, wb))
+            rows.append({
+                "prefix_tokens": ln,
+                "readmit_ttft_ms": round(
+                    ttft_ms(engR, prefix + [5]), 1),
+                "reprefill_ttft_ms": round(
+                    ttft_ms(engP, prefix + [5]), 1),
+            })
+        past = [r for r in rows if r["prefix_tokens"] >= 48]
+        for r in past:
+            if r["readmit_ttft_ms"] >= r["reprefill_ttft_ms"]:
+                failures.append(
+                    "throughput: kv-tier re-admission (%.1fms) did not "
+                    "beat chunked re-prefill (%.1fms) at %d tokens — "
+                    "past the banked crossover"
+                    % (r["readmit_ttft_ms"], r["reprefill_ttft_ms"],
+                       r["prefix_tokens"]))
+        st = engR.stats().get("kv_tier") or {}
+        if not st.get("spills") or not st.get("readmits"):
+            failures.append(
+                "kv-tier churn moved no blocks through the host tier: "
+                "%r" % st)
+        report["kv_tier_churn"] = {
+            "rows": rows,
+            "spills": st.get("spills"),
+            "readmits": st.get("readmits"),
+        }
+    finally:
+        for eng in (engR, engP):
+            try:
+                if eng is not None:
+                    eng.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        _flags.set_flags({"FLAGS_" + k: v for k, v in saved.items()})
+
+
 def run_probe(fast=True, verbose=False):
     import numpy as np
 
@@ -701,6 +963,14 @@ def run_probe(fast=True, verbose=False):
         )
     except Exception as e:  # noqa: BLE001 - the trial must report, not die
         failures.append("gen-failover trial crashed: %r" % e)
+
+    # ---- fleet KV tier: affinity routing + host-spill churn ----------
+    try:
+        run_kv_tier_trial(
+            tmp, os.path.join(tmp, "export_v1"), report, failures, fast
+        )
+    except Exception as e:  # noqa: BLE001 - the trial must report, not die
+        failures.append("kv-tier trial crashed: %r" % e)
 
     # ---- merged fleet report -----------------------------------------
     fr_path = os.path.join(workdir, "fleet_report.json")
