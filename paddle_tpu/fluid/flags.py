@@ -185,6 +185,34 @@ _DEFAULTS = {
     "fleet_restart_backoff_s": 0.5,
     "fleet_max_replica_restarts": 10,
     "fleet_drain_grace_s": 15.0,
+    # autoscaler policy selection: fleet_policy picks the controller's
+    # scaling brain — "streak" is the load-driven AutoscalerPolicy above;
+    # "slo" is SLOPolicy, which scales on scraped per-replica p95 TTFT
+    # (fleet_slo_ttft_ms) / p95 inter-token latency
+    # (fleet_slo_intertoken_ms) budgets instead of raw queue depth (0
+    # disarms a budget; sheds always count as breach). Scale-down needs
+    # every armed p95 under fleet_slo_headroom * budget (plus zero
+    # sheds) sustained for the same fleet_scale_down_ticks hysteresis.
+    "fleet_policy": "streak",
+    "fleet_slo_ttft_ms": 2000.0,
+    "fleet_slo_intertoken_ms": 0.0,
+    "fleet_slo_headroom": 0.6,
+    # decode-slot scheduler (paddle_tpu/serving/decode.py): pending
+    # admissions dequeue weighted-fair across tenants (stride scheduling;
+    # sched_tenant_weights is "tenantA:4,tenantB:1" — unlisted tenants
+    # weigh 1.0) with interactive class strictly ahead of batch. When
+    # sched_preempt is on and an interactive request is waiting with no
+    # free slot, the engine evicts a batch generation mid-stream (its
+    # prompt + emitted tokens re-prefill on re-admission, so the resumed
+    # stream is token-exact) instead of making interactive queue behind
+    # it.
+    "sched_preempt": True,
+    "sched_tenant_weights": "",
+    # fleet simulator (paddle_tpu/serving/sim): virtual-clock replay of
+    # recorded/synthetic workloads through the real policy + admission +
+    # router classes. sim_replica_ready_s models the spawn-to-ready lag
+    # of a scaled-up replica inside the simulation.
+    "sim_replica_ready_s": 5.0,
     # replica router (paddle_tpu/serving/router.py): the fleet's single
     # front door. router_port binds the listener (0 = ephemeral); a
     # health thread polls every backend's /readyz each

@@ -395,16 +395,21 @@ def slowest_requests(obs_root, top=10, replicas=None):
     the merged fleet trace. ``replicas=`` scopes ``replica_<id>``
     sources to those ids (a reused workdir keeps dead runs' replica
     dirs; their dumps must not name trace_ids the current fleet never
-    saw); non-replica sources (the controller) always pass."""
+    saw); non-replica sources (the controller) always pass. Rows are
+    the stable journey schema (``flight.to_journey``) — the same codec
+    the fleet simulator replays, so the report and the sim can never
+    disagree about a field."""
+    from . import flight as _flight
+
     rows = []
     for label, rec in read_flight_records(obs_root):
         m = _REPLICA_DIR.match(label)
         if replicas is not None and m and int(m.group(1)) not in replicas:
             continue
-        ms = rec.get("ms")
-        if not isinstance(ms, (int, float)):
+        row = _flight.to_journey(dict(rec, process=label))
+        if not isinstance(row.get("ms"), (int, float)):
             continue
-        rows.append(dict(rec, process=label))
+        rows.append(row)
     rows.sort(key=lambda r: -float(r["ms"]))
     return rows[:int(top)]
 

@@ -32,7 +32,14 @@ from ..fluid import profiler as _profiler
 from . import trace as _trace
 
 __all__ = ["note", "records", "reset", "dump", "dump_on_error",
-           "flight_path"]
+           "flight_path", "to_journey", "write_journeys",
+           "load_journeys", "JOURNEY_SCHEMA_VERSION"]
+
+# the stable journey-export schema (JSONL, one journey per line). Bumped
+# only when a FIELD changes meaning — adding optional fields is not a
+# bump; consumers (the simulator, the fleet report) read by name and
+# ignore what they don't know.
+JOURNEY_SCHEMA_VERSION = 1
 
 _lock = threading.Lock()
 _buf = deque(maxlen=256)
@@ -141,3 +148,93 @@ def load(path):
         return []
     recs = payload.get("records")
     return recs if isinstance(recs, list) else []
+
+
+# -- journey export/import ---------------------------------------------------
+#
+# The flight ring's records are whatever the front door stashed that
+# day; the JOURNEY is the stable, versioned view of one — the contract
+# the simulator replays and the fleet report tabulates, so neither ever
+# reaches into ring internals or chases a gateway field rename.
+
+_J_STR = ("request_id", "tenant", "priority", "endpoint", "reason",
+          "trace_id", "backend", "process")
+_J_NUM = ("ts", "ms", "status", "tokens", "admit_wait_ms",
+          "inflight_at_entry", "ttft_ms", "ticks_spanned", "retries",
+          "failovers", "cached_prefix_tokens", "admit_windows",
+          "resumed_tokens", "preemptions")
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, str):
+        # hand-edited / re-exported JSONL sometimes quotes numbers;
+        # accept them, drop anything unparseable
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    if not isinstance(v, (int, float)):
+        return None
+    return float(v) if isinstance(v, float) else int(v)
+
+
+def to_journey(record):
+    """Normalize one raw flight record (or an already-exported journey
+    line) into the stable journey dict: known string fields coerced to
+    str, known numeric fields to int/float (bad types dropped, never
+    raised), ``schema_version`` stamped, unknown fields discarded.
+    ``priority`` defaults to ``interactive`` and ``tenant`` to ``anon``
+    so every journey is replayable as-is."""
+    rec = record if isinstance(record, dict) else {}
+    j = {"schema_version": JOURNEY_SCHEMA_VERSION}
+    for k in _J_STR:
+        v = rec.get(k)
+        if v is not None and not isinstance(v, (dict, list)):
+            j[k] = str(v)
+    for k in _J_NUM:
+        v = _num(rec.get(k))
+        if v is not None:
+            j[k] = v
+    j.setdefault("tenant", "anon")
+    if j.get("priority") not in ("interactive", "batch"):
+        j["priority"] = "interactive"
+    return j
+
+
+def write_journeys(path, records_in=None):
+    """Export journeys as JSONL (one ``to_journey`` dict per line) to
+    ``path``, atomic replace. ``records_in`` defaults to the live ring.
+    Returns the number of lines written."""
+    recs = records() if records_in is None else list(records_in)
+    rows = [to_journey(r) for r in recs]
+    tmp = "%s.tmp.%d" % (str(path), os.getpid())
+    with open(tmp, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    os.replace(tmp, str(path))
+    return len(rows)
+
+
+def load_journeys(path):
+    """Parse a journey JSONL file back into journey dicts (each
+    re-normalized through ``to_journey`` — a hand-edited or
+    future-versioned line still yields the fields this version knows).
+    Torn lines are skipped; a missing file reads as []."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict):
+                    out.append(to_journey(row))
+    except OSError:
+        return []
+    return out

@@ -163,10 +163,18 @@ def render_prometheus():
         pn = prom_name(name)
         lines.append("# TYPE %s counter" % pn)
         lines.append("%s %d" % (pn, val))
+    gauge_typed = set()
     for name, val in sorted(gauge_values().items()):
-        pn = prom_name(name)
-        lines.append("# TYPE %s gauge" % pn)
-        lines.append("%s %.17g" % (pn, val))
+        # a gauge registered as 'name{label="v"}' renders as a labeled
+        # series under the base family (one TYPE line per family) —
+        # how the per-class admission-wait gauges expose their class
+        base, _, labels = str(name).partition("{")
+        pn = prom_name(base)
+        if pn not in gauge_typed:
+            gauge_typed.add(pn)
+            lines.append("# TYPE %s gauge" % pn)
+        series = pn + ("{" + labels if labels else "")
+        lines.append("%s %.17g" % (series, val))
     for name, samples in sorted(_profiler.get_histograms().items()):
         pn = prom_name(name)
         s = percentiles(samples, points=(50, 95, 99))

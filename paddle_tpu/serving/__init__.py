@@ -60,7 +60,12 @@ from .decode import (  # noqa: F401
     fast_forward_rng,
     sample_token,
 )
-from .fleet import AutoscalerPolicy, FleetController  # noqa: F401
+from .fleet import (  # noqa: F401
+    AutoscalerPolicy,
+    FleetController,
+    SLOPolicy,
+    make_policy,
+)
 from .gateway import Gateway  # noqa: F401
 from .metrics import ServingStats, snapshot_stats  # noqa: F401
 from .pool import PredictorPool  # noqa: F401
@@ -73,6 +78,8 @@ __all__ = [
     "Router",
     "FleetController",
     "AutoscalerPolicy",
+    "SLOPolicy",
+    "make_policy",
     "DecodeEngine",
     "sample_token",
     "fast_forward_rng",

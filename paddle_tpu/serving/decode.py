@@ -1203,8 +1203,16 @@ class GenerationStream(object):
 
     def __init__(self, prompt_ids, max_new_tokens=None, eos_id=None,
                  temperature=0.0, top_k=0, top_p=0.0, seed=None,
-                 resume_tokens=None):
+                 resume_tokens=None, priority=None, tenant=None):
         self.prompt_ids = [int(t) for t in prompt_ids]
+        # scheduling identity (weighted-fair dequeue + preemption):
+        # interactive unless the caller says batch; tenant keys the
+        # fair-share virtual time
+        self.priority = "batch" if priority == "batch" else "interactive"
+        self.tenant = str(tenant or "")
+        # how many times this stream was preemption-evicted and
+        # re-admitted token-exactly (journey fact; 0 for most streams)
+        self.preemptions = 0
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         # sampling knobs (host-side over fetched logits — sample_token):
@@ -1546,7 +1554,15 @@ class DecodeEngine(object):
                         "resume_admissions": 0, "resume_tokens": 0,
                         "spec_drafted": 0, "spec_accepted": 0,
                         "oom_sheds": 0,
-                        "kv_readmits": 0, "kv_readmit_tokens": 0}
+                        "kv_readmits": 0, "kv_readmit_tokens": 0,
+                        "preemptions": 0, "preempt_replayed_tokens": 0}
+        # weighted-fair scheduler state (stride scheduling): per-tenant
+        # virtual time + the global virtual clock a joining tenant
+        # starts at (so a newcomer can't claim "unused" history)
+        self._sched_vtime = {}
+        self._sched_vclock = 0.0
+        self._sched_weights = {}
+        self._sched_weights_ver = None
         # fleet KV tier (kv_tier.py): host-spill store behind the paged
         # prefix index. Evicted device blocks spill D2H off the tick
         # thread; a later admission whose chain outruns the device index
@@ -1572,7 +1588,12 @@ class DecodeEngine(object):
         self._host_bytes_gauge = None
 
     # -- lifecycle -----------------------------------------------------------
-    def start(self):
+    def start(self, loop=True):
+        """Build the session, warm every steady-state shape, register
+        gauges, and (default) spawn the driver loop thread.
+        ``loop=False`` skips the thread: the caller drives ``_tick()``
+        itself — the deterministic harness the scheduler/preemption
+        tests use to stop the engine at an exact token boundary."""
         if self.started:
             raise RuntimeError("decode engine already started")
         if self._thread is not None and self._thread.is_alive():
@@ -1700,10 +1721,11 @@ class DecodeEngine(object):
                 )
             _xla_stats.arm_serving_steady()
             self._armed = True
-            self._thread = threading.Thread(
-                target=self._loop, name="decode-engine", daemon=True
-            )
-            self._thread.start()
+            if loop:
+                self._thread = threading.Thread(
+                    target=self._loop, name="decode-engine", daemon=True
+                )
+                self._thread.start()
             # LAST: a half-started engine must never look started — a
             # failure above (thread exhaustion, gauge clash) would
             # otherwise leave submits feeding a queue nothing drains
@@ -1831,8 +1853,13 @@ class DecodeEngine(object):
     # -- request path --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_id=None,
                temperature=0.0, top_k=0, top_p=0.0, seed=None,
-               resume_tokens=None):
+               resume_tokens=None, priority=None, tenant=None):
         """Non-blocking admission; returns a ``GenerationStream``.
+        ``priority`` ("interactive" default / "batch") and ``tenant``
+        are the scheduling identity: dequeue order is interactive-first
+        then weighted-fair across tenants, and under
+        ``FLAGS_sched_preempt`` a pending interactive request evicts a
+        running batch stream (token-exactly re-admitted later).
         Bounded queue: beyond ``queue_depth`` waiting requests, sheds
         with ``ServerOverloadedError`` (same backpressure contract as
         the micro-batcher). Sampling knobs are per-request and host-side
@@ -1890,6 +1917,7 @@ class DecodeEngine(object):
                     prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     seed=seed, resume_tokens=resume,
+                    priority=priority, tenant=tenant,
                 )
                 stream._finish("length")
                 return stream
@@ -1907,7 +1935,8 @@ class DecodeEngine(object):
         stream = GenerationStream(prompt, max_new_tokens=max_new_tokens,
                                   eos_id=eos_id, temperature=temperature,
                                   top_k=top_k, top_p=top_p, seed=seed,
-                                  resume_tokens=resume)
+                                  resume_tokens=resume,
+                                  priority=priority, tenant=tenant)
         with self._cond:
             # re-checked under the lock stop() drains under: after the
             # drain, started is already False here and the stream can
@@ -1931,13 +1960,14 @@ class DecodeEngine(object):
 
     def generate(self, prompt_ids, max_new_tokens=None, eos_id=None,
                  temperature=0.0, top_k=0, top_p=0.0, seed=None,
-                 resume_tokens=None):
+                 resume_tokens=None, priority=None, tenant=None):
         """Submit and return the streaming handle (iterate for tokens as
         they land; ``.tokens()`` / ``.result()`` to block)."""
         return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
                            eos_id=eos_id, temperature=temperature,
                            top_k=top_k, top_p=top_p, seed=seed,
-                           resume_tokens=resume_tokens)
+                           resume_tokens=resume_tokens,
+                           priority=priority, tenant=tenant)
 
     def set_spec_width(self, width):
         """Runtime speculation toggle for a paged engine: switch the
@@ -1984,6 +2014,9 @@ class DecodeEngine(object):
             "spec_drafted": self._counts["spec_drafted"],
             "spec_accepted": self._counts["spec_accepted"],
             "oom_sheds": self._counts["oom_sheds"],
+            "preemptions": self._counts["preemptions"],
+            "preempt_replayed_tokens":
+                self._counts["preempt_replayed_tokens"],
         }
         if self._counts["spec_drafted"]:
             out["spec_acceptance"] = (
@@ -2119,18 +2152,161 @@ class DecodeEngine(object):
             prefix -= self.prefix_block
         return 0, [(0, prompt_len)]
 
+    # -- scheduler (weighted-fair dequeue + priority preemption) -------------
+    def _tenant_weight(self, tenant):
+        """Weight from ``FLAGS_sched_tenant_weights`` ("a:4,b:1");
+        unlisted tenants weigh 1. Parsed once per flags version."""
+        ver = _flags.version()
+        if ver != self._sched_weights_ver:
+            self._sched_weights_ver = ver
+            table = {}
+            spec = str(_flags.get_flag("sched_tenant_weights", "") or "")
+            for part in spec.split(","):
+                name, sep, w = part.strip().rpartition(":")
+                if not sep:
+                    continue
+                try:
+                    table[name.strip()] = max(float(w), 1e-3)
+                except ValueError:
+                    continue
+            self._sched_weights = table
+        return self._sched_weights.get(tenant, 1.0)
+
+    def _dequeue_locked(self):
+        """Scheduler pick from the pending queue (caller holds _cond):
+        interactive class strictly before batch; within a class,
+        preemption-evicted re-admissions first (their fair share was
+        charged at first admission), then weighted-fair across tenants
+        — stride scheduling, each fresh dequeue advancing the tenant's
+        virtual time by 1/weight, lowest virtual time next, FIFO within
+        a tenant. One tenant alone degenerates to exact FIFO (the
+        historical order). O(queue) scan per admission — the queue is
+        bounded by ``queue_depth``."""
+        if not self._pending:
+            return None
+        best_i = best_key = None
+        for i, s in enumerate(self._pending):
+            cls = 0 if getattr(s, "priority", "interactive") != "batch" \
+                else 1
+            replay = 0 if getattr(s, "preemptions", 0) else 1
+            if replay:
+                t = getattr(s, "tenant", "") or ""
+                v = max(self._sched_vtime.get(t, 0.0), self._sched_vclock)
+            else:
+                v = -1.0
+            key = (cls, replay, v, i)
+            if best_key is None or key < best_key:
+                best_key, best_i = key, i
+        stream = self._pending[best_i]
+        del self._pending[best_i]
+        if best_key[1]:  # fresh admission: charge its tenant's stride
+            t = getattr(stream, "tenant", "") or ""
+            v = best_key[2]
+            self._sched_vclock = v
+            self._sched_vtime[t] = v + 1.0 / self._tenant_weight(t)
+            if len(self._sched_vtime) > 4096:
+                # tenant names are caller data: a pathological stream
+                # of one-shot tenants must not grow this forever —
+                # resetting loses only relative history
+                self._sched_vtime.clear()
+        return stream
+
+    def _preempt_for_pending(self):
+        """Tick boundary, no free slot: when ``FLAGS_sched_preempt`` is
+        on and an interactive request is pending, evict one BATCH
+        stream — a still-prefilling job first (nothing emitted, nothing
+        to replay), else the active slot with the least cached work.
+        The victim goes back to the FRONT of the pending queue; its
+        re-admission re-prefills prompt + emitted tokens, so the
+        continuation is token-exact (the stream object, its RNG state
+        and emitted list survive eviction untouched). Returns True when
+        a slot was freed."""
+        if not bool(_flags.get_flag("sched_preempt", True)):
+            return False
+        with self._cond:
+            wanting = any(
+                not s._cancelled
+                and getattr(s, "priority", "interactive") != "batch"
+                for s in self._pending
+            )
+        if not wanting:
+            return False
+        victim_idx = victim = None
+        from_active = False
+        for idx, job in self._prefilling.items():
+            if getattr(job.stream, "priority", "interactive") == "batch":
+                victim_idx, victim = idx, job.stream
+                break
+        if victim_idx is None:
+            best = None
+            for idx, slot in self._active.items():
+                if getattr(slot.stream, "priority",
+                           "interactive") != "batch":
+                    continue
+                cost = len(slot.stream.full_prompt()) \
+                    + len(slot.stream._tokens)
+                if best is None or cost < best[0]:
+                    best = (cost, idx, slot.stream)
+            if best is not None:
+                _cost, victim_idx, victim = best
+                from_active = True
+        if victim_idx is None:
+            return False
+        if from_active:
+            self._active.pop(victim_idx, None)
+            # an evicted ACTIVE stream was admitted, so its slot exit is
+            # a retirement — the admissions == retirements + occupancy
+            # invariant survives; its re-admission counts again
+            _profiler.bump_counter("serving_slot_retirements")
+            self._counts["retirements"] += 1
+        else:
+            self._prefilling.pop(victim_idx, None)
+        self._free.append(victim_idx)
+        self._release_slot_blocks(victim_idx)
+        victim.preemptions += 1
+        replayed = len(victim._tokens)
+        _profiler.bump_counter("decode_preemptions")
+        _profiler.bump_counter("decode_preempt_replayed_tokens", replayed)
+        self._counts["preemptions"] += 1
+        self._counts["preempt_replayed_tokens"] += replayed
+        with self._cond:
+            # FRONT of the queue, bypassing the depth bound: this is an
+            # internal re-queue of an already-admitted request, not new
+            # load — shedding it here would break the durability
+            # contract
+            self._pending.appendleft(victim)
+        return True
+
+    def _admission_prompt(self, stream):
+        """Every token whose K/V must be in the slot's cache before the
+        next pick: prompt + resume suffix + whatever this stream already
+        emitted HERE. The last part is non-empty only for a
+        preemption-evicted stream re-admitting — re-prefilling its own
+        emissions is what makes eviction token-exact (same logits, and
+        the stream's live RNG is already past all its picks)."""
+        return stream.full_prompt() + [
+            int(t) for t in getattr(stream, "_tokens", ()) or ()
+        ]
+
     def _admit(self):
         """Admit queued requests into free slots — mid-flight, between
-        decode steps, never evicting an active stream. Each admission
-        first copies the longest cached prefix into the slot row
-        (O(copied bytes) block copies, no recompute), then prefills the
-        suffix: single-window prompts inline (the PR 8 behavior), longer
-        ones as a chunked ``_PrefillJob`` advanced one window per tick."""
+        decode steps, never evicting an active stream (except the
+        explicit preemption path: with ``FLAGS_sched_preempt`` and no
+        free slot, a pending interactive request evicts one batch
+        stream). Dequeue order is the scheduler's (interactive class
+        first, weighted-fair across tenants within a class), not raw
+        FIFO. Each admission first copies the longest cached prefix
+        into the slot row (O(copied bytes) block copies, no recompute),
+        then prefills the suffix: single-window prompts inline (the
+        PR 8 behavior), longer ones as a chunked ``_PrefillJob``
+        advanced one window per tick."""
+        if not self._free:
+            self._preempt_for_pending()
         while self._free:
             with self._cond:
-                if not self._pending:
-                    return
-                stream = self._pending.popleft()
+                stream = self._dequeue_locked()
+            if stream is None:
+                return
             if stream._cancelled:
                 # cancelled while queued: never admitted, so no slot,
                 # no retirement tally — just finish the dead handle
@@ -2144,7 +2320,7 @@ class DecodeEngine(object):
             # same admission machinery (prefix copies, window planning)
             # serves both, which is exactly what makes a resumed
             # re-prefill cost ~one suffix window instead of a stall
-            prompt = stream.full_prompt()
+            prompt = self._admission_prompt(stream)
             entries, hit_tokens = [], 0
             if self.prefix is not None:
                 entries, hit_tokens = self.prefix.lookup(prompt)
@@ -2217,7 +2393,7 @@ class DecodeEngine(object):
         Pool exhaustion (after refcount-eviction of store-only blocks)
         sheds the request with the overload contract instead of
         corrupting a neighbor."""
-        prompt = stream.full_prompt()
+        prompt = self._admission_prompt(stream)
         entries, hit_tokens = [], 0
         if self.pindex is not None:
             # lookup increfs each matched block — those references ARE
@@ -2641,7 +2817,7 @@ class DecodeEngine(object):
         finish admission: publish the prompt's blocks to the prefix
         store, emit the first token, and join the decode batch."""
         stream = job.stream
-        prompt = stream.full_prompt()
+        prompt = self._admission_prompt(stream)
         s, e = job.windows[job.wi]
         try:
             with _xla_stats.serving_request_window():
@@ -2696,10 +2872,12 @@ class DecodeEngine(object):
                 self.pindex.publish(prompt, self._slot_blocks[slot_idx])
         elif self.prefix is not None:
             self._publish_blocks(slot_idx, prompt)
-        # a resume admission's budget accounting continues the ORIGINAL
-        # request: the replayed suffix counts as already generated
+        # a resume (or preemption re-) admission's budget accounting
+        # continues the ORIGINAL request: every replayed token counts
+        # as already generated — len(prompt) - len(prompt_ids) is the
+        # resume suffix plus this stream's own pre-eviction emissions
         slot = _Slot(stream, tok, next_pos=len(prompt),
-                     generated=1 + len(stream.resume_tokens))
+                     generated=1 + len(prompt) - len(stream.prompt_ids))
         with self._cond:
             # stop() drains under this lock and flips started inside
             # it: if the drain happened while the prefill above was
@@ -2723,9 +2901,13 @@ class DecodeEngine(object):
                                    len(stream.resume_tokens))
             self._counts["resume_admissions"] += 1
             self._counts["resume_tokens"] += len(stream.resume_tokens)
-        stream.first_tick = self.tick
-        stream.ttft_ms = (time.monotonic() - stream._t_submit) * 1e3
-        _profiler.bump_histogram("decode_ttft_ms", stream.ttft_ms)
+        if stream.ttft_ms is None:
+            stream.first_tick = self.tick
+            stream.ttft_ms = (time.monotonic() - stream._t_submit) * 1e3
+            _profiler.bump_histogram("decode_ttft_ms", stream.ttft_ms)
+        # else: a preemption re-admission — the stream's REAL first
+        # token was already stamped; re-stamping would inflate the
+        # fleet TTFT SLI with scheduler wait
         self._emit(slot_idx, slot, tok)
 
     def _publish_blocks(self, slot_idx, prompt):

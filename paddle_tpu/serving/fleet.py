@@ -61,6 +61,8 @@ from ..observability import registry as _obs_registry
 __all__ = [
     "FLEET_LOG",
     "AutoscalerPolicy",
+    "SLOPolicy",
+    "make_policy",
     "FleetController",
     "load_events",
 ]
@@ -155,6 +157,111 @@ class AutoscalerPolicy(object):
             self._low_streak = 0
             return target - 1, "idle"
         return target, None
+
+
+class SLOPolicy(object):
+    """SLO-driven scaling: pressure is a LATENCY budget breach, not a
+    queue length. ``observe(samples, target)`` has the exact
+    AutoscalerPolicy contract (same streak/hysteresis shape, same
+    clamping, same empty-round reset) but reads the decode engine's
+    latency histograms — ``ttft_p95_ms`` (p95 of ``decode_ttft_ms``)
+    and ``intertoken_p95_ms`` (p95 of ``decode_intertoken_ms``) — which
+    ``_scrape_samples`` now carries alongside the queue fields:
+
+    - a round is *pressured* when ANY shed happened, the fleet-mean
+      TTFT p95 is at/over ``FLAGS_fleet_slo_ttft_ms``, or (budget
+      armed, > 0) the inter-token p95 is at/over
+      ``FLAGS_fleet_slo_intertoken_ms``;
+    - a round is *idle* when shed-free AND every armed budget sits
+      under ``FLAGS_fleet_slo_headroom`` of itself (scale-down needs
+      real headroom, not a hair under the line); replicas with no
+      latency samples yet (no traffic) count as idle.
+
+    The simulator won this policy its promotion: against recorded
+    journeys it holds interactive TTFT through load the queue-depth
+    policy reacts to one streak late."""
+
+    def __init__(self, min_replicas=None, max_replicas=None,
+                 ttft_budget_ms=None, intertoken_budget_ms=None,
+                 headroom=None, up_ticks=None, down_ticks=None):
+        self.min_replicas = max(1, int(_flag("fleet_min_replicas",
+                                             min_replicas)))
+        self.max_replicas = max(self.min_replicas,
+                                int(_flag("fleet_max_replicas",
+                                          max_replicas)))
+        self.ttft_budget_ms = float(_flag("fleet_slo_ttft_ms",
+                                          ttft_budget_ms))
+        self.intertoken_budget_ms = float(_flag("fleet_slo_intertoken_ms",
+                                                intertoken_budget_ms))
+        self.headroom = min(1.0, max(0.0, float(_flag("fleet_slo_headroom",
+                                                      headroom))))
+        self.up_ticks = max(1, int(_flag("fleet_scale_up_ticks", up_ticks)))
+        self.down_ticks = max(1, int(_flag("fleet_scale_down_ticks",
+                                           down_ticks)))
+        self._high_streak = 0
+        self._low_streak = 0
+
+    def _clamp(self, n):
+        return max(self.min_replicas, min(self.max_replicas, int(n)))
+
+    @staticmethod
+    def _mean(samples, key):
+        vals = [float(s[key]) for s in samples if s.get(key) is not None]
+        return (sum(vals) / len(vals)) if vals else None
+
+    def observe(self, samples, target):
+        target = self._clamp(target)
+        if not samples:
+            self._high_streak = self._low_streak = 0
+            return target, None
+        sheds = sum(float(s.get("shed_delta") or 0.0) for s in samples)
+        ttft = self._mean(samples, "ttft_p95_ms")
+        itl = self._mean(samples, "intertoken_p95_ms")
+        breached = sheds > 0
+        under_headroom = sheds == 0
+        if self.ttft_budget_ms > 0 and ttft is not None:
+            breached = breached or ttft >= self.ttft_budget_ms
+            under_headroom = (under_headroom
+                              and ttft <= self.headroom * self.ttft_budget_ms)
+        if self.intertoken_budget_ms > 0 and itl is not None:
+            breached = breached or itl >= self.intertoken_budget_ms
+            under_headroom = (
+                under_headroom
+                and itl <= self.headroom * self.intertoken_budget_ms
+            )
+        if breached:
+            _profiler.bump_counter("fleet_slo_breach_ticks")
+            self._high_streak += 1
+            self._low_streak = 0
+        elif under_headroom:
+            self._low_streak += 1
+            self._high_streak = 0
+        # between headroom and budget: hold both streaks (hysteresis
+        # band, same as AutoscalerPolicy's queue band)
+        if self._high_streak >= self.up_ticks and target < self.max_replicas:
+            self._high_streak = self._low_streak = 0
+            return target + 1, "slo_pressure"
+        if self._low_streak >= self.down_ticks and target > self.min_replicas:
+            self._low_streak = 0
+            return target - 1, "slo_headroom"
+        return target, None
+
+
+def make_policy(name=None, min_replicas=None, max_replicas=None):
+    """The ``FLAGS_fleet_policy`` selector ("streak" | "slo") — one
+    constructor shared by the live controller and the fleet simulator,
+    so a policy promoted in the sim is the byte-identical object the
+    fleet runs."""
+    name = str(name if name is not None
+               else _flags.get_flag("fleet_policy", "streak")).lower()
+    if name == "slo":
+        return SLOPolicy(min_replicas=min_replicas,
+                         max_replicas=max_replicas)
+    if name in ("streak", ""):
+        return AutoscalerPolicy(min_replicas=min_replicas,
+                                max_replicas=max_replicas)
+    raise ValueError("unknown fleet policy %r (want 'streak' or 'slo')"
+                     % name)
 
 
 # ---------------------------------------------------------------------------
@@ -284,7 +391,9 @@ class FleetController(object):
         self._peers_file = os.path.join(self.workdir, "kv_peers.json")
         self.model_dir, declared = _resolve_model(model_dir)
         self.version = declared if declared is not None else 1
-        self.policy = policy or AutoscalerPolicy(
+        # policy: explicit object > FLAGS_fleet_policy selection
+        # ("streak" = queue-depth AutoscalerPolicy, "slo" = SLOPolicy)
+        self.policy = policy or make_policy(
             min_replicas=min_replicas, max_replicas=max_replicas
         )
         self.autoscale = bool(autoscale)
@@ -1038,6 +1147,13 @@ class FleetController(object):
                     "queue_depth": queue,
                     "shed_delta": shed_delta,
                     "p95_ms": p95,
+                    # decode-engine latency SLIs (None until the replica
+                    # has served traffic) — what SLOPolicy budgets
+                    # against; AutoscalerPolicy ignores the extra keys
+                    "ttft_p95_ms": parsed.get(
+                        ("decode_ttft_ms", 'quantile="0.95"')),
+                    "intertoken_p95_ms": parsed.get(
+                        ("decode_intertoken_ms", 'quantile="0.95"')),
                 })
 
         scrapers = []

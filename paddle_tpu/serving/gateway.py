@@ -63,6 +63,7 @@ and only then closes the listener.
 from __future__ import annotations
 
 import http.client
+import inspect
 import itertools
 import json
 import os
@@ -131,6 +132,32 @@ def encode_tensor(arr):
             "dtype": str(arr.dtype)}
 
 
+_SCHED_KW_CACHE = {}
+
+
+def _accepts_sched_kwargs(fn):
+    """True when ``fn`` (a server's generate) can take the scheduling
+    identity kwargs (priority/tenant) — explicitly or via **kwargs.
+    Cached by the bound method's underlying function."""
+    key = getattr(fn, "__func__", fn)
+    hit = _SCHED_KW_CACHE.get(key)
+    if hit is None:
+        try:
+            sig = inspect.signature(fn)
+            params = sig.parameters.values()
+            hit = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                      for p in params) or (
+                "priority" in sig.parameters
+                and "tenant" in sig.parameters)
+        except (TypeError, ValueError):
+            hit = False
+        _SCHED_KW_CACHE[key] = hit
+        if len(_SCHED_KW_CACHE) > 256:  # bespoke-fake churn bound
+            _SCHED_KW_CACHE.clear()
+            _SCHED_KW_CACHE[key] = hit
+    return hit
+
+
 # -- admission control -------------------------------------------------------
 
 
@@ -157,19 +184,21 @@ class _AdmissionDenied(ServingError):
 class _TokenBucket(object):
     """Classic token bucket: ``rate`` tokens/sec refill into ``burst``
     capacity; one token per request. Not thread-safe on its own — the
-    controller's lock serializes access."""
+    controller's lock serializes access. ``clock`` is injectable (the
+    fleet simulator feeds its virtual clock; default wall monotonic)."""
 
-    __slots__ = ("rate", "burst", "tokens", "t")
+    __slots__ = ("rate", "burst", "tokens", "t", "_clock")
 
-    def __init__(self, rate, burst):
+    def __init__(self, rate, burst, clock=None):
         self.rate = float(rate)
         self.burst = float(max(1, burst))
         self.tokens = self.burst
-        self.t = time.monotonic()
+        self._clock = clock or time.monotonic
+        self.t = self._clock()
 
     def try_take(self):
         """None on success, else seconds until a token is available."""
-        now = time.monotonic()
+        now = self._clock()
         self.tokens = min(self.burst,
                           self.tokens + (now - self.t) * self.rate)
         self.t = now
@@ -189,19 +218,27 @@ class _Admission(object):
     priority-ordered waiting. ``admit()`` either returns (after
     reserving an inflight slot) or raises ``_AdmissionDenied``;
     ``release()`` frees the slot and wakes waiters — interactive
-    waiters are granted freed capacity before batch waiters."""
+    waiters are granted freed capacity before batch waiters.
+
+    The decision chain lives in small ``*_locked`` primitives so two
+    callers share ONE policy: the gateway's blocking ``admit()`` and
+    the fleet simulator's event-driven ``try_admit``/``try_grant``
+    (which parks virtually instead of on the condition). ``clock`` is
+    injectable for the same reason — the simulator feeds its virtual
+    clock and the rate buckets/deadlines follow it."""
 
     def __init__(self, rate_rps, burst, tenant_max_inflight, max_inflight,
-                 admit_timeout_ms):
+                 admit_timeout_ms, clock=None):
         self.rate_rps = float(rate_rps)
         self.burst = int(burst)
         self.tenant_max = int(tenant_max_inflight)
         self.global_max = int(max_inflight)
         self.admit_timeout_s = float(admit_timeout_ms) / 1e3
+        self._clock = clock or time.monotonic
         self._buckets = {}
         self._inflight = {}
         self._total = 0
-        self._interactive_waiting = 0
+        self._waiting = {"interactive": 0, "batch": 0}
         self._cond = threading.Condition()
 
     @property
@@ -209,62 +246,130 @@ class _Admission(object):
         with self._cond:
             return self._total
 
-    def admit(self, tenant, priority):
+    def waiting_by_class(self):
+        """{priority_class: parked-waiter count}: the QUEUED (not yet
+        admitted) pressure — what the ``gateway_admit_waiting`` gauges
+        export and the SLO policy / simulator read. Grant-time ordering
+        alone made this invisible: a batch flood parked on the cap
+        looked identical to an idle gateway."""
         with self._cond:
-            # 1) rate limit: cheapest check first, fail fast with the
-            #    bucket's own refill estimate as the retry hint. Buckets
-            #    key on the RAW tenant name but bounded (the header is
-            #    client data): past _MAX_TRACKED_TENANTS distinct
-            #    tenants the long tail shares one sentinel-keyed
-            #    overflow bucket — a sentinel, not a name, so no real
-            #    tenant (not even one literally called "overflow") can
-            #    collide into it, and sanitization collisions ("a-b" vs
-            #    "a.b") can't couple two tenants' rates
-            if self.rate_rps > 0:
-                key = tenant
-                if (key not in self._buckets
-                        and len(self._buckets) >= _MAX_TRACKED_TENANTS):
-                    key = _OVERFLOW_BUCKET
-                bucket = self._buckets.get(key)
-                if bucket is None:
-                    bucket = self._buckets[key] = _TokenBucket(
-                        self.rate_rps, self.burst
-                    )
-                wait_s = bucket.try_take()
-                if wait_s is not None:
-                    raise _AdmissionDenied(
-                        "ratelimit",
-                        "tenant %r over %.3g req/s rate limit" %
-                        (tenant, self.rate_rps),
-                        retry_after_ms=wait_s * 1e3,
-                    )
-            # 2) tenant quota: the isolation knob — one tenant's flood
-            #    caps at its own share, the others' headroom survives
-            if (self.tenant_max > 0
-                    and self._inflight.get(tenant, 0) >= self.tenant_max):
-                raise _AdmissionDenied(
-                    "quota",
-                    "tenant %r at max inflight %d" %
-                    (tenant, self.tenant_max),
-                    # a slot frees when one of the tenant's own requests
-                    # completes; no better estimate than "soon"
-                    retry_after_ms=50,
-                )
-            # 3) global cap: WAIT (bounded) for a slot, interactive
-            #    ahead of batch — a batch waiter only takes a freed slot
-            #    while no interactive request is waiting
-            t_wait = time.monotonic()
+            return dict(self._waiting)
+
+    def _check_rate_locked(self, tenant):
+        # rate limit: cheapest check first, fail fast with the
+        # bucket's own refill estimate as the retry hint. Buckets
+        # key on the RAW tenant name but bounded (the header is
+        # client data): past _MAX_TRACKED_TENANTS distinct
+        # tenants the long tail shares one sentinel-keyed
+        # overflow bucket — a sentinel, not a name, so no real
+        # tenant (not even one literally called "overflow") can
+        # collide into it, and sanitization collisions ("a-b" vs
+        # "a.b") can't couple two tenants' rates
+        if self.rate_rps <= 0:
+            return
+        key = tenant
+        if (key not in self._buckets
+                and len(self._buckets) >= _MAX_TRACKED_TENANTS):
+            key = _OVERFLOW_BUCKET
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            bucket = self._buckets[key] = _TokenBucket(
+                self.rate_rps, self.burst, clock=self._clock
+            )
+        wait_s = bucket.try_take()
+        if wait_s is not None:
+            raise _AdmissionDenied(
+                "ratelimit",
+                "tenant %r over %.3g req/s rate limit" %
+                (tenant, self.rate_rps),
+                retry_after_ms=wait_s * 1e3,
+            )
+
+    def _check_quota_locked(self, tenant):
+        # tenant quota: the isolation knob — one tenant's flood caps at
+        # its own share, the others' headroom survives
+        if (self.tenant_max > 0
+                and self._inflight.get(tenant, 0) >= self.tenant_max):
+            raise _AdmissionDenied(
+                "quota",
+                "tenant %r at max inflight %d" %
+                (tenant, self.tenant_max),
+                # a slot frees when one of the tenant's own requests
+                # completes; no better estimate than "soon"
+                retry_after_ms=50,
+            )
+
+    def _cap_blocked_locked(self, cls):
+        # global cap, interactive ahead of batch — a batch request only
+        # takes capacity while no interactive request is waiting
+        return self._total >= self.global_max or (
+            cls == "batch" and self._waiting["interactive"] > 0
+        )
+
+    def _grant_locked(self, tenant):
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+        self._total += 1
+
+    def _try_admit_locked(self, tenant, priority, first=True):
+        """One admission attempt (caller holds the lock): the full
+        rate→quota→cap chain on the ``first`` attempt; on a wake-up
+        retry (``first=False``) the cap plus the post-wait quota
+        re-check — several same-tenant requests can pass the pre-wait
+        check with 0 inflight, park on the cap, then all wake; without
+        the re-check they would all admit and exceed the tenant's
+        share. Returns None on grant (slot reserved) or "wait" when
+        the request must park; raises _AdmissionDenied otherwise."""
+        cls = "batch" if priority == "batch" else "interactive"
+        if first:
+            self._check_rate_locked(tenant)
+            self._check_quota_locked(tenant)
+        if self._cap_blocked_locked(cls):
+            return "wait"
+        if not first:
+            self._check_quota_locked(tenant)
+        self._grant_locked(tenant)
+        return None
+
+    # -- event-driven drivers (the fleet simulator) ---------------------
+    def try_admit(self, tenant, priority):
+        """Non-blocking first attempt: None on grant, "wait" when the
+        caller should park (track the park via note_wait_start/_end and
+        retry with try_grant on release/deadline events); raises like
+        ``admit()``."""
+        with self._cond:
+            return self._try_admit_locked(tenant, priority, first=True)
+
+    def try_grant(self, tenant, priority):
+        """Wake-up retry for a parked caller (post-wait semantics)."""
+        with self._cond:
+            return self._try_admit_locked(tenant, priority, first=False)
+
+    def note_wait_start(self, priority):
+        cls = "batch" if priority == "batch" else "interactive"
+        with self._cond:
+            self._waiting[cls] += 1
+
+    def note_wait_end(self, priority):
+        cls = "batch" if priority == "batch" else "interactive"
+        with self._cond:
+            self._waiting[cls] = max(0, self._waiting[cls] - 1)
+            if cls == "interactive" and self._waiting["interactive"] == 0:
+                # unblock batch waiters parked on the priority predicate
+                self._cond.notify_all()
+
+    def admit(self, tenant, priority):
+        cls = "batch" if priority == "batch" else "interactive"
+        with self._cond:
+            if self._try_admit_locked(tenant, priority, first=True) is None:
+                return
+            # blocked on the global cap (or the interactive-first
+            # predicate): WAIT, bounded by the admit timeout
+            t_wait = self._clock()
             deadline = t_wait + self.admit_timeout_s
-            waited = False
-            interactive = priority != "batch"
-            if interactive:
-                self._interactive_waiting += 1
+            self._waiting[cls] += 1
             try:
-                while self._total >= self.global_max or (
-                    not interactive and self._interactive_waiting > 0
-                ):
-                    waited = True
-                    remaining = deadline - time.monotonic()
+                while True:
+                    remaining = deadline - self._clock()
                     if remaining <= 0:
                         raise _AdmissionDenied(
                             "overload",
@@ -274,34 +379,20 @@ class _Admission(object):
                             retry_after_ms=self.admit_timeout_s * 1e3,
                         )
                     self._cond.wait(remaining)
+                    if not self._cap_blocked_locked(cls):
+                        break
             finally:
-                if interactive:
-                    self._interactive_waiting -= 1
-                    if self._interactive_waiting == 0:
-                        # unblock batch waiters parked on the
-                        # interactive-priority predicate
-                        self._cond.notify_all()
-            if waited:
-                _profiler.bump_histogram(
-                    "gateway_admit_wait_ms",
-                    (time.monotonic() - t_wait) * 1e3,
-                )
-                # re-check the quota AFTER the wait: several same-tenant
-                # requests can pass the pre-wait check with 0 inflight,
-                # park on the global cap, then all wake — without this
-                # (still under the lock, so increments serialize) they
-                # would all admit and exceed the tenant's share
-                if (self.tenant_max > 0
-                        and self._inflight.get(tenant, 0)
-                        >= self.tenant_max):
-                    raise _AdmissionDenied(
-                        "quota",
-                        "tenant %r at max inflight %d" %
-                        (tenant, self.tenant_max),
-                        retry_after_ms=50,
-                    )
-            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
-            self._total += 1
+                self._waiting[cls] -= 1
+                if cls == "interactive" and self._waiting["interactive"] == 0:
+                    # unblock batch waiters parked on the
+                    # interactive-priority predicate
+                    self._cond.notify_all()
+            _profiler.bump_histogram(
+                "gateway_admit_wait_ms",
+                (self._clock() - t_wait) * 1e3,
+            )
+            self._check_quota_locked(tenant)  # post-wait re-check
+            self._grant_locked(tenant)
 
     def release(self, tenant):
         with self._cond:
@@ -427,6 +518,7 @@ class Gateway(object):
         self._inflight = 0
         self._inflight_gauge = None
         self._draining_gauge = None
+        self._waiting_gauges = {}
         self._prev_sigterm = None
         self._sig_installed = False
         self._drain_watch = None
@@ -459,6 +551,16 @@ class Gateway(object):
         self._draining_gauge = lambda g=self: 1.0 if g._draining else 0.0
         _obs_registry.register_gauge("gateway_draining",
                                      self._draining_gauge)
+        # queued (not yet admitted) pressure per priority class — the
+        # signal the SLO policy and the fleet simulator read; renders
+        # as labeled series gateway_admit_waiting{class="..."}
+        self._waiting_gauges = {}
+        for _cls in ("interactive", "batch"):
+            fn = (lambda g=self, c=_cls:
+                  g.admission.waiting_by_class().get(c, 0))
+            gname = 'gateway_admit_waiting{class="%s"}' % _cls
+            self._waiting_gauges[gname] = fn
+            _obs_registry.register_gauge(gname, fn)
         # watch the shared preemption latch: a SIGTERM seen by ANY
         # installed handler (ours via install_sigterm, or a trainer's
         # PreemptionHandler in the same process) drains this gateway
@@ -602,6 +704,9 @@ class Gateway(object):
             _obs_registry.unregister_gauge("gateway_draining",
                                            self._draining_gauge)
             self._draining_gauge = None
+        for gname, fn in self._waiting_gauges.items():
+            _obs_registry.unregister_gauge(gname, fn)
+        self._waiting_gauges = {}
         self._restore_sigterm()
         self._started = False
         self._stopped.set()  # unblock concurrent stop() callers
@@ -807,6 +912,10 @@ def _make_handler(gw):
             The id goes back out on ``X-Trace-Id``, the SSE terminal
             events, the access-log line, and the flight record."""
             tenant, priority, rid = self._request_meta()
+            # stashed for handlers that thread scheduling identity into
+            # the engine (_generate) — fn() only receives (tenant, rid,
+            # body)
+            self._priority = priority
             tp = _trace.parse_traceparent(self.headers.get("traceparent"))
             trace_id, parent_span = tp if tp else (_trace.new_trace_id(),
                                                   None)
@@ -1040,6 +1149,12 @@ def _make_handler(gw):
             # the unpulled suffix; any failure degrades to plain local
             # prefill — the pull is never on the correctness path
             self._kv_pull_if_cold(prompt)
+            # scheduling identity for the engine's weighted-fair /
+            # preemption scheduler; guarded so bespoke server fakes
+            # with a positional-only generate() keep working
+            if _accepts_sched_kwargs(gw.server.generate):
+                kw["priority"] = getattr(self, "_priority", "interactive")
+                kw["tenant"] = tenant
             try:
                 stream = gw.server.generate(prompt, **kw)
             except ServerOverloadedError as e:
@@ -1253,6 +1368,12 @@ def _make_handler(gw):
                 facts["spec_drafted"] = drafted
                 facts["spec_accepted"] = accepted
                 facts["spec_acceptance"] = round(accepted / drafted, 4)
+            # scheduler journey fact: how many times this stream was
+            # preemption-evicted and token-exactly re-admitted — only
+            # when it happened, so untouched payloads stay identical
+            preempted = int(getattr(stream, "preemptions", 0) or 0)
+            if preempted:
+                facts["preemptions"] = preempted
             # engine-tick journey fact for the flight record: how many
             # fused decode ticks this generation spanned
             ft = getattr(stream, "first_tick", None)

@@ -332,7 +332,12 @@ class Router(object):
                  retries=None, backend_timeout_s=None,
                  generate_retries=None, breaker_failures=None,
                  breaker_cooldown_s=None, access_log=None,
-                 access_log_max_mb=None):
+                 access_log_max_mb=None, clock=None):
+        # the ROUTING-STATE clock (picks, breakers, advert staleness):
+        # injectable so the fleet simulator can drive _pick/_mark_failed
+        # on its virtual clock; the HTTP forwarding path stays on real
+        # wall time (it never runs under the simulator)
+        self._clock = clock or time.monotonic
         self.host = host
         self.port_requested = int(_flag("router_port", port))
         # the fleet's PUBLIC front door logs one JSONL line per request
@@ -501,7 +506,7 @@ class Router(object):
             return sum(b.inflight for b in self._backends.values())
 
     def breaker_open_count(self):
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             return sum(1 for b in self._backends.values()
                        if b.breaker_state(now) == "open")
@@ -531,7 +536,7 @@ class Router(object):
         scores fall back to plain least-inflight — and an advert older
         than the staleness bound scores 0, so a dead replica's last
         advertisement can't keep attracting its prefix traffic."""
-        now = time.monotonic()
+        now = self._clock()
         chain_cache = {}  # block size -> this prompt's chain keys
         with self._lock:
             ready = []
@@ -621,7 +626,7 @@ class Router(object):
         breaker opens for ``breaker_cooldown_s`` (excluded from picks
         even if /readyz flips healthy in between), then goes half-open
         for a single probe."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             b.ready = False
             b.probe_inflight = False
@@ -686,7 +691,7 @@ class Router(object):
                     b.advert_block = int(kv.get("block") or 0)
                 except (TypeError, ValueError):
                     b.advert_block = 0
-                b.advert_t = time.monotonic()
+                b.advert_t = self._clock()
                 role = kv.get("role")
                 if role in ("prefill", "decode", "mixed"):
                     b.role = role

@@ -250,7 +250,7 @@ class InferenceServer(object):
 
     def generate(self, prompt_ids, max_new_tokens=None, eos_id=None,
                  temperature=0.0, top_k=0, top_p=0.0, seed=None,
-                 resume_tokens=None):
+                 resume_tokens=None, priority=None, tenant=None):
         """Autoregressive completion through the attached DecodeEngine:
         returns a ``GenerationStream`` — iterate it for tokens as they
         are generated, or block on ``.tokens()`` / ``.result()``. The
@@ -271,7 +271,7 @@ class InferenceServer(object):
         return self._decode_engine.generate(
             prompt_ids, max_new_tokens=max_new_tokens, eos_id=eos_id,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
-            resume_tokens=resume_tokens,
+            resume_tokens=resume_tokens, priority=priority, tenant=tenant,
         )
 
     def _seq_align(self, inputs):

@@ -14,8 +14,9 @@ KV-cache decode runtime, -> +gateway_* with the HTTP gateway,
 -> +fleet_*/router_* with the serving fleet control plane,
 -> +chaos_* with the durable-generations failover PR,
 -> +guardian_* with the training-guardian PR,
--> +trace_* with the fleet-wide distributed-tracing PR, and
--> +kv_tier_* with the fleet KV tier PR.)
+-> +trace_* with the fleet-wide distributed-tracing PR,
+-> +kv_tier_* with the fleet KV tier PR, and
+-> +sim_*/slo_*/sched_* with the fleet-simulator / SLO-scheduling PR.)
 
 A second pass lints METRIC names: every counter / histogram /
 scrape-time gauge the registry can render (every literal name at a
@@ -40,7 +41,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the linted knob families (prefix with trailing underscore)
 PREFIXES = ("obs_", "dist_", "elastic_", "serving_", "decode_",
             "gateway_", "fleet_", "router_", "chaos_", "guardian_",
-            "trace_", "kv_tier_")
+            "trace_", "kv_tier_", "sim_", "slo_", "sched_")
 _NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
 
 # the spellings a knob is consumed under: the env-bridge name and the

@@ -590,7 +590,7 @@ def run_kv_tier_trial(tmp, model_dir, report, failures, fast):
         _flags.set_flags({"FLAGS_" + k: v for k, v in saved.items()})
 
 
-def run_probe(fast=True, verbose=False):
+def run_probe(fast=True, verbose=False, keep_workdir=False):
     import numpy as np
 
     from paddle_tpu import inference
@@ -646,6 +646,10 @@ def run_probe(fast=True, verbose=False):
         "FLAGS_gateway_rate_limit_rps": "60",
         "FLAGS_gateway_rate_burst": "12",
         "FLAGS_obs_snapshot_interval_s": "1.0",
+        # keep the WHOLE trial in the flight ring: the default 256 only
+        # retains the tail of the flood, and a truncated recording is a
+        # biased tape for the simulator to replay (--keep-workdir)
+        "FLAGS_trace_flight_records": "8192",
     }
     body = {"inputs": [encode_tensor(xd)], "deadline_ms": 10000}
 
@@ -994,9 +998,16 @@ def run_probe(fast=True, verbose=False):
     except (OSError, ValueError) as e:
         failures.append("fleet_report.json unreadable: %r" % e)
 
-    import shutil
+    if keep_workdir:
+        # leave the flight dumps + fleet_report.json on disk so
+        # ``tools/fleet_sim.py --obs-root <tmp>/fleet*/obs --compare``
+        # can calibrate the simulator against this live run
+        report["workdir"] = tmp
+        print("WORKDIR %s" % tmp, flush=True)
+    else:
+        import shutil
 
-    shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
     report["pass"] = not failures
     report["failures"] = failures
     if verbose:
@@ -1009,8 +1020,12 @@ def main(argv=None):
     ap.add_argument("--fast", action="store_true",
                     help="tier-1 budget subset")
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--keep-workdir", action="store_true",
+                    help="don't delete the temp workdir; prints its "
+                         "path so fleet_sim.py can replay the recording")
     args = ap.parse_args(argv)
-    report = run_probe(fast=args.fast, verbose=args.verbose)
+    report = run_probe(fast=args.fast, verbose=args.verbose,
+                       keep_workdir=args.keep_workdir)
     print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
     print("PROBE PASS" if report["pass"]
           else "PROBE FAIL: %s" % "; ".join(report["failures"]))
