@@ -37,11 +37,28 @@ __all__ = [
     "versions",
     "latest",
     "read_manifest",
+    "commit_json",
 ]
 
 LATEST = "LATEST"
 MANIFEST = "model_version.json"
 _VERSION_DIR = re.compile(r"^v_(\d+)$")
+
+
+def commit_json(path, obj, indent=None):
+    """Two-phase atomic JSON commit: stage to ``<path>.tmp.<pid>``,
+    then ``os.replace`` — a concurrent reader sees the old document or
+    the new one, never a torn line. This is the ONE write discipline
+    for every fleet shared file (``LATEST``, endpoint files,
+    ``kv_peers.json``, ``fleet_state.json``, the fleet report), so
+    reader-side torn-file handling has exactly one failure mode to
+    cover: a file that predates its writer's crash. Returns ``path``."""
+    path = str(path)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(obj, f, sort_keys=True, indent=indent)
+    os.replace(tmp, path)
+    return path
 
 
 def versions(repo):
@@ -125,8 +142,6 @@ def publish(export_dir, repo, version=None):
         raise
     # LATEST flips last, atomically: a concurrent reader sees either
     # the old pointer or the new one, never a torn line
-    tmp = os.path.join(repo, "%s.tmp.%d" % (LATEST, os.getpid()))
-    with open(tmp, "w") as f:
-        json.dump({"version": next_v, "dir": "v_%d" % next_v}, f)
-    os.replace(tmp, os.path.join(repo, LATEST))
+    commit_json(os.path.join(repo, LATEST),
+                {"version": next_v, "dir": "v_%d" % next_v})
     return next_v, final

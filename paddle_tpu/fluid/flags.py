@@ -197,6 +197,21 @@ _DEFAULTS = {
     "fleet_slo_ttft_ms": 2000.0,
     "fleet_slo_intertoken_ms": 0.0,
     "fleet_slo_headroom": 0.6,
+    # control-plane durability (crash-safe controller): every replica
+    # refreshes a lease stamp in its endpoint file every
+    # fleet_lease_interval_s; a lease older than fleet_lease_ttl_s
+    # means the replica is dead or wedged (a restarted controller will
+    # not adopt it, a running one kills it). The controller journals
+    # its own lease into workdir/fleet_state.json — a second
+    # controller starting on the same workdir refuses to double-
+    # supervise while that lease is younger than
+    # fleet_state_lease_ttl_s AND the journaled pid is alive
+    # (split-brain guard); a stale lease or a dead pid means the
+    # previous controller crashed, and the newcomer adopts the
+    # surviving replica pool instead of respawning it.
+    "fleet_lease_interval_s": 1.0,
+    "fleet_lease_ttl_s": 5.0,
+    "fleet_state_lease_ttl_s": 10.0,
     # decode-slot scheduler (paddle_tpu/serving/decode.py): pending
     # admissions dequeue weighted-fair across tenants (stride scheduling;
     # sched_tenant_weights is "tenantA:4,tenantB:1" — unlisted tenants
@@ -366,6 +381,13 @@ _DEFAULTS = {
     # deterministic rig behind the router failover trials
     "chaos_die_after_tokens": -1,
     "chaos_die_replica": -1,
+    # control-plane fault: the FLEET CONTROLLER process SIGKILLs itself
+    # chaos_kill_controller_after_s seconds after its control loop
+    # starts (its replicas keep serving headless) — the deterministic
+    # rig behind the controller-crash / replica-adoption probe trial.
+    # One-shot under chaos_marker_dir like every chaos fault, so the
+    # RESTARTED controller in the same trial does not re-fire it.
+    "chaos_kill_controller_after_s": -1.0,
     # observability (paddle_tpu/observability): one telemetry spine over
     # tracing + metrics. obs_trace gates the span tracer (on by default —
     # bounded ring buffer, ~µs per span, measured <2% of the step path by

@@ -255,11 +255,12 @@ def write_gang_report(workdir, obs_dir=None, path=None):
 
 
 def _write_json(report, path):
-    tmp = "%s.tmp.%d" % (path, os.getpid())
-    with open(tmp, "w") as f:
-        json.dump(report, f, sort_keys=True, indent=1)
-    os.replace(tmp, path)
-    return path
+    # the fleet's shared atomic-commit discipline (tmp.<pid> +
+    # os.replace) lives in checkpoint.modeldir; imported lazily so a
+    # report-only consumer doesn't pay for it at module import
+    from ..checkpoint import modeldir as _modeldir
+
+    return _modeldir.commit_json(path, report, indent=1)
 
 
 # ---------------------------------------------------------------------------
@@ -474,19 +475,21 @@ def fleet_report(workdir, obs_root=None):
     # the log filename is serving.fleet.FLEET_LOG; spelled literally so
     # a report-only consumer (post-mortem tooling) never pays the whole
     # serving-package import for one string constant
-    events = _last_fleet_run(
-        _sup.load_events(str(workdir), filename="fleet.log")
-    )
+    all_events = _sup.load_events(str(workdir), filename="fleet.log")
+    events = _last_fleet_run(all_events)
     obs_root = obs_root or os.path.join(str(workdir), "obs")
     snaps = read_replica_snapshots(obs_root)
     # scope the snapshots to THIS run, like the events: a reused
     # workdir keeps dead runs' replica_<id> dirs on disk, and replica
     # ids restart per run — without the filter a previous run's
     # replica would inflate per_replica and the fleet-wide
-    # steady_recompiles sum the probes gate on
+    # steady_recompiles sum the probes gate on. A replica ADOPTED by a
+    # restarted controller belongs to this run exactly like a spawned
+    # one (its ids don't restart across an adoption — the journal
+    # resumes the id sequence), so adoption events join the scope set.
     spawned = {
         e.get("replica") for e in events
-        if e.get("event") == "replica_spawn"
+        if e.get("event") in ("replica_spawn", "replica_adopt")
     }
     if spawned:
         snaps = {r: s for r, s in snaps.items() if r in spawned}
@@ -529,6 +532,31 @@ def fleet_report(workdir, obs_root=None):
         and e.get("ready_ms") is not None
     ]
     summaries = {str(r): _replica_summary(s) for r, s in snaps.items()}
+    # control-plane durability audit. Counts are scoped to the newest
+    # run like everything else EXCEPT controller_boots: a boot count of
+    # one per run is a tautology, so restarts are counted across the
+    # whole log — the one fact only the full history holds.
+    boots = sum(1 for e in all_events if e.get("event") == "fleet_boot")
+    recover = next(
+        (e for e in events if e.get("event") == "controller_recover"),
+        None,
+    )
+    adoption = {
+        "controller_boots": boots,
+        "controller_restarts": max(0, boots - 1),
+        "adopted": sum(1 for e in events
+                       if e.get("event") == "replica_adopt"),
+        "respawned": sum(1 for e in events
+                         if e.get("event") == "replica_spawn"
+                         and e.get("replacement")),
+        "lease_expiries": sum(
+            1 for e in events
+            if e.get("event") == "replica_lease_expired"
+        ),
+        # how long the pool served unsupervised before this run's
+        # controller recovered it (None: this run adopted nothing)
+        "headless_ms": recover.get("headless_ms") if recover else None,
+    }
     return {
         "schema_version": _registry.SCHEMA_VERSION,
         "ts": time.time(),
@@ -549,6 +577,7 @@ def fleet_report(workdir, obs_root=None):
                        if e.get("event") == "replica_crash"),
         "hangs": sum(1 for e in events
                      if e.get("event") == "replica_hang"),
+        "adoption": adoption,
         "replica_ready_ms": _registry.percentiles(ready_ms,
                                                   points=(50, 99)),
         "replicas_reporting": sorted(snaps),

@@ -39,6 +39,29 @@ Structured JSONL events land in ``workdir/fleet.log`` (the supervisor
 log dialect: ``schema_version``/``ts``/``ts_mono``), and
 ``observability.aggregate.write_fleet_report`` merges them with the
 per-replica snapshot files into ``workdir/fleet_report.json``.
+
+**Durability (ISSUE 19).** The controller itself is a process that can
+die. Its intent — replica target, serving version, roles, rollout
+phase, crash-budget ledger — is journaled to ``workdir/
+fleet_state.json`` on every mutation (atomic two-phase commit via
+``checkpoint.modeldir.commit_json``, the one write discipline for
+every fleet shared file), with a heartbeat-refreshed controller lease.
+A (re)started controller reads the journal, scans the replicas'
+endpoint files (each lease-stamped by the replica's own serve loop),
+probes ``/readyz`` as ground truth, and ADOPTS live warm replicas in
+place instead of respawning them; journaled replicas that died while
+the fleet was headless are replaced under the restored crash budget;
+a rollout interrupted pre-flip aborts cleanly (old version keeps
+serving) and one interrupted post-flip resumes the old pool's drain.
+Supervision is a declarative reconcile of observed state against the
+journaled intent, so a controller restart, an adoption, and an
+ordinary crash replacement are one code path. The router's breaker /
+affinity state is deliberately NOT journaled: breakers are a load
+signal the rebuilt router re-learns in a few probe rounds, not intent
+— journaling them would pin stale verdicts on a pool that kept moving
+while the controller was down. A second controller started on a
+workdir whose journal holds a live, fresh lease fails fast with
+``FleetLockError`` (the split-brain guard).
 """
 
 from __future__ import annotations
@@ -57,17 +80,27 @@ from ..distributed import supervisor as _supervisor
 from ..fluid import flags as _flags
 from ..fluid import profiler as _profiler
 from ..observability import registry as _obs_registry
+from ..testing import chaos as _chaos
 
 __all__ = [
     "FLEET_LOG",
+    "FLEET_STATE",
     "AutoscalerPolicy",
     "SLOPolicy",
     "make_policy",
     "FleetController",
+    "FleetLockError",
     "load_events",
+    "read_fleet_state",
 ]
 
 FLEET_LOG = "fleet.log"
+FLEET_STATE = "fleet_state.json"
+
+# workdirs with a started FleetController in THIS process — the
+# in-process arm of the split-brain guard (the journal lease can't
+# distinguish two controllers sharing one pid)
+_LIVE_CONTROLLERS = set()
 
 
 def _flag(name, override):
@@ -77,6 +110,97 @@ def _flag(name, override):
 def load_events(workdir):
     """Parse ``workdir/fleet.log`` back into a list of event dicts."""
     return _supervisor.load_events(workdir, filename=FLEET_LOG)
+
+
+def read_fleet_state(workdir):
+    """The durable controller journal (``workdir/fleet_state.json``),
+    or None when absent, torn, or not a JSON object — a bad journal is
+    stale-until-rewritten, never an error (the restarted controller
+    boots fresh and re-journals)."""
+    state = _read_json(os.path.join(str(workdir), FLEET_STATE))
+    return state if isinstance(state, dict) else None
+
+
+class FleetLockError(RuntimeError):
+    """A second controller refused to start on a workdir whose journal
+    holds a live, fresh controller lease (split-brain guard). Carries
+    the structured facts: ``pid`` (the holder) and ``lease_age_s``."""
+
+    def __init__(self, workdir, pid, lease_age_s):
+        self.workdir = str(workdir)
+        self.pid = pid
+        self.lease_age_s = float(lease_age_s)
+        super(FleetLockError, self).__init__(
+            "fleet workdir %r is held by a live controller (pid %s, "
+            "lease %.1fs old): refusing split-brain start"
+            % (self.workdir, pid, self.lease_age_s)
+        )
+
+
+def _pid_alive(pid):
+    """True when ``pid`` names a live, non-zombie process (EPERM counts
+    as alive — it exists, it just isn't ours)."""
+    return _AdoptedProc(pid).poll() is None
+
+
+class _AdoptedProc(object):
+    """A Popen-shaped handle over an ADOPTED replica — a live process
+    the restarted controller did not spawn and cannot ``wait()`` on.
+    ``poll()`` is signal-0 liveness plus a ``/proc/<pid>/stat`` zombie
+    check: a zombie of some OTHER parent still answers signal 0, and
+    without the 'Z' check a kill-then-wait on one would stall the
+    drain path for its full timeout. The exit code of a non-child is
+    unknowable, so a vanished process reports -1."""
+
+    def __init__(self, pid):
+        self.pid = int(pid)
+        self.returncode = None
+
+    def _alive(self):
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            pass  # EPERM etc.: the pid exists
+        try:
+            with open("/proc/%d/stat" % self.pid) as f:
+                # comm may contain spaces/parens: state is the field
+                # after the LAST ") "
+                tail = f.read().rsplit(") ", 1)
+            if len(tail) == 2 and tail[1][:1] == "Z":
+                return False
+        except OSError:
+            pass  # no procfs: signal-0 liveness is the best we have
+        return True
+
+    def poll(self):
+        if self.returncode is None and not self._alive():
+            self.returncode = -1
+        return self.returncode
+
+    def wait(self, timeout=None):
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(
+                    "adopted pid %d" % self.pid, timeout
+                )
+            time.sleep(0.05)
+        return self.returncode
+
+    def send_signal(self, sig):
+        try:
+            os.kill(self.pid, sig)
+        except OSError:
+            pass
+
+    def terminate(self):
+        self.send_signal(signal.SIGTERM)
+
+    def kill(self):
+        self.send_signal(signal.SIGKILL)
 
 
 # ---------------------------------------------------------------------------
@@ -271,11 +395,11 @@ class _Replica(object):
     __slots__ = (
         "id", "version", "model_dir", "proc", "endpoint_file", "hb_file",
         "obs_dir", "state", "endpoint", "spawn_t", "drain_t", "shed_seen",
-        "hb_seen", "role",
+        "hb_seen", "role", "adopted",
     )
 
     def __init__(self, rid, version, model_dir, proc, endpoint_file,
-                 hb_file, obs_dir, role="mixed"):
+                 hb_file, obs_dir, role="mixed", adopted=False):
         self.id = int(rid)
         self.version = int(version)
         self.model_dir = str(model_dir)
@@ -290,6 +414,7 @@ class _Replica(object):
         self.shed_seen = 0.0     # autoscaler shed-delta bookkeeping
         self.hb_seen = None      # (mtime, first-observed monotonic time)
         self.role = str(role)    # prefill|decode|mixed (KV-tier split)
+        self.adopted = bool(adopted)  # survivor of a crashed controller
 
     @property
     def pid(self):
@@ -306,6 +431,7 @@ class _Replica(object):
             "metrics_port": ep.get("metrics_port"),
             "model_dir": self.model_dir,
             "role": self.role,
+            "adopted": self.adopted,
         }
 
 
@@ -375,7 +501,8 @@ class FleetController(object):
                  ready_timeout_s=None, drain_grace_s=None,
                  restart_backoff_s=None, max_replica_restarts=None,
                  heartbeat_timeout_s=None, poll_s=0.1, seed=None,
-                 echo_events=False, roles=None):
+                 echo_events=False, roles=None, lease_interval_s=None,
+                 lease_ttl_s=None, state_lease_ttl_s=None):
         self.workdir = str(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         # role-split topology (KV tier): {"prefill": 1, "decode": 2}.
@@ -414,6 +541,16 @@ class FleetController(object):
         )
         self.max_replica_restarts = int(
             _flag("fleet_max_replica_restarts", max_replica_restarts)
+        )
+        # durability knobs: how often the replica serve loop and the
+        # controller tick re-stamp their leases, and how stale each
+        # lease may grow before it means "dead"
+        self.lease_interval_s = float(
+            _flag("fleet_lease_interval_s", lease_interval_s)
+        )
+        self.lease_ttl_s = float(_flag("fleet_lease_ttl_s", lease_ttl_s))
+        self.state_lease_ttl_s = float(
+            _flag("fleet_state_lease_ttl_s", state_lease_ttl_s)
         )
         # replica heartbeats ride the supervisor's worker-side protocol
         # (PADDLE_TPU_HEARTBEAT_FILE + WorkerHeartbeat): the staleness
@@ -459,44 +596,330 @@ class FleetController(object):
         self._last_tick_err = 0.0
         self._ready_gauge = None
         self._target_gauge = None
+        # durable control-plane state
+        self._state_file = os.path.join(self.workdir, FLEET_STATE)
+        self._boot_id = "%d.%d" % (os.getpid(), int(time.time() * 1e3))
+        self._boot_mono = time.monotonic()
+        self._rollout_meta = None   # journaled rollout phase (or None)
+        self._last_journal_t = 0.0
 
     # -- public ------------------------------------------------------------
     def start(self, wait_ready_s=None):
         if self._started:
             raise RuntimeError("fleet controller already started")
-        if self._owns_router:
-            self.router.start()
-        # pin routing to the serving version from the FIRST moment: a
-        # router left on "route all" (None) would serve live traffic
-        # from still-warming new-version replicas the instant
-        # _check_ready adds them during the first deploy() — before the
-        # atomic flip, violating the rollout contract
-        self.router.set_active_version(self.version)
-        self.log.event(
-            "fleet_boot", target=self.target,
-            min_replicas=self.policy.min_replicas,
-            max_replicas=self.policy.max_replicas,
-            version=self.version, model_dir=self.model_dir,
-            router_port=self.router.port,
-        )
-        self._stop_evt.clear()
-        with self._lock:
-            for _ in range(self.target):
-                self._spawn(self.version, self.model_dir)
-        self._started = True
-        self._ready_gauge = lambda c=self: c.ready_count()
-        _obs_registry.register_gauge("fleet_replicas_ready",
-                                     self._ready_gauge)
-        self._target_gauge = lambda c=self: c.target
-        _obs_registry.register_gauge("fleet_replicas_target",
-                                     self._target_gauge)
-        self._tick_thread = threading.Thread(
-            target=self._run, name="fleet_control", daemon=True
-        )
-        self._tick_thread.start()
+        wd_key = os.path.realpath(self.workdir)
+        state = read_fleet_state(self.workdir)
+        self._check_split_brain(wd_key, state)
+        recovered = self._restore_intent(state)
+        _LIVE_CONTROLLERS.add(wd_key)
+        try:
+            if self._owns_router:
+                self.router.start()
+            # pin routing to the serving version from the FIRST moment:
+            # a router left on "route all" (None) would serve live
+            # traffic from still-warming new-version replicas the
+            # instant _check_ready adds them during the first deploy()
+            # — before the atomic flip, violating the rollout contract
+            self.router.set_active_version(self.version)
+            self.log.event(
+                "fleet_boot", target=self.target,
+                min_replicas=self.policy.min_replicas,
+                max_replicas=self.policy.max_replicas,
+                version=self.version, model_dir=self.model_dir,
+                router_port=self.router.port,
+                recovered=bool(recovered),
+            )
+            self._stop_evt.clear()
+            self._boot_mono = time.monotonic()
+            if recovered:
+                self._recover(recovered)
+            # a fresh boot and a recovery converge on the SAME path an
+            # ordinary crash replacement takes: reconcile observed
+            # state against the journaled intent (fresh: zero adopted,
+            # deficit == target, ungated growth spawns)
+            self._reconcile(time.monotonic())
+            self._journal()
+            self._started = True
+            self._ready_gauge = lambda c=self: c.ready_count()
+            _obs_registry.register_gauge("fleet_replicas_ready",
+                                         self._ready_gauge)
+            self._target_gauge = lambda c=self: c.target
+            _obs_registry.register_gauge("fleet_replicas_target",
+                                         self._target_gauge)
+            self._tick_thread = threading.Thread(
+                target=self._run, name="fleet_control", daemon=True
+            )
+            self._tick_thread.start()
+        except BaseException:
+            _LIVE_CONTROLLERS.discard(wd_key)
+            raise
         if wait_ready_s:
             self.wait_ready(timeout=float(wait_ready_s))
         return self
+
+    # -- durable state / recovery -------------------------------------------
+    def _check_split_brain(self, wd_key, state):
+        """Refuse to start over a live controller: one already started
+        in this process on the same workdir, or a journal lease that is
+        fresh (< state_lease_ttl_s) AND whose holder pid is alive. A
+        fresh lease with a DEAD holder is the common crash-then-restart
+        window — proceed; a stale lease means the holder stopped
+        supervising — proceed regardless of its pid."""
+        if wd_key in _LIVE_CONTROLLERS:
+            raise FleetLockError(self.workdir, os.getpid(), 0.0)
+        ctl = (state or {}).get("controller")
+        if not isinstance(ctl, dict):
+            return
+        try:
+            pid = int(ctl.get("pid") or 0)
+            age = time.time() - float(ctl.get("lease_ts") or 0.0)
+        except (TypeError, ValueError):
+            return
+        if pid <= 0 or age >= self.state_lease_ttl_s:
+            return
+        if pid != os.getpid() and _pid_alive(pid):
+            raise FleetLockError(self.workdir, pid, age)
+
+    def _restore_intent(self, state):
+        """Adopt the journaled INTENT (target, version, model dir,
+        roles, rollout phase) and crash-budget ledger as this
+        controller's own. Tolerates partial/odd journals field by
+        field — adoption probes reality afterwards anyway. Returns the
+        state when there is one to recover from, else None."""
+        if not state:
+            return None
+        ctl = state.get("controller")
+        pool = state.get("replicas")
+        if not isinstance(ctl, dict) and not (
+            isinstance(pool, dict) and pool
+        ):
+            # a cleanly-released journal (stop() wrote the lease away
+            # and the pool drained empty): nothing to recover — this
+            # boot's OWN configuration is the intent
+            return None
+        intent = state.get("intent")
+        intent = intent if isinstance(intent, dict) else {}
+        ledger = state.get("ledger")
+        ledger = ledger if isinstance(ledger, dict) else {}
+        try:
+            self.target = self.policy._clamp(
+                int(intent.get("target", self.target))
+            )
+        except (TypeError, ValueError):
+            pass
+        try:
+            if intent.get("version") is not None:
+                self.version = int(intent["version"])
+        except (TypeError, ValueError):
+            pass
+        if intent.get("model_dir"):
+            self.model_dir = str(intent["model_dir"])
+        if isinstance(intent.get("roles"), dict):
+            try:
+                self.roles = {
+                    str(k): int(v) for k, v in intent["roles"].items()
+                    if k in ("prefill", "decode", "mixed") and int(v) > 0
+                }
+            except (TypeError, ValueError):
+                pass
+        ro = intent.get("rollout")
+        self._rollout_meta = ro if isinstance(ro, dict) else None
+        try:
+            self.crashes = int(ledger.get("crashes", 0))
+            self._pool_crashes = int(ledger.get("pool_crashes", 0))
+            self._gaveup = bool(ledger.get("gaveup", False))
+        except (TypeError, ValueError):
+            pass
+        return state
+
+    def _recover(self, state):
+        """The adoption scan: walk the journaled pool and the endpoint
+        dir, probe ``/readyz`` as ground truth, adopt live warm
+        replicas in place, book headless deaths as crash deficit under
+        the restored budget, and land an interrupted rollout (pre-flip
+        abort / post-flip drain resume)."""
+        prev = state.get("controller")
+        prev = prev if isinstance(prev, dict) else {}
+        journal = state.get("replicas")
+        journal = journal if isinstance(journal, dict) else {}
+        ro = self._rollout_meta
+        abort_version = None
+        resume_from = None
+        if ro and ro.get("phase") == "spawning":
+            # died before the traffic flip: the new version never
+            # served — kill its half-born replicas, v_old keeps serving
+            try:
+                abort_version = int(ro.get("version"))
+            except (TypeError, ValueError):
+                pass
+        elif ro and ro.get("phase") == "flipped":
+            # died after the flip: the new version IS the pool (intent
+            # version was journaled atomically with the flip); what
+            # remains of the old pool resumes its drain
+            try:
+                resume_from = int(ro.get("from_version"))
+            except (TypeError, ValueError):
+                pass
+        # every replica the journal believes in, plus any endpoint file
+        # on disk (a spawn journaled late still gets considered)
+        rids = set()
+        for key in journal:
+            try:
+                rids.add(int(key))
+            except (TypeError, ValueError):
+                pass
+        try:
+            import re as _re
+            for name in os.listdir(self._ep_dir):
+                m = _re.match(r"^replica_(\d+)\.json$", name)
+                if m:
+                    rids.add(int(m.group(1)))
+        except OSError:
+            pass
+        adopted, drained, killed, lost = [], [], [], []
+        with self._lock:
+            self._next_rid = max([self._next_rid] +
+                                 [i + 1 for i in rids])
+            for rid in sorted(rids):
+                meta = journal.get(str(rid))
+                meta = meta if isinstance(meta, dict) else {}
+                epf = os.path.join(self._ep_dir,
+                                   "replica_%d.json" % rid)
+                ep = _read_json(epf)
+                ep = ep if isinstance(ep, dict) else None
+                try:
+                    rver = int(meta.get("version",
+                                        (ep or {}).get("version")))
+                except (TypeError, ValueError):
+                    rver = self.version
+                pid = (ep or {}).get("pid") or meta.get("pid")
+                port = (ep or {}).get("gateway_port")
+                alive = bool(pid) and _pid_alive(pid)
+                if rver == abort_version:
+                    if alive:
+                        _AdoptedProc(pid).kill()
+                    killed.append(rid)
+                    continue
+                # /readyz is the adoption ground truth: a live pid
+                # whose gateway won't answer (draining, wedged, or
+                # torn endpoint) is not a survivor worth adopting
+                if not (alive and port and self._probe_readyz(port)):
+                    if str(rid) in journal:
+                        lost.append((rid, rver))
+                    continue
+                role = str(meta.get("role") or "mixed")
+                r = _Replica(
+                    rid, rver,
+                    meta.get("model_dir") or self.model_dir,
+                    _AdoptedProc(pid), epf,
+                    os.path.join(self._hb_dir, "replica_%d.json" % rid),
+                    os.path.join(self._obs_root, "replica_%d" % rid),
+                    role=role, adopted=True,
+                )
+                r.state = "ready"
+                r.endpoint = ep
+                self._replicas[rid] = r
+                if role != "prefill" and rver != resume_from:
+                    self.router.add_backend(
+                        r.id, self.host, port, version=rver,
+                        ready=True, adopted=True, journal_version=rver,
+                    )
+                _profiler.bump_counter("fleet_adoptions")
+                self.log.event(
+                    "replica_adopt", replica=rid, version=rver,
+                    pid=pid, role=role,
+                    ready_replicas=self._ready_locked(),
+                )
+                adopted.append(r)
+                if rver == resume_from:
+                    drained.append(r)
+            if any(r.role == "prefill" for r in adopted):
+                self._update_peers_locked()
+            # journaled-live replicas that did not survive the
+            # headless window: real crashes against the restored
+            # budget; only current-pool holes gate as replacements
+            for rid, rver in lost:
+                self.crashes += 1
+                _profiler.bump_counter("fleet_replica_crashes")
+                self.log.event("replica_lost", replica=rid,
+                               version=rver)
+                if rver == self.version:
+                    self._pool_crashes += 1
+                    self._crash_deficit += 1
+            for r in drained:
+                self._begin_drain(r, reason="rollout")
+        if abort_version is not None:
+            self.log.event(
+                "rollout_abort", version=abort_version, flipped=False,
+                error="controller died before the flip; "
+                      "aborted on recovery", killed=killed,
+            )
+        if resume_from is not None:
+            self.log.event(
+                "rollout_resume", version=self.version,
+                from_version=resume_from, draining=len(drained),
+            )
+        self._rollout_meta = None
+        headless_ms = None
+        try:
+            headless_ms = max(
+                0.0, (time.time() - float(prev["lease_ts"])) * 1e3
+            )
+            _profiler.bump_histogram("fleet_headless_ms", headless_ms)
+        except (KeyError, TypeError, ValueError):
+            pass
+        self.log.event(
+            "controller_recover", adopted=len(adopted),
+            lost=len(lost),
+            headless_ms=(round(headless_ms, 1)
+                         if headless_ms is not None else None),
+        )
+
+    def _state_locked(self, controller):
+        return {
+            "schema_version": 1,
+            "controller": controller,
+            "intent": {
+                "target": self.target,
+                "version": self.version,
+                "model_dir": self.model_dir,
+                "roles": self.roles,
+                "rollout": self._rollout_meta,
+            },
+            "ledger": {
+                "pool_crashes": self._pool_crashes,
+                "crashes": self.crashes,
+                "gaveup": self._gaveup,
+            },
+            "replicas": {
+                str(r.id): {"version": r.version,
+                            "model_dir": r.model_dir,
+                            "role": r.role, "pid": r.pid}
+                for r in self._replicas.values()
+                if r.state in ("starting", "ready")
+            },
+        }
+
+    def _journal(self, release=False):
+        """Atomically commit intent + ledger + pool to the journal,
+        re-stamping the controller lease (``release`` writes the lease
+        away — a clean stop leaves no holder). Best-effort: a full
+        disk must not take down supervision; the state catches up on
+        the next successful commit."""
+        from ..checkpoint import modeldir as _modeldir
+
+        with self._lock:
+            controller = None if release else {
+                "pid": os.getpid(),
+                "lease_ts": time.time(),
+                "boot_id": self._boot_id,
+            }
+            state = self._state_locked(controller)
+        try:
+            _modeldir.commit_json(self._state_file, state, indent=1)
+        except OSError:
+            pass
+        self._last_journal_t = time.monotonic()
 
     def ready_count(self, version=None):
         with self._lock:
@@ -550,6 +973,7 @@ class FleetController(object):
                 event, from_replicas=old, to_replicas=n, reason=reason,
                 ready_replicas=self._ready_locked(),
             )
+        self._journal()
         self._write_report()
         return n
 
@@ -583,6 +1007,16 @@ class FleetController(object):
             with self._lock:
                 for _ in range(count):
                     new_ids.append(self._spawn(new_version, new_dir).id)
+                self._rollout_meta = {
+                    "phase": "spawning", "version": new_version,
+                    "model_dir": new_dir, "from_version": old_version,
+                    "new_ids": list(new_ids),
+                }
+            # journal the in-flight rollout BEFORE any new replica can
+            # go ready: a controller that dies from here until the flip
+            # aborts the rollout on recovery (v_old never stopped
+            # serving)
+            self._journal()
             deadline = time.monotonic() + timeout
             while True:
                 with self._lock:
@@ -615,9 +1049,21 @@ class FleetController(object):
             with self._lock:
                 self.version = new_version
                 self.model_dir = new_dir
+                self._rollout_meta = {
+                    "phase": "flipped", "version": new_version,
+                    "model_dir": new_dir, "from_version": old_version,
+                    "new_ids": list(new_ids),
+                }
                 old = [r for r in self._replicas.values()
                        if r.version == old_version
                        and r.state in ("starting", "ready")]
+            # ONE commit records the flip: intent.version advances to
+            # the new version in the same atomic write that marks the
+            # phase "flipped" — a recovery sees either pre-flip (abort
+            # to old) or post-flip (resume old-pool drain), never a
+            # half-state
+            self._journal()
+            with self._lock:
                 for r in old:
                     self._begin_drain(r, reason="rollout")
             drained = self._await_exits([r.id for r in old],
@@ -666,6 +1112,8 @@ class FleetController(object):
         finally:
             with self._lock:
                 self._rollout = False
+                self._rollout_meta = None
+            self._journal()
 
     def stop(self):
         """Drain every replica gracefully, stop the control loop and
@@ -703,6 +1151,11 @@ class FleetController(object):
                                            self._target_gauge)
             self._target_gauge = None
         self.log.event("fleet_stop", crashes=self.crashes)
+        # clean release: journal with no controller lease (and an empty
+        # live pool) so the next start on this workdir boots fresh
+        # instead of recovering
+        self._journal(release=True)
+        _LIVE_CONTROLLERS.discard(os.path.realpath(self.workdir))
         self._write_report(force=True)
 
     def __enter__(self):
@@ -892,6 +1345,7 @@ class FleetController(object):
                 # retries — but a PERSISTENT fault must not leave the
                 # fleet silently unsupervised, so it surfaces in
                 # fleet.log (rate-limited, and itself guarded)
+                _profiler.bump_counter("fleet_reconcile_errors")
                 now = time.monotonic()
                 if now - self._last_tick_err > 5.0:
                     self._last_tick_err = now
@@ -903,6 +1357,7 @@ class FleetController(object):
 
     def _tick(self):
         now = time.monotonic()
+        _chaos.maybe_kill_controller(now - self._boot_mono)
         with self._lock:
             replicas = list(self._replicas.values())
         for r in replicas:
@@ -924,6 +1379,10 @@ class FleetController(object):
         if self.autoscale and not self._rollout and now >= self._next_scale_t:
             self._next_scale_t = now + self.scale_interval_s
             self._autoscale_tick()
+        # refresh the controller lease (and let the journal absorb any
+        # pool churn the transitions above didn't force out)
+        if now - self._last_journal_t >= self.lease_interval_s:
+            self._journal()
 
     def _on_exit(self, r, rc):
         with self._lock:
@@ -959,6 +1418,10 @@ class FleetController(object):
             ) * (0.5 + 0.5 * self._rng.random())
             self._backoff_until = max(self._backoff_until,
                                       time.monotonic() + delay)
+        # the pool and the crash ledger both changed: a controller that
+        # dies right after must not re-adopt a replica it reaped (or
+        # forget the budget this crash burned)
+        self._journal()
 
     def _update_peers_locked(self):
         """Atomically rewrite the KV peers file from the ready prefill
@@ -1039,10 +1502,40 @@ class FleetController(object):
 
         return probe_readyz(self.host, port, timeout=0.5)
 
+    def _lease_expired(self, r):
+        """Replica-lease watch: a serving replica re-stamps
+        ``lease_ts`` in its endpoint file every lease interval; a stamp
+        older than ``lease_ttl_s`` means the process is alive but its
+        serve loop stopped turning — kill it so reconcile replaces it.
+        Replicas that never stamped a lease (custom replica_cmd) are
+        exempt; a torn/unreadable endpoint file is stale-until-
+        rewritten, never an expiry verdict."""
+        if self.lease_ttl_s <= 0:
+            return False
+        ep = _read_json(r.endpoint_file)
+        if isinstance(ep, dict):
+            r.endpoint = ep
+        ep = r.endpoint
+        if not isinstance(ep, dict) or "lease_ts" not in ep:
+            return False
+        try:
+            age = time.time() - float(ep["lease_ts"])
+        except (TypeError, ValueError):
+            return False
+        if age <= self.lease_ttl_s:
+            return False
+        _profiler.bump_counter("fleet_lease_expiries")
+        self.log.event("replica_lease_expired", replica=r.id,
+                       age_s=round(age, 2))
+        self._kill(r)  # the exit reaper turns this into a crash
+        return True
+
     def _check_hang(self, r, now):
         """Supervisor-style staleness watch over the replica heartbeat
         file. A replica that never beats (a custom replica_cmd without
         the hook) is unobservable — exit/ready checks still cover it."""
+        if self._lease_expired(r):
+            return
         hb = _supervisor.read_heartbeat(r.hb_file)
         if hb is None:
             return
@@ -1090,6 +1583,9 @@ class FleetController(object):
                         "giveup", crashes=self._pool_crashes,
                         max_replica_restarts=self.max_replica_restarts,
                     )
+                    # journal the latched giveup: a restart must not
+                    # grant a crash-looping pool a fresh budget
+                    self._journal()
                     return
                 for _ in range(self._crash_deficit):
                     self._spawn(self.version, self.model_dir,

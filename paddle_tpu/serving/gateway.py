@@ -870,11 +870,16 @@ def _make_handler(gw):
                     # the KV-tier advertisement rides the readiness
                     # poll the router already makes: hot prefix-chain
                     # heads + block size + role, for affinity scoring
+                    # the lease stamp doubles as the router's liveness
+                    # signal for ADOPTED backends (pid + wall-clock ts,
+                    # same shape as the endpoint-file lease)
                     self._send_json(
                         200,
                         {"status": "ready",
                          "inflight": gw.admission.total_inflight,
-                         "kv": gw.kv_advert()},
+                         "kv": gw.kv_advert(),
+                         "lease": {"pid": os.getpid(),
+                                   "ts": time.time()}},
                     )
             else:
                 self._send_json(404, {"error": "not found"})

@@ -52,11 +52,11 @@ __all__ = ["build_gpt_decode_engine", "main"]
 
 
 def _write_endpoint(path, payload):
-    """Atomic tmp+replace: the controller must never read a torn file."""
-    tmp = "%s.tmp.%d" % (path, os.getpid())
-    with open(tmp, "w") as f:
-        json.dump(payload, f, sort_keys=True)
-    os.replace(tmp, path)
+    """Atomic tmp+replace (the shared ``modeldir.commit_json``
+    discipline): the controller must never read a torn file."""
+    from paddle_tpu.checkpoint import modeldir as _modeldir
+
+    _modeldir.commit_json(path, payload)
 
 
 def _load_warmup(model_dir, warmup_path):
@@ -159,7 +159,7 @@ def main(argv=None):
     # endpoint file so the controller can align this replica's trace
     # timeline even before (or without) pulling its /healthz
     anchor = _trace.clock_anchor()
-    _write_endpoint(args.endpoint_file, {
+    endpoint = {
         "pid": os.getpid(),
         "replica_id": str(args.replica_id),
         "version": int(args.version),
@@ -170,16 +170,32 @@ def main(argv=None):
         "warmed": warmup is not None,
         "ts": anchor["ts"],
         "ts_mono": anchor["ts_mono"],
-    })
+        "lease_ts": time.time(),
+    }
+    _write_endpoint(args.endpoint_file, endpoint)
 
+    from paddle_tpu.fluid import flags as _flags
+
+    lease_interval = float(_flags.get_flag("fleet_lease_interval_s"))
     hb = _supervisor.worker_heartbeat()
     step = 0
+    last_lease = time.time()
     try:
         # serve until the gateway's drain closes the listener (SIGTERM
         # -> /readyz 503 -> in-flight completes -> port is None)
         while gw.port is not None:
             if hb is not None:
                 hb.beat(step, status="serve")
+            # re-stamp the endpoint lease: proof this loop is turning,
+            # which outlives the controller (adoption trusts the stamp
+            # before any controller is back to probe us)
+            if lease_interval > 0 and \
+                    time.time() - last_lease >= lease_interval:
+                endpoint["lease_ts"] = last_lease = time.time()
+                try:
+                    _write_endpoint(args.endpoint_file, endpoint)
+                except OSError:
+                    pass
             step += 1
             time.sleep(0.2)
     finally:

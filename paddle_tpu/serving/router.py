@@ -131,15 +131,28 @@ class Backend(object):
     __slots__ = ("id", "host", "port", "version", "ready", "inflight",
                  "fail_streak", "breaker_until", "probe_inflight",
                  "probe_t", "prefix_heads", "advert_block", "advert_t",
-                 "affinity_score", "role")
+                 "affinity_score", "role", "adopted", "lease_t",
+                 "lease_pid", "journal_version")
 
-    def __init__(self, backend_id, host, port, version=0, ready=False):
+    def __init__(self, backend_id, host, port, version=0, ready=False,
+                 adopted=False, journal_version=None):
         self.id = str(backend_id)
         self.host = str(host)
         self.port = int(port)
         self.version = int(version)
         self.ready = bool(ready)
         self.inflight = 0
+        # durability provenance (stamped by the fleet controller on
+        # adoption, refreshed by the health loop from the /readyz
+        # lease): adopted = this backend predates the current
+        # controller boot; journal_version = what the controller's
+        # journal believed its version was; lease_t/lease_pid = the
+        # last gateway lease seen (monotonic stamp + serving pid)
+        self.adopted = bool(adopted)
+        self.journal_version = (None if journal_version is None
+                                else int(journal_version))
+        self.lease_t = 0.0
+        self.lease_pid = None
         # KV-tier advertisement (stamped by the health loop from the
         # /readyz body): the replica's hot prefix-chain head keys, its
         # paged block size, and when the advert was taken — _pick's
@@ -193,6 +206,14 @@ class Backend(object):
             if self.advert_t else None
         )
         out["affinity_score"] = self.affinity_score
+        # durability provenance: adopted-vs-spawned, the journaled
+        # version the adoption trusted, and the gateway lease age
+        out["adopted"] = self.adopted
+        out["journal_version"] = self.journal_version
+        out["lease_age_s"] = (
+            round(time.monotonic() - self.lease_t, 3)
+            if self.lease_t else None
+        )
         return out
 
 
@@ -466,11 +487,16 @@ class Router(object):
         return "http://%s:%d%s" % (self.host, self.port, path)
 
     # -- backend registry ----------------------------------------------------
-    def add_backend(self, backend_id, host, port, version=0, ready=False):
+    def add_backend(self, backend_id, host, port, version=0, ready=False,
+                    adopted=False, journal_version=None):
         """Register (or replace) one replica gateway. ``ready=True``
         skips the first health-probe gap — the fleet controller adds a
-        backend only after polling its ``/readyz`` itself."""
-        b = Backend(backend_id, host, port, version=version, ready=ready)
+        backend only after polling its ``/readyz`` itself. ``adopted``
+        marks a backend inherited from a pre-restart pool (with the
+        version the controller journal recorded for it) — provenance
+        surfaced on ``/backends``, not a routing input."""
+        b = Backend(backend_id, host, port, version=version, ready=ready,
+                    adopted=adopted, journal_version=journal_version)
         with self._lock:
             self._backends[b.id] = b
         return b
@@ -695,6 +721,14 @@ class Router(object):
                 role = kv.get("role")
                 if role in ("prefill", "decode", "mixed"):
                     b.role = role
+            lease = body.get("lease") if isinstance(body, dict) else None
+            if isinstance(lease, dict):
+                # gateway lease rides the same poll: age surfaced on
+                # /backends, pid pins WHICH process answered (an
+                # adopted backend's port could be re-bound by a
+                # stranger after its real replica died)
+                b.lease_t = self._clock()
+                b.lease_pid = lease.get("pid")
 
     def _probe_ready(self, b):
         return probe_readyz_body(b.host, b.port,

@@ -64,6 +64,15 @@ Injection points and their hosts:
   ``PADDLE_TPU_REPLICA_ID`` (injected by the fleet controller) matches
   (-1 = any process with the fault armed), the serving-side analogue of
   ``lose_rank``'s slot addressing.
+- ``kill_controller_after_s`` — the CONTROL-PLANE fault:
+  ``serving/fleet.py``'s supervision tick calls
+  ``maybe_kill_controller(elapsed_s)`` with the seconds since the
+  control loop started, and the controller process SIGKILLs itself the
+  first tick past the armed bound — its replicas keep serving
+  headless, which is exactly the window the adoption/reconcile probe
+  trial measures. One-shot under ``marker_dir`` like every fault, so
+  the restarted controller of the same trial (same environment) does
+  not re-fire it.
 """
 
 from __future__ import annotations
@@ -80,6 +89,7 @@ __all__ = [
     "active_plan",
     "on_step",
     "on_stream_token",
+    "maybe_kill_controller",
     "maybe_slow_feed",
     "corrupt_ckpt_bytes",
     "maybe_rpc_error",
@@ -114,7 +124,8 @@ class FaultPlan(object):
                  lose_rank_at_step=None, lose_rank_for=-1,
                  die_after_tokens=None, die_replica=None,
                  nan_grad_at_step=None, loss_spike_at_step=None,
-                 bitflip_grad_at_step=None):
+                 bitflip_grad_at_step=None,
+                 kill_controller_after_s=None):
         self.crash_at_step = crash_at_step
         self.hang_at_step = hang_at_step
         # data-plane faults (the training guardian's closed loop):
@@ -142,6 +153,10 @@ class FaultPlan(object):
         # analogue of lose_rank's slot addressing; None/-1 = any)
         self.die_after_tokens = die_after_tokens
         self.die_replica = die_replica
+        # control-plane fault: the fleet controller SIGKILLs itself N
+        # seconds into its supervision loop (replicas keep serving
+        # headless) — the adoption/reconcile trial's deterministic kill
+        self.kill_controller_after_s = kill_controller_after_s
 
     @classmethod
     def from_flags(cls):
@@ -165,10 +180,13 @@ class FaultPlan(object):
         nan_at = int(_flags.get_flag("chaos_nan_grad_at_step", -1))
         spike_at = int(_flags.get_flag("chaos_loss_spike_at_step", -1))
         bitflip_at = int(_flags.get_flag("chaos_bitflip_grad_at_step", -1))
+        kill_ctl = float(
+            _flags.get_flag("chaos_kill_controller_after_s", -1.0)
+        )
         if (crash < 0 and hang < 0 and not corrupt and slow <= 0
                 and rpc_n <= 0 and (lose < 0 or lose_at < 0)
                 and die_after <= 0 and nan_at < 0 and spike_at < 0
-                and bitflip_at < 0):
+                and bitflip_at < 0 and kill_ctl <= 0):
             return None
         return cls(
             crash_at_step=crash if crash >= 0 else None,
@@ -186,6 +204,7 @@ class FaultPlan(object):
             nan_grad_at_step=nan_at if nan_at >= 0 else None,
             loss_spike_at_step=spike_at if spike_at >= 0 else None,
             bitflip_grad_at_step=bitflip_at if bitflip_at >= 0 else None,
+            kill_controller_after_s=kill_ctl if kill_ctl > 0 else None,
         )
 
     def targets_me(self):
@@ -366,6 +385,40 @@ def on_stream_token():
         except Exception:
             pass
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_controller(elapsed_s):
+    """Fleet-controller supervision-tick hook: SIGKILL this process the
+    first tick at/past the armed ``kill_controller_after_s`` bound
+    (``elapsed_s`` = seconds since the control loop started). SIGKILL,
+    not exit: a real controller OOM-kill runs no drain and signals no
+    replica — the surviving pool keeps serving headless, which is the
+    window the adoption trial measures. ``target_rank`` does not apply
+    (there is one controller); ``marker_dir`` one-shot applies, so the
+    trial's RESTARTED controller (same environment) never re-fires."""
+    plan = active_plan()
+    if plan is None or plan.kill_controller_after_s is None:
+        return
+    if float(elapsed_s) < float(plan.kill_controller_after_s):
+        return
+    if not _fire_once(plan, "kill_controller"):
+        return
+    print(
+        "CHAOS kill_controller_after_s=%.3f elapsed=%.3f pid=%d"
+        % (float(plan.kill_controller_after_s), float(elapsed_s),
+           os.getpid()),
+        flush=True,
+    )
+    # same black-box flush as die_after_tokens: the staged death must
+    # leave a deterministic telemetry trail for the trial to assert on.
+    # Best-effort; the kill happens regardless.
+    try:
+        from ..observability import exporter as _obs_exporter
+
+        _obs_exporter.dump_blackbox()
+    except Exception:
+        pass
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_slow_feed():

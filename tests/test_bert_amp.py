@@ -15,11 +15,13 @@ deterministic — fixed graph seed, fixed feed, single-threaded CPU XLA),
 so the assert now runs 12 steps instead of weakening the bound."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.models import bert
 
 
+@pytest.mark.slow  # ~9 s of 12-step CPU training; fast equivalents: test_amp_gray_harmonization pins the bf16 rewrite's op-level decisions the 12-step descent rides on
 def test_bert_classifier_amp_trains():
     cfg = bert.BertConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
     S, N = 16, 8

@@ -205,6 +205,7 @@ def test_submit_validation_and_overload(rig):
                 pass
 
 
+@pytest.mark.slow  # ~9 s; fast equivalents: greedy_generate_matches_reference (dense-engine token parity) + the kernel-level parity tests in test_flash_attention
 def test_flash_decode_engine_matches_dense():
     """A flash-attention engine (interpret kernels: causal prefill kernel
     + single-query decode kernel) reproduces the dense engine's tokens
@@ -616,6 +617,7 @@ def test_submit_after_stop_raises_not_hangs():
         engine.submit([1, 2])
 
 
+@pytest.mark.slow  # ~8 s; fast equivalents: needs_rng_flash_attention_attr_aware + rng_run_index_skipped_for_random_free_programs pin the same rng-skip analysis from both sides
 def test_flash_attention_dropout_mask_varies_per_step():
     """Regression for the rng-skip analysis: flash_attention consumes a
     PRNG key for in-kernel dropout, so a training program whose ONLY

@@ -113,6 +113,7 @@ def test_cpu_fallback_is_reference():
                                rtol=1e-6)
 
 
+@pytest.mark.slow  # ~16 s; fast equivalents: cpu_fallback_is_reference + gpt_flash_matches_dense (test_gpt) cover the flag->reference routing and flag-path model parity
 def test_bert_flash_flag_matches_dense_path():
     """BERT with use_flash_attention must produce the same classifier loss
     as the dense path on padded batches (on CPU the flag routes through
@@ -569,6 +570,7 @@ def test_flash_dropout_statistics_and_seed():
     np.testing.assert_allclose(o0, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~8 s; fast equivalents: flash_dropout_statistics_and_seed + the flash_dropout_kernel_matches_fallback grid
 def test_flash_dropout_keeps_expectation():
     """1/keep upscaling is unbiased: E_seed[mask/keep] -> 1 per entry, and
     the seed-averaged attention output converges toward the dense one

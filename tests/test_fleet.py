@@ -11,6 +11,8 @@ replica), and the full real stack runs once inside the probe."""
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -623,16 +625,25 @@ srv.daemon_threads = True
 threading.Thread(target=srv.serve_forever, daemon=True).start()
 stop = threading.Event()
 signal.signal(signal.SIGTERM, lambda *a: stop.set())
-tmp = endpoint_file + ".tmp"
-with open(tmp, "w") as f:
-    json.dump({"pid": os.getpid(), "version": version,
-               "gateway_port": srv.server_address[1],
-               "metrics_port": None}, f)
-os.replace(tmp, endpoint_file)
+
+def write_endpoint():
+    tmp = endpoint_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "version": version,
+                   "gateway_port": srv.server_address[1],
+                   "metrics_port": None, "lease_ts": time.time()}, f)
+    os.replace(tmp, endpoint_file)
+
+write_endpoint()
 if mode == "crash_after_ready":
     time.sleep(0.4); sys.exit(7)
+# lease discipline: re-stamp lease_ts like a real replica serve loop —
+# except in "lease_stale" mode, which stamps once and goes silent (a
+# wedged process whose port still answers)
+last = time.time()
 while not stop.wait(0.05):
-    pass
+    if mode != "lease_stale" and time.time() - last >= 0.2:
+        write_endpoint(); last = time.time()
 srv.shutdown()
 sys.exit(0)
 """
@@ -927,9 +938,421 @@ class TestFleetController:
 
 
 # ---------------------------------------------------------------------------
-# model-dir versioning (checkpoint/modeldir.py)
+# control-plane durability (ISSUE 19): journal, leases, adoption
 # ---------------------------------------------------------------------------
-class TestModeldir:
+def _crash_controller(ctrl):
+    """Simulate a controller CRASH: supervision thread and router die,
+    the journal keeps its lease, and the replicas are orphaned
+    mid-serve (nothing drains them). The journal's controller pid is
+    rewritten to a reaped child's pid so the restart sees the real
+    crash shape (a dead journal-holder) — in-process, both controllers
+    would otherwise share os.getpid()."""
+    ctrl._stop_evt.set()
+    if ctrl._tick_thread is not None:
+        ctrl._tick_thread.join(timeout=10)
+        ctrl._tick_thread = None
+    ctrl._started = False
+    if ctrl._owns_router:
+        ctrl.router.stop()
+    if ctrl._ready_gauge is not None:
+        obs_registry.unregister_gauge("fleet_replicas_ready",
+                                      ctrl._ready_gauge)
+        ctrl._ready_gauge = None
+    if ctrl._target_gauge is not None:
+        obs_registry.unregister_gauge("fleet_replicas_target",
+                                      ctrl._target_gauge)
+        ctrl._target_gauge = None
+    fleet_mod._LIVE_CONTROLLERS.discard(os.path.realpath(ctrl.workdir))
+    st = fleet_mod.read_fleet_state(ctrl.workdir)
+    st["controller"]["pid"] = _dead_pid()
+    modeldir.commit_json(
+        os.path.join(ctrl.workdir, fleet_mod.FLEET_STATE), st)
+
+
+def _dead_pid():
+    """A pid guaranteed dead (spawned, exited, fully reaped)."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def _reap_orphans(ctrl):
+    """Reap the zombie children a crashed controller's pool leaves in
+    THIS test process once a later controller kills/drains them."""
+    for r in ctrl._replicas.values():
+        try:
+            if isinstance(r.proc, subprocess.Popen):
+                r.proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def _spawn_orphan(tmp_path, rid, version, mode="serve"):
+    """A fake replica spawned OUTSIDE any controller (a survivor of a
+    crashed one): writes workdir/endpoints/replica_<rid>.json itself."""
+    epdir = tmp_path / "work" / "endpoints"
+    os.makedirs(str(epdir), exist_ok=True)
+    epf = str(epdir / ("replica_%d.json" % rid))
+    p = subprocess.Popen([sys.executable, "-c", _FAKE_REPLICA, epf,
+                          str(version), mode])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not os.path.isfile(epf):
+        time.sleep(0.02)
+    assert os.path.isfile(epf), "orphan fake replica never published"
+    return p
+
+
+def _write_journal(tmp_path, replicas, rollout=None, target=2,
+                   version=1, lease_age=3600.0, pid=0):
+    """Manufacture a fleet_state.json as a crashed controller would
+    have left it: ``replicas`` maps rid -> (version, pid). The default
+    lease is ancient and the default holder pid 0, so the split-brain
+    guard always lets the restart proceed."""
+    work = str(tmp_path / "work")
+    os.makedirs(work, exist_ok=True)
+    state = {
+        "schema_version": 1,
+        "controller": {"pid": int(pid),
+                       "lease_ts": time.time() - float(lease_age),
+                       "boot_id": "test"},
+        "intent": {"target": int(target), "version": int(version),
+                   "model_dir": str(tmp_path / "model"), "roles": {},
+                   "rollout": rollout},
+        "ledger": {"pool_crashes": 0, "crashes": 0, "gaveup": False},
+        "replicas": {
+            str(rid): {"version": v, "model_dir": str(tmp_path / "model"),
+                       "role": "mixed", "pid": p}
+            for rid, (v, p) in replicas.items()
+        },
+    }
+    modeldir.commit_json(os.path.join(work, fleet_mod.FLEET_STATE),
+                         state)
+    return state
+
+
+class TestFleetDurability:
+    def test_journal_written_mutated_and_released(self, tmp_path):
+        ctrl = _controller(tmp_path)
+        try:
+            ctrl.start(wait_ready_s=30)
+            st = fleet_mod.read_fleet_state(ctrl.workdir)
+            assert st["schema_version"] == 1
+            assert st["controller"]["pid"] == os.getpid()
+            assert st["intent"]["target"] == 2
+            assert st["intent"]["version"] == 1
+            assert st["intent"]["rollout"] is None
+            assert len(st["replicas"]) == 2
+            ctrl.scale_to(3)  # an intent mutation journals immediately
+            assert fleet_mod.read_fleet_state(
+                ctrl.workdir)["intent"]["target"] == 3
+        finally:
+            ctrl.stop()
+        st = fleet_mod.read_fleet_state(ctrl.workdir)
+        assert st["controller"] is None  # clean stop releases the lease
+        assert st["replicas"] == {}      # ...and the pool drained away
+
+    def test_torn_fleet_state_is_fresh_start(self, tmp_path):
+        work = tmp_path / "work"
+        os.makedirs(str(work))
+        with open(str(work / fleet_mod.FLEET_STATE), "w") as f:
+            f.write('{"schema_version": 1, "controller": {"pid": ')
+        assert fleet_mod.read_fleet_state(str(work)) is None
+        ctrl = _controller(tmp_path)
+        try:
+            ctrl.start(wait_ready_s=30)  # torn journal: boot fresh
+            assert ctrl.ready_count() == 2
+            assert fleet_mod.read_fleet_state(
+                ctrl.workdir)["schema_version"] == 1
+        finally:
+            ctrl.stop()
+
+    def test_torn_shared_file_readers_go_stale_not_crash(self, tmp_path):
+        from paddle_tpu.serving import kv_tier
+
+        torn = str(tmp_path / "kv_peers.json")
+        with open(torn, "w") as f:
+            f.write('{"peers": [{"id": 0, "ho')
+        assert kv_tier.read_peers(torn) == []
+        assert kv_tier.read_peers(str(tmp_path / "absent.json")) == []
+        ep = str(tmp_path / "replica_0.json")
+        with open(ep, "w") as f:
+            f.write('{"pid": 12')
+        assert fleet_mod._read_json(ep) is None
+        # absent, torn, and parseable-but-wrong-shape journals all read
+        # as "no journal" (fresh start), never an exception
+        assert fleet_mod.read_fleet_state(str(tmp_path)) is None
+        with open(str(tmp_path / fleet_mod.FLEET_STATE), "w") as f:
+            f.write("[1, 2]")
+        assert fleet_mod.read_fleet_state(str(tmp_path)) is None
+
+    def test_restart_adopts_survivors_replaces_headless_death(
+            self, tmp_path):
+        ctrl = _controller(tmp_path)
+        ctrl.start(wait_ready_s=30)
+        pids = {i["id"]: i["pid"] for i in ctrl.replica_info()}
+        _crash_controller(ctrl)
+        # one replica dies while the fleet is headless
+        dead_rid, surv_rid = min(pids), max(pids)
+        os.kill(pids[dead_rid], signal.SIGKILL)
+        time.sleep(0.2)
+        ctrl2 = _controller(tmp_path)
+        try:
+            ctrl2.start(wait_ready_s=30)
+            assert ctrl2.ready_count() == 2
+            infos = {i["id"]: i for i in ctrl2.replica_info()}
+            # the survivor was ADOPTED in place — same pid, no respawn
+            assert infos[surv_rid]["pid"] == pids[surv_rid]
+            assert infos[surv_rid]["adopted"] is True
+            assert [i["id"] for i in infos.values()
+                    if i.get("adopted")] == [surv_rid]
+            ev = fleet_mod.load_events(ctrl2.workdir)
+            names = [e["event"] for e in ev]
+            assert names.count("replica_adopt") == 1
+            assert "replica_lost" in names
+            rec = [e for e in ev if e["event"] == "controller_recover"]
+            assert rec and rec[-1]["adopted"] == 1
+            assert rec[-1]["headless_ms"] >= 0
+            # exactly one replacement across the whole log: the
+            # headless death — the survivor was never respawned
+            respawns = [e for e in ev if e["event"] == "replica_spawn"
+                        and e.get("replacement")]
+            assert len(respawns) == 1
+            # the adopted survivor serves through the new router
+            st, body, _h = _post(ctrl2.router.url("/v1/infer"), {"x": 1})
+            assert st == 200
+        finally:
+            ctrl2.stop()
+            _reap_orphans(ctrl)
+
+    def test_double_start_same_workdir_is_split_brain(self, tmp_path):
+        ctrl = _controller(tmp_path)
+        try:
+            ctrl.start(wait_ready_s=30)
+            dup = _controller(tmp_path)
+            with pytest.raises(fleet_mod.FleetLockError) as ei:
+                dup.start()
+            assert ei.value.pid == os.getpid()
+            # the loser did not disturb the incumbent
+            assert ctrl.ready_count() == 2
+        finally:
+            ctrl.stop()
+        # a clean stop releases the lease: the next start proceeds
+        ctrl3 = _controller(tmp_path)
+        try:
+            ctrl3.start(wait_ready_s=30)
+            assert ctrl3.ready_count() == 2
+        finally:
+            ctrl3.stop()
+
+    def test_split_brain_guard_via_journal_lease(self, tmp_path):
+        work = str(tmp_path / "work")
+        holder = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(120)"])
+        try:
+            # a LIVE holder with a fresh lease blocks the start
+            _write_journal(tmp_path, {}, lease_age=0.0, pid=holder.pid)
+            ctrl = _controller(tmp_path)
+            with pytest.raises(fleet_mod.FleetLockError) as ei:
+                ctrl.start()
+            assert ei.value.pid == holder.pid
+            assert ei.value.lease_age_s < 10.0
+            # a STALE lease does not block, even with the holder alive
+            # (it stopped journaling — supervising nothing)
+            _write_journal(tmp_path, {}, lease_age=3600.0,
+                           pid=holder.pid)
+            ctrl2 = _controller(tmp_path)
+            try:
+                ctrl2.start(wait_ready_s=30)
+                assert ctrl2.ready_count() == 2
+            finally:
+                ctrl2.stop()
+        finally:
+            holder.kill()
+            holder.wait()
+        # a DEAD holder with a FRESH lease does not block either (the
+        # common crash-then-restart-within-ttl case)
+        _write_journal(tmp_path, {}, lease_age=0.0, pid=holder.pid)
+        ctrl3 = _controller(tmp_path)
+        try:
+            ctrl3.start(wait_ready_s=30)
+            assert ctrl3.ready_count() == 2
+        finally:
+            ctrl3.stop()
+
+    def test_lease_expiry_kills_wedged_replica(self, tmp_path):
+        ctrl = _controller(
+            tmp_path, replicas=2, lease_ttl_s=1.0,
+            replica_cmd=_fake_cmd(
+                lambda rid: "lease_stale" if rid == 0 else "serve"),
+        )
+        try:
+            ctrl.start(wait_ready_s=30)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                ev = fleet_mod.load_events(ctrl.workdir)
+                if any(e["event"] == "replica_lease_expired"
+                       for e in ev) and ctrl.ready_count() == 2:
+                    break
+                time.sleep(0.05)
+            ev = fleet_mod.load_events(ctrl.workdir)
+            exp = [e for e in ev
+                   if e["event"] == "replica_lease_expired"]
+            assert exp and exp[0]["replica"] == 0
+            assert exp[0]["age_s"] >= 1.0  # rounded to 2dp; raw age is strictly > ttl
+            assert ctrl.ready_count() == 2  # replaced under the budget
+        finally:
+            ctrl.stop()
+
+    def test_replicas_without_lease_are_exempt(self, tmp_path):
+        """A custom replica_cmd that never stamps lease_ts must never
+        be lease-killed — the exit/ready/heartbeat checks still cover
+        it (fail-safe, stale-until-rewritten discipline)."""
+
+        class _NullProc(object):
+            pid = None
+
+            def kill(self):
+                self.killed = True
+
+            def poll(self):
+                return None
+
+        ctrl = _controller(tmp_path, lease_ttl_s=0.1)
+        epf = str(tmp_path / "ep.json")
+        r = fleet_mod._Replica(0, 1, "m", _NullProc(), epf, "hb", "obs")
+        modeldir.commit_json(epf, {"pid": 1, "gateway_port": 1})
+        assert ctrl._lease_expired(r) is False
+        # ...while a stamped-but-stale lease DOES expire
+        modeldir.commit_json(epf, {"pid": 1, "gateway_port": 1,
+                                   "lease_ts": time.time() - 9.0})
+        assert ctrl._lease_expired(r) is True
+
+    def test_interrupted_rollout_pre_flip_aborts_to_old_version(
+            self, tmp_path):
+        p0 = _spawn_orphan(tmp_path, 0, 1)
+        p1 = _spawn_orphan(tmp_path, 1, 1)
+        p2 = _spawn_orphan(tmp_path, 2, 2)  # half-born new version
+        _write_journal(
+            tmp_path, {0: (1, p0.pid), 1: (1, p1.pid), 2: (2, p2.pid)},
+            rollout={"phase": "spawning", "version": 2,
+                     "model_dir": str(tmp_path / "model"),
+                     "from_version": 1, "new_ids": [2]})
+        ctrl = _controller(tmp_path)
+        try:
+            ctrl.start(wait_ready_s=30)
+            # pre-flip: the rollout aborts cleanly — v1 keeps serving,
+            # the half-born v2 replica is killed, not adopted
+            assert ctrl.version == 1
+            assert ctrl.router.active_version == 1
+            assert ctrl.ready_count(version=1) == 2
+            assert p2.wait(timeout=10) != 0
+            ev = fleet_mod.load_events(ctrl.workdir)
+            ab = [e for e in ev if e["event"] == "rollout_abort"]
+            assert ab and ab[-1]["flipped"] is False
+            assert [e["event"] for e in ev].count("replica_adopt") == 2
+            st, body, _h = _post(ctrl.router.url("/v1/infer"), {"x": 1})
+            assert st == 200 and body["version"] == 1
+            assert fleet_mod.read_fleet_state(
+                ctrl.workdir)["intent"]["rollout"] is None
+        finally:
+            ctrl.stop()
+            for p in (p0, p1, p2):
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+
+    def test_interrupted_rollout_post_flip_resumes_drain(self,
+                                                         tmp_path):
+        p0 = _spawn_orphan(tmp_path, 0, 1)  # old-version straggler
+        p1 = _spawn_orphan(tmp_path, 1, 2)
+        p2 = _spawn_orphan(tmp_path, 2, 2)
+        _write_journal(
+            tmp_path, {0: (1, p0.pid), 1: (2, p1.pid), 2: (2, p2.pid)},
+            version=2,
+            rollout={"phase": "flipped", "version": 2,
+                     "model_dir": str(tmp_path / "model"),
+                     "from_version": 1, "new_ids": [1, 2]})
+        ctrl = _controller(tmp_path)
+        try:
+            ctrl.start(wait_ready_s=30)
+            # post-flip: the new version is the pool; the v1 straggler
+            # resumes its drain (SIGTERM -> clean exit 0)
+            assert ctrl.version == 2
+            assert ctrl.router.active_version == 2
+            assert p0.wait(timeout=20) == 0
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and any(
+                    i["version"] == 1 for i in ctrl.replica_info()):
+                time.sleep(0.05)
+            assert {i["version"]
+                    for i in ctrl.replica_info()} == {2}
+            assert ctrl.ready_count(version=2) == 2
+            ev = fleet_mod.load_events(ctrl.workdir)
+            assert "rollout_resume" in [e["event"] for e in ev]
+            st, body, _h = _post(ctrl.router.url("/v1/infer"), {"x": 1})
+            assert st == 200 and body["version"] == 2
+            assert fleet_mod.read_fleet_state(
+                ctrl.workdir)["intent"]["rollout"] is None
+        finally:
+            ctrl.stop()
+            for p in (p0, p1, p2):
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+
+    def test_chaos_kill_controller_fires_once(self, tmp_path):
+        """The FLAGS_chaos_kill_controller_after_s fault SIGKILLs the
+        armed process exactly once per marker dir — the restarted
+        controller (same env) must never re-fire."""
+        script = (
+            "import sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "from paddle_tpu.testing import chaos\n"
+            "t0 = time.monotonic()\n"
+            "for _ in range(400):\n"
+            "    chaos.maybe_kill_controller(time.monotonic() - t0)\n"
+            "    time.sleep(0.01)\n"
+            "print('SURVIVED', flush=True)\n"
+        ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["FLAGS_chaos_kill_controller_after_s"] = "0.05"
+        env["FLAGS_chaos_marker_dir"] = str(tmp_path / "markers")
+        p = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == -signal.SIGKILL
+        assert "CHAOS kill_controller" in p.stdout
+        assert os.path.isfile(
+            str(tmp_path / "markers" / "fired_kill_controller"))
+        # second process, same marker dir: the one-shot never re-fires
+        p2 = subprocess.run([sys.executable, "-c", script], env=env,
+                            capture_output=True, text=True, timeout=120)
+        assert p2.returncode == 0 and "SURVIVED" in p2.stdout
+        # disarmed (default flags): a plain run is untouched
+        env.pop("FLAGS_chaos_kill_controller_after_s")
+        env.pop("FLAGS_chaos_marker_dir")
+        p3 = subprocess.run([sys.executable, "-c", script], env=env,
+                            capture_output=True, text=True, timeout=120)
+        assert p3.returncode == 0 and "SURVIVED" in p3.stdout
+
+    def test_backend_rows_surface_adoption_fields(self, router):
+        be = _fake_backend("a", version=3)
+        try:
+            router.add_backend("a", "127.0.0.1", be.server_address[1],
+                               version=3, ready=True, adopted=True,
+                               journal_version=3)
+            rows = {b["id"]: b for b in router.backends()}
+            assert rows["a"]["adopted"] is True
+            assert rows["a"]["journal_version"] == 3
+            assert rows["a"]["lease_age_s"] is None  # no probe yet
+            router.add_backend("b", "127.0.0.1", be.server_address[1],
+                               version=3, ready=True)
+            rows = {b["id"]: b for b in router.backends()}
+            assert rows["b"]["adopted"] is False
+            assert rows["b"]["journal_version"] is None
+        finally:
+            be.shutdown()
     def _export(self, tmp_path, name, payload):
         d = tmp_path / name
         os.makedirs(str(d))
@@ -972,6 +1395,21 @@ class TestModeldir:
             modeldir.publish(e1, repo, version=3)
         v, _d = modeldir.publish(e1, repo)
         assert v == 6
+
+    def test_commit_json_atomic_no_stage_leak(self, tmp_path):
+        """commit_json is the ONE write discipline for every fleet
+        shared file: the staged tmp never survives a commit, and a
+        re-commit replaces the document in place."""
+        p = str(tmp_path / "doc.json")
+        assert modeldir.commit_json(p, {"a": 1}) == p
+        with open(p) as f:
+            assert json.load(f) == {"a": 1}
+        modeldir.commit_json(p, {"a": 2}, indent=1)
+        with open(p) as f:
+            assert json.load(f) == {"a": 2}
+        leftovers = [n for n in os.listdir(str(tmp_path))
+                     if n.startswith("doc.json.tmp")]
+        assert leftovers == []
 
     def test_fleet_resolves_repo_with_torn_latest_pointer(self,
                                                           tmp_path):
@@ -1060,6 +1498,54 @@ class TestFleetReport:
         assert rep["replica_ready_ms"]["count"] == 3
 
 
+    def test_adoption_audit_scoped_to_newest_run(self, tmp_path):
+        """The durability audit: restarts count across the WHOLE log
+        (the only fact the full history holds), adoption/respawn/lease
+        counts scope to the newest run, and adopted replicas join the
+        spawned-set so their snapshots aren't discarded as stale."""
+        work = str(tmp_path / "work")
+        os.makedirs(work)
+        from paddle_tpu.distributed.supervisor import _Log
+
+        log = _Log(os.path.join(work, fleet_mod.FLEET_LOG))
+        log.event("fleet_boot", target=3, version=1)
+        log.event("replica_spawn", replica=0, version=1)
+        log.event("replica_adopt", replica=9, version=1)  # old run
+        log.event("fleet_boot", target=3, version=1)  # the restart
+        log.event("controller_recover", adopted=2, lost=1,
+                  headless_ms=812.5)
+        log.event("replica_adopt", replica=1, version=1, pid=4242)
+        log.event("replica_adopt", replica=2, version=1, pid=4243)
+        log.event("replica_ready", replica=3, ready_ms=5.0,
+                  ready_replicas=3)
+        log.event("replica_spawn", replica=3, version=1,
+                  replacement=True)
+        log.event("replica_lease_expired", replica=2, age_s=9.0)
+        # an ADOPTED replica's snapshot dir must survive the stale
+        # filter (its id was never spawned in this run)
+        d = os.path.join(work, "obs", "replica_1")
+        os.makedirs(d)
+        with open(os.path.join(d, "rank_0.jsonl"), "w") as f:
+            f.write(json.dumps({
+                "ts": 1.0, "ts_mono": 1.0, "pid": 4242,
+                "counters": {"gateway_requests": 7},
+                "histograms": {},
+                "compiles": {"steady_recompiles": 0},
+            }) + "\n")
+        path = aggregate.write_fleet_report(work)
+        with open(path) as f:
+            rep = json.load(f)
+        assert rep["adoption"] == {
+            "controller_boots": 2,
+            "controller_restarts": 1,
+            "adopted": 2,
+            "respawned": 1,
+            "lease_expiries": 1,
+            "headless_ms": 812.5,
+        }
+        assert rep["replicas_reporting"] == [1]
+
+
 # ---------------------------------------------------------------------------
 # batcher queue-depth gauge parity (satellite)
 # ---------------------------------------------------------------------------
@@ -1133,6 +1619,25 @@ def test_fleet_probe_fast_acceptance():
     assert report["kv_tier"]["steady_recompiles"] == 0
     assert report["kv_tier_churn"]["spills"] >= 1
     assert report["kv_tier_churn"]["readmits"] >= 1
+    # controller durability (ISSUE 19): the controller SIGKILLed
+    # mid-load costs zero client stream failures through the headless
+    # window, the restart ADOPTS both survivors and replaces the one
+    # replica killed while headless (exactly one replacement spawn —
+    # adoption, not respawn), the double-start is refused, and a
+    # rollout interrupted on either side of the flip lands consistent.
+    # These bars are exactness: a controller-crash failure string is
+    # UNPREFIXED, so it never earns the throughput retry
+    cc = report["controller_crash"]
+    assert cc["stream_errors"] == 0
+    assert cc["streams"] >= 6
+    assert cc["adopted"] == 2
+    assert cc["lost"] == 1
+    assert cc["respawned"] == 1
+    assert cc["headless_ms"] > 0
+    assert cc["split_brain_blocked"] is True
+    assert cc["steady_recompiles"] == 0
+    assert cc["rollout_preflip_version"] == 1
+    assert cc["rollout_postflip_version"] == 2
 
 
 # ---------------------------------------------------------------------------

@@ -15,8 +15,11 @@ KV-cache decode runtime, -> +gateway_* with the HTTP gateway,
 -> +chaos_* with the durable-generations failover PR,
 -> +guardian_* with the training-guardian PR,
 -> +trace_* with the fleet-wide distributed-tracing PR,
--> +kv_tier_* with the fleet KV tier PR, and
--> +sim_*/slo_*/sched_* with the fleet-simulator / SLO-scheduling PR.)
+-> +kv_tier_* with the fleet KV tier PR,
+-> +sim_*/slo_*/sched_* with the fleet-simulator / SLO-scheduling PR,
+and -> +fleet_lease_*/fleet_state_*/chaos_kill_controller_* with the
+control-plane durability PR — covered by the existing fleet_*/chaos_*
+prefixes, noted here so the scope history stays complete.)
 
 A second pass lints METRIC names: every counter / histogram /
 scrape-time gauge the registry can render (every literal name at a

@@ -30,6 +30,17 @@ armed) behind a real Router — and asserts the control-plane bars:
   (``admit_windows``/``cached_prefix_tokens`` on the done event), the
   failover blip is measured, and the fleet still holds 0 steady
   recompiles;
+- CONTROLLER DURABILITY: the controller itself is SIGKILLed mid-load
+  (the ``FLAGS_chaos_kill_controller_after_s`` fault, fired from its
+  own supervision tick) over a 3-replica GPT decode fleet — the
+  headless pool keeps serving token-exact streams with zero client
+  failures, a replica SIGKILLed WHILE headless is detected and
+  replaced under the journaled crash budget by the restarted
+  controller, which ADOPTS the live survivors instead of respawning
+  them; a second controller started on the held workdir fails fast
+  with ``FleetLockError``; and a rollout interrupted by a controller
+  kill on either side of the traffic flip lands consistent (pre-flip
+  aborts to the old version, post-flip resumes the old pool's drain);
 - the router hop's added latency is measured (PERF.md), and
   ``fleet_report.json`` carries the replica timeline + scale/rollout
   events + per-replica tallies.
@@ -47,6 +58,7 @@ import argparse
 import json
 import os
 import signal
+import subprocess
 import sys
 import tempfile
 import threading
@@ -590,6 +602,472 @@ def run_kv_tier_trial(tmp, model_dir, report, failures, fast):
         _flags.set_flags({"FLAGS_" + k: v for k, v in saved.items()})
 
 
+# -- controller-durability trial (ISSUE 19) ---------------------------------
+#
+# The controller must die by SIGKILL with no drain, so it runs in a
+# RUNNER subprocess (this same script, hidden ``--runner`` mode) while
+# the probe process plays the client fleet-operator: driving SSE load
+# direct to the replica gateways through the headless window, killing a
+# replica while nobody supervises, then restarting the runner and
+# auditing the adoption from the journal + event log.
+
+GPT_SPEC = {"seed": 29, "vocab_size": 97, "hidden_size": 32,
+            "num_layers": 2, "num_heads": 2, "intermediate_size": 64,
+            "max_len": 48, "slots": 8, "prefill_buckets": [8, 16, 48]}
+
+
+def run_runner(args):
+    """``--runner`` child: a real FleetController over ``--workdir``.
+    ``serve`` supervises until the ``arm_kill`` file appears (then arms
+    the chaos controller-kill fault via flags — the next supervision
+    tick SIGKILLs this process; the marker dir makes it one-shot, so a
+    RESTARTED runner that re-arms never re-fires) or ``stop_runner``
+    appears (clean stop, exit 0). ``rollout`` deploys ``--deploy-dir``
+    and SIGKILLs itself the moment the journaled rollout phase reaches
+    ``--kill-at-phase``."""
+    from paddle_tpu.checkpoint import modeldir as _modeldir
+    from paddle_tpu.fluid import flags as _flags
+    from paddle_tpu.serving.fleet import FleetController
+
+    replica_env = {
+        "FLAGS_serving_strict_compiles": "1",
+        "FLAGS_obs_snapshot_interval_s": "1.0",
+    }
+    kwargs = {}
+    if args.gpt_decode:
+        kwargs["replica_args"] = ["--gpt-decode", args.gpt_decode]
+    ctrl = FleetController(
+        model_dir=args.model_dir, workdir=args.workdir,
+        replicas=args.replicas, replica_env=replica_env,
+        autoscale=False, seed=0,
+        # generous replica-lease TTL: 3 replicas + stream load on a
+        # 2-core box can starve a serve loop past the 5s default, and
+        # a false lease expiry would corrupt the adoption arithmetic
+        lease_ttl_s=15.0,
+        **kwargs,
+    )
+    ctrl.start()
+    ctrl.wait_ready(timeout=240)
+    _modeldir.commit_json(args.ready_file, {
+        "pid": os.getpid(),
+        "router_port": ctrl.router.port,
+    })
+    if args.runner == "rollout":
+        dep_err = []
+
+        def _deploy():
+            try:
+                ctrl.deploy(args.deploy_dir)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                dep_err.append(repr(e))
+
+        th = threading.Thread(target=_deploy, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            meta = ctrl._rollout_meta
+            if (isinstance(meta, dict)
+                    and meta.get("phase") == args.kill_at_phase):
+                os.kill(os.getpid(), signal.SIGKILL)
+            if not th.is_alive():
+                print("RUNNER rollout finished before the %r kill: %r"
+                      % (args.kill_at_phase, dep_err), flush=True)
+                return 1
+            time.sleep(0.001)
+        print("RUNNER rollout never reached phase %r"
+              % args.kill_at_phase, flush=True)
+        return 1
+    arm = os.path.join(args.workdir, "arm_kill")
+    stop = os.path.join(args.workdir, "stop_runner")
+    armed = False
+    while True:
+        if not armed and os.path.exists(arm):
+            _flags.set_flags({
+                "FLAGS_chaos_kill_controller_after_s": 0.001,
+                "FLAGS_chaos_marker_dir":
+                    os.path.join(args.workdir, "chaos_markers"),
+            })
+            armed = True
+        if os.path.exists(stop):
+            ctrl.stop()
+            return 0
+        time.sleep(0.05)
+
+
+def _spawn_runner(mode, workdir, model_dir, ready_file, replicas,
+                  gpt_decode=None, kill_at_phase=None, deploy_dir=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--runner", mode,
+           "--workdir", workdir, "--model-dir", model_dir,
+           "--ready-file", ready_file, "--replicas", str(replicas)]
+    if gpt_decode:
+        cmd += ["--gpt-decode", gpt_decode]
+    if kill_at_phase:
+        cmd += ["--kill-at-phase", kill_at_phase]
+    if deploy_dir:
+        cmd += ["--deploy-dir", deploy_dir]
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def _await_file(path, timeout, what, failures):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                pass  # torn mid-commit: stale-until-rewritten
+        time.sleep(0.1)
+    failures.append("controller-crash: %s never appeared (%.0fs)"
+                    % (what, timeout))
+    return None
+
+
+def run_controller_crash_trial(tmp, report, failures, fast):
+    """Kill the CONTROLLER (not a replica) mid-load and demand the
+    durability bars: headless serving is client-invisible, restart
+    adopts instead of respawning, a headless replica death is detected
+    and replaced under the journaled budget, a double-start is refused,
+    and an interrupted rollout lands consistent on either side of the
+    flip. Failures are UNPREFIXED: every bar here is correctness — a
+    squeezed box earns no retry."""
+    import numpy as np
+
+    from paddle_tpu import inference
+    from paddle_tpu.checkpoint import modeldir
+    from paddle_tpu.observability import registry as _reg
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving.fleet import (FleetController, FleetLockError,
+                                          read_fleet_state)
+    from paddle_tpu.serving.replica import build_gpt_decode_engine
+
+    t0 = time.monotonic()
+    cc = {}
+    workdir = os.path.join(tmp, "fleet_ctl_crash")
+    model_dir = os.path.join(tmp, "export_v1")
+
+    # the uninterrupted oracle, same seeded spec as every replica
+    oracle_engine = build_gpt_decode_engine(GPT_SPEC).start()
+    rs = np.random.RandomState(41)
+    streams = []
+    for i in range(6):
+        prompt = [int(t) for t in rs.randint(0, GPT_SPEC["vocab_size"],
+                                             9 + i)]
+        knobs = ({} if i % 2 == 0 else
+                 {"temperature": 1.2, "top_k": 16, "seed": 300 + i})
+        streams.append({"prompt": prompt, "knobs": knobs})
+    try:
+        for s in streams:
+            s["oracle"] = oracle_engine.generate(
+                s["prompt"], max_new_tokens=8, **s["knobs"]
+            ).tokens(timeout=120)
+    finally:
+        oracle_engine.stop()
+
+    def run_stream(s, port):
+        body = dict(prompt_ids=s["prompt"], max_new_tokens=8,
+                    deadline_ms=60000, **s["knobs"])
+        try:
+            _st, events, _c, _g, _h = _sse_collect(
+                "http://127.0.0.1:%d/v1/generate" % port, body,
+                timeout=90)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            return {"error": repr(e)}
+        toks = [e["token"] for e in events if "token" in e]
+        errs = [e for e in events if "error" in e]
+        if errs:
+            return {"error": "in-band %r" % errs[:1]}
+        if toks != s["oracle"]:
+            return {"error": "diverged %r != %r" % (toks, s["oracle"])}
+        return {}
+
+    def read_endpoint(rid):
+        try:
+            with open(os.path.join(workdir, "endpoints",
+                                   "replica_%d.json" % rid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # ---- phase A: 3-replica GPT fleet; SIGKILL the controller --------
+    ready1 = os.path.join(tmp, "ctl_ready_1.json")
+    runner = _spawn_runner("serve", workdir, model_dir, ready1,
+                           replicas=3, gpt_decode=json.dumps(GPT_SPEC))
+    runner2 = None
+    try:
+        if _await_file(ready1, 300, "serve runner ready", failures) is None:
+            raise RuntimeError("runner never came up")
+        eps = {rid: read_endpoint(rid) for rid in (0, 1, 2)}
+        if not all(isinstance(e, dict) and e.get("gateway_port")
+                   for e in eps.values()):
+            failures.append("controller-crash: endpoint files "
+                            "incomplete: %r" % eps)
+            raise RuntimeError("no endpoints")
+        # survivors 1 and 2 carry the client load; 0 dies headless
+        survivor_ports = [eps[1]["gateway_port"], eps[2]["gateway_port"]]
+        results = [None] * len(streams)
+
+        def client(i, port):
+            results[i] = run_stream(streams[i], port)
+
+        # round 1: streams in flight WHILE the controller is killed
+        ths = [threading.Thread(target=client,
+                                args=(i, survivor_ports[i % 2]))
+               for i in range(4)]
+        for t in ths:
+            t.start()
+        with open(os.path.join(workdir, "arm_kill"), "w") as f:
+            f.write("1")
+        runner.wait(timeout=60)
+        t_dead = time.monotonic()
+        if runner.returncode != -signal.SIGKILL:
+            failures.append(
+                "controller-crash: runner exited %r, not SIGKILL"
+                % runner.returncode)
+        # a replica dies while NOBODY is supervising
+        os.kill(eps[0]["pid"], signal.SIGKILL)
+        # round 2: streams born fully headless
+        for i in (4, 5):
+            ths.append(threading.Thread(
+                target=client, args=(i, survivor_ports[i % 2])))
+            ths[-1].start()
+        for t in ths:
+            t.join()
+        stream_errors = [(i, r["error"])
+                         for i, r in enumerate(results)
+                         if r and "error" in r]
+        if stream_errors:
+            failures.append(
+                "controller-crash: %d/%d headless streams failed: %r"
+                % (len(stream_errors), len(streams), stream_errors[:2]))
+        cc["streams"] = len(streams)
+        cc["stream_errors"] = len(stream_errors)
+
+        # ---- phase C: restart; adopt survivors, replace the dead -----
+        ready2 = os.path.join(tmp, "ctl_ready_2.json")
+        runner2 = _spawn_runner("serve", workdir, model_dir, ready2,
+                                replicas=3,
+                                gpt_decode=json.dumps(GPT_SPEC))
+        r2 = _await_file(ready2, 300, "recovery runner ready", failures)
+        if r2 is None:
+            raise RuntimeError("recovery runner never came up")
+        cc["headless_window_s"] = round(time.monotonic() - t_dead, 1)
+        ev = fleet_mod.load_events(workdir)
+        rec = [e for e in ev if e.get("event") == "controller_recover"]
+        cc["adopted"] = rec[-1]["adopted"] if rec else None
+        cc["lost"] = rec[-1]["lost"] if rec else None
+        cc["headless_ms"] = rec[-1]["headless_ms"] if rec else None
+        if not rec or rec[-1]["adopted"] != 2:
+            failures.append(
+                "controller-crash: expected 2 adopted survivors, "
+                "got %r" % (rec[-1] if rec else None))
+        if not rec or rec[-1]["lost"] != 1:
+            failures.append(
+                "controller-crash: expected 1 journaled replica lost "
+                "headless, got %r" % (rec[-1] if rec else None))
+        if not rec or not rec[-1]["headless_ms"] or \
+                rec[-1]["headless_ms"] <= 0:
+            failures.append("controller-crash: headless_ms not "
+                            "measured: %r" % (rec[-1] if rec else None))
+        boots = [i for i, e in enumerate(ev)
+                 if e.get("event") == "fleet_boot"]
+        since_boot = ev[boots[-1]:] if boots else ev
+        respawned = [e for e in since_boot
+                     if e.get("event") == "replica_spawn"
+                     and e.get("replacement")]
+        cc["respawned"] = len(respawned)
+        if len(respawned) != 1:
+            failures.append(
+                "controller-crash: expected exactly 1 replacement "
+                "spawn after recovery, got %d" % len(respawned))
+
+        # ---- split-brain guard: a second controller must refuse ------
+        blocked = False
+        try:
+            dup = FleetController(
+                model_dir=model_dir, workdir=workdir, replicas=3,
+                autoscale=False, seed=0,
+                replica_args=["--gpt-decode", json.dumps(GPT_SPEC)],
+            )
+            dup.start()
+            dup.stop()  # should be unreachable
+        except FleetLockError as e:
+            blocked = True
+            if e.pid != r2["pid"]:
+                failures.append(
+                    "controller-crash: lock error blames pid %r, the "
+                    "live runner is %r" % (e.pid, r2["pid"]))
+        except Exception as e:  # noqa: BLE001
+            failures.append(
+                "controller-crash: double start died with %r, not "
+                "FleetLockError" % e)
+        cc["split_brain_blocked"] = blocked
+        if not blocked:
+            failures.append("controller-crash: double-started "
+                            "controller was NOT refused")
+
+        # ---- the adopted pool serves through the NEW router ----------
+        state = read_fleet_state(workdir)
+        pool = (state or {}).get("replicas") or {}
+        if len(pool) != 3:
+            failures.append(
+                "controller-crash: journal pool is %r, expected 3"
+                % sorted(pool))
+        res = run_stream(streams[0], r2["router_port"])
+        if "error" in res:
+            failures.append(
+                "controller-crash: post-recovery routed stream "
+                "failed: %r" % res["error"])
+
+        # ---- strict gate across the adopted + respawned pool ---------
+        steady = scraped = 0
+        for rid in sorted(int(k) for k in pool):
+            ep = read_endpoint(rid)
+            port = (ep or {}).get("metrics_port")
+            if not port:
+                continue
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics" % port, timeout=5
+                ) as r:
+                    parsed = _reg.parse_prometheus(
+                        r.read().decode("utf-8"))
+                scraped += 1
+                steady += int(parsed.get(
+                    ("serving_steady_recompiles", ""), 0))
+            except Exception as e:  # noqa: BLE001
+                failures.append(
+                    "controller-crash metrics scrape failed: %r" % e)
+        cc["steady_recompiles"] = steady
+        if not scraped:
+            failures.append("controller-crash: no replica metrics "
+                            "scraped")
+        if steady != 0:
+            failures.append(
+                "controller-crash: %d steady-state recompiles across "
+                "the adopted pool" % steady)
+
+        with open(os.path.join(workdir, "stop_runner"), "w") as f:
+            f.write("1")
+        if runner2.wait(timeout=120) != 0:
+            failures.append(
+                "controller-crash: recovery runner clean stop exited "
+                "%r" % runner2.returncode)
+        runner2 = None
+    except RuntimeError:
+        pass  # already booked a failure above
+    finally:
+        for p in (runner, runner2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+    # ---- phase D: rollout interrupted on both sides of the flip ------
+    xd = np.random.RandomState(7).rand(1, 24).astype("float32")
+    expected = {}
+    for phase, want_version in (("spawning", 1), ("flipped", 2)):
+        wd = os.path.join(tmp, "fleet_roll_%s" % phase)
+        repo = os.path.join(tmp, "repo_roll_%s" % phase)
+        modeldir.publish(os.path.join(tmp, "export_v1"), repo)
+        key = "rollout_%s_version" % (
+            "preflip" if phase == "spawning" else "postflip")
+        cc[key] = None
+        ready_r = os.path.join(tmp, "ctl_roll_%s_ready.json" % phase)
+        roller = _spawn_runner(
+            "rollout", wd, repo, ready_r, replicas=2,
+            kill_at_phase=phase,
+            deploy_dir=os.path.join(tmp, "export_v2"))
+        rec_runner = None
+        try:
+            if _await_file(ready_r, 240, "rollout runner (%s)" % phase,
+                           failures) is None:
+                raise RuntimeError("rollout runner never came up")
+            roller.wait(timeout=240)
+            if roller.returncode != -signal.SIGKILL:
+                failures.append(
+                    "controller-crash: rollout(%s) runner exited %r, "
+                    "not SIGKILL:\n%s"
+                    % (phase, roller.returncode,
+                       (roller.stdout.read() or "")[-500:]))
+                raise RuntimeError("no kill")
+            ready_r2 = os.path.join(
+                tmp, "ctl_roll_%s_ready2.json" % phase)
+            rec_runner = _spawn_runner("serve", wd, repo, ready_r2,
+                                       replicas=2)
+            r2 = _await_file(ready_r2, 240,
+                             "rollout(%s) recovery ready" % phase,
+                             failures)
+            if r2 is None:
+                raise RuntimeError("no recovery")
+            ev = fleet_mod.load_events(wd)
+            want_ev = ("rollout_abort" if phase == "spawning"
+                       else "rollout_resume")
+            if not any(e.get("event") == want_ev for e in ev):
+                failures.append(
+                    "controller-crash: rollout(%s) recovery logged no "
+                    "%s" % (phase, want_ev))
+            state = read_fleet_state(wd)
+            got_v = ((state or {}).get("intent") or {}).get("version")
+            cc[key] = got_v
+            if got_v != want_version:
+                failures.append(
+                    "controller-crash: rollout(%s) landed on version "
+                    "%r, expected %d" % (phase, got_v, want_version))
+            vers = sorted(set(
+                m.get("version")
+                for m in ((state or {}).get("replicas") or {}).values()
+            ))
+            if vers != [want_version]:
+                failures.append(
+                    "controller-crash: rollout(%s) pool versions %r, "
+                    "expected all %d" % (phase, vers, want_version))
+            # the recovered fleet serves the landed version, exactly
+            # (v1 = the published export_v1, v2 = the deployed
+            # export_v2 — deploy() of a plain export dir serves it in
+            # place, no publish)
+            if want_version not in expected:
+                pred = inference.create_paddle_predictor(
+                    inference.AnalysisConfig(os.path.join(
+                        tmp, "export_v%d" % want_version)))
+                expected[want_version] = [np.asarray(o)
+                                          for o in pred.run([xd])]
+            from paddle_tpu.serving.gateway import (decode_tensor,
+                                                    encode_tensor)
+            st, b, h = _post(
+                "http://127.0.0.1:%d/v1/infer" % r2["router_port"],
+                {"inputs": [encode_tensor(xd)], "deadline_ms": 10000})
+            got = ([decode_tensor(x) for x in b["outputs"]]
+                   if st == 200 else None)
+            if (st != 200
+                    or int(h.get("X-Model-Version", 0)) != want_version
+                    or not all(np.array_equal(g, e) for g, e in
+                               zip(got, expected[want_version]))):
+                failures.append(
+                    "controller-crash: rollout(%s) recovered fleet "
+                    "served wrong answer (status %r, version header "
+                    "%r)" % (phase, st, h.get("X-Model-Version")))
+            with open(os.path.join(wd, "stop_runner"), "w") as f:
+                f.write("1")
+            if rec_runner.wait(timeout=120) != 0:
+                failures.append(
+                    "controller-crash: rollout(%s) recovery runner "
+                    "stop exited %r" % (phase, rec_runner.returncode))
+            rec_runner = None
+        except RuntimeError:
+            pass  # already booked a failure above
+        finally:
+            for p in (roller, rec_runner):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+
+    cc["wall_s"] = round(time.monotonic() - t0, 1)
+    report["controller_crash"] = cc
+
+
 def run_probe(fast=True, verbose=False, keep_workdir=False):
     import numpy as np
 
@@ -976,6 +1454,12 @@ def run_probe(fast=True, verbose=False, keep_workdir=False):
     except Exception as e:  # noqa: BLE001 - the trial must report, not die
         failures.append("kv-tier trial crashed: %r" % e)
 
+    # ---- controller durability: crash, adopt, reconcile --------------
+    try:
+        run_controller_crash_trial(tmp, report, failures, fast)
+    except Exception as e:  # noqa: BLE001 - the trial must report, not die
+        failures.append("controller-crash trial crashed: %r" % e)
+
     # ---- merged fleet report -----------------------------------------
     fr_path = os.path.join(workdir, "fleet_report.json")
     try:
@@ -1023,7 +1507,20 @@ def main(argv=None):
     ap.add_argument("--keep-workdir", action="store_true",
                     help="don't delete the temp workdir; prints its "
                          "path so fleet_sim.py can replay the recording")
+    # hidden: the controller-durability trial's runner child
+    ap.add_argument("--runner", choices=("serve", "rollout"),
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--workdir", help=argparse.SUPPRESS)
+    ap.add_argument("--model-dir", help=argparse.SUPPRESS)
+    ap.add_argument("--ready-file", help=argparse.SUPPRESS)
+    ap.add_argument("--replicas", type=int, default=3,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--gpt-decode", help=argparse.SUPPRESS)
+    ap.add_argument("--kill-at-phase", help=argparse.SUPPRESS)
+    ap.add_argument("--deploy-dir", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.runner:
+        return run_runner(args)
     report = run_probe(fast=args.fast, verbose=args.verbose,
                        keep_workdir=args.keep_workdir)
     print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
