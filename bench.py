@@ -128,6 +128,11 @@ def _bank_entry(line):
             "pool_anchor_len", "oom_sheds",
             "spec", "spec_tokens", "spec_speedup", "spec_acceptance",
             "spec_parity", "draft_accuracy", "baseline_tok_per_sec_user",
+            # tensor-parallel rung (gpt_decode_tp): tp is the bank_best
+            # guard flag; tp_degree is the mesh width the rate was
+            # measured at (a TP=2 rate is a different machine budget —
+            # it must never replace the single-device decode headline)
+            "tp", "tp_degree",
             # per-rung cost census (observability/xla_stats): the
             # compiled step's FLOP/HBM-byte budget banks alongside the
             # throughput so PERF.md's bytes-budget table has provenance
@@ -201,7 +206,10 @@ def bank_best(prefix):
     them. Decode rungs (tokens/sec/user) need 'decode' in the prefix,
     and the BENCH_DECODE prefix-cache rung (tokens/sec/user at ~90%
     prefix share — an amortized metric a cold-prompt decode headline
-    must never inherit) additionally needs 'prefix'."""
+    must never inherit) additionally needs 'prefix'. The tensor-parallel
+    rung (gpt_decode_tp: the same per-user rate but spread over a TP
+    mesh — a different machine budget) is likewise only visible to a
+    prefix containing 'tp'."""
     cands = [
         (slot, e)
         for slot, e in load_bank().items()
@@ -212,6 +220,7 @@ def bank_best(prefix):
         and ("prefix" in prefix or not e.get("prefix_cache"))
         and ("paged" in prefix or not e.get("paged"))
         and ("spec" in prefix or not e.get("spec"))
+        and ("tp" in prefix or not e.get("tp"))
     ]
     if not cands:
         return None, None
@@ -457,6 +466,16 @@ def decode_child_main(cfg):
     t_start = time.time()
     if cfg["platform"]:
         os.environ["JAX_PLATFORMS"] = cfg["platform"]
+    tp = int(cfg.get("tp", 0) or 0)
+    if tp > 1 and cfg["platform"] == "cpu":
+        # tp rung on the CPU backend: fork the host into tp virtual
+        # devices before jax initializes (same lever the SPMD probe and
+        # test harness use)
+        cur = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in cur:
+            os.environ["XLA_FLAGS"] = (
+                cur + " --xla_force_host_platform_device_count=%d" % tp
+            ).strip()
 
     import jax
 
@@ -557,6 +576,17 @@ def decode_child_main(cfg):
         if anchor:
             pool_blocks = streams * anchor // paged_block + 1
         eng_kw.update(block_size=paged_block, pool_blocks=pool_blocks)
+    if tp > 1:
+        # tensor-parallel rung: every decode/prefill/paged program runs
+        # GSPMD-sharded over a {"model": tp} mesh (KV pools partitioned
+        # on the heads axis, block tables replicated)
+        if jax.device_count() < tp:
+            _child_fail(
+                "config",
+                "tp rung needs >= %d devices, backend has %d"
+                % (tp, jax.device_count()),
+            )
+        eng_kw["tp"] = tp
 
     n_requests = cfg.get("requests", 4 * streams)
     max_new = cfg.get("max_new", 64)
@@ -698,6 +728,8 @@ def decode_child_main(cfg):
     if spec_k > 1:
         result.update(spec_facts)
         result.update({"spec": True, "spec_tokens": spec_k})
+    if tp > 1:
+        result.update({"tp": True, "tp_degree": tp})
     if prefix_cache:
         hit_ttfts = [h.ttft_ms for h in handles
                      if getattr(h, "cached_prefix_tokens", 0) > 0
@@ -1559,6 +1591,56 @@ def parent_main():
             tunnel_suspect = True
         return False
 
+    def try_decode_tp_tpu(slot):
+        """BENCH_DECODE=1 tensor-parallel rung: tokens/sec/user with the
+        paged engine's programs GSPMD-sharded over a {"model": TP} mesh
+        (attention heads and KV pools partitioned, block tables
+        replicated) — the serving shape the SPMD mainline exists for.
+        Banked under 'gpt_decode_tp' with the 'tp' guard flag: a TP=2
+        rate spends 2 devices per user, so bank_best hides it from every
+        prefix not containing 'tp' (mirror of the paged/spec guards;
+        'paged' is dropped from the entry — the rung is paged by
+        construction and the tp guard alone isolates it)."""
+        nonlocal tunnel_suspect
+        cfg = {
+            "platform": os.environ.get("BENCH_DECODE_PLATFORM", ""),
+            "decode": True,
+            "tp": int(os.environ.get("BENCH_DECODE_TP", "2")),
+            "streams": int(os.environ.get("BENCH_DECODE_STREAMS", "8")),
+            "max_len": int(os.environ.get("BENCH_DECODE_MAXLEN", "256")),
+            "max_new": int(os.environ.get("BENCH_DECODE_MAXNEW", "64")),
+            "prompt_len": int(os.environ.get("BENCH_DECODE_PROMPT", "32")),
+            "paged_block": int(os.environ.get("BENCH_DECODE_PAGED_BLOCK",
+                                              "16")),
+            "layers": int(os.environ.get("BENCH_DECODE_LAYERS", "12")),
+            "hidden": int(os.environ.get("BENCH_DECODE_HIDDEN", "768")),
+            "heads": int(os.environ.get("BENCH_DECODE_HEADS", "12")),
+            "vocab": int(os.environ.get("BENCH_DECODE_VOCAB", "50257")),
+            "flash": os.environ.get("BENCH_DECODE_FLASH", "0") == "1",
+        }
+        label = "decode-tp-gpt-%ds-tp%d" % (cfg["streams"], cfg["tp"])
+        result, kind, err, probe_ok = _run_attempt(
+            label, cfg, slot * tpu_scale, tpu_deadline()
+        )
+        if result is not None:
+            if result["device"] == "tpu":
+                entry = _bank_entry(dict(result, **{
+                    "metric": "gpt2_decode_tp_throughput",
+                    "value": round(result["tok_per_sec_user"], 2),
+                    "unit": "tokens/sec/user",
+                    "device": "tpu",
+                    "decode": True,
+                    "tok_per_sec": round(result["tok_per_sec"], 1),
+                    "flash_attention": cfg["flash"],
+                }))
+                entry.pop("paged", None)
+                bank_write("gpt_decode_tp", entry)
+            return True
+        note_fail("decode", label, kind, err)
+        if kind == "no_tpu" or (kind == "killed" and not probe_ok):
+            tunnel_suspect = True
+        return False
+
     def bank_cpu_fallbacks():
         # a banked TPU number makes the CPU fallback pointless — skip it
         # and leave the window to phase-D TPU retries
@@ -1620,6 +1702,9 @@ def parent_main():
         # cold rung's pool byte budget, then speculative vs width-1
         try_decode_paged_tpu(300.0)
         try_decode_spec_tpu(340.0)
+        # SPMD mainline rung: the paged rate again, sharded over a
+        # {"model": TP} mesh
+        try_decode_tp_tpu(300.0)
 
     # ---- phase C: degraded CPU fallbacks for anything still missing ----
     bank_cpu_fallbacks()

@@ -648,13 +648,18 @@ class _ScopeEnv(dict):
 # ---------------------------------------------------------------------------
 class _CompiledBlock(object):
     def __init__(self, program, block_idx, feed_names, fetch_names, place,
-                 mesh_axes=None, mesh=None):
+                 mesh_axes=None, mesh=None, spmd=None):
         # device-plane telemetry: the serializable image of this block's
         # cache key, the build span, and the build record (the recompile
         # sentinel classifies cold / program_mutation / feed_order_change
-        # / lru_eviction from the key history)
+        # / lru_eviction from the key history). A GSPMD plan enters the
+        # key twice: mesh shape + the sharding-policy fingerprint, so a
+        # policy change is a visible recompile, never silent aliasing.
         self._obs_key = _xla_stats.make_key(
-            program, feed_names, fetch_names, mesh=mesh, block_idx=block_idx
+            program, feed_names, fetch_names,
+            mesh=spmd.mesh if spmd is not None else mesh,
+            block_idx=block_idx,
+            spmd=spmd.summary() if spmd is not None else None,
         )
         t0 = time.perf_counter()
         with _obs_trace.span(
@@ -663,7 +668,7 @@ class _CompiledBlock(object):
         ):
             self._construct(
                 program, block_idx, feed_names, fetch_names, place,
-                mesh_axes, mesh,
+                mesh_axes, mesh, spmd,
             )
         _xla_stats.on_build(
             self._obs_key, (time.perf_counter() - t0) * 1e3,
@@ -671,7 +676,7 @@ class _CompiledBlock(object):
         )
 
     def _construct(self, program, block_idx, feed_names, fetch_names, place,
-                   mesh_axes, mesh):
+                   mesh_axes, mesh, spmd=None):
         import jax
 
         self.program = program
@@ -679,8 +684,20 @@ class _CompiledBlock(object):
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.place = place
-        self.mesh_axes = dict(mesh_axes or {})
-        self.mesh = mesh  # jax.sharding.Mesh for SPMD execution, or None
+        # GSPMD path (parallel.spmd.SpmdPlan): the program is traced
+        # UNTRANSFORMED (no shard_map, no collective ops — mesh_axes
+        # stays empty so every lowering keeps single-device semantics)
+        # and parallelism comes entirely from input/state placement:
+        # run() commits feeds and state with the plan's NamedShardings,
+        # jit infers in_shardings from the committed arrays, and
+        # out_shardings pins persistable outputs to the plan so donated
+        # state never drifts layout. The XLA SPMD partitioner derives
+        # the collective schedule (grad all-reduce under DP, row-matmul
+        # reduce under TP) from the annotations alone.
+        self.spmd = spmd
+        self.mesh_axes = dict(mesh_axes or {}) if spmd is None else {}
+        # jax.sharding.Mesh for legacy shard_map execution, or None
+        self.mesh = mesh if spmd is None else None
         self.segments = split_segments(program, self.block)
         self.version = program._version
         # True once any XLA segment contains a random(-grad) op: run()
@@ -827,7 +844,20 @@ class _CompiledBlock(object):
                 and not getattr(program, "_keep_mutable", False)
                 else ()
             )
-            jfn = jax.jit(fn, donate_argnums=donate)
+            if self.spmd is not None:
+                # pin persistable outputs (params, optimizer state, KV
+                # pools) to their policy shardings so the update loop's
+                # layout is a fixpoint; activations/fetches stay None =
+                # partitioner's choice
+                out_shardings = tuple(
+                    self.spmd.sharding_of(n) if n in persistable else None
+                    for n in out_names
+                )
+                jfn = jax.jit(
+                    fn, donate_argnums=donate, out_shardings=out_shardings
+                )
+            else:
+                jfn = jax.jit(fn, donate_argnums=donate)
             self._plans.append(
                 (
                     "xla",
@@ -1065,7 +1095,19 @@ class _CompiledBlock(object):
     def run(self, scope, feed, rng_key, place):
         import jax
 
-        if self.mesh is not None:
+        if self.spmd is not None:
+            # GSPMD placement: feeds batch-shard over the data axis when
+            # their leading dim divides (replicate otherwise — decode's
+            # slot indices, block tables), state lands with its policy
+            # sharding. The committed inputs ARE the parallelism spec;
+            # the traced fn never saw a mesh.
+            spmd_plan = self.spmd
+            feed_dev = None
+            feed_dev_of = spmd_plan.feed_sharding
+
+            def state_dev_for(name):
+                return spmd_plan.sharding_of(name)
+        elif self.mesh is not None:
             # sharded H2D: feeds split over the data axis; state vars land
             # with their dist_attr sharding (TP weights stay sharded
             # between steps instead of being re-replicated)
@@ -1073,10 +1115,16 @@ class _CompiledBlock(object):
 
             feed_dev = NamedSharding(self.mesh, P("data"))
 
+            def feed_dev_of(val):
+                return feed_dev
+
             def state_dev_for(name):
                 return NamedSharding(self.mesh, self._dist_spec_of(name))
         else:
             feed_dev = core.get_jax_device(place)
+
+            def feed_dev_of(val):
+                return feed_dev
 
             def state_dev_for(name):
                 return core.get_jax_device(place)
@@ -1089,6 +1137,7 @@ class _CompiledBlock(object):
         # cost) is skipped wholesale
         fast_feed = (
             self.mesh is None
+            and self.spmd is None
             and isinstance(feed, DeviceFeedBatch)
             and feed.device is not None
             and feed.device == feed_dev
@@ -1121,7 +1170,7 @@ class _CompiledBlock(object):
                     val = lookup(n)
                 if val is None:
                     raise ValueError("feed variable %r was not provided" % n)
-                feed_vals.append(_to_device(val, feed_dev))
+                feed_vals.append(_to_device(val, feed_dev_of(val)))
             mutable_vals = []
             for n in plan["mutable"]:
                 v = lookup(n)

@@ -111,6 +111,17 @@ _DEFAULTS = {
     "decode_block_size": 0,
     "decode_spec_tokens": 0,
     "decode_spec_draft": "ngram",
+    # SPMD mesh (paddle_tpu/parallel/spmd.py): spmd_decode_tp > 1 serves
+    # DecodeSession/DecodeEngine tensor-parallel over a {"model": tp}
+    # mesh (weights Megatron column/row-sharded, KV pools
+    # heads-partitioned, block tables replicated) via the GSPMD path;
+    # mesh_force_host_devices arms
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N through
+    # spmd.ensure_virtual_devices() so a CPU-only box exposes N virtual
+    # devices for single-process multi-device SPMD (0 = leave the
+    # environment alone; only effective before jax initializes).
+    "spmd_decode_tp": 1,
+    "mesh_force_host_devices": 0,
     # fleet KV tier (paddle_tpu/serving/kv_tier.py): tiered prefix-block
     # cache over the paged pool. kv_tier_host_mb sizes the host-spill
     # store (LRU-evicted device blocks spill D2H and re-admit H2D on a

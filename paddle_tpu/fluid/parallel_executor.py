@@ -23,6 +23,10 @@ class ParallelExecutor(object):
         num_trainers=1,
         trainer_id=0,
         scope=None,
+        use_spmd=False,
+        mesh_axes=None,
+        fsdp=False,
+        dist_attrs=None,
     ):
         self._main_program = main_program or default_main_program()
         self._scope = scope or core.global_scope()
@@ -31,13 +35,28 @@ class ParallelExecutor(object):
             core.tpu_places() if use_cuda else core.cpu_places()
         )
         self._exe = Executor(place)
-        self._compiled = CompiledProgram(
-            self._main_program, build_strategy=build_strategy
-        ).with_data_parallel(
-            loss_name=loss_name,
-            exec_strategy=exec_strategy or ExecutionStrategy(),
-            share_vars_from=share_vars_from._compiled if share_vars_from else None,
-        )
+        if use_spmd:
+            # GSPMD mainline (parallel/spmd.py): untransformed program,
+            # placement-derived DP/TP/FSDP — see CompiledProgram.with_mesh
+            self._compiled = CompiledProgram(
+                self._main_program, build_strategy=build_strategy
+            ).with_mesh(
+                loss_name=loss_name,
+                mesh_axes=mesh_axes,
+                fsdp=fsdp,
+                dist_attrs=dist_attrs,
+                exec_strategy=exec_strategy or ExecutionStrategy(),
+            )
+        else:
+            self._compiled = CompiledProgram(
+                self._main_program, build_strategy=build_strategy
+            ).with_data_parallel(
+                loss_name=loss_name,
+                exec_strategy=exec_strategy or ExecutionStrategy(),
+                share_vars_from=(
+                    share_vars_from._compiled if share_vars_from else None
+                ),
+            )
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict

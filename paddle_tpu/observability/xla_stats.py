@@ -174,10 +174,12 @@ def program_label(program):
         return label
 
 
-def make_key(program, feed_names, fetch_names, mesh=None, block_idx=0):
+def make_key(program, feed_names, fetch_names, mesh=None, block_idx=0,
+             spmd=None):
     """The serializable image of the executor's program cache key:
     program label + version + sorted feed names + ordered fetch names
-    (+ SPMD mesh shape / non-zero block index when applicable)."""
+    (+ SPMD mesh shape / sharding-policy summary / non-zero block index
+    when applicable)."""
     extra = []
     if block_idx:
         extra.append(("block", int(block_idx)))
@@ -186,6 +188,10 @@ def make_key(program, feed_names, fetch_names, mesh=None, block_idx=0):
             "spmd",
             tuple(zip(list(mesh.axis_names), list(mesh.devices.shape))),
         ))
+    if spmd:
+        extra.append(
+            ("spmd_policy", tuple(sorted(spmd.items())))
+        )
     return {
         "program": program_label(program),
         "version": int(getattr(program, "_version", 0)),
@@ -721,6 +727,24 @@ def summary():
     }
 
 
+# newest SPMD plan summary (set by parallel.spmd.lower via
+# set_active_spmd — a setter hook so spmd.py never imports this module
+# at its own import time and vice versa). Rides /compiles so the
+# exporter shows which mesh/policy the live compiles were built under.
+_active_spmd = None
+
+
+def set_active_spmd(summary_dict):
+    global _active_spmd
+    with _lock:
+        _active_spmd = dict(summary_dict) if summary_dict else None
+
+
+def active_spmd():
+    with _lock:
+        return dict(_active_spmd) if _active_spmd else None
+
+
 def compiles_endpoint():
     """The ``/compiles`` document: summary + full records + per-key
     census (the whole device plane in one JSON GET)."""
@@ -732,6 +756,7 @@ def compiles_endpoint():
         "rank": _trace.gang_rank(),
         "pid": os.getpid(),
         "serving_steady": _steady_count > 0,
+        "spmd": active_spmd(),
         "summary": summary(),
         "records": get_records(),
         "census": census_by_key(),

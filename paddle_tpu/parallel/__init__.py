@@ -11,3 +11,14 @@ from .mesh import (  # noqa: F401
     shard_map,
     CommContext,
 )
+from .spmd import (  # noqa: F401
+    SpmdPlan,
+    data_mesh,
+    ensure_virtual_devices,
+    hybrid_mesh,
+    load_train_checkpoint,
+    lower,
+    place_scope,
+    spec_for,
+    tp_mesh,
+)

@@ -533,10 +533,24 @@ class DecodeSession(object):
     def __init__(self, cfg, place=None, scope=None, slots=None,
                  max_len=None, prefill_buckets=None, prefix_blocks=0,
                  prefix_block=None, build_resume=False, block_size=None,
-                 pool_blocks=0, spec_tokens=None, window_cap=0):
+                 pool_blocks=0, spec_tokens=None, window_cap=0, tp=None):
         self.cfg = copy.copy(cfg)
         self.cfg.is_test = True
         self.slots = int(_flag("decode_slots", slots))
+        # tensor-parallel serving (parallel/spmd.py): tp > 1 runs every
+        # session program through the GSPMD mesh path over a
+        # {"model": tp} mesh — weights Megatron column/row-sharded, KV
+        # pools/stores heads-partitioned on dim 1, slot indices and
+        # block tables replicated. The host-side runtime (slot
+        # management, block tables, prefix index) is unchanged: only
+        # placement differs, and every device step stays ONE
+        # exe.run(...) call
+        self.tp = max(int(_flag("spmd_decode_tp", tp)), 1)
+        self._tp_mesh = None
+        if self.tp > 1:
+            from ..parallel import spmd as _spmd
+
+            self._tp_mesh = _spmd.tp_mesh(self.tp)
         max_len = int(_flag("decode_max_len", max_len))
         if max_len <= 0:
             max_len = int(cfg.max_position_embeddings)
@@ -623,12 +637,13 @@ class DecodeSession(object):
                             self.cfg, self.slots, seq_len, max_len
                         )
                     )
-                self._prefill[seq_len] = (main, next_logits.name)
+                self._prefill[seq_len] = (self._maybe_tp(main),
+                                          next_logits.name)
             with fluid.unique_name.guard():
                 main, _startup, _feeds, step_logits = (
                     _gpt.build_gpt_decode_step(self.cfg, self.slots, max_len)
                 )
-            self._decode = (main, step_logits.name)
+            self._decode = (self._maybe_tp(main), step_logits.name)
         else:
             # one window program per bucket handles ALL prefill in paged
             # mode (a monolithic prefill is just a window at offset 0),
@@ -640,7 +655,7 @@ class DecodeSession(object):
                         self.cfg, self.pool_blocks, self.block_size,
                         self.max_blocks, seq_len,
                     )
-                self._paged_window[seq_len] = (main, nl.name)
+                self._paged_window[seq_len] = (self._maybe_tp(main), nl.name)
             widths = [1]
             if self.spec_tokens > 1:
                 widths.append(self.spec_tokens)
@@ -650,12 +665,12 @@ class DecodeSession(object):
                         self.cfg, self.slots, self.pool_blocks,
                         self.block_size, self.max_blocks, step_w=w,
                     )
-                self._paged_step[w] = (main, sl.name)
+                self._paged_step[w] = (self._maybe_tp(main), sl.name)
             with fluid.unique_name.guard():
                 main, _s, _f, ok = _gpt.build_gpt_paged_block_copy(
                     self.cfg, self.pool_blocks, self.block_size, npairs=1
                 )
-            self._block_copy = (main, ok.name)
+            self._block_copy = (self._maybe_tp(main), ok.name)
         # resume-prefill family (prefix-cache hits + chunked prefill):
         # one program per bucket, prefilling a window at a FED offset.
         # Graph-built only on request — a greedy_generate 1-slot session
@@ -675,7 +690,7 @@ class DecodeSession(object):
                     main, _s, _f, nl = _gpt.build_gpt_resume_prefill(
                         self.cfg, self.slots, seq_len, max_len
                     )
-                self._resume[seq_len] = (main, nl.name)
+                self._resume[seq_len] = (self._maybe_tp(main), nl.name)
         # block-copy programs between the prefix store and slot rows —
         # both directions, each ONE compiled program with fed locations
         self._copy_in = None
@@ -686,13 +701,13 @@ class DecodeSession(object):
                     self.cfg, self.slots, max_len, self.prefix_blocks,
                     self.prefix_block, publish=False,
                 )
-            self._copy_in = (m_in, ok_in.name)
+            self._copy_in = (self._maybe_tp(m_in), ok_in.name)
             with fluid.unique_name.guard():
                 m_pub, _s, _f, ok_pub = _gpt.build_gpt_prefix_copy(
                     self.cfg, self.slots, max_len, self.prefix_blocks,
                     self.prefix_block, publish=True,
                 )
-            self._publish = (m_pub, ok_pub.name)
+            self._publish = (self._maybe_tp(m_pub), ok_pub.name)
         if self.paged:
             self._cols = np.arange(self.max_blocks * self.block_size)
         else:
@@ -702,6 +717,21 @@ class DecodeSession(object):
             for T in self.buckets
         }
         self.reset_caches()
+
+    def _maybe_tp(self, main):
+        """tp > 1: route the program through the GSPMD mesh path. The
+        returned CompiledProgram runs through the SAME
+        ``exe.run(main, feed=..., ...)`` call sites (Executor delegates),
+        so every device step below is parallelism-agnostic. Each program
+        gets its own sharding plan (its persistable set differs —
+        prefill sees caches, block-copy sees only pools)."""
+        if self._tp_mesh is None:
+            return main
+        from ..fluid import compiler as _compiler
+
+        return _compiler.CompiledProgram(main).with_mesh(
+            mesh=self._tp_mesh
+        )
 
     # -- state ---------------------------------------------------------------
     def reset_caches(self):
@@ -1090,9 +1120,11 @@ def session_for_generate(exe, cfg, scope, max_len, param_program):
             # block_size pinned 0: greedy_generate's 1-slot sessions
             # stay on the legacy contiguous path regardless of the
             # serving-engine paged flags
+            # tp likewise pinned 1: the oracle path stays single-device
+            # even when FLAGS_spmd_decode_tp arms a TP serving engine
             sess = DecodeSession(
                 cfg, place=exe.place, scope=scope_obj, slots=1,
-                max_len=max_len, block_size=0, spec_tokens=0,
+                max_len=max_len, block_size=0, spec_tokens=0, tp=1,
             )
             cache["sessions"][key] = sess
     sess.bind_params(param_program)
@@ -1478,10 +1510,13 @@ class DecodeEngine(object):
                  param_program=None, prefix_block=None,
                  prefix_cache_mb=None, prefill_chunk=None,
                  block_size=None, spec_tokens=None, spec_draft=None,
-                 pool_blocks=0, drafter=None):
+                 pool_blocks=0, drafter=None, tp=None):
         self._cfg = cfg
         self._place = place
         self._scope = scope
+        # tensor-parallel serving over the GSPMD mesh: the replica's
+        # device count; the session shards weights/KV over it
+        self.tp = max(int(_flag("spmd_decode_tp", tp)), 1)
         self._slots_arg = slots
         self._max_len_arg = max_len
         self._buckets_arg = prefill_buckets
@@ -1614,6 +1649,7 @@ class DecodeEngine(object):
                 pool_blocks=self._pool_blocks_arg,
                 spec_tokens=self.spec_tokens,
                 window_cap=self.prefill_chunk,
+                tp=self.tp,
             )
             self.allocator = BlockAllocator(self.session.pool_blocks)
             self.prefix = None
@@ -1655,6 +1691,7 @@ class DecodeEngine(object):
                 prefill_buckets=self._buckets_arg, prefix_blocks=blocks,
                 prefix_block=self.prefix_block,
                 build_resume=bool(blocks or self.prefill_chunk),
+                tp=self.tp,
             )
             self.prefix = PrefixCache(blocks, self.prefix_block) \
                 if blocks else None

@@ -198,6 +198,37 @@ def test_bank_best_never_promotes_paged_or_spec_entry(bench_mod):
     assert e["spec_acceptance"] == 0.8 and e["draft_accuracy"] == 0.9
 
 
+def test_bank_best_never_promotes_tp_entry(bench_mod):
+    """The SPMD tensor-parallel rung banks tokens/sec/user measured
+    across a {"model": TP} mesh — a rate that spends TP devices per
+    user and must never replace the single-device 'gpt_decode'
+    headline. Only a prefix containing 'tp' retrieves it, and the mesh
+    width survives the bank round-trip."""
+    b = bench_mod
+    b.bank_write(
+        "gpt_decode_tp",
+        {"metric": "gpt2_decode_tp_throughput", "value": 55555.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 256,
+         "device": "tpu", "decode": True, "tp": True, "tp_degree": 2},
+    )
+    b.bank_write(
+        "gpt_decode",
+        {"metric": "gpt2_decode_throughput", "value": 120.0,
+         "unit": "tokens/sec/user", "streams": 8, "max_len": 256,
+         "device": "tpu", "decode": True},
+    )
+    # the cold single-device headline never inherits the TP rate
+    slot, e = b.bank_best("gpt_decode")
+    assert slot == "gpt_decode" and not e.get("tp")
+    # nor does the training-headline prefix see either decode rung
+    slot, e = b.bank_best("gpt")
+    assert slot is None or not e.get("decode")
+    # the tp rung is retrievable by its own prefix with its facts intact
+    slot, e = b.bank_best("gpt_decode_tp")
+    assert e["tp"] is True and e["tp_degree"] == 2
+    assert e["value"] == 55555.0
+
+
 def test_degraded_cpu_line_has_null_vs_baseline(bench_mod):
     b = bench_mod
     line = b._resnet_line({"ips": 0.7, "device": "cpu"}, 8, ["tpu: killed"], True)

@@ -397,6 +397,7 @@ def test_flash_backward_never_materializes_scores():
                                                              shape))
 
 
+@pytest.mark.slow  # ~8 s; fast in-file equivalents: flash_grad_matches_reference + the flash_dropout_kernel_matches_fallback grid prove the same forward/backward kernels; gpt_flash_matches_dense (test_gpt) keeps a fast model-level flag-path check
 def test_bert_trains_through_flash_kernel():
     """End-to-end: a tiny BERT fine-tune step runs THROUGH the Pallas
     kernels (interpret mode) — forward and the new two-kernel backward —
@@ -599,7 +600,7 @@ def test_flash_dropout_keeps_expectation():
     assert err(16) < err(2) * 0.75  # converging toward the dense output
 
 
-@pytest.mark.slow  # ~9 s; fast equivalents: bert_trains_through_flash_kernel + dropout kernel parity
+@pytest.mark.slow  # ~9 s; fast equivalents: the flash_dropout_kernel_matches_fallback grid + flash_grad_matches_reference (bert_trains_through_flash_kernel is slow-tier now too)
 def test_bert_trains_through_flash_with_dropout():
     """End-to-end: default-dropout BERT config trains THROUGH the kernel
     (interpret mode) with finite, decreasing loss."""

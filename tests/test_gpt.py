@@ -24,6 +24,7 @@ def _feed(cfg, B, T, seed=0, lens=None):
     }
 
 
+@pytest.mark.slow  # ~12 s (30 convergence steps); fast in-file equivalent: gpt_loss_ignores_padding compiles + runs the same build_gpt_lm_train graph, and the SPMD probe (test_spmd.py acceptance) trains it DP=4 in tier-1
 def test_gpt_lm_trains():
     cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
     T, B = 24, 8

@@ -17,9 +17,10 @@ KV-cache decode runtime, -> +gateway_* with the HTTP gateway,
 -> +trace_* with the fleet-wide distributed-tracing PR,
 -> +kv_tier_* with the fleet KV tier PR,
 -> +sim_*/slo_*/sched_* with the fleet-simulator / SLO-scheduling PR,
-and -> +fleet_lease_*/fleet_state_*/chaos_kill_controller_* with the
+-> +fleet_lease_*/fleet_state_*/chaos_kill_controller_* with the
 control-plane durability PR — covered by the existing fleet_*/chaos_*
-prefixes, noted here so the scope history stays complete.)
+prefixes, noted here so the scope history stays complete —
+and -> +spmd_*/mesh_* with the SPMD-mesh mainline PR.)
 
 A second pass lints METRIC names: every counter / histogram /
 scrape-time gauge the registry can render (every literal name at a
@@ -44,7 +45,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the linted knob families (prefix with trailing underscore)
 PREFIXES = ("obs_", "dist_", "elastic_", "serving_", "decode_",
             "gateway_", "fleet_", "router_", "chaos_", "guardian_",
-            "trace_", "kv_tier_", "sim_", "slo_", "sched_")
+            "trace_", "kv_tier_", "sim_", "slo_", "sched_",
+            "spmd_", "mesh_")
 _NAME = r"((?:%s)[a-z0-9_]+)" % "|".join(p.rstrip("_") + "_" for p in PREFIXES)
 
 # the spellings a knob is consumed under: the env-bridge name and the

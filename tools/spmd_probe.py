@@ -1,0 +1,419 @@
+"""CPU-runnable closed-loop probe for the SPMD mesh mainline.
+
+Exercises the GSPMD execution subsystem (paddle_tpu/parallel/spmd.py +
+the executor/compiler graft) end to end on a single process exposing 8
+virtual CPU devices via ``--xla_force_host_platform_device_count``, and
+asserts the mainlining acceptance bars:
+
+- TP SERVING PARITY: a tensor-parallel (TP=2) paged DecodeEngine —
+  weights Megatron column/row-sharded, KV block pools heads-partitioned
+  over the ``model`` axis, block tables replicated — is token-exact vs
+  the single-device ``gpt._reference_generate`` oracle across the miss,
+  zero-copy prefix-hit, chunked-window, and resume admission paths;
+- TRAIN -> SERVE RESHARD: a DP=4-trained checkpoint (params updated
+  under the GSPMD data mesh, saved by a 4-rank CheckpointManager gang)
+  loads into a TP=2 serving replica via
+  ``spmd.load_train_checkpoint`` — every param bit-exact after the
+  topology conversion, restored weights committed on the serve mesh,
+  and the replica's output token-exact vs the oracle on the trained
+  params;
+- TRAIN DIGESTS (child process, ``JAX_ENABLE_X64``): DP=2 and FSDP=2
+  loss streams digest byte-equal the single-device run on the same
+  data stream once the f64 accumulation noise (~1e-13) is rounded back
+  to f32 — the reduction-order ULP wiggle that makes raw f32 streams
+  diverge is below the cast;
+- OPTIMIZER SHARDING: under FSDP=2 the Momentum velocity state holds
+  ~1/2 the bytes per device of the single-device run (the ZeRO-style
+  weight-update sharding of PAPERS "Automatic Cross-Replica Sharding");
+- ZERO RECOMPILES: the whole TP serving schedule (miss/hit/chunked/
+  resume churn) finishes with ``serving_steady_recompiles`` unchanged
+  under the armed strict gate — sharded placement enters the compile
+  key once at warmup and never drifts;
+- TELEMETRY: the active mesh/policy summary reaches the ``/compiles``
+  payload and the ``spmd_mesh_shape``/``spmd_sharded_params`` gauges
+  render on the exporter registry.
+
+Run directly (prints one REPORT json line + PROBE PASS/FAIL)::
+
+    python tools/spmd_probe.py --fast
+
+or via tests/test_spmd.py, which runs --fast as a tier-1 gate.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+# virtual multi-device SPMD must be armed BEFORE jax initializes; the
+# test harness wipes XLA_FLAGS in probe subprocesses, so self-set here
+_N_DEV = 8
+_cur = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _cur:
+    os.environ["XLA_FLAGS"] = (
+        _cur + " --xla_force_host_platform_device_count=%d" % _N_DEV
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA_VERSION = 1
+
+
+def _build_mlp_train(seed=90, dtype="float32"):
+    """Small fc->relu->fc->softmax-CE trainer with MOMENTUM (per-param
+    velocity state — the optimizer-sharding measurement needs real
+    accumulator bytes). Guard-reset names keep param init identical
+    across builds. fc params inherit the data dtype, so dtype="float64"
+    yields an end-to-end f64 graph."""
+    import paddle_tpu.fluid as fluid
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype=dtype)
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        logits = fluid.layers.fc(h, size=5)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, y)
+        avg = fluid.layers.mean(loss)
+        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(avg)
+    return main, startup, avg
+
+
+def run_train_leg(fast=True):
+    """The byte-equality + optimizer-bytes legs, run in a CHILD process
+    under ``JAX_ENABLE_X64`` with an all-f64 graph (empirical finding:
+    f32 GSPMD loss streams drift from single-device by reduction-order
+    ULPs — ~1.5e-8 — from step ~2; X64 alone does NOT help because
+    explicitly-f32 program vars stay f32, so the graph itself is built
+    float64 — there the same wiggle is ~1e-13 and vanishes when the
+    stream is cast back to f32 for digesting)."""
+    import hashlib
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    steps = 6 if fast else 12
+
+    def digest(losses):
+        arr = np.asarray(losses, np.float64).astype(np.float32)
+        return hashlib.sha256(arr.tobytes()).hexdigest()
+
+    def run(mode):
+        from paddle_tpu.fluid import compiler
+
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        main, startup, avg = _build_mlp_train(dtype="float64")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if mode == "single":
+                prog = main
+            else:
+                prog = compiler.CompiledProgram(main).with_mesh(
+                    loss_name=avg.name, mesh_axes={"data": 2},
+                    fsdp=(mode == "fsdp"),
+                )
+            losses = []
+            for step in range(steps):
+                rng = np.random.RandomState(77 + step)
+                bx = rng.rand(32, 16).astype("float64")
+                by = rng.randint(0, 5, size=(32, 1)).astype("int64")
+                out = exe.run(prog, feed={"x": bx, "y": by},
+                              fetch_list=[avg.name])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            # per-device optimizer-state bytes: every velocity
+            # accumulator's single-shard footprint (single-device arrays
+            # are their own one shard)
+            opt_bytes = 0
+            for v in main.list_vars():
+                if not (v.persistable and "velocity" in v.name):
+                    continue
+                val = scope.get(v.name)
+                shards = getattr(val, "addressable_shards", None)
+                if shards:
+                    opt_bytes += int(shards[0].data.nbytes)
+                else:
+                    opt_bytes += int(np.asarray(val).nbytes)
+        return digest(losses), losses, opt_bytes
+
+    d_single, l_single, b_single = run("single")
+    d_dp, _l, _b = run("dp")
+    d_fsdp, _l, b_fsdp = run("fsdp")
+    ratio = b_fsdp / max(b_single, 1)
+    return {
+        "steps": steps,
+        "digest_single": d_single,
+        "digest_dp2": d_dp,
+        "digest_fsdp2": d_fsdp,
+        "dp_equal": d_dp == d_single,
+        "fsdp_equal": d_fsdp == d_single,
+        "losses": [round(v, 6) for v in l_single],
+        "opt_bytes_single": b_single,
+        "opt_bytes_fsdp2_per_dev": b_fsdp,
+        "opt_bytes_ratio": round(ratio, 4),
+        "x64": bool(os.environ.get("JAX_ENABLE_X64")),
+    }
+
+
+def run_probe(fast=True, verbose=False):
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import checkpoint
+    from paddle_tpu.fluid import compiler, flags as _flags, profiler
+    from paddle_tpu.models import gpt
+    from paddle_tpu.observability import registry as obs_registry
+    from paddle_tpu.observability import xla_stats
+    from paddle_tpu.parallel import spmd
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    _flags.set_flags({"FLAGS_serving_strict_compiles": True})
+
+    report = {"schema_version": REPORT_SCHEMA_VERSION, "fast": bool(fast),
+              "devices": _N_DEV}
+    failures = []
+
+    max_len = 32 if fast else 48
+    block = 4
+    slots = 4
+    cfg = gpt.GPTConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.max_position_embeddings = max_len
+
+    with fluid.unique_name.guard():
+        infer, startup, _names, logits = gpt.build_gpt_infer(cfg, max_len)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+
+    # ---- DP=4 training on the GSPMD data mesh: the params this probe
+    # serves are the product of a data-parallel update loop, so the
+    # checkpoint below is genuinely "DP=4-trained". The train startup
+    # initializes the shared canonical params AND the Adam accumulators
+    # (the guard-built infer program reads the same names) ----
+    with fluid.unique_name.guard():
+        tmain, tstartup, _tfeeds, tloss = gpt.build_gpt_lm_train(
+            cfg, seq_len=16, learning_rate=1e-3
+        )
+    with fluid.executor.scope_guard(scope):
+        exe.run(tstartup)
+    train_prog = compiler.CompiledProgram(tmain).with_mesh(
+        loss_name=tloss.name, mesh_axes={"data": 4}
+    )
+    rs = np.random.RandomState(7)
+    train_losses = []
+    with fluid.executor.scope_guard(scope):
+        for _ in range(2):
+            ids = rs.randint(0, cfg.vocab_size, (8, 16, 1)).astype("int64")
+            pos = np.tile(np.arange(16).reshape(1, 16, 1), (8, 1, 1))
+            mask = np.ones((8, 16, 1), "float32")
+            out = exe.run(train_prog, feed={
+                "ids": ids, "pos_ids": pos.astype("int64"),
+                "input_mask": mask,
+            }, fetch_list=[tloss.name])
+            train_losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    report["dp4_train_losses"] = [round(v, 5) for v in train_losses]
+
+    def oracle(prompt):
+        return gpt._reference_generate(
+            exe, infer, logits, cfg, prompt, max_len, scope=scope
+        )
+
+    # ---- TP=2 serving replica over the trained params ----
+    engine = DecodeEngine(
+        cfg, scope=scope, slots=slots, max_len=max_len,
+        param_program=infer, block_size=block, tp=2,
+        prefill_chunk=8,
+        prefix_cache_mb=4 * gpt.paged_block_bytes(cfg, block) / 2.0 ** 20,
+    ).start()
+    ckpt_dir = tempfile.mkdtemp(prefix="spmd_probe_ckpt_")
+    engine2 = None
+    try:
+        c_warm = profiler.get_counters()
+        tp_parity = {}
+        # miss + chunked windows: a 17-token prompt tiles as 8/8/1
+        p_long = list(rs.randint(0, cfg.vocab_size, 17))
+        full_long = oracle(p_long)
+        s = engine.generate(p_long, max_new_tokens=6)
+        tp_parity["miss"] = (
+            s.tokens(timeout=240) == full_long[17:23]
+            and s.cached_prefix_tokens == 0
+        )
+        tp_parity["chunked_windows"] = s.admit_windows == 3
+        # zero-copy hit over the heads-sharded pool
+        s = engine.generate(p_long, max_new_tokens=6)
+        tp_parity["hit"] = (
+            s.tokens(timeout=240) == full_long[17:23]
+            and s.cached_prefix_tokens >= block
+        )
+        # resume: re-admit prompt + generated suffix, continue exact
+        s = engine.generate(p_long, max_new_tokens=6,
+                            resume_tokens=full_long[17:20])
+        tp_parity["resume"] = s.tokens(timeout=240) == full_long[20:23]
+        # slot churn: more requests than slots through the shared pool
+        churn_ok = True
+        for i in range(2 * slots):
+            p = list(rs.randint(0, cfg.vocab_size, 3 + (i % 3)))
+            got = engine.generate(p, max_new_tokens=4).tokens(timeout=240)
+            churn_ok = churn_ok and got == oracle(p)[len(p):len(p) + 4]
+        tp_parity["slot_churn"] = churn_ok
+        report["tp_parity"] = {k: bool(v) for k, v in tp_parity.items()}
+        if not all(tp_parity.values()):
+            failures.append("tp parity: %r" % tp_parity)
+
+        steady = (profiler.get_counters()
+                  .get("serving_steady_recompiles", 0)
+                  - c_warm.get("serving_steady_recompiles", 0))
+        report["strict"] = {"steady_recompiles": int(steady),
+                            "gate_armed": True}
+        if steady != 0:
+            failures.append("%d steady-state recompiles" % steady)
+
+        # ---- train-mesh -> serve-mesh conversion: 4-rank DP gang saves
+        # (params replicated -> round-robin shard ownership), a fresh
+        # TP=2 replica restores through the nranks=1 reassembly and
+        # commits every param onto the serve mesh ----
+        mgrs = [
+            checkpoint.CheckpointManager(
+                ckpt_dir, rank=r, nranks=4, commit_timeout_s=60
+            )
+            for r in range(4)
+        ]
+        for m in mgrs[1:]:
+            m.save(3, infer, scope=scope, async_=True)
+        mgrs[0].save(3, infer, scope=scope, async_=False)
+        for m in mgrs[1:]:
+            m.wait()
+        for m in mgrs:
+            m.close()
+
+        scope2 = fluid.core.Scope()
+        plan2 = spmd.lower(infer, spmd.tp_mesh(2))
+        step = spmd.load_train_checkpoint(ckpt_dir, infer, scope2, plan2)
+        params = [v.name for v in infer.list_vars() if v.persistable]
+        bit_exact = all(
+            np.array_equal(np.asarray(scope2.get(n)),
+                           np.asarray(scope.get(n)))
+            for n in params
+        )
+        qkv = next(n for n in params if n.endswith("_att_q.w_0"))
+        on_mesh = len(getattr(scope2.get(qkv), "devices", lambda: [])()) == 2
+        engine2 = DecodeEngine(
+            cfg, scope=scope2, slots=2, max_len=max_len,
+            param_program=infer, block_size=block, tp=2,
+        ).start()
+        p = list(rs.randint(0, cfg.vocab_size, 5))
+        served = engine2.generate(p).result(timeout=240)
+        reshard_parity = served == oracle(p)
+        report["reshard"] = {
+            "restored_step": int(step),
+            "params": len(params),
+            "bit_exact": bool(bit_exact),
+            "qkv_on_serve_mesh": bool(on_mesh),
+            "serve_parity": bool(reshard_parity),
+        }
+        if step != 3:
+            failures.append("reshard restored step %r != 3" % step)
+        if not bit_exact:
+            failures.append("train->serve reshard not bit-exact")
+        if not on_mesh:
+            failures.append("restored params not committed on the TP mesh")
+        if not reshard_parity:
+            failures.append("resharded replica output != oracle")
+
+        # ---- telemetry: active plan on /compiles + registry gauges ----
+        gauges = obs_registry.gauge_values()
+        rendered = obs_registry.render_prometheus()
+        endpoint = xla_stats.compiles_endpoint()
+        spmd_stanza = endpoint.get("spmd") or {}
+        mesh_gauge = 'spmd_mesh_shape{axis="model"}'
+        telemetry = {
+            "compiles_spmd": dict(spmd_stanza, mesh=list(
+                spmd_stanza.get("mesh", ())
+            )),
+            "mesh_gauge": gauges.get(mesh_gauge),
+            "sharded_params_gauge": gauges.get("spmd_sharded_params"),
+            "rendered_ok": "spmd_mesh_shape" in rendered
+            and "spmd_sharded_params" in rendered,
+        }
+        report["telemetry"] = telemetry
+        if not spmd_stanza.get("sharded_params"):
+            failures.append("/compiles carries no active spmd summary")
+        if gauges.get(mesh_gauge) != 2.0:
+            failures.append("spmd_mesh_shape model-axis gauge != 2")
+        if not telemetry["rendered_ok"]:
+            failures.append("spmd gauges missing from the exporter render")
+    finally:
+        engine.stop()
+        if engine2 is not None:
+            engine2.stop()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    # ---- f64 child: DP/FSDP byte-equal digests + optimizer bytes ----
+    env = dict(os.environ)
+    env["JAX_ENABLE_X64"] = "true"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=%d" % _N_DEV
+    cmd = [sys.executable, os.path.abspath(__file__), "--train-leg"]
+    if fast:
+        cmd.append("--fast")
+    child = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=900)
+    train = None
+    for line in child.stdout.splitlines():
+        if line.startswith("TRAINREPORT "):
+            train = json.loads(line[len("TRAINREPORT "):])
+    report["train"] = train
+    if train is None:
+        failures.append(
+            "train leg child produced no TRAINREPORT (rc=%d): %s"
+            % (child.returncode, (child.stderr or "")[-400:])
+        )
+    else:
+        if not train["dp_equal"]:
+            failures.append("dp=2 digest != single-device digest")
+        if not train["fsdp_equal"]:
+            failures.append("fsdp=2 digest != single-device digest")
+        # velocity tensors split dim 0 across 2 devices: ~0.5 plus the
+        # replicated odd-shaped stragglers
+        if not train["opt_bytes_ratio"] <= 0.6:
+            failures.append(
+                "fsdp=2 per-device optimizer bytes ratio %.3f > 0.6"
+                % train["opt_bytes_ratio"]
+            )
+
+    report["pass"] = not failures
+    report["failures"] = failures
+    if verbose:
+        print(json.dumps(report, indent=1), file=sys.stderr)
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 budget subset")
+    ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--train-leg", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: f64 child mode
+    args = ap.parse_args(argv)
+    if args.train_leg:
+        print("TRAINREPORT " + json.dumps(run_train_leg(fast=args.fast),
+                                          sort_keys=True), flush=True)
+        return 0
+    report = run_probe(fast=args.fast, verbose=args.verbose)
+    print("REPORT " + json.dumps(report, sort_keys=True), flush=True)
+    print("PROBE PASS" if report["pass"]
+          else "PROBE FAIL: %s" % "; ".join(report["failures"]))
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
